@@ -1,0 +1,1 @@
+"""Common runtime utilities (reference: common/ — SURVEY.md §2.3)."""

@@ -1,0 +1,150 @@
+"""File watching: callback with full file content on complete modification.
+
+Reference: common/file_watcher.{h,cpp} (inotify IN_CLOSE_WRITE singleton
+watcher, survives delete/recreate, file_watcher.cpp:63-120) and
+common/FilePoller.* / MultiFilePoller.* (mtime-polling alternative vendored
+from wangle).
+
+TPU-first design: a single polling implementation (mtime + content hash) —
+portable, no inotify dependency, identical callback contract: the callback
+receives the *full file content* and only fires when content actually
+changed. A singleton thread multiplexes all watched files, like the
+reference's one-epoll-thread design.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .misc import read_file as _read
+
+log = logging.getLogger(__name__)
+
+Callback = Callable[[bytes], None]
+
+
+class FileWatcher:
+    """Singleton polling file watcher.
+
+    ``add_file(path, cb)`` registers a callback fired with the file's full
+    content whenever its content changes (and once immediately if the file
+    exists). ``remove_file`` unregisters. Files may not exist yet, may be
+    deleted and recreated — the watcher keeps polling.
+    """
+
+    _instance: Optional["FileWatcher"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, poll_interval_sec: float = 0.1):
+        self._poll_interval = poll_interval_sec
+        self._lock = threading.Lock()
+        # path -> (callbacks, last_content_hash)
+        self._files: Dict[str, Tuple[List[Callback], Optional[str]]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def instance(cls) -> "FileWatcher":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    @classmethod
+    def reset_for_test(cls) -> None:
+        with cls._instance_lock:
+            if cls._instance is not None:
+                cls._instance.stop()
+            cls._instance = None
+
+    def add_file(self, path: str, callback: Callback) -> None:
+        path = os.path.abspath(path)
+        initial: Optional[bytes] = None
+        with self._lock:
+            cbs, digest = self._files.get(path, ([], None))
+            cbs = cbs + [callback]
+            # Every newly registered callback gets the current content once,
+            # even when the path was already being watched.
+            content = _read(path)
+            if content is not None:
+                digest = hashlib.sha1(content).hexdigest()
+                initial = content
+            self._files[path] = (cbs, digest)
+            self._ensure_thread()
+        if initial is not None:
+            _safe_call(callback, initial, path)
+
+    def remove_file(self, path: str, callback: Optional[Callback] = None) -> None:
+        path = os.path.abspath(path)
+        with self._lock:
+            entry = self._files.get(path)
+            if entry is None:
+                return
+            cbs, digest = entry
+            if callback is None:
+                self._files.pop(path, None)
+            else:
+                cbs = [c for c in cbs if c is not callback]
+                if cbs:
+                    self._files[path] = (cbs, digest)
+                else:
+                    self._files.pop(path, None)
+
+    def poll_now(self) -> None:
+        """Force one poll cycle synchronously (test hook)."""
+        self._poll()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # -- internals --------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="file-watcher", daemon=True
+            )
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_interval):
+            try:
+                self._poll()
+            except Exception:  # pragma: no cover - defensive
+                log.exception("file watcher poll failed")
+
+    def _poll(self) -> None:
+        with self._lock:
+            paths = list(self._files.keys())
+        for path in paths:
+            content = _read(path)
+            if content is None:
+                continue
+            digest = hashlib.sha1(content).hexdigest()
+            fire: List[Callback] = []
+            with self._lock:
+                entry = self._files.get(path)
+                if entry is None:
+                    continue
+                cbs, old_digest = entry
+                if digest != old_digest:
+                    self._files[path] = (cbs, digest)
+                    fire = list(cbs)
+            for cb in fire:
+                _safe_call(cb, content, path)
+
+
+def _safe_call(cb: Callback, content: bytes, path: str) -> None:
+    try:
+        cb(content)
+    except Exception:  # pragma: no cover - defensive
+        log.exception("file watcher callback failed for %s", path)

@@ -1,0 +1,124 @@
+"""gflags-style flag registry.
+
+The reference defines ~80 ``DEFINE_*`` gflags across the tree (e.g.
+rocksdb_replicator/replicated_db.cpp:36-90 defines 13 replication knobs) and
+exports them read-only via the status server's ``/gflags.txt``
+(common/stats/status_server.cpp). This module provides the same three
+capabilities: define-with-default, process-wide override (CLI / env / test),
+and text export.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "help", "type")
+
+    def __init__(self, name: str, default: Any, help: str):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.help = help
+        self.type = type(default)
+
+
+class FlagRegistry:
+    """Process-wide flag registry. Thread-safe."""
+
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, default: Any, help: str = "") -> None:
+        with self._lock:
+            if name in self._flags:
+                # Re-definition with an identical default is a no-op so that
+                # modules can be safely re-imported (e.g. under pytest).
+                return
+            flag = _Flag(name, default, help)
+            # Environment override: RSTPU_FLAG_<NAME>.
+            env = os.environ.get("RSTPU_FLAG_" + name.upper())
+            if env is not None:
+                flag.value = _coerce(env, flag.type)
+            self._flags[name] = flag
+
+    def get(self, name: str) -> Any:
+        return self._flags[name].value
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            flag = self._flags[name]
+            flag.value = _coerce(value, flag.type)
+
+    def reset(self, name: Optional[str] = None) -> None:
+        with self._lock:
+            names = [name] if name else list(self._flags)
+            for n in names:
+                self._flags[n].value = self._flags[n].default
+
+    def override(self, **kv: Any) -> "_FlagOverride":
+        """Scoped override for tests: ``with FLAGS.override(x=1): ...``"""
+        return _FlagOverride(self, kv)
+
+    def dump_text(self) -> str:
+        """Export in the /gflags.txt style: --name=value per line."""
+        with self._lock:
+            lines = [
+                f"--{f.name}={f.value}"
+                for f in sorted(self._flags.values(), key=lambda f: f.name)
+            ]
+        return "\n".join(lines) + "\n"
+
+    def parse_args(self, argv: list) -> list:
+        """Consume --name=value args; returns the remainder."""
+        rest = []
+        for arg in argv:
+            if arg.startswith("--") and "=" in arg:
+                name, _, val = arg[2:].partition("=")
+                if name in self._flags:
+                    self.set(name, val)
+                    continue
+            rest.append(arg)
+        return rest
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._flags[name].value
+        except KeyError:
+            raise AttributeError(f"undefined flag: {name}") from None
+
+
+class _FlagOverride:
+    def __init__(self, registry: FlagRegistry, kv: Dict[str, Any]):
+        self._registry = registry
+        self._kv = kv
+        self._saved: Dict[str, Any] = {}
+
+    def __enter__(self):
+        for k, v in self._kv.items():
+            self._saved[k] = self._registry.get(k)
+            self._registry.set(k, v)
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self._saved.items():
+            self._registry.set(k, v)
+        return False
+
+
+def _coerce(value: Any, typ: type) -> Any:
+    if isinstance(value, typ) and not (typ is bool and not isinstance(value, bool)):
+        return value
+    if typ is bool:
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return typ(value)
+
+
+FLAGS = FlagRegistry()
+define_flag = FLAGS.define

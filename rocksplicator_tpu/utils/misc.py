@@ -1,0 +1,72 @@
+"""Small host/environment helpers.
+
+Reference: common/availability_zone.{h,cpp} (AZ from EC2 metadata),
+common/network_util (local eth0 IP), common/timeutil, common/file_util,
+common/deploy_info. TPU-first: AZ comes from env/config (no EC2 metadata
+endpoint), and the host identity helpers are zero-egress.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Optional
+
+
+def availability_zone(default: str = "us-east-1a") -> str:
+    """AZ of this host. Env override RSTPU_AZ; else default (the reference
+    queries EC2 instance metadata — not applicable on TPU VMs)."""
+    return os.environ.get("RSTPU_AZ", default)
+
+
+def placement_group(default: str = "pg0") -> str:
+    return os.environ.get("RSTPU_PG", default)
+
+
+def local_ip() -> str:
+    """Best-effort local routable IP (reference common/network_util)."""
+    env = os.environ.get("RSTPU_LOCAL_IP")
+    if env:
+        return env
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        # No packets are sent for a UDP connect; this just picks the
+        # interface the kernel would route through.
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def now_us() -> int:
+    return int(time.time() * 1_000_000)
+
+
+def read_file(path: str) -> Optional[bytes]:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def write_file_atomic(path: str, data: bytes) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def build_revision() -> str:
+    """Deploy info (reference common/deploy_info)."""
+    return os.environ.get("RSTPU_BUILD_REVISION", "dev")

@@ -1,0 +1,234 @@
+"""Object store abstraction — the S3Util equivalent.
+
+Reference: common/s3util.{h,cpp} — AWS SDK wrapper with get/put/list(V2)/
+delete/copy, ``getObjects(prefix, local_dir)`` batch download
+(s3util.cpp:385-416), a direct-IO download path (s3util.h:82-103), rate
+limiter hookup, and a ``BuildS3Util`` factory keyed by bucket + rate limit.
+
+TPU-first design: a small ``ObjectStore`` interface with two backends:
+``LocalObjectStore`` (a directory tree standing in for a bucket — used by all
+tests and local deployments, filling the reference's missing S3 mock, SURVEY
+§4) and a gated ``S3ObjectStore`` stub that raises unless boto3 is present
+(no cloud deps are baked into the image). Parallel batched transfer mirrors
+the reference's 8-thread upload/download executors (admin_handler.cpp:399-407).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from .rate_limiter import ConcurrentRateLimiter
+
+
+class ObjectStoreError(Exception):
+    pass
+
+
+class ObjectStore:
+    """Abstract object store. Keys are '/'-separated paths within a bucket."""
+
+    def get_object(self, key: str, local_path: str) -> None:
+        raise NotImplementedError
+
+    def get_object_bytes(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def put_object(self, local_path: str, key: str) -> None:
+        raise NotImplementedError
+
+    def put_object_bytes(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def list_objects(self, prefix: str) -> List[str]:
+        raise NotImplementedError
+
+    def delete_object(self, key: str) -> None:
+        raise NotImplementedError
+
+    def copy_object(self, src_key: str, dst_key: str) -> None:
+        raise NotImplementedError
+
+    # -- batch ops (reference: s3util.cpp:385-416 + admin_handler 8-thread
+    #    parallel batched checkpoint transfer) ----------------------------
+
+    def get_objects(
+        self, prefix: str, local_dir: str, parallelism: int = 8
+    ) -> List[str]:
+        """Download every object under ``prefix`` into ``local_dir``.
+        Returns local file paths."""
+        keys = self.list_objects(prefix)
+        os.makedirs(local_dir, exist_ok=True)
+        results: List[str] = []
+        lock = threading.Lock()
+
+        def fetch(key: str) -> None:
+            name = key[len(prefix):].lstrip("/") or os.path.basename(key)
+            local_path = os.path.join(local_dir, name)
+            os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+            self.get_object(key, local_path)
+            with lock:
+                results.append(local_path)
+
+        with ThreadPoolExecutor(max_workers=parallelism) as pool:
+            list(pool.map(fetch, keys))
+        return sorted(results)
+
+    def put_objects(
+        self, local_paths: List[str], prefix: str, parallelism: int = 8
+    ) -> List[str]:
+        """Upload files under ``prefix``; returns the object keys. Keys are
+        ``prefix/<basename>``; duplicate basenames would silently overwrite
+        each other, so they are rejected up front."""
+        basenames = [os.path.basename(p) for p in local_paths]
+        if len(set(basenames)) != len(basenames):
+            dupes = sorted({b for b in basenames if basenames.count(b) > 1})
+            raise ObjectStoreError(f"duplicate basenames in batch: {dupes}")
+        keys: List[str] = []
+        lock = threading.Lock()
+
+        def push(local_path: str) -> None:
+            key = prefix.rstrip("/") + "/" + os.path.basename(local_path)
+            self.put_object(local_path, key)
+            with lock:
+                keys.append(key)
+
+        with ThreadPoolExecutor(max_workers=parallelism) as pool:
+            list(pool.map(push, local_paths))
+        return sorted(keys)
+
+
+class LocalObjectStore(ObjectStore):
+    """Directory-backed object store: bucket == a root directory."""
+
+    def __init__(
+        self,
+        root: str,
+        rate_limit_bytes_per_sec: Optional[float] = None,
+    ):
+        self._root = os.path.abspath(root)
+        os.makedirs(self._root, exist_ok=True)
+        self._limiter = (
+            ConcurrentRateLimiter(rate_limit_bytes_per_sec)
+            if rate_limit_bytes_per_sec
+            else None
+        )
+
+    def _path(self, key: str) -> str:
+        key = key.lstrip("/")
+        path = os.path.abspath(os.path.join(self._root, key))
+        if not path.startswith(self._root + os.sep) and path != self._root:
+            raise ObjectStoreError(f"key escapes bucket root: {key!r}")
+        return path
+
+    def _charge(self, nbytes: int) -> None:
+        if self._limiter is not None and nbytes > 0:
+            self._limiter.apply_cost(nbytes)
+
+    def get_object(self, key: str, local_path: str) -> None:
+        src = self._path(key)
+        if not os.path.isfile(src):
+            raise ObjectStoreError(f"no such object: {key}")
+        self._charge(os.path.getsize(src))
+        os.makedirs(os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
+        shutil.copyfile(src, local_path)
+
+    def get_object_bytes(self, key: str) -> bytes:
+        src = self._path(key)
+        if not os.path.isfile(src):
+            raise ObjectStoreError(f"no such object: {key}")
+        with open(src, "rb") as f:
+            data = f.read()
+        self._charge(len(data))
+        return data
+
+    def put_object(self, local_path: str, key: str) -> None:
+        if not os.path.isfile(local_path):
+            raise ObjectStoreError(f"no such local file: {local_path}")
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        self._charge(os.path.getsize(local_path))
+        tmp = dst + ".tmp"
+        shutil.copyfile(local_path, tmp)
+        os.replace(tmp, dst)
+
+    def put_object_bytes(self, key: str, data: bytes) -> None:
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        self._charge(len(data))
+        tmp = dst + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, dst)
+
+    def list_objects(self, prefix: str) -> List[str]:
+        prefix = prefix.lstrip("/")
+        out: List[str] = []
+        for dirpath, _dirnames, filenames in os.walk(self._root):
+            for name in filenames:
+                if name.endswith(".tmp"):
+                    continue
+                full = os.path.join(dirpath, name)
+                key = os.path.relpath(full, self._root).replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+    def delete_object(self, key: str) -> None:
+        path = self._path(key)
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            raise ObjectStoreError(f"no such object: {key}") from None
+
+    def copy_object(self, src_key: str, dst_key: str) -> None:
+        src, dst = self._path(src_key), self._path(dst_key)
+        if not os.path.isfile(src):
+            raise ObjectStoreError(f"no such object: {src_key}")
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copyfile(src, dst)
+
+
+class S3ObjectStore(ObjectStore):
+    """Real-S3 backend, gated like the reference's integration tests
+    (admin_handler_test.cpp --enable_integration_test). Requires boto3 at
+    runtime; not available in the build image."""
+
+    def __init__(self, bucket: str, rate_limit_bytes_per_sec: Optional[float] = None):
+        try:
+            import boto3  # type: ignore
+        except ImportError as e:  # pragma: no cover
+            raise ObjectStoreError(
+                "S3ObjectStore requires boto3; use LocalObjectStore or run "
+                "with --enable_integration_test on a host with AWS deps"
+            ) from e
+        self._bucket = bucket  # pragma: no cover
+        self._s3 = boto3.client("s3")  # pragma: no cover
+
+
+# -- factory (reference: S3Util::BuildS3Util keyed by bucket+ratelimit) ----
+
+_store_cache: Dict[Tuple[str, Optional[float]], ObjectStore] = {}
+_store_cache_lock = threading.Lock()
+
+
+def build_object_store(
+    uri: str, rate_limit_bytes_per_sec: Optional[float] = None
+) -> ObjectStore:
+    """``local:///path`` or bare ``/path`` → LocalObjectStore; ``s3://bucket``
+    → S3ObjectStore. Cached by (uri, ratelimit) like BuildS3Util."""
+    key = (uri, rate_limit_bytes_per_sec)
+    with _store_cache_lock:
+        store = _store_cache.get(key)
+        if store is None:
+            if uri.startswith("s3://"):
+                store = S3ObjectStore(uri[5:], rate_limit_bytes_per_sec)
+            elif uri.startswith("local://"):
+                store = LocalObjectStore(uri[8:], rate_limit_bytes_per_sec)
+            else:
+                store = LocalObjectStore(uri, rate_limit_bytes_per_sec)
+            _store_cache[key] = store
+        return store

@@ -1,0 +1,330 @@
+"""Stats: counters, metrics (latency histograms), and gauges.
+
+Reference: common/stats/stats.{h,cpp}:89-241 — thread-local lock-free
+counters/metrics flushed ~1s into global folly MultiLevelTimeSeries /
+TimeseriesHistogram with 1-minute windows; dynamic string names plus
+pre-registered enum names; pull-model gauges; text dump for the status
+server; tag-style names like ``metric segment=x db=y``
+(application_db_manager.cpp:120-125).
+
+TPU-first design notes: the structure is the same (thread-local write path,
+windowed global aggregation, pull-model text export), but implemented with
+per-thread buffers drained on read rather than a background flusher thread —
+Python threads are cheap to enumerate and the read path is cold.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Windowed aggregation
+# ---------------------------------------------------------------------------
+
+_WINDOW_SEC = 60          # one-minute windows, like the reference
+_NUM_WINDOWS = 60         # keep an hour of per-minute buckets
+
+
+class _TimeSeries:
+    """Multi-level-ish time series: per-minute buckets + all-time total."""
+
+    __slots__ = ("buckets", "total")
+
+    def __init__(self) -> None:
+        self.buckets: Dict[int, float] = {}
+        self.total = 0.0
+
+    def add(self, value: float, now: float) -> None:
+        b = int(now // _WINDOW_SEC)
+        self.buckets[b] = self.buckets.get(b, 0.0) + value
+        self.total += value
+        if len(self.buckets) > _NUM_WINDOWS + 2:
+            cutoff = b - _NUM_WINDOWS
+            for k in [k for k in self.buckets if k < cutoff]:
+                del self.buckets[k]
+
+    def rate_last_minute(self, now: float) -> float:
+        b = int(now // _WINDOW_SEC)
+        # Sum the previous full window and the current partial one.
+        return self.buckets.get(b, 0.0) + self.buckets.get(b - 1, 0.0)
+
+
+class _Histogram:
+    """Windowed histogram with percentile queries (log-spaced buckets)."""
+
+    __slots__ = ("windows", "count", "sum")
+
+    # log-spaced buckets, 8 per octave (~9% relative resolution), covering
+    # 2^-4 (0.0625) .. 2^40 (~1e12) — enough for sub-ms latencies through
+    # byte counts.
+    _SUB = 8
+    _MIN_EXP = -4 * 8
+    _MAX_EXP = 40 * 8
+
+    def __init__(self) -> None:
+        self.windows: Dict[int, List[int]] = {}
+        self.count = 0
+        self.sum = 0.0
+
+    @classmethod
+    def _bucket_of(cls, value: float) -> int:
+        if value <= 0:
+            return 0
+        e = int(math.floor(math.log2(value) * cls._SUB))
+        return max(cls._MIN_EXP, min(cls._MAX_EXP, e)) - cls._MIN_EXP
+
+    @classmethod
+    def _bucket_value(cls, idx: int) -> float:
+        # Upper edge of the bucket — conservative for percentile reads.
+        return 2.0 ** ((idx + cls._MIN_EXP + 1) / cls._SUB)
+
+    def add(self, value: float, now: float) -> None:
+        w = int(now // _WINDOW_SEC)
+        buckets = self.windows.get(w)
+        if buckets is None:
+            buckets = [0] * (self._MAX_EXP - self._MIN_EXP + 1)
+            self.windows[w] = buckets
+            if len(self.windows) > 3:
+                cutoff = w - 2
+                for k in [k for k in self.windows if k < cutoff]:
+                    del self.windows[k]
+        buckets[self._bucket_of(value)] += 1
+        self.count += 1
+        self.sum += value
+
+    def percentile(self, pct: float, now: Optional[float] = None) -> float:
+        """Percentile over the last ~2 windows."""
+        now = time.time() if now is None else now
+        w = int(now // _WINDOW_SEC)
+        merged = [0] * (self._MAX_EXP - self._MIN_EXP + 1)
+        for k in (w, w - 1):
+            b = self.windows.get(k)
+            if b:
+                for i, c in enumerate(b):
+                    merged[i] += c
+        total = sum(merged)
+        if total == 0:
+            return 0.0
+        target = total * pct / 100.0
+        acc = 0
+        for i, c in enumerate(merged):
+            acc += c
+            if acc >= target:
+                return self._bucket_value(i)
+        return self._bucket_value(len(merged) - 1)
+
+    def avg(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Thread-local write path
+# ---------------------------------------------------------------------------
+
+
+class _ThreadBuffer(threading.local):
+    def __init__(self) -> None:
+        self.counters: Dict[str, float] = defaultdict(float)
+        self.metrics: Dict[str, List[float]] = defaultdict(list)
+        # Guards this thread's buffers against a concurrent flush() drain.
+        # Mostly uncontended (owner thread vs the occasional drainer).
+        self.lock = threading.Lock()
+
+
+class Stats:
+    """Process-wide stats registry.
+
+    API mirrors the reference (stats.h:89-241): ``incr`` (Incr),
+    ``add_metric`` (AddMetric), gauges with pull callbacks, and
+    ``dump_text`` for the status server.
+    """
+
+    _instance: Optional["Stats"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, _TimeSeries] = {}
+        self._metrics: Dict[str, _Histogram] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._tls = _ThreadBuffer()
+        self._all_buffers: List[_ThreadBuffer] = []
+        self._buffers_lock = threading.Lock()
+        self._flush_interval = 1.0
+        self._last_flush = 0.0
+
+    # -- singleton --------------------------------------------------------
+
+    @classmethod
+    def get(cls) -> "Stats":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    @classmethod
+    def reset_for_test(cls) -> None:
+        with cls._instance_lock:
+            cls._instance = cls()
+
+    # -- write path (hot; thread-local, no lock) --------------------------
+
+    def incr(self, name: str, value: float = 1.0) -> None:
+        buf = self._buf()
+        with buf.lock:
+            buf.counters[name] += value
+        self._maybe_flush()
+
+    def add_metric(self, name: str, value: float) -> None:
+        buf = self._buf()
+        with buf.lock:
+            buf.metrics[name].append(value)
+        self._maybe_flush()
+
+    def add_gauge(self, name: str, callback: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = callback
+
+    def remove_gauge(self, name: str) -> None:
+        with self._lock:
+            self._gauges.pop(name, None)
+
+    # -- internals --------------------------------------------------------
+
+    def _buf(self) -> _ThreadBuffer:
+        buf = self._tls
+        if not getattr(buf, "_registered", False):
+            with self._buffers_lock:
+                self._all_buffers.append(
+                    _Snapshot(buf.counters, buf.metrics, buf.lock,
+                              threading.current_thread())
+                )
+            buf._registered = True  # type: ignore[attr-defined]
+        return buf
+
+    def _maybe_flush(self) -> None:
+        now = time.time()
+        if now - self._last_flush >= self._flush_interval:
+            self.flush(now)
+
+    def flush(self, now: Optional[float] = None) -> None:
+        """Drain every thread's buffer into the global windowed stores."""
+        now = time.time() if now is None else now
+        self._last_flush = now
+        with self._buffers_lock:
+            snaps = list(self._all_buffers)
+        dead: List[_Snapshot] = []
+        with self._lock:
+            for snap in snaps:
+                with snap.lock:
+                    counters = list(snap.counters.items())
+                    snap.counters.clear()
+                    metrics = list(snap.metrics.items())
+                    snap.metrics.clear()
+                    if not snap.owner.is_alive():
+                        dead.append(snap)
+                for name, v in counters:
+                    ts = self._counters.get(name)
+                    if ts is None:
+                        ts = self._counters[name] = _TimeSeries()
+                    ts.add(v, now)
+                for name, vals in metrics:
+                    h = self._metrics.get(name)
+                    if h is None:
+                        h = self._metrics[name] = _Histogram()
+                    for v in vals:
+                        h.add(v, now)
+        if dead:
+            # Prune drained buffers of exited threads so _all_buffers does
+            # not grow with every short-lived worker thread.
+            with self._buffers_lock:
+                self._all_buffers = [
+                    s for s in self._all_buffers if s not in dead
+                ]
+
+    # -- read path --------------------------------------------------------
+
+    def get_counter(self, name: str) -> float:
+        self.flush()
+        with self._lock:
+            ts = self._counters.get(name)
+            return ts.total if ts else 0.0
+
+    def counter_rate(self, name: str) -> float:
+        self.flush()
+        now = time.time()
+        with self._lock:
+            ts = self._counters.get(name)
+            return ts.rate_last_minute(now) if ts else 0.0
+
+    def metric_percentile(self, name: str, pct: float) -> float:
+        self.flush()
+        with self._lock:
+            h = self._metrics.get(name)
+            return h.percentile(pct) if h else 0.0
+
+    def metric_avg(self, name: str) -> float:
+        self.flush()
+        with self._lock:
+            h = self._metrics.get(name)
+            return h.avg() if h else 0.0
+
+    def metric_count(self, name: str) -> int:
+        self.flush()
+        with self._lock:
+            h = self._metrics.get(name)
+            return h.count if h else 0
+
+    def dump_text(self) -> str:
+        """stats.txt-style dump (status_server.cpp /stats.txt endpoint)."""
+        self.flush()
+        now = time.time()
+        lines: List[str] = []
+        with self._lock:
+            for name in sorted(self._counters):
+                ts = self._counters[name]
+                lines.append(
+                    f"counter {name} total={ts.total:.0f} "
+                    f"last_minute={ts.rate_last_minute(now):.0f}"
+                )
+            for name in sorted(self._metrics):
+                h = self._metrics[name]
+                lines.append(
+                    f"metric {name} count={h.count} avg={h.avg():.3f} "
+                    f"p50={h.percentile(50, now):.3f} "
+                    f"p90={h.percentile(90, now):.3f} "
+                    f"p99={h.percentile(99, now):.3f}"
+                )
+            gauges = list(self._gauges.items())
+        for name, cb in sorted(gauges):
+            try:
+                lines.append(f"gauge {name} value={cb():.3f}")
+            except Exception as e:  # pragma: no cover - defensive
+                lines.append(f"gauge {name} error={e!r}")
+        return "\n".join(lines) + "\n"
+
+
+class _Snapshot:
+    """Holds references to a thread's buffers so flush() can drain them."""
+
+    __slots__ = ("counters", "metrics", "lock", "owner")
+
+    def __init__(self, counters, metrics, lock, owner):
+        self.counters = counters
+        self.metrics = metrics
+        self.lock = lock
+        self.owner = owner
+
+
+def tagged(name: str, **tags: str) -> str:
+    """Tag-style metric naming: ``tagged("db_size", db="seg00001")`` →
+    ``"db_size db=seg00001"`` (reference application_db_manager.cpp:120-125)."""
+    if not tags:
+        return name
+    return name + " " + " ".join(f"{k}={v}" for k, v in sorted(tags.items()))

@@ -1,0 +1,127 @@
+"""HTTP status server for observability.
+
+Reference: common/stats/status_server.{h,cpp} — libmicrohttpd server on port
+9999 exposing ``/stats.txt``, ``/gflags.txt``, ``/dump_heap``,
+``/rocksdb_info.txt`` via a pluggable endpoint→handler map, plus an index at
+``/``. Here: stdlib ThreadingHTTPServer; ``/dump_heap`` is replaced by
+``/threads.txt`` (Python stack dump — the equivalent introspection surface)
+and ``/rocksdb_info.txt`` by ``/storage_info.txt``.
+"""
+
+from __future__ import annotations
+
+import io
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from .flags import FLAGS
+from .stats import Stats
+
+EndpointHandler = Callable[[], str]
+
+
+class StatusServer:
+    _instance: Optional["StatusServer"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, port: int = 9999, extra_endpoints: Optional[Dict[str, EndpointHandler]] = None):
+        self._port = port
+        self._endpoints: Dict[str, EndpointHandler] = {
+            "/stats.txt": lambda: Stats.get().dump_text(),
+            "/flags.txt": FLAGS.dump_text,
+            "/gflags.txt": FLAGS.dump_text,  # reference-compatible alias
+            "/threads.txt": _dump_threads,
+        }
+        if extra_endpoints:
+            self._endpoints.update(extra_endpoints)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def start_status_server(
+        cls, port: int = 9999, extra_endpoints: Optional[Dict[str, EndpointHandler]] = None
+    ) -> "StatusServer":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls(port, extra_endpoints)
+                cls._instance.start()
+            return cls._instance
+
+    @classmethod
+    def reset_for_test(cls) -> None:
+        with cls._instance_lock:
+            if cls._instance is not None:
+                cls._instance.stop()
+            cls._instance = None
+
+    def register_endpoint(self, path: str, handler: EndpointHandler) -> None:
+        self._endpoints[path] = handler
+
+    def start(self) -> None:
+        endpoints = self._endpoints
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802
+                path = self.path.split("?", 1)[0]
+                if path == "/":
+                    body = "".join(
+                        f'<a href="{p}">{p}</a><br/>\n' for p in sorted(endpoints)
+                    )
+                    ctype = "text/html"
+                elif path in endpoints:
+                    try:
+                        body = endpoints[path]()
+                    except Exception as e:
+                        self.send_response(500)
+                        self.end_headers()
+                        self.wfile.write(repr(e).encode())
+                        return
+                    ctype = "text/plain"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                data = body.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args) -> None:  # silence per-request logs
+                pass
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self._port), Handler)
+        self._port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="status-server", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def _dump_threads() -> str:
+    out = io.StringIO()
+    frames = sys._current_frames()
+    for t in threading.enumerate():
+        out.write(f"--- thread {t.name} (daemon={t.daemon}) ---\n")
+        frame = frames.get(t.ident or -1)
+        if frame:
+            traceback.print_stack(frame, file=out)
+        out.write("\n")
+    return out.getvalue()

@@ -1,0 +1,65 @@
+"""RAII latency timers and slow-request sampled logging.
+
+Reference: common/timer.h (RAII latency metric) and common/slow_log_timer.h:20-45
+(slow-request sampling logger).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from typing import Optional
+
+from .stats import Stats
+
+log = logging.getLogger(__name__)
+
+
+class Timer:
+    """Context manager that records elapsed milliseconds as a metric."""
+
+    def __init__(self, metric_name: str, stats: Optional[Stats] = None):
+        self._metric = metric_name
+        self._stats = stats or Stats.get()
+        self._start = 0.0
+        self.elapsed_ms = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.elapsed_ms = (time.monotonic() - self._start) * 1000.0
+        self._stats.add_metric(self._metric, self.elapsed_ms)
+        return False
+
+
+class SlowLogTimer(Timer):
+    """Timer that additionally logs a sampled message when elapsed time
+    exceeds ``threshold_ms`` (reference slow_log_timer.h:20-45)."""
+
+    def __init__(
+        self,
+        metric_name: str,
+        threshold_ms: float = 100.0,
+        sample_rate: float = 0.1,
+        context: str = "",
+        stats: Optional[Stats] = None,
+    ):
+        super().__init__(metric_name, stats)
+        self._threshold_ms = threshold_ms
+        self._sample_rate = sample_rate
+        self._context = context
+
+    def __exit__(self, *exc) -> bool:
+        super().__exit__(*exc)
+        if self.elapsed_ms > self._threshold_ms and random.random() < self._sample_rate:
+            log.warning(
+                "slow request: %s took %.1fms (threshold %.1fms) %s",
+                self._metric,
+                self.elapsed_ms,
+                self._threshold_ms,
+                self._context,
+            )
+        return False

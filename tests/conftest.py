@@ -1,0 +1,39 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding tests run
+without TPU hardware (the driver separately dry-runs the multichip path).
+Must set env vars before jax is first imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_singletons():
+    """Reset process-wide singletons between tests."""
+    from rocksplicator_tpu.utils.stats import Stats
+
+    Stats.reset_for_test()
+    yield
+
+
+@pytest.fixture()
+def file_watcher():
+    from rocksplicator_tpu.utils.file_watcher import FileWatcher
+
+    FileWatcher.reset_for_test()
+    w = FileWatcher.instance()
+    yield w
+    FileWatcher.reset_for_test()

@@ -1,0 +1,537 @@
+"""Tests for the common runtime layer (reference: common/tests/*)."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from rocksplicator_tpu.utils import segment_utils
+from rocksplicator_tpu.utils.concurrent_map import FastReadMap
+from rocksplicator_tpu.utils.dbconfig import DBConfigManager
+from rocksplicator_tpu.utils.flags import FlagRegistry
+from rocksplicator_tpu.utils.hot_key_detector import HotKeyDetector
+from rocksplicator_tpu.utils.object_lock import ObjectLock
+from rocksplicator_tpu.utils.objectstore import (
+    LocalObjectStore,
+    ObjectStoreError,
+    build_object_store,
+)
+from rocksplicator_tpu.utils.rate_limiter import ConcurrentRateLimiter
+from rocksplicator_tpu.utils.stats import Stats, tagged
+from rocksplicator_tpu.utils.status_server import StatusServer
+from rocksplicator_tpu.utils.timer import Timer
+
+
+# ---------------------------------------------------------------------------
+# flags
+# ---------------------------------------------------------------------------
+
+
+def test_flags_define_get_set_dump():
+    flags = FlagRegistry()
+    flags.define("max_things", 50, "how many things")
+    flags.define("enable_x", False, "toggle")
+    assert flags.max_things == 50
+    flags.set("max_things", "99")
+    assert flags.max_things == 99
+    flags.set("enable_x", "true")
+    assert flags.enable_x is True
+    dump = flags.dump_text()
+    assert "--max_things=99" in dump
+    with flags.override(max_things=1):
+        assert flags.max_things == 1
+    assert flags.max_things == 99
+    rest = flags.parse_args(["--max_things=7", "positional", "--unknown=1"])
+    assert flags.max_things == 7
+    assert rest == ["positional", "--unknown=1"]
+
+
+# ---------------------------------------------------------------------------
+# stats
+# ---------------------------------------------------------------------------
+
+
+def test_stats_counters_metrics_gauges():
+    s = Stats.get()
+    for _ in range(10):
+        s.incr("writes")
+    s.incr("bytes", 100)
+    assert s.get_counter("writes") == 10
+    assert s.get_counter("bytes") == 100
+    for v in [1, 2, 3, 4, 100]:
+        s.add_metric("latency", v)
+    assert s.metric_count("latency") == 5
+    assert s.metric_avg("latency") == pytest.approx(22.0)
+    assert s.metric_percentile("latency", 50) <= s.metric_percentile("latency", 99)
+    s.add_gauge("queue_depth", lambda: 7.0)
+    dump = s.dump_text()
+    assert "counter writes total=10" in dump
+    assert "metric latency" in dump
+    assert "gauge queue_depth value=7.000" in dump
+
+
+def test_stats_multithreaded_stress():
+    s = Stats.get()
+    n_threads, n_iters = 8, 2000
+
+    def worker():
+        for _ in range(n_iters):
+            s.incr("stress_counter")
+            s.add_metric("stress_metric", 1.0)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert s.get_counter("stress_counter") == n_threads * n_iters
+    assert s.metric_count("stress_metric") == n_threads * n_iters
+
+
+def test_tagged_names():
+    assert tagged("db_size", db="seg00001", segment="seg") == (
+        "db_size db=seg00001 segment=seg"
+    )
+
+
+def test_timer_records_metric():
+    s = Stats.get()
+    with Timer("op_ms", s):
+        time.sleep(0.01)
+    assert s.metric_count("op_ms") == 1
+    assert s.metric_avg("op_ms") >= 5.0
+
+
+# ---------------------------------------------------------------------------
+# segment utils (reference common/tests/ segment tests)
+# ---------------------------------------------------------------------------
+
+
+def test_segment_utils_roundtrip():
+    assert segment_utils.segment_to_db_name("seg", 42) == "seg00042"
+    assert segment_utils.db_name_to_segment("seg00042") == "seg"
+    assert segment_utils.extract_shard_id("seg00042") == 42
+    assert segment_utils.extract_shard_id("bad") == -1
+    assert segment_utils.db_name_to_partition_name("test00100") == "test_100"
+    assert segment_utils.partition_name_to_db_name("test_100") == "test00100"
+    with pytest.raises(ValueError):
+        segment_utils.segment_to_db_name("seg", 100000)
+
+
+# ---------------------------------------------------------------------------
+# object lock (reference common/tests/object_lock_test.cpp)
+# ---------------------------------------------------------------------------
+
+
+def test_object_lock_serializes_per_key():
+    lock = ObjectLock()
+    order = []
+
+    def hold(key, tag, dur):
+        with lock.locked(key):
+            order.append(("start", tag))
+            time.sleep(dur)
+            order.append(("end", tag))
+
+    t1 = threading.Thread(target=hold, args=("db1", "a", 0.05))
+    t1.start()
+    time.sleep(0.01)
+    t2 = threading.Thread(target=hold, args=("db1", "b", 0.0))
+    t3 = threading.Thread(target=hold, args=("db2", "c", 0.0))
+    t2.start()
+    t3.start()
+    for t in (t1, t2, t3):
+        t.join()
+    # b must start only after a ends; c is unconstrained.
+    ia_end = order.index(("end", "a"))
+    ib_start = order.index(("start", "b"))
+    assert ib_start > ia_end
+    assert lock.num_live_locks() == 0
+
+
+def test_object_lock_stress():
+    lock = ObjectLock()
+    counters = {f"k{i}": 0 for i in range(4)}
+
+    def worker():
+        for i in range(500):
+            key = f"k{i % 4}"
+            with lock.locked(key):
+                counters[key] += 1
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(counters.values()) == 8 * 500
+    assert lock.num_live_locks() == 0
+
+
+def test_object_lock_try_lock():
+    lock = ObjectLock()
+    lock.lock("x")
+    got = []
+    t = threading.Thread(target=lambda: got.append(lock.try_lock("x")))
+    t.start()
+    t.join()
+    assert got == [False]
+    lock.unlock("x")
+    assert lock.try_lock("x")
+    lock.unlock("x")
+
+
+# ---------------------------------------------------------------------------
+# rate limiter (reference common/tests/concurrent_rate_limiter_test.cpp)
+# ---------------------------------------------------------------------------
+
+
+def test_rate_limiter_basic():
+    rl = ConcurrentRateLimiter(rate=100.0, burst=10.0)
+    assert rl.try_get(10.0)
+    assert not rl.try_get(5.0)
+    time.sleep(0.06)
+    assert rl.try_get(5.0)
+
+
+def test_rate_limiter_blocking_and_stress():
+    rl = ConcurrentRateLimiter(rate=10000.0, burst=100.0)
+    acquired = [0]
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(50):
+            rl.apply_cost(1.0)
+            with lock:
+                acquired[0] += 1
+
+    start = time.monotonic()
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert acquired[0] == 200
+    # 200 tokens at 10k/s with 100 burst: should finish well under a second.
+    assert time.monotonic() - start < 2.0
+
+
+# ---------------------------------------------------------------------------
+# hot key detector (reference common/tests/hot_key_detector_test.cpp)
+# ---------------------------------------------------------------------------
+
+
+def test_hot_key_detector_finds_hot_key():
+    det = HotKeyDetector(num_buckets=10)
+    for i in range(1000):
+        det.record("hot")
+        det.record(f"cold{i % 100}")
+    assert det.is_above("hot", 0.3)
+    assert not det.is_above("cold1", 0.3)
+    top = det.top(1)
+    assert top[0][0] == "hot"
+
+
+def test_hot_key_detector_stress():
+    det = HotKeyDetector(num_buckets=50)
+
+    def worker(tid):
+        for i in range(2000):
+            det.record((tid, i % 20))
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(det.top(100)) <= 50
+
+
+# ---------------------------------------------------------------------------
+# FastReadMap (reference rocksdb_replicator/tests/fast_read_map_test.cpp)
+# ---------------------------------------------------------------------------
+
+
+def test_fast_read_map_semantics():
+    m = FastReadMap()
+    assert m.add("a", 1)
+    assert not m.add("a", 2)  # no overwrite
+    assert m.get("a") == 1
+    assert m.remove("a")
+    assert not m.remove("a")
+    assert m.get("a") is None
+
+
+def test_fast_read_map_stress():
+    m = FastReadMap()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            m.add(f"k{i % 50}", i)
+            m.remove(f"k{(i + 25) % 50}")
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            snap = m.snapshot()
+            try:
+                for k, v in snap.items():
+                    assert isinstance(v, int)
+            except RuntimeError as e:  # dict mutated during iteration
+                errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(2)] + [
+        threading.Thread(target=reader) for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors  # snapshots must be immune to concurrent writes
+
+
+# ---------------------------------------------------------------------------
+# file watcher + dbconfig (reference common/tests/file_watcher_test.cpp)
+# ---------------------------------------------------------------------------
+
+
+def test_file_watcher_fires_on_change(tmp_path, file_watcher):
+    path = tmp_path / "conf.json"
+    path.write_bytes(b"v1")
+    seen = []
+    file_watcher.add_file(str(path), seen.append)
+    assert seen == [b"v1"]  # initial content delivered
+    path.write_bytes(b"v2")
+    file_watcher.poll_now()
+    assert seen[-1] == b"v2"
+    # delete/recreate survival
+    path.unlink()
+    file_watcher.poll_now()
+    path.write_bytes(b"v3")
+    file_watcher.poll_now()
+    assert seen[-1] == b"v3"
+    # unchanged content does not re-fire
+    n = len(seen)
+    file_watcher.poll_now()
+    assert len(seen) == n
+
+
+def test_dbconfig_replication_mode(tmp_path, file_watcher):
+    DBConfigManager.reset_for_test()
+    path = tmp_path / "dbconfig.json"
+    path.write_text(json.dumps({"seg": {"replication_mode": 2}}))
+    mgr = DBConfigManager.get()
+    mgr.load_from_file(str(path), watch=True)
+    assert mgr.get_replication_mode("seg") == 2
+    assert mgr.get_replication_mode("other") == 0
+    path.write_text(json.dumps({"seg": {"replication_mode": 1}}))
+    file_watcher.poll_now()
+    assert mgr.get_replication_mode("seg") == 1
+    # invalid JSON keeps previous config
+    path.write_text("{broken")
+    file_watcher.poll_now()
+    assert mgr.get_replication_mode("seg") == 1
+    DBConfigManager.reset_for_test()
+
+
+# ---------------------------------------------------------------------------
+# object store (fills the reference's missing S3 mock; s3_util_test.cpp analog)
+# ---------------------------------------------------------------------------
+
+
+def test_local_object_store_roundtrip(tmp_path):
+    store = LocalObjectStore(str(tmp_path / "bucket"))
+    src = tmp_path / "f1.sst"
+    src.write_bytes(b"hello sst")
+    store.put_object(str(src), "backups/db1/f1.sst")
+    store.put_object_bytes("backups/db1/f2.sst", b"second")
+    assert store.list_objects("backups/db1") == [
+        "backups/db1/f1.sst",
+        "backups/db1/f2.sst",
+    ]
+    assert store.get_object_bytes("backups/db1/f2.sst") == b"second"
+    out_dir = tmp_path / "restore"
+    paths = store.get_objects("backups/db1", str(out_dir))
+    assert len(paths) == 2
+    assert (out_dir / "f1.sst").read_bytes() == b"hello sst"
+    store.copy_object("backups/db1/f1.sst", "backups/db2/f1.sst")
+    assert store.get_object_bytes("backups/db2/f1.sst") == b"hello sst"
+    store.delete_object("backups/db1/f1.sst")
+    with pytest.raises(ObjectStoreError):
+        store.get_object_bytes("backups/db1/f1.sst")
+    with pytest.raises(ObjectStoreError):
+        store._path("../escape")
+
+
+def test_object_store_factory_cached(tmp_path):
+    a = build_object_store(str(tmp_path / "b1"))
+    b = build_object_store(str(tmp_path / "b1"))
+    c = build_object_store(str(tmp_path / "b2"))
+    assert a is b
+    assert a is not c
+
+
+def test_put_objects_batch(tmp_path):
+    store = LocalObjectStore(str(tmp_path / "bucket"))
+    files = []
+    for i in range(10):
+        p = tmp_path / f"part{i}.sst"
+        p.write_bytes(b"x" * i)
+        files.append(str(p))
+    keys = store.put_objects(files, "ckpt/v1", parallelism=4)
+    assert len(keys) == 10
+    assert store.list_objects("ckpt/v1") == keys
+
+
+# ---------------------------------------------------------------------------
+# status server (reference common/tests/ status server coverage)
+# ---------------------------------------------------------------------------
+
+
+def test_status_server_endpoints():
+    StatusServer.reset_for_test()
+    Stats.get().incr("served")
+    srv = StatusServer.start_status_server(port=0, extra_endpoints={
+        "/storage_info.txt": lambda: "dbs=0\n",
+    })
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        stats_txt = urllib.request.urlopen(base + "/stats.txt").read().decode()
+        assert "counter served" in stats_txt
+        index = urllib.request.urlopen(base + "/").read().decode()
+        assert "/stats.txt" in index
+        info = urllib.request.urlopen(base + "/storage_info.txt").read().decode()
+        assert info == "dbs=0\n"
+        threads_txt = urllib.request.urlopen(base + "/threads.txt").read().decode()
+        assert "thread" in threads_txt
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope")
+    finally:
+        StatusServer.reset_for_test()
+
+
+# ---------------------------------------------------------------------------
+# hedged requests (reference common/tests/future_util gap — covered here)
+# ---------------------------------------------------------------------------
+
+
+def test_speculate_primary_wins():
+    import asyncio
+
+    from rocksplicator_tpu.utils.future_util import speculate
+
+    async def fast():
+        return "primary"
+
+    async def slow():
+        await asyncio.sleep(1)
+        return "backup"
+
+    assert asyncio.run(speculate(fast, slow, 0.05)) == "primary"
+
+
+def test_speculate_backup_wins_on_slow_primary():
+    import asyncio
+
+    from rocksplicator_tpu.utils.future_util import speculate
+
+    async def stuck():
+        await asyncio.sleep(5)
+        return "primary"
+
+    async def quick():
+        return "backup"
+
+    async def run():
+        return await asyncio.wait_for(speculate(stuck, quick, 0.01), 2)
+
+    assert asyncio.run(run()) == "backup"
+
+
+def test_speculate_backup_after_primary_failure():
+    import asyncio
+
+    from rocksplicator_tpu.utils.future_util import speculate
+
+    async def failing():
+        raise RuntimeError("boom")
+
+    async def quick():
+        return "backup"
+
+    assert asyncio.run(speculate(failing, quick, 0.5)) == "backup"
+
+
+# ---------------------------------------------------------------------------
+# regression tests from code review
+# ---------------------------------------------------------------------------
+
+
+def test_rate_limiter_oversized_cost_terminates():
+    # cost > burst must incur token debt, not hang (AWS ApplyCost semantics)
+    rl = ConcurrentRateLimiter(rate=1000.0, burst=10.0)
+    slept = rl.apply_cost(50.0)
+    assert slept >= 0.0
+    # bucket is now in debt: an immediate try_get must fail
+    assert not rl.try_get(1.0)
+
+
+def test_put_objects_rejects_duplicate_basenames(tmp_path):
+    store = LocalObjectStore(str(tmp_path / "bucket"))
+    d1 = tmp_path / "shard1"
+    d2 = tmp_path / "shard2"
+    d1.mkdir()
+    d2.mkdir()
+    (d1 / "part0.sst").write_bytes(b"a")
+    (d2 / "part0.sst").write_bytes(b"b")
+    with pytest.raises(ObjectStoreError):
+        store.put_objects([str(d1 / "part0.sst"), str(d2 / "part0.sst")], "v1")
+
+
+def test_file_watcher_second_callback_gets_initial_content(tmp_path, file_watcher):
+    path = tmp_path / "c.json"
+    path.write_bytes(b"content")
+    first, second = [], []
+    file_watcher.add_file(str(path), first.append)
+    file_watcher.add_file(str(path), second.append)
+    assert first == [b"content"]
+    assert second == [b"content"]
+
+
+def test_dbconfig_rejects_non_object_json(tmp_path, file_watcher):
+    DBConfigManager.reset_for_test()
+    path = tmp_path / "cfg.json"
+    path.write_text(json.dumps({"seg": {"replication_mode": 2}}))
+    mgr = DBConfigManager.get()
+    mgr.load_from_file(str(path), watch=True)
+    assert mgr.get_replication_mode("seg") == 2
+    path.write_text("[]")
+    file_watcher.poll_now()
+    assert mgr.get_replication_mode("seg") == 2  # kept previous config
+    DBConfigManager.reset_for_test()
+
+
+def test_stats_dead_thread_buffers_pruned():
+    s = Stats.get()
+
+    def worker():
+        s.incr("from_worker")
+
+    for _ in range(20):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert s.get_counter("from_worker") == 20
+    s.flush()
+    s.flush()  # second flush prunes buffers drained while owner was dead
+    with s._buffers_lock:
+        live = len(s._all_buffers)
+    assert live <= 2  # main thread (+ possibly one straggler)
