@@ -1,0 +1,28 @@
+"""Typed async RPC — the fbthrift-equivalent transport layer.
+
+Reference: the fbthrift header protocol over TCP with zero-copy IOBuf
+payloads (rocksdb_replicator/thrift/replicator.thrift:44-49), client pools
+with per-connection health tracking (common/thrift_client_pool.h), and a
+shard-map-driven router (common/thrift_router.h).
+
+TPU-first design: a single asyncio event loop in a dedicated IO thread
+drives all connections (vs. the reference's N IO threads × EventBase); the
+wire format is a length-prefixed frame with a JSON header and a raw binary
+payload region so WAL update bytes travel without copies or base64.
+"""
+
+from .framing import FrameReader, write_frame
+from .serde import encode_message, decode_message
+from .errors import RpcError, RpcTimeout, RpcConnectionError, RpcApplicationError
+from .ioloop import IoLoop
+from .client import RpcClient
+from .client_pool import RpcClientPool
+from .server import RpcServer
+from .router import RpcRouter, ClusterLayout, Role, Quantity
+
+__all__ = [
+    "FrameReader", "write_frame", "encode_message", "decode_message",
+    "RpcError", "RpcTimeout", "RpcConnectionError", "RpcApplicationError",
+    "IoLoop", "RpcClient", "RpcClientPool", "RpcServer",
+    "RpcRouter", "ClusterLayout", "Role", "Quantity",
+]

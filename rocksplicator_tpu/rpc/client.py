@@ -1,0 +1,139 @@
+"""RpcClient: one multiplexed connection with health tracking.
+
+Reference: common/thrift_client_pool.h:107-142 — ``ClientStatusCallback``
+tracks ``is_good`` via close/connectError callbacks; requests are
+multiplexed on a header channel. Here: request ids multiplex concurrent
+calls on one TCP stream; ``is_good`` flips false on connection errors and
+the pool handles reconnect throttling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from .errors import RpcApplicationError, RpcConnectionError, RpcTimeout
+from .framing import FrameReader, write_frame
+from .serde import decode_message, encode_message
+
+log = logging.getLogger(__name__)
+
+
+class RpcClient:
+    """Async RPC client bound to the event loop that created it."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 5.0):
+        self.host = host
+        self.port = port
+        self._connect_timeout = connect_timeout
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._ids = itertools.count(1)
+        self._recv_task: Optional[asyncio.Task] = None
+        self._write_lock = asyncio.Lock()
+        self.is_good = False
+        self.last_connect_attempt = 0.0
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    async def connect(self) -> None:
+        self.last_connect_attempt = time.monotonic()
+        try:
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port),
+                self._connect_timeout,
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            self.is_good = False
+            raise RpcConnectionError(f"connect {self.host}:{self.port}: {e}") from e
+        self.is_good = True
+        self._recv_task = asyncio.ensure_future(self._recv_loop())
+
+    async def _recv_loop(self) -> None:
+        assert self._reader is not None
+        reader = FrameReader(self._reader)
+        try:
+            while True:
+                header, payload = await reader.read_frame()
+                msg = decode_message(header, payload)
+                fut = self._pending.pop(msg.get("id"), None)
+                if fut is None or fut.done():
+                    continue
+                if msg.get("ok"):
+                    fut.set_result(msg.get("result"))
+                else:
+                    err = msg.get("error") or {}
+                    fut.set_exception(
+                        RpcApplicationError(
+                            err.get("code", "UNKNOWN"),
+                            err.get("message", ""),
+                            err.get("data"),
+                        )
+                    )
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            self._fail_pending(RpcConnectionError(f"connection lost: {e}"))
+        except asyncio.CancelledError:
+            self._fail_pending(RpcConnectionError("client closed"))
+            raise
+        except Exception as e:  # pragma: no cover - defensive
+            log.exception("rpc client recv loop error")
+            self._fail_pending(RpcConnectionError(f"recv error: {e}"))
+        finally:
+            self.is_good = False
+
+    def _fail_pending(self, exc: Exception) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+
+    async def call(
+        self, method: str, args: Optional[Dict[str, Any]] = None,
+        timeout: Optional[float] = 30.0,
+    ) -> Any:
+        if not self.is_good:
+            raise RpcConnectionError(f"client {self.host}:{self.port} not connected")
+        req_id = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        header, chunks = encode_message(
+            {"id": req_id, "method": method, "args": args or {}}
+        )
+        try:
+            async with self._write_lock:
+                assert self._writer is not None
+                await write_frame(self._writer, header, chunks)
+        except (ConnectionError, OSError) as e:
+            self.is_good = False
+            self._pending.pop(req_id, None)
+            raise RpcConnectionError(f"send failed: {e}") from e
+        try:
+            if timeout is None:
+                return await fut
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)
+            raise RpcTimeout(f"{method} to {self.host}:{self.port} timed out") from None
+
+    async def close(self) -> None:
+        self.is_good = False
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            try:
+                await self._recv_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._recv_task = None
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            self._writer = None

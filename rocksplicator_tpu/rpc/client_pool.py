@@ -1,0 +1,68 @@
+"""RpcClientPool: addr → healthy client cache with reconnect throttling.
+
+Reference: common/thrift_client_pool.h:104-479 — per-IO-thread addr→channel
+maps with health callbacks, reconnect throttling, and stale-channel cleanup.
+Here one pool per process (single IO loop), same contract: ``get_client``
+returns a connected client, reuses healthy ones, throttles reconnect storms
+to a bad host, and evicts dead clients.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional, Tuple
+
+from .client import RpcClient
+from .errors import RpcConnectionError
+
+RECONNECT_THROTTLE_SEC = 1.0
+
+
+class RpcClientPool:
+    def __init__(self, connect_timeout: float = 5.0):
+        self._clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._connect_timeout = connect_timeout
+        self._locks: Dict[Tuple[str, int], asyncio.Lock] = {}
+
+    async def get_client(self, host: str, port: int) -> RpcClient:
+        addr = (host, port)
+        client = self._clients.get(addr)
+        if client is not None and client.is_good:
+            return client
+        lock = self._locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            client = self._clients.get(addr)
+            if client is not None and client.is_good:
+                return client
+            # Reconnect throttling: if we very recently failed to connect to
+            # this addr, fail fast instead of hammering it.
+            if (
+                client is not None
+                and time.monotonic() - client.last_connect_attempt
+                < RECONNECT_THROTTLE_SEC
+            ):
+                raise RpcConnectionError(
+                    f"{host}:{port} recently failed; throttled"
+                )
+            if client is not None:
+                await client.close()
+            client = RpcClient(host, port, self._connect_timeout)
+            # Register before connecting so a failed attempt is remembered
+            # for throttling.
+            self._clients[addr] = client
+            await client.connect()
+            return client
+
+    async def call(self, host: str, port: int, method: str, args=None,
+                   timeout: Optional[float] = 30.0):
+        client = await self.get_client(host, port)
+        return await client.call(method, args, timeout)
+
+    def peek(self, host: str, port: int) -> Optional[RpcClient]:
+        return self._clients.get((host, port))
+
+    async def close(self) -> None:
+        for client in list(self._clients.values()):
+            await client.close()
+        self._clients.clear()

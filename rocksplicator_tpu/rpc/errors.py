@@ -1,0 +1,29 @@
+"""RPC error taxonomy."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class RpcError(Exception):
+    """Base class for transport-level RPC failures."""
+
+
+class RpcTimeout(RpcError):
+    pass
+
+
+class RpcConnectionError(RpcError):
+    pass
+
+
+class RpcApplicationError(RpcError):
+    """A typed error raised by the remote handler (thrift exception
+    equivalent). ``code`` is an application-defined error code; ``data``
+    carries structured detail."""
+
+    def __init__(self, code: str, message: str = "", data: Optional[Dict[str, Any]] = None):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+        self.data = data or {}

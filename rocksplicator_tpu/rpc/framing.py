@@ -1,0 +1,53 @@
+"""Length-prefixed framing over asyncio streams.
+
+Frame layout (all little-endian):
+    magic   u16  = 0x5254 ("RT")
+    flags   u16  (reserved; bit 0 = header compressed — not yet used)
+    hlen    u32  header length
+    plen    u32  payload length
+    header  [hlen] JSON
+    payload [plen] raw binary region
+
+The reference's analog is the fbthrift header protocol with optional
+snappy/zstd transforms (common/thrift_client_pool.h:277-284); compression
+flags are reserved in the header for the same purpose.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import List, Tuple
+
+MAGIC = 0x5254
+_HEADER = struct.Struct("<HHII")
+MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+
+async def write_frame(
+    writer: asyncio.StreamWriter, header: bytes, payload_chunks: List[bytes]
+) -> None:
+    plen = sum(len(c) for c in payload_chunks)
+    writer.write(_HEADER.pack(MAGIC, 0, len(header), plen))
+    writer.write(header)
+    for chunk in payload_chunks:
+        writer.write(chunk)
+    await writer.drain()
+
+
+class FrameReader:
+    def __init__(self, reader: asyncio.StreamReader):
+        self._reader = reader
+
+    async def read_frame(self) -> Tuple[memoryview, memoryview]:
+        """Returns (header, payload) memoryviews. Raises
+        asyncio.IncompleteReadError on clean EOF."""
+        head = await self._reader.readexactly(_HEADER.size)
+        magic, _flags, hlen, plen = _HEADER.unpack(head)
+        if magic != MAGIC:
+            raise ValueError(f"bad frame magic: {magic:#x}")
+        if hlen + plen > MAX_FRAME_BYTES:
+            raise ValueError(f"frame too large: {hlen + plen}")
+        body = await self._reader.readexactly(hlen + plen)
+        view = memoryview(body)
+        return view[:hlen], view[hlen:]

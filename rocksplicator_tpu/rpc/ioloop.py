@@ -1,0 +1,68 @@
+"""IoLoop: one asyncio event loop in a dedicated IO thread.
+
+Reference analog: common/thrift_client_pool.h's N IO threads each driving a
+folly EventBase. Here one loop multiplexes all connections (Python sockets
+are cheap under asyncio); sync layers submit coroutines and wait on
+concurrent futures, matching the reference pattern of CPU worker threads
+handing IO to EventBase threads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+from typing import Any, Awaitable, Coroutine, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class IoLoop:
+    _default: Optional["IoLoop"] = None
+    _default_lock = threading.Lock()
+
+    def __init__(self, name: str = "rpc-io"):
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._started = threading.Event()
+        self._thread.start()
+        self._started.wait()
+
+    @classmethod
+    def default(cls) -> "IoLoop":
+        with cls._default_lock:
+            if cls._default is None or not cls._default._thread.is_alive():
+                cls._default = cls()
+            return cls._default
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        self._loop.call_soon(self._started.set)
+        self._loop.run_forever()
+
+    @property
+    def loop(self) -> asyncio.AbstractEventLoop:
+        return self._loop
+
+    def run_coro(self, coro: Coroutine[Any, Any, T]) -> "concurrent.futures.Future[T]":
+        """Submit a coroutine from any thread; returns a concurrent future."""
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    def run_sync(self, coro: Coroutine[Any, Any, T], timeout: Optional[float] = None) -> T:
+        if threading.current_thread() is self._thread:
+            raise RuntimeError("run_sync called from the IO thread (would deadlock)")
+        return self.run_coro(coro).result(timeout)
+
+    def call_soon(self, fn, *args) -> None:
+        self._loop.call_soon_threadsafe(fn, *args)
+
+    def stop(self) -> None:
+        def _shutdown():
+            for task in asyncio.all_tasks(self._loop):
+                task.cancel()
+            self._loop.stop()
+
+        self._loop.call_soon_threadsafe(_shutdown)
+        self._thread.join(timeout=5.0)

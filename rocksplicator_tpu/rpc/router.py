@@ -1,0 +1,205 @@
+"""Shard-map-driven request router.
+
+Reference: common/thrift_router.h:86-534 — parses a JSON shard map
+(format per thrift_router.h:536-566 / ConfigGenerator.java:
+``{segment: {num_shards: N, "ip:port:az": ["00042:M", "00043:S", ...]}}``)
+into a ``ClusterLayout``; ``getClientsFor(segment, role, quantity, shard)``
+applies role filtering, master preference, AZ-locality sort, and a
+deterministic rotation hash (thrift_router.h:384-455) so equally-good
+replicas share load. The map file is hot-reloaded via the file watcher.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import logging
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.file_watcher import FileWatcher
+from .client_pool import RpcClientPool
+from .errors import RpcConnectionError
+
+log = logging.getLogger(__name__)
+
+
+class Role(enum.Enum):
+    LEADER = "LEADER"       # reference: MASTER
+    FOLLOWER = "FOLLOWER"   # reference: SLAVE
+    ANY = "ANY"
+
+
+class Quantity(enum.Enum):
+    ONE = 1
+    TWO = 2
+    ALL = -1
+
+
+@dataclass(frozen=True)
+class Host:
+    ip: str
+    port: int
+    az: str
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return (self.ip, self.port)
+
+
+@dataclass
+class _Segment:
+    num_shards: int = 0
+    # shard -> [(host, role)]
+    shard_to_hosts: Dict[int, List[Tuple[Host, Role]]] = field(default_factory=dict)
+
+
+class ClusterLayout:
+    def __init__(self) -> None:
+        self.segments: Dict[str, _Segment] = {}
+
+    @classmethod
+    def parse(cls, content: bytes) -> "ClusterLayout":
+        """Parse the shard-map JSON (reference thrift_router.h:536-566)."""
+        layout = cls()
+        raw = json.loads(content.decode("utf-8")) if content.strip() else {}
+        if not isinstance(raw, dict):
+            raise ValueError("shard map must be a JSON object")
+        for segment, body in raw.items():
+            if not isinstance(body, dict):
+                raise ValueError(f"segment {segment}: body must be an object")
+            seg = _Segment()
+            for key, value in body.items():
+                if key in ("num_shards", "num_leaf_segments"):
+                    seg.num_shards = int(value)
+                    continue
+                parts = key.split(":")
+                if len(parts) < 2:
+                    raise ValueError(f"bad host key: {key!r}")
+                ip, port = parts[0], int(parts[1])
+                az = parts[2] if len(parts) > 2 else ""
+                host = Host(ip, port, az)
+                for shard_spec in value:
+                    shard_str, _, role_str = str(shard_spec).partition(":")
+                    shard = int(shard_str)
+                    role = {
+                        "M": Role.LEADER,
+                        "S": Role.FOLLOWER,
+                        "": Role.ANY,
+                    }.get(role_str, Role.ANY)
+                    seg.shard_to_hosts.setdefault(shard, []).append((host, role))
+            layout.segments[segment] = seg
+        return layout
+
+
+class RpcRouter:
+    """Routes requests by (segment, shard, role)."""
+
+    def __init__(
+        self,
+        local_az: str = "",
+        shard_map_path: Optional[str] = None,
+        pool: Optional[RpcClientPool] = None,
+        local_group_prefix_len: int = 0,
+    ):
+        self._local_az = local_az
+        self._layout = ClusterLayout()
+        self._pool = pool or RpcClientPool()
+        # Locality sort tier 2: hosts whose IP shares this prefix length with
+        # a local-group marker sort earlier (reference local-group-prefix).
+        self._local_group_prefix_len = local_group_prefix_len
+        if shard_map_path is not None:
+            FileWatcher.instance().add_file(shard_map_path, self._on_map_content)
+
+    # -- config -----------------------------------------------------------
+
+    def _on_map_content(self, content: bytes) -> None:
+        try:
+            self._layout = ClusterLayout.parse(content)
+        except (ValueError, KeyError) as e:
+            log.error("invalid shard map, keeping previous: %s", e)
+
+    def update_layout(self, layout: ClusterLayout) -> None:
+        self._layout = layout
+
+    @property
+    def layout(self) -> ClusterLayout:
+        return self._layout
+
+    def num_shards(self, segment: str) -> int:
+        seg = self._layout.segments.get(segment)
+        return seg.num_shards if seg else 0
+
+    # -- host selection ---------------------------------------------------
+
+    def get_hosts_for(
+        self,
+        segment: str,
+        shard: int,
+        role: Role = Role.ANY,
+        quantity: Quantity = Quantity.ONE,
+    ) -> List[Host]:
+        """Ordered candidate hosts for a shard.
+
+        Selection mirrors thrift_router.h:384-455: filter by role (ANY
+        prefers the leader first), sort by AZ locality, then rotate
+        equally-local groups deterministically by shard hash.
+        """
+        seg = self._layout.segments.get(segment)
+        if seg is None:
+            return []
+        entries = seg.shard_to_hosts.get(shard, [])
+        if role is Role.ANY:
+            candidates = sorted(
+                entries, key=lambda hr: 0 if hr[1] is Role.LEADER else 1
+            )
+        else:
+            candidates = [hr for hr in entries if hr[1] is role]
+
+        def locality(hr: Tuple[Host, Role]) -> int:
+            host = hr[0]
+            if self._local_az and host.az == self._local_az:
+                return 0
+            return 1
+
+        # Stable sort keeps the leader-first ordering within locality tiers;
+        # rotation spreads load across equally-good candidates.
+        rot = zlib.crc32(f"{segment}:{shard}".encode()) if candidates else 0
+        groups: Dict[Tuple[int, int], List[Host]] = {}
+        for hr in candidates:
+            key = (locality(hr), 0 if hr[1] is Role.LEADER and role is Role.ANY else 1)
+            groups.setdefault(key, []).append(hr[0])
+        ordered: List[Host] = []
+        for key in sorted(groups):
+            group = groups[key]
+            r = rot % len(group)
+            ordered.extend(group[r:] + group[:r])
+
+        if quantity is Quantity.ALL:
+            return ordered
+        return ordered[: quantity.value]
+
+    async def get_clients_for(
+        self,
+        segment: str,
+        shard: int,
+        role: Role = Role.ANY,
+        quantity: Quantity = Quantity.ONE,
+    ):
+        """Connected clients for the chosen hosts; skips bad hosts
+        (reference: filterBadHosts)."""
+        clients = []
+        want = None if quantity is Quantity.ALL else quantity.value
+        for host in self.get_hosts_for(segment, shard, role, Quantity.ALL):
+            try:
+                clients.append(await self._pool.get_client(host.ip, host.port))
+            except RpcConnectionError:
+                continue
+            if want is not None and len(clients) >= want:
+                break
+        return clients
+
+    @property
+    def pool(self) -> RpcClientPool:
+        return self._pool

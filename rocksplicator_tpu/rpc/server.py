@@ -1,0 +1,165 @@
+"""RpcServer: asyncio server dispatching typed method calls.
+
+Reference: the fbthrift ThriftServer hosting e.g. the ``Replicator`` service
+(rocksdb_replicator/rocksdb_replicator.cpp:46-87) and ``Admin`` service.
+Handlers are objects exposing ``async def handle_<method>(self, **args)``;
+raising RpcApplicationError maps to a typed error frame (thrift exception
+equivalent). CPU-bound work should be pushed to an executor by the handler.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+from .errors import RpcApplicationError
+from .framing import FrameReader, write_frame
+from .ioloop import IoLoop
+from .serde import decode_message, encode_message
+from ..utils.stats import Stats
+
+log = logging.getLogger(__name__)
+
+
+class RpcServer:
+    """Serves one or more handler objects on a TCP port.
+
+    Multiple handlers may be stacked (e.g. an application handler extending
+    the Admin service — counter.thrift's ``service Counter extends Admin``);
+    method lookup walks them in registration order.
+    """
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 ioloop: Optional[IoLoop] = None):
+        self._host = host
+        self._port = port
+        self._ioloop = ioloop or IoLoop.default()
+        self._handlers: list = []
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._ready = threading.Event()
+        self._conn_tasks: set = set()
+
+    def add_handler(self, handler: object) -> None:
+        self._handlers.append(handler)
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        """Start serving (callable from any thread); blocks until bound."""
+        self._ioloop.run_sync(self._start_async())
+
+    async def _start_async(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self._host, self._port
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+        self._ready.set()
+
+    def stop(self) -> None:
+        try:
+            self._ioloop.run_sync(self._stop_async(), timeout=5.0)
+        except Exception:
+            pass
+
+    async def _stop_async(self) -> None:
+        # Cancel live connections before wait_closed(): since Python 3.12
+        # wait_closed() also waits for connection handlers to finish, and
+        # ours loop until cancelled.
+        for task in list(self._conn_tasks):
+            task.cancel()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- connection handling ---------------------------------------------
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+        frame_reader = FrameReader(reader)
+        write_lock = asyncio.Lock()
+        inflight: set = set()
+        try:
+            while True:
+                header, payload = await frame_reader.read_frame()
+                msg = decode_message(header, payload)
+                # Each request runs as its own task so slow handlers (e.g.
+                # long-poll replicate) don't block the connection.
+                t = asyncio.ensure_future(
+                    self._dispatch(msg, writer, write_lock)
+                )
+                inflight.add(t)
+                t.add_done_callback(inflight.discard)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("rpc server connection error")
+        finally:
+            for t in inflight:
+                t.cancel()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            if task is not None:
+                self._conn_tasks.discard(task)
+
+    async def _dispatch(
+        self,
+        msg: Dict[str, Any],
+        writer: asyncio.StreamWriter,
+        write_lock: asyncio.Lock,
+    ) -> None:
+        req_id = msg.get("id")
+        method = msg.get("method", "")
+        args = msg.get("args") or {}
+        stats = Stats.get()
+        stats.incr(f"rpc.{method}.received")
+        try:
+            fn = self._find_handler(method)
+            result = await fn(**args)
+            reply = {"id": req_id, "ok": True, "result": result}
+            stats.incr(f"rpc.{method}.success")
+        except RpcApplicationError as e:
+            reply = {
+                "id": req_id,
+                "ok": False,
+                "error": {"code": e.code, "message": e.message, "data": e.data},
+            }
+            stats.incr(f"rpc.{method}.app_error")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.exception("handler %s failed", method)
+            reply = {
+                "id": req_id,
+                "ok": False,
+                "error": {"code": "INTERNAL", "message": repr(e), "data": {}},
+            }
+            stats.incr(f"rpc.{method}.internal_error")
+        header, chunks = encode_message(reply)
+        try:
+            async with write_lock:
+                await write_frame(writer, header, chunks)
+        except (ConnectionError, OSError):
+            pass
+
+    def _find_handler(self, method: str):
+        for handler in self._handlers:
+            fn = getattr(handler, f"handle_{method}", None)
+            if fn is not None:
+                return fn
+        raise RpcApplicationError("NO_SUCH_METHOD", method)

@@ -62,9 +62,15 @@ class DBConfigManager:
     @classmethod
     def reset_for_test(cls) -> None:
         with cls._instance_lock:
+            if cls._instance is not None and cls._instance._path is not None:
+                FileWatcher.instance().remove_file(
+                    cls._instance._path, cls._instance._on_content
+                )
             cls._instance = None
 
     def load_from_file(self, path: str, watch: bool = True) -> None:
+        if self._path is not None:
+            FileWatcher.instance().remove_file(self._path, self._on_content)
         self._path = path
         if watch:
             FileWatcher.instance().add_file(path, self._on_content)

@@ -66,15 +66,21 @@ class FileWatcher:
         path = os.path.abspath(path)
         initial: Optional[bytes] = None
         with self._lock:
-            cbs, digest = self._files.get(path, ([], None))
-            cbs = cbs + [callback]
-            # Every newly registered callback gets the current content once,
-            # even when the path was already being watched.
-            content = _read(path)
-            if content is not None:
-                digest = hashlib.sha1(content).hexdigest()
+            entry = self._files.get(path)
+            if entry is None:
+                content = _read(path)
+                digest = (
+                    hashlib.sha1(content).hexdigest() if content is not None else None
+                )
+                self._files[path] = ([callback], digest)
                 initial = content
-            self._files[path] = (cbs, digest)
+            else:
+                # Already watched: only the new callback gets the current
+                # content; the shared digest is left for _poll to advance so
+                # existing subscribers still see any pending change.
+                cbs, digest = entry
+                self._files[path] = (cbs + [callback], digest)
+                initial = _read(path)
             self._ensure_thread()
         if initial is not None:
             _safe_call(callback, initial, path)
@@ -89,7 +95,9 @@ class FileWatcher:
             if callback is None:
                 self._files.pop(path, None)
             else:
-                cbs = [c for c in cbs if c is not callback]
+                # Equality, not identity: bound methods are re-created on
+                # every attribute access but compare equal.
+                cbs = [c for c in cbs if c != callback]
                 if cbs:
                     self._files[path] = (cbs, digest)
                 else:

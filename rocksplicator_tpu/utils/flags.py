@@ -99,9 +99,16 @@ class _FlagOverride:
         self._saved: Dict[str, Any] = {}
 
     def __enter__(self):
-        for k, v in self._kv.items():
-            self._saved[k] = self._registry.get(k)
-            self._registry.set(k, v)
+        try:
+            for k, v in self._kv.items():
+                self._saved[k] = self._registry.get(k)
+                self._registry.set(k, v)
+        except Exception:
+            # Roll back overrides already applied: __exit__ won't run when
+            # __enter__ raises.
+            for k, v in self._saved.items():
+                self._registry.set(k, v)
+            raise
         return self
 
     def __exit__(self, *exc):
@@ -111,7 +118,9 @@ class _FlagOverride:
 
 
 def _coerce(value: Any, typ: type) -> Any:
-    if isinstance(value, typ) and not (typ is bool and not isinstance(value, bool)):
+    # A bool value only passes through unchanged for bool flags; for e.g.
+    # int flags it falls through to typ(value) so the flag holds 1, not True.
+    if isinstance(value, typ) and not (typ is not bool and isinstance(value, bool)):
         return value
     if typ is bool:
         if isinstance(value, str):

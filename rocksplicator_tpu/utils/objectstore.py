@@ -151,7 +151,7 @@ class LocalObjectStore(ObjectStore):
         dst = self._path(key)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         self._charge(os.path.getsize(local_path))
-        tmp = dst + ".tmp"
+        tmp = self._tmp_name(dst)
         shutil.copyfile(local_path, tmp)
         os.replace(tmp, dst)
 
@@ -159,17 +159,23 @@ class LocalObjectStore(ObjectStore):
         dst = self._path(key)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         self._charge(len(data))
-        tmp = dst + ".tmp"
+        tmp = self._tmp_name(dst)
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, dst)
+
+    @staticmethod
+    def _tmp_name(dst: str) -> str:
+        # Unique per writer so concurrent puts to one key can't interleave
+        # in a shared temp file; last os.replace() wins atomically.
+        return f"{dst}.{os.getpid()}.{threading.get_ident()}.tmp"
 
     def list_objects(self, prefix: str) -> List[str]:
         prefix = prefix.lstrip("/")
         out: List[str] = []
         for dirpath, _dirnames, filenames in os.walk(self._root):
             for name in filenames:
-                if name.endswith(".tmp"):
+                if name.endswith(".tmp"):  # in-flight writer temp files
                     continue
                 full = os.path.join(dirpath, name)
                 key = os.path.relpath(full, self._root).replace(os.sep, "/")

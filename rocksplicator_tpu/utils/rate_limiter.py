@@ -61,6 +61,8 @@ class ConcurrentRateLimiter:
         return self._rate
 
     def set_rate(self, rate: float) -> None:
+        if rate <= 0:
+            raise ValueError("rate must be positive")
         with self._lock:
             self._refill(time.monotonic())
             self._rate = rate

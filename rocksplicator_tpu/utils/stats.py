@@ -49,9 +49,14 @@ class _TimeSeries:
                 del self.buckets[k]
 
     def rate_last_minute(self, now: float) -> float:
+        # Sliding-window estimate: current partial bucket plus the previous
+        # bucket weighted by its unexpired fraction (avoids the up-to-2x
+        # over-read of naively summing both buckets).
         b = int(now // _WINDOW_SEC)
-        # Sum the previous full window and the current partial one.
-        return self.buckets.get(b, 0.0) + self.buckets.get(b - 1, 0.0)
+        frac_elapsed = (now - b * _WINDOW_SEC) / _WINDOW_SEC
+        return self.buckets.get(b, 0.0) + self.buckets.get(b - 1, 0.0) * (
+            1.0 - frac_elapsed
+        )
 
 
 class _Histogram:

@@ -1,0 +1,342 @@
+"""RPC layer tests (reference: common/tests/thrift_client_pool_test.cpp,
+thrift_router_test.cpp — live local servers, role/AZ/quantity logic)."""
+
+import asyncio
+import json
+
+import pytest
+
+from rocksplicator_tpu.rpc import (
+    ClusterLayout,
+    IoLoop,
+    Quantity,
+    Role,
+    RpcApplicationError,
+    RpcClientPool,
+    RpcConnectionError,
+    RpcRouter,
+    RpcServer,
+    RpcTimeout,
+)
+from rocksplicator_tpu.rpc.serde import decode_message, encode_message
+
+
+# ---------------------------------------------------------------------------
+# serde
+# ---------------------------------------------------------------------------
+
+
+def test_serde_roundtrip_with_binary():
+    msg = {
+        "id": 1,
+        "method": "replicate",
+        "args": {
+            "db_name": "seg00001",
+            "updates": [
+                {"seq_no": 5, "raw_data": b"\x00\x01binary\xff"},
+                {"seq_no": 6, "raw_data": b"more"},
+            ],
+            "nested": {"blob": b"xyz", "n": 3.5, "flag": True, "none": None},
+        },
+    }
+    header, chunks = encode_message(msg)
+    payload = b"".join(chunks)
+    out = decode_message(memoryview(header), memoryview(payload))
+    assert out["id"] == 1
+    assert bytes(out["args"]["updates"][0]["raw_data"]) == b"\x00\x01binary\xff"
+    assert bytes(out["args"]["updates"][1]["raw_data"]) == b"more"
+    assert bytes(out["args"]["nested"]["blob"]) == b"xyz"
+    assert out["args"]["nested"]["n"] == 3.5
+    assert out["args"]["nested"]["none"] is None
+    # zero-copy: decoded binaries are views into the payload buffer
+    assert isinstance(out["args"]["updates"][0]["raw_data"], memoryview)
+
+
+def test_serde_rejects_reserved_key():
+    with pytest.raises(ValueError):
+        encode_message({"$bin": [0, 1]})
+
+
+# ---------------------------------------------------------------------------
+# server + client + pool over real TCP
+# ---------------------------------------------------------------------------
+
+
+class EchoHandler:
+    async def handle_echo(self, text="", blob=b""):
+        return {"text": text, "blob": bytes(blob) + b"!"}
+
+    async def handle_fail(self, code="BOOM"):
+        raise RpcApplicationError(code, "requested failure", {"k": 1})
+
+    async def handle_slow(self, delay=1.0):
+        await asyncio.sleep(delay)
+        return {"done": True}
+
+    async def handle_crash(self):
+        raise RuntimeError("unexpected")
+
+
+class ExtensionHandler:
+    """Stacked handler — the 'service Counter extends Admin' pattern."""
+
+    async def handle_extra(self):
+        return {"extra": True}
+
+
+@pytest.fixture()
+def rpc_server():
+    ioloop = IoLoop.default()
+    server = RpcServer(port=0, ioloop=ioloop)
+    server.add_handler(ExtensionHandler())
+    server.add_handler(EchoHandler())
+    server.start()
+    yield server, ioloop
+    server.stop()
+
+
+def test_rpc_echo_and_binary(rpc_server):
+    server, ioloop = rpc_server
+
+    async def go():
+        pool = RpcClientPool()
+        result = await pool.call(
+            "127.0.0.1", server.port, "echo", {"text": "hi", "blob": b"abc"}
+        )
+        assert result["text"] == "hi"
+        assert bytes(result["blob"]) == b"abc!"
+        extra = await pool.call("127.0.0.1", server.port, "extra")
+        assert extra["extra"] is True
+        await pool.close()
+
+    ioloop.run_sync(go())
+
+
+def test_rpc_application_error(rpc_server):
+    server, ioloop = rpc_server
+
+    async def go():
+        pool = RpcClientPool()
+        with pytest.raises(RpcApplicationError) as ei:
+            await pool.call("127.0.0.1", server.port, "fail", {"code": "SOURCE_NOT_FOUND"})
+        assert ei.value.code == "SOURCE_NOT_FOUND"
+        assert ei.value.data == {"k": 1}
+        # unexpected handler exceptions surface as INTERNAL
+        with pytest.raises(RpcApplicationError) as ei2:
+            await pool.call("127.0.0.1", server.port, "crash")
+        assert ei2.value.code == "INTERNAL"
+        # unknown method
+        with pytest.raises(RpcApplicationError) as ei3:
+            await pool.call("127.0.0.1", server.port, "nope")
+        assert ei3.value.code == "NO_SUCH_METHOD"
+        await pool.close()
+
+    ioloop.run_sync(go())
+
+
+def test_rpc_timeout_and_concurrency(rpc_server):
+    server, ioloop = rpc_server
+
+    async def go():
+        pool = RpcClientPool()
+        with pytest.raises(RpcTimeout):
+            await pool.call("127.0.0.1", server.port, "slow", {"delay": 5.0}, timeout=0.1)
+        # a slow call must not block a fast one on the same connection
+        slow = asyncio.ensure_future(
+            pool.call("127.0.0.1", server.port, "slow", {"delay": 0.5})
+        )
+        fast = await pool.call("127.0.0.1", server.port, "echo", {"text": "quick"})
+        assert fast["text"] == "quick"
+        assert not slow.done()
+        assert (await slow)["done"] is True
+        await pool.close()
+
+    ioloop.run_sync(go())
+
+
+def test_client_pool_health_and_reconnect(rpc_server):
+    server, ioloop = rpc_server
+
+    async def go():
+        pool = RpcClientPool()
+        client = await pool.get_client("127.0.0.1", server.port)
+        assert client.is_good
+        # same healthy client is reused
+        assert await pool.get_client("127.0.0.1", server.port) is client
+        # connection refused flips to error
+        with pytest.raises(RpcConnectionError):
+            await pool.get_client("127.0.0.1", 1)  # nothing listens there
+        # immediately retrying the bad addr is throttled
+        with pytest.raises(RpcConnectionError) as ei:
+            await pool.get_client("127.0.0.1", 1)
+        assert "throttled" in str(ei.value)
+        await pool.close()
+
+    ioloop.run_sync(go())
+
+
+def test_server_restart_client_reconnects():
+    ioloop = IoLoop.default()
+    server = RpcServer(port=0, ioloop=ioloop)
+    server.add_handler(EchoHandler())
+    server.start()
+    port = server.port
+
+    async def first():
+        pool = RpcClientPool()
+        r = await pool.call("127.0.0.1", port, "echo", {"text": "a"})
+        assert r["text"] == "a"
+        return pool
+
+    pool = ioloop.run_sync(first())
+    server.stop()
+
+    async def after_stop():
+        client = pool.peek("127.0.0.1", port)
+        # give the recv loop a beat to observe the close
+        for _ in range(50):
+            if not client.is_good:
+                break
+            await asyncio.sleep(0.05)
+        assert not client.is_good
+        with pytest.raises(RpcConnectionError):
+            await pool.call("127.0.0.1", port, "echo", {"text": "b"})
+
+    ioloop.run_sync(after_stop())
+
+    server2 = RpcServer(port=port, host="127.0.0.1", ioloop=ioloop)
+    server2.add_handler(EchoHandler())
+    server2.start()
+
+    async def after_restart():
+        await asyncio.sleep(1.1)  # clear the reconnect throttle
+        r = await pool.call("127.0.0.1", port, "echo", {"text": "back"})
+        assert r["text"] == "back"
+        await pool.close()
+
+    try:
+        ioloop.run_sync(after_restart())
+    finally:
+        server2.stop()
+
+
+# ---------------------------------------------------------------------------
+# router (reference thrift_router_test.cpp — 18 TESTs of role/AZ/locality)
+# ---------------------------------------------------------------------------
+
+
+SHARD_MAP = {
+    "seg": {
+        "num_shards": 3,
+        "10.0.0.1:9090:az1": ["00000:M", "00001:S"],
+        "10.0.0.2:9090:az2": ["00000:S", "00001:M", "00002:S"],
+        "10.0.0.3:9090:az1": ["00000:S", "00002:M"],
+    }
+}
+
+
+def _router(local_az="az1"):
+    router = RpcRouter(local_az=local_az)
+    router.update_layout(ClusterLayout.parse(json.dumps(SHARD_MAP).encode()))
+    return router
+
+
+def test_router_parse_and_counts():
+    router = _router()
+    assert router.num_shards("seg") == 3
+    assert router.num_shards("missing") == 0
+    assert router.get_hosts_for("missing", 0) == []
+
+
+def test_router_leader_selection():
+    router = _router()
+    hosts = router.get_hosts_for("seg", 0, Role.LEADER, Quantity.ALL)
+    assert [h.ip for h in hosts] == ["10.0.0.1"]
+    hosts = router.get_hosts_for("seg", 1, Role.LEADER, Quantity.ALL)
+    assert [h.ip for h in hosts] == ["10.0.0.2"]
+
+
+def test_router_follower_selection():
+    router = _router()
+    hosts = router.get_hosts_for("seg", 0, Role.FOLLOWER, Quantity.ALL)
+    assert sorted(h.ip for h in hosts) == ["10.0.0.2", "10.0.0.3"]
+    # az1-local follower (10.0.0.3) must sort before az2
+    assert hosts[0].ip == "10.0.0.3"
+
+
+def test_router_any_prefers_leader_then_locality():
+    router = _router(local_az="az1")
+    hosts = router.get_hosts_for("seg", 0, Role.ANY, Quantity.ALL)
+    assert len(hosts) == 3
+    # leader in local az: first
+    assert hosts[0].ip == "10.0.0.1"
+    # local follower before remote follower
+    assert hosts[1].ip == "10.0.0.3"
+    assert hosts[2].ip == "10.0.0.2"
+
+
+def test_router_any_remote_leader_still_preferred_within_tier():
+    router = _router(local_az="az2")
+    hosts = router.get_hosts_for("seg", 2, Role.ANY, Quantity.ALL)
+    # shard 2: leader 10.0.0.3 (az1), follower 10.0.0.2 (az2 = local).
+    # Locality tier sorts the local follower first, leader next.
+    assert [h.ip for h in hosts] == ["10.0.0.2", "10.0.0.3"]
+
+
+def test_router_quantities():
+    router = _router()
+    assert len(router.get_hosts_for("seg", 0, Role.ANY, Quantity.ONE)) == 1
+    assert len(router.get_hosts_for("seg", 0, Role.ANY, Quantity.TWO)) == 2
+    assert len(router.get_hosts_for("seg", 0, Role.ANY, Quantity.ALL)) == 3
+
+
+def test_router_rotation_is_deterministic():
+    router = _router(local_az="")
+    a = router.get_hosts_for("seg", 0, Role.FOLLOWER, Quantity.ALL)
+    b = router.get_hosts_for("seg", 0, Role.FOLLOWER, Quantity.ALL)
+    assert a == b
+
+
+def test_router_hot_reload_from_file(tmp_path, file_watcher):
+    path = tmp_path / "shard_map.json"
+    path.write_text(json.dumps(SHARD_MAP))
+    router = RpcRouter(local_az="az1", shard_map_path=str(path))
+    assert router.num_shards("seg") == 3
+    new_map = {"seg": {"num_shards": 1, "10.9.9.9:1:az9": ["00000:M"]}}
+    path.write_text(json.dumps(new_map))
+    file_watcher.poll_now()
+    assert router.num_shards("seg") == 1
+    assert router.get_hosts_for("seg", 0, Role.LEADER)[0].ip == "10.9.9.9"
+    # malformed update keeps previous layout
+    path.write_text("not json")
+    file_watcher.poll_now()
+    assert router.num_shards("seg") == 1
+
+
+def test_router_get_clients_skips_bad_hosts():
+    ioloop = IoLoop.default()
+    server = RpcServer(port=0, ioloop=ioloop)
+    server.add_handler(EchoHandler())
+    server.start()
+    try:
+        shard_map = {
+            "seg": {
+                "num_shards": 1,
+                f"127.0.0.1:{server.port}:az1": ["00000:S"],
+                "127.0.0.1:1:az1": ["00000:M"],  # dead leader
+            }
+        }
+        router = RpcRouter(local_az="az1")
+        router.update_layout(ClusterLayout.parse(json.dumps(shard_map).encode()))
+
+        async def go():
+            clients = await router.get_clients_for(
+                "seg", 0, Role.ANY, Quantity.ONE
+            )
+            assert len(clients) == 1
+            assert clients[0].port == server.port
+            await router.pool.close()
+
+        ioloop.run_sync(go())
+    finally:
+        server.stop()

@@ -535,3 +535,59 @@ def test_stats_dead_thread_buffers_pruned():
     with s._buffers_lock:
         live = len(s._all_buffers)
     assert live <= 2  # main thread (+ possibly one straggler)
+
+
+def test_file_watcher_bound_method_unregister(tmp_path, file_watcher):
+    path = tmp_path / "w.txt"
+    path.write_bytes(b"a")
+
+    class Sub:
+        def __init__(self):
+            self.seen = []
+
+        def cb(self, content):
+            self.seen.append(content)
+
+    sub = Sub()
+    file_watcher.add_file(str(path), sub.cb)
+    assert sub.seen == [b"a"]
+    file_watcher.remove_file(str(path), sub.cb)  # fresh bound-method object
+    path.write_bytes(b"b")
+    file_watcher.poll_now()
+    assert sub.seen == [b"a"]  # unregistered callback must not fire
+
+
+def test_file_watcher_pending_change_not_swallowed(tmp_path, file_watcher):
+    path = tmp_path / "w2.txt"
+    path.write_bytes(b"v1")
+    a, b = [], []
+    file_watcher.add_file(str(path), a.append)
+    path.write_bytes(b"v2")  # change lands before next poll
+    file_watcher.add_file(str(path), b.append)  # must not swallow it
+    assert b == [b"v2"]
+    file_watcher.poll_now()
+    assert a[-1] == b"v2"  # existing subscriber still sees the change
+
+
+def test_flags_override_rolls_back_on_undefined_key():
+    flags = FlagRegistry()
+    flags.define("good", 1)
+    with pytest.raises(KeyError):
+        with flags.override(good=5, undefined_flag=2):
+            pass
+    assert flags.good == 1
+
+
+def test_flags_bool_not_leaked_into_int_flag():
+    flags = FlagRegistry()
+    flags.define("n", 5)
+    flags.set("n", True)
+    assert flags.n == 1 and flags.n is not True
+
+
+def test_rate_limiter_set_rate_validation():
+    rl = ConcurrentRateLimiter(rate=10.0)
+    with pytest.raises(ValueError):
+        rl.set_rate(0)
+    with pytest.raises(ValueError):
+        rl.set_rate(-5)
