@@ -106,11 +106,22 @@ class RpcRouter:
         self._local_az = local_az
         self._layout = ClusterLayout()
         self._pool = pool or RpcClientPool()
-        # Locality sort tier 2: hosts whose IP shares this prefix length with
-        # a local-group marker sort earlier (reference local-group-prefix).
+        # Locality tier between same-AZ and remote: hosts whose AZ shares
+        # the first N chars with ours (e.g. "us-east-1a"/"us-east-1b" share
+        # 9) — the reference's local-group-prefix sort.
         self._local_group_prefix_len = local_group_prefix_len
+        self._shard_map_path = shard_map_path
         if shard_map_path is not None:
             FileWatcher.instance().add_file(shard_map_path, self._on_map_content)
+
+    def close(self) -> None:
+        """Unregister the shard-map watcher (must be called for routers
+        constructed with ``shard_map_path``)."""
+        if self._shard_map_path is not None:
+            FileWatcher.instance().remove_file(
+                self._shard_map_path, self._on_map_content
+            )
+            self._shard_map_path = None
 
     # -- config -----------------------------------------------------------
 
@@ -161,7 +172,14 @@ class RpcRouter:
             host = hr[0]
             if self._local_az and host.az == self._local_az:
                 return 0
-            return 1
+            n = self._local_group_prefix_len
+            if (
+                n > 0
+                and self._local_az
+                and host.az[:n] == self._local_az[:n]
+            ):
+                return 1
+            return 2
 
         # Stable sort keeps the leader-first ordering within locality tiers;
         # rotation spreads load across equally-good candidates.

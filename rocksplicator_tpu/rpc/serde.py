@@ -47,7 +47,15 @@ def decode_message(header: memoryview, payload: memoryview) -> Any:
     def walk(value: Any) -> Any:
         if isinstance(value, dict):
             if _BIN_KEY in value and len(value) == 1:
-                off, length = value[_BIN_KEY]
+                ref = value[_BIN_KEY]
+                if (
+                    not isinstance(ref, list)
+                    or len(ref) != 2
+                    or not all(isinstance(x, int) and x >= 0 for x in ref)
+                    or ref[0] + ref[1] > len(payload)
+                ):
+                    raise ValueError(f"invalid binary ref: {ref!r}")
+                off, length = ref
                 return payload[off:off + length]
             return {k: walk(v) for k, v in value.items()}
         if isinstance(value, list):

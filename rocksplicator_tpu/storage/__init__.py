@@ -1,0 +1,22 @@
+"""LSM storage engine — the L0 equivalent.
+
+The reference vendors RocksDB as its storage engine (SURVEY.md §1 L0); this
+package provides a from-scratch LSM engine with the API surface the upper
+layers depend on: ``WriteBatch`` (incl. ``put_log_data`` for replication
+timestamps), sequence numbers with ``get_updates_since``, checkpoints,
+external-file ingestion (incl. ``ingest_behind``), backup/restore, merge
+operators, and compaction with a pluggable backend — the seam where the TPU
+offload plugs in (BASELINE.json north star).
+"""
+
+from .records import WriteBatch, OpType, decode_batch
+from .engine import DB, DBOptions, destroy_db
+from .errors import StorageError, NotFoundError, Corruption
+from .merge import MergeOperator, UInt64AddOperator
+
+__all__ = [
+    "WriteBatch", "OpType", "decode_batch",
+    "DB", "DBOptions", "destroy_db",
+    "StorageError", "NotFoundError", "Corruption",
+    "MergeOperator", "UInt64AddOperator",
+]

@@ -1,0 +1,108 @@
+"""Register-blocked bloom filter with a TPU-vectorizable hash.
+
+Reference: RocksDB bloom filters at 10 bits/key (performance.cpp bloom
+config). Design constraint here is the BASELINE.json north star: bloom
+bitmap construction runs as a TPU kernel over fixed-width lanes, so the
+hash is defined over a **fixed 24-byte zero-padded key prefix plus the key
+length**, FNV-1a folded in u32 words — computable with identical results in
+numpy/JAX u32 lanes and in this pure-Python reference implementation.
+(Long keys sharing a 24-byte prefix merely share bloom bits — more false
+positives, never false negatives.)
+
+Layout: ``num_words`` 32-bit words; each key sets K bits within ONE word
+(register-blocked / Impala-style), chosen by a second hash — one word of
+memory traffic per probe on CPU, one lane op on TPU.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, List
+
+import numpy as np
+
+PREFIX_BYTES = 24
+_PREFIX_WORDS = PREFIX_BYTES // 4
+K_BITS = 6
+_FNV_OFFSET = 2166136261
+_FNV_PRIME = 16777619
+_H2_MUL = 0x9E3779B1
+_MASK32 = 0xFFFFFFFF
+
+
+def key_words(key: bytes) -> List[int]:
+    """The 7 u32 lanes hashed for ``key`` (6 prefix words + length)."""
+    prefix = key[:PREFIX_BYTES].ljust(PREFIX_BYTES, b"\x00")
+    words = list(struct.unpack(f"<{_PREFIX_WORDS}I", prefix))
+    words.append(len(key) & _MASK32)
+    return words
+
+
+def _avalanche(h: int) -> int:
+    """murmur3 fmix32 — u32 shifts/multiplies only (TPU-lane friendly)."""
+    h &= _MASK32
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _MASK32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _MASK32
+    h ^= h >> 16
+    return h
+
+
+def hash_pair(key: bytes) -> tuple:
+    h = _FNV_OFFSET
+    for w in key_words(key):
+        h = ((h ^ w) * _FNV_PRIME) & _MASK32
+    h1 = _avalanche(h)
+    h2 = _avalanche((h * _H2_MUL + 1) & _MASK32)
+    return h1, h2
+
+
+def word_mask(key: bytes, num_words: int) -> tuple:
+    """(word_index, 32-bit mask) for ``key`` — the exact quantities the TPU
+    kernel computes per lane. Each of the K bits comes from an independent
+    5-bit slice of h2 (30 of 32 bits used)."""
+    h1, h2 = hash_pair(key)
+    mask = 0
+    for j in range(K_BITS):
+        mask |= 1 << ((h2 >> (5 * j)) & 31)
+    return h1 % num_words, mask
+
+
+def num_words_for(num_keys: int, bits_per_key: int = 10) -> int:
+    return max(1, (num_keys * bits_per_key + 31) // 32)
+
+
+class BloomFilter:
+    def __init__(self, num_words: int, words: np.ndarray | None = None):
+        self.num_words = num_words
+        self.words = (
+            words if words is not None else np.zeros(num_words, dtype=np.uint32)
+        )
+
+    @classmethod
+    def build(cls, keys: Iterable[bytes], bits_per_key: int = 10) -> "BloomFilter":
+        keys = list(keys)
+        bf = cls(num_words_for(len(keys), bits_per_key))
+        for key in keys:
+            bf.add(key)
+        return bf
+
+    def add(self, key: bytes) -> None:
+        idx, mask = word_mask(key, self.num_words)
+        self.words[idx] |= np.uint32(mask)
+
+    def may_contain(self, key: bytes) -> bool:
+        idx, mask = word_mask(key, self.num_words)
+        return (int(self.words[idx]) & mask) == mask
+
+    # -- serialization ----------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<I", self.num_words) + self.words.astype("<u4").tobytes()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "BloomFilter":
+        (num_words,) = struct.unpack_from("<I", data, 0)
+        words = np.frombuffer(data, dtype="<u4", count=num_words, offset=4).copy()
+        return cls(num_words, words)

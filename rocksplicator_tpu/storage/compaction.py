@@ -1,0 +1,115 @@
+"""Compaction: k-way merge of sorted runs with LSM resolution.
+
+This module defines the **CompactionBackend seam** — the boundary behind
+which the TPU offload plugs in (BASELINE.json north star: "L0→Ln compaction
+jobs ... ship their key-value blocks to a TPU sidecar"). The default
+backend is the CPU heap-merge; ``rocksplicator_tpu.tpu.compaction_service``
+registers a TPU backend implementing the same interface.
+
+An input "run" is an iterator of (key, seq, vtype, value) in (key asc,
+seq desc) order; the output is the merged, deduplicated stream in the same
+order, with per-key resolution:
+- newest PUT wins; MERGE operands above it fold into it
+- newest DELETE wins; at the bottom level tombstones (and the keys they
+  shadow) are dropped entirely
+- unresolved MERGE chains are partially merged when the operator allows
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+from .merge import MergeOperator
+from .records import OpType
+
+Entry = Tuple[bytes, int, int, bytes]  # key, seq, vtype, value
+
+
+class CompactionBackend:
+    name = "base"
+
+    def merge_runs(
+        self,
+        runs: List[Iterable[Entry]],
+        merge_op: Optional[MergeOperator],
+        drop_tombstones: bool,
+    ) -> Iterator[Entry]:
+        raise NotImplementedError
+
+
+class CpuCompactionBackend(CompactionBackend):
+    """Heap-based k-way merge — the 32-core-CPU baseline the TPU backend is
+    benchmarked against."""
+
+    name = "cpu"
+
+    def merge_runs(
+        self,
+        runs: List[Iterable[Entry]],
+        merge_op: Optional[MergeOperator],
+        drop_tombstones: bool,
+    ) -> Iterator[Entry]:
+        # (key asc, seq desc) merge order.
+        merged = heapq.merge(*runs, key=lambda e: (e[0], -e[1]))
+        return resolve_stream(merged, merge_op, drop_tombstones)
+
+
+def resolve_stream(
+    merged: Iterable[Entry],
+    merge_op: Optional[MergeOperator],
+    drop_tombstones: bool,
+) -> Iterator[Entry]:
+    """Collapse a (key asc, seq desc)-ordered stream to one entry per key."""
+    cur_key: Optional[bytes] = None
+    group: List[Entry] = []
+    for entry in merged:
+        if entry[0] != cur_key:
+            if group:
+                yield from _resolve_group(group, merge_op, drop_tombstones)
+            cur_key = entry[0]
+            group = [entry]
+        else:
+            group.append(entry)
+    if group:
+        yield from _resolve_group(group, merge_op, drop_tombstones)
+
+
+def _resolve_group(
+    group: List[Entry],
+    merge_op: Optional[MergeOperator],
+    drop_tombstones: bool,
+) -> List[Entry]:
+    """group: all entries for one key, newest (highest seq) first. Returns
+    the surviving entries (usually one; an unresolved MERGE chain without a
+    partial-merge-capable operator survives as multiple entries, like
+    RocksDB keeps stacked merge operands)."""
+    key = group[0][0]
+    top_seq = group[0][1]
+    operands: List[bytes] = []
+    for _key, seq, vtype, value in group:
+        if vtype == OpType.PUT:
+            if operands and merge_op:
+                return [(key, top_seq, OpType.PUT,
+                         merge_op.merge(key, value, list(reversed(operands))))]
+            return [(key, top_seq, OpType.PUT, value)]
+        if vtype == OpType.DELETE:
+            if operands and merge_op:
+                return [(key, top_seq, OpType.PUT,
+                         merge_op.merge(key, None, list(reversed(operands))))]
+            if drop_tombstones:
+                return []
+            return [(key, top_seq, OpType.DELETE, b"")]
+        if vtype == OpType.MERGE:
+            operands.append(value)
+    # Only MERGE ops seen for this key.
+    if drop_tombstones and merge_op:
+        # Bottom level: no older data can exist — fold to a final value.
+        return [(key, top_seq, OpType.PUT,
+                 merge_op.merge(key, None, list(reversed(operands))))]
+    if merge_op:
+        partial = merge_op.partial_merge(key, list(reversed(operands)))
+        if partial is not None:
+            return [(key, top_seq, OpType.MERGE, partial)]
+    # No (partial-merge-capable) operator: keep the chain intact.
+    return [e for e in group if e[2] == OpType.MERGE]

@@ -1,0 +1,654 @@
+"""DB: the LSM engine tying WAL, memtable, SSTs, and compaction together.
+
+API parity targets (what the upper layers use of rocksdb::DB — SURVEY.md):
+- ``write(batch)`` / ``get`` / ``multi_get`` / ``new_iterator``
+  (application_db.cpp delegates these)
+- ``latest_sequence_number`` / ``get_updates_since`` (db_wrapper.h seam)
+- ``checkpoint`` (admin_handler.cpp:996-1129 checkpoint backup)
+- ``ingest_external_file`` with ``allow_global_seqno`` / ``ingest_behind``
+  (admin_handler.cpp:1819-1827)
+- ``compact_range`` (async_tm_compactDB) with a pluggable backend — the
+  TPU offload seam
+- ``get_property`` incl. ``num-levels`` / ``highest-empty-level``
+  (application_db.cpp:183-225 DBLmaxEmpty ingest-behind safety check)
+- ``destroy_db`` (clearDB path: removeDB → DestroyDB → reopen)
+- ``set_options`` (async_tm_setDBOptions)
+
+Directory layout: ``<path>/MANIFEST`` (JSON, atomic rewrite),
+``<path>/wal/wal-*.log``, ``<path>/sst-*.tsst``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..utils.misc import write_file_atomic
+from . import wal as wal_mod
+from .compaction import CompactionBackend, CpuCompactionBackend, resolve_stream
+from .errors import Corruption, InvalidArgument, StorageError
+from .memtable import MemTable
+from .merge import MERGE_OPERATORS, MergeOperator
+from .records import OpType, WriteBatch, decode_batch
+from .sst import COMPRESSION_NONE, COMPRESSION_ZLIB, SSTReader, SSTWriter
+
+import heapq
+import logging
+
+log = logging.getLogger(__name__)
+
+_MANIFEST = "MANIFEST"
+
+
+@dataclass
+class DBOptions:
+    create_if_missing: bool = True
+    error_if_exists: bool = False
+    merge_operator: Optional[MergeOperator] = None
+    num_levels: int = 7
+    allow_ingest_behind: bool = False
+    memtable_bytes: int = 8 * 1024 * 1024
+    block_bytes: int = 32 * 1024
+    compression: int = COMPRESSION_ZLIB
+    bits_per_key: int = 10
+    wal_segment_bytes: int = 16 * 1024 * 1024
+    wal_ttl_seconds: float = 3600.0
+    sync_writes: bool = False
+    level0_compaction_trigger: int = 4
+    target_file_bytes: int = 64 * 1024 * 1024
+    compaction_backend: Optional[CompactionBackend] = None
+    disable_auto_compaction: bool = False
+
+    # Mutable at runtime via DB.set_options (reference setDBOptions RPC).
+    MUTABLE = {
+        "memtable_bytes", "wal_ttl_seconds", "level0_compaction_trigger",
+        "target_file_bytes", "disable_auto_compaction", "sync_writes",
+    }
+
+
+class DB:
+    """One LSM database (one shard in the sharded deployment)."""
+
+    def __init__(self, path: str, options: Optional[DBOptions] = None):
+        self.path = os.path.abspath(path)
+        self.options = options or DBOptions()
+        self._lock = threading.RLock()
+        self._mem = MemTable()
+        self._imm: Optional[MemTable] = None  # memtable being flushed
+        self._last_seq = 0
+        self._persisted_seq = 0  # highest seq durable in SSTs
+        self._next_file_id = 1
+        # levels[0] may overlap; levels[1:] sorted non-overlapping by range
+        self._levels: List[List[str]] = []
+        self._readers: Dict[str, SSTReader] = {}
+        self._wal: Optional[wal_mod.WalWriter] = None
+        self._closed = False
+        self._backend = self.options.compaction_backend or CpuCompactionBackend()
+        self._open()
+
+    # ------------------------------------------------------------------
+    # open / recovery
+    # ------------------------------------------------------------------
+
+    def _open(self) -> None:
+        manifest_path = os.path.join(self.path, _MANIFEST)
+        exists = os.path.isfile(manifest_path)
+        if exists and self.options.error_if_exists:
+            raise InvalidArgument(f"db exists: {self.path}")
+        if not exists and not self.options.create_if_missing:
+            raise InvalidArgument(f"db missing: {self.path}")
+        os.makedirs(self.path, exist_ok=True)
+        os.makedirs(self._wal_dir, exist_ok=True)
+        if exists:
+            with open(manifest_path, "r") as f:
+                manifest = json.load(f)
+            self._persisted_seq = manifest["persisted_seq"]
+            self._next_file_id = manifest["next_file_id"]
+            self._levels = [list(files) for files in manifest["levels"]]
+        else:
+            self._levels = [[] for _ in range(self.options.num_levels)]
+            self._persist_manifest()
+        while len(self._levels) < self.options.num_levels:
+            self._levels.append([])
+        for level_files in self._levels:
+            for name in level_files:
+                self._readers[name] = SSTReader(os.path.join(self.path, name))
+        # Recover: last_seq from SSTs, then WAL replay beyond persisted_seq.
+        self._last_seq = self._persisted_seq
+        for start_seq, body in wal_mod.iter_updates(
+            self._wal_dir, 0, truncate_torn=True
+        ):
+            batch = decode_batch(body)
+            end_seq = start_seq + batch.count() - 1
+            if end_seq <= self._persisted_seq:
+                continue
+            self._apply_to_memtable(batch, start_seq)
+            self._last_seq = max(self._last_seq, end_seq)
+        self._wal = wal_mod.WalWriter(
+            self._wal_dir, self.options.wal_segment_bytes, self.options.sync_writes
+        )
+
+    @property
+    def _wal_dir(self) -> str:
+        return os.path.join(self.path, "wal")
+
+    def _persist_manifest(self) -> None:
+        manifest = {
+            "persisted_seq": self._persisted_seq,
+            "next_file_id": self._next_file_id,
+            "levels": self._levels,
+        }
+        write_file_atomic(
+            os.path.join(self.path, _MANIFEST),
+            json.dumps(manifest).encode("utf-8"),
+        )
+
+    # ------------------------------------------------------------------
+    # writes
+    # ------------------------------------------------------------------
+
+    def write(self, batch: WriteBatch, sync: bool = False) -> int:
+        """Apply a batch atomically; returns the batch's start seq."""
+        count = batch.count()
+        with self._lock:
+            self._check_open()
+            start_seq = self._last_seq + 1
+            encoded = batch.encode()
+            assert self._wal is not None
+            self._wal.append(start_seq, encoded)
+            if sync or self.options.sync_writes:
+                self._wal.sync()
+            self._apply_to_memtable(batch, start_seq)
+            self._last_seq += count
+            if self._mem.approximate_bytes() >= self.options.memtable_bytes:
+                self._flush_locked()
+            return start_seq
+
+    def _apply_to_memtable(self, batch: WriteBatch, start_seq: int) -> None:
+        seq = start_seq
+        for op, key, value in batch.ops():
+            if op is OpType.LOG_DATA:
+                continue
+            self._mem.apply(key, seq, op, value)
+            seq += 1
+
+    def put(self, key: bytes, value: bytes) -> int:
+        return self.write(WriteBatch().put(key, value))
+
+    def delete(self, key: bytes) -> int:
+        return self.write(WriteBatch().delete(key))
+
+    def merge(self, key: bytes, operand: bytes) -> int:
+        return self.write(WriteBatch().merge(key, operand))
+
+    # ------------------------------------------------------------------
+    # reads
+    # ------------------------------------------------------------------
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        key = bytes(key)
+        with self._lock:
+            self._check_open()
+            merge_op = self.options.merge_operator
+            operands: List[bytes] = []
+            for mem in (self._mem, self._imm):
+                if mem is None:
+                    continue
+                resolved, value, pending = mem.get(key, merge_op)
+                if resolved and not operands:
+                    return value
+                if resolved:
+                    base = value
+                    return merge_op.merge(key, base, operands[::-1]) if merge_op else base
+                operands.extend(pending[::-1])  # newest-first accumulation
+            # L0 newest-first, then deeper levels. Fold through every entry
+            # of each file's per-key stack (MERGE operands stack within one
+            # SST after a flush).
+            for name in reversed(self._levels[0]):
+                for result in self._readers[name].get_entries(key):
+                    done, value = self._fold(key, result, operands, merge_op)
+                    if done:
+                        return value
+            for level_files in self._levels[1:]:
+                reader = self._find_file_for_key(level_files, key)
+                if reader is None:
+                    continue
+                for result in reader.get_entries(key):
+                    done, value = self._fold(key, result, operands, merge_op)
+                    if done:
+                        return value
+            if operands and merge_op:
+                return merge_op.merge(key, None, operands[::-1])
+            return None
+
+    def _fold(
+        self,
+        key: bytes,
+        result: Tuple[int, int, bytes],
+        operands: List[bytes],
+        merge_op: Optional[MergeOperator],
+    ) -> Tuple[bool, Optional[bytes]]:
+        _seq, vtype, value = result
+        if vtype == OpType.PUT:
+            if operands and merge_op:
+                return True, merge_op.merge(key, value, operands[::-1])
+            return True, value
+        if vtype == OpType.DELETE:
+            if operands and merge_op:
+                return True, merge_op.merge(key, None, operands[::-1])
+            return True, None
+        operands.append(value)  # MERGE operand, keep descending
+        return False, None
+
+    def _find_file_for_key(self, level_files: List[str], key: bytes) -> Optional[SSTReader]:
+        for name in level_files:
+            reader = self._readers[name]
+            mn, mx = reader.min_key(), reader.max_key()
+            if mn is not None and mx is not None and mn <= key <= mx:
+                return reader
+        return None
+
+    def multi_get(self, keys: List[bytes]) -> List[Optional[bytes]]:
+        return [self.get(k) for k in keys]
+
+    def new_iterator(
+        self, start: Optional[bytes] = None, end: Optional[bytes] = None
+    ) -> Iterator[Tuple[bytes, bytes]]:
+        """Live (key, value) pairs in key order over a point-in-time view.
+
+        The view is materialized under the DB lock so concurrent flush/
+        compaction file GC cannot invalidate it (the native engine will use
+        refcounted file snapshots instead)."""
+        out: List[Tuple[bytes, bytes]] = []
+        with self._lock:
+            self._check_open()
+            runs: List[Iterator] = []
+            mems = [m for m in (self._mem, self._imm) if m is not None]
+            for mem in mems:
+                runs.append(iter(list(mem.entries())))
+            for name in self._levels[0]:
+                runs.append(self._readers[name].iterate())
+            for level_files in self._levels[1:]:
+                for name in level_files:
+                    runs.append(self._readers[name].iterate())
+            merge_op = self.options.merge_operator
+            merged = heapq.merge(*runs, key=lambda e: (e[0], -e[1]))
+            for key, _seq, vtype, value in resolve_stream(merged, merge_op, False):
+                if start is not None and key < start:
+                    continue
+                if end is not None and key >= end:
+                    break
+                if vtype == OpType.DELETE:
+                    continue
+                if vtype == OpType.MERGE:
+                    value = merge_op.merge(key, None, [value]) if merge_op else value
+                out.append((key, value))
+        return iter(out)
+
+    # ------------------------------------------------------------------
+    # sequence numbers / replication shipping (db_wrapper.h seam)
+    # ------------------------------------------------------------------
+
+    def latest_sequence_number(self) -> int:
+        with self._lock:
+            return self._last_seq
+
+    def get_updates_since(self, seq: int) -> Iterator[Tuple[int, bytes]]:
+        """(start_seq, raw_batch_bytes) for every batch whose start_seq >=
+        ``seq``. Followers pass latest_local+1 (replicated_db.cpp:486-505)."""
+        return wal_mod.iter_updates(self._wal_dir, seq)
+
+    # ------------------------------------------------------------------
+    # flush / compaction
+    # ------------------------------------------------------------------
+
+    def flush(self) -> None:
+        with self._lock:
+            self._check_open()
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if len(self._mem) == 0:
+            return
+        mem = self._mem
+        self._imm = mem
+        self._mem = MemTable()
+        writer: Optional[SSTWriter] = None
+        try:
+            name = self._new_file_name()
+            writer = SSTWriter(
+                os.path.join(self.path, name),
+                self.options.block_bytes,
+                self.options.compression,
+                self.options.bits_per_key,
+            )
+            for key, seq, vtype, value in mem.entries():
+                writer.add(key, seq, vtype, value)
+            writer.finish()
+            self._readers[name] = SSTReader(os.path.join(self.path, name))
+            self._levels[0].append(name)
+            self._persisted_seq = max(self._persisted_seq, mem.max_seq)
+            self._persist_manifest()
+        except BaseException:
+            # Keep read-your-writes: fold the unflushed entries back under
+            # any writes that raced in, and drop the partial SST.
+            if writer is not None:
+                writer.abandon()
+            self._mem.absorb_older(mem)
+            raise
+        finally:
+            self._imm = None
+        wal_mod.purge_obsolete(
+            self._wal_dir, self._persisted_seq, self.options.wal_ttl_seconds
+        )
+        if (
+            not self.options.disable_auto_compaction
+            and len(self._levels[0]) >= self.options.level0_compaction_trigger
+        ):
+            self._compact_level0_locked()
+
+    def _new_file_name(self) -> str:
+        name = f"sst-{self._next_file_id:08d}.tsst"
+        self._next_file_id += 1
+        return name
+
+    def compact_range(
+        self, start: Optional[bytes] = None, end: Optional[bytes] = None
+    ) -> None:
+        """Full compaction: merge everything into the bottom level (the
+        reference's CompactRange(full) after ingest, admin_handler.cpp:1845).
+        ``start``/``end`` accepted for API parity; the merge is whole-range."""
+        with self._lock:
+            self._check_open()
+            self._flush_locked()
+            # allow_ingest_behind reserves the true bottom level for
+            # ingested-behind data (RocksDB does the same), so full
+            # compaction targets num_levels-2 there.
+            bottom = self.options.num_levels - 1
+            if self.options.allow_ingest_behind:
+                bottom -= 1
+            inputs: List[str] = [n for files in self._levels for n in files]
+            if not inputs:
+                return
+            runs = [self._readers[n].iterate() for n in inputs]
+            # Tombstones must survive when data can later be ingested BEHIND
+            # this level — dropping them would resurrect deleted keys.
+            out_names = self._write_merged(
+                runs, drop_tombstones=not self.options.allow_ingest_behind
+            )
+            for files in self._levels:
+                files.clear()
+            self._levels[bottom] = out_names
+            self._gc_files(inputs)
+            self._persist_manifest()
+
+    def _compact_level0_locked(self) -> None:
+        """L0 → L1 compaction (tombstones kept; not bottom level)."""
+        inputs = list(self._levels[0]) + list(self._levels[1])
+        if not inputs:
+            return
+        runs = [self._readers[n].iterate() for n in inputs]
+        drop = (
+            all(not files for files in self._levels[2:])
+            and not self.options.allow_ingest_behind
+        )
+        out_names = self._write_merged(runs, drop_tombstones=drop)
+        self._levels[0] = []
+        self._levels[1] = out_names
+        self._gc_files(inputs)
+        self._persist_manifest()
+
+    def _write_merged(self, runs: List, drop_tombstones: bool) -> List[str]:
+        stream = self._backend.merge_runs(
+            runs, self.options.merge_operator, drop_tombstones
+        )
+        out_names: List[str] = []
+        writer: Optional[SSTWriter] = None
+        written = 0
+        for key, seq, vtype, value in stream:
+            if writer is None:
+                name = self._new_file_name()
+                out_names.append(name)
+                writer = SSTWriter(
+                    os.path.join(self.path, name),
+                    self.options.block_bytes,
+                    self.options.compression,
+                    self.options.bits_per_key,
+                )
+                written = 0
+            writer.add(key, seq, vtype, value)
+            written += len(key) + len(value)
+            if written >= self.options.target_file_bytes:
+                writer.finish()
+                writer = None
+        if writer is not None:
+            writer.finish()
+        for name in out_names:
+            self._readers[name] = SSTReader(os.path.join(self.path, name))
+        return out_names
+
+    def _gc_files(self, names: List[str]) -> None:
+        for name in names:
+            reader = self._readers.pop(name, None)
+            if reader is not None:
+                reader.close()
+            try:
+                os.remove(os.path.join(self.path, name))
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+    # properties (application_db.cpp:183-225)
+    # ------------------------------------------------------------------
+
+    def get_property(self, name: str) -> Optional[str]:
+        with self._lock:
+            if name == "num-levels":
+                return str(self.options.num_levels)
+            if name == "highest-empty-level":
+                # Highest (deepest) level index that is empty along with all
+                # levels above... reference semantics: the highest level L
+                # such that levels L..Lmax hold no files ⇒ safe ingest-behind.
+                highest = -1
+                for i in range(self.options.num_levels - 1, -1, -1):
+                    if not self._levels[i]:
+                        highest = i
+                    else:
+                        break
+                return str(highest)
+            if name.startswith("num-files-at-level"):
+                level = int(name[len("num-files-at-level"):])
+                if 0 <= level < len(self._levels):
+                    return str(len(self._levels[level]))
+                return "0"
+            if name == "estimate-num-keys":
+                total = len(self._mem) + sum(
+                    r.props.get("num_keys", 0) for r in self._readers.values()
+                )
+                return str(total)
+            if name == "total-sst-bytes":
+                total = 0
+                for files in self._levels:
+                    for n in files:
+                        try:
+                            total += os.path.getsize(os.path.join(self.path, n))
+                        except OSError:
+                            pass
+                return str(total)
+            return None
+
+    def approximate_disk_size(self) -> int:
+        return int(self.get_property("total-sst-bytes") or 0)
+
+    def set_options(self, updates: Dict[str, object]) -> None:
+        """Runtime-mutable options (reference setDBOptions,
+        admin_handler.cpp:2134-2158)."""
+        from ..utils.flags import _coerce
+
+        with self._lock:
+            for k, v in updates.items():
+                if k not in DBOptions.MUTABLE:
+                    raise InvalidArgument(f"option not mutable: {k}")
+                current = getattr(self.options, k)
+                # _coerce handles "false"→False etc. (same class of bug as
+                # flags string coercion).
+                setattr(self.options, k, _coerce(v, type(current)))
+
+    # ------------------------------------------------------------------
+    # checkpoint / ingest / destroy
+    # ------------------------------------------------------------------
+
+    def checkpoint(self, checkpoint_dir: str) -> None:
+        """Consistent on-disk snapshot via hardlinks (rocksdb::Checkpoint).
+        Flushes first so the checkpoint is WAL-free, like the reference's
+        checkpoint-backup path (admin_handler.cpp:996-1129)."""
+        with self._lock:
+            self._check_open()
+            self._flush_locked()
+            if os.path.exists(checkpoint_dir):
+                raise InvalidArgument(f"checkpoint dir exists: {checkpoint_dir}")
+            os.makedirs(checkpoint_dir)
+            for files in self._levels:
+                for name in files:
+                    src = os.path.join(self.path, name)
+                    dst = os.path.join(checkpoint_dir, name)
+                    try:
+                        os.link(src, dst)
+                    except OSError:
+                        shutil.copyfile(src, dst)
+            manifest = {
+                "persisted_seq": self._persisted_seq,
+                "next_file_id": self._next_file_id,
+                "levels": self._levels,
+            }
+            write_file_atomic(
+                os.path.join(checkpoint_dir, _MANIFEST),
+                json.dumps(manifest).encode("utf-8"),
+            )
+
+    def ingest_external_file(
+        self,
+        sst_paths: List[str],
+        move_files: bool = False,
+        allow_global_seqno: bool = True,
+        ingest_behind: bool = False,
+    ) -> None:
+        """IngestExternalFile parity (admin_handler.cpp:1819-1827).
+
+        Normal ingest: file gets global_seqno = last_seq+1 and lands in L0.
+        ingest_behind: file lands in the bottom level with global_seqno 0
+        (older than everything); requires ``allow_ingest_behind`` and an
+        empty bottom level (the DBLmaxEmpty check).
+        """
+        with self._lock:
+            self._check_open()
+            if ingest_behind:
+                if not self.options.allow_ingest_behind:
+                    raise InvalidArgument("db not opened with allow_ingest_behind")
+                if self._levels[-1]:
+                    raise InvalidArgument("bottom level not empty")
+            new_names: List[str] = []
+            try:
+                for src in sst_paths:
+                    probe = SSTReader(src)  # validates format
+                    probe.close()
+                    name = self._new_file_name()
+                    dst = os.path.join(self.path, name)
+                    if move_files:
+                        try:
+                            os.link(src, dst)
+                            os.remove(src)
+                        except OSError:
+                            shutil.move(src, dst)
+                    else:
+                        shutil.copyfile(src, dst)
+                    new_names.append(name)
+            except (OSError, Corruption) as e:
+                self._gc_files(new_names)
+                raise StorageError(f"ingest failed: {e}") from e
+            if ingest_behind:
+                self._set_global_seqnos(new_names, 0)
+                # Bottom level must stay sorted & non-overlapping.
+                readers = [self._readers_open(n) for n in new_names]
+                readers.sort(key=lambda r: r.min_key() or b"")
+                ordered = [os.path.basename(r._path) for r in readers]
+                for a, b in zip(readers, readers[1:]):
+                    if a.max_key() and b.min_key() and a.max_key() >= b.min_key():
+                        self._gc_files(new_names)
+                        raise InvalidArgument("ingest_behind files overlap")
+                self._levels[-1] = ordered
+            else:
+                # The ingested file is newer than everything current, so the
+                # memtable must be flushed below it first (RocksDB flushes on
+                # overlapping ingest for the same reason).
+                if len(self._mem):
+                    self._flush_locked()
+                if allow_global_seqno:
+                    self._last_seq += 1
+                    self._set_global_seqnos(new_names, self._last_seq)
+                    self._persisted_seq = max(self._persisted_seq, self._last_seq)
+                self._levels[0].extend(new_names)
+            self._persist_manifest()
+
+    def _readers_open(self, name: str) -> SSTReader:
+        if name not in self._readers:
+            self._readers[name] = SSTReader(os.path.join(self.path, name))
+        return self._readers[name]
+
+    def _set_global_seqnos(self, names: List[str], seqno: int) -> None:
+        """Rewrite the footer global_seqno in place (RocksDB does exactly
+        this — a pwrite into the ingested file's seqno slot)."""
+        from .sst import _FOOTER, FLAG_HAS_GLOBAL_SEQNO, MAGIC
+
+        for name in names:
+            path = os.path.join(self.path, name)
+            with open(path, "r+b") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(size - _FOOTER.size)
+                fields = list(_FOOTER.unpack(f.read(_FOOTER.size)))
+                fields[3] = seqno
+                fields[6] |= FLAG_HAS_GLOBAL_SEQNO
+                f.seek(size - _FOOTER.size)
+                f.write(_FOOTER.pack(*fields))
+            old = self._readers.pop(name, None)
+            if old is not None:
+                old.close()
+            self._readers[name] = SSTReader(path)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if self._wal is not None:
+                self._wal.close()
+            for reader in self._readers.values():
+                reader.close()
+            self._readers.clear()
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise StorageError("db is closed")
+
+    def __enter__(self) -> "DB":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def destroy_db(path: str) -> None:
+    """DestroyDB parity (clearDB path, admin_handler.cpp:1774-1817)."""
+    if os.path.isdir(path):
+        shutil.rmtree(path)

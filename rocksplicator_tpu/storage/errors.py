@@ -1,0 +1,17 @@
+"""Storage engine error taxonomy."""
+
+
+class StorageError(Exception):
+    pass
+
+
+class NotFoundError(StorageError):
+    pass
+
+
+class Corruption(StorageError):
+    pass
+
+
+class InvalidArgument(StorageError):
+    pass

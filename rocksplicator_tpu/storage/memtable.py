@@ -1,0 +1,82 @@
+"""In-memory write buffer (memtable).
+
+Reference: RocksDB memtable. Stores per-key op stacks (newest first) so
+MERGE operands accumulate correctly before a flush; iteration yields
+entries in (key asc, seq desc) order — the SST writer's required order.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .merge import MergeOperator
+from .records import OpType
+
+# entry: (seq, vtype, value), newest first
+_Entry = Tuple[int, int, bytes]
+
+
+class MemTable:
+    def __init__(self) -> None:
+        self._data: Dict[bytes, List[_Entry]] = {}
+        self._bytes = 0
+        self.min_seq: Optional[int] = None
+        self.max_seq = 0
+
+    def apply(self, key: bytes, seq: int, vtype: int, value: bytes) -> None:
+        self._data.setdefault(key, []).insert(0, (seq, vtype, value))
+        self._bytes += len(key) + len(value) + 16
+        if self.min_seq is None:
+            self.min_seq = seq
+        self.max_seq = max(self.max_seq, seq)
+
+    def get(
+        self, key: bytes, merge_op: Optional[MergeOperator]
+    ) -> Tuple[bool, Optional[bytes], List[bytes]]:
+        """Returns (resolved, value_or_None, pending_operands).
+
+        resolved=True: value_or_None is the final answer (None = deleted).
+        resolved=False: pending_operands are MERGE operands (newest last)
+        still awaiting a base value from older levels.
+        """
+        entries = self._data.get(key)
+        if not entries:
+            return False, None, []
+        operands: List[bytes] = []
+        for seq, vtype, value in entries:  # newest -> oldest
+            if vtype == OpType.PUT:
+                if operands and merge_op:
+                    return True, merge_op.merge(key, value, list(reversed(operands))), []
+                return True, value, []
+            if vtype == OpType.DELETE:
+                if operands and merge_op:
+                    return True, merge_op.merge(key, None, list(reversed(operands))), []
+                return True, None, []
+            if vtype == OpType.MERGE:
+                operands.append(value)
+        return False, None, list(reversed(operands))
+
+    def absorb_older(self, older: "MemTable") -> None:
+        """Fold an OLDER memtable's entries beneath this one's (flush-failure
+        recovery path): older entries append after newer ones per key."""
+        for key, entries in older._data.items():
+            self._data.setdefault(key, []).extend(entries)
+        self._bytes += older._bytes
+        if older.min_seq is not None:
+            self.min_seq = (
+                older.min_seq if self.min_seq is None
+                else min(self.min_seq, older.min_seq)
+            )
+        self.max_seq = max(self.max_seq, older.max_seq)
+
+    def approximate_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def entries(self) -> Iterator[Tuple[bytes, int, int, bytes]]:
+        """(key, seq, vtype, value) in (key asc, seq desc) order."""
+        for key in sorted(self._data):
+            for seq, vtype, value in self._data[key]:
+                yield key, seq, vtype, value

@@ -1,0 +1,56 @@
+"""Merge operators.
+
+Reference: rocksdb::AssociativeMergeOperator;
+examples/counter_service/merge_operator.h:20-40 implements the counter bump
+as a uint64-add associative merge.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+_U64 = struct.Struct("<q")
+
+
+class MergeOperator:
+    name = "base"
+
+    def merge(self, key: bytes, existing: Optional[bytes], operands: List[bytes]) -> bytes:
+        raise NotImplementedError
+
+    def partial_merge(self, key: bytes, operands: List[bytes]) -> Optional[bytes]:
+        """Associative collapse of operands without the base value; None if
+        not supported."""
+        return None
+
+
+class UInt64AddOperator(MergeOperator):
+    """Counter bump (merge_operator.h:20-40): values are little-endian
+    int64; merge sums base + operands. Malformed values reset to 0 like the
+    reference's defensive parse."""
+
+    name = "uint64add"
+
+    @staticmethod
+    def _parse(v: Optional[bytes]) -> int:
+        if v is None or len(v) != _U64.size:
+            return 0
+        return _U64.unpack(v)[0]
+
+    def merge(self, key: bytes, existing: Optional[bytes], operands: List[bytes]) -> bytes:
+        total = self._parse(existing)
+        for op in operands:
+            total += self._parse(op)
+        total &= (1 << 64) - 1
+        if total >= 1 << 63:
+            total -= 1 << 64
+        return _U64.pack(total)
+
+    def partial_merge(self, key: bytes, operands: List[bytes]) -> Optional[bytes]:
+        return self.merge(key, None, operands)
+
+
+MERGE_OPERATORS = {
+    UInt64AddOperator.name: UInt64AddOperator,
+}

@@ -1,0 +1,351 @@
+"""TSST — the sorted-string-table file format.
+
+Reference: RocksDB SST files (the engine's persistent sorted runs), incl.
+the properties the admin plane reads and the ``global_seqno`` mechanism
+used by ``IngestExternalFile`` (admin_handler.cpp:1819-1827 ingests with
+``allow_global_seqno``).
+
+Layout (all little-endian):
+
+    [data block 0] ... [data block N-1]
+    [bloom block]
+    [index block]     per block: varstr last_key, u64 offset, u32 size, u8 compressed
+    [props JSON]
+    [footer]          fixed size, see _FOOTER
+
+Data block entry: u32 key_len, key, u64 seq, u8 vtype, u32 val_len, val —
+entries strictly sorted by (key asc, seq desc). Blocks optionally
+zlib-compressed (standing in for the reference's Snappy/ZSTD block
+compression; the codec byte keeps the format open for a TPU-side encoder).
+
+A file-level ``global_seqno`` overrides per-entry seqs at read time —
+exactly how ingestion assigns sequence numbers without rewriting the file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .bloom import BloomFilter
+from .errors import Corruption, InvalidArgument
+from .records import OpType
+
+MAGIC = b"TSSTv1\x00\x00"
+_FOOTER = struct.Struct("<QQQQIQB8s")  # bloom_off, index_off, props_off,
+# global_seqno, num_blocks, num_entries, flags, magic
+_ENTRY_HEAD = struct.Struct("<I")
+_ENTRY_META = struct.Struct("<QBI")
+_INDEX_ENTRY = struct.Struct("<QIB")
+
+COMPRESSION_NONE = 0
+COMPRESSION_ZLIB = 1
+
+FLAG_HAS_GLOBAL_SEQNO = 1
+
+
+def _encode_entry(key: bytes, seq: int, vtype: int, value: bytes) -> bytes:
+    return (
+        _ENTRY_HEAD.pack(len(key))
+        + key
+        + _ENTRY_META.pack(seq, vtype, len(value))
+        + value
+    )
+
+
+class SSTWriter:
+    """Writes entries in strictly ascending (key, -seq) order."""
+
+    def __init__(
+        self,
+        path: str,
+        block_bytes: int = 32 * 1024,
+        compression: int = COMPRESSION_ZLIB,
+        bits_per_key: int = 10,
+    ):
+        self._path = path
+        self._block_bytes = block_bytes
+        self._compression = compression
+        self._bits_per_key = bits_per_key
+        self._file = open(path, "wb")
+        self._block: List[bytes] = []
+        self._block_size = 0
+        self._index: List[Tuple[bytes, int, int, int]] = []
+        self._offset = 0
+        self._keys: List[bytes] = []
+        self._last_key: Optional[bytes] = None
+        self._last_seq = 0
+        self._num_entries = 0
+        self._min_key: Optional[bytes] = None
+        self._max_key: Optional[bytes] = None
+        self._min_seq: Optional[int] = None
+        self._max_seq = 0
+        self._raw_bytes = 0
+        self._finished = False
+
+    def add(self, key: bytes, seq: int, vtype: int, value: bytes) -> None:
+        if self._last_key is not None and (
+            key < self._last_key or (key == self._last_key and seq >= self._last_seq)
+        ):
+            raise InvalidArgument(
+                f"keys out of order: {key!r}@{seq} after {self._last_key!r}@{self._last_seq}"
+            )
+        if self._last_key != key:
+            self._keys.append(key)
+        self._last_key, self._last_seq = key, seq
+        entry = _encode_entry(key, seq, vtype, value)
+        self._block.append(entry)
+        self._block_size += len(entry)
+        self._raw_bytes += len(entry)
+        self._num_entries += 1
+        if self._min_key is None:
+            self._min_key = key
+        self._max_key = key
+        if self._min_seq is None or seq < self._min_seq:
+            self._min_seq = seq
+        self._max_seq = max(self._max_seq, seq)
+        if self._block_size >= self._block_bytes:
+            self._flush_block()
+
+    def add_encoded_block(self, block_payload: bytes, last_key: bytes,
+                          num_entries: int, keys: List[bytes],
+                          min_key: bytes, max_key: bytes,
+                          min_seq: int, max_seq: int,
+                          compressed: bool) -> None:
+        """Accepts a pre-encoded data block — the TPU encode kernel's output
+        path: blocks arrive already packed (and optionally compressed) and
+        are appended without re-serialization."""
+        if self._block:
+            self._flush_block()
+        self._file.write(block_payload)
+        self._index.append(
+            (last_key, self._offset, len(block_payload),
+             COMPRESSION_ZLIB if compressed else COMPRESSION_NONE)
+        )
+        self._offset += len(block_payload)
+        self._keys.extend(keys)
+        self._num_entries += num_entries
+        self._raw_bytes += len(block_payload)
+        if self._min_key is None:
+            self._min_key = min_key
+        self._max_key = max_key
+        if self._min_seq is None or min_seq < self._min_seq:
+            self._min_seq = min_seq
+        self._max_seq = max(self._max_seq, max_seq)
+        self._last_key = max_key
+        self._last_seq = 0
+
+    def _flush_block(self) -> None:
+        if not self._block:
+            return
+        raw = b"".join(self._block)
+        codec = self._compression
+        payload = zlib.compress(raw, 1) if codec == COMPRESSION_ZLIB else raw
+        if len(payload) >= len(raw):
+            codec, payload = COMPRESSION_NONE, raw
+        assert self._last_key is not None
+        self._index.append((self._last_key, self._offset, len(payload), codec))
+        self._file.write(payload)
+        self._offset += len(payload)
+        self._block = []
+        self._block_size = 0
+
+    def finish(self, global_seqno: Optional[int] = None,
+               extra_props: Optional[Dict] = None) -> Dict:
+        if self._finished:
+            raise InvalidArgument("finish() called twice")
+        self._flush_block()
+        self._finished = True
+        bloom_off = self._offset
+        bloom = BloomFilter.build(self._keys, self._bits_per_key)
+        bloom_bytes = bloom.to_bytes()
+        self._file.write(bloom_bytes)
+        index_off = bloom_off + len(bloom_bytes)
+        index_parts = []
+        for last_key, off, size, codec in self._index:
+            index_parts.append(struct.pack("<I", len(last_key)))
+            index_parts.append(last_key)
+            index_parts.append(_INDEX_ENTRY.pack(off, size, codec))
+        index_bytes = b"".join(index_parts)
+        self._file.write(index_bytes)
+        props_off = index_off + len(index_bytes)
+        props = {
+            "num_entries": self._num_entries,
+            "num_keys": len(self._keys),
+            "raw_bytes": self._raw_bytes,
+            "min_key": self._min_key.hex() if self._min_key is not None else None,
+            "max_key": self._max_key.hex() if self._max_key is not None else None,
+            "min_seq": self._min_seq or 0,
+            "max_seq": self._max_seq,
+        }
+        if extra_props:
+            props.update(extra_props)
+        props_bytes = json.dumps(props).encode("utf-8")
+        self._file.write(props_bytes)
+        flags = FLAG_HAS_GLOBAL_SEQNO if global_seqno is not None else 0
+        self._file.write(
+            _FOOTER.pack(
+                bloom_off, index_off, props_off,
+                global_seqno if global_seqno is not None else 0,
+                len(self._index), self._num_entries, flags, MAGIC,
+            )
+        )
+        self._file.close()
+        return props
+
+    def abandon(self) -> None:
+        if not self._finished:
+            self._file.close()
+            try:
+                os.remove(self._path)
+            except OSError:
+                pass
+
+
+class SSTReader:
+    """Thread-safe reader: block reads use positioned pread so concurrent
+    gets/iterators never race on a shared file offset."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._fd = os.open(path, os.O_RDONLY)
+        file_size = os.fstat(self._fd).st_size
+        if file_size < _FOOTER.size:
+            os.close(self._fd)
+            raise Corruption(f"{path}: too small for footer")
+        try:
+            footer_raw = os.pread(self._fd, _FOOTER.size, file_size - _FOOTER.size)
+            (
+                bloom_off, index_off, props_off, global_seqno,
+                num_blocks, num_entries, flags, magic,
+            ) = _FOOTER.unpack(footer_raw)
+            if magic != MAGIC:
+                raise Corruption(f"{path}: bad magic")
+        except Corruption:
+            os.close(self._fd)
+            raise
+        self.global_seqno: Optional[int] = (
+            global_seqno if flags & FLAG_HAS_GLOBAL_SEQNO else None
+        )
+        self.num_entries = num_entries
+        self._bloom = BloomFilter.from_bytes(
+            os.pread(self._fd, index_off - bloom_off, bloom_off)
+        )
+        index_raw = os.pread(self._fd, props_off - index_off, index_off)
+        self._index: List[Tuple[bytes, int, int, int]] = []
+        pos = 0
+        for _ in range(num_blocks):
+            (klen,) = struct.unpack_from("<I", index_raw, pos)
+            pos += 4
+            last_key = index_raw[pos:pos + klen]
+            pos += klen
+            off, size, codec = _INDEX_ENTRY.unpack_from(index_raw, pos)
+            pos += _INDEX_ENTRY.size
+            self._index.append((last_key, off, size, codec))
+        props_raw = os.pread(
+            self._fd, file_size - _FOOTER.size - props_off, props_off
+        )
+        self.props: Dict = json.loads(props_raw.decode("utf-8")) if props_raw else {}
+
+    # -- reads ------------------------------------------------------------
+
+    def _read_block(self, block_idx: int) -> bytes:
+        _last_key, off, size, codec = self._index[block_idx]
+        payload = os.pread(self._fd, size, off)
+        if codec == COMPRESSION_ZLIB:
+            return zlib.decompress(payload)
+        return payload
+
+    @staticmethod
+    def _iter_block(raw: bytes) -> Iterator[Tuple[bytes, int, int, bytes]]:
+        pos = 0
+        while pos < len(raw):
+            (klen,) = _ENTRY_HEAD.unpack_from(raw, pos)
+            pos += _ENTRY_HEAD.size
+            key = raw[pos:pos + klen]
+            pos += klen
+            seq, vtype, vlen = _ENTRY_META.unpack_from(raw, pos)
+            pos += _ENTRY_META.size
+            value = raw[pos:pos + vlen]
+            pos += vlen
+            yield key, seq, vtype, value
+
+    def _effective_seq(self, seq: int) -> int:
+        return self.global_seqno if self.global_seqno is not None else seq
+
+    def may_contain(self, key: bytes) -> bool:
+        return self._bloom.may_contain(key)
+
+    def get_entries(self, key: bytes) -> List[Tuple[int, int, bytes]]:
+        """ALL entries for key, newest first: [(seq, vtype, value)].
+        Multiple entries occur for stacked MERGE operands — callers must
+        fold through the whole stack, not just the newest."""
+        if not self._bloom.may_contain(key):
+            return []
+        # binary search over block last_keys for the first candidate block
+        lo, hi = 0, len(self._index) - 1
+        block = None
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            if self._index[mid][0] < key:
+                lo = mid + 1
+            else:
+                block = mid
+                hi = mid - 1
+        if block is None:
+            return []
+        out: List[Tuple[int, int, bytes]] = []
+        # Entries for one key are contiguous and (seq desc)-ordered but may
+        # span a block boundary.
+        for b in range(block, len(self._index)):
+            done = False
+            for k, seq, vtype, value in self._iter_block(self._read_block(b)):
+                if k == key:
+                    out.append((self._effective_seq(seq), vtype, value))
+                elif k > key:
+                    done = True
+                    break
+            if done or (out and b < len(self._index) - 1
+                        and self._index[b][0] > key):
+                break
+        return out
+
+    def get(self, key: bytes) -> Optional[Tuple[int, int, bytes]]:
+        """Newest entry for key: (seq, vtype, value) or None."""
+        entries = self.get_entries(key)
+        return entries[0] if entries else None
+
+    def iterate(
+        self, start: Optional[bytes] = None, end: Optional[bytes] = None
+    ) -> Iterator[Tuple[bytes, int, int, bytes]]:
+        """All entries (key, seq, vtype, value) in order, [start, end)."""
+        for i, (last_key, _off, _size, _codec) in enumerate(self._index):
+            if start is not None and last_key < start:
+                continue
+            for key, seq, vtype, value in self._iter_block(self._read_block(i)):
+                if start is not None and key < start:
+                    continue
+                if end is not None and key >= end:
+                    return
+                yield key, self._effective_seq(seq), vtype, value
+
+    def min_key(self) -> Optional[bytes]:
+        mk = self.props.get("min_key")
+        return bytes.fromhex(mk) if mk else None
+
+    def max_key(self) -> Optional[bytes]:
+        mk = self.props.get("max_key")
+        return bytes.fromhex(mk) if mk else None
+
+    def max_seq(self) -> int:
+        if self.global_seqno is not None:
+            return self.global_seqno
+        return self.props.get("max_seq", 0)
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1

@@ -340,3 +340,39 @@ def test_router_get_clients_skips_bad_hosts():
         ioloop.run_sync(go())
     finally:
         server.stop()
+
+
+def test_serde_rejects_bad_binary_refs():
+    import json as _json
+
+    payload = memoryview(b"0123456789")
+    for ref in ([-10, 5], [0, 99], [5], "x", [0, -1]):
+        header = _json.dumps({"v": {"$bin": ref}}).encode()
+        with pytest.raises(ValueError):
+            decode_message(memoryview(header), payload)
+
+
+def test_router_local_group_prefix_locality():
+    shard_map = {
+        "seg": {
+            "num_shards": 1,
+            "10.0.0.1:1:us-east-1a": ["00000:S"],
+            "10.0.0.2:1:us-east-1b": ["00000:S"],
+            "10.0.0.3:1:eu-west-1a": ["00000:S"],
+        }
+    }
+    router = RpcRouter(local_az="us-east-1a", local_group_prefix_len=9)
+    router.update_layout(ClusterLayout.parse(json.dumps(shard_map).encode()))
+    hosts = router.get_hosts_for("seg", 0, Role.FOLLOWER, Quantity.ALL)
+    assert [h.ip for h in hosts] == ["10.0.0.1", "10.0.0.2", "10.0.0.3"]
+
+
+def test_router_close_unregisters_watcher(tmp_path, file_watcher):
+    path = tmp_path / "map.json"
+    path.write_text(json.dumps({"seg": {"num_shards": 1, "1.2.3.4:1:az": ["00000:M"]}}))
+    router = RpcRouter(local_az="az", shard_map_path=str(path))
+    assert router.num_shards("seg") == 1
+    router.close()
+    path.write_text(json.dumps({"seg": {"num_shards": 9, "1.2.3.4:1:az": ["00000:M"]}}))
+    file_watcher.poll_now()
+    assert router.num_shards("seg") == 1  # no longer watching

@@ -1,0 +1,605 @@
+"""Storage engine tests.
+
+Covers the WriteBatch/WAL/SST/engine stack plus "engine assumption" tests —
+the equivalent of the reference's rocksdb_assumption_test.cpp (438 LoC),
+which pins the storage behaviors the replicator depends on: sequence-number
+continuity, get_updates_since semantics, restore/reopen seq behavior.
+"""
+
+import os
+import struct
+import threading
+
+import pytest
+
+from rocksplicator_tpu.storage import (
+    DB,
+    DBOptions,
+    NotFoundError,
+    OpType,
+    UInt64AddOperator,
+    WriteBatch,
+    decode_batch,
+    destroy_db,
+)
+from rocksplicator_tpu.storage.bloom import BloomFilter, word_mask
+from rocksplicator_tpu.storage.errors import Corruption, InvalidArgument
+from rocksplicator_tpu.storage.records import _TS
+from rocksplicator_tpu.storage.sst import SSTReader, SSTWriter
+from rocksplicator_tpu.storage import wal as wal_mod
+
+
+# ---------------------------------------------------------------------------
+# WriteBatch
+# ---------------------------------------------------------------------------
+
+
+def test_write_batch_roundtrip():
+    b = WriteBatch()
+    b.put(b"k1", b"v1").delete(b"k2").merge(b"k3", b"m3").put_log_data(b"meta")
+    data = b.encode()
+    out = decode_batch(data)
+    ops = list(out.ops())
+    assert ops == [
+        (OpType.PUT, b"k1", b"v1"),
+        (OpType.DELETE, b"k2", b""),
+        (OpType.MERGE, b"k3", b"m3"),
+        (OpType.LOG_DATA, b"", b"meta"),
+    ]
+    # LOG_DATA consumes no seqno (rocksdb assumption parity)
+    assert out.count() == 3
+    assert len(out) == 4
+
+
+def test_write_batch_timestamp_stamping():
+    b = WriteBatch().put(b"k", b"v")
+    b.stamp_timestamp_ms(12345)
+    out = decode_batch(b.encode())
+    assert out.extract_timestamp_ms() == 12345
+    stripped = out.strip_log_data()
+    assert stripped.extract_timestamp_ms() is None
+    assert stripped.count() == 1
+
+
+def test_decode_rejects_garbage():
+    with pytest.raises(Corruption):
+        decode_batch(b"\x01")
+    good = WriteBatch().put(b"a", b"b").encode()
+    with pytest.raises(Corruption):
+        decode_batch(good + b"extra")
+    with pytest.raises(Corruption):
+        decode_batch(good[:-1])
+
+
+# ---------------------------------------------------------------------------
+# WAL
+# ---------------------------------------------------------------------------
+
+
+def test_wal_append_iterate_roundtrip(tmp_path):
+    wal_dir = str(tmp_path / "wal")
+    w = wal_mod.WalWriter(wal_dir, segment_bytes=200)
+    batches = []
+    seq = 1
+    for i in range(10):
+        b = WriteBatch().put(f"k{i}".encode(), b"x" * 20)
+        w.append(seq, b.encode())
+        batches.append((seq, b.encode()))
+        seq += b.count()
+    w.close()
+    got = list(wal_mod.iter_updates(wal_dir, 0))
+    assert got == batches
+    # from the middle
+    got5 = list(wal_mod.iter_updates(wal_dir, 5))
+    assert got5 == batches[4:]
+    # multiple segments were created (small segment_bytes)
+    assert len(os.listdir(wal_dir)) > 1
+
+
+def test_wal_torn_tail_truncated(tmp_path):
+    wal_dir = str(tmp_path / "wal")
+    w = wal_mod.WalWriter(wal_dir)
+    w.append(1, WriteBatch().put(b"a", b"1").encode())
+    w.append(2, WriteBatch().put(b"b", b"2").encode())
+    w.close()
+    seg = os.path.join(wal_dir, sorted(os.listdir(wal_dir))[0])
+    with open(seg, "ab") as f:
+        f.write(b"\x99" * 7)  # torn partial record
+    got = list(wal_mod.iter_updates(wal_dir, 0, truncate_torn=True))
+    assert len(got) == 2
+    # file was truncated in place
+    got2 = list(wal_mod.iter_updates(wal_dir, 0))
+    assert len(got2) == 2
+
+
+def test_wal_purge_keeps_active_and_ttl(tmp_path):
+    wal_dir = str(tmp_path / "wal")
+    w = wal_mod.WalWriter(wal_dir, segment_bytes=50)
+    for i in range(10):
+        w.append(i + 1, WriteBatch().put(f"k{i}".encode(), b"v" * 30).encode())
+    w.close()
+    n_before = len(os.listdir(wal_dir))
+    assert n_before > 2
+    # TTL not reached: nothing purged
+    assert wal_mod.purge_obsolete(wal_dir, persisted_seq=100, ttl_seconds=3600) == 0
+    # TTL zero + all persisted: all but active purged
+    removed = wal_mod.purge_obsolete(wal_dir, persisted_seq=100, ttl_seconds=0.0)
+    assert removed == n_before - 1
+    # unpersisted segments survive even past TTL
+    w2 = wal_mod.WalWriter(str(tmp_path / "wal2"), segment_bytes=50)
+    for i in range(10):
+        w2.append(i + 1, WriteBatch().put(f"k{i}".encode(), b"v" * 30).encode())
+    w2.close()
+    removed = wal_mod.purge_obsolete(
+        str(tmp_path / "wal2"), persisted_seq=2, ttl_seconds=0.0
+    )
+    remaining = list(wal_mod.iter_updates(str(tmp_path / "wal2"), 3))
+    assert [s for s, _ in remaining] == list(range(3, 11))
+
+
+# ---------------------------------------------------------------------------
+# bloom
+# ---------------------------------------------------------------------------
+
+
+def test_bloom_no_false_negatives():
+    keys = [f"key-{i}".encode() for i in range(5000)]
+    bf = BloomFilter.build(keys, bits_per_key=10)
+    for k in keys:
+        assert bf.may_contain(k)
+
+
+def test_bloom_false_positive_rate_reasonable():
+    keys = [f"key-{i}".encode() for i in range(5000)]
+    bf = BloomFilter.build(keys, bits_per_key=10)
+    fp = sum(bf.may_contain(f"other-{i}".encode()) for i in range(5000))
+    assert fp / 5000 < 0.05  # 10 bits/key blocked bloom: expect ~1-2%
+
+
+def test_bloom_serialization_roundtrip():
+    keys = [os.urandom(12) for _ in range(100)]
+    bf = BloomFilter.build(keys)
+    bf2 = BloomFilter.from_bytes(bf.to_bytes())
+    for k in keys:
+        assert bf2.may_contain(k)
+
+
+def test_bloom_long_keys_share_prefix_no_false_negative():
+    a = b"x" * 30 + b"a"
+    b = b"x" * 30 + b"b"
+    bf = BloomFilter.build([a])
+    assert bf.may_contain(a)
+    # same 24B prefix and same length collide by design (never false-neg)
+    assert bf.may_contain(b)
+
+
+# ---------------------------------------------------------------------------
+# SST
+# ---------------------------------------------------------------------------
+
+
+def _write_sst(path, entries, **kw):
+    w = SSTWriter(str(path), **kw)
+    for e in entries:
+        w.add(*e)
+    return w.finish()
+
+
+def test_sst_write_read_get(tmp_path):
+    entries = [(f"k{i:04d}".encode(), i + 1, OpType.PUT, f"v{i}".encode() * 10)
+               for i in range(1000)]
+    path = tmp_path / "a.tsst"
+    props = _write_sst(path, entries, block_bytes=512)
+    assert props["num_entries"] == 1000
+    r = SSTReader(str(path))
+    assert r.num_entries == 1000
+    assert r.get(b"k0500") == (501, OpType.PUT, b"v500" * 10)
+    assert r.get(b"missing") is None
+    assert r.min_key() == b"k0000"
+    assert r.max_key() == b"k0999"
+    got = list(r.iterate())
+    assert [e[0] for e in got] == [e[0] for e in entries]
+    # range iteration
+    sub = list(r.iterate(start=b"k0100", end=b"k0110"))
+    assert len(sub) == 10
+    r.close()
+
+
+def test_sst_merge_stack_and_order_enforcement(tmp_path):
+    path = tmp_path / "m.tsst"
+    w = SSTWriter(str(path))
+    w.add(b"k", 5, OpType.MERGE, b"m5")
+    w.add(b"k", 3, OpType.MERGE, b"m3")
+    w.add(b"k", 1, OpType.PUT, b"base")
+    with pytest.raises(InvalidArgument):
+        w.add(b"a", 9, OpType.PUT, b"out-of-order")
+    w.add(b"z", 9, OpType.PUT, b"ok")
+    w.finish()
+    r = SSTReader(str(path))
+    stack = r.get_entries(b"k")
+    assert stack == [(5, OpType.MERGE, b"m5"), (3, OpType.MERGE, b"m3"),
+                     (1, OpType.PUT, b"base")]
+    r.close()
+
+
+def test_sst_global_seqno(tmp_path):
+    path = tmp_path / "g.tsst"
+    _write_sst(path, [(b"a", 0, OpType.PUT, b"1"), (b"b", 0, OpType.PUT, b"2")])
+    r = SSTReader(str(path))
+    assert r.global_seqno is None
+    assert r.get(b"a") == (0, OpType.PUT, b"1")
+    r.close()
+    # finish(global_seqno=...) stamps it at write time
+    path2 = tmp_path / "g2.tsst"
+    w = SSTWriter(str(path2))
+    w.add(b"a", 0, OpType.PUT, b"1")
+    w.finish(global_seqno=77)
+    r2 = SSTReader(str(path2))
+    assert r2.global_seqno == 77
+    assert r2.get(b"a") == (77, OpType.PUT, b"1")
+    assert r2.max_seq() == 77
+    r2.close()
+
+
+def test_sst_corruption_detection(tmp_path):
+    path = tmp_path / "c.tsst"
+    _write_sst(path, [(b"a", 1, OpType.PUT, b"1")])
+    with open(path, "r+b") as f:
+        f.seek(-4, os.SEEK_END)
+        f.write(b"XXXX")  # clobber magic
+    with pytest.raises(Corruption):
+        SSTReader(str(path))
+    with pytest.raises(Corruption):
+        SSTReader(__file__)  # arbitrary non-sst file
+
+
+# ---------------------------------------------------------------------------
+# DB engine
+# ---------------------------------------------------------------------------
+
+
+def test_db_basic_crud(tmp_path):
+    with DB(str(tmp_path / "db")) as db:
+        db.put(b"k1", b"v1")
+        db.put(b"k2", b"v2")
+        assert db.get(b"k1") == b"v1"
+        db.delete(b"k1")
+        assert db.get(b"k1") is None
+        assert db.get(b"k2") == b"v2"
+        assert db.multi_get([b"k1", b"k2", b"k3"]) == [None, b"v2", None]
+
+
+def test_db_write_batch_atomic_and_seqnos(tmp_path):
+    with DB(str(tmp_path / "db")) as db:
+        seq = db.write(WriteBatch().put(b"a", b"1").put(b"b", b"2").delete(b"c"))
+        assert seq == 1
+        assert db.latest_sequence_number() == 3
+        seq2 = db.put(b"d", b"4")
+        assert seq2 == 4
+
+
+def test_db_merge_operator_counter(tmp_path):
+    opts = DBOptions(merge_operator=UInt64AddOperator())
+    pack = struct.Struct("<q").pack
+    with DB(str(tmp_path / "db"), opts) as db:
+        db.merge(b"ctr", pack(5))
+        db.merge(b"ctr", pack(7))
+        assert db.get(b"ctr") == pack(12)
+        db.put(b"ctr", pack(100))
+        db.merge(b"ctr", pack(1))
+        assert db.get(b"ctr") == pack(101)
+        db.delete(b"ctr")
+        db.merge(b"ctr", pack(3))
+        assert db.get(b"ctr") == pack(3)
+
+
+def test_db_merge_across_flushes(tmp_path):
+    opts = DBOptions(merge_operator=UInt64AddOperator())
+    pack = struct.Struct("<q").pack
+    with DB(str(tmp_path / "db"), opts) as db:
+        db.merge(b"ctr", pack(1))
+        db.flush()
+        db.merge(b"ctr", pack(2))
+        db.flush()
+        db.merge(b"ctr", pack(4))
+        assert db.get(b"ctr") == pack(7)
+        db.compact_range()
+        assert db.get(b"ctr") == pack(7)
+
+
+def test_db_recovery_from_wal(tmp_path):
+    path = str(tmp_path / "db")
+    db = DB(path)
+    db.put(b"k1", b"v1")
+    db.put(b"k2", b"v2")
+    last = db.latest_sequence_number()
+    db.close()  # no flush: data only in WAL
+    db2 = DB(path)
+    assert db2.get(b"k1") == b"v1"
+    assert db2.get(b"k2") == b"v2"
+    # ASSUMPTION (rocksdb parity): seq numbers continue after reopen
+    assert db2.latest_sequence_number() == last
+    db2.put(b"k3", b"v3")
+    assert db2.latest_sequence_number() == last + 1
+    db2.close()
+
+
+def test_db_recovery_after_flush_and_more_writes(tmp_path):
+    path = str(tmp_path / "db")
+    db = DB(path)
+    for i in range(100):
+        db.put(f"k{i:03d}".encode(), f"v{i}".encode())
+    db.flush()
+    for i in range(100, 150):
+        db.put(f"k{i:03d}".encode(), f"v{i}".encode())
+    last = db.latest_sequence_number()
+    db.close()
+    db2 = DB(path)
+    assert db2.latest_sequence_number() == last
+    for i in range(150):
+        assert db2.get(f"k{i:03d}".encode()) == f"v{i}".encode()
+    db2.close()
+
+
+def test_db_get_updates_since_ships_raw_batches(tmp_path):
+    """ASSUMPTION test: get_updates_since semantics the replicator relies on
+    (reference rocksdb_assumption_test.cpp GetUpdatesSince coverage)."""
+    with DB(str(tmp_path / "db")) as db:
+        b1 = WriteBatch().put(b"a", b"1").put(b"b", b"2")
+        b1.stamp_timestamp_ms(111)
+        db.write(b1)  # seqs 1-2
+        b2 = WriteBatch().delete(b"a")
+        db.write(b2)  # seq 3
+        updates = list(db.get_updates_since(1))
+        assert len(updates) == 2
+        seq0, raw0 = updates[0]
+        assert seq0 == 1
+        decoded = decode_batch(raw0)
+        assert decoded.extract_timestamp_ms() == 111  # log data survives
+        assert decoded.count() == 2
+        # from seq 3 only the second batch
+        updates3 = list(db.get_updates_since(3))
+        assert [s for s, _ in updates3] == [3]
+        # beyond the end: empty
+        assert list(db.get_updates_since(4)) == []
+        # flush does not destroy update history (WAL TTL keeps it)
+        db.flush()
+        assert len(list(db.get_updates_since(1))) == 2
+
+
+def test_db_iterator_merged_view(tmp_path):
+    with DB(str(tmp_path / "db")) as db:
+        db.put(b"a", b"1")
+        db.put(b"b", b"2")
+        db.flush()
+        db.put(b"c", b"3")
+        db.delete(b"b")
+        items = list(db.new_iterator())
+        assert items == [(b"a", b"1"), (b"c", b"3")]
+        sub = list(db.new_iterator(start=b"b"))
+        assert sub == [(b"c", b"3")]
+
+
+def test_db_flush_compaction_and_levels(tmp_path):
+    opts = DBOptions(level0_compaction_trigger=3, memtable_bytes=1 << 30)
+    with DB(str(tmp_path / "db"), opts) as db:
+        for round_ in range(3):
+            for i in range(50):
+                db.put(f"k{i:03d}".encode(), f"r{round_}".encode())
+            db.flush()
+        # 3 L0 files triggered compaction into L1
+        assert db.get_property("num-files-at-level0") == "0"
+        assert db.get_property("num-files-at-level1") == "1"
+        for i in range(50):
+            assert db.get(f"k{i:03d}".encode()) == b"r2"
+        # deletes compact away at the bottom
+        for i in range(50):
+            db.delete(f"k{i:03d}".encode())
+        db.compact_range()
+        assert list(db.new_iterator()) == []
+        assert db.get_property("estimate-num-keys") == "0"
+
+
+def test_db_properties_for_ingest_behind(tmp_path):
+    opts = DBOptions(allow_ingest_behind=True, num_levels=7)
+    with DB(str(tmp_path / "db"), opts) as db:
+        assert db.get_property("num-levels") == "7"
+        assert db.get_property("highest-empty-level") == "0"  # all empty
+        db.put(b"a", b"1")
+        db.flush()
+        # L0 occupied; levels 1..6 empty → highest fully-empty run starts at 1
+        assert db.get_property("highest-empty-level") == "1"
+
+
+def test_db_checkpoint_and_open_from_checkpoint(tmp_path):
+    path = str(tmp_path / "db")
+    ckpt = str(tmp_path / "ckpt")
+    db = DB(path)
+    for i in range(20):
+        db.put(f"k{i}".encode(), f"v{i}".encode())
+    db.checkpoint(ckpt)
+    db.put(b"after", b"x")  # not in checkpoint
+    last_ckpt_seq = 20
+    db.close()
+    restored = DB(ckpt)
+    assert restored.get(b"k5") == b"v5"
+    assert restored.get(b"after") is None
+    # ASSUMPTION: restored DB's seq equals checkpoint-time persisted seq
+    assert restored.latest_sequence_number() == last_ckpt_seq
+    restored.close()
+
+
+def test_db_ingest_external_file(tmp_path):
+    ext = tmp_path / "ext.tsst"
+    w = SSTWriter(str(ext))
+    w.add(b"in1", 0, OpType.PUT, b"x1")
+    w.add(b"in2", 0, OpType.PUT, b"x2")
+    w.finish()
+    with DB(str(tmp_path / "db")) as db:
+        db.put(b"in1", b"old")
+        before = db.latest_sequence_number()
+        db.ingest_external_file([str(ext)])
+        # ingested data got a global seqno NEWER than existing data
+        assert db.latest_sequence_number() == before + 1
+        assert db.get(b"in1") == b"x1"
+        assert db.get(b"in2") == b"x2"
+
+
+def test_db_ingest_behind(tmp_path):
+    ext = tmp_path / "ext.tsst"
+    w = SSTWriter(str(ext))
+    w.add(b"base", 0, OpType.PUT, b"bulk")
+    w.add(b"in1", 0, OpType.PUT, b"bulk")
+    w.finish()
+    opts = DBOptions(allow_ingest_behind=True)
+    with DB(str(tmp_path / "db"), opts) as db:
+        db.put(b"in1", b"live")
+        db.ingest_external_file([str(ext)], ingest_behind=True)
+        # live data shadows ingested-behind data; new keys appear
+        assert db.get(b"in1") == b"live"
+        assert db.get(b"base") == b"bulk"
+    # without allow_ingest_behind the ingest is rejected
+    with DB(str(tmp_path / "db2")) as db2:
+        with pytest.raises(InvalidArgument):
+            db2.ingest_external_file([str(ext)], ingest_behind=True)
+
+
+def test_db_ingest_move_files(tmp_path):
+    ext = tmp_path / "mv.tsst"
+    w = SSTWriter(str(ext))
+    w.add(b"a", 0, OpType.PUT, b"1")
+    w.finish()
+    with DB(str(tmp_path / "db")) as db:
+        db.ingest_external_file([str(ext)], move_files=True)
+        assert not ext.exists()
+        assert db.get(b"a") == b"1"
+
+
+def test_db_set_options(tmp_path):
+    with DB(str(tmp_path / "db")) as db:
+        db.set_options({"memtable_bytes": 1024, "disable_auto_compaction": True})
+        assert db.options.memtable_bytes == 1024
+        assert db.options.disable_auto_compaction is True
+        with pytest.raises(InvalidArgument):
+            db.set_options({"num_levels": 3})
+
+
+def test_destroy_db(tmp_path):
+    path = str(tmp_path / "db")
+    db = DB(path)
+    db.put(b"a", b"1")
+    db.close()
+    destroy_db(path)
+    assert not os.path.exists(path)
+    db2 = DB(path)  # fresh
+    assert db2.get(b"a") is None
+    assert db2.latest_sequence_number() == 0
+    db2.close()
+
+
+def test_db_concurrent_writers_stress(tmp_path):
+    with DB(str(tmp_path / "db")) as db:
+        n_threads, n_keys = 4, 200
+
+        def worker(tid):
+            for i in range(n_keys):
+                db.put(f"t{tid}-k{i}".encode(), f"v{tid}-{i}".encode())
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert db.latest_sequence_number() == n_threads * n_keys
+        for tid in range(n_threads):
+            for i in range(0, n_keys, 17):
+                assert db.get(f"t{tid}-k{i}".encode()) == f"v{tid}-{i}".encode()
+
+
+def test_db_auto_flush_on_memtable_full(tmp_path):
+    opts = DBOptions(memtable_bytes=4096, level0_compaction_trigger=1000)
+    with DB(str(tmp_path / "db"), opts) as db:
+        for i in range(100):
+            db.put(f"k{i:04d}".encode(), b"x" * 100)
+        assert int(db.get_property("num-files-at-level0")) >= 1
+        for i in range(100):
+            assert db.get(f"k{i:04d}".encode()) == b"x" * 100
+
+
+# ---------------------------------------------------------------------------
+# regression tests from code review
+# ---------------------------------------------------------------------------
+
+
+def test_compact_range_keeps_tombstones_with_ingest_behind(tmp_path):
+    opts = DBOptions(allow_ingest_behind=True)
+    with DB(str(tmp_path / "db"), opts) as db:
+        db.put(b"k", b"v")
+        db.delete(b"k")
+        db.compact_range()
+        ext = tmp_path / "old.tsst"
+        w = SSTWriter(str(ext))
+        w.add(b"k", 0, OpType.PUT, b"stale")
+        w.finish()
+        db.ingest_external_file([str(ext)], ingest_behind=True)
+        # the tombstone must still shadow the ingested-behind stale value
+        assert db.get(b"k") is None
+
+
+def test_wal_straddling_batch_returned(tmp_path):
+    wal_dir = str(tmp_path / "wal")
+    w = wal_mod.WalWriter(wal_dir)
+    big = WriteBatch()
+    for i in range(5):
+        big.put(f"k{i}".encode(), b"v")
+    w.append(10, big.encode())  # occupies seqs 10-14
+    w.append(15, WriteBatch().put(b"z", b"v").encode())
+    w.close()
+    got = list(wal_mod.iter_updates(wal_dir, 12))
+    assert [s for s, _ in got] == [10, 15]  # straddler included
+    got2 = list(wal_mod.iter_updates(wal_dir, 15))
+    assert [s for s, _ in got2] == [15]
+
+
+def test_wal_reader_tolerates_purged_segment(tmp_path):
+    wal_dir = str(tmp_path / "wal")
+    w = wal_mod.WalWriter(wal_dir, segment_bytes=50)
+    for i in range(6):
+        w.append(i + 1, WriteBatch().put(f"k{i}".encode(), b"v" * 30).encode())
+    w.close()
+
+    # simulate a segment vanishing between listing and open
+    import rocksplicator_tpu.storage.wal as walmod
+    real_segments = walmod._segments(wal_dir)
+    os.remove(real_segments[1][1])
+    got = list(wal_mod.iter_updates(wal_dir, 0))
+    assert len(got) > 0  # no FileNotFoundError
+
+
+def test_flush_failure_preserves_reads(tmp_path, monkeypatch):
+    with DB(str(tmp_path / "db")) as db:
+        db.put(b"k1", b"v1")
+
+        def boom(*a, **kw):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(
+            "rocksplicator_tpu.storage.engine.SSTWriter.finish", boom
+        )
+        with pytest.raises(OSError):
+            db.flush()
+        monkeypatch.undo()
+        # read-your-writes survives the failed flush
+        assert db.get(b"k1") == b"v1"
+        db.put(b"k2", b"v2")
+        db.flush()  # now succeeds
+        assert db.get(b"k1") == b"v1"
+        assert db.get(b"k2") == b"v2"
+
+
+def test_set_options_bool_string_coercion(tmp_path):
+    with DB(str(tmp_path / "db")) as db:
+        db.set_options({"disable_auto_compaction": "false"})
+        assert db.options.disable_auto_compaction is False
+        db.set_options({"disable_auto_compaction": "true"})
+        assert db.options.disable_auto_compaction is True
