@@ -1,0 +1,43 @@
+"""AsyncNotifier: park long-poll requests without holding threads.
+
+Reference: rocksdb_replicator/non_blocking_condition_variable.h:40-165 —
+an executor-backed condition variable where a task runs when its predicate
+is true, when notifyAll fires, or on timeout, exactly once. With asyncio
+the same contract is a notifier whose ``wait(timeout)`` parks a coroutine
+(no thread held — same property that lets thousands of long-polls park)
+and a thread-safe ``notify_all`` that wakes every parked waiter.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Set
+
+
+class AsyncNotifier:
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self._waiters: Set[asyncio.Future] = set()
+
+    async def wait(self, timeout_sec: float) -> bool:
+        """Park until notify_all or timeout. True iff notified."""
+        fut: asyncio.Future = self._loop.create_future()
+        self._waiters.add(fut)
+        try:
+            await asyncio.wait_for(fut, timeout_sec)
+            return True
+        except asyncio.TimeoutError:
+            return False
+        finally:
+            self._waiters.discard(fut)
+
+    def notify_all(self) -> None:
+        """Callable only on the loop thread; use notify_all_threadsafe
+        elsewhere."""
+        for fut in self._waiters:
+            if not fut.done():
+                fut.set_result(True)
+        self._waiters.clear()
+
+    def notify_all_threadsafe(self) -> None:
+        self._loop.call_soon_threadsafe(self.notify_all)

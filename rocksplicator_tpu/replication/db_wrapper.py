@@ -1,0 +1,68 @@
+"""DbWrapper: the 4-method seam between replication and storage.
+
+Reference: rocksdb_replicator/db_wrapper.h:6-15. **This is the boundary the
+TPU offload backend plugs into** (BASELINE.json): replication never touches
+the engine directly, so a wrapper can route writes/compaction through
+offloaded paths — or, for CDC observers, publish updates instead of
+persisting them (cdc_admin/cdc_application_db.cpp:15-41).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, List, Optional, Tuple
+
+from ..storage.engine import DB
+from ..storage.records import WriteBatch, decode_batch
+
+
+class DbWrapper:
+    """Abstract seam (db_wrapper.h)."""
+
+    def write_to_leader(self, batch: WriteBatch) -> int:
+        """Apply a leader-side write. Returns the batch's start seq."""
+        raise NotImplementedError
+
+    def get_updates_from_leader(
+        self, since_seq: int
+    ) -> Iterator[Tuple[int, bytes]]:
+        """Iterator (cursor) of (start_seq, raw_batch_bytes) for batches
+        with start_seq >= since_seq. The replicator caches live cursors
+        between long-poll requests (replicated_db.cpp:577-611)."""
+        raise NotImplementedError
+
+    def latest_sequence_number(self) -> int:
+        raise NotImplementedError
+
+    def handle_replicate_response(self, raw_data: bytes, timestamp_ms: Optional[int]) -> None:
+        """Apply one replicated update locally (follower path)."""
+        raise NotImplementedError
+
+
+class StorageDbWrapper(DbWrapper):
+    """Default wrapper over the LSM engine (rocksdb_wrapper.{h,cpp}):
+    write → db.write; updates → db.get_updates_since; replicate response →
+    decode raw batch, apply locally keeping the embedded timestamp so
+    chained downstream followers still see the leader's stamp."""
+
+    def __init__(self, db: DB):
+        self.db = db
+
+    def write_to_leader(self, batch: WriteBatch) -> int:
+        return self.db.write(batch)
+
+    def get_updates_from_leader(
+        self, since_seq: int
+    ) -> Iterator[Tuple[int, bytes]]:
+        return self.db.get_updates_since(since_seq)
+
+    def latest_sequence_number(self) -> int:
+        return self.db.latest_sequence_number()
+
+    def handle_replicate_response(self, raw_data: bytes, timestamp_ms: Optional[int]) -> None:
+        # The raw batch still carries the leader's LOG_DATA timestamp, so
+        # applying it verbatim preserves the stamp for chained downstream
+        # followers (reference re-stamps explicitly; here the bytes already
+        # contain it).
+        batch = decode_batch(raw_data)
+        self.db.write(batch)

@@ -1,0 +1,38 @@
+"""ReplicatorHandler: the replicate RPC service handler.
+
+Reference: rocksdb_replicator/replicator_handler.cpp:24-41 — db-name lookup
+in the FastReadMap, delegate to ReplicatedDB::handleReplicateRequest,
+SOURCE_NOT_FOUND otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..rpc.errors import RpcApplicationError
+from ..utils.concurrent_map import FastReadMap
+from .wire import ReplicaRole, ReplicateErrorCode
+
+
+class ReplicatorHandler:
+    def __init__(self, db_map: FastReadMap):
+        self._dbs = db_map
+
+    async def handle_replicate(
+        self,
+        db_name: str = "",
+        seq_no: int = 0,
+        max_wait_ms: Optional[int] = None,
+        max_updates: Optional[int] = None,
+        role: str = ReplicaRole.FOLLOWER.value,
+    ) -> dict:
+        db = self._dbs.get(db_name)
+        if db is None or db.removed:
+            raise RpcApplicationError(
+                ReplicateErrorCode.SOURCE_NOT_FOUND.value, db_name
+            )
+        updates = await db.handle_replicate_request(
+            seq_no=seq_no, max_wait_ms=max_wait_ms,
+            max_updates=max_updates, role=role,
+        )
+        return {"updates": updates}

@@ -1,0 +1,70 @@
+"""Cached WAL update iterators with idle eviction.
+
+Reference: replicated_db.cpp:577-611 (cached TransactionLogIterators so
+long WAL scans don't restart per request) + cached_iter_cleaner.cpp:29-78
+(background eviction of iterators idle > 60s).
+
+A cached cursor is keyed by the next seq it will serve; a follower's steady
+pull stream hits the cache every time (seq_n+1 == next), so serving N
+updates costs one WAL position, not a rescan from seq 0.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..utils.stats import Stats
+from .wire import REPLICATOR_METRICS as M
+
+
+class _Cursor:
+    __slots__ = ("it", "next_seq", "last_used")
+
+    def __init__(self, it: Iterator[Tuple[int, bytes]], next_seq: int):
+        self.it = it
+        self.next_seq = next_seq
+        self.last_used = time.monotonic()
+
+
+class IterCache:
+    def __init__(self, idle_timeout_sec: float = 60.0, max_cursors: int = 8):
+        self._idle_timeout = idle_timeout_sec
+        self._max = max_cursors
+        self._lock = threading.Lock()
+        self._cursors: Dict[int, _Cursor] = {}
+
+    def take(self, next_seq: int) -> Optional[Iterator[Tuple[int, bytes]]]:
+        """Pop a cursor positioned at next_seq, if cached."""
+        with self._lock:
+            cur = self._cursors.pop(next_seq, None)
+        if cur is not None:
+            Stats.get().incr(M["iter_cache_hits"])
+            return cur.it
+        Stats.get().incr(M["iter_cache_misses"])
+        return None
+
+    def put(self, next_seq: int, it: Iterator[Tuple[int, bytes]]) -> None:
+        with self._lock:
+            self._cursors[next_seq] = _Cursor(it, next_seq)
+            if len(self._cursors) > self._max:
+                oldest = min(self._cursors, key=lambda k: self._cursors[k].last_used)
+                del self._cursors[oldest]
+
+    def evict_idle(self, now: Optional[float] = None) -> int:
+        """Reference CachedIterCleaner behavior; called by the replicator's
+        periodic maintenance task."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            stale = [
+                k for k, c in self._cursors.items()
+                if now - c.last_used > self._idle_timeout
+            ]
+            for k in stale:
+                del self._cursors[k]
+            return len(stale)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cursors.clear()

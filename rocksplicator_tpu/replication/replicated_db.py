@@ -1,0 +1,379 @@
+"""ReplicatedDB: the per-shard replication state machine.
+
+Reference: rocksdb_replicator/replicated_db.cpp (613 LoC) — three faces:
+- **leader write path** (``write``): stamp wall-clock ms into the batch,
+  write via DbWrapper, wake parked long-polls, and in mode 1/2 wait for a
+  follower ACK with fail-fast degradation (replicated_db.cpp:103-166,
+  236-273);
+- **server path** (``handle_replicate_request``): post ACKs from follower
+  pulls, park on the notifier up to max_wait_ms, then serve ≤ max_updates
+  batches from a cached WAL cursor (replicated_db.cpp:435-575);
+- **follower path** (``pull loop``): long-poll the upstream, apply raw
+  batches via DbWrapper, track lag from embedded timestamps, and on errors
+  back off with randomized delay / reset upstream via the leader resolver
+  (replicated_db.cpp:314-433, 278-312).
+
+Replication modes (replicated_db.cpp:59-64): 0 async, 1 semi-sync (ACK
+when the response carrying the write is sent to a follower), 2 sync (ACK
+when a follower's next pull confirms the seq was applied).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from ..rpc.client_pool import RpcClientPool
+from ..rpc.errors import RpcApplicationError, RpcError
+from ..storage.records import WriteBatch, decode_batch
+from ..utils.misc import now_ms
+from ..utils.stats import Stats, tagged
+from .cond_var import AsyncNotifier
+from .db_wrapper import DbWrapper
+from .iter_cache import IterCache
+from .max_number_box import MaxNumberBox
+from .wire import REPLICATOR_METRICS as M
+from .wire import ReplicaRole, ReplicateErrorCode
+
+log = logging.getLogger(__name__)
+
+LeaderResolver = Callable[[str], Optional[Tuple[str, int]]]
+
+
+@dataclass
+class ReplicationFlags:
+    """Defaults mirror the reference gflags (replicated_db.cpp:36-90)."""
+
+    max_updates_per_response: int = 50
+    server_long_poll_ms: int = 10_000
+    pull_error_delay_min_ms: int = 5_000
+    pull_error_delay_max_ms: int = 10_000
+    ack_timeout_ms: int = 2_000
+    degraded_ack_timeout_ms: int = 10
+    consecutive_timeouts_to_degrade: int = 100
+    upstream_reset_sample_rate: float = 0.1
+    # pulls from a non-leader that return nothing this many times in a row
+    # trigger an upstream reset (replicated_db.cpp:392-408 heuristic)
+    empty_pulls_before_reset: int = 5
+    pull_rpc_margin_ms: int = 5_000
+
+
+class ReplicatedDB:
+    def __init__(
+        self,
+        name: str,
+        wrapper: DbWrapper,
+        role: ReplicaRole,
+        loop: asyncio.AbstractEventLoop,
+        executor: ThreadPoolExecutor,
+        pool: RpcClientPool,
+        upstream_addr: Optional[Tuple[str, int]] = None,
+        replication_mode: int = 0,
+        flags: Optional[ReplicationFlags] = None,
+        leader_resolver: Optional[LeaderResolver] = None,
+    ):
+        self.name = name
+        self.wrapper = wrapper
+        self.role = role
+        self.replication_mode = replication_mode
+        self.upstream_addr = upstream_addr
+        self.flags = flags or ReplicationFlags()
+        self._loop = loop
+        self._executor = executor
+        self._pool = pool
+        self._leader_resolver = leader_resolver
+        self._notifier = AsyncNotifier(loop)
+        self._acked = MaxNumberBox()
+        self._iter_cache = IterCache()
+        self._removed = False
+        self._pull_task: Optional[asyncio.Task] = None
+        # ACK degradation state (replicated_db.cpp:236-273)
+        self._consecutive_ack_timeouts = 0
+        self._degraded = False
+        self._empty_pulls = 0
+        self._stats = Stats.get()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self.role in (ReplicaRole.FOLLOWER, ReplicaRole.OBSERVER):
+            if self.upstream_addr is None:
+                raise ValueError(f"{self.name}: {self.role} requires an upstream")
+            self._pull_task = asyncio.run_coroutine_threadsafe(
+                self._pull_loop(), self._loop
+            )
+
+    def stop(self) -> None:
+        self._removed = True
+        task = self._pull_task
+        if task is not None:
+            self._loop.call_soon_threadsafe(task.cancel)
+            self._pull_task = None
+        self._notifier.notify_all_threadsafe()
+        self._iter_cache.clear()
+
+    @property
+    def removed(self) -> bool:
+        return self._removed
+
+    # ------------------------------------------------------------------
+    # leader write path (any thread)
+    # ------------------------------------------------------------------
+
+    def write(self, batch: WriteBatch) -> int:
+        if self.role not in (ReplicaRole.LEADER, ReplicaRole.NOOP):
+            raise RpcApplicationError(
+                "NOT_LEADER", f"{self.name} role is {self.role.value}"
+            )
+        start = time.monotonic()
+        batch.stamp_timestamp_ms()
+        seq = self.wrapper.write_to_leader(batch)
+        end_seq = seq + batch.count() - 1
+        self._stats.incr(M["leader_writes"])
+        self._stats.incr(M["leader_write_bytes"], batch.byte_size())
+        # Wake parked follower long-polls (no thread was held by them).
+        self._notifier.notify_all_threadsafe()
+        if self.replication_mode in (1, 2) and self.role is ReplicaRole.LEADER:
+            self._write_wait_follower_ack(end_seq)
+        self._stats.add_metric(M["leader_write_ms"], (time.monotonic() - start) * 1e3)
+        return seq
+
+    def _write_wait_follower_ack(self, target_seq: int) -> None:
+        """replicated_db.cpp:236-273: 2000ms timeout normally; after 100
+        consecutive timeouts drop to 10ms to fail fast; recover on the
+        first success."""
+        f = self.flags
+        timeout_ms = (
+            f.degraded_ack_timeout_ms if self._degraded else f.ack_timeout_ms
+        )
+        self._stats.incr(M["ack_waits"])
+        ok = self._acked.wait(target_seq, timeout_ms / 1000.0)
+        if ok:
+            self._consecutive_ack_timeouts = 0
+            if self._degraded:
+                self._degraded = False
+                log.info("%s: ACK degradation recovered", self.name)
+        else:
+            self._stats.incr(M["ack_timeouts"])
+            self._consecutive_ack_timeouts += 1
+            if (
+                not self._degraded
+                and self._consecutive_ack_timeouts
+                >= f.consecutive_timeouts_to_degrade
+            ):
+                self._degraded = True
+                self._stats.incr(M["ack_degraded"])
+                log.warning("%s: entering degraded ACK mode", self.name)
+
+    # ------------------------------------------------------------------
+    # server path (loop thread)
+    # ------------------------------------------------------------------
+
+    async def handle_replicate_request(
+        self,
+        seq_no: int,
+        max_wait_ms: Optional[int] = None,
+        max_updates: Optional[int] = None,
+        role: str = ReplicaRole.FOLLOWER.value,
+    ) -> List[dict]:
+        """Serve updates after ``seq_no`` (the puller's latest applied seq).
+        Returns a list of update dicts; empty list on long-poll timeout."""
+        f = self.flags
+        max_wait_ms = f.server_long_poll_ms if max_wait_ms is None else max_wait_ms
+        max_updates = (
+            f.max_updates_per_response if max_updates is None else max_updates
+        )
+        self._stats.incr(M["replicate_requests"])
+        # Mode-2 ACK: the puller's request proves it applied through seq_no
+        # (replicated_db.cpp:450-456); OBSERVERs never count (:452).
+        if role != ReplicaRole.OBSERVER.value and self.replication_mode == 2:
+            self._acked.post(seq_no)
+        latest = self.wrapper.latest_sequence_number()
+        if latest <= seq_no and max_wait_ms > 0:
+            await self._notifier.wait(max_wait_ms / 1000.0)
+            if self._removed:
+                raise RpcApplicationError(
+                    ReplicateErrorCode.SOURCE_REMOVED.value, self.name
+                )
+            latest = self.wrapper.latest_sequence_number()
+        if latest <= seq_no:
+            return []
+        try:
+            updates = await self._loop.run_in_executor(
+                self._executor, self._read_updates, seq_no + 1, max_updates
+            )
+        except Exception as e:
+            log.exception("%s: WAL read failed", self.name)
+            raise RpcApplicationError(
+                ReplicateErrorCode.SOURCE_READ_ERROR.value, repr(e)
+            ) from e
+        # Mode-1 semi-sync ACK: posted when the response is handed to the
+        # transport (replicated_db.cpp:543-546).
+        if (
+            updates
+            and self.replication_mode == 1
+            and role != ReplicaRole.OBSERVER.value
+        ):
+            last = updates[-1]
+            self._acked.post(last["seq_no"] + last["count"] - 1)
+        self._stats.incr(M["replicate_updates_sent"], len(updates))
+        self._stats.incr(
+            M["replicate_bytes_sent"],
+            sum(len(u["raw_data"]) for u in updates),
+        )
+        return updates
+
+    def _read_updates(self, from_seq: int, max_updates: int) -> List[dict]:
+        """Executor-side WAL read using the cursor cache."""
+        it = self._iter_cache.take(from_seq)
+        if it is None:
+            it = self.wrapper.get_updates_from_leader(from_seq)
+        updates: List[dict] = []
+        next_seq = from_seq
+        exhausted = True
+        for start_seq, raw in it:
+            batch = decode_batch(raw)
+            count = batch.count()
+            updates.append(
+                {
+                    "seq_no": start_seq,
+                    "count": count,
+                    "raw_data": bytes(raw),
+                    "timestamp": batch.extract_timestamp_ms(),
+                }
+            )
+            next_seq = start_seq + count
+            if len(updates) >= max_updates:
+                exhausted = False
+                break
+        if not exhausted:
+            self._iter_cache.put(next_seq, it)
+        return updates
+
+    # ------------------------------------------------------------------
+    # follower pull path (loop thread)
+    # ------------------------------------------------------------------
+
+    async def _pull_loop(self) -> None:
+        f = self.flags
+        while not self._removed:
+            try:
+                applied = await self._pull_once()
+                if applied == 0 and self.role is ReplicaRole.FOLLOWER:
+                    # no-updates heuristic: repeatedly empty long-polls may
+                    # mean we're polling a stale leader.
+                    self._empty_pulls += 1
+                    if self._empty_pulls >= f.empty_pulls_before_reset:
+                        self._empty_pulls = 0
+                        await self._maybe_reset_upstream(force_sample=False)
+                else:
+                    self._empty_pulls = 0
+            except asyncio.CancelledError:
+                raise
+            except RpcApplicationError as e:
+                self._stats.incr(M["pull_errors"])
+                if e.code == ReplicateErrorCode.SOURCE_NOT_FOUND.value:
+                    await self._maybe_reset_upstream(force_sample=False)
+                await self._pull_error_delay()
+            except (RpcError, Exception) as e:
+                self._stats.incr(M["pull_errors"])
+                log.warning("%s: pull error from %s: %r", self.name,
+                            self.upstream_addr, e)
+                # A dead upstream looks like connection errors; consult the
+                # leader resolver (sampled) in case leadership moved.
+                await self._maybe_reset_upstream(force_sample=False)
+                await self._pull_error_delay()
+
+    async def _pull_once(self) -> int:
+        f = self.flags
+        assert self.upstream_addr is not None
+        host, port = self.upstream_addr
+        client = await self._pool.get_client(host, port)
+        latest = self.wrapper.latest_sequence_number()
+        self._stats.incr(M["pull_requests"])
+        result = await client.call(
+            "replicate",
+            {
+                "db_name": self.name,
+                "seq_no": latest,
+                "max_wait_ms": f.server_long_poll_ms,
+                "max_updates": f.max_updates_per_response,
+                "role": self.role.value,
+            },
+            timeout=(f.server_long_poll_ms + f.pull_rpc_margin_ms) / 1000.0,
+        )
+        updates = result.get("updates", []) if result else []
+        if not updates:
+            return 0
+        await self._loop.run_in_executor(
+            self._executor, self._apply_updates, updates
+        )
+        return len(updates)
+
+    def _apply_updates(self, updates: List[dict]) -> None:
+        """Executor-side ordered apply of one response's updates."""
+        now = now_ms()
+        total_bytes = 0
+        for u in updates:
+            raw = bytes(u["raw_data"])
+            ts = u.get("timestamp")
+            self.wrapper.handle_replicate_response(raw, ts)
+            total_bytes += len(raw)
+            if ts is not None:
+                self._stats.add_metric(M["replication_lag_ms"], max(0, now - ts))
+        self._stats.incr(M["pull_updates_applied"], len(updates))
+        self._stats.incr(M["pull_bytes_applied"], total_bytes)
+
+    async def _pull_error_delay(self) -> None:
+        f = self.flags
+        delay_ms = random.uniform(
+            f.pull_error_delay_min_ms, f.pull_error_delay_max_ms
+        )
+        await asyncio.sleep(delay_ms / 1000.0)
+
+    async def _maybe_reset_upstream(self, force_sample: bool) -> None:
+        """Query the leader resolver (reference: Helix GetLeaderInstanceId,
+        sampled at 10% to avoid hammering the control plane)."""
+        f = self.flags
+        if self._leader_resolver is None:
+            return
+        if not force_sample and random.random() > f.upstream_reset_sample_rate:
+            return
+        try:
+            new_addr = await self._loop.run_in_executor(
+                self._executor, self._leader_resolver, self.name
+            )
+        except Exception:
+            log.exception("%s: leader resolver failed", self.name)
+            return
+        if new_addr and tuple(new_addr) != tuple(self.upstream_addr or ()):
+            log.info("%s: resetting upstream %s -> %s", self.name,
+                     self.upstream_addr, new_addr)
+            self.upstream_addr = tuple(new_addr)
+            self._stats.incr(M["upstream_resets"])
+
+    def reset_upstream(self, addr: Tuple[str, int]) -> None:
+        """Explicit upstream repoint (changeDBRoleAndUpStream path)."""
+        self.upstream_addr = tuple(addr)
+
+    # ------------------------------------------------------------------
+    # introspection (replicated_db.cpp:168-182)
+    # ------------------------------------------------------------------
+
+    def introspect(self) -> str:
+        return (
+            f"db={self.name} role={self.role.value} "
+            f"mode={self.replication_mode} "
+            f"latest_seq={self.wrapper.latest_sequence_number()} "
+            f"acked_seq={self._acked.value} "
+            f"upstream={self.upstream_addr} "
+            f"degraded={self._degraded} removed={self._removed}"
+        )

@@ -1,0 +1,163 @@
+"""Replicator: owns the replication server, executor, pool, and db map.
+
+Reference: rocksdb_replicator/rocksdb_replicator.h:83-256 — a singleton in
+production (``instance()``) owning the replication thrift server (port
+9091), a ≥16-thread CPU executor, a client pool, and the db map; tests
+construct private instances on distinct ports to build multi-node
+topologies in one process (rocksdb_replicator_test.cpp:137-144) — the
+constructor here is public for exactly that reason.
+
+``add_db``/``remove_db``/``write`` mirror the reference lifecycle;
+removal stops the pull loop and waits for in-flight handlers to drain via
+the removed flag (the reference spin-waits on a weak_ptr,
+rocksdb_replicator.cpp:135-154 — here explicit ownership makes that a
+cancel + flag).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional, Tuple
+
+from ..rpc.client_pool import RpcClientPool
+from ..rpc.ioloop import IoLoop
+from ..rpc.server import RpcServer
+from ..storage.records import WriteBatch
+from ..utils.concurrent_map import FastReadMap
+from ..utils.dbconfig import DBConfigManager
+from ..utils.segment_utils import db_name_to_segment
+from .db_wrapper import DbWrapper
+from .handler import ReplicatorHandler
+from .replicated_db import LeaderResolver, ReplicatedDB, ReplicationFlags
+from .wire import ReplicaRole
+
+DEFAULT_REPLICATOR_PORT = 9091
+_EXECUTOR_THREADS = 16  # reference: ≥16 CPU threads (rocksdb_replicator.cpp:58-67)
+
+
+class Replicator:
+    _instance: Optional["Replicator"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(
+        self,
+        port: int = 0,
+        ioloop: Optional[IoLoop] = None,
+        flags: Optional[ReplicationFlags] = None,
+        executor_threads: int = _EXECUTOR_THREADS,
+    ):
+        self._ioloop = ioloop or IoLoop.default()
+        self._flags = flags or ReplicationFlags()
+        self._dbs: FastReadMap = FastReadMap()
+        self._executor = ThreadPoolExecutor(
+            max_workers=executor_threads, thread_name_prefix="replicator"
+        )
+        self._pool = RpcClientPool()
+        self._server = RpcServer(port=port, ioloop=self._ioloop)
+        self._server.add_handler(ReplicatorHandler(self._dbs))
+        self._server.start()
+        self._maintenance_stop = threading.Event()
+        self._maintenance = threading.Thread(
+            target=self._maintenance_loop, name="replicator-maint", daemon=True
+        )
+        self._maintenance.start()
+
+    @classmethod
+    def instance(cls, port: int = DEFAULT_REPLICATOR_PORT) -> "Replicator":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls(port=port)
+        return cls._instance
+
+    @classmethod
+    def reset_for_test(cls) -> None:
+        with cls._instance_lock:
+            if cls._instance is not None:
+                cls._instance.stop()
+            cls._instance = None
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def ioloop(self) -> IoLoop:
+        return self._ioloop
+
+    # ------------------------------------------------------------------
+
+    def add_db(
+        self,
+        name: str,
+        wrapper: DbWrapper,
+        role: ReplicaRole,
+        upstream_addr: Optional[Tuple[str, int]] = None,
+        replication_mode: Optional[int] = None,
+        leader_resolver: Optional[LeaderResolver] = None,
+    ) -> ReplicatedDB:
+        """Register a db for replication. Duplicate names are an error
+        (reference returns DB_ALREADY_EXISTS)."""
+        if replication_mode is None:
+            # Per-dataset config with default 0 (replicated_db.cpp:131-136).
+            try:
+                segment = db_name_to_segment(name)
+            except ValueError:
+                segment = name
+            replication_mode = DBConfigManager.get().get_replication_mode(segment)
+        rdb = ReplicatedDB(
+            name=name,
+            wrapper=wrapper,
+            role=role,
+            loop=self._ioloop.loop,
+            executor=self._executor,
+            pool=self._pool,
+            upstream_addr=upstream_addr,
+            replication_mode=replication_mode,
+            flags=self._flags,
+            leader_resolver=leader_resolver,
+        )
+        if not self._dbs.add(name, rdb):
+            raise ValueError(f"db already exists: {name}")
+        rdb.start()
+        return rdb
+
+    def remove_db(self, name: str) -> None:
+        rdb = self._dbs.get(name)
+        if rdb is None:
+            raise KeyError(f"no such db: {name}")
+        rdb.stop()
+        self._dbs.remove(name)
+
+    def get_db(self, name: str) -> Optional[ReplicatedDB]:
+        return self._dbs.get(name)
+
+    def write(self, name: str, batch: WriteBatch) -> int:
+        rdb = self._dbs.get(name)
+        if rdb is None:
+            raise KeyError(f"no such db: {name}")
+        return rdb.write(batch)
+
+    def introspect(self) -> str:
+        lines = [rdb.introspect() for _name, rdb in sorted(self._dbs.items())]
+        return "\n".join(lines) + "\n"
+
+    # ------------------------------------------------------------------
+
+    def _maintenance_loop(self) -> None:
+        """Periodic iterator-cache eviction (reference CachedIterCleaner's
+        background EventBase thread, cached_iter_cleaner.cpp:29-78)."""
+        while not self._maintenance_stop.wait(5.0):
+            for _name, rdb in self._dbs.items():
+                rdb._iter_cache.evict_idle()
+
+    def stop(self) -> None:
+        self._maintenance_stop.set()
+        for _name, rdb in list(self._dbs.items()):
+            rdb.stop()
+        self._dbs.clear()
+        self._server.stop()
+        self._ioloop.run_sync(self._pool.close())
+        self._executor.shutdown(wait=False)
+        self._maintenance.join(timeout=2.0)

@@ -1,0 +1,50 @@
+"""Replication wire protocol types.
+
+Reference: rocksdb_replicator/thrift/replicator.thrift:21-92 —
+``ReplicateRequest{seq_no, db_name, max_wait_ms, max_updates, role}``,
+``Update{raw_data (zero-copy IOBuf), timestamp, seq_no}``,
+``ReplicaRole{NOOP, FOLLOWER, LEADER, OBSERVER}``,
+``ErrorCode{SOURCE_NOT_FOUND, SOURCE_READ_ERROR, SOURCE_REMOVED}``.
+
+On the wire these travel as the RPC layer's dict messages; raw_data rides
+the binary payload region (no copies, no base64).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReplicaRole(str, enum.Enum):
+    NOOP = "NOOP"          # serve locally, no replication
+    FOLLOWER = "FOLLOWER"  # pull from upstream, ACK counts (mode 1/2)
+    LEADER = "LEADER"      # accept writes, serve updates
+    OBSERVER = "OBSERVER"  # pull from upstream, ACK does NOT count (CDC)
+
+
+class ReplicateErrorCode(str, enum.Enum):
+    SOURCE_NOT_FOUND = "SOURCE_NOT_FOUND"
+    SOURCE_READ_ERROR = "SOURCE_READ_ERROR"
+    SOURCE_REMOVED = "SOURCE_REMOVED"
+
+
+# Counter/metric names (reference rocksdb_replicator/replicator_stats.{h,cpp})
+REPLICATOR_METRICS = dict(
+    leader_writes="replicator.leader_writes",
+    leader_write_bytes="replicator.leader_write_bytes",
+    leader_write_ms="replicator.leader_write_ms",
+    ack_waits="replicator.ack_waits",
+    ack_timeouts="replicator.ack_timeouts",
+    ack_degraded="replicator.ack_degraded_mode",
+    replicate_requests="replicator.replicate_requests",
+    replicate_updates_sent="replicator.replicate_updates_sent",
+    replicate_bytes_sent="replicator.replicate_bytes_sent",
+    pull_requests="replicator.pull_requests",
+    pull_updates_applied="replicator.pull_updates_applied",
+    pull_bytes_applied="replicator.pull_bytes_applied",
+    pull_errors="replicator.pull_errors",
+    upstream_resets="replicator.upstream_resets",
+    replication_lag_ms="replicator.replication_lag_ms",
+    iter_cache_hits="replicator.iter_cache_hits",
+    iter_cache_misses="replicator.iter_cache_misses",
+)

@@ -38,6 +38,7 @@ from .records import OpType, WriteBatch, decode_batch
 from .sst import COMPRESSION_NONE, COMPRESSION_ZLIB, SSTReader, SSTWriter
 
 import heapq
+import itertools
 import logging
 
 log = logging.getLogger(__name__)
@@ -137,15 +138,17 @@ class DB:
     def _wal_dir(self) -> str:
         return os.path.join(self.path, "wal")
 
-    def _persist_manifest(self) -> None:
-        manifest = {
+    def _manifest_dict(self) -> Dict:
+        return {
             "persisted_seq": self._persisted_seq,
             "next_file_id": self._next_file_id,
             "levels": self._levels,
         }
+
+    def _persist_manifest(self, target_dir: Optional[str] = None) -> None:
         write_file_atomic(
-            os.path.join(self.path, _MANIFEST),
-            json.dumps(manifest).encode("utf-8"),
+            os.path.join(target_dir or self.path, _MANIFEST),
+            json.dumps(self._manifest_dict()).encode("utf-8"),
         )
 
     # ------------------------------------------------------------------
@@ -278,15 +281,27 @@ class DB:
                     runs.append(self._readers[name].iterate())
             merge_op = self.options.merge_operator
             merged = heapq.merge(*runs, key=lambda e: (e[0], -e[1]))
-            for key, _seq, vtype, value in resolve_stream(merged, merge_op, False):
+            resolved = resolve_stream(merged, merge_op, False)
+            # resolve_stream emits one entry per key except for unresolved
+            # MERGE chains (no partial-merge operator), which must be folded
+            # here as a group — newest first in the stream.
+            for key, group in itertools.groupby(resolved, key=lambda e: e[0]):
+                entries = list(group)
                 if start is not None and key < start:
                     continue
                 if end is not None and key >= end:
                     break
+                vtype = entries[0][2]
                 if vtype == OpType.DELETE:
                     continue
                 if vtype == OpType.MERGE:
-                    value = merge_op.merge(key, None, [value]) if merge_op else value
+                    operands = [e[3] for e in reversed(entries)]  # oldest first
+                    value = (
+                        merge_op.merge(key, None, operands)
+                        if merge_op else entries[0][3]
+                    )
+                else:
+                    value = entries[0][3]
                 out.append((key, value))
         return iter(out)
 
@@ -384,8 +399,11 @@ class DB:
             for files in self._levels:
                 files.clear()
             self._levels[bottom] = out_names
-            self._gc_files(inputs)
+            # Manifest first, THEN delete inputs — a crash in between leaves
+            # orphan files (harmless), never a manifest pointing at deleted
+            # ones (unopenable DB).
             self._persist_manifest()
+            self._gc_files(inputs)
 
     def _compact_level0_locked(self) -> None:
         """L0 → L1 compaction (tombstones kept; not bottom level)."""
@@ -400,8 +418,8 @@ class DB:
         out_names = self._write_merged(runs, drop_tombstones=drop)
         self._levels[0] = []
         self._levels[1] = out_names
+        self._persist_manifest()  # before GC — see compact_range
         self._gc_files(inputs)
-        self._persist_manifest()
 
     def _write_merged(self, runs: List, drop_tombstones: bool) -> List[str]:
         stream = self._backend.merge_runs(
@@ -521,15 +539,7 @@ class DB:
                         os.link(src, dst)
                     except OSError:
                         shutil.copyfile(src, dst)
-            manifest = {
-                "persisted_seq": self._persisted_seq,
-                "next_file_id": self._next_file_id,
-                "levels": self._levels,
-            }
-            write_file_atomic(
-                os.path.join(checkpoint_dir, _MANIFEST),
-                json.dumps(manifest).encode("utf-8"),
-            )
+            self._persist_manifest(target_dir=checkpoint_dir)
 
     def ingest_external_file(
         self,
@@ -592,6 +602,9 @@ class DB:
                     self._last_seq += 1
                     self._set_global_seqnos(new_names, self._last_seq)
                     self._persisted_seq = max(self._persisted_seq, self._last_seq)
+                else:
+                    for name in new_names:
+                        self._readers_open(name)
                 self._levels[0].extend(new_names)
             self._persist_manifest()
 

@@ -158,7 +158,6 @@ class SSTWriter:
         if self._finished:
             raise InvalidArgument("finish() called twice")
         self._flush_block()
-        self._finished = True
         bloom_off = self._offset
         bloom = BloomFilter.build(self._keys, self._bits_per_key)
         bloom_bytes = bloom.to_bytes()
@@ -194,6 +193,9 @@ class SSTWriter:
             )
         )
         self._file.close()
+        # Only now is the file complete — a failure anywhere above leaves
+        # _finished False so abandon() still closes and removes it.
+        self._finished = True
         return props
 
     def abandon(self) -> None:

@@ -154,8 +154,13 @@ def iter_updates(
         if i + 1 < len(segs) and segs[i + 1][0] <= since_seq:
             continue
         is_last = i + 1 == len(segs)
+        # Torn tails are only legitimate in the LAST segment (crash mid-
+        # append). A CRC mismatch mid-log is real corruption and must raise,
+        # not silently truncate committed records.
         for start_seq, body in _iter_segment(
-            path, truncate_torn=truncate_torn, tolerate_tail=is_last
+            path,
+            truncate_torn=truncate_torn and is_last,
+            tolerate_tail=is_last,
         ):
             if start_seq >= since_seq:
                 yielded_any = True
