@@ -1,0 +1,11 @@
+"""Admin / data plane (reference: rocksdb_admin/, cdc_admin/ — SURVEY §2.2)."""
+
+from .application_db import ApplicationDB
+from .db_manager import ApplicationDBManager
+from .handler import AdminHandler, DBMetaData
+from .cdc import CdcAdminHandler
+
+__all__ = [
+    "ApplicationDB", "ApplicationDBManager", "AdminHandler", "DBMetaData",
+    "CdcAdminHandler",
+]

@@ -1,0 +1,113 @@
+"""ApplicationDB: storage DB + replication registration.
+
+Reference: rocksdb_admin/application_db.{h,cpp} — wraps rocksdb::DB, routes
+writes through ``ReplicatedDB::Write`` when the db is replicated
+(application_db.cpp:122-136), delegates reads with stats, exposes
+``CompactRange``/``GetProperty`` including the custom
+``applicationdb.num-levels`` / ``applicationdb.highest-empty-level`` props
+backing the ``DBLmaxEmpty()`` ingest-behind safety check
+(application_db.cpp:183-225). The constructor registers with the
+replicator (application_db.cpp:52-70); ``close`` unregisters.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterator, List, Optional, Tuple
+
+from ..replication.db_wrapper import DbWrapper, StorageDbWrapper
+from ..replication.replicated_db import LeaderResolver, ReplicatedDB
+from ..replication.replicator import Replicator
+from ..replication.wire import ReplicaRole
+from ..storage.engine import DB
+from ..storage.records import WriteBatch
+from ..utils.stats import Stats, tagged
+
+log = logging.getLogger(__name__)
+
+
+class ApplicationDB:
+    def __init__(
+        self,
+        name: str,
+        db: DB,
+        role: ReplicaRole,
+        replicator: Optional[Replicator] = None,
+        upstream_addr: Optional[Tuple[str, int]] = None,
+        replication_mode: Optional[int] = None,
+        leader_resolver: Optional[LeaderResolver] = None,
+        wrapper: Optional[DbWrapper] = None,
+        enable_read_stats: bool = True,  # optional: ~10M Get/s design point
+    ):
+        self.name = name
+        self.db = db
+        self.role = role
+        self._replicator = replicator
+        self._stats = Stats.get()
+        self._enable_read_stats = enable_read_stats
+        self.replicated_db: Optional[ReplicatedDB] = None
+        if replicator is not None and role is not ReplicaRole.NOOP:
+            self.replicated_db = replicator.add_db(
+                name,
+                wrapper or StorageDbWrapper(db),
+                role,
+                upstream_addr=upstream_addr,
+                replication_mode=replication_mode,
+                leader_resolver=leader_resolver,
+            )
+
+    # -- writes ------------------------------------------------------------
+
+    def write(self, batch: WriteBatch) -> int:
+        if self.replicated_db is not None:
+            seq = self.replicated_db.write(batch)
+        else:
+            seq = self.db.write(batch)
+        self._stats.incr(tagged("applicationdb.writes", db=self.name))
+        return seq
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        if self._enable_read_stats:
+            self._stats.incr(tagged("applicationdb.gets", db=self.name))
+        return self.db.get(key)
+
+    def multi_get(self, keys: List[bytes]) -> List[Optional[bytes]]:
+        if self._enable_read_stats:
+            self._stats.incr(
+                tagged("applicationdb.multigets", db=self.name), len(keys)
+            )
+        return self.db.multi_get(keys)
+
+    def new_iterator(self, start=None, end=None) -> Iterator[Tuple[bytes, bytes]]:
+        return self.db.new_iterator(start, end)
+
+    # -- admin surface -----------------------------------------------------
+
+    def compact_range(self, start=None, end=None) -> None:
+        self.db.compact_range(start, end)
+
+    def get_property(self, name: str) -> Optional[str]:
+        # applicationdb.* prefix parity (application_db.cpp:183-199)
+        if name.startswith("applicationdb."):
+            name = name[len("applicationdb."):]
+        return self.db.get_property(name)
+
+    def db_lmax_empty(self) -> bool:
+        """True iff the bottom level is empty ⇒ ingest_behind is safe
+        (application_db.cpp:200-225). highest-empty-level is -1 exactly
+        when the bottom level holds files."""
+        return int(self.get_property("highest-empty-level") or -1) != -1
+
+    def latest_sequence_number(self) -> int:
+        return self.db.latest_sequence_number()
+
+    def close(self) -> None:
+        if self.replicated_db is not None and self._replicator is not None:
+            try:
+                self._replicator.remove_db(self.name)
+            except KeyError:
+                pass
+            self.replicated_db = None
+        self.db.close()

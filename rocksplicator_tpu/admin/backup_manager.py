@@ -1,0 +1,77 @@
+"""ApplicationDBBackupManager: continuous incremental backups.
+
+Reference: rocksdb_admin/application_db_backup_manager.{h,cpp} — optional
+background thread periodically checkpoint-backing-up every hosted DB to the
+object store (flag ``enable_async_incremental_backup_dbs``,
+admin_handler.cpp:467-470).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..storage import backup as backup_mod
+from ..utils.objectstore import ObjectStore
+from ..utils.stats import Stats
+from .db_manager import ApplicationDBManager
+
+log = logging.getLogger(__name__)
+
+
+class ApplicationDBBackupManager:
+    def __init__(
+        self,
+        db_manager: ApplicationDBManager,
+        store: ObjectStore,
+        prefix: str = "incremental_backups",
+        interval_sec: float = 300.0,
+        parallelism: int = 8,
+    ):
+        self._db_manager = db_manager
+        self._store = store
+        self._prefix = prefix.rstrip("/")
+        self._interval = interval_sec
+        self._parallelism = parallelism
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="backup-manager", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def backup_all_dbs(self) -> int:
+        """One pass over every hosted DB (backupAllDBsToS3). Returns the
+        number successfully backed up."""
+        ok = 0
+        for name in self._db_manager.get_all_db_names():
+            app_db = self._db_manager.get_db(name)
+            if app_db is None:
+                continue
+            try:
+                backup_mod.backup_db(
+                    app_db.db, self._store, f"{self._prefix}/{name}",
+                    parallelism=self._parallelism, incremental=True,
+                )
+                ok += 1
+                Stats.get().incr("backup_manager.backups_ok")
+            except Exception:
+                Stats.get().incr("backup_manager.backups_failed")
+                log.exception("incremental backup failed for %s", name)
+        return ok
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.backup_all_dbs()

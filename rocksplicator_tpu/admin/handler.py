@@ -1,0 +1,562 @@
+"""AdminHandler: the admin data-plane service.
+
+Reference: rocksdb_admin/rocksdb_admin.thrift:259-363 (15 RPCs) +
+rocksdb_admin/admin_handler.{h,cpp} (2.2k LoC). Implements:
+
+ping, addDB, backupDB, restoreDB, backupDBToS3, restoreDBFromS3, checkDB,
+closeDB, changeDBRoleAndUpStream, getSequenceNumber, clearDB,
+addS3SstFilesToDB, startMessageIngestion, stopMessageIngestion,
+setDBOptions, compactDB.
+
+Structure parity: a private meta_db at ``<rocksdb_dir>/meta_db`` storing
+per-db DBMetaData (admin_handler.cpp:204-212, 556-595); per-db ObjectLock
+serializing admin ops; an object-store cache; an ingest concurrency gate
+(``num_current_s3_sst_downloadings_``); message-ingestion watcher map.
+"S3" RPC names are kept for wire parity — the bucket argument is any
+object-store URI (local dir or s3://).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import shutil
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import asdict, dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..replication.replicated_db import LeaderResolver
+from ..replication.replicator import Replicator
+from ..replication.wire import ReplicaRole
+from ..rpc.errors import RpcApplicationError
+from ..storage import backup as backup_mod
+from ..storage.engine import DB, DBOptions, destroy_db
+from ..storage.errors import StorageError
+from ..utils.object_lock import ObjectLock
+from ..utils.objectstore import build_object_store
+from ..utils.segment_utils import db_name_to_segment
+from ..utils.stats import Stats
+from ..utils.timer import Timer
+from .application_db import ApplicationDB
+from .db_manager import ApplicationDBManager
+
+log = logging.getLogger(__name__)
+
+# AdminErrorCode parity (rocksdb_admin.thrift)
+DB_NOT_FOUND = "DB_NOT_FOUND"
+DB_ALREADY_EXISTS = "DB_ALREADY_EXISTS"
+INVALID_DB_ROLE = "INVALID_DB_ROLE"
+INVALID_UPSTREAM = "INVALID_UPSTREAM"
+DB_ADMIN_ERROR = "DB_ADMIN_ERROR"
+DB_ERROR = "DB_ERROR"
+TOO_MANY_REQUESTS = "TOO_MANY_REQUESTS"
+NOT_IMPLEMENTED = "NOT_IMPLEMENTED"
+
+_ROLE_ALIASES = {
+    "LEADER": ReplicaRole.LEADER, "MASTER": ReplicaRole.LEADER,
+    "FOLLOWER": ReplicaRole.FOLLOWER, "SLAVE": ReplicaRole.FOLLOWER,
+    "NOOP": ReplicaRole.NOOP, "OBSERVER": ReplicaRole.OBSERVER,
+}
+
+OptionsGenerator = Callable[[str], DBOptions]
+
+
+@dataclass
+class DBMetaData:
+    """rocksdb_admin.thrift DBMetaData."""
+
+    db_name: str
+    s3_bucket: str = ""
+    s3_path: str = ""
+    last_kafka_msg_timestamp_ms: int = 0
+
+    def encode(self) -> bytes:
+        return json.dumps(asdict(self)).encode("utf-8")
+
+    @classmethod
+    def decode(cls, db_name: str, raw: Optional[bytes]) -> "DBMetaData":
+        if not raw:
+            return cls(db_name=db_name)
+        d = json.loads(bytes(raw).decode("utf-8"))
+        d.setdefault("db_name", db_name)
+        return cls(**d)
+
+
+def _parse_role(role: str) -> ReplicaRole:
+    r = _ROLE_ALIASES.get(role.upper())
+    if r is None:
+        raise RpcApplicationError(INVALID_DB_ROLE, role)
+    return r
+
+
+class AdminHandler:
+    def __init__(
+        self,
+        rocksdb_dir: str,
+        replicator: Replicator,
+        db_manager: Optional[ApplicationDBManager] = None,
+        options_generator: Optional[OptionsGenerator] = None,
+        leader_resolver: Optional[LeaderResolver] = None,
+        executor_threads: int = 8,
+        max_sst_loading_concurrency: int = 999,
+        object_store_rate_limit_bytes: Optional[float] = None,
+    ):
+        self.rocksdb_dir = os.path.abspath(rocksdb_dir)
+        os.makedirs(self.rocksdb_dir, exist_ok=True)
+        self.replicator = replicator
+        self.db_manager = db_manager or ApplicationDBManager()
+        self._options_gen = options_generator or (lambda segment: DBOptions())
+        self._leader_resolver = leader_resolver
+        self._executor = ThreadPoolExecutor(
+            max_workers=executor_threads, thread_name_prefix="admin"
+        )
+        self._db_admin_lock = ObjectLock()
+        self._store_rate_limit = object_store_rate_limit_bytes
+        self._max_sst_loading = max_sst_loading_concurrency
+        self._sst_loading_lock = threading.Lock()
+        self._num_sst_loading = 0
+        self._meta_db = DB(os.path.join(self.rocksdb_dir, "meta_db"))
+        # db_name -> message-ingestion watcher (kafka-equivalent stack)
+        self._ingestion: Dict[str, object] = {}
+        self._stats = Stats.get()
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    async def _run(self, fn: Callable, *args):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._executor, fn, *args)
+
+    def _db_path(self, db_name: str) -> str:
+        return os.path.join(self.rocksdb_dir, db_name)
+
+    def _options_for(self, db_name: str) -> DBOptions:
+        try:
+            segment = db_name_to_segment(db_name)
+        except ValueError:
+            segment = db_name
+        return self._options_gen(segment)
+
+    def _get_app_db(self, db_name: str) -> ApplicationDB:
+        app_db = self.db_manager.get_db(db_name)
+        if app_db is None:
+            raise RpcApplicationError(DB_NOT_FOUND, db_name)
+        return app_db
+
+    def get_meta_data(self, db_name: str) -> DBMetaData:
+        """admin_handler.cpp:556-576."""
+        raw = self._meta_db.get(db_name.encode("utf-8"))
+        return DBMetaData.decode(db_name, raw)
+
+    def write_meta_data(
+        self, db_name: str, s3_bucket: str = "", s3_path: str = "",
+        last_kafka_msg_timestamp_ms: Optional[int] = None,
+    ) -> None:
+        """admin_handler.cpp:578-595."""
+        meta = self.get_meta_data(db_name)
+        meta.s3_bucket = s3_bucket
+        meta.s3_path = s3_path
+        if last_kafka_msg_timestamp_ms is not None:
+            meta.last_kafka_msg_timestamp_ms = last_kafka_msg_timestamp_ms
+        self._meta_db.put(db_name.encode("utf-8"), meta.encode())
+
+    def clear_meta_data(self, db_name: str) -> None:
+        self._meta_db.delete(db_name.encode("utf-8"))
+
+    def _store(self, uri: str):
+        return build_object_store(uri, self._store_rate_limit)
+
+    def _open_app_db(
+        self,
+        db_name: str,
+        role: ReplicaRole,
+        upstream: Optional[Tuple[str, int]],
+        overwrite: bool = False,
+    ) -> ApplicationDB:
+        path = self._db_path(db_name)
+        if overwrite:
+            destroy_db(path)
+        options = self._options_for(db_name)
+        db = DB(path, options)
+        app_db = ApplicationDB(
+            db_name, db, role,
+            replicator=self.replicator,
+            upstream_addr=upstream,
+            leader_resolver=self._leader_resolver,
+        )
+        if not self.db_manager.add_db(db_name, app_db):
+            app_db.close()
+            raise RpcApplicationError(DB_ALREADY_EXISTS, db_name)
+        return app_db
+
+    # ------------------------------------------------------------------
+    # RPC: liveness / introspection
+    # ------------------------------------------------------------------
+
+    async def handle_ping(self) -> dict:
+        return {"ok": True, "timestamp_ms": int(time.time() * 1000)}
+
+    async def handle_get_sequence_number(self, db_name: str = "") -> dict:
+        app_db = self._get_app_db(db_name)
+        return {"seq_num": app_db.latest_sequence_number()}
+
+    async def handle_check_db(self, db_name: str = "") -> dict:
+        """checkDB: seq + WAL/update recency info for rebuild decisions
+        (needRebuildDB, LeaderFollowerStateModelFactory.java:469-479)."""
+        app_db = self._get_app_db(db_name)
+
+        def collect():
+            seq = app_db.latest_sequence_number()
+            last_ts = None
+            # newest update timestamp from the WAL tail
+            for _seq, raw in app_db.db.get_updates_since(max(1, seq)):
+                from ..storage.records import decode_batch
+
+                last_ts = decode_batch(raw).extract_timestamp_ms()
+            wal_dir = os.path.join(app_db.db.path, "wal")
+            oldest_wal_ts = None
+            try:
+                segs = sorted(os.listdir(wal_dir))
+                if segs:
+                    oldest_wal_ts = int(
+                        os.path.getmtime(os.path.join(wal_dir, segs[0])) * 1000
+                    )
+            except OSError:
+                pass
+            return {
+                "seq_num": seq,
+                "last_update_timestamp_ms": last_ts,
+                "oldest_wal_timestamp_ms": oldest_wal_ts,
+                "db_size_bytes": app_db.db.approximate_disk_size(),
+                "role": app_db.role.value,
+            }
+
+        return await self._run(collect)
+
+    # ------------------------------------------------------------------
+    # RPC: lifecycle
+    # ------------------------------------------------------------------
+
+    async def handle_add_db(
+        self,
+        db_name: str = "",
+        upstream_ip: str = "",
+        upstream_port: int = 0,
+        role: str = "FOLLOWER",
+        overwrite: bool = False,
+        replication_mode: Optional[int] = None,
+    ) -> dict:
+        """addDB (admin_handler.cpp:597-694): open the db and register it
+        with the replicator in the given role."""
+        parsed = _parse_role(role)
+        upstream = (upstream_ip, upstream_port) if upstream_ip else None
+        if parsed in (ReplicaRole.FOLLOWER, ReplicaRole.OBSERVER) and not upstream:
+            raise RpcApplicationError(INVALID_UPSTREAM, "follower requires upstream")
+
+        def do():
+            with self._db_admin_lock.locked(db_name):
+                if self.db_manager.get_db(db_name) is not None:
+                    raise RpcApplicationError(DB_ALREADY_EXISTS, db_name)
+                self._open_app_db(db_name, parsed, upstream, overwrite)
+
+        await self._run(do)
+        return {}
+
+    async def handle_close_db(self, db_name: str = "") -> dict:
+        def do():
+            with self._db_admin_lock.locked(db_name):
+                if self.db_manager.remove_db(db_name) is None:
+                    raise RpcApplicationError(DB_NOT_FOUND, db_name)
+
+        await self._run(do)
+        return {}
+
+    async def handle_clear_db(
+        self, db_name: str = "", reopen_db: bool = True
+    ) -> dict:
+        """clearDB: destroy data; optionally reopen fresh with the same
+        role/upstream (admin_handler.cpp clearDB + reopen pattern)."""
+
+        def do():
+            with self._db_admin_lock.locked(db_name):
+                app_db = self.db_manager.get_db(db_name)
+                role, upstream = ReplicaRole.NOOP, None
+                if app_db is not None:
+                    role = app_db.role
+                    if app_db.replicated_db is not None:
+                        upstream = app_db.replicated_db.upstream_addr
+                    self.db_manager.remove_db(db_name)
+                destroy_db(self._db_path(db_name))
+                self.clear_meta_data(db_name)
+                if reopen_db:
+                    self._open_app_db(db_name, role, upstream)
+
+        await self._run(do)
+        return {}
+
+    async def handle_change_db_role_and_upstream(
+        self,
+        db_name: str = "",
+        new_role: str = "FOLLOWER",
+        upstream_ip: str = "",
+        upstream_port: int = 0,
+    ) -> dict:
+        """changeDBRoleAndUpStream (admin_handler.cpp:1438): implemented as
+        removeDB + addDB with the new role, keeping the storage."""
+        parsed = _parse_role(new_role)
+        upstream = (upstream_ip, upstream_port) if upstream_ip else None
+        if parsed in (ReplicaRole.FOLLOWER, ReplicaRole.OBSERVER) and not upstream:
+            raise RpcApplicationError(INVALID_UPSTREAM, "follower requires upstream")
+
+        def do():
+            with self._db_admin_lock.locked(db_name):
+                app_db = self.db_manager.get_db(db_name)
+                if app_db is None:
+                    raise RpcApplicationError(DB_NOT_FOUND, db_name)
+                self.db_manager.remove_db(db_name)  # closes storage + repl
+                self._open_app_db(db_name, parsed, upstream)
+
+        await self._run(do)
+        return {}
+
+    # ------------------------------------------------------------------
+    # RPC: backup / restore
+    # ------------------------------------------------------------------
+
+    async def handle_backup_db(self, db_name: str = "", hdfs_backup_dir: str = "") -> dict:
+        """backupDB — the reference's HDFS path; here any store URI
+        (admin_handler.cpp:696-766)."""
+        return await self._backup(db_name, hdfs_backup_dir, "")
+
+    async def handle_restore_db(
+        self, db_name: str = "", hdfs_backup_dir: str = "",
+        upstream_ip: str = "", upstream_port: int = 0,
+    ) -> dict:
+        return await self._restore(db_name, hdfs_backup_dir, "", upstream_ip, upstream_port)
+
+    async def handle_backup_db_to_s3(
+        self, db_name: str = "", s3_bucket: str = "", s3_backup_dir: str = "",
+        limit_mbs: int = 0,
+    ) -> dict:
+        """backupDBToS3 (admin_handler.cpp:996-1129 checkpoint path)."""
+        return await self._backup(db_name, s3_bucket, s3_backup_dir)
+
+    async def handle_restore_db_from_s3(
+        self, db_name: str = "", s3_bucket: str = "", s3_backup_dir: str = "",
+        upstream_ip: str = "", upstream_port: int = 0, limit_mbs: int = 0,
+    ) -> dict:
+        return await self._restore(db_name, s3_bucket, s3_backup_dir, upstream_ip, upstream_port)
+
+    async def _backup(self, db_name: str, store_uri: str, sub_path: str) -> dict:
+        app_db = self._get_app_db(db_name)
+        store = self._store(store_uri)
+        prefix = sub_path or db_name
+
+        def do():
+            with self._db_admin_lock.locked(db_name), Timer("admin.backup_ms"):
+                meta = self.get_meta_data(db_name)
+                return backup_mod.backup_db(
+                    app_db.db, store, prefix,
+                    meta={"last_kafka_msg_timestamp_ms": meta.last_kafka_msg_timestamp_ms},
+                )
+
+        dbmeta = await self._run(do)
+        return {"seq": dbmeta["seq"], "timestamp_ms": dbmeta["timestamp_ms"]}
+
+    async def _restore(
+        self, db_name: str, store_uri: str, sub_path: str,
+        upstream_ip: str, upstream_port: int,
+    ) -> dict:
+        store = self._store(store_uri)
+        prefix = sub_path or db_name
+        upstream = (upstream_ip, upstream_port) if upstream_ip else None
+        role = ReplicaRole.FOLLOWER if upstream else ReplicaRole.NOOP
+
+        def do():
+            with self._db_admin_lock.locked(db_name), Timer("admin.restore_ms"):
+                if self.db_manager.get_db(db_name) is not None:
+                    self.db_manager.remove_db(db_name)
+                destroy_db(self._db_path(db_name))
+                dbmeta = backup_mod.restore_db(store, prefix, self._db_path(db_name))
+                self._open_app_db(db_name, role, upstream)
+                ts = dbmeta.get("last_kafka_msg_timestamp_ms")
+                if ts:
+                    self.write_meta_data(db_name, last_kafka_msg_timestamp_ms=ts)
+                return dbmeta
+
+        dbmeta = await self._run(do)
+        return {"seq": dbmeta["seq"]}
+
+    # ------------------------------------------------------------------
+    # RPC: SST bulk ingest — the north-star workload (§3.3)
+    # ------------------------------------------------------------------
+
+    async def handle_add_s3_sst_files_to_db(
+        self,
+        db_name: str = "",
+        s3_bucket: str = "",
+        s3_path: str = "",
+        ingest_behind: bool = False,
+        allow_overlapping_keys: bool = True,
+        s3_download_limit_mb: int = 64,
+        compact_db_after_load: bool = False,
+    ) -> dict:
+        """addS3SstFilesToDB (admin_handler.cpp:1635-1850). Call-stack
+        parity per SURVEY §3.3: per-db lock → meta idempotency → ingest-
+        behind validation (DBLmaxEmpty) → concurrency gate → batch download
+        → (optional full replace) → ingest → meta write → optional compact."""
+        app_db = self._get_app_db(db_name)
+        store = self._store(s3_bucket)
+
+        def do():
+            with self._db_admin_lock.locked(db_name):
+                # idempotency via meta_db (:1655-1667)
+                meta = self.get_meta_data(db_name)
+                if meta.s3_bucket == s3_bucket and meta.s3_path == s3_path:
+                    return {"skipped": True}
+                if ingest_behind:
+                    if not app_db.db.options.allow_ingest_behind:
+                        raise RpcApplicationError(
+                            DB_ADMIN_ERROR, "db not opened with allow_ingest_behind"
+                        )
+                    if not app_db.db_lmax_empty():
+                        raise RpcApplicationError(
+                            DB_ADMIN_ERROR, "bottom level not empty"
+                        )
+                # concurrency gate (:1692-1706)
+                with self._sst_loading_lock:
+                    if self._num_sst_loading >= self._max_sst_loading:
+                        raise RpcApplicationError(
+                            TOO_MANY_REQUESTS,
+                            f"{self._num_sst_loading} ingests in flight",
+                        )
+                    self._num_sst_loading += 1
+                try:
+                    return self._do_ingest(
+                        db_name, app_db, store, s3_bucket, s3_path,
+                        ingest_behind, allow_overlapping_keys,
+                        compact_db_after_load,
+                    )
+                finally:
+                    with self._sst_loading_lock:
+                        self._num_sst_loading -= 1
+
+        return await self._run(do)
+
+    def _do_ingest(
+        self, db_name, app_db, store, s3_bucket, s3_path,
+        ingest_behind, allow_overlapping_keys, compact_after,
+    ) -> dict:
+        tmp = tempfile.mkdtemp(prefix=f"rstpu-ingest-{db_name}-")
+        try:
+            with Timer("admin.sst_download_ms"):
+                local_files = store.get_objects(s3_path, tmp)  # :1724-1726
+            sst_files = [p for p in local_files if p.endswith(".tsst")]
+            if not sst_files:
+                raise RpcApplicationError(DB_ADMIN_ERROR, f"no .tsst under {s3_path}")
+            target_db = app_db
+            if not allow_overlapping_keys and not ingest_behind:
+                # full replace: close → destroy → reopen → re-add (:1774-1817)
+                role = app_db.role
+                upstream = (
+                    app_db.replicated_db.upstream_addr
+                    if app_db.replicated_db else None
+                )
+                self.db_manager.remove_db(db_name)
+                destroy_db(self._db_path(db_name))
+                target_db = self._open_app_db(db_name, role, upstream)
+            with Timer("admin.sst_ingest_ms"):
+                target_db.db.ingest_external_file(
+                    sst_files,
+                    move_files=True,
+                    allow_global_seqno=True,
+                    ingest_behind=ingest_behind,
+                )  # :1819-1827
+            self.write_meta_data(db_name, s3_bucket, s3_path)  # :1836
+            if compact_after:
+                with Timer("admin.post_ingest_compact_ms"):
+                    target_db.compact_range()  # :1845-1850
+            self._stats.incr("admin.sst_files_ingested", len(sst_files))
+            return {"ingested_files": len(sst_files)}
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    # ------------------------------------------------------------------
+    # RPC: options / compaction
+    # ------------------------------------------------------------------
+
+    async def handle_set_db_options(
+        self, db_name: str = "", options: Optional[Dict[str, Any]] = None
+    ) -> dict:
+        """setDBOptions (admin_handler.cpp:2134-2158)."""
+        app_db = self._get_app_db(db_name)
+        try:
+            app_db.db.set_options(options or {})
+        except StorageError as e:
+            raise RpcApplicationError(DB_ADMIN_ERROR, str(e)) from e
+        return {}
+
+    async def handle_compact_db(self, db_name: str = "") -> dict:
+        app_db = self._get_app_db(db_name)
+
+        def do():
+            with Timer("admin.compact_ms"):
+                app_db.compact_range()
+
+        await self._run(do)
+        return {}
+
+    # ------------------------------------------------------------------
+    # RPC: message ingestion (kafka-equivalent; wired by the queue stack)
+    # ------------------------------------------------------------------
+
+    async def handle_start_message_ingestion(
+        self, db_name: str = "", topic_name: str = "",
+        kafka_broker_serverset_path: str = "", replay_timestamp_ms: int = 0,
+    ) -> dict:
+        from ..kafka.ingestion import start_ingestion  # lazy: optional stack
+
+        app_db = self._get_app_db(db_name)
+        if db_name in self._ingestion:
+            raise RpcApplicationError(DB_ADMIN_ERROR, f"{db_name} already ingesting")
+        meta = self.get_meta_data(db_name)
+        start_ts = max(replay_timestamp_ms, meta.last_kafka_msg_timestamp_ms)
+        watcher = await self._run(
+            start_ingestion, self, db_name, app_db, topic_name,
+            kafka_broker_serverset_path, start_ts,
+        )
+        self._ingestion[db_name] = watcher
+        return {}
+
+    async def handle_stop_message_ingestion(self, db_name: str = "") -> dict:
+        watcher = self._ingestion.pop(db_name, None)
+        if watcher is None:
+            raise RpcApplicationError(DB_NOT_FOUND, f"{db_name} not ingesting")
+        await self._run(watcher.stop)
+        return {}
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def storage_info_text(self) -> str:
+        """/storage_info.txt endpoint body (reference /rocksdb_info.txt)."""
+        return self.db_manager.dump_db_stats_as_text()
+
+    def close(self) -> None:
+        for name in self.db_manager.get_all_db_names():
+            self.db_manager.remove_db(name)
+        for watcher in self._ingestion.values():
+            try:
+                watcher.stop()
+            except Exception:
+                pass
+        self._ingestion.clear()
+        self._meta_db.close()
+        self._executor.shutdown(wait=False)

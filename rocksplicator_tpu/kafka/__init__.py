@@ -1,0 +1,5 @@
+"""Message-queue ingestion stack (reference: common/kafka/ — SURVEY §2.3).
+
+Implemented by the queue stack stage; ``ingestion.start_ingestion`` is the
+seam the admin plane's start/stopMessageIngestion RPCs call.
+"""

@@ -1,0 +1,11 @@
+"""start/stopMessageIngestion seam (filled in by the queue stack)."""
+
+from __future__ import annotations
+
+from ..rpc.errors import RpcApplicationError
+
+
+def start_ingestion(handler, db_name, app_db, topic_name, broker_path, start_ts):
+    raise RpcApplicationError(
+        "NOT_IMPLEMENTED", "message ingestion requires the queue stack"
+    )

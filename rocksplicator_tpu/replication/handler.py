@@ -31,8 +31,9 @@ class ReplicatorHandler:
             raise RpcApplicationError(
                 ReplicateErrorCode.SOURCE_NOT_FOUND.value, db_name
             )
-        updates = await db.handle_replicate_request(
+        # Response carries latest_seq (CDC "start from now" probes, catch-up
+        # progress) and source_role (puller's stale-leader detection).
+        return await db.handle_replicate_request(
             seq_no=seq_no, max_wait_ms=max_wait_ms,
             max_updates=max_updates, role=role,
         )
-        return {"updates": updates}

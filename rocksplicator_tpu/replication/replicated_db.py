@@ -183,9 +183,11 @@ class ReplicatedDB:
         max_wait_ms: Optional[int] = None,
         max_updates: Optional[int] = None,
         role: str = ReplicaRole.FOLLOWER.value,
-    ) -> List[dict]:
+    ) -> dict:
         """Serve updates after ``seq_no`` (the puller's latest applied seq).
-        Returns a list of update dicts; empty list on long-poll timeout."""
+        Returns {updates, latest_seq, source_role}; updates is empty on a
+        long-poll timeout. source_role lets pullers detect they're polling
+        a non-leader (upstream-reset heuristic, replicated_db.cpp:385-399)."""
         f = self.flags
         max_wait_ms = f.server_long_poll_ms if max_wait_ms is None else max_wait_ms
         max_updates = (
@@ -196,16 +198,24 @@ class ReplicatedDB:
         # (replicated_db.cpp:450-456); OBSERVERs never count (:452).
         if role != ReplicaRole.OBSERVER.value and self.replication_mode == 2:
             self._acked.post(seq_no)
-        latest = self.wrapper.latest_sequence_number()
+        # latest_sequence_number takes the storage lock, which flush/
+        # compaction can hold for seconds — never block the shared IO loop
+        # on it.
+        latest = await self._loop.run_in_executor(
+            self._executor, self.wrapper.latest_sequence_number
+        )
         if latest <= seq_no and max_wait_ms > 0:
             await self._notifier.wait(max_wait_ms / 1000.0)
             if self._removed:
                 raise RpcApplicationError(
                     ReplicateErrorCode.SOURCE_REMOVED.value, self.name
                 )
-            latest = self.wrapper.latest_sequence_number()
+            latest = await self._loop.run_in_executor(
+                self._executor, self.wrapper.latest_sequence_number
+            )
         if latest <= seq_no:
-            return []
+            return {"updates": [], "latest_seq": latest,
+                    "source_role": self.role.value}
         try:
             updates = await self._loop.run_in_executor(
                 self._executor, self._read_updates, seq_no + 1, max_updates
@@ -229,17 +239,30 @@ class ReplicatedDB:
             M["replicate_bytes_sent"],
             sum(len(u["raw_data"]) for u in updates),
         )
-        return updates
+        return {"updates": updates, "latest_seq": latest,
+                "source_role": self.role.value}
 
     def _read_updates(self, from_seq: int, max_updates: int) -> List[dict]:
-        """Executor-side WAL read using the cursor cache."""
+        """Executor-side WAL read using the cursor cache.
+
+        Raises on a WAL gap (requested updates already purged) — the analog
+        of rocksdb GetUpdatesSince returning NotFound, which tells the
+        puller it must rebuild from a snapshot rather than silently skip."""
         it = self._iter_cache.take(from_seq)
         if it is None:
             it = self.wrapper.get_updates_from_leader(from_seq)
         updates: List[dict] = []
         next_seq = from_seq
         exhausted = True
+        first = True
         for start_seq, raw in it:
+            if first:
+                first = False
+                if start_seq > from_seq:
+                    raise ValueError(
+                        f"WAL gap: requested seq {from_seq}, oldest available "
+                        f"{start_seq} (purged — puller must rebuild)"
+                    )
             batch = decode_batch(raw)
             count = batch.count()
             updates.append(
@@ -266,10 +289,15 @@ class ReplicatedDB:
         f = self.flags
         while not self._removed:
             try:
-                applied = await self._pull_once()
-                if applied == 0 and self.role is ReplicaRole.FOLLOWER:
-                    # no-updates heuristic: repeatedly empty long-polls may
-                    # mean we're polling a stale leader.
+                applied, source_role = await self._pull_once()
+                if (
+                    applied == 0
+                    and self.role is ReplicaRole.FOLLOWER
+                    and source_role not in (None, ReplicaRole.LEADER.value)
+                ):
+                    # Empty pulls FROM A NON-LEADER mean leadership moved
+                    # (replicated_db.cpp:385-399); idle leaders are normal
+                    # and never trigger resets.
                     self._empty_pulls += 1
                     if self._empty_pulls >= f.empty_pulls_before_reset:
                         self._empty_pulls = 0
@@ -292,12 +320,14 @@ class ReplicatedDB:
                 await self._maybe_reset_upstream(force_sample=False)
                 await self._pull_error_delay()
 
-    async def _pull_once(self) -> int:
+    async def _pull_once(self) -> Tuple[int, Optional[str]]:
         f = self.flags
         assert self.upstream_addr is not None
         host, port = self.upstream_addr
         client = await self._pool.get_client(host, port)
-        latest = self.wrapper.latest_sequence_number()
+        latest = await self._loop.run_in_executor(
+            self._executor, self.wrapper.latest_sequence_number
+        )
         self._stats.incr(M["pull_requests"])
         result = await client.call(
             "replicate",
@@ -311,12 +341,13 @@ class ReplicatedDB:
             timeout=(f.server_long_poll_ms + f.pull_rpc_margin_ms) / 1000.0,
         )
         updates = result.get("updates", []) if result else []
+        source_role = result.get("source_role") if result else None
         if not updates:
-            return 0
+            return 0, source_role
         await self._loop.run_in_executor(
             self._executor, self._apply_updates, updates
         )
-        return len(updates)
+        return len(updates), source_role
 
     def _apply_updates(self, updates: List[dict]) -> None:
         """Executor-side ordered apply of one response's updates."""
@@ -325,12 +356,25 @@ class ReplicatedDB:
         for u in updates:
             raw = bytes(u["raw_data"])
             ts = u.get("timestamp")
+            # Sequence-continuity guard: applying out of order would shift
+            # the local numbering below the leader's and silently diverge
+            # (re-fetch + double-apply). Abort the response instead.
+            expected = self.wrapper.latest_sequence_number() + 1
+            got = int(u.get("seq_no", expected))
+            if got != expected:
+                raise ValueError(
+                    f"{self.name}: replication seq discontinuity: expected "
+                    f"{expected}, got {got} — rebuild required"
+                )
             self.wrapper.handle_replicate_response(raw, ts)
             total_bytes += len(raw)
             if ts is not None:
                 self._stats.add_metric(M["replication_lag_ms"], max(0, now - ts))
         self._stats.incr(M["pull_updates_applied"], len(updates))
         self._stats.incr(M["pull_bytes_applied"], total_bytes)
+        # Wake OUR parked long-polls so chained downstream followers see the
+        # new updates immediately (reference replicated_db.cpp:391).
+        self._notifier.notify_all_threadsafe()
 
     async def _pull_error_delay(self) -> None:
         f = self.flags

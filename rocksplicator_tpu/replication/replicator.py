@@ -120,7 +120,13 @@ class Replicator:
         )
         if not self._dbs.add(name, rdb):
             raise ValueError(f"db already exists: {name}")
-        rdb.start()
+        try:
+            rdb.start()
+        except BaseException:
+            # Never leave a zombie registration behind a failed start.
+            self._dbs.remove(name)
+            rdb.stop()
+            raise
         return rdb
 
     def remove_db(self, name: str) -> None:

@@ -401,3 +401,77 @@ def test_max_number_box_stress():
         t.join()
     p.join()
     assert all(results)
+
+
+# ---------------------------------------------------------------------------
+# regression tests from code review
+# ---------------------------------------------------------------------------
+
+
+def test_wal_gap_raises_source_read_error(hosts):
+    """A follower asking for purged history must get an error (rebuild
+    signal), never a silent skip."""
+    import os
+    from rocksplicator_tpu.rpc.errors import RpcApplicationError
+    leader = hosts("l")
+    # tiny WAL segments so history spans many files and can be purged
+    ldb, lrdb = leader.add_db("seg00001", ReplicaRole.LEADER,
+                              wal_segment_bytes=200)
+    for i in range(20):
+        leader.replicator.write("seg00001", WriteBatch().put(f"k{i}".encode(), b"v"))
+    ldb.flush()
+    from rocksplicator_tpu.storage import wal as wal_mod
+    wal_dir = os.path.join(ldb.path, "wal")
+    removed = wal_mod.purge_obsolete(wal_dir, persisted_seq=20, ttl_seconds=0.0)
+    assert removed > 0  # early history is gone
+    # direct server-path call: ask for seq 1 which is now purged
+    import asyncio
+    async def ask():
+        return await lrdb.handle_replicate_request(seq_no=1, max_wait_ms=0)
+    with pytest.raises(RpcApplicationError) as ei:
+        asyncio.run_coroutine_threadsafe(ask(), leader.replicator.ioloop.loop).result(5)
+    assert ei.value.code == "SOURCE_READ_ERROR"
+
+
+def test_apply_rejects_seq_discontinuity(hosts):
+    leader = hosts("l")
+    ldb, lrdb = leader.add_db("seg00001", ReplicaRole.LEADER)
+    batch = WriteBatch().put(b"k", b"v")
+    raw = batch.encode()
+    # craft a response whose seq skips ahead
+    with pytest.raises(ValueError):
+        lrdb._apply_updates([{"seq_no": 99, "raw_data": raw, "timestamp": None}])
+
+
+def test_chain_propagates_quickly_via_notify(hosts):
+    """Mid-chain nodes must wake downstream long-polls on apply, not wait
+    out the long-poll timeout (reference replicated_db.cpp:391)."""
+    slow_poll = ReplicationFlags(
+        server_long_poll_ms=8000,  # long: timeout-based propagation would fail
+        pull_error_delay_min_ms=50, pull_error_delay_max_ms=120,
+    )
+    a, b, c = hosts("a", slow_poll), hosts("b", slow_poll), hosts("c", slow_poll)
+    adb, _ = a.add_db("seg00001", ReplicaRole.LEADER)
+    bdb, _ = b.add_db("seg00001", ReplicaRole.FOLLOWER, upstream=a.addr)
+    cdb, _ = c.add_db("seg00001", ReplicaRole.FOLLOWER, upstream=b.addr)
+    time.sleep(0.3)  # both pulls parked in long-poll
+    a.replicator.write("seg00001", WriteBatch().put(b"k", b"v"))
+    # must reach C well within the 8s long-poll window
+    assert wait_until(lambda: cdb.get(b"k") == b"v", timeout=3.0)
+
+
+def test_add_db_failed_start_no_zombie(hosts):
+    leader = hosts("l")
+    from rocksplicator_tpu.storage import DB as _DB
+    db = _DB(str(leader.dir / "seg00009"))
+    leader.dbs["seg00009"] = db
+    with pytest.raises(ValueError):
+        leader.replicator.add_db(
+            "seg00009", StorageDbWrapper(db), ReplicaRole.FOLLOWER,
+            upstream_addr=None,  # invalid: follower needs upstream
+        )
+    # retry with valid args must succeed (no zombie registration)
+    rdb = leader.replicator.add_db(
+        "seg00009", StorageDbWrapper(db), ReplicaRole.LEADER
+    )
+    assert rdb is not None
