@@ -1,0 +1,8 @@
+"""counter_service — the canonical application.
+
+Reference: examples/counter_service/ — a thrift ``Counter extends Admin``
+service (get/set/bump with a ``need_routing`` server-side routing flag),
+``CounterHandler extends AdminHandler``, a ``CounterRouter`` over the shard
+-map router, the uint64-add merge operator, per-segment storage options,
+and a stress-test load generator.
+"""

@@ -1,0 +1,191 @@
+"""CounterHandler + service main.
+
+Reference: examples/counter_service/counter_handler.cpp:31-107 (handler
+extending AdminHandler; get/set/bump with ``need_routing`` server-side
+forwarding) and counter.cpp:57-104 (main wiring: Stats, shard-map router,
+DBs created from static config, RPC server, StatusServer, cluster join).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import struct
+import sys
+from typing import Optional
+
+from rocksplicator_tpu.admin import AdminHandler
+from rocksplicator_tpu.admin.db_manager import ApplicationDBManager
+from rocksplicator_tpu.replication import ReplicaRole, ReplicationFlags, Replicator
+from rocksplicator_tpu.rpc import RpcApplicationError, RpcServer
+from rocksplicator_tpu.rpc.router import Quantity, Role, RpcRouter
+from rocksplicator_tpu.storage.records import WriteBatch
+from rocksplicator_tpu.utils.graceful_shutdown import GracefulShutdownHandler
+from rocksplicator_tpu.utils.misc import availability_zone, local_ip
+from rocksplicator_tpu.utils.segment_utils import segment_to_db_name
+from rocksplicator_tpu.utils.stats import Stats
+from rocksplicator_tpu.utils.status_server import StatusServer
+
+from .counter_router import SEGMENT, CounterRouter
+from .options import counter_options_generator
+
+_I64 = struct.Struct("<q")
+
+
+class CounterHandler(AdminHandler):
+    """``service Counter extends Admin`` — the handler stacks counter RPCs
+    on top of every Admin RPC (counter_handler.cpp:31-107)."""
+
+    def __init__(self, *args, router: Optional[RpcRouter] = None, **kw):
+        super().__init__(*args, **kw)
+        self.router = CounterRouter(router) if router else None
+
+    # -- helpers -----------------------------------------------------------
+
+    def _local_db_for(self, counter_name: str):
+        if self.router is None or self.router.num_shards == 0:
+            raise RpcApplicationError("NO_SHARD_MAP", "router not configured")
+        db_name = self.router.db_name_for(counter_name)
+        return db_name, self.db_manager.get_db(db_name)
+
+    async def _forward(self, method: str, counter_name: str, **extra):
+        """Server-side routing (need_routing flag): forward to the shard's
+        leader elsewhere in the cluster."""
+        clients = await self.router.clients_for(counter_name, Role.LEADER)
+        if not clients:
+            raise RpcApplicationError("NO_LEADER", counter_name)
+        return await clients[0].call(
+            method, {"counter_name": counter_name, "need_routing": False, **extra}
+        )
+
+    # -- counter RPCs -------------------------------------------------------
+
+    async def handle_get_counter(
+        self, counter_name: str = "", need_routing: bool = False
+    ) -> dict:
+        db_name, app_db = self._local_db_for(counter_name)
+        if app_db is None:
+            if need_routing:
+                return await self._forward("get_counter", counter_name)
+            raise RpcApplicationError("DB_NOT_FOUND", db_name)
+        raw = await self._run(app_db.get, counter_name.encode("utf-8"))
+        return {"counter_value": _I64.unpack(raw)[0] if raw else 0}
+
+    async def handle_set_counter(
+        self, counter_name: str = "", counter_value: int = 0,
+        need_routing: bool = False,
+    ) -> dict:
+        db_name, app_db = self._local_db_for(counter_name)
+        if app_db is None or (
+            app_db.role is not ReplicaRole.LEADER
+            and app_db.role is not ReplicaRole.NOOP
+        ):
+            if need_routing:
+                return await self._forward(
+                    "set_counter", counter_name, counter_value=counter_value
+                )
+            raise RpcApplicationError("NOT_LEADER", db_name)
+        batch = WriteBatch().put(
+            counter_name.encode("utf-8"), _I64.pack(counter_value)
+        )
+        await self._run(app_db.write, batch)
+        return {}
+
+    async def handle_bump_counter(
+        self, counter_name: str = "", delta: int = 1, need_routing: bool = False
+    ) -> dict:
+        db_name, app_db = self._local_db_for(counter_name)
+        if app_db is None or (
+            app_db.role is not ReplicaRole.LEADER
+            and app_db.role is not ReplicaRole.NOOP
+        ):
+            if need_routing:
+                return await self._forward(
+                    "bump_counter", counter_name, delta=delta
+                )
+            raise RpcApplicationError("NOT_LEADER", db_name)
+        batch = WriteBatch().merge(counter_name.encode("utf-8"), _I64.pack(delta))
+        await self._run(app_db.write, batch)
+        return {}
+
+
+def create_dbs_from_shard_map(
+    handler: CounterHandler, router: RpcRouter, my_addr, segment: str = SEGMENT
+) -> int:
+    """CreateDBBasedOnConfig parity (admin_handler.cpp:246-323): open every
+    shard this host owns per the static shard map, in the mapped role.
+    ``my_addr`` is this host's (ip, service_port); follower upstreams use
+    the leader's replication-plane address (Host.repl_addr)."""
+    layout = router.layout.segments.get(segment)
+    if layout is None:
+        return 0
+    created = 0
+    for shard, host_roles in sorted(layout.shard_to_hosts.items()):
+        my_role = None
+        leader_repl_addr = None
+        for host, role in host_roles:
+            if role is Role.LEADER:
+                leader_repl_addr = host.repl_addr
+            if (host.ip, host.port) == tuple(my_addr):
+                my_role = role
+        if my_role is None:
+            continue
+        db_name = segment_to_db_name(segment, shard)
+        if my_role is Role.LEADER:
+            handler._open_app_db(db_name, ReplicaRole.LEADER, None)
+        else:
+            if leader_repl_addr is None:
+                continue
+            handler._open_app_db(db_name, ReplicaRole.FOLLOWER, leader_repl_addr)
+        created += 1
+    return created
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="counter service")
+    p.add_argument("--rocksdb_dir", required=True)
+    p.add_argument("--port", type=int, default=9090)
+    p.add_argument("--replicator_port", type=int, default=0,
+                   help="default: service port + 1 (shard-map convention)")
+    p.add_argument("--status_port", type=int, default=9999)
+    p.add_argument("--shard_map_path", default=None)
+    p.add_argument("--az", default=None)
+    args = p.parse_args(argv)
+
+    Stats.get()
+    az = args.az or availability_zone()
+    router = RpcRouter(local_az=az, shard_map_path=args.shard_map_path)
+    replicator = Replicator(port=args.replicator_port or args.port + 1)
+    handler = CounterHandler(
+        args.rocksdb_dir, replicator,
+        db_manager=ApplicationDBManager(),
+        options_generator=counter_options_generator,
+        router=router,
+    )
+    # Shard maps carry the SERVICE port; peers reach replication at the
+    # leader's repl_addr (4th host-key field or service port + 1).
+    my_addr = (local_ip(), args.port)
+    n = create_dbs_from_shard_map(handler, router, my_addr)
+    server = RpcServer(port=args.port, ioloop=replicator.ioloop)
+    server.add_handler(handler)
+    server.start()
+    status = StatusServer.start_status_server(
+        args.status_port,
+        extra_endpoints={"/storage_info.txt": handler.storage_info_text},
+    )
+    shutdown = GracefulShutdownHandler()
+    shutdown.add_server(server)
+    shutdown.register_post_shutdown_hook(handler.close)
+    shutdown.register_post_shutdown_hook(replicator.stop)
+    shutdown.install()
+    print(
+        f"counter_service up: port={server.port} replicator={replicator.port} "
+        f"status={status.port} dbs={n}",
+        flush=True,
+    )
+    shutdown.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

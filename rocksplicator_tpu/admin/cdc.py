@@ -58,10 +58,14 @@ class CdcDbWrapper(DbWrapper):
         batch = decode_batch(raw_data)
         with self._lock:
             start_seq = self._seq + 1
+        # Publish BEFORE advancing the applied seq: a publisher failure
+        # leaves _seq unchanged so the pull loop re-fetches and re-publishes
+        # the batch (at-least-once, never silent loss).
+        self._publisher(self.db_name, start_seq, bytes(raw_data), timestamp_ms)
+        with self._lock:
             self._seq += batch.count()
             self.published_count += 1
             self.last_published_ms = int(time.time() * 1000)
-        self._publisher(self.db_name, start_seq, bytes(raw_data), timestamp_ms)
 
 
 class MemoryPublisher:
@@ -111,9 +115,26 @@ class CdcAdminHandler:
         position" (probed via a non-blocking replicate call)."""
         if not upstream_ip:
             raise RpcApplicationError("INVALID_UPSTREAM", "upstream required")
+        # Reserve before the awaits so a concurrent duplicate gets the typed
+        # error instead of a raw add_db ValueError.
         with self._lock:
             if db_name in self._observers:
                 raise RpcApplicationError(OBSERVER_ALREADY_EXISTS, db_name)
+            self._observers[db_name] = None  # reservation
+        try:
+            return await self._do_add_observer(
+                db_name, upstream_ip, upstream_port, start_seq
+            )
+        except BaseException:
+            with self._lock:
+                if self._observers.get(db_name) is None:
+                    self._observers.pop(db_name, None)
+            raise
+
+    async def _do_add_observer(
+        self, db_name: str, upstream_ip: str, upstream_port: int,
+        start_seq: Optional[int],
+    ) -> dict:
         if start_seq is None:
             pool = self.replicator._pool
             client = await pool.get_client(upstream_ip, upstream_port)
@@ -134,9 +155,10 @@ class CdcAdminHandler:
 
     async def handle_remove_observer(self, db_name: str = "") -> dict:
         with self._lock:
-            entry = self._observers.pop(db_name, None)
-        if entry is None:
-            raise RpcApplicationError(OBSERVER_NOT_FOUND, db_name)
+            entry = self._observers.get(db_name)
+            if entry is None:  # absent or still-starting reservation
+                raise RpcApplicationError(OBSERVER_NOT_FOUND, db_name)
+            del self._observers[db_name]
         self.replicator.remove_db(db_name)
         return {}
 
@@ -162,10 +184,11 @@ class CdcAdminHandler:
 
     def close(self) -> None:
         with self._lock:
-            names = list(self._observers)
+            names = [n for n, e in self._observers.items() if e is not None]
             self._observers.clear()
         for name in names:
             try:
                 self.replicator.remove_db(name)
             except KeyError:
                 pass
+

@@ -65,6 +65,9 @@ _ROLE_ALIASES = {
 
 OptionsGenerator = Callable[[str], DBOptions]
 
+# sentinel marking an in-flight startMessageIngestion reservation
+_RESERVED = object()
+
 
 @dataclass
 class DBMetaData:
@@ -411,11 +414,13 @@ class AdminHandler:
         parity per SURVEY §3.3: per-db lock → meta idempotency → ingest-
         behind validation (DBLmaxEmpty) → concurrency gate → batch download
         → (optional full replace) → ingest → meta write → optional compact."""
-        app_db = self._get_app_db(db_name)
         store = self._store(s3_bucket)
 
         def do():
             with self._db_admin_lock.locked(db_name):
+                # resolve the db INSIDE the lock: a concurrent closeDB must
+                # yield DB_NOT_FOUND, not operate on a stale handle
+                app_db = self._get_app_db(db_name)
                 # idempotency via meta_db (:1655-1667)
                 meta = self.get_meta_data(db_name)
                 if meta.s3_bucket == s3_bucket and meta.s3_path == s3_path:
@@ -495,19 +500,25 @@ class AdminHandler:
         self, db_name: str = "", options: Optional[Dict[str, Any]] = None
     ) -> dict:
         """setDBOptions (admin_handler.cpp:2134-2158)."""
-        app_db = self._get_app_db(db_name)
-        try:
-            app_db.db.set_options(options or {})
-        except StorageError as e:
-            raise RpcApplicationError(DB_ADMIN_ERROR, str(e)) from e
+        def do():
+            with self._db_admin_lock.locked(db_name):
+                app_db = self._get_app_db(db_name)
+                try:
+                    app_db.db.set_options(options or {})
+                except StorageError as e:
+                    raise RpcApplicationError(DB_ADMIN_ERROR, str(e)) from e
+
+        await self._run(do)
         return {}
 
     async def handle_compact_db(self, db_name: str = "") -> dict:
-        app_db = self._get_app_db(db_name)
-
         def do():
-            with Timer("admin.compact_ms"):
-                app_db.compact_range()
+            # per-db lock: a concurrent clearDB/closeDB must not destroy the
+            # directory under a running compaction
+            with self._db_admin_lock.locked(db_name):
+                app_db = self._get_app_db(db_name)
+                with Timer("admin.compact_ms"):
+                    app_db.compact_range()
 
         await self._run(do)
         return {}
@@ -523,21 +534,32 @@ class AdminHandler:
         from ..kafka.ingestion import start_ingestion  # lazy: optional stack
 
         app_db = self._get_app_db(db_name)
+        # Reserve the slot before any await (atomic on the event loop): two
+        # concurrent starts must not both pass the check and leak a watcher.
         if db_name in self._ingestion:
             raise RpcApplicationError(DB_ADMIN_ERROR, f"{db_name} already ingesting")
-        meta = self.get_meta_data(db_name)
-        start_ts = max(replay_timestamp_ms, meta.last_kafka_msg_timestamp_ms)
-        watcher = await self._run(
-            start_ingestion, self, db_name, app_db, topic_name,
-            kafka_broker_serverset_path, start_ts,
-        )
+        self._ingestion[db_name] = _RESERVED
+        try:
+            meta = self.get_meta_data(db_name)
+            start_ts = max(replay_timestamp_ms, meta.last_kafka_msg_timestamp_ms)
+            watcher = await self._run(
+                start_ingestion, self, db_name, app_db, topic_name,
+                kafka_broker_serverset_path, start_ts,
+            )
+        except BaseException:
+            if self._ingestion.get(db_name) is _RESERVED:
+                del self._ingestion[db_name]
+            raise
         self._ingestion[db_name] = watcher
         return {}
 
     async def handle_stop_message_ingestion(self, db_name: str = "") -> dict:
-        watcher = self._ingestion.pop(db_name, None)
+        watcher = self._ingestion.get(db_name)
         if watcher is None:
             raise RpcApplicationError(DB_NOT_FOUND, f"{db_name} not ingesting")
+        if watcher is _RESERVED:
+            raise RpcApplicationError(DB_ADMIN_ERROR, f"{db_name} still starting")
+        del self._ingestion[db_name]
         await self._run(watcher.stop)
         return {}
 

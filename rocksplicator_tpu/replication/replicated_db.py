@@ -353,13 +353,13 @@ class ReplicatedDB:
         """Executor-side ordered apply of one response's updates."""
         now = now_ms()
         total_bytes = 0
+        # Sequence-continuity guard: applying out of order would shift the
+        # local numbering below the leader's and silently diverge (re-fetch
+        # + double-apply). One storage-lock read, then track incrementally.
+        expected = self.wrapper.latest_sequence_number() + 1
         for u in updates:
             raw = bytes(u["raw_data"])
             ts = u.get("timestamp")
-            # Sequence-continuity guard: applying out of order would shift
-            # the local numbering below the leader's and silently diverge
-            # (re-fetch + double-apply). Abort the response instead.
-            expected = self.wrapper.latest_sequence_number() + 1
             got = int(u.get("seq_no", expected))
             if got != expected:
                 raise ValueError(
@@ -367,6 +367,7 @@ class ReplicatedDB:
                     f"{expected}, got {got} — rebuild required"
                 )
             self.wrapper.handle_replicate_response(raw, ts)
+            expected += int(u.get("count") or decode_batch(raw).count())
             total_bytes += len(raw)
             if ts is not None:
                 self._stats.add_metric(M["replication_lag_ms"], max(0, now - ts))

@@ -42,10 +42,18 @@ class Host:
     ip: str
     port: int
     az: str
+    # Replication-plane port. The reference runs its replicator on a fixed
+    # port (9091) next to the service port (9090); here the convention is
+    # service port + 1 unless the shard map's 4th host-key field overrides.
+    repl_port: int = 0
 
     @property
     def addr(self) -> Tuple[str, int]:
         return (self.ip, self.port)
+
+    @property
+    def repl_addr(self) -> Tuple[str, int]:
+        return (self.ip, self.repl_port or self.port + 1)
 
 
 @dataclass
@@ -79,7 +87,8 @@ class ClusterLayout:
                     raise ValueError(f"bad host key: {key!r}")
                 ip, port = parts[0], int(parts[1])
                 az = parts[2] if len(parts) > 2 else ""
-                host = Host(ip, port, az)
+                repl_port = int(parts[3]) if len(parts) > 3 else 0
+                host = Host(ip, port, az, repl_port)
                 for shard_spec in value:
                     shard_str, _, role_str = str(shard_spec).partition(":")
                     shard = int(shard_str)

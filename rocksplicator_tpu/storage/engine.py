@@ -25,6 +25,7 @@ import os
 import shutil
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -111,8 +112,13 @@ class DB:
             self._persisted_seq = manifest["persisted_seq"]
             self._next_file_id = manifest["next_file_id"]
             self._levels = [list(files) for files in manifest["levels"]]
+            self._incarnation = manifest.get("incarnation", "00000000")
         else:
             self._levels = [[] for _ in range(self.options.num_levels)]
+            # Unique per DB creation: file names can never collide across a
+            # destroy+recreate, so name-based incremental backup skipping is
+            # safe (a recreated db's sst-...-00000001 is a different name).
+            self._incarnation = uuid.uuid4().hex[:8]
             self._persist_manifest()
         while len(self._levels) < self.options.num_levels:
             self._levels.append([])
@@ -143,6 +149,7 @@ class DB:
             "persisted_seq": self._persisted_seq,
             "next_file_id": self._next_file_id,
             "levels": self._levels,
+            "incarnation": self._incarnation,
         }
 
     def _persist_manifest(self, target_dir: Optional[str] = None) -> None:
@@ -368,7 +375,7 @@ class DB:
             self._compact_level0_locked()
 
     def _new_file_name(self) -> str:
-        name = f"sst-{self._next_file_id:08d}.tsst"
+        name = f"sst-{self._incarnation}-{self._next_file_id:08d}.tsst"
         self._next_file_id += 1
         return name
 

@@ -415,3 +415,95 @@ def test_backup_manager_incremental(nodes, tmp_path, call):
     with DB(str(tmp_path / "r1")) as restored:
         assert restored.get(b"more") == b"x"
         assert restored.latest_sequence_number() == dbmeta["seq"] == 11
+
+
+# ---------------------------------------------------------------------------
+# regression tests from code review (round 2)
+# ---------------------------------------------------------------------------
+
+
+def test_backup_after_clear_not_corrupted_by_name_collision(nodes, call, tmp_path):
+    """clearDB resets file ids; incremental backup must not skip the new
+    same-numbered SST (fixed by per-creation incarnation ids)."""
+    n = nodes("a")
+    store_uri = str(tmp_path / "bucket")
+    call(n, "add_db", db_name="seg00001", role="LEADER")
+    app = n.handler.db_manager.get_db("seg00001")
+    app.write(WriteBatch().put(b"old", b"1"))
+    call(n, "backup_db_to_s3", db_name="seg00001",
+         s3_bucket=store_uri, s3_backup_dir="b/seg00001")
+    call(n, "clear_db", db_name="seg00001")  # fresh incarnation
+    app2 = n.handler.db_manager.get_db("seg00001")
+    app2.write(WriteBatch().put(b"new", b"2"))
+    call(n, "backup_db_to_s3", db_name="seg00001",
+         s3_bucket=store_uri, s3_backup_dir="b/seg00001")
+    call(n, "clear_db", db_name="seg00001", reopen_db=False)
+    call(n, "restore_db_from_s3", db_name="seg00001",
+         s3_bucket=store_uri, s3_backup_dir="b/seg00001")
+    restored = n.handler.db_manager.get_db("seg00001")
+    assert restored.get(b"new") == b"2"
+    assert restored.get(b"old") is None  # no stale pre-clear data
+
+
+def test_cdc_publisher_failure_is_at_least_once(nodes, call):
+    a = nodes("a")
+    call(a, "add_db", db_name="seg00001", role="LEADER")
+    adb = a.handler.db_manager.get_db("seg00001")
+
+    failures = [2]  # fail the first two publish attempts
+    published = []
+
+    def flaky_publisher(db_name, start_seq, raw, ts):
+        if failures[0] > 0:
+            failures[0] -= 1
+            raise RuntimeError("broker down")
+        published.append((start_seq, raw))
+
+    cdc_node = nodes("cdc")
+    cdc = CdcAdminHandler(cdc_node.replicator, flaky_publisher)
+    ioloop = cdc_node.replicator.ioloop
+    import asyncio
+
+    fut = ioloop.run_coro(cdc.handle_add_observer(
+        db_name="seg00001", upstream_ip=a.repl_addr[0],
+        upstream_port=a.repl_addr[1]))
+    fut.result(10)
+    adb.write(WriteBatch().put(b"k", b"v"))
+    # the batch must eventually be published despite the two failures
+    assert wait_until(lambda: len(published) == 1, timeout=20)
+    assert published[0][0] == 1
+
+
+def test_concurrent_duplicate_add_observer_typed_error(nodes, monkeypatch):
+    a = nodes("a")
+    cdc = CdcAdminHandler(a.replicator, MemoryPublisher())
+    ioloop = a.replicator.ioloop
+    import asyncio
+
+    real = CdcAdminHandler._do_add_observer
+
+    async def slow(self, *args, **kw):
+        await asyncio.sleep(0.5)  # hold the first call in flight
+        return await real(self, *args, **kw)
+
+    monkeypatch.setattr(CdcAdminHandler, "_do_add_observer", slow)
+
+    async def both():
+        t1 = asyncio.ensure_future(cdc.handle_add_observer(
+            db_name="segX", upstream_ip="127.0.0.1", upstream_port=1))
+        await asyncio.sleep(0.05)
+        try:
+            await cdc.handle_add_observer(
+                db_name="segX", upstream_ip="127.0.0.1", upstream_port=1)
+            code = None
+        except RpcApplicationError as e:
+            code = e.code
+        t1.cancel()
+        try:
+            await t1
+        except (asyncio.CancelledError, Exception):
+            pass
+        return code
+
+    code = ioloop.run_coro(both()).result(10)
+    assert code == "OBSERVER_ALREADY_EXISTS"

@@ -1,0 +1,208 @@
+"""End-to-end counter_service tests — the minimum end-to-end slice
+(SURVEY §7 stage 4 / BASELINE config 1: counter_service, 1 shard,
+1 replica, int64 counters, async replication)."""
+
+import json
+import struct
+import time
+
+import pytest
+
+from examples.counter_service.counter_service import (
+    CounterHandler,
+    create_dbs_from_shard_map,
+)
+from examples.counter_service.options import counter_options_generator
+from rocksplicator_tpu.replication import ReplicationFlags, Replicator
+from rocksplicator_tpu.rpc import (
+    ClusterLayout,
+    IoLoop,
+    RpcApplicationError,
+    RpcClientPool,
+    RpcRouter,
+    RpcServer,
+)
+
+FAST = ReplicationFlags(
+    server_long_poll_ms=400, pull_error_delay_min_ms=50, pull_error_delay_max_ms=120
+)
+
+
+class CounterNode:
+    def __init__(self, tmp_path, name, shard_map_builder):
+        self.replicator = Replicator(port=0, flags=FAST)
+        self.router = RpcRouter(local_az="az1")
+        self.handler = CounterHandler(
+            str(tmp_path / name), self.replicator,
+            options_generator=counter_options_generator,
+            router=self.router,
+        )
+        self.server = RpcServer(port=0, ioloop=self.replicator.ioloop)
+        self.server.add_handler(self.handler)
+        self.server.start()
+        self._shard_map_builder = shard_map_builder
+
+    @property
+    def repl_addr(self):
+        return ("127.0.0.1", self.replicator.port)
+
+    @property
+    def port(self):
+        return self.server.port
+
+    def load_shard_map(self, shard_map: dict):
+        self.router.update_layout(
+            ClusterLayout.parse(json.dumps(shard_map).encode())
+        )
+
+    def create_dbs(self):
+        # identity is the SERVICE address; replication uses Host.repl_addr
+        return create_dbs_from_shard_map(
+            self.handler, self.router, ("127.0.0.1", self.port)
+        )
+
+    def stop(self):
+        self.server.stop()
+        self.handler.close()
+        self.replicator.stop()
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """Two-node cluster, 2 shards: node A leads shard 0, node B leads
+    shard 1, each follows the other (the reference's standard layout)."""
+    a = CounterNode(tmp_path, "a", None)
+    b = CounterNode(tmp_path, "b", None)
+    # One map, reference-style: service port + explicit replication port
+    # (4th host-key field; production uses the port+1 convention instead).
+    shard_map = {
+        "counter": {
+            "num_shards": 2,
+            f"127.0.0.1:{a.port}:az1:{a.replicator.port}": ["00000:M", "00001:S"],
+            f"127.0.0.1:{b.port}:az1:{b.replicator.port}": ["00000:S", "00001:M"],
+        }
+    }
+    a.load_shard_map(shard_map)
+    b.load_shard_map(shard_map)
+    assert a.create_dbs() == 2
+    assert b.create_dbs() == 2
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+@pytest.fixture()
+def call():
+    ioloop = IoLoop.default()
+    pool = RpcClientPool()
+
+    def do(port, method, **args):
+        async def go():
+            return await pool.call("127.0.0.1", port, method, args, timeout=30)
+
+        return ioloop.run_sync(go())
+
+    yield do
+    ioloop.run_sync(pool.close())
+
+
+def wait_until(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _owner(a, b, name, call):
+    """Which node leads this counter's shard?"""
+    shard = a.handler.router.shard_for(name)
+    return (a, b) if shard == 0 else (b, a)
+
+
+def test_set_get_bump_on_leader(cluster, call):
+    a, b = cluster
+    leader, follower = _owner(a, b, "visits", call)
+    call(leader.port, "set_counter", counter_name="visits", counter_value=42)
+    assert call(leader.port, "get_counter", counter_name="visits")[
+        "counter_value"] == 42
+    for _ in range(8):
+        call(leader.port, "bump_counter", counter_name="visits", delta=10)
+    assert call(leader.port, "get_counter", counter_name="visits")[
+        "counter_value"] == 122
+
+
+def test_replication_to_follower_and_read_from_follower(cluster, call):
+    a, b = cluster
+    leader, follower = _owner(a, b, "hits", call)
+    call(leader.port, "bump_counter", counter_name="hits", delta=7)
+    # follower serves (possibly stale) reads locally once replicated
+    assert wait_until(
+        lambda: call(follower.port, "get_counter", counter_name="hits")[
+            "counter_value"] == 7
+    )
+
+
+def test_need_routing_forwards_writes_to_leader(cluster, call):
+    a, b = cluster
+    leader, follower = _owner(a, b, "routed", call)
+    # write sent to the WRONG node, with need_routing: forwarded to leader
+    call(follower.port, "bump_counter", counter_name="routed", delta=5,
+         need_routing=True)
+    assert call(leader.port, "get_counter", counter_name="routed")[
+        "counter_value"] == 5
+    # without need_routing the follower rejects the write
+    with pytest.raises(RpcApplicationError) as ei:
+        call(follower.port, "bump_counter", counter_name="routed", delta=5)
+    assert ei.value.code == "NOT_LEADER"
+
+
+def test_counter_admin_rpcs_available(cluster, call):
+    """Counter extends Admin: admin RPCs work on the same port."""
+    a, b = cluster
+    assert call(a.port, "ping")["ok"] is True
+    shard0_db = "counter00000"
+    seq = call(a.port, "get_sequence_number", db_name=shard0_db)
+    assert "seq_num" in seq
+
+
+def test_baseline_config1_one_shard_counters(tmp_path, call):
+    """BASELINE config 1 shape: 1 shard, 1 replica, int64 counters, async
+    replication, small scale for CI (bench.py runs the 1M version)."""
+    node = CounterNode(tmp_path, "solo", None)
+    try:
+        shard_map = {
+            "counter": {
+                "num_shards": 1,
+                f"127.0.0.1:{node.port}:az1:{node.replicator.port}": ["00000:M"],
+            }
+        }
+        node.load_shard_map(shard_map)
+        assert node.create_dbs() == 1
+        n = 500
+        t0 = time.monotonic()
+        for i in range(n):
+            call(node.port, "bump_counter",
+                 counter_name=f"c{i % 50}", delta=1)
+        elapsed = time.monotonic() - t0
+        total = sum(
+            call(node.port, "get_counter", counter_name=f"c{j}")["counter_value"]
+            for j in range(50)
+        )
+        assert total == n
+        # sanity throughput print for the record
+        print(f"config1 small: {n / elapsed:.0f} qps")
+    finally:
+        node.stop()
+
+
+def test_stress_tool_runs(cluster):
+    from examples.counter_service import stress_test
+
+    a, b = cluster
+    rc = stress_test.main([
+        "--host", "127.0.0.1", "--port", str(a.port),
+        "--threads", "2", "--requests", "50", "--counters", "10",
+    ])
+    assert rc == 0
