@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Headline benchmark: shard-batched TPU compaction throughput vs CPU.
+
+Models BASELINE config ladder steps 1-3 in miniature: S shards of counter
+workload (PUT/MERGE/DELETE mix) run the fused merge-resolve + bloom
+pipeline. The TPU number is the vmapped single-launch pipeline; the CPU
+baseline is the best of (vectorized numpy lexsort+reduceat, pure-Python
+heap-merge extrapolated) on the identical workload.
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+Diagnostics go to stderr.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+SHARDS = int(os.environ.get("BENCH_SHARDS", "8"))
+ENTRIES = int(os.environ.get("BENCH_ENTRIES", str(1 << 17)))
+ITERS = int(os.environ.get("BENCH_ITERS", "10"))
+KEY_BYTES = 16
+VAL_BYTES = 8
+# what a CPU compaction would read per entry in the SST encoding:
+# u32 klen + key + u64 seq + u8 vtype + u32 vlen + value
+ENTRY_BYTES = 4 + KEY_BYTES + 8 + 1 + 4 + VAL_BYTES
+
+
+def build_inputs():
+    from rocksplicator_tpu.models.compaction_model import synth_counter_batch
+
+    shards = []
+    for s in range(SHARDS):
+        shards.append(synth_counter_batch(
+            ENTRIES, key_space=ENTRIES // 8, seed=1234 + s,
+            key_bytes=KEY_BYTES,
+        ))
+    stacked = {
+        k: np.stack([b[k] for b in shards]) for k in shards[0]
+    }
+    return stacked
+
+
+def bench_tpu(stacked):
+    import jax
+    import jax.numpy as jnp
+
+    from rocksplicator_tpu.models import CompactionModel
+
+    model = CompactionModel(capacity=ENTRIES)
+    fwd = jax.jit(jax.vmap(model.forward))
+    log(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}")
+    dev = {k: jnp.asarray(v) for k, v in stacked.items()}
+    args = (
+        dev["key_words_be"], dev["key_words_le"], dev["key_len"],
+        dev["seq_hi"], dev["seq_lo"], dev["vtype"], dev["val_words"],
+        dev["val_len"], dev["valid"],
+    )
+    t0 = time.monotonic()
+    out = fwd(*args)
+    jax.block_until_ready(out)
+    log(f"tpu compile+first run: {time.monotonic() - t0:.1f}s, "
+        f"counts={np.asarray(out['count'])[:4]}...")
+    # steady state
+    t0 = time.monotonic()
+    for _ in range(ITERS):
+        out = fwd(*args)
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / ITERS
+    total_bytes = SHARDS * ENTRIES * ENTRY_BYTES
+    gbps = total_bytes / dt / 1e9
+    log(f"tpu: {dt * 1e3:.1f} ms/iter over {total_bytes / 1e6:.0f} MB "
+        f"=> {gbps:.2f} GB/s")
+
+    # transfer-inclusive variant (fresh H2D each iteration)
+    t0 = time.monotonic()
+    for _ in range(max(1, ITERS // 3)):
+        dev2 = {k: jnp.asarray(v) for k, v in stacked.items()}
+        out = fwd(
+            dev2["key_words_be"], dev2["key_words_le"], dev2["key_len"],
+            dev2["seq_hi"], dev2["seq_lo"], dev2["vtype"],
+            dev2["val_words"], dev2["val_len"], dev2["valid"],
+        )
+        jax.block_until_ready(out)
+    dt_x = (time.monotonic() - t0) / max(1, ITERS // 3)
+    log(f"tpu transfer-inclusive: {dt_x * 1e3:.1f} ms/iter "
+        f"=> {total_bytes / dt_x / 1e9:.2f} GB/s")
+    return gbps
+
+
+def bench_numpy(stacked):
+    from rocksplicator_tpu.ops.kv_format import KVBatch
+    from rocksplicator_tpu.tpu.backend import numpy_merge_resolve
+    from rocksplicator_tpu.storage.bloom import BloomFilter, num_words_for
+
+    def one_pass():
+        total = 0
+        for s in range(SHARDS):
+            batch = KVBatch(
+                key_words_be=stacked["key_words_be"][s],
+                key_words_le=stacked["key_words_le"][s],
+                key_len=stacked["key_len"][s],
+                seq_hi=stacked["seq_hi"][s],
+                seq_lo=stacked["seq_lo"][s],
+                vtype=stacked["vtype"][s],
+                val_words=stacked["val_words"][s],
+                val_len=stacked["val_len"][s],
+                valid=stacked["valid"][s],
+                val_bytes=VAL_BYTES,
+            )
+            arrays, count = numpy_merge_resolve(
+                batch, uint64_add=True, drop_tombstones=True
+            )
+            # bloom build is part of the compaction job on CPU too
+            bf = BloomFilter(num_words_for(count or 1, 10))
+            kw = arrays[0]
+            kl = arrays[1]
+            kb = (
+                np.ascontiguousarray(kw.astype(">u4"))
+                .view(np.uint8).reshape(len(kw), 24)
+            )
+            for i in range(count):
+                bf.add(kb[i, : kl[i]].tobytes())
+            total += count
+        return total
+
+    t0 = time.monotonic()
+    total = one_pass()
+    dt = time.monotonic() - t0
+    total_bytes = SHARDS * ENTRIES * ENTRY_BYTES
+    gbps = total_bytes / dt / 1e9
+    log(f"numpy cpu: {dt * 1e3:.0f} ms/pass (out={total}) => {gbps:.3f} GB/s")
+    return gbps
+
+
+def bench_python(stacked):
+    """Reference-style interpreter heap-merge, extrapolated from a sample."""
+    from rocksplicator_tpu.ops.kv_format import KVBatch, unpack_entries
+    from rocksplicator_tpu.storage.compaction import CpuCompactionBackend
+    from rocksplicator_tpu.storage.merge import UInt64AddOperator
+
+    sample = max(1, ENTRIES // 32)
+    kb = (
+        np.ascontiguousarray(stacked["key_words_be"][0][:sample].astype(">u4"))
+        .view(np.uint8).reshape(sample, 24)
+    )
+    seqs = (stacked["seq_hi"][0][:sample].astype(np.uint64) << np.uint64(32)) | \
+        stacked["seq_lo"][0][:sample].astype(np.uint64)
+    vb = (
+        np.ascontiguousarray(stacked["val_words"][0][:sample].astype("<u4"))
+        .view(np.uint8).reshape(sample, VAL_BYTES)
+    )
+    entries = []
+    for i in range(sample):
+        entries.append((
+            kb[i, :KEY_BYTES].tobytes(), int(seqs[i]),
+            int(stacked["vtype"][0][i]),
+            vb[i].tobytes() if stacked["vtype"][0][i] != 2 else b"",
+        ))
+    entries.sort(key=lambda e: (e[0], -e[1]))
+    t0 = time.monotonic()
+    out = list(CpuCompactionBackend().merge_runs(
+        [entries], UInt64AddOperator(), True
+    ))
+    dt = time.monotonic() - t0
+    gbps = sample * ENTRY_BYTES / dt / 1e9
+    log(f"python cpu (heapq, {sample} sample): {dt * 1e3:.0f} ms "
+        f"=> {gbps:.3f} GB/s")
+    return gbps
+
+
+def main():
+    log(f"bench config: shards={SHARDS} entries/shard={ENTRIES} iters={ITERS}")
+    stacked = build_inputs()
+    tpu_gbps = bench_tpu(stacked)
+    numpy_gbps = bench_numpy(stacked)
+    py_gbps = bench_python(stacked)
+    baseline = max(numpy_gbps, py_gbps)
+    result = {
+        "metric": "shard_batched_compaction_throughput",
+        "value": round(tpu_gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(tpu_gbps / baseline, 2) if baseline > 0 else 0.0,
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Flagship 'model': the compaction pipeline as a jittable forward step."""
+
+from .compaction_model import CompactionModel, synth_counter_batch
+
+__all__ = ["CompactionModel", "synth_counter_batch"]

@@ -1,0 +1,16 @@
+"""JAX ops for the TPU compaction pipeline.
+
+The hot ops of the north star (BASELINE.json): k-way merge-sort with LSM
+resolution, bloom bitmap construction, and block encoding — expressed as
+fixed-shape array programs that XLA tiles onto the TPU (sorts/segment ops
+on the VPU, bulk data movement on HBM-friendly layouts).
+"""
+
+from .kv_format import KVBatch, KEY_WORDS, pack_entries, unpack_entries
+from .compaction_kernel import merge_resolve_kernel, MergeKind
+from .bloom_tpu import bloom_build_tpu
+
+__all__ = [
+    "KVBatch", "KEY_WORDS", "pack_entries", "unpack_entries",
+    "merge_resolve_kernel", "MergeKind", "bloom_build_tpu",
+]

@@ -1,0 +1,101 @@
+"""TPU bloom-filter construction — byte-identical to storage/bloom.py.
+
+The register-blocked bloom (one 32-bit word per key, K bits from 5-bit
+slices of a second hash) was designed for exactly this kernel: the FNV fold
++ murmur finalizer are pure u32 lane ops.
+
+TPU design note: scatter-OR does not exist and per-bit plane scatters are
+slow, so the bitmap materializes scatter-free except for one final store:
+sort keys by word index, compute each word's OR via bit-plane prefix-sum
+differences over the sorted segments, then ONE scatter of (identical-
+per-segment) word values. Sorts + scans + a single scatter — the same
+op-diet as the merge kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..storage.bloom import K_BITS, _FNV_OFFSET, _FNV_PRIME, _H2_MUL
+from .kv_format import KEY_WORDS
+
+_U32 = jnp.uint32
+
+
+def _avalanche(h: jnp.ndarray) -> jnp.ndarray:
+    h = h ^ (h >> 16)
+    h = h * _U32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * _U32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def bloom_hash_pair(
+    key_words_le: jnp.ndarray, key_len: jnp.ndarray
+) -> tuple:
+    """(h1, h2) per row — vectorized hash_pair (storage/bloom.py)."""
+    h = jnp.full(key_len.shape, _U32(_FNV_OFFSET))
+    for w in range(KEY_WORDS):
+        h = (h ^ key_words_le[:, w]) * _U32(_FNV_PRIME)
+    h = (h ^ key_len.astype(_U32)) * _U32(_FNV_PRIME)
+    h1 = _avalanche(h)
+    h2 = _avalanche(h * _U32(_H2_MUL) + _U32(1))
+    return h1, h2
+
+
+def bloom_word_mask(
+    key_words_le: jnp.ndarray, key_len: jnp.ndarray, num_words: int
+) -> tuple:
+    """(word_idx, 32-bit mask) per row — vectorized word_mask()."""
+    h1, h2 = bloom_hash_pair(key_words_le, key_len)
+    mask = jnp.zeros_like(h2)
+    for j in range(K_BITS):
+        mask = mask | (_U32(1) << ((h2 >> _U32(5 * j)) & _U32(31)))
+    return (h1 % _U32(num_words)).astype(jnp.int32), mask
+
+
+@functools.partial(jax.jit, static_argnames=("num_words",))
+def bloom_build_tpu(
+    key_words_le: jnp.ndarray,  # (N, 6) u32
+    key_len: jnp.ndarray,       # (N,) u32
+    valid: jnp.ndarray,         # (N,) bool
+    *,
+    num_words: int,
+) -> jnp.ndarray:
+    """Returns the (num_words,) u32 bloom bitmap."""
+    n = key_len.shape[0]
+    word_idx, mask = bloom_word_mask(key_words_le, key_len, num_words)
+    word_idx = jnp.where(valid, word_idx, num_words)  # invalid -> spill word
+    # group rows by word: 2-operand sort
+    sorted_idx, sorted_mask = lax.sort(
+        (word_idx.astype(jnp.uint32), mask), num_keys=1, is_stable=False
+    )
+    sorted_idx = sorted_idx.astype(jnp.int32)
+    iota = lax.iota(jnp.int32, n)
+    new_word = jnp.concatenate(
+        [jnp.ones(1, bool), sorted_idx[1:] != sorted_idx[:-1]]
+    )
+    last_word = jnp.concatenate([new_word[1:], jnp.ones(1, bool)])
+    seg_start = lax.cummax(jnp.where(new_word, iota, 0))
+    seg_end = jnp.flip(lax.cummin(jnp.flip(jnp.where(last_word, iota, n - 1))))
+    # per-word OR via bit-plane prefix sums
+    bits = ((sorted_mask[:, None] >> jnp.arange(32, dtype=_U32)[None, :])
+            & _U32(1)).astype(jnp.int32)
+    csum = jnp.cumsum(bits, axis=0)
+    seg_or = (
+        jnp.take(csum, seg_end, axis=0)
+        - (jnp.take(csum, seg_start, axis=0) - jnp.take(bits, seg_start, axis=0))
+    ) > 0
+    word_val = jnp.sum(
+        seg_or.astype(_U32) << jnp.arange(32, dtype=_U32)[None, :],
+        axis=1, dtype=_U32,
+    )
+    # every row of a segment writes the same value -> single scatter
+    bitmap = jnp.zeros(num_words + 1, dtype=_U32)
+    bitmap = bitmap.at[sorted_idx].set(word_val, mode="drop")
+    return bitmap[:num_words]

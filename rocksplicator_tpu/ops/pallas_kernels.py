@@ -1,0 +1,73 @@
+"""Pallas TPU kernels for the compaction pipeline hot ops.
+
+The bloom hash (7-word FNV fold + murmur finalizer per key) is pure VPU
+lane arithmetic — an ideal Pallas kernel: keys arrive as an (8, N) u32
+panel (6 prefix words + length + padding row) so the sublane dimension is
+exactly one tile and N rides the 128-wide lanes.
+
+The lax implementation in bloom_tpu.py remains the default (XLA fuses it
+into the surrounding pipeline); this kernel is the explicit-VMEM variant,
+kept byte-identical and selected via ``use_pallas=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..storage.bloom import _FNV_OFFSET, _FNV_PRIME, _H2_MUL
+
+_U32 = jnp.uint32
+_LANES = 512  # block width (multiple of 128)
+
+
+def _avalanche(h):
+    h = h ^ (h >> 16)
+    h = h * _U32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * _U32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _bloom_hash_kernel(panel_ref, out_ref):
+    """panel_ref: (8, L) u32 — rows 0..5 key words (LE), row 6 key length.
+    out_ref: (8, L) u32 — row 0 = h1, row 1 = h2."""
+    h = jnp.full((panel_ref.shape[1],), _U32(_FNV_OFFSET))
+    for w in range(6):
+        h = (h ^ panel_ref[w, :]) * _U32(_FNV_PRIME)
+    h = (h ^ panel_ref[6, :]) * _U32(_FNV_PRIME)
+    h1 = _avalanche(h)
+    h2 = _avalanche(h * _U32(_H2_MUL) + _U32(1))
+    out_ref[0, :] = h1
+    out_ref[1, :] = h2
+    # rows 2..7 are padding; leave them zeroed
+    for r in range(2, 8):
+        out_ref[r, :] = jnp.zeros_like(h1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def bloom_hash_pallas(
+    key_words_le: jnp.ndarray,  # (N, 6) u32
+    key_len: jnp.ndarray,       # (N,) u32
+    interpret: bool = False,
+) -> tuple:
+    """(h1, h2) per key via the Pallas kernel. ``interpret=True`` runs the
+    kernel in interpreter mode (CPU tests)."""
+    n = key_len.shape[0]
+    padded = ((n + _LANES - 1) // _LANES) * _LANES
+    panel = jnp.zeros((8, padded), dtype=_U32)
+    panel = panel.at[:6, :n].set(key_words_le.T.astype(_U32))
+    panel = panel.at[6, :n].set(key_len.astype(_U32))
+    out = pl.pallas_call(
+        _bloom_hash_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, padded), _U32),
+        grid=(padded // _LANES,),
+        in_specs=[pl.BlockSpec((8, _LANES), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((8, _LANES), lambda i: (0, i)),
+        interpret=interpret,
+    )(panel)
+    return out[0, :n], out[1, :n]

@@ -1,0 +1,11 @@
+"""Mesh-sharded execution of the compaction pipeline.
+
+The scaling dimensions (SURVEY §2.6 mapping): the "shard" mesh axis is the
+DP-analog (independent shards compact in parallel) and the "block" axis is
+the SP-analog (one shard's entry stream split blockwise across devices,
+merged with collectives).
+"""
+
+from .mesh import make_mesh, sharded_compaction_step
+
+__all__ = ["make_mesh", "sharded_compaction_step"]

@@ -1,0 +1,171 @@
+"""Device-mesh sharding for batched compaction.
+
+Mesh axes:
+- ``shard``: independent shards (DP-analog) — no communication.
+- ``block``: blockwise split of one shard's entries (SP-analog) — each
+  device merges its block locally, then an ``all_gather`` over the block
+  axis assembles the shard's blocks for the final merge, and a ``psum``
+  over the shard axis produces global job stats. Collectives ride ICI on
+  real hardware; the same program runs on a virtual CPU mesh in tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+def make_mesh(num_devices: Optional[int] = None,
+              axis_names: Tuple[str, str] = ("shard", "block")):
+    """2D mesh over the first ``num_devices`` devices: block axis of 2 when
+    the device count is even (so both collectives are exercised), else 1."""
+    import jax
+
+    devices = jax.devices()
+    n = num_devices or len(devices)
+    devices = devices[:n]
+    block = 2 if n % 2 == 0 and n >= 2 else 1
+    shard = n // block
+    arr = np.array(devices).reshape(shard, block)
+    return jax.sharding.Mesh(arr, axis_names)
+
+
+def sharded_compaction_step(mesh, model=None):
+    """Returns a jitted step over (S, B, N, ...) arrays: S sharded on the
+    ``shard`` axis, B on the ``block`` axis.
+
+    Per (shard, block) tile: local merge-resolve. Then all_gather along
+    ``block`` to assemble the shard's blocks, a second merge-resolve over
+    the concatenation (entries per block stay sorted, so this is the
+    SP merge step), bloom build, and a psum'd global stats reduction.
+    Output: final merged arrays per shard (replicated over ``block``),
+    bloom words, per-shard counts, and the global count.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..models.compaction_model import CompactionModel
+    from ..ops.bloom_tpu import bloom_build_tpu
+    from ..ops.compaction_kernel import merge_resolve_kernel
+
+    model = model or CompactionModel()
+    merge_kind = model.merge_kind
+
+    def local_step(kwbe, kwle, klen, shi, slo, vt, vw, vl, valid):
+        # local shapes: (s, 1, N, ...) — one block column per device
+        s, b, n = klen.shape
+        squeeze = lambda a: a.reshape((s * b, n) + a.shape[3:])
+
+        def run(args, drop):
+            return merge_resolve_kernel(
+                *args, merge_kind=merge_kind, drop_tombstones=drop
+            )
+
+        # 1) block-local merge (keep tombstones: blocks are partial views)
+        local = jax.vmap(lambda *a: run(a, False))(
+            squeeze(kwbe), squeeze(kwle), squeeze(klen), squeeze(shi),
+            squeeze(slo), squeeze(vt), squeeze(vw), squeeze(vl),
+            squeeze(valid),
+        )
+        # 2) assemble the shard's blocks: all_gather over the block axis
+        gathered = {
+            k: jax.lax.all_gather(v, "block", axis=1)
+            for k, v in local.items()
+        }
+        nb = gathered["key_len"].shape[1]
+        flat = {
+            k: v.reshape((s, nb * n) + v.shape[3:])
+            for k, v in gathered.items()
+            if k != "count"
+        }
+        # rows beyond each block's count are zero-filled by the scatter —
+        # mark them invalid for the final merge
+        per_block_counts = gathered["count"]  # (s, nb)
+        row_block = jnp.arange(nb * n) // n
+        row_in_block = jnp.arange(nb * n) % n
+        valid2 = row_in_block[None, :] < per_block_counts[:, row_block]
+        # 3) final merge per shard + bloom + stats
+        final = jax.vmap(
+            lambda *a: merge_resolve_kernel(
+                *a, merge_kind=merge_kind,
+                drop_tombstones=model.drop_tombstones,
+            )
+        )(
+            flat["key_words_be"], flat["key_words_le"], flat["key_len"],
+            flat["seq_hi"], flat["seq_lo"], flat["vtype"],
+            flat["val_words"], flat["val_len"], valid2,
+        )
+        out_valid = (
+            jnp.arange(nb * n)[None, :] < final["count"][:, None]
+        )
+        bloom = jax.vmap(
+            lambda kw, kl, v: bloom_build_tpu(
+                kw, kl, v, num_words=model.num_bloom_words
+            )
+        )(final["key_words_le"], final["key_len"], out_valid)
+        global_count = jax.lax.psum(final["count"].sum(), "shard")
+        # re-insert the block axis (replicated) for out_specs
+        expand = lambda a: a[:, None]
+        return (
+            {k: expand(v) for k, v in final.items() if k != "count"},
+            expand(bloom),
+            expand(final["count"]),
+            global_count[None, None],
+        )
+
+    in_spec = P("shard", "block")
+    step = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(in_spec,) * 9,
+        out_specs=(
+            {k: P("shard", None) for k in (
+                "key_words_be", "key_words_le", "key_len", "seq_hi",
+                "seq_lo", "vtype", "val_words", "val_len",
+            )},
+            P("shard", None),
+            P("shard", None),
+            P(None, None),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(step)
+
+
+def make_sharded_inputs(mesh, shards_per_device: int = 1,
+                        entries_per_block: int = 256, model=None,
+                        seed: int = 0) -> Dict[str, np.ndarray]:
+    """Synthetic (S, B, N, ...) inputs laid out for the mesh."""
+    from ..models.compaction_model import synth_counter_batch
+
+    shard_n = mesh.shape["shard"] * shards_per_device
+    block_n = mesh.shape["block"]
+    n = entries_per_block
+    arrays = None
+    for s in range(shard_n):
+        for b in range(block_n):
+            batch = synth_counter_batch(
+                n, seed=seed + s * 131 + b,
+                start_seq=1 + b * n,
+            )
+            if arrays is None:
+                arrays = {
+                    k: np.zeros((shard_n, block_n) + v.shape, v.dtype)
+                    for k, v in batch.items()
+                }
+            for k, v in batch.items():
+                arrays[k][s, b] = v
+    return arrays
+
+
+def shard_inputs_on_mesh(mesh, arrays: Dict[str, np.ndarray]):
+    """device_put with PartitionSpec("shard", "block") on the leading dims."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("shard", "block"))
+    return {k: jax.device_put(v, sharding) for k, v in arrays.items()}

@@ -1,0 +1,13 @@
+"""TPU offload backend (the new part — BASELINE.json north star).
+
+``TpuCompactionBackend`` plugs into the storage engine's
+CompactionBackend seam; ``TpuCompactionService`` batches shard-level
+compaction/ingest jobs across a device mesh.
+"""
+
+from .backend import TpuCompactionBackend, NumpyCompactionBackend
+from .compaction_service import TpuCompactionService
+
+__all__ = [
+    "TpuCompactionBackend", "NumpyCompactionBackend", "TpuCompactionService",
+]

@@ -1,0 +1,148 @@
+"""TpuCompactionService: shard-batched compaction jobs on the device.
+
+North star (BASELINE.json): "a TpuCompactionService is registered by
+ApplicationDBManager so that L0→Ln compaction jobs and load_sst ingests
+ship their key-value blocks to a TPU sidecar, where kernels run k-way
+merge-sort, bloom construction, and block encoding as batched ops over
+shards."
+
+Two integration levels:
+- ``install_on_options(options)`` — per-DB: plugs a TpuCompactionBackend
+  into the engine's CompactionBackend seam (compact_range / L0→L1 jobs).
+- ``compact_shard_batch(batches)`` — job-level: many shards' runs compact
+  in ONE vmapped kernel launch (the 1000-shard load_sst path), each shard
+  padded to a common capacity; returns per-shard merged entries + bloom
+  words + counts.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..storage.bloom import num_words_for
+from ..storage.engine import DBOptions
+from ..ops.bloom_tpu import bloom_build_tpu
+from ..ops.compaction_kernel import MergeKind, merge_resolve_kernel
+from ..ops.kv_format import KVBatch, unpack_entries
+from .backend import TpuCompactionBackend, _next_pow2
+
+log = logging.getLogger(__name__)
+
+
+class TpuCompactionService:
+    _instance: Optional["TpuCompactionService"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, bits_per_key: int = 10):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax = jax
+        self._jnp = jnp
+        self._bits_per_key = bits_per_key
+        self._vmapped_cache: Dict[tuple, object] = {}
+
+    @classmethod
+    def instance(cls) -> "TpuCompactionService":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    # ------------------------------------------------------------------
+    # per-DB integration (engine CompactionBackend seam)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def install_on_options(options: DBOptions) -> DBOptions:
+        """Route this DB's compactions through the TPU backend."""
+        options.compaction_backend = TpuCompactionBackend()
+        return options
+
+    # ------------------------------------------------------------------
+    # job-level batched API (the load_sst / compaction-storm path)
+    # ------------------------------------------------------------------
+
+    def _pipeline(self, merge_kind: MergeKind, drop_tombstones: bool,
+                  num_words: int):
+        key = (merge_kind, drop_tombstones, num_words)
+        fn = self._vmapped_cache.get(key)
+        if fn is None:
+            jax = self._jax
+
+            def one_shard(kwbe, kwle, klen, shi, slo, vt, vw, vl, valid):
+                out = merge_resolve_kernel(
+                    kwbe, kwle, klen, shi, slo, vt, vw, vl, valid,
+                    merge_kind=merge_kind, drop_tombstones=drop_tombstones,
+                )
+                out_valid = (
+                    jax.lax.iota(jax.numpy.int32, klen.shape[0]) < out["count"]
+                )
+                bloom = bloom_build_tpu(
+                    out["key_words_le"], out["key_len"], out_valid,
+                    num_words=num_words,
+                )
+                out["bloom"] = bloom
+                return out
+
+            fn = jax.jit(jax.vmap(one_shard))
+            self._vmapped_cache[key] = fn
+        return fn
+
+    def compact_shard_batch(
+        self,
+        batches: Sequence[KVBatch],
+        merge_kind: MergeKind = MergeKind.UINT64_ADD,
+        drop_tombstones: bool = True,
+    ) -> List[dict]:
+        """Compact many shards in one launch. Returns, per shard:
+        {"entries": [(key, seq, vtype, value)], "bloom_words": np.ndarray,
+        "count": int}."""
+        if not batches:
+            return []
+        capacity = _next_pow2(max(b.capacity for b in batches))
+        num_words = num_words_for(capacity, self._bits_per_key)
+        jnp = self._jnp
+        stacked = {
+            name: jnp.asarray(np.stack([
+                _pad_to(getattr(b, name), capacity) for b in batches
+            ]))
+            for name in (
+                "key_words_be", "key_words_le", "key_len", "seq_hi",
+                "seq_lo", "vtype", "val_words", "val_len", "valid",
+            )
+        }
+        fn = self._pipeline(merge_kind, drop_tombstones, num_words)
+        out = fn(
+            stacked["key_words_be"], stacked["key_words_le"],
+            stacked["key_len"], stacked["seq_hi"], stacked["seq_lo"],
+            stacked["vtype"], stacked["val_words"], stacked["val_len"],
+            stacked["valid"],
+        )
+        host = {k: np.asarray(v) for k, v in out.items()}
+        results = []
+        for s in range(len(batches)):
+            count = int(host["count"][s])
+            entries = unpack_entries(
+                host["key_words_be"][s], host["key_len"][s],
+                host["seq_hi"][s], host["seq_lo"][s], host["vtype"][s],
+                host["val_words"][s], host["val_len"][s], count,
+            )
+            results.append({
+                "entries": entries,
+                "bloom_words": host["bloom"][s],
+                "count": count,
+            })
+        return results
+
+
+def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
+    if arr.shape[0] == capacity:
+        return arr
+    pad = [(0, capacity - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)

@@ -8,12 +8,20 @@ Must set env vars before jax is first imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force a hermetic 8-device virtual CPU mesh. The machine image's
+# sitecustomize registers a TPU-tunnel PJRT plugin at interpreter start and
+# sets jax_platforms itself, so the env var alone is not enough — the jax
+# config must be overridden before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

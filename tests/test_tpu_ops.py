@@ -1,0 +1,181 @@
+"""Parity tests: TPU kernels vs the CPU storage-engine semantics.
+
+The 'XLA assumption tests' SURVEY §4 calls for: the TPU merge-resolve
+kernel must produce exactly what compaction.py's resolve_stream produces,
+and the TPU bloom must be byte-identical to storage/bloom.py.
+"""
+
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from rocksplicator_tpu.ops import (
+    KVBatch,
+    MergeKind,
+    bloom_build_tpu,
+    merge_resolve_kernel,
+    pack_entries,
+    unpack_entries,
+)
+from rocksplicator_tpu.ops.kv_format import UnsupportedBatch
+from rocksplicator_tpu.storage.bloom import BloomFilter, num_words_for
+from rocksplicator_tpu.storage.compaction import CpuCompactionBackend
+from rocksplicator_tpu.storage.merge import UInt64AddOperator
+from rocksplicator_tpu.storage.records import OpType
+
+import jax.numpy as jnp
+
+pack64 = struct.Struct("<q").pack
+
+
+def run_kernel(entries, merge_kind=MergeKind.UINT64_ADD, drop_tombstones=True,
+               capacity=None):
+    batch = pack_entries(entries, capacity=capacity)
+    out = merge_resolve_kernel(
+        jnp.asarray(batch.key_words_be), jnp.asarray(batch.key_words_le),
+        jnp.asarray(batch.key_len), jnp.asarray(batch.seq_hi),
+        jnp.asarray(batch.seq_lo), jnp.asarray(batch.vtype),
+        jnp.asarray(batch.val_words), jnp.asarray(batch.val_len),
+        jnp.asarray(batch.valid),
+        merge_kind=merge_kind, drop_tombstones=drop_tombstones,
+    )
+    return unpack_entries(
+        np.asarray(out["key_words_be"]), np.asarray(out["key_len"]),
+        np.asarray(out["seq_hi"]), np.asarray(out["seq_lo"]),
+        np.asarray(out["vtype"]), np.asarray(out["val_words"]),
+        np.asarray(out["val_len"]), int(out["count"]),
+    )
+
+
+def keys_only(result):
+    return [(k, int(vt), v) for k, s, vt, v in result]
+
+
+def test_kernel_put_delete_basic():
+    entries = [
+        (b"a", 1, OpType.PUT, pack64(10)),
+        (b"a", 5, OpType.PUT, pack64(20)),
+        (b"b", 2, OpType.PUT, pack64(7)),
+        (b"c", 3, OpType.PUT, pack64(1)),
+        (b"c", 4, OpType.DELETE, b""),
+    ]
+    got = run_kernel(entries)
+    assert keys_only(got) == [
+        (b"a", OpType.PUT, pack64(20)),
+        (b"b", OpType.PUT, pack64(7)),
+    ]
+    # keep tombstones mid-level
+    got2 = run_kernel(entries, drop_tombstones=False)
+    assert keys_only(got2) == [
+        (b"a", OpType.PUT, pack64(20)),
+        (b"b", OpType.PUT, pack64(7)),
+        (b"c", OpType.DELETE, b""),
+    ]
+
+
+def test_kernel_merge_folding():
+    entries = [
+        (b"ctr", 1, OpType.PUT, pack64(100)),
+        (b"ctr", 2, OpType.MERGE, pack64(5)),
+        (b"ctr", 3, OpType.MERGE, pack64(7)),
+        (b"del", 1, OpType.PUT, pack64(1)),
+        (b"del", 2, OpType.DELETE, b""),
+        (b"del", 3, OpType.MERGE, pack64(9)),
+        (b"pure", 4, OpType.MERGE, pack64(3)),
+        (b"pure", 5, OpType.MERGE, pack64(4)),
+    ]
+    got = run_kernel(entries)
+    assert keys_only(got) == [
+        (b"ctr", OpType.PUT, pack64(112)),
+        (b"del", OpType.PUT, pack64(9)),
+        (b"pure", OpType.PUT, pack64(7)),   # bottom: fold to PUT
+    ]
+    got_mid = run_kernel(entries, drop_tombstones=False)
+    assert keys_only(got_mid) == [
+        (b"ctr", OpType.PUT, pack64(112)),
+        (b"del", OpType.PUT, pack64(9)),
+        (b"pure", OpType.MERGE, pack64(7)),  # mid-level: partial merge
+    ]
+
+
+def test_kernel_negative_and_large_values():
+    entries = [
+        (b"n", 1, OpType.PUT, pack64(-5)),
+        (b"n", 2, OpType.MERGE, pack64(-10)),
+        (b"big", 1, OpType.MERGE, pack64(2**40)),
+        (b"big", 2, OpType.MERGE, pack64(2**40 + 3)),
+    ]
+    got = dict((k, v) for k, s, vt, v in run_kernel(entries))
+    assert got[b"n"] == pack64(-15)
+    assert got[b"big"] == pack64(2**41 + 3)
+
+
+def test_kernel_matches_cpu_reference_randomized():
+    rng = random.Random(42)
+    keys = [f"key{i:02d}".encode() for i in range(20)]
+    entries = []
+    seq = 1
+    for _ in range(300):
+        k = rng.choice(keys)
+        r = rng.random()
+        if r < 0.5:
+            entries.append((k, seq, OpType.MERGE, pack64(rng.randrange(-50, 50))))
+        elif r < 0.8:
+            entries.append((k, seq, OpType.PUT, pack64(rng.randrange(1000))))
+        else:
+            entries.append((k, seq, OpType.DELETE, b""))
+        seq += 1
+    rng.shuffle(entries)  # kernel sorts internally
+    for drop in (True, False):
+        got = keys_only(run_kernel(entries, drop_tombstones=drop))
+        want = keys_only(
+            CpuCompactionBackend().merge_runs(
+                [sorted(entries, key=lambda e: (e[0], -e[1]))],
+                UInt64AddOperator(), drop,
+            )
+        )
+        assert got == want, f"drop_tombstones={drop}"
+
+
+def test_kernel_with_padding_capacity():
+    entries = [(b"a", 1, OpType.PUT, pack64(1)), (b"b", 2, OpType.PUT, pack64(2))]
+    got = run_kernel(entries, capacity=64)  # 62 invalid rows of padding
+    assert keys_only(got) == [
+        (b"a", OpType.PUT, pack64(1)),
+        (b"b", OpType.PUT, pack64(2)),
+    ]
+
+
+def test_pack_rejects_oversize():
+    with pytest.raises(UnsupportedBatch):
+        pack_entries([(b"x" * 25, 1, OpType.PUT, b"")])
+    with pytest.raises(UnsupportedBatch):
+        pack_entries([(b"x", 1, OpType.PUT, b"v" * 9)])
+
+
+def test_bloom_tpu_byte_identical_to_cpu():
+    keys = [f"key-{i}".encode() for i in range(2000)]
+    nw = num_words_for(len(keys), 10)
+    cpu = BloomFilter(nw)
+    for k in keys:
+        cpu.add(k)
+    batch = pack_entries([(k, 1, OpType.PUT, b"") for k in keys])
+    tpu_words = np.asarray(bloom_build_tpu(
+        jnp.asarray(batch.key_words_le), jnp.asarray(batch.key_len),
+        jnp.asarray(batch.valid), num_words=nw,
+    ))
+    assert np.array_equal(tpu_words, cpu.words)
+
+
+def test_bloom_tpu_invalid_rows_excluded():
+    batch = pack_entries([(b"real", 1, OpType.PUT, b"")], capacity=8)
+    nw = 4
+    tpu_words = np.asarray(bloom_build_tpu(
+        jnp.asarray(batch.key_words_le), jnp.asarray(batch.key_len),
+        jnp.asarray(batch.valid), num_words=nw,
+    ))
+    cpu = BloomFilter(nw)
+    cpu.add(b"real")
+    assert np.array_equal(tpu_words, cpu.words)
