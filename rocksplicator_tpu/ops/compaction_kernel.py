@@ -43,7 +43,10 @@ _MERGE = 3
 
 
 class MergeKind(enum.Enum):
-    NONE = "none"            # PUT/DELETE only (no merge operator)
+    # PUT/DELETE only. Batches containing MERGE records without an operator
+    # must NOT use this kernel (the backend routes them to the CPU path,
+    # which preserves unresolved operand chains like the reference).
+    NONE = "none"
     UINT64_ADD = "uint64add"  # the counter operator (merge_operator.h:20-40)
 
 
@@ -162,7 +165,11 @@ def merge_resolve_kernel(
     seg_base_del = seg_any(first_base_mask & is_del)
 
     if merge_kind is MergeKind.UINT64_ADD:
-        contrib = operand_mask | (first_base_mask & is_put)
+        # Reference parity (merge.py UInt64AddOperator._parse): values whose
+        # length is not exactly 8 parse as 0.
+        contrib = (
+            (operand_mask | (first_base_mask & is_put)) & (val_len == 8)
+        )
         lo = val_words[:, 0]
         hi = val_words[:, 1] if val_words.shape[1] > 1 else jnp.zeros_like(lo)
         zero = jnp.uint32(0)
@@ -218,6 +225,16 @@ def merge_resolve_kernel(
         m = live if a.ndim == 1 else live[:, None]
         return jnp.where(m, take2(a), jnp.zeros_like(a))
 
+    # Limb sums are exact only below 2^16 contributing operands per key;
+    # flag oversize groups so callers fall back to CPU instead of silently
+    # wrapping (the limit is generous: 65k updates of ONE key in ONE batch).
+    seg_size = seg_end - seg_start + 1
+    overflow_risk = (
+        jnp.any((seg_size >= (1 << 16)) & valid)
+        if merge_kind is MergeKind.UINT64_ADD
+        else jnp.asarray(False)
+    )
+
     return {
         "key_words_be": masked(key_words_be),
         "key_words_le": masked(key_words_le),
@@ -228,4 +245,5 @@ def merge_resolve_kernel(
         "val_words": masked(val_words),
         "val_len": masked(val_len),
         "count": count,
+        "needs_cpu_fallback": overflow_risk,
     }

@@ -19,18 +19,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..storage.bloom import _FNV_OFFSET, _FNV_PRIME, _H2_MUL
+from .bloom_tpu import _avalanche  # shared so both paths stay byte-identical
 
 _U32 = jnp.uint32
 _LANES = 512  # block width (multiple of 128)
-
-
-def _avalanche(h):
-    h = h ^ (h >> 16)
-    h = h * _U32(0x85EBCA6B)
-    h = h ^ (h >> 13)
-    h = h * _U32(0xC2B2AE35)
-    h = h ^ (h >> 16)
-    return h
 
 
 def _bloom_hash_kernel(panel_ref, out_ref):

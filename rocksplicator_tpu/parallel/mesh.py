@@ -42,6 +42,14 @@ def sharded_compaction_step(mesh, model=None):
     SP merge step), bloom build, and a psum'd global stats reduction.
     Output: final merged arrays per shard (replicated over ``block``),
     bloom words, per-shard counts, and the global count.
+
+    **Required invariant:** a shard's blocks must partition its entries by
+    sequence range — every seq in block b strictly newer than every seq in
+    block b-1 (the natural layout: blocks are WAL ranges / LSM runs).
+    Block-local resolution folds operands into the block's newest base;
+    that composes across blocks ONLY under this ordering (a newer block's
+    partial fold must not swallow operands that an older block's newer-seq
+    base should shadow). ``make_sharded_inputs`` generates compliant data.
     """
     import jax
     import jax.numpy as jnp
@@ -66,11 +74,12 @@ def sharded_compaction_step(mesh, model=None):
             )
 
         # 1) block-local merge (keep tombstones: blocks are partial views)
-        local = jax.vmap(lambda *a: run(a, False))(
+        local = dict(jax.vmap(lambda *a: run(a, False))(
             squeeze(kwbe), squeeze(kwle), squeeze(klen), squeeze(shi),
             squeeze(slo), squeeze(vt), squeeze(vw), squeeze(vl),
             squeeze(valid),
-        )
+        ))
+        local.pop("needs_cpu_fallback", None)
         # 2) assemble the shard's blocks: all_gather over the block axis
         gathered = {
             k: jax.lax.all_gather(v, "block", axis=1)
@@ -89,7 +98,7 @@ def sharded_compaction_step(mesh, model=None):
         row_in_block = jnp.arange(nb * n) % n
         valid2 = row_in_block[None, :] < per_block_counts[:, row_block]
         # 3) final merge per shard + bloom + stats
-        final = jax.vmap(
+        final = dict(jax.vmap(
             lambda *a: merge_resolve_kernel(
                 *a, merge_kind=merge_kind,
                 drop_tombstones=model.drop_tombstones,
@@ -98,7 +107,8 @@ def sharded_compaction_step(mesh, model=None):
             flat["key_words_be"], flat["key_words_le"], flat["key_len"],
             flat["seq_hi"], flat["seq_lo"], flat["vtype"],
             flat["val_words"], flat["val_len"], valid2,
-        )
+        ))
+        final.pop("needs_cpu_fallback", None)
         out_valid = (
             jnp.arange(nb * n)[None, :] < final["count"][:, None]
         )

@@ -60,20 +60,28 @@ class TpuCompactionBackend(CompactionBackend):
         entries: List[Entry] = [e for run in runs for e in run]
         if not entries:
             return iter(())
-        if len(entries) > MAX_TPU_ENTRIES:
+
+        def cpu():
             return self._fallback.merge_runs(
                 [sorted(entries, key=lambda e: (e[0], -e[1]))],
                 merge_op, drop_tombstones,
             )
+
+        if len(entries) > MAX_TPU_ENTRIES:
+            return cpu()
+        if merge_op is None and any(e[2] == _MERGE for e in entries):
+            # MERGE records without an operator: the reference preserves the
+            # unresolved operand chain — only the CPU path can express that.
+            return cpu()
         try:
             batch = pack_entries(entries, capacity=_next_pow2(len(entries)))
         except UnsupportedBatch as e:
             log.debug("TPU compaction fallback: %s", e)
-            return self._fallback.merge_runs(
-                [sorted(entries, key=lambda e: (e[0], -e[1]))],
-                merge_op, drop_tombstones,
-            )
-        return iter(self._run_batch(batch, merge_op, drop_tombstones))
+            return cpu()
+        result = self._run_batch(batch, merge_op, drop_tombstones)
+        if result is None:  # kernel flagged limb-overflow risk
+            return cpu()
+        return iter(result)
 
     def _run_batch(
         self, batch: KVBatch, merge_op: Optional[MergeOperator],
@@ -92,6 +100,8 @@ class TpuCompactionBackend(CompactionBackend):
             jnp.asarray(batch.valid),
             merge_kind=kind, drop_tombstones=drop_tombstones,
         )
+        if bool(out["needs_cpu_fallback"]):
+            return None
         return unpack_entries(
             np.asarray(out["key_words_be"]), np.asarray(out["key_len"]),
             np.asarray(out["seq_hi"]), np.asarray(out["seq_lo"]),
@@ -116,6 +126,11 @@ class NumpyCompactionBackend(CompactionBackend):
         entries = [e for run in runs for e in run]
         if not entries:
             return iter(())
+        if merge_op is None and any(e[2] == _MERGE for e in entries):
+            return self._fallback.merge_runs(
+                [sorted(entries, key=lambda e: (e[0], -e[1]))],
+                merge_op, drop_tombstones,
+            )
         try:
             batch = pack_entries(entries)
         except UnsupportedBatch:
@@ -153,9 +168,6 @@ def numpy_merge_resolve(
     )
     n = valid_n
     if n == 0:
-        empty = (np.zeros((0, 6), np.uint32),) + tuple(
-            np.zeros(0, np.uint32) for _ in range(3)
-        )
         return (batch.key_words_be[:0], batch.key_len[:0], batch.seq_hi[:0],
                 batch.seq_lo[:0], batch.vtype[:0], batch.val_words[:0],
                 batch.val_len[:0]), 0
@@ -189,7 +201,8 @@ def numpy_merge_resolve(
             vals = vw[:, 0].astype(np.int64) | (vw[:, 1].astype(np.int64) << 32)
         else:
             vals = vw[:, 0].astype(np.int64)
-        contrib = operand_mask | (is_base & (pos == fb) & is_put)
+        # parity with UInt64AddOperator._parse: non-8-byte values parse as 0
+        contrib = (operand_mask | (is_base & (pos == fb) & is_put)) & (vlen == 8)
         sums = np.add.reduceat(np.where(contrib, vals, 0), bounds)
 
     # representative = first row of each segment

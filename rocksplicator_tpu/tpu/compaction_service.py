@@ -127,6 +127,10 @@ class TpuCompactionService:
         host = {k: np.asarray(v) for k, v in out.items()}
         results = []
         for s in range(len(batches)):
+            if bool(host.get("needs_cpu_fallback", np.zeros(1))[s]):
+                results.append(self._cpu_recompute(
+                    batches[s], merge_kind, drop_tombstones))
+                continue
             count = int(host["count"][s])
             entries = unpack_entries(
                 host["key_words_be"][s], host["key_len"][s],
@@ -139,6 +143,24 @@ class TpuCompactionService:
                 "count": count,
             })
         return results
+
+    def _cpu_recompute(self, batch: KVBatch, merge_kind: MergeKind,
+                       drop_tombstones: bool) -> dict:
+        """Host recompute for shards the kernel flagged (e.g. one key with
+        ≥2^16 operands — beyond the limb-sum range)."""
+        from ..storage.bloom import BloomFilter
+        from .backend import numpy_merge_resolve
+
+        arrays, count = numpy_merge_resolve(
+            batch, uint64_add=merge_kind is MergeKind.UINT64_ADD,
+            drop_tombstones=drop_tombstones,
+        )
+        entries = unpack_entries(*arrays, count)
+        num_words = num_words_for(batch.capacity, self._bits_per_key)
+        bf = BloomFilter(num_words)
+        for key, _seq, _vt, _val in entries:
+            bf.add(key)
+        return {"entries": entries, "bloom_words": bf.words, "count": count}
 
 
 def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:

@@ -179,3 +179,64 @@ def test_bloom_tpu_invalid_rows_excluded():
     cpu = BloomFilter(nw)
     cpu.add(b"real")
     assert np.array_equal(tpu_words, cpu.words)
+
+
+# ---------------------------------------------------------------------------
+# regression tests from code review
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_short_merge_operand_parses_as_zero():
+    """UInt64AddOperator parity: non-8-byte values count as 0."""
+    entries = [
+        (b"k", 1, OpType.PUT, pack64(10)),
+        (b"k", 2, OpType.MERGE, b"\x01\x00\x00\x00"),  # 4 bytes -> 0
+        (b"k", 3, OpType.MERGE, pack64(5)),
+    ]
+    got = dict((k, v) for k, s, vt, v in run_kernel(entries))
+    want = UInt64AddOperator().merge(
+        b"k", pack64(10), [b"\x01\x00\x00\x00", pack64(5)]
+    )
+    assert got[b"k"] == want == pack64(15)
+
+
+def test_backend_none_with_merge_records_falls_back():
+    from rocksplicator_tpu.tpu.backend import TpuCompactionBackend
+
+    entries = sorted([
+        (b"k", 2, OpType.MERGE, b"op2"),
+        (b"k", 1, OpType.PUT, b"base"),
+    ], key=lambda e: (e[0], -e[1]))
+    got = list(TpuCompactionBackend().merge_runs([entries], None, False))
+    want = list(CpuCompactionBackend().merge_runs([entries], None, False))
+    assert got == want  # unresolved chain preserved, base not lost
+
+
+def test_kernel_flags_oversize_merge_group():
+    import jax.numpy as jnp
+
+    n = 1 << 17
+    entries_kw = np.zeros((n, 6), dtype=np.uint32)  # all same key
+    out = merge_resolve_kernel(
+        jnp.asarray(entries_kw), jnp.asarray(entries_kw),
+        jnp.full(n, 8, jnp.uint32),
+        jnp.zeros(n, jnp.uint32), jnp.asarray(np.arange(n, dtype=np.uint32)),
+        jnp.full(n, 3, jnp.uint32),  # all MERGE
+        jnp.ones((n, 2), jnp.uint32), jnp.full(n, 8, jnp.uint32),
+        jnp.ones(n, bool),
+        merge_kind=MergeKind.UINT64_ADD, drop_tombstones=True,
+    )
+    assert bool(out["needs_cpu_fallback"])
+
+
+def test_service_cpu_recompute_on_oversize_group():
+    from rocksplicator_tpu.tpu.compaction_service import TpuCompactionService
+
+    n = 1 << 17
+    entries = [(b"hot", i + 1, OpType.MERGE, pack64(1)) for i in range(n)]
+    batch = pack_entries(sorted(entries, key=lambda e: (e[0], -e[1])))
+    service = TpuCompactionService()
+    results = service.compact_shard_batch([batch])
+    assert results[0]["count"] == 1
+    k, s, vt, v = results[0]["entries"][0]
+    assert k == b"hot" and v == pack64(n)  # exact despite 2^17 operands
