@@ -1,0 +1,17 @@
+"""Cluster management — the native control plane (reference: SURVEY §2.4,
+cluster_management/ — Apache Helix on ZooKeeper via an embedded JVM).
+
+Rebuilt without a JVM:
+- ``coordinator``: a small coordination service (sessions, ephemeral nodes,
+  CAS, watches, locks) standing in for ZooKeeper;
+- ``controller``: leader-elected assignment computation (Helix controller
+  equivalent) with highest-seq-aware leader election;
+- ``participant``: joins the cluster, runs state-model transitions against
+  the local Admin service;
+- ``state_models``: LeaderFollower / MasterSlave / Bootstrap /
+  OnlineOffline / Cache / CdcLeaderStandby;
+- ``spectator`` + ``config_generator`` + ``publishers``: external-view →
+  shard-map JSON fan-out;
+- ``tasks``: Backup/Restore/Ingest/Dedup task framework;
+- ``eventstore``: leader-handoff event history.
+"""

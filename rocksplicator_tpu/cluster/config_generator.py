@@ -1,0 +1,57 @@
+"""ConfigGenerator: external view → shard-map JSON.
+
+Reference: ConfigGenerator.java:167-474 — on ExternalView/config change,
+regenerate the shard map ``{resource: {num_shards, "ip:port:az": ["00001:M",
+...]}}`` and hand it to a pluggable ShardMapPublisher. The map format is
+exactly what the data-plane router parses (rpc/router.py), with the
+replication port carried as the 4th host-key field.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, List
+
+from ..utils.segment_utils import partition_name_to_db_name, extract_shard_id, db_name_to_segment
+from .model import InstanceInfo, ResourceDef, cluster_path, decode_states
+
+log = logging.getLogger(__name__)
+
+_LEADERLIKE = {"LEADER", "MASTER"}
+_SERVING = _LEADERLIKE | {"FOLLOWER", "SLAVE", "ONLINE"}
+
+
+def generate_shard_map(coord, cluster: str) -> Dict:
+    """Build the shard map from the coordinator's current states."""
+    path = lambda *p: cluster_path(cluster, *p)
+    instances: Dict[str, InstanceInfo] = {}
+    for iid in coord.list(path("instances")):
+        raw = coord.get_or_none(path("instances", iid))
+        if raw:
+            instances[iid] = InstanceInfo.decode(raw)
+    resources: Dict[str, ResourceDef] = {}
+    for seg in coord.list(path("resources")):
+        raw = coord.get_or_none(path("resources", seg))
+        if raw:
+            resources[seg] = ResourceDef.decode(raw)
+
+    shard_map: Dict[str, Dict] = {
+        seg: {"num_shards": r.num_shards} for seg, r in resources.items()
+    }
+    for iid, info in instances.items():
+        states = decode_states(coord.get_or_none(path("currentstates", iid)))
+        host_key = f"{info.host}:{info.admin_port}:{info.az}:{info.repl_port}"
+        for partition, state in sorted(states.items()):
+            if state not in _SERVING:
+                continue
+            db_name = partition_name_to_db_name(partition)
+            seg = db_name_to_segment(db_name)
+            if seg not in shard_map:
+                continue
+            shard = extract_shard_id(db_name)
+            marker = "M" if state in _LEADERLIKE else "S"
+            shard_map[seg].setdefault(host_key, []).append(
+                f"{shard:05d}:{marker}"
+            )
+    return shard_map

@@ -1,0 +1,230 @@
+"""Controller: leader-elected assignment computation.
+
+Reference: the Helix controller (external to the reference repo but the
+brain of its control plane). Responsibilities reproduced:
+- watch live instances / resources / current states;
+- compute stable partition placement (rendezvous hashing keeps most
+  placements unchanged when membership changes);
+- leader handoff in two phases (demote-then-promote) so participants'
+  no-live-leader guard holds;
+- write per-instance assignments the participants converge on;
+- reconcile periodically to self-heal.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.segment_utils import segment_to_db_name, db_name_to_partition_name
+from .coordinator import CoordinatorClient
+from .model import (
+    FOLLOWER,
+    LEADER,
+    InstanceInfo,
+    PartitionAssignment,
+    ResourceDef,
+    cluster_path,
+    decode_states,
+    encode_assignments,
+)
+
+log = logging.getLogger(__name__)
+
+_LEADERLIKE = {"LEADER", "MASTER"}
+_FOLLOWERLIKE = {"FOLLOWER", "SLAVE"}
+
+
+def _rendezvous(partition: str, instance_id: str) -> int:
+    h = hashlib.blake2b(
+        f"{partition}|{instance_id}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "big")
+
+
+class Controller:
+    def __init__(
+        self,
+        coord_host: str,
+        coord_port: int,
+        cluster: str,
+        controller_id: str,
+        reconcile_interval: float = 2.0,
+    ):
+        self.cluster = cluster
+        self.controller_id = controller_id
+        self.coord = CoordinatorClient(coord_host, coord_port)
+        self._path = lambda *p: cluster_path(cluster, *p)
+        self._interval = reconcile_interval
+        self._stop = threading.Event()
+        self._kick = threading.Event()
+        self._is_leader = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"controller-{controller_id}", daemon=True
+        )
+        self._thread.start()
+        # wake on membership / state / resource changes
+        self._watches = [
+            self.coord.watch(self._path("instances"), self._on_change),
+            self.coord.watch(self._path("currentstates"), self._on_change),
+            self.coord.watch(self._path("resources"), self._on_change),
+        ]
+
+    def _on_change(self, _snap) -> None:
+        self._kick.set()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if not self._is_leader:
+                    self._is_leader = self.coord.elect_leader(
+                        self._path("controller"), self.controller_id
+                    ) or self.coord.current_leader(
+                        self._path("controller")
+                    ) == self.controller_id
+                if self._is_leader:
+                    self.reconcile()
+            except Exception:
+                log.exception("controller loop error")
+            self._kick.wait(self._interval)
+            self._kick.clear()
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self) -> None:
+        """One pass: recompute and publish assignments for every resource."""
+        instances = self._live_instances()
+        current = self._current_states()
+        per_instance: Dict[str, Dict[str, PartitionAssignment]] = {
+            iid: {} for iid in instances
+        }
+        for seg in self.coord.list(self._path("resources")):
+            raw = self.coord.get_or_none(self._path("resources", seg))
+            if raw is None:
+                continue
+            resource = ResourceDef.decode(raw)
+            self._assign_resource(resource, instances, current, per_instance)
+        for iid, assignments in per_instance.items():
+            path = self._path("assignments", iid)
+            encoded = encode_assignments(assignments)
+            existing = self.coord.get_or_none(path)
+            if existing != encoded:
+                self.coord.put(path, encoded)
+
+    def _assign_resource(
+        self,
+        resource: ResourceDef,
+        instances: Dict[str, InstanceInfo],
+        current: Dict[str, Dict[str, str]],
+        per_instance: Dict[str, Dict[str, PartitionAssignment]],
+    ) -> None:
+        leader_state, follower_state = self._state_names(resource.state_model)
+        iids = sorted(instances)
+        if not iids:
+            return
+        for shard in range(resource.num_shards):
+            partition = db_name_to_partition_name(
+                segment_to_db_name(resource.segment, shard)
+            )
+            ranked = sorted(
+                iids, key=lambda iid: _rendezvous(partition, iid),
+                reverse=True,
+            )
+            replicas = ranked[: resource.replicas]
+            if not replicas:
+                continue
+            # who currently leads?
+            live_leader = None
+            for iid in iids:
+                if current.get(iid, {}).get(partition) in _LEADERLIKE:
+                    live_leader = iid
+                    break
+            # target leader: sticky to the live leader if still placed;
+            # else the best-ranked replica that's already serving; else rank-0
+            if live_leader in replicas:
+                target_leader = live_leader
+            else:
+                serving = [
+                    iid for iid in replicas
+                    if current.get(iid, {}).get(partition) in
+                    (_FOLLOWERLIKE | _LEADERLIKE)
+                ]
+                target_leader = serving[0] if serving else replicas[0]
+            # two-phase handoff: demote first, promote when no live leader
+            promote_ok = live_leader is None or live_leader == target_leader
+            # followers need the upstream (the acting leader while handoff
+            # is in flight, else the target leader)
+            upstream_iid = live_leader or target_leader
+            upstream_info = instances.get(upstream_iid)
+            upstream = (
+                f"{upstream_info.host}:{upstream_info.repl_port}"
+                if upstream_info else None
+            )
+            for iid in replicas:
+                if iid == target_leader and promote_ok:
+                    state: str = leader_state
+                    up = None
+                elif iid == target_leader:
+                    state = follower_state
+                    up = upstream if upstream_iid != iid else None
+                else:
+                    state = follower_state
+                    up = upstream if upstream_iid != iid else None
+                per_instance[iid][partition] = PartitionAssignment(state, up)
+
+    @staticmethod
+    def _state_names(state_model: str) -> Tuple[str, str]:
+        if state_model == "MasterSlave":
+            return "MASTER", "SLAVE"
+        if state_model in ("OnlineOffline", "Cache", "Bootstrap"):
+            return "ONLINE", "ONLINE"
+        if state_model == "CdcLeaderStandby":
+            return "LEADER", "STANDBY"
+        return LEADER, FOLLOWER
+
+    # ------------------------------------------------------------------
+
+    def _live_instances(self) -> Dict[str, InstanceInfo]:
+        out = {}
+        for iid in self.coord.list(self._path("instances")):
+            raw = self.coord.get_or_none(self._path("instances", iid))
+            if raw:
+                out[iid] = InstanceInfo.decode(raw)
+        return out
+
+    def _current_states(self) -> Dict[str, Dict[str, str]]:
+        out = {}
+        for iid in self.coord.list(self._path("currentstates")):
+            out[iid] = decode_states(
+                self.coord.get_or_none(self._path("currentstates", iid))
+            )
+        return out
+
+    # -- admin API -------------------------------------------------------
+
+    def add_resource(self, resource: ResourceDef) -> None:
+        self.coord.put(
+            self._path("resources", resource.segment), resource.encode()
+        )
+        self._kick.set()
+
+    def remove_resource(self, segment: str) -> None:
+        self.coord.delete_if_exists(self._path("resources", segment))
+        self._kick.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        for w in self._watches:
+            w.set()
+        self._thread.join(timeout=5.0)
+        self.coord.close()

@@ -1,0 +1,496 @@
+"""Coordination service — the ZooKeeper equivalent.
+
+Reference dependency: the entire Java control plane sits on ZK (sessions,
+ephemeral znodes, watches, InterProcessMutex locks, merged event stores).
+This module provides those primitives natively over the framework's RPC
+layer:
+
+- hierarchical nodes with versioned CAS writes;
+- sessions with TTL heartbeats; ephemeral nodes die with their session;
+- sequential nodes (``path-0000000001``) for lock/election recipes;
+- long-poll watches on data and children (the same no-thread-parked
+  pattern as the replication server);
+- client-side distributed lock + leader election recipes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..rpc.client_pool import RpcClientPool
+from ..rpc.errors import RpcApplicationError, RpcError
+from ..rpc.ioloop import IoLoop
+from ..rpc.server import RpcServer
+
+log = logging.getLogger(__name__)
+
+NO_NODE = "NO_NODE"
+NODE_EXISTS = "NODE_EXISTS"
+BAD_VERSION = "BAD_VERSION"
+NO_SESSION = "NO_SESSION"
+NOT_EMPTY = "NOT_EMPTY"
+
+DEFAULT_SESSION_TTL = 6.0
+
+
+class _Node:
+    __slots__ = ("value", "version", "ephemeral_owner", "seq_counter")
+
+    def __init__(self, value: bytes, ephemeral_owner: Optional[int]):
+        self.value = value
+        self.version = 0
+        self.ephemeral_owner = ephemeral_owner
+        self.seq_counter = itertools.count(0)
+
+
+class CoordinatorServer:
+    """In-memory coordination server (durability is a later-round item —
+    the reference's ZK is durable; state here rebuilds from live sessions
+    on restart, which the state machines tolerate)."""
+
+    def __init__(self, port: int = 0, ioloop: Optional[IoLoop] = None,
+                 session_ttl: float = DEFAULT_SESSION_TTL):
+        self._ioloop = ioloop or IoLoop.default()
+        self._nodes: Dict[str, _Node] = {"/": _Node(b"", None)}
+        self._sessions: Dict[int, float] = {}  # sid -> expiry deadline
+        self._session_ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._ttl = session_ttl
+        self._change_event: Dict[str, asyncio.Event] = {}
+        self._global_version = 0
+        self._server = RpcServer(port=port, ioloop=self._ioloop)
+        self._server.add_handler(self)
+        self._server.start()
+        self._reaper_task = self._ioloop.run_coro(self._reap_sessions())
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def stop(self) -> None:
+        self._reaper_task.cancel()
+        self._server.stop()
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        if not path.startswith("/"):
+            raise RpcApplicationError(NO_NODE, f"bad path {path!r}")
+        return "/" + "/".join(p for p in path.split("/") if p)
+
+    @staticmethod
+    def _parent(path: str) -> str:
+        return path.rsplit("/", 1)[0] or "/"
+
+    def _signal_change(self, *paths: str) -> None:
+        self._global_version += 1
+        for path in paths:
+            ev = self._change_event.get(path)
+            if ev is not None:
+                ev.set()
+                self._change_event.pop(path, None)
+
+    async def _wait_change(self, path: str, timeout: float) -> None:
+        ev = self._change_event.get(path)
+        if ev is None:
+            ev = asyncio.Event()
+            self._change_event[path] = ev
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+
+    def _check_session(self, sid: int) -> None:
+        if sid and sid not in self._sessions:
+            raise RpcApplicationError(NO_SESSION, str(sid))
+
+    async def _reap_sessions(self) -> None:
+        while True:
+            await asyncio.sleep(self._ttl / 3)
+            now = time.monotonic()
+            with self._lock:
+                dead = [s for s, dl in self._sessions.items() if dl < now]
+                for sid in dead:
+                    del self._sessions[sid]
+                touched: Set[str] = set()
+                if dead:
+                    dead_set = set(dead)
+                    for path in [
+                        p for p, n in self._nodes.items()
+                        if n.ephemeral_owner in dead_set
+                    ]:
+                        del self._nodes[path]
+                        touched.add(path)
+                        touched.add(self._parent(path))
+            for sid in dead:
+                log.info("coordinator: session %d expired", sid)
+            if dead:
+                self._signal_change(*touched)
+
+    # ------------------------------------------------------------------
+    # session RPCs
+    # ------------------------------------------------------------------
+
+    async def handle_create_session(self, ttl: Optional[float] = None) -> dict:
+        sid = next(self._session_ids)
+        with self._lock:
+            self._sessions[sid] = time.monotonic() + (ttl or self._ttl)
+        return {"session_id": sid, "ttl": ttl or self._ttl}
+
+    async def handle_heartbeat(self, session_id: int = 0) -> dict:
+        with self._lock:
+            if session_id not in self._sessions:
+                raise RpcApplicationError(NO_SESSION, str(session_id))
+            self._sessions[session_id] = time.monotonic() + self._ttl
+        return {}
+
+    async def handle_close_session(self, session_id: int = 0) -> dict:
+        with self._lock:
+            self._sessions.pop(session_id, None)
+            touched: Set[str] = set()
+            for path in [
+                p for p, n in self._nodes.items()
+                if n.ephemeral_owner == session_id
+            ]:
+                del self._nodes[path]
+                touched.add(path)
+                touched.add(self._parent(path))
+        self._signal_change(*touched)
+        return {}
+
+    # ------------------------------------------------------------------
+    # node RPCs
+    # ------------------------------------------------------------------
+
+    async def handle_create(
+        self, path: str = "", value: bytes = b"", ephemeral: bool = False,
+        sequential: bool = False, session_id: int = 0,
+        make_parents: bool = True,
+    ) -> dict:
+        path = self._norm(path)
+        value = bytes(value)
+        with self._lock:
+            if ephemeral:
+                self._check_session(session_id)
+            parent = self._parent(path)
+            if parent not in self._nodes:
+                if not make_parents:
+                    raise RpcApplicationError(NO_NODE, parent)
+                # materialize missing ancestors (persistent)
+                parts = [p for p in parent.split("/") if p]
+                cur = ""
+                for p in parts:
+                    cur += "/" + p
+                    self._nodes.setdefault(cur, _Node(b"", None))
+            if sequential:
+                seq = next(self._nodes[parent].seq_counter)
+                path = f"{path}{seq:010d}"
+            if path in self._nodes:
+                raise RpcApplicationError(NODE_EXISTS, path)
+            self._nodes[path] = _Node(
+                value, session_id if ephemeral else None
+            )
+        self._signal_change(path, self._parent(path))
+        return {"path": path}
+
+    async def handle_get(self, path: str = "") -> dict:
+        path = self._norm(path)
+        with self._lock:
+            node = self._nodes.get(path)
+            if node is None:
+                raise RpcApplicationError(NO_NODE, path)
+            return {"value": node.value, "version": node.version}
+
+    async def handle_exists(self, path: str = "") -> dict:
+        path = self._norm(path)
+        with self._lock:
+            node = self._nodes.get(path)
+            return {
+                "exists": node is not None,
+                "version": node.version if node else -1,
+            }
+
+    async def handle_set(
+        self, path: str = "", value: bytes = b"", expected_version: int = -1
+    ) -> dict:
+        path = self._norm(path)
+        value = bytes(value)
+        with self._lock:
+            node = self._nodes.get(path)
+            if node is None:
+                raise RpcApplicationError(NO_NODE, path)
+            if expected_version >= 0 and node.version != expected_version:
+                raise RpcApplicationError(
+                    BAD_VERSION, f"{path}: {node.version} != {expected_version}"
+                )
+            node.value = value
+            node.version += 1
+            version = node.version
+        self._signal_change(path)
+        return {"version": version}
+
+    async def handle_delete(
+        self, path: str = "", expected_version: int = -1,
+        recursive: bool = False,
+    ) -> dict:
+        path = self._norm(path)
+        with self._lock:
+            node = self._nodes.get(path)
+            if node is None:
+                raise RpcApplicationError(NO_NODE, path)
+            if expected_version >= 0 and node.version != expected_version:
+                raise RpcApplicationError(BAD_VERSION, path)
+            prefix = path + "/"
+            children = [p for p in self._nodes if p.startswith(prefix)]
+            if children and not recursive:
+                raise RpcApplicationError(NOT_EMPTY, path)
+            for p in children:
+                del self._nodes[p]
+            del self._nodes[path]
+        self._signal_change(path, self._parent(path))
+        return {}
+
+    async def handle_list(self, path: str = "") -> dict:
+        path = self._norm(path)
+        with self._lock:
+            if path != "/" and path not in self._nodes:
+                raise RpcApplicationError(NO_NODE, path)
+            prefix = path if path.endswith("/") else path + "/"
+            children = sorted({
+                p[len(prefix):].split("/", 1)[0]
+                for p in self._nodes
+                if p.startswith(prefix)
+            })
+        return {"children": children}
+
+    async def handle_watch(
+        self, path: str = "", known_version: int = -2,
+        max_wait_ms: int = 10_000,
+    ) -> dict:
+        """Long-poll: returns when the node (or its children) changed vs
+        ``known_version`` (use the ``cversion`` from the previous call), or
+        on timeout. version -1 = node absent."""
+        path = self._norm(path)
+
+        def snapshot():
+            with self._lock:
+                node = self._nodes.get(path)
+                prefix = path if path.endswith("/") else path + "/"
+                children = sorted({
+                    p[len(prefix):].split("/", 1)[0]
+                    for p in self._nodes if p.startswith(prefix)
+                })
+                version = node.version if node else -1
+                cver = hash((version, tuple(children))) & 0x7FFFFFFF
+                return {
+                    "exists": node is not None,
+                    "value": node.value if node else b"",
+                    "version": version,
+                    "children": children,
+                    "cversion": cver,
+                }
+
+        snap = snapshot()
+        if known_version != -2 and snap["cversion"] == known_version:
+            await self._wait_change(path, max_wait_ms / 1000.0)
+            snap = snapshot()
+        return snap
+
+
+class CoordinatorClient:
+    """Sync client + session keepalive + watch loops + lock/election
+    recipes (the Curator equivalent)."""
+
+    def __init__(self, host: str, port: int, ioloop: Optional[IoLoop] = None,
+                 session_ttl: Optional[float] = None):
+        self._host, self._port = host, port
+        self._ioloop = ioloop or IoLoop.default()
+        self._pool = RpcClientPool()
+        self._stop = threading.Event()
+        r = self._call("create_session", ttl=session_ttl)
+        self.session_id = r["session_id"]
+        self._ttl = r["ttl"]
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="coord-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+        self._watch_threads: List[threading.Thread] = []
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _call(self, method: str, timeout: float = 30.0, **args):
+        async def go():
+            return await self._pool.call(
+                self._host, self._port, method, args, timeout=timeout
+            )
+
+        return self._ioloop.run_sync(go(), timeout=timeout + 5)
+
+    def _heartbeat_loop(self) -> None:
+        interval = self._ttl / 3
+        while not self._stop.wait(interval):
+            try:
+                self._call("heartbeat", session_id=self.session_id)
+            except RpcError:
+                pass  # reconnects on next beat; session may expire meanwhile
+            except Exception:
+                log.exception("coordinator heartbeat failed")
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._call("close_session", session_id=self.session_id)
+        except Exception:
+            pass
+        self._hb_thread.join(timeout=2.0)
+        for t in self._watch_threads:
+            t.join(timeout=2.0)
+        self._ioloop.run_sync(self._pool.close())
+
+    # -- node ops ---------------------------------------------------------
+
+    def create(self, path: str, value: bytes = b"", ephemeral: bool = False,
+               sequential: bool = False) -> str:
+        return self._call(
+            "create", path=path, value=value, ephemeral=ephemeral,
+            sequential=sequential, session_id=self.session_id,
+        )["path"]
+
+    def ensure(self, path: str, value: bytes = b"") -> None:
+        try:
+            self.create(path, value)
+        except RpcApplicationError as e:
+            if e.code != NODE_EXISTS:
+                raise
+
+    def get(self, path: str) -> Tuple[bytes, int]:
+        r = self._call("get", path=path)
+        return bytes(r["value"]), r["version"]
+
+    def get_or_none(self, path: str) -> Optional[bytes]:
+        try:
+            return self.get(path)[0]
+        except RpcApplicationError as e:
+            if e.code == NO_NODE:
+                return None
+            raise
+
+    def set(self, path: str, value: bytes, expected_version: int = -1) -> int:
+        return self._call(
+            "set", path=path, value=value, expected_version=expected_version
+        )["version"]
+
+    def put(self, path: str, value: bytes) -> None:
+        """create-or-set."""
+        try:
+            self.create(path, value)
+        except RpcApplicationError as e:
+            if e.code != NODE_EXISTS:
+                raise
+            self.set(path, value)
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        self._call("delete", path=path, recursive=recursive)
+
+    def delete_if_exists(self, path: str, recursive: bool = False) -> None:
+        try:
+            self.delete(path, recursive=recursive)
+        except RpcApplicationError as e:
+            if e.code != NO_NODE:
+                raise
+
+    def list(self, path: str) -> List[str]:
+        try:
+            return self._call("list", path=path)["children"]
+        except RpcApplicationError as e:
+            if e.code == NO_NODE:
+                return []
+            raise
+
+    def exists(self, path: str) -> bool:
+        return self._call("exists", path=path)["exists"]
+
+    # -- watches ----------------------------------------------------------
+
+    def watch(self, path: str, callback, poll_ms: int = 5_000) -> threading.Event:
+        """Fire ``callback(snapshot_dict)`` on every observed change (and
+        once initially). Returns an Event; set it to stop the watch."""
+        stop = threading.Event()
+
+        def loop():
+            known = -2
+            while not stop.is_set() and not self._stop.is_set():
+                try:
+                    snap = self._call(
+                        "watch", path=path, known_version=known,
+                        max_wait_ms=poll_ms, timeout=poll_ms / 1000 + 10,
+                    )
+                except (RpcError, RpcApplicationError):
+                    time.sleep(0.5)
+                    continue
+                except Exception:
+                    log.exception("watch loop error for %s", path)
+                    time.sleep(0.5)
+                    continue
+                if snap["cversion"] != known:
+                    known = snap["cversion"]
+                    try:
+                        callback(snap)
+                    except Exception:
+                        log.exception("watch callback failed for %s", path)
+
+        t = threading.Thread(target=loop, name=f"watch:{path}", daemon=True)
+        t.start()
+        self._watch_threads.append(t)
+        return stop
+
+    # -- recipes -----------------------------------------------------------
+
+    def acquire_lock(self, lock_path: str, timeout: float = 30.0) -> Optional[str]:
+        """InterProcessMutex recipe: ephemeral sequential node; lowest wins.
+        Returns my node path (pass to release_lock), or None on timeout."""
+        self.ensure(lock_path)
+        me = self.create(f"{lock_path}/lock-", ephemeral=True, sequential=True)
+        my_name = me.rsplit("/", 1)[1]
+        deadline = time.monotonic() + timeout
+        known = -2  # first watch returns immediately with the snapshot
+        while time.monotonic() < deadline:
+            remaining = max(0.05, deadline - time.monotonic())
+            wait_ms = int(min(remaining, 2.0) * 1000)
+            snap = self._call(
+                "watch", path=lock_path, known_version=known,
+                max_wait_ms=wait_ms, timeout=wait_ms / 1000 + 10,
+            )
+            known = snap["cversion"]
+            siblings = sorted(snap["children"])
+            if siblings and siblings[0] == my_name:
+                return me
+        self.delete_if_exists(me)
+        return None
+
+    def release_lock(self, my_node: str) -> None:
+        self.delete_if_exists(my_node)
+
+    def elect_leader(self, election_path: str, my_id: str) -> bool:
+        """Simple leader election: ephemeral node claim. True if leader."""
+        self.ensure(election_path)
+        try:
+            self.create(f"{election_path}/leader", my_id.encode(),
+                        ephemeral=True)
+            return True
+        except RpcApplicationError as e:
+            if e.code == NODE_EXISTS:
+                return False
+            raise
+
+    def current_leader(self, election_path: str) -> Optional[str]:
+        raw = self.get_or_none(f"{election_path}/leader")
+        return raw.decode() if raw is not None else None

@@ -1,0 +1,84 @@
+"""Leader-handoff event history.
+
+Reference: cluster_management eventstore/ — typed events (init/success/
+failure of each transition phase) merged into ZK nodes
+(ZkMergeableEventStore) and analyzed by EventHistoryAnalysisTool. Here:
+per-partition JSON event lists in the coordinator with CAS-merge appends
+and a capped length.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+from ..rpc.errors import RpcApplicationError
+from .model import cluster_path
+
+MAX_EVENTS = 64
+
+
+def _events_path(cluster: str, partition: str) -> str:
+    return cluster_path(cluster, "events", partition)
+
+
+def append_event(
+    coord,
+    cluster: str,
+    partition: str,
+    event_type: str,
+    originator: str,
+    detail: str = "",
+    max_retries: int = 5,
+) -> None:
+    """CAS-merge append (ZkMergeableEventStore semantics)."""
+    path = _events_path(cluster, partition)
+    event = {
+        "ts_ms": int(time.time() * 1000),
+        "type": event_type,
+        "originator": originator,
+        "detail": detail,
+    }
+    for _ in range(max_retries):
+        try:
+            raw, version = coord.get(path)
+            events = json.loads(bytes(raw).decode()) if raw else []
+        except RpcApplicationError as e:
+            if e.code != "NO_NODE":
+                raise
+            try:
+                coord.create(path, json.dumps([event]).encode())
+                return
+            except RpcApplicationError as e2:
+                if e2.code != "NODE_EXISTS":
+                    raise
+                continue  # lost the create race; retry the merge path
+        events.append(event)
+        events = events[-MAX_EVENTS:]
+        try:
+            coord.set(path, json.dumps(events).encode(), expected_version=version)
+            return
+        except RpcApplicationError as e:
+            if e.code != "BAD_VERSION":
+                raise
+            # merged by someone else concurrently; retry
+
+
+def read_events(coord, cluster: str, partition: str) -> List[Dict]:
+    raw = coord.get_or_none(_events_path(cluster, partition))
+    return json.loads(bytes(raw).decode()) if raw else []
+
+
+def analyze_leader_history(coord, cluster: str, partition: str) -> Dict:
+    """EventHistoryAnalysisTool essentials: handoff counts + last leader."""
+    events = read_events(coord, cluster, partition)
+    promotions = [e for e in events if e["type"] == "follower_to_leader_success"]
+    failures = [e for e in events if e["type"].endswith("_failure")]
+    return {
+        "num_events": len(events),
+        "num_promotions": len(promotions),
+        "num_failures": len(failures),
+        "last_leader": promotions[-1]["originator"] if promotions else None,
+        "events": events,
+    }

@@ -1,0 +1,114 @@
+"""Admin RPC helpers for the control plane — the Utils.java equivalent.
+
+Reference: cluster_management Utils.java:132-606 — thrift client helpers to
+the local/remote Admin service (addDB, closeDB, clearDB,
+changeDBRoleAndUpStream, getLatestSequenceNumber, checkDB, backupDB(ToS3),
+restoreDB(FromS3), ingestFromS3, compactDB, setDBOptions).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+from ..rpc.client_pool import RpcClientPool
+from ..rpc.errors import RpcApplicationError, RpcError
+from ..rpc.ioloop import IoLoop
+
+log = logging.getLogger(__name__)
+
+
+class AdminClient:
+    """Sync helpers over the async RPC pool (one per control-plane actor)."""
+
+    def __init__(self, ioloop: Optional[IoLoop] = None):
+        self._ioloop = ioloop or IoLoop.default()
+        self._pool = RpcClientPool()
+
+    def call(self, addr: Tuple[str, int], method: str, timeout: float = 60.0,
+             **args) -> Any:
+        async def go():
+            return await self._pool.call(
+                addr[0], addr[1], method, args, timeout=timeout
+            )
+
+        return self._ioloop.run_sync(go(), timeout=timeout + 10)
+
+    def close(self) -> None:
+        self._ioloop.run_sync(self._pool.close())
+
+    # -- Utils.java surface ------------------------------------------------
+
+    def ping(self, addr) -> bool:
+        try:
+            return bool(self.call(addr, "ping", timeout=5.0).get("ok"))
+        except (RpcError, RpcApplicationError):
+            return False
+
+    def add_db(self, addr, db_name: str, role: str = "FOLLOWER",
+               upstream: Optional[Tuple[str, int]] = None,
+               overwrite: bool = False) -> None:
+        args: Dict[str, Any] = {
+            "db_name": db_name, "role": role, "overwrite": overwrite,
+        }
+        if upstream:
+            args["upstream_ip"], args["upstream_port"] = upstream
+        self.call(addr, "add_db", **args)
+
+    def close_db(self, addr, db_name: str) -> None:
+        self.call(addr, "close_db", db_name=db_name)
+
+    def clear_db(self, addr, db_name: str, reopen: bool = True) -> None:
+        self.call(addr, "clear_db", db_name=db_name, reopen_db=reopen)
+
+    def change_db_role_and_upstream(
+        self, addr, db_name: str, new_role: str,
+        upstream: Optional[Tuple[str, int]] = None,
+    ) -> None:
+        args: Dict[str, Any] = {"db_name": db_name, "new_role": new_role}
+        if upstream:
+            args["upstream_ip"], args["upstream_port"] = upstream
+        self.call(addr, "change_db_role_and_upstream", **args)
+
+    def get_sequence_number(self, addr, db_name: str) -> Optional[int]:
+        try:
+            return int(self.call(addr, "get_sequence_number",
+                                 db_name=db_name, timeout=10.0)["seq_num"])
+        except (RpcError, RpcApplicationError):
+            return None
+
+    def check_db(self, addr, db_name: str) -> Optional[dict]:
+        try:
+            return self.call(addr, "check_db", db_name=db_name, timeout=10.0)
+        except (RpcError, RpcApplicationError):
+            return None
+
+    def backup_db_to_store(self, addr, db_name: str, store_uri: str,
+                           backup_path: str) -> dict:
+        return self.call(addr, "backup_db_to_s3", db_name=db_name,
+                         s3_bucket=store_uri, s3_backup_dir=backup_path,
+                         timeout=600.0)
+
+    def restore_db_from_store(
+        self, addr, db_name: str, store_uri: str, backup_path: str,
+        upstream: Optional[Tuple[str, int]] = None,
+    ) -> dict:
+        args: Dict[str, Any] = {
+            "db_name": db_name, "s3_bucket": store_uri,
+            "s3_backup_dir": backup_path,
+        }
+        if upstream:
+            args["upstream_ip"], args["upstream_port"] = upstream
+        return self.call(addr, "restore_db_from_s3", timeout=600.0, **args)
+
+    def ingest_from_store(self, addr, db_name: str, store_uri: str,
+                          sst_path: str, **kw) -> dict:
+        return self.call(addr, "add_s3_sst_files_to_db", db_name=db_name,
+                         s3_bucket=store_uri, s3_path=sst_path,
+                         timeout=600.0, **kw)
+
+    def compact_db(self, addr, db_name: str) -> None:
+        self.call(addr, "compact_db", db_name=db_name, timeout=600.0)
+
+    def set_db_options(self, addr, db_name: str, options: Dict) -> None:
+        self.call(addr, "set_db_options", db_name=db_name, options=options)

@@ -1,0 +1,97 @@
+"""Cluster data model: coordinator path layout + JSON payloads.
+
+Path conventions (the ZK tree equivalent):
+
+    /clusters/<cluster>/instances/<instance_id>        ephemeral instance info
+    /clusters/<cluster>/resources/<segment>            resource definition
+    /clusters/<cluster>/assignments/<instance_id>      controller → participant
+    /clusters/<cluster>/currentstates/<instance_id>    participant → world
+    /clusters/<cluster>/partitionstate/<partition>     leader seq checkpoints
+    /clusters/<cluster>/locks/partitions/<partition>   per-partition mutex
+    /clusters/<cluster>/controller                     leader election
+    /clusters/<cluster>/events/<partition>             leader-handoff history
+    /clusters/<cluster>/config/<segment>               resource configs
+    /clusters/<cluster>/tasks/queue, /tasks/results    task framework
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+# states (LeaderFollower model; MasterSlave aliases map onto these)
+OFFLINE = "OFFLINE"
+FOLLOWER = "FOLLOWER"
+LEADER = "LEADER"
+ONLINE = "ONLINE"      # OnlineOffline / Cache models
+STANDBY = "STANDBY"    # CdcLeaderStandby
+DROPPED = "DROPPED"
+ERROR = "ERROR"
+
+
+def cluster_path(cluster: str, *parts: str) -> str:
+    return "/".join(["", "clusters", cluster, *parts])
+
+
+@dataclass
+class InstanceInfo:
+    instance_id: str
+    host: str
+    admin_port: int
+    repl_port: int
+    az: str = ""
+
+    def encode(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "InstanceInfo":
+        return cls(**json.loads(bytes(raw).decode()))
+
+
+@dataclass
+class ResourceDef:
+    segment: str
+    num_shards: int
+    replicas: int = 3
+    state_model: str = "LeaderFollower"
+
+    def encode(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "ResourceDef":
+        return cls(**json.loads(bytes(raw).decode()))
+
+
+@dataclass
+class PartitionAssignment:
+    """One partition's target on one instance."""
+
+    state: str
+    upstream: Optional[str] = None  # "host:repl_port" of the leader
+
+    def to_json(self) -> dict:
+        return {"state": self.state, "upstream": self.upstream}
+
+
+def encode_assignments(assignments: Dict[str, PartitionAssignment]) -> bytes:
+    return json.dumps({p: a.to_json() for p, a in assignments.items()}).encode()
+
+
+def decode_assignments(raw: bytes) -> Dict[str, PartitionAssignment]:
+    if not raw:
+        return {}
+    d = json.loads(bytes(raw).decode())
+    return {p: PartitionAssignment(**v) for p, v in d.items()}
+
+
+def encode_states(states: Dict[str, str]) -> bytes:
+    return json.dumps(states).encode()
+
+
+def decode_states(raw: Optional[bytes]) -> Dict[str, str]:
+    if not raw:
+        return {}
+    return json.loads(bytes(raw).decode())

@@ -1,0 +1,199 @@
+"""Participant: joins the cluster and drives state transitions.
+
+Reference: Participant.java:67-512 — started in the embedded JVM by
+``common::JoinCluster`` (helix_client.cpp:216-227); registers the state
+-model factory by type, executes controller-issued transitions against the
+local Admin service, reports current states. Rebuilt natively: the
+participant is a plain object the service process constructs — no JVM.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+from .coordinator import CoordinatorClient
+from .helix_utils import AdminClient
+from .model import (
+    DROPPED,
+    ERROR,
+    InstanceInfo,
+    OFFLINE,
+    cluster_path,
+    decode_assignments,
+    encode_states,
+)
+from .state_models import FACTORIES
+from .state_models.base import ClusterContext, TransitionError
+
+log = logging.getLogger(__name__)
+
+
+class Participant:
+    def __init__(
+        self,
+        coord_host: str,
+        coord_port: int,
+        cluster: str,
+        instance: InstanceInfo,
+        state_model: str = "LeaderFollower",
+        backup_store_uri: Optional[str] = None,
+        transition_workers: int = 4,
+        catch_up_timeout: float = 30.0,
+    ):
+        self.cluster = cluster
+        self.instance = instance
+        self.coord = CoordinatorClient(coord_host, coord_port)
+        self.admin = AdminClient()
+        self.ctx = ClusterContext(
+            self.coord, self.admin, cluster, instance,
+            backup_store_uri=backup_store_uri,
+            catch_up_timeout=catch_up_timeout,
+        )
+        factory_cls = FACTORIES[state_model]
+        self.factory = factory_cls(self.ctx)
+        self._current: Dict[str, str] = {}
+        self._applied_upstream: Dict[str, str] = {}
+        self._state_lock = threading.Lock()
+        self._executor = ThreadPoolExecutor(
+            max_workers=transition_workers, thread_name_prefix="transition"
+        )
+        self._inflight: Dict[str, bool] = {}
+        self._path = lambda *p: cluster_path(cluster, *p)
+        self._stopped = False
+        # register (ephemeral) + publish empty current state + watch
+        self.coord.ensure(self._path("instances"))
+        self.coord.create(
+            self._path("instances", instance.instance_id),
+            instance.encode(), ephemeral=True,
+        )
+        self.coord.put(
+            self._path("currentstates", instance.instance_id),
+            encode_states({}),
+        )
+        self._watch_stop = self.coord.watch(
+            self._path("assignments", instance.instance_id),
+            self._on_assignments,
+        )
+
+    # ------------------------------------------------------------------
+
+    def _on_assignments(self, snap: dict) -> None:
+        if self._stopped:
+            return
+        targets = decode_assignments(bytes(snap.get("value") or b""))
+        with self._state_lock:
+            partitions = set(targets) | set(self._current)
+            for partition in partitions:
+                target = targets.get(partition)
+                target_state = target.state if target else DROPPED
+                cur = self._current.get(partition, OFFLINE)
+                if self._inflight.get(partition):
+                    continue
+                if cur == target_state:
+                    # State already right — but the upstream may have moved
+                    # (leader handoff): repoint without a state transition
+                    # (reference "repoint all others",
+                    # LeaderFollowerStateModelFactory.java promote step).
+                    if (
+                        target is not None
+                        and target.upstream
+                        and self._applied_upstream.get(partition)
+                        != target.upstream
+                        and target_state in ("FOLLOWER", "SLAVE")
+                    ):
+                        self._inflight[partition] = True
+                        self._executor.submit(
+                            self._run_repoint, partition, target_state,
+                            target.upstream,
+                        )
+                    continue
+                self._inflight[partition] = True
+                self._executor.submit(
+                    self._run_transition, partition, cur, target_state
+                )
+
+    def _run_transition(self, partition: str, from_state: str,
+                        to_state: str) -> None:
+        try:
+            model = self.factory.get(partition)
+            try:
+                steps = model.plan(from_state, to_state)
+            except TransitionError:
+                # e.g. LEADER -> DROPPED passes through FOLLOWER/OFFLINE
+                steps = None
+            if steps is None:
+                log.error("%s: no path %s->%s", partition, from_state, to_state)
+                self._set_current(partition, ERROR)
+                return
+            state = from_state
+            for a, b in steps:
+                log.info("%s: %s -> %s", partition, a, b)
+                model.transition(a, b)
+                state = b
+                self._set_current(partition, state)
+        except Exception:
+            log.exception("%s: transition %s->%s failed", partition,
+                          from_state, to_state)
+            self._set_current(partition, ERROR)
+        finally:
+            with self._state_lock:
+                self._inflight.pop(partition, None)
+            # re-evaluate: the target may have moved meanwhile
+            if not self._stopped:
+                raw = self.coord.get_or_none(
+                    self._path("assignments", self.instance.instance_id)
+                )
+                if raw is not None:
+                    self._on_assignments({"value": raw})
+
+    def _run_repoint(self, partition: str, state: str, upstream: str) -> None:
+        from ..utils.segment_utils import partition_name_to_db_name
+
+        try:
+            host, _, port = upstream.partition(":")
+            db_name = partition_name_to_db_name(partition)
+            log.info("%s: repointing upstream -> %s", partition, upstream)
+            self.ctx.admin.change_db_role_and_upstream(
+                self.ctx.local_admin_addr, db_name, state, (host, int(port))
+            )
+            with self._state_lock:
+                self._applied_upstream[partition] = upstream
+        except Exception:
+            log.exception("%s: repoint failed", partition)
+        finally:
+            with self._state_lock:
+                self._inflight.pop(partition, None)
+
+    def _set_current(self, partition: str, state: str) -> None:
+        with self._state_lock:
+            if state == DROPPED:
+                self._current.pop(partition, None)
+            else:
+                self._current[partition] = state
+            snapshot = dict(self._current)
+        self.coord.put(
+            self._path("currentstates", self.instance.instance_id),
+            encode_states(snapshot),
+        )
+
+    @property
+    def current_states(self) -> Dict[str, str]:
+        with self._state_lock:
+            return dict(self._current)
+
+    def stop(self) -> None:
+        """shutDownParticipant (Participant.java) — drop membership."""
+        self._stopped = True
+        self._watch_stop.set()
+        self._executor.shutdown(wait=True)
+        try:
+            self.coord.delete_if_exists(
+                self._path("instances", self.instance.instance_id)
+            )
+        except Exception:
+            pass
+        self.coord.close()
+        self.admin.close()

@@ -1,0 +1,101 @@
+"""Shard-map publishers.
+
+Reference: cluster_management publisher/ — local file dump, HTTP post,
+dedup wrapper, parallel fan-out, ZK per-resource publisher. Here: local
+file (what data-plane routers watch), coordinator node, callback, dedup
+and parallel combinators.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Callable, Dict, List
+
+from ..utils.misc import write_file_atomic
+from .model import cluster_path
+
+log = logging.getLogger(__name__)
+
+
+class ShardMapPublisher:
+    def publish(self, shard_map: Dict) -> None:
+        raise NotImplementedError
+
+
+class LocalFilePublisher(ShardMapPublisher):
+    """Writes the JSON map to a file — routers hot-reload it (the reference
+    shard-map-file contract)."""
+
+    def __init__(self, path: str):
+        self._path = path
+
+    def publish(self, shard_map: Dict) -> None:
+        write_file_atomic(
+            self._path, json.dumps(shard_map, sort_keys=True).encode()
+        )
+
+
+class CoordinatorNodePublisher(ShardMapPublisher):
+    """Publishes into the coordinator tree (the ZK-publisher analog) for
+    shard-map agents to sync down."""
+
+    def __init__(self, coord, cluster: str):
+        self._coord = coord
+        self._cluster = cluster
+
+    def publish(self, shard_map: Dict) -> None:
+        self._coord.put(
+            cluster_path(self._cluster, "shardmap"),
+            json.dumps(shard_map, sort_keys=True).encode(),
+        )
+
+
+class CallbackPublisher(ShardMapPublisher):
+    def __init__(self, fn: Callable[[Dict], None]):
+        self._fn = fn
+
+    def publish(self, shard_map: Dict) -> None:
+        self._fn(shard_map)
+
+
+class DedupPublisher(ShardMapPublisher):
+    """Suppresses republishing identical maps (dedup wrapper)."""
+
+    def __init__(self, inner: ShardMapPublisher):
+        self._inner = inner
+        self._last: str = ""
+        self._lock = threading.Lock()
+
+    def publish(self, shard_map: Dict) -> None:
+        encoded = json.dumps(shard_map, sort_keys=True)
+        with self._lock:
+            if encoded == self._last:
+                return
+            self._last = encoded
+        self._inner.publish(shard_map)
+
+
+class ParallelPublisher(ShardMapPublisher):
+    """Fan-out to several publishers (parallel publisher)."""
+
+    def __init__(self, publishers: List[ShardMapPublisher]):
+        self._publishers = publishers
+
+    def publish(self, shard_map: Dict) -> None:
+        threads = [
+            threading.Thread(target=self._safe, args=(p, shard_map))
+            for p in self._publishers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    @staticmethod
+    def _safe(p: ShardMapPublisher, shard_map: Dict) -> None:
+        try:
+            p.publish(shard_map)
+        except Exception:
+            log.exception("shard map publisher failed")

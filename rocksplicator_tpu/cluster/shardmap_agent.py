@@ -1,0 +1,66 @@
+"""Shard-map agents: coordinator-published maps → local files for clients.
+
+Reference: cluster_management shardmapagent/ + ClientShardMapAgent — agents
+subscribing to ZK shard maps and materializing per-cluster local files that
+client-side routers watch.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Dict, List
+
+from ..utils.misc import write_file_atomic
+from .coordinator import CoordinatorClient
+from .model import cluster_path
+
+log = logging.getLogger(__name__)
+
+
+class ShardMapAgent:
+    """Syncs one cluster's published shard map to a local file."""
+
+    def __init__(self, coord_host: str, coord_port: int, cluster: str,
+                 target_path: str):
+        self.cluster = cluster
+        self.target_path = target_path
+        self.coord = CoordinatorClient(coord_host, coord_port)
+        self._watch_stop = self.coord.watch(
+            cluster_path(cluster, "shardmap"), self._on_map
+        )
+
+    def _on_map(self, snap: dict) -> None:
+        if not snap.get("exists"):
+            return
+        try:
+            write_file_atomic(self.target_path, bytes(snap["value"]))
+        except Exception:
+            log.exception("shard map agent write failed")
+
+    def stop(self) -> None:
+        self._watch_stop.set()
+        self.coord.close()
+
+
+class ClientShardMapAgent:
+    """Multi-cluster variant: one agent process materializing a file per
+    cluster under a directory (ClientShardMapAgent)."""
+
+    def __init__(self, coord_host: str, coord_port: int,
+                 clusters: List[str], target_dir: str):
+        import os
+
+        os.makedirs(target_dir, exist_ok=True)
+        self._agents = [
+            ShardMapAgent(
+                coord_host, coord_port, c,
+                f"{target_dir.rstrip('/')}/{c}.json",
+            )
+            for c in clusters
+        ]
+
+    def stop(self) -> None:
+        for a in self._agents:
+            a.stop()

@@ -1,0 +1,100 @@
+"""Spectator: watches the external view and regenerates shard maps.
+
+Reference: Spectator.java:55-426 / DistributedSpectatorMain — a
+leader-standby-elected process running ConfigGenerator on EXTERNAL_VIEW
+changes; the embedded variant rides inside the participant process
+(HelixCustomCodeRunner, Participant.java:449-466). Here both modes are one
+class: standalone=True elects a leader among spectators so only one
+publishes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+from .config_generator import generate_shard_map
+from .coordinator import CoordinatorClient
+from .model import cluster_path
+from .publishers import DedupPublisher, ShardMapPublisher
+
+log = logging.getLogger(__name__)
+
+
+class Spectator:
+    def __init__(
+        self,
+        coord_host: str,
+        coord_port: int,
+        cluster: str,
+        publishers: List[ShardMapPublisher],
+        spectator_id: str = "spectator",
+        standalone: bool = True,
+    ):
+        self.cluster = cluster
+        self.spectator_id = spectator_id
+        self._standalone = standalone
+        self.coord = CoordinatorClient(coord_host, coord_port)
+        self._publisher = DedupPublisher(_Multi(publishers))
+        self._path = lambda *p: cluster_path(cluster, *p)
+        self._kick = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"spectator-{spectator_id}", daemon=True
+        )
+        self._thread.start()
+        self._watches = [
+            self.coord.watch(self._path("currentstates"), self._on_change),
+            self.coord.watch(self._path("instances"), self._on_change),
+        ]
+
+    def _on_change(self, _snap) -> None:
+        self._kick.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self._standalone:
+                    is_leader = (
+                        self.coord.elect_leader(
+                            self._path("spectator_election"), self.spectator_id
+                        )
+                        or self.coord.current_leader(
+                            self._path("spectator_election")
+                        ) == self.spectator_id
+                    )
+                    if not is_leader:
+                        self._kick.wait(1.0)
+                        self._kick.clear()
+                        continue
+                self.publish_once()
+            except Exception:
+                log.exception("spectator loop error")
+            self._kick.wait(1.0)
+            self._kick.clear()
+
+    def publish_once(self) -> dict:
+        shard_map = generate_shard_map(self.coord, self.cluster)
+        self._publisher.publish(shard_map)
+        return shard_map
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        for w in self._watches:
+            w.set()
+        self._thread.join(timeout=5.0)
+        self.coord.close()
+
+
+class _Multi(ShardMapPublisher):
+    def __init__(self, publishers: List[ShardMapPublisher]):
+        self._publishers = publishers
+
+    def publish(self, shard_map) -> None:
+        for p in self._publishers:
+            try:
+                p.publish(shard_map)
+            except Exception:
+                log.exception("publisher failed")

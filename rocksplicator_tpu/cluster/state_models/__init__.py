@@ -1,0 +1,25 @@
+"""State-model factories (reference: cluster_management state models)."""
+
+from .base import StateModel, StateModelFactory, TransitionError
+from .leader_follower import LeaderFollowerStateModelFactory
+from .master_slave import MasterSlaveStateModelFactory
+from .online_offline import OnlineOfflineStateModelFactory
+from .cache import CacheStateModelFactory
+from .bootstrap import BootstrapStateModelFactory
+from .cdc_leader_standby import CdcLeaderStandbyStateModelFactory
+
+FACTORIES = {
+    "LeaderFollower": LeaderFollowerStateModelFactory,
+    "MasterSlave": MasterSlaveStateModelFactory,
+    "OnlineOffline": OnlineOfflineStateModelFactory,
+    "Cache": CacheStateModelFactory,
+    "Bootstrap": BootstrapStateModelFactory,
+    "CdcLeaderStandby": CdcLeaderStandbyStateModelFactory,
+}
+
+__all__ = [
+    "StateModel", "StateModelFactory", "TransitionError", "FACTORIES",
+    "LeaderFollowerStateModelFactory", "MasterSlaveStateModelFactory",
+    "OnlineOfflineStateModelFactory", "CacheStateModelFactory",
+    "BootstrapStateModelFactory", "CdcLeaderStandbyStateModelFactory",
+]

@@ -1,0 +1,70 @@
+"""Bootstrap — Online/Offline variant that bootstraps data via message
+ingestion.
+
+Reference: BootstrapStateModelFactory.java:277 — Offline→Online opens the
+db and starts message ingestion (startMessageIngestion) from the resource's
+configured topic; Online→Offline stops ingestion and closes.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ...utils.segment_utils import (
+    db_name_to_segment,
+    partition_name_to_db_name,
+)
+from ..model import DROPPED, OFFLINE, ONLINE
+from .base import StateModel, StateModelFactory
+
+log = logging.getLogger(__name__)
+
+
+class BootstrapStateModel(StateModel):
+    edges = [
+        (OFFLINE, ONLINE),
+        (ONLINE, OFFLINE),
+        (OFFLINE, DROPPED),
+    ]
+
+    @property
+    def db_name(self) -> str:
+        return partition_name_to_db_name(self.partition)
+
+    def on_become_online_from_offline(self) -> None:
+        ctx = self.ctx
+        ctx.admin.add_db(ctx.local_admin_addr, self.db_name, "NOOP")
+        cfg = ctx.resource_config(db_name_to_segment(self.db_name))
+        topic = cfg.get("kafka_topic")
+        broker_path = cfg.get("kafka_broker_serverset_path", "")
+        if topic:
+            ctx.admin.call(
+                ctx.local_admin_addr, "start_message_ingestion",
+                db_name=self.db_name, topic_name=topic,
+                kafka_broker_serverset_path=broker_path,
+            )
+
+    def on_become_offline_from_online(self) -> None:
+        ctx = self.ctx
+        try:
+            ctx.admin.call(
+                ctx.local_admin_addr, "stop_message_ingestion",
+                db_name=self.db_name,
+            )
+        except Exception:
+            log.debug("%s: no ingestion to stop", self.db_name)
+        ctx.admin.close_db(ctx.local_admin_addr, self.db_name)
+
+    def on_become_dropped_from_offline(self) -> None:
+        try:
+            self.ctx.admin.add_db(self.ctx.local_admin_addr, self.db_name, "NOOP")
+        except Exception:
+            pass
+        self.ctx.admin.clear_db(
+            self.ctx.local_admin_addr, self.db_name, reopen=False
+        )
+
+
+class BootstrapStateModelFactory(StateModelFactory):
+    model_class = BootstrapStateModel
+    name = "Bootstrap"

@@ -1,0 +1,32 @@
+"""Cache — trivial online/offline for cache nodes (no storage ops).
+
+Reference: CacheStateModelFactory.java:99 — transitions are no-ops beyond
+membership; the router simply includes/excludes the host.
+"""
+
+from __future__ import annotations
+
+from ..model import DROPPED, OFFLINE, ONLINE
+from .base import StateModel, StateModelFactory
+
+
+class CacheStateModel(StateModel):
+    edges = [
+        (OFFLINE, ONLINE),
+        (ONLINE, OFFLINE),
+        (OFFLINE, DROPPED),
+    ]
+
+    def on_become_online_from_offline(self) -> None:
+        pass
+
+    def on_become_offline_from_online(self) -> None:
+        pass
+
+    def on_become_dropped_from_offline(self) -> None:
+        pass
+
+
+class CacheStateModelFactory(StateModelFactory):
+    model_class = CacheStateModel
+    name = "Cache"

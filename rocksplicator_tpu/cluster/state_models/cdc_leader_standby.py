@@ -1,0 +1,74 @@
+"""CdcLeaderStandby — observer management for CDC nodes.
+
+Reference: CdcLeaderStandbyStateModelFactory.java + CdcUtils.java:56-84 —
+a LeaderStandby machine where becoming LEADER calls CdcAdmin addObserver
+(pointing at the partition's current data-plane leader) and leaving calls
+removeObserver. The CDC service itself is cdc_admin (admin/cdc.py here).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Tuple
+
+from ...utils.segment_utils import partition_name_to_db_name
+from ..model import DROPPED, LEADER, OFFLINE, STANDBY
+from .base import StateModel, StateModelFactory, TransitionError
+
+log = logging.getLogger(__name__)
+
+
+class CdcLeaderStandbyStateModel(StateModel):
+    edges = [
+        (OFFLINE, STANDBY),
+        (STANDBY, LEADER),
+        (LEADER, STANDBY),
+        (STANDBY, OFFLINE),
+        (OFFLINE, DROPPED),
+    ]
+
+    @property
+    def db_name(self) -> str:
+        return partition_name_to_db_name(self.partition)
+
+    def _data_leader(self) -> Optional[Tuple[str, int]]:
+        view = self.ctx.external_view(self.partition)
+        instances = self.ctx.live_instances()
+        for iid, state in view.items():
+            if state in ("LEADER", "MASTER") and iid in instances:
+                info = instances[iid]
+                return (info.host, info.repl_port)
+        return None
+
+    def on_become_standby_from_offline(self) -> None:
+        pass  # standby holds no observer
+
+    def on_become_leader_from_standby(self) -> None:
+        upstream = self._data_leader()
+        if upstream is None:
+            raise TransitionError(f"{self.partition}: no data-plane leader")
+        self.ctx.admin.call(
+            self.ctx.local_admin_addr, "add_observer",
+            db_name=self.db_name,
+            upstream_ip=upstream[0], upstream_port=upstream[1],
+        )
+
+    def on_become_standby_from_leader(self) -> None:
+        try:
+            self.ctx.admin.call(
+                self.ctx.local_admin_addr, "remove_observer",
+                db_name=self.db_name,
+            )
+        except Exception:
+            log.debug("%s: no observer to remove", self.db_name)
+
+    def on_become_offline_from_standby(self) -> None:
+        pass
+
+    def on_become_dropped_from_offline(self) -> None:
+        pass
+
+
+class CdcLeaderStandbyStateModelFactory(StateModelFactory):
+    model_class = CdcLeaderStandbyStateModel
+    name = "CdcLeaderStandby"

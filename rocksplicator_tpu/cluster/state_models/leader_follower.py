@@ -1,0 +1,257 @@
+"""LeaderFollower — the core orchestration state machine.
+
+Reference: LeaderFollowerStateModelFactory.java:51-96 (state diagram) and
+per-transition algorithms:
+
+- Offline→Follower (:434-568): per-partition lock → addDB FOLLOWER →
+  needRebuildDB (WAL-age / seq-gap heuristic vs live replicas) → if stale,
+  backup-from-peer + restore → catch-up loop → repoint to the true leader
+  → apply resource configs from the coordinator.
+- Follower→Leader (:230-303): lock → verify no live leader in the external
+  view → find the replica with the highest seq; if someone is ahead, catch
+  up via a temporary upstream → 3-node-failure guard vs the persisted last
+  leader seq → promote self → checkpoint the leader seq.
+- Leader→Follower, Follower→Offline, Offline→Dropped: demote / closeDB /
+  clearDB.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional, Tuple
+
+from ...utils.segment_utils import (
+    db_name_to_segment,
+    partition_name_to_db_name,
+)
+from ..model import DROPPED, FOLLOWER, LEADER, OFFLINE
+from .base import StateModel, StateModelFactory, TransitionError
+
+log = logging.getLogger(__name__)
+
+# if a replica is this many seqs behind the best peer, rebuild from a
+# snapshot rather than WAL catch-up (needRebuildDB analog)
+REBUILD_SEQ_GAP = 100_000
+CATCH_UP_MARGIN = 10
+
+
+class LeaderFollowerStateModel(StateModel):
+    edges = [
+        (OFFLINE, FOLLOWER),
+        (FOLLOWER, LEADER),
+        (LEADER, FOLLOWER),
+        (FOLLOWER, OFFLINE),
+        (OFFLINE, DROPPED),
+    ]
+
+    # -- helpers -----------------------------------------------------------
+
+    @property
+    def db_name(self) -> str:
+        return partition_name_to_db_name(self.partition)
+
+    def _live_replicas(self) -> Dict[str, Tuple]:
+        """instance_id -> (info, state, seq) for live hosts of my partition."""
+        ctx = self.ctx
+        out = {}
+        view = ctx.external_view(self.partition)
+        instances = ctx.live_instances()
+        for iid, state in view.items():
+            info = instances.get(iid)
+            if info is None:
+                continue
+            seq = ctx.admin.get_sequence_number(
+                (info.host, info.admin_port), self.db_name
+            )
+            out[iid] = (info, state, seq)
+        return out
+
+    def _current_leader_addr(self) -> Optional[Tuple[str, int]]:
+        for iid, (info, state, _seq) in self._live_replicas().items():
+            if state == LEADER and iid != self.ctx.instance.instance_id:
+                return (info.host, info.repl_port)
+        return None
+
+    def _catch_up(self, target_addr: Tuple[str, int], deadline: float) -> bool:
+        """Wait until local seq is within margin of the target's
+        (catch-up loop, LeaderFollowerStateModelFactory.java:570-599)."""
+        ctx = self.ctx
+        admin_target = target_addr
+        while time.monotonic() < deadline:
+            local = ctx.admin.get_sequence_number(
+                ctx.local_admin_addr, self.db_name
+            )
+            remote = ctx.admin.get_sequence_number(admin_target, self.db_name)
+            if local is None or remote is None:
+                return False
+            if local + CATCH_UP_MARGIN >= remote:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def _apply_resource_configs(self) -> None:
+        """reference :500-525 — reapply per-resource db options from the
+        coordinator after (re)adding the db."""
+        segment = db_name_to_segment(self.db_name)
+        cfg = self.ctx.resource_config(segment)
+        options = cfg.get("db_options")
+        if options:
+            try:
+                self.ctx.admin.set_db_options(
+                    self.ctx.local_admin_addr, self.db_name, options
+                )
+            except Exception:
+                log.warning("%s: applying resource configs failed", self.db_name)
+
+    # -- transitions -------------------------------------------------------
+
+    def on_become_follower_from_offline(self) -> None:
+        ctx = self.ctx
+        ctx.log_event(self.partition, "offline_to_follower_init")
+        lock = ctx.partition_lock(self.partition)
+        if lock is None:
+            raise TransitionError(f"{self.partition}: partition lock timeout")
+        try:
+            replicas = self._live_replicas()
+            leader = None
+            best_seq = -1
+            best_addr = None
+            for iid, (info, state, seq) in replicas.items():
+                if iid == ctx.instance.instance_id:
+                    continue
+                if state == LEADER:
+                    leader = info
+                if seq is not None and seq > best_seq:
+                    best_seq = seq
+                    best_addr = info
+            upstream = (
+                (leader.host, leader.repl_port) if leader
+                else (best_addr.host, best_addr.repl_port) if best_addr
+                else ctx.local_repl_addr  # bootstrap: self-upstream, no-op
+            )
+            ctx.admin.add_db(
+                ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream
+            )
+            # needRebuildDB: far behind the best replica -> snapshot rebuild
+            local = ctx.admin.get_sequence_number(
+                ctx.local_admin_addr, self.db_name
+            ) or 0
+            if (
+                best_seq - local > REBUILD_SEQ_GAP
+                and ctx.backup_store_uri
+                and best_addr is not None
+            ):
+                ctx.log_event(self.partition, "rebuild_from_peer_init")
+                peer = (best_addr.host, best_addr.admin_port)
+                backup_path = f"rebuilds/{self.db_name}"
+                ctx.admin.backup_db_to_store(
+                    peer, self.db_name, ctx.backup_store_uri, backup_path
+                )
+                ctx.admin.restore_db_from_store(
+                    ctx.local_admin_addr, self.db_name,
+                    ctx.backup_store_uri, backup_path, upstream,
+                )
+                ctx.log_event(self.partition, "rebuild_from_peer_success")
+            if best_addr is not None:
+                self._catch_up(
+                    (best_addr.host, best_addr.admin_port),
+                    time.monotonic() + ctx.catch_up_timeout,
+                )
+            self._apply_resource_configs()
+            ctx.log_event(self.partition, "offline_to_follower_success")
+        except Exception:
+            ctx.log_event(self.partition, "offline_to_follower_failure")
+            raise
+        finally:
+            ctx.release_partition_lock(lock)
+
+    def on_become_leader_from_follower(self) -> None:
+        ctx = self.ctx
+        ctx.log_event(self.partition, "follower_to_leader_init")
+        lock = ctx.partition_lock(self.partition)
+        if lock is None:
+            raise TransitionError(f"{self.partition}: partition lock timeout")
+        try:
+            replicas = self._live_replicas()
+            # no-live-leader check (reference :230-260)
+            for iid, (info, state, _seq) in replicas.items():
+                if state == LEADER and iid != ctx.instance.instance_id:
+                    raise TransitionError(
+                        f"{self.partition}: {iid} is still LEADER"
+                    )
+            local = ctx.admin.get_sequence_number(
+                ctx.local_admin_addr, self.db_name
+            ) or 0
+            # highest-seq election: catch up from any replica ahead of us
+            best_iid, best_seq, best_info = None, local, None
+            for iid, (info, _state, seq) in replicas.items():
+                if iid == ctx.instance.instance_id or seq is None:
+                    continue
+                if seq > best_seq:
+                    best_iid, best_seq, best_info = iid, seq, info
+            if best_info is not None:
+                ctx.log_event(self.partition, "catch_up_via_peer",
+                              f"peer={best_iid} seq={best_seq}")
+                ctx.admin.change_db_role_and_upstream(
+                    ctx.local_admin_addr, self.db_name, "FOLLOWER",
+                    (best_info.host, best_info.repl_port),
+                )
+                self._catch_up(
+                    (best_info.host, best_info.admin_port),
+                    time.monotonic() + ctx.catch_up_timeout,
+                )
+            # 3-node-failure guard (reference :291-303): refuse promotion if
+            # we're far behind the last known leader seq in the coordinator.
+            persisted = ctx.get_partition_seq(self.partition)
+            local = ctx.admin.get_sequence_number(
+                ctx.local_admin_addr, self.db_name
+            ) or 0
+            if persisted is not None and local + REBUILD_SEQ_GAP < persisted:
+                raise TransitionError(
+                    f"{self.partition}: local seq {local} too far behind "
+                    f"last leader seq {persisted}; refusing promotion"
+                )
+            ctx.admin.change_db_role_and_upstream(
+                ctx.local_admin_addr, self.db_name, "LEADER"
+            )
+            ctx.set_partition_seq(self.partition, local)
+            ctx.log_event(self.partition, "follower_to_leader_success")
+        except Exception:
+            ctx.log_event(self.partition, "follower_to_leader_failure")
+            raise
+        finally:
+            ctx.release_partition_lock(lock)
+
+    def on_become_follower_from_leader(self) -> None:
+        ctx = self.ctx
+        ctx.log_event(self.partition, "leader_to_follower_init")
+        # checkpoint the final leader seq before demotion
+        seq = ctx.admin.get_sequence_number(ctx.local_admin_addr, self.db_name)
+        if seq is not None:
+            ctx.set_partition_seq(self.partition, seq)
+        upstream = self._current_leader_addr() or ctx.local_repl_addr
+        ctx.admin.change_db_role_and_upstream(
+            ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream
+        )
+        ctx.log_event(self.partition, "leader_to_follower_success")
+
+    def on_become_offline_from_follower(self) -> None:
+        self.ctx.admin.close_db(self.ctx.local_admin_addr, self.db_name)
+
+    def on_become_dropped_from_offline(self) -> None:
+        # destroy local data (reference: Offline→Dropped removes the db)
+        try:
+            self.ctx.admin.add_db(
+                self.ctx.local_admin_addr, self.db_name, "NOOP"
+            )
+        except Exception:
+            pass
+        self.ctx.admin.clear_db(
+            self.ctx.local_admin_addr, self.db_name, reopen=False
+        )
+
+
+class LeaderFollowerStateModelFactory(StateModelFactory):
+    model_class = LeaderFollowerStateModel
+    name = "LeaderFollower"

@@ -1,0 +1,43 @@
+"""MasterSlave — the older naming of the LeaderFollower machine.
+
+Reference: MasterSlaveStateModelFactory.java (669 LoC) — same algorithm
+with MASTER/SLAVE state names. The admin plane accepts both role namings,
+so this subclasses the LeaderFollower transitions under aliased states.
+"""
+
+from __future__ import annotations
+
+from ..model import DROPPED, OFFLINE
+from .base import StateModelFactory
+from .leader_follower import LeaderFollowerStateModel
+
+MASTER = "MASTER"
+SLAVE = "SLAVE"
+
+
+class MasterSlaveStateModel(LeaderFollowerStateModel):
+    edges = [
+        (OFFLINE, SLAVE),
+        (SLAVE, MASTER),
+        (MASTER, SLAVE),
+        (SLAVE, OFFLINE),
+        (OFFLINE, DROPPED),
+    ]
+
+    # aliases onto the LeaderFollower transition bodies
+    def on_become_slave_from_offline(self):
+        self.on_become_follower_from_offline()
+
+    def on_become_master_from_slave(self):
+        self.on_become_leader_from_follower()
+
+    def on_become_slave_from_master(self):
+        self.on_become_follower_from_leader()
+
+    def on_become_offline_from_slave(self):
+        self.on_become_offline_from_follower()
+
+
+class MasterSlaveStateModelFactory(StateModelFactory):
+    model_class = MasterSlaveStateModel
+    name = "MasterSlave"

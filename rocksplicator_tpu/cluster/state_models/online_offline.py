@@ -1,0 +1,43 @@
+"""OnlineOffline — read-only serving clusters.
+
+Reference: OnlineOfflineStateModelFactory.java:168 — Offline→Online opens
+the db standalone (no replication), Online→Offline closes it.
+"""
+
+from __future__ import annotations
+
+from ...utils.segment_utils import partition_name_to_db_name
+from ..model import DROPPED, OFFLINE, ONLINE
+from .base import StateModel, StateModelFactory
+
+
+class OnlineOfflineStateModel(StateModel):
+    edges = [
+        (OFFLINE, ONLINE),
+        (ONLINE, OFFLINE),
+        (OFFLINE, DROPPED),
+    ]
+
+    @property
+    def db_name(self) -> str:
+        return partition_name_to_db_name(self.partition)
+
+    def on_become_online_from_offline(self) -> None:
+        self.ctx.admin.add_db(self.ctx.local_admin_addr, self.db_name, "NOOP")
+
+    def on_become_offline_from_online(self) -> None:
+        self.ctx.admin.close_db(self.ctx.local_admin_addr, self.db_name)
+
+    def on_become_dropped_from_offline(self) -> None:
+        try:
+            self.ctx.admin.add_db(self.ctx.local_admin_addr, self.db_name, "NOOP")
+        except Exception:
+            pass
+        self.ctx.admin.clear_db(
+            self.ctx.local_admin_addr, self.db_name, reopen=False
+        )
+
+
+class OnlineOfflineStateModelFactory(StateModelFactory):
+    model_class = OnlineOfflineStateModel
+    name = "OnlineOffline"

@@ -1,0 +1,256 @@
+"""Task framework: Backup / Restore / Ingest / Dedup jobs.
+
+Reference: cluster_management task/ — Helix Task framework factories
+(BackupTask backs one partition to cloud, RestoreTask, IngestTask calling
+ingestFromS3, DedupTask) with job configs carrying store path, version,
+rate limits. Here: a coordinator-queued job model; workers claim jobs with
+a lock, execute against the owning instance's Admin service, and record
+results.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from .coordinator import CoordinatorClient
+from .helix_utils import AdminClient
+from .model import InstanceInfo, cluster_path, decode_states
+
+log = logging.getLogger(__name__)
+
+_LEADERLIKE = {"LEADER", "MASTER"}
+
+
+class TaskRunner:
+    """Executes one task type against a partition's owning instance."""
+
+    name = "base"
+
+    def run(self, worker: "TaskWorker", job: Dict) -> Dict:
+        raise NotImplementedError
+
+    # -- shared helpers ----------------------------------------------------
+
+    @staticmethod
+    def _find_owner(worker: "TaskWorker", partition: str,
+                    prefer_leader: bool = True):
+        path = worker._path
+        coord = worker.coord
+        instances: Dict[str, InstanceInfo] = {}
+        for iid in coord.list(path("instances")):
+            raw = coord.get_or_none(path("instances", iid))
+            if raw:
+                instances[iid] = InstanceInfo.decode(raw)
+        fallback = None
+        for iid, info in instances.items():
+            states = decode_states(
+                coord.get_or_none(path("currentstates", iid))
+            )
+            state = states.get(partition)
+            if state is None:
+                continue
+            if state in _LEADERLIKE:
+                return info
+            fallback = fallback or info
+        return None if prefer_leader and fallback is None else fallback
+
+
+class BackupTask(TaskRunner):
+    """task/BackupTask.java:1-60 — back one partition up to the store."""
+
+    name = "Backup"
+
+    def run(self, worker, job):
+        from ..utils.segment_utils import partition_name_to_db_name
+
+        partition = job["partition"]
+        db_name = partition_name_to_db_name(partition)
+        owner = self._find_owner(worker, partition)
+        if owner is None:
+            raise RuntimeError(f"no live owner for {partition}")
+        version = job.get("version") or time.strftime("%Y%m%d-%H%M%S")
+        backup_path = f"{job.get('store_path', 'backups')}/{db_name}/{version}"
+        r = worker.admin.backup_db_to_store(
+            (owner.host, owner.admin_port), db_name,
+            job["store_uri"], backup_path,
+        )
+        return {"backup_path": backup_path, "seq": r.get("seq")}
+
+
+class RestoreTask(TaskRunner):
+    name = "Restore"
+
+    def run(self, worker, job):
+        from ..utils.segment_utils import partition_name_to_db_name
+
+        partition = job["partition"]
+        db_name = partition_name_to_db_name(partition)
+        owner = self._find_owner(worker, partition, prefer_leader=False)
+        if owner is None:
+            raise RuntimeError(f"no live owner for {partition}")
+        r = worker.admin.restore_db_from_store(
+            (owner.host, owner.admin_port), db_name,
+            job["store_uri"], job["backup_path"],
+        )
+        return {"seq": r.get("seq")}
+
+
+class IngestTask(TaskRunner):
+    """task/IngestTask.java — calls the SST bulk-ingest RPC."""
+
+    name = "Ingest"
+
+    def run(self, worker, job):
+        from ..utils.segment_utils import partition_name_to_db_name
+
+        partition = job["partition"]
+        db_name = partition_name_to_db_name(partition)
+        owner = self._find_owner(worker, partition)
+        if owner is None:
+            raise RuntimeError(f"no live owner for {partition}")
+        r = worker.admin.ingest_from_store(
+            (owner.host, owner.admin_port), db_name,
+            job["store_uri"], job["sst_path"],
+            ingest_behind=job.get("ingest_behind", False),
+            allow_overlapping_keys=job.get("allow_overlapping_keys", True),
+            compact_db_after_load=job.get("compact_after", False),
+        )
+        return dict(r)
+
+
+class DedupTask(TaskRunner):
+    """task/DedupTask.java — full compaction deduplicates a partition."""
+
+    name = "Dedup"
+
+    def run(self, worker, job):
+        from ..utils.segment_utils import partition_name_to_db_name
+
+        partition = job["partition"]
+        db_name = partition_name_to_db_name(partition)
+        owner = self._find_owner(worker, partition)
+        if owner is None:
+            raise RuntimeError(f"no live owner for {partition}")
+        worker.admin.compact_db((owner.host, owner.admin_port), db_name)
+        return {}
+
+
+TASK_RUNNERS: Dict[str, TaskRunner] = {
+    t.name: t() for t in (BackupTask, RestoreTask, IngestTask, DedupTask)
+}
+
+
+def submit_task(coord: CoordinatorClient, cluster: str, task_type: str,
+                job: Dict) -> str:
+    """Enqueue a job; returns the task id."""
+    task_id = f"{task_type.lower()}-{uuid.uuid4().hex[:8]}"
+    payload = {"task_id": task_id, "type": task_type, "job": job,
+               "submitted_ms": int(time.time() * 1000)}
+    coord.put(
+        cluster_path(cluster, "tasks", "queue", task_id),
+        json.dumps(payload).encode(),
+    )
+    return task_id
+
+
+def task_result(coord: CoordinatorClient, cluster: str, task_id: str,
+                timeout: float = 0.0) -> Optional[Dict]:
+    path = cluster_path(cluster, "tasks", "results", task_id)
+    deadline = time.monotonic() + timeout
+    while True:
+        raw = coord.get_or_none(path)
+        if raw is not None:
+            return json.loads(bytes(raw).decode())
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(0.1)
+
+
+class TaskWorker:
+    """Claims queued tasks (coordinator lock per task) and runs them."""
+
+    def __init__(self, coord_host: str, coord_port: int, cluster: str,
+                 worker_id: str = "worker",
+                 runners: Optional[Dict[str, TaskRunner]] = None):
+        self.cluster = cluster
+        self.worker_id = worker_id
+        self.coord = CoordinatorClient(coord_host, coord_port)
+        self.admin = AdminClient()
+        self.runners = runners or TASK_RUNNERS
+        self._path = lambda *p: cluster_path(cluster, *p)
+        self._stop = threading.Event()
+        self._kick = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"task-worker-{worker_id}", daemon=True
+        )
+        self._thread.start()
+        self._watch_stop = self.coord.watch(
+            self._path("tasks", "queue"), lambda _s: self._kick.set()
+        )
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._drain()
+            except Exception:
+                log.exception("task worker error")
+            self._kick.wait(1.0)
+            self._kick.clear()
+
+    def _drain(self) -> None:
+        for task_id in self.coord.list(self._path("tasks", "queue")):
+            if self._stop.is_set():
+                return
+            lock = self.coord.acquire_lock(
+                self._path("tasks", "locks", task_id), timeout=0.5
+            )
+            if lock is None:
+                continue
+            try:
+                raw = self.coord.get_or_none(
+                    self._path("tasks", "queue", task_id)
+                )
+                if raw is None:
+                    continue  # another worker finished it
+                payload = json.loads(bytes(raw).decode())
+                result = self._execute(payload)
+                self.coord.put(
+                    self._path("tasks", "results", task_id),
+                    json.dumps(result).encode(),
+                )
+                self.coord.delete_if_exists(
+                    self._path("tasks", "queue", task_id)
+                )
+            finally:
+                self.coord.release_lock(lock)
+
+    def _execute(self, payload: Dict) -> Dict:
+        task_type = payload.get("type", "")
+        runner = self.runners.get(task_type)
+        base = {
+            "task_id": payload.get("task_id"),
+            "type": task_type,
+            "worker": self.worker_id,
+            "finished_ms": int(time.time() * 1000),
+        }
+        if runner is None:
+            return {**base, "ok": False, "error": f"unknown task {task_type}"}
+        try:
+            out = runner.run(self, payload.get("job", {}))
+            return {**base, "ok": True, "result": out}
+        except Exception as e:
+            log.exception("task %s failed", payload.get("task_id"))
+            return {**base, "ok": False, "error": repr(e)}
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        self._watch_stop.set()
+        self._thread.join(timeout=5.0)
+        self.coord.close()
+        self.admin.close()

@@ -79,7 +79,7 @@ def sharded_compaction_step(mesh, model=None):
             squeeze(slo), squeeze(vt), squeeze(vw), squeeze(vl),
             squeeze(valid),
         ))
-        local.pop("needs_cpu_fallback", None)
+        local_fallback = jnp.any(local.pop("needs_cpu_fallback"))
         # 2) assemble the shard's blocks: all_gather over the block axis
         gathered = {
             k: jax.lax.all_gather(v, "block", axis=1)
@@ -108,7 +108,7 @@ def sharded_compaction_step(mesh, model=None):
             flat["seq_hi"], flat["seq_lo"], flat["vtype"],
             flat["val_words"], flat["val_len"], valid2,
         ))
-        final.pop("needs_cpu_fallback", None)
+        fallback = local_fallback | jnp.any(final.pop("needs_cpu_fallback"))
         out_valid = (
             jnp.arange(nb * n)[None, :] < final["count"][:, None]
         )
@@ -118,6 +118,9 @@ def sharded_compaction_step(mesh, model=None):
             )
         )(final["key_words_le"], final["key_len"], out_valid)
         global_count = jax.lax.psum(final["count"].sum(), "shard")
+        # any device needing CPU fallback poisons the whole job (max = OR
+        # across the shard axis; block columns are identical)
+        global_fallback = jax.lax.pmax(fallback.astype(jnp.int32), "shard")
         # re-insert the block axis (replicated) for out_specs
         expand = lambda a: a[:, None]
         return (
@@ -125,6 +128,7 @@ def sharded_compaction_step(mesh, model=None):
             expand(bloom),
             expand(final["count"]),
             global_count[None, None],
+            global_fallback[None, None],
         )
 
     in_spec = P("shard", "block")
@@ -139,6 +143,7 @@ def sharded_compaction_step(mesh, model=None):
             )},
             P("shard", None),
             P("shard", None),
+            P(None, None),
             P(None, None),
         ),
         check_vma=False,

@@ -69,14 +69,16 @@ class TpuCompactionBackend(CompactionBackend):
 
         if len(entries) > MAX_TPU_ENTRIES:
             return cpu()
-        if merge_op is None and any(e[2] == _MERGE for e in entries):
-            # MERGE records without an operator: the reference preserves the
-            # unresolved operand chain — only the CPU path can express that.
-            return cpu()
         try:
             batch = pack_entries(entries, capacity=_next_pow2(len(entries)))
         except UnsupportedBatch as e:
             log.debug("TPU compaction fallback: %s", e)
+            return cpu()
+        if merge_op is None and bool((batch.vtype == _MERGE).any()):
+            # MERGE records without an operator: the reference preserves the
+            # unresolved operand chain — only the CPU path can express that.
+            # (Checked on the packed vtype lane — a numpy any(), not a
+            # Python walk of up to 4M tuples.)
             return cpu()
         result = self._run_batch(batch, merge_op, drop_tombstones)
         if result is None:  # kernel flagged limb-overflow risk
@@ -86,7 +88,9 @@ class TpuCompactionBackend(CompactionBackend):
     def _run_batch(
         self, batch: KVBatch, merge_op: Optional[MergeOperator],
         drop_tombstones: bool,
-    ) -> List[Entry]:
+    ) -> Optional[List[Entry]]:
+        """None means the kernel flagged a condition (limb-overflow risk)
+        requiring the CPU path."""
         jnp = self._jax.numpy
         kind = (
             MergeKind.UINT64_ADD if isinstance(merge_op, UInt64AddOperator)
@@ -126,18 +130,19 @@ class NumpyCompactionBackend(CompactionBackend):
         entries = [e for run in runs for e in run]
         if not entries:
             return iter(())
-        if merge_op is None and any(e[2] == _MERGE for e in entries):
+
+        def cpu():
             return self._fallback.merge_runs(
                 [sorted(entries, key=lambda e: (e[0], -e[1]))],
                 merge_op, drop_tombstones,
             )
+
         try:
             batch = pack_entries(entries)
         except UnsupportedBatch:
-            return self._fallback.merge_runs(
-                [sorted(entries, key=lambda e: (e[0], -e[1]))],
-                merge_op, drop_tombstones,
-            )
+            return cpu()
+        if merge_op is None and bool((batch.vtype == _MERGE).any()):
+            return cpu()
         arrays, count = numpy_merge_resolve(
             batch, uint64_add=merge_op is not None,
             drop_tombstones=drop_tombstones,

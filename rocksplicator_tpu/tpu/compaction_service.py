@@ -127,9 +127,9 @@ class TpuCompactionService:
         host = {k: np.asarray(v) for k, v in out.items()}
         results = []
         for s in range(len(batches)):
-            if bool(host.get("needs_cpu_fallback", np.zeros(1))[s]):
+            if bool(host["needs_cpu_fallback"][s]):
                 results.append(self._cpu_recompute(
-                    batches[s], merge_kind, drop_tombstones))
+                    batches[s], merge_kind, drop_tombstones, num_words))
                 continue
             count = int(host["count"][s])
             entries = unpack_entries(
@@ -145,9 +145,11 @@ class TpuCompactionService:
         return results
 
     def _cpu_recompute(self, batch: KVBatch, merge_kind: MergeKind,
-                       drop_tombstones: bool) -> dict:
+                       drop_tombstones: bool, num_words: int) -> dict:
         """Host recompute for shards the kernel flagged (e.g. one key with
-        ≥2^16 operands — beyond the limb-sum range)."""
+        ≥2^16 operands — beyond the limb-sum range). ``num_words`` is the
+        job-wide bloom size so fallback blooms stay interchangeable with
+        the TPU-built ones."""
         from ..storage.bloom import BloomFilter
         from .backend import numpy_merge_resolve
 
@@ -156,7 +158,6 @@ class TpuCompactionService:
             drop_tombstones=drop_tombstones,
         )
         entries = unpack_entries(*arrays, count)
-        num_words = num_words_for(batch.capacity, self._bits_per_key)
         bf = BloomFilter(num_words)
         for key, _seq, _vt, _val in entries:
             bf.add(key)

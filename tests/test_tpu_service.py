@@ -156,7 +156,7 @@ def test_sharded_step_matches_single_device():
     step = sharded_compaction_step(mesh, model)
     arrays = make_sharded_inputs(mesh, shards_per_device=1,
                                  entries_per_block=128, model=model)
-    out_final, bloom, counts, global_count = step(
+    out_final, bloom, counts, global_count, needs_fallback = step(
         *(jnp.asarray(arrays[k]) for k in (
             "key_words_be", "key_words_le", "key_len", "seq_hi", "seq_lo",
             "vtype", "val_words", "val_len", "valid"))
