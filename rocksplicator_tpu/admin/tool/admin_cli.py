@@ -1,0 +1,259 @@
+"""Admin CLI — the script-driven cluster management tool.
+
+Reference: rocksdb_admin/tool/rocksdb_admin.py (731 LoC) — config
+generation from a host file, ping, failover (promote/demote via
+changeDBRoleAndUpStream), remove_host, load_sst orchestration across the
+cluster. Commands here speak the Admin RPC directly or read a shard-map
+file for cluster-wide operations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from ...cluster.helix_utils import AdminClient
+from ...rpc.router import ClusterLayout, Role
+from ...utils.segment_utils import segment_to_db_name
+
+
+def _load_layout(path: str) -> ClusterLayout:
+    with open(path, "rb") as f:
+        return ClusterLayout.parse(f.read())
+
+
+def cmd_ping(admin: AdminClient, args) -> int:
+    ok = admin.ping((args.host, args.port))
+    print(f"{args.host}:{args.port} {'OK' if ok else 'UNREACHABLE'}")
+    return 0 if ok else 1
+
+
+def cmd_status(admin: AdminClient, args) -> int:
+    layout = _load_layout(args.shard_map)
+    rc = 0
+    for segment, seg in sorted(layout.segments.items()):
+        print(f"segment {segment}: {seg.num_shards} shards")
+        for shard in sorted(seg.shard_to_hosts):
+            db_name = segment_to_db_name(segment, shard)
+            for host, role in seg.shard_to_hosts[shard]:
+                seq = admin.get_sequence_number((host.ip, host.port), db_name)
+                mark = "M" if role is Role.LEADER else "S"
+                status = f"seq={seq}" if seq is not None else "DOWN"
+                if seq is None:
+                    rc = 1
+                print(f"  {db_name} {mark} {host.ip}:{host.port} {status}")
+    return rc
+
+
+def cmd_config_gen(admin: AdminClient, args) -> int:
+    """Static shard map from a host file (one ip:port:az per line):
+    round-robin leaders, next-host followers (reference config gen)."""
+    hosts: List[str] = []
+    with open(args.host_file) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                hosts.append(line)
+    if not hosts:
+        print("no hosts", file=sys.stderr)
+        return 1
+    seg: Dict[str, object] = {"num_shards": args.shard_num}
+    per_host: Dict[str, List[str]] = {h: [] for h in hosts}
+    for shard in range(args.shard_num):
+        for r in range(min(args.replicas, len(hosts))):
+            host = hosts[(shard + r) % len(hosts)]
+            marker = "M" if r == 0 else "S"
+            per_host[host].append(f"{shard:05d}:{marker}")
+    for host, entries in per_host.items():
+        if entries:
+            seg[host] = entries
+    print(json.dumps({args.segment: seg}, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_failover(admin: AdminClient, args) -> int:
+    """Promote --new_leader; demote the old leader to its follower
+    (reference promote/demote via changeDBRoleAndUpStream)."""
+    layout = _load_layout(args.shard_map)
+    seg = layout.segments[args.segment]
+    db_name = segment_to_db_name(args.segment, args.shard)
+    new_ip, new_port = args.new_leader.split(":")
+    new_port = int(new_port)
+    old_leader = None
+    new_host = None
+    for host, role in seg.shard_to_hosts[args.shard]:
+        if role is Role.LEADER:
+            old_leader = host
+        if (host.ip, host.port) == (new_ip, new_port):
+            new_host = host
+    if new_host is None:
+        print(f"{args.new_leader} does not host shard {args.shard}",
+              file=sys.stderr)
+        return 1
+    if old_leader and (old_leader.ip, old_leader.port) != (new_ip, new_port):
+        admin.change_db_role_and_upstream(
+            (old_leader.ip, old_leader.port), db_name, "FOLLOWER",
+            new_host.repl_addr,
+        )
+        print(f"demoted {old_leader.ip}:{old_leader.port}")
+    admin.change_db_role_and_upstream(
+        (new_ip, new_port), db_name, "LEADER"
+    )
+    print(f"promoted {args.new_leader} for {db_name}")
+    # repoint remaining followers
+    for host, role in seg.shard_to_hosts[args.shard]:
+        if (host.ip, host.port) in ((new_ip, new_port),
+                                    (old_leader.ip, old_leader.port)
+                                    if old_leader else ()):
+            continue
+        admin.change_db_role_and_upstream(
+            (host.ip, host.port), db_name, "FOLLOWER", new_host.repl_addr
+        )
+        print(f"repointed {host.ip}:{host.port}")
+    return 0
+
+
+def cmd_remove_host(admin: AdminClient, args) -> int:
+    layout = _load_layout(args.shard_map)
+    ip, port = args.target.split(":")
+    port = int(port)
+    removed = 0
+    for segment, seg in layout.segments.items():
+        for shard, hosts in seg.shard_to_hosts.items():
+            for host, _role in hosts:
+                if (host.ip, host.port) == (ip, port):
+                    db_name = segment_to_db_name(segment, shard)
+                    try:
+                        admin.close_db((ip, port), db_name)
+                        removed += 1
+                    except Exception as e:
+                        print(f"  {db_name}: {e}", file=sys.stderr)
+    print(f"closed {removed} dbs on {args.target}")
+    return 0
+
+
+def cmd_load_sst(admin: AdminClient, args) -> int:
+    """Cluster-wide SST load: ingest each shard's files on its leader
+    (reference load_sst orchestration)."""
+    layout = _load_layout(args.shard_map)
+    seg = layout.segments[args.segment]
+    failures = 0
+    for shard in sorted(seg.shard_to_hosts):
+        db_name = segment_to_db_name(args.segment, shard)
+        leader = next(
+            (h for h, r in seg.shard_to_hosts[shard] if r is Role.LEADER),
+            None,
+        )
+        if leader is None:
+            print(f"{db_name}: no leader", file=sys.stderr)
+            failures += 1
+            continue
+        try:
+            r = admin.ingest_from_store(
+                (leader.ip, leader.port), db_name, args.store_uri,
+                f"{args.sst_path}/{shard:05d}",
+                ingest_behind=args.ingest_behind,
+                compact_db_after_load=args.compact,
+            )
+            print(f"{db_name}: {r}")
+        except Exception as e:
+            print(f"{db_name}: FAILED {e}", file=sys.stderr)
+            failures += 1
+    return 1 if failures else 0
+
+
+def cmd_backup(admin: AdminClient, args) -> int:
+    r = admin.backup_db_to_store(
+        (args.host, args.port), args.db, args.store_uri, args.backup_path
+    )
+    print(json.dumps(r))
+    return 0
+
+
+def cmd_restore(admin: AdminClient, args) -> int:
+    upstream = None
+    if args.upstream:
+        ip, port = args.upstream.split(":")
+        upstream = (ip, int(port))
+    r = admin.restore_db_from_store(
+        (args.host, args.port), args.db, args.store_uri, args.backup_path,
+        upstream,
+    )
+    print(json.dumps(r))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="admin_cli")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("ping")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, required=True)
+    sp.set_defaults(fn=cmd_ping)
+
+    sp = sub.add_parser("status")
+    sp.add_argument("--shard_map", required=True)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("config_gen")
+    sp.add_argument("--host_file", required=True)
+    sp.add_argument("--segment", required=True)
+    sp.add_argument("--shard_num", type=int, default=1000)
+    sp.add_argument("--replicas", type=int, default=3)
+    sp.set_defaults(fn=cmd_config_gen)
+
+    sp = sub.add_parser("failover")
+    sp.add_argument("--shard_map", required=True)
+    sp.add_argument("--segment", required=True)
+    sp.add_argument("--shard", type=int, required=True)
+    sp.add_argument("--new_leader", required=True, help="ip:service_port")
+    sp.set_defaults(fn=cmd_failover)
+
+    sp = sub.add_parser("remove_host")
+    sp.add_argument("--shard_map", required=True)
+    sp.add_argument("--target", required=True, help="ip:service_port")
+    sp.set_defaults(fn=cmd_remove_host)
+
+    sp = sub.add_parser("load_sst")
+    sp.add_argument("--shard_map", required=True)
+    sp.add_argument("--segment", required=True)
+    sp.add_argument("--store_uri", required=True)
+    sp.add_argument("--sst_path", required=True)
+    sp.add_argument("--ingest_behind", action="store_true")
+    sp.add_argument("--compact", action="store_true")
+    sp.set_defaults(fn=cmd_load_sst)
+
+    sp = sub.add_parser("backup")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, required=True)
+    sp.add_argument("--db", required=True)
+    sp.add_argument("--store_uri", required=True)
+    sp.add_argument("--backup_path", required=True)
+    sp.set_defaults(fn=cmd_backup)
+
+    sp = sub.add_parser("restore")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, required=True)
+    sp.add_argument("--db", required=True)
+    sp.add_argument("--store_uri", required=True)
+    sp.add_argument("--backup_path", required=True)
+    sp.add_argument("--upstream", default=None, help="ip:repl_port")
+    sp.set_defaults(fn=cmd_restore)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    admin = AdminClient()
+    try:
+        return args.fn(admin, args)
+    finally:
+        admin.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,12 +85,18 @@ class Controller:
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
-                if not self._is_leader:
-                    self._is_leader = self.coord.elect_leader(
+                # Re-validate leadership EVERY pass: a session expiry hands
+                # leadership to a peer, and a stale latched flag would leave
+                # two controllers writing conflicting assignments.
+                current = self.coord.current_leader(self._path("controller"))
+                if current is None:
+                    self.coord.elect_leader(
                         self._path("controller"), self.controller_id
-                    ) or self.coord.current_leader(
+                    )
+                    current = self.coord.current_leader(
                         self._path("controller")
-                    ) == self.controller_id
+                    )
+                self._is_leader = current == self.controller_id
                 if self._is_leader:
                     self.reconcile()
             except Exception:
@@ -173,10 +179,9 @@ class Controller:
                 if iid == target_leader and promote_ok:
                     state: str = leader_state
                     up = None
-                elif iid == target_leader:
-                    state = follower_state
-                    up = upstream if upstream_iid != iid else None
                 else:
+                    # includes a demote-in-flight target leader: it stays a
+                    # follower of the acting leader until promote_ok
                     state = follower_state
                     up = upstream if upstream_iid != iid else None
                 per_instance[iid][partition] = PartitionAssignment(state, up)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
@@ -42,7 +43,9 @@ class Participant:
         backup_store_uri: Optional[str] = None,
         transition_workers: int = 4,
         catch_up_timeout: float = 30.0,
+        error_retry_backoff: float = 1.0,
     ):
+        self.error_retry_backoff = error_retry_backoff
         self.cluster = cluster
         self.instance = instance
         self.coord = CoordinatorClient(coord_host, coord_port)
@@ -57,6 +60,7 @@ class Participant:
         self._current: Dict[str, str] = {}
         self._applied_upstream: Dict[str, str] = {}
         self._state_lock = threading.Lock()
+        self._publish_lock = threading.Lock()
         self._executor = ThreadPoolExecutor(
             max_workers=transition_workers, thread_name_prefix="transition"
         )
@@ -92,6 +96,8 @@ class Participant:
                 cur = self._current.get(partition, OFFLINE)
                 if self._inflight.get(partition):
                     continue
+                if cur == ERROR and target is None:
+                    continue  # nothing to recover toward
                 if cur == target_state:
                     # State already right — but the upstream may have moved
                     # (leader handoff): repoint without a state transition
@@ -119,16 +125,18 @@ class Participant:
                         to_state: str) -> None:
         try:
             model = self.factory.get(partition)
+            # ERROR recovers via OFFLINE (Helix resets ERROR->OFFLINE)
+            plan_from = OFFLINE if from_state == ERROR else from_state
             try:
-                steps = model.plan(from_state, to_state)
+                steps = model.plan(plan_from, to_state)
             except TransitionError:
-                # e.g. LEADER -> DROPPED passes through FOLLOWER/OFFLINE
                 steps = None
             if steps is None:
                 log.error("%s: no path %s->%s", partition, from_state, to_state)
                 self._set_current(partition, ERROR)
+                time.sleep(self.error_retry_backoff)
                 return
-            state = from_state
+            state = plan_from
             for a, b in steps:
                 log.info("%s: %s -> %s", partition, a, b)
                 model.transition(a, b)
@@ -138,6 +146,9 @@ class Participant:
             log.exception("%s: transition %s->%s failed", partition,
                           from_state, to_state)
             self._set_current(partition, ERROR)
+            # paced retry, not a hot loop: the finally-block re-evaluation
+            # will plan again from OFFLINE after the backoff
+            time.sleep(self.error_retry_backoff)
         finally:
             with self._state_lock:
                 self._inflight.pop(partition, None)
@@ -168,16 +179,21 @@ class Participant:
                 self._inflight.pop(partition, None)
 
     def _set_current(self, partition: str, state: str) -> None:
-        with self._state_lock:
-            if state == DROPPED:
-                self._current.pop(partition, None)
-            else:
-                self._current[partition] = state
-            snapshot = dict(self._current)
-        self.coord.put(
-            self._path("currentstates", self.instance.instance_id),
-            encode_states(snapshot),
-        )
+        # _publish_lock serializes snapshot+put as one unit so concurrent
+        # transition threads cannot publish snapshots out of order (an older
+        # snapshot overwriting a newer one would hide partitions from the
+        # spectator until the next unrelated update).
+        with self._publish_lock:
+            with self._state_lock:
+                if state == DROPPED:
+                    self._current.pop(partition, None)
+                else:
+                    self._current[partition] = state
+                snapshot = dict(self._current)
+            self.coord.put(
+                self._path("currentstates", self.instance.instance_id),
+                encode_states(snapshot),
+            )
 
     @property
     def current_states(self) -> Dict[str, str]:

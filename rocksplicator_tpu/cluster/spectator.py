@@ -17,7 +17,7 @@ from typing import List, Optional
 from .config_generator import generate_shard_map
 from .coordinator import CoordinatorClient
 from .model import cluster_path
-from .publishers import DedupPublisher, ShardMapPublisher
+from .publishers import DedupPublisher, ParallelPublisher, ShardMapPublisher
 
 log = logging.getLogger(__name__)
 
@@ -36,7 +36,7 @@ class Spectator:
         self.spectator_id = spectator_id
         self._standalone = standalone
         self.coord = CoordinatorClient(coord_host, coord_port)
-        self._publisher = DedupPublisher(_Multi(publishers))
+        self._publisher = DedupPublisher(ParallelPublisher(publishers))
         self._path = lambda *p: cluster_path(cluster, *p)
         self._kick = threading.Event()
         self._stop = threading.Event()
@@ -86,15 +86,3 @@ class Spectator:
             w.set()
         self._thread.join(timeout=5.0)
         self.coord.close()
-
-
-class _Multi(ShardMapPublisher):
-    def __init__(self, publishers: List[ShardMapPublisher]):
-        self._publishers = publishers
-
-    def publish(self, shard_map) -> None:
-        for p in self._publishers:
-            try:
-                p.publish(shard_map)
-            except Exception:
-                log.exception("publisher failed")

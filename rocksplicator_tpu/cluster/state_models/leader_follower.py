@@ -35,6 +35,10 @@ log = logging.getLogger(__name__)
 REBUILD_SEQ_GAP = 100_000
 CATCH_UP_MARGIN = 10
 
+# current-state values that mean "is the leader" — the MasterSlave subclass
+# publishes MASTER, so every external-view comparison must accept both
+LEADERLIKE = {"LEADER", "MASTER"}
+
 
 class LeaderFollowerStateModel(StateModel):
     edges = [
@@ -69,7 +73,7 @@ class LeaderFollowerStateModel(StateModel):
 
     def _current_leader_addr(self) -> Optional[Tuple[str, int]]:
         for iid, (info, state, _seq) in self._live_replicas().items():
-            if state == LEADER and iid != self.ctx.instance.instance_id:
+            if state in LEADERLIKE and iid != self.ctx.instance.instance_id:
                 return (info.host, info.repl_port)
         return None
 
@@ -120,7 +124,7 @@ class LeaderFollowerStateModel(StateModel):
             for iid, (info, state, seq) in replicas.items():
                 if iid == ctx.instance.instance_id:
                     continue
-                if state == LEADER:
+                if state in LEADERLIKE:
                     leader = info
                 if seq is not None and seq > best_seq:
                     best_seq = seq
@@ -176,9 +180,9 @@ class LeaderFollowerStateModel(StateModel):
             replicas = self._live_replicas()
             # no-live-leader check (reference :230-260)
             for iid, (info, state, _seq) in replicas.items():
-                if state == LEADER and iid != ctx.instance.instance_id:
+                if state in LEADERLIKE and iid != ctx.instance.instance_id:
                     raise TransitionError(
-                        f"{self.partition}: {iid} is still LEADER"
+                        f"{self.partition}: {iid} is still {state}"
                     )
             local = ctx.admin.get_sequence_number(
                 ctx.local_admin_addr, self.db_name

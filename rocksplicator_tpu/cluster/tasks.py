@@ -37,8 +37,8 @@ class TaskRunner:
     # -- shared helpers ----------------------------------------------------
 
     @staticmethod
-    def _find_owner(worker: "TaskWorker", partition: str,
-                    prefer_leader: bool = True):
+    def _find_owner(worker: "TaskWorker", partition: str):
+        """The partition's live leader if any, else any live replica."""
         path = worker._path
         coord = worker.coord
         instances: Dict[str, InstanceInfo] = {}
@@ -57,7 +57,7 @@ class TaskRunner:
             if state in _LEADERLIKE:
                 return info
             fallback = fallback or info
-        return None if prefer_leader and fallback is None else fallback
+        return fallback
 
 
 class BackupTask(TaskRunner):
@@ -90,7 +90,7 @@ class RestoreTask(TaskRunner):
 
         partition = job["partition"]
         db_name = partition_name_to_db_name(partition)
-        owner = self._find_owner(worker, partition, prefer_leader=False)
+        owner = self._find_owner(worker, partition)
         if owner is None:
             raise RuntimeError(f"no live owner for {partition}")
         r = worker.admin.restore_db_from_store(

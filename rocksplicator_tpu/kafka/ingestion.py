@@ -1,11 +1,102 @@
-"""start/stopMessageIngestion seam (filled in by the queue stack)."""
+"""start/stopMessageIngestion: queue → db wiring.
+
+Reference: admin_handler.cpp message-ingestion paths — a KafkaWatcher per
+db consuming the topic partition matching the db's shard id; messages
+apply as PUTs (empty value ⇒ DELETE); ``last_kafka_msg_timestamp_ms``
+persists into the meta_db every 1000 messages (admin_handler.cpp:2065-2075)
+so a restart resumes from where ingestion left off (replay via timestamp
+seek).
+
+Broker addressing: ``embedded://<cluster>`` selects an in-process
+MockKafkaCluster (the only backend in this image); a file path is treated
+as a broker-serverset file for a future networked backend.
+"""
 
 from __future__ import annotations
 
+import logging
+
 from ..rpc.errors import RpcApplicationError
+from ..storage.records import WriteBatch
+from ..utils.segment_utils import extract_shard_id
+from .broker import Message, MockConsumer, get_cluster
+from .watcher import KafkaWatcher
+
+log = logging.getLogger(__name__)
+
+META_PERSIST_EVERY = 1000  # messages (admin_handler.cpp:2065-2075)
 
 
-def start_ingestion(handler, db_name, app_db, topic_name, broker_path, start_ts):
-    raise RpcApplicationError(
-        "NOT_IMPLEMENTED", "message ingestion requires the queue stack"
+class IngestionWatcher(KafkaWatcher):
+    def __init__(self, handler, db_name: str, app_db, consumer, topic: str,
+                 partitions, start_ts: int):
+        super().__init__(
+            name=db_name, consumer=consumer, topic=topic,
+            partitions=partitions, start_timestamp_ms=start_ts,
+        )
+        self._handler = handler
+        self._db_name = db_name
+        self._app_db = app_db
+        self._since_persist = 0
+
+    def handle_message(self, msg: Message, is_replay: bool) -> None:
+        batch = WriteBatch()
+        if msg.value:
+            batch.put(msg.key, msg.value)
+        else:
+            batch.delete(msg.key)
+        self._app_db.write(batch)
+        self._since_persist += 1
+        if self._since_persist >= META_PERSIST_EVERY:
+            self._since_persist = 0
+            self._persist_timestamp(msg.timestamp_ms)
+
+    def _persist_timestamp(self, ts_ms: int) -> None:
+        try:
+            self._handler.write_meta_data(
+                self._db_name, last_kafka_msg_timestamp_ms=ts_ms
+            )
+        except Exception:
+            log.exception("%s: persisting kafka timestamp failed", self._db_name)
+
+    def stop(self) -> None:
+        super().stop()
+        if self.last_timestamp_ms:
+            self._persist_timestamp(self.last_timestamp_ms)
+
+
+def start_ingestion(handler, db_name: str, app_db, topic_name: str,
+                    broker_path: str, start_ts: int) -> IngestionWatcher:
+    """The admin RPC seam (handler.py start/stopMessageIngestion)."""
+    if not topic_name:
+        raise RpcApplicationError("DB_ADMIN_ERROR", "topic_name required")
+    if broker_path.startswith("embedded://") or not broker_path:
+        cluster_name = broker_path[len("embedded://"):] or "default"
+        cluster = get_cluster(cluster_name)
+    else:
+        # networked backend goes here (librdkafka analog); the serverset
+        # file is watched via KafkaBrokerFileWatcherManager
+        raise RpcApplicationError(
+            "NOT_IMPLEMENTED",
+            f"networked brokers not available in this image: {broker_path}",
+        )
+    if cluster.num_partitions(topic_name) == 0:
+        raise RpcApplicationError(
+            "DB_ADMIN_ERROR", f"no such topic: {topic_name}"
+        )
+    # The partition IS the shard id (reference rejects any mismatch rather
+    # than silently ingesting another shard's data).
+    shard = extract_shard_id(db_name)
+    if not (0 <= shard < cluster.num_partitions(topic_name)):
+        raise RpcApplicationError(
+            "DB_ADMIN_ERROR",
+            f"shard {shard} of {db_name} has no partition in topic "
+            f"{topic_name} ({cluster.num_partitions(topic_name)} partitions)",
+        )
+    partition = shard
+    consumer = MockConsumer(cluster, group_id=f"ingest-{db_name}")
+    watcher = IngestionWatcher(
+        handler, db_name, app_db, consumer, topic_name, [partition], start_ts
     )
+    watcher.start()
+    return watcher

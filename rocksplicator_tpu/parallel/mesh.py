@@ -118,9 +118,12 @@ def sharded_compaction_step(mesh, model=None):
             )
         )(final["key_words_le"], final["key_len"], out_valid)
         global_count = jax.lax.psum(final["count"].sum(), "shard")
-        # any device needing CPU fallback poisons the whole job (max = OR
-        # across the shard axis; block columns are identical)
-        global_fallback = jax.lax.pmax(fallback.astype(jnp.int32), "shard")
+        # any device needing CPU fallback poisons the whole job. Reduce over
+        # BOTH axes: local_fallback differs per block column, and out_spec
+        # P(None, None) materializes one column's value.
+        global_fallback = jax.lax.pmax(
+            fallback.astype(jnp.int32), ("shard", "block")
+        )
         # re-insert the block axis (replicated) for out_specs
         expand = lambda a: a[:, None]
         return (

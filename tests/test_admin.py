@@ -309,12 +309,19 @@ def test_set_db_options_and_compact(nodes, call):
     assert list(app_db.new_iterator()) == []
 
 
-def test_message_ingestion_not_wired_yet(nodes, call):
+def test_message_ingestion_error_paths(nodes, call):
     n = nodes("a")
     call(n, "add_db", db_name="seg00001", role="LEADER")
+    # unknown topic on the embedded broker
     with pytest.raises(RpcApplicationError) as ei:
-        call(n, "start_message_ingestion", db_name="seg00001", topic_name="t")
-    assert ei.value.code == "NOT_IMPLEMENTED"
+        call(n, "start_message_ingestion", db_name="seg00001",
+             topic_name="no-such-topic")
+    assert ei.value.code == "DB_ADMIN_ERROR"
+    # networked brokers are not available in this image
+    with pytest.raises(RpcApplicationError) as ei3:
+        call(n, "start_message_ingestion", db_name="seg00001",
+             topic_name="t", kafka_broker_serverset_path="/etc/brokers")
+    assert ei3.value.code == "NOT_IMPLEMENTED"
     with pytest.raises(RpcApplicationError) as ei2:
         call(n, "stop_message_ingestion", db_name="seg00001")
     assert ei2.value.code == "DB_NOT_FOUND"

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""rpcgrep — live RPC traffic inspection (the tgrep equivalent).
+
+Reference: tgrep/ (1.2k LoC) — a thrift-aware packet sniffer (libpcap →
+flow reassembly → thrift frame decode) for debugging live traffic. Here:
+a decoding TCP proxy — point a client at the proxy port, traffic forwards
+to the real server while every frame's header (method, id, ok/error,
+payload size) prints, optionally filtered by method regex.
+
+Usage:
+    python tools/rpcgrep.py --listen 9190 --target 127.0.0.1:9090 \
+        [--method 'replicate|add_db'] [--show-args]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import re
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from rocksplicator_tpu.rpc.framing import FrameReader, write_frame  # noqa: E402
+from rocksplicator_tpu.rpc.serde import decode_message  # noqa: E402
+
+
+def _summarize(direction: str, header: memoryview, payload: memoryview,
+               method_re, show_args: bool, conn_id: int) -> None:
+    try:
+        msg = decode_message(header, payload)
+    except Exception as e:
+        print(f"[{conn_id}] {direction} <undecodable: {e}>")
+        return
+    method = msg.get("method")
+    if method is not None:  # request
+        if method_re and not method_re.search(method):
+            return
+        line = (f"[{conn_id}] {direction} call id={msg.get('id')} "
+                f"method={method} payload={len(payload)}B")
+        if show_args:
+            args = {
+                k: (f"<{len(v)}B>" if isinstance(v, (bytes, memoryview)) else v)
+                for k, v in (msg.get("args") or {}).items()
+            }
+            line += f" args={json.dumps(args, default=str)[:200]}"
+    else:  # reply
+        ok = msg.get("ok")
+        err = (msg.get("error") or {}).get("code") if not ok else None
+        line = (f"[{conn_id}] {direction} reply id={msg.get('id')} "
+                f"ok={ok}{f' error={err}' if err else ''} "
+                f"payload={len(payload)}B")
+    ts = time.strftime("%H:%M:%S")
+    print(f"{ts} {line}", flush=True)
+
+
+async def _pump(reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                direction: str, method_re, show_args: bool,
+                conn_id: int) -> None:
+    frames = FrameReader(reader)
+    try:
+        while True:
+            header, payload = await frames.read_frame()
+            _summarize(direction, header, payload, method_re, show_args, conn_id)
+            await write_frame(writer, bytes(header), [bytes(payload)])
+    except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        pass
+    finally:
+        writer.close()
+
+
+async def serve(listen_port: int, target_host: str, target_port: int,
+                method_re, show_args: bool) -> None:
+    conn_counter = [0]
+
+    async def on_conn(cr: asyncio.StreamReader, cw: asyncio.StreamWriter):
+        conn_counter[0] += 1
+        cid = conn_counter[0]
+        peer = cw.get_extra_info("peername")
+        print(f"# conn {cid} from {peer}", flush=True)
+        try:
+            tr, tw = await asyncio.open_connection(target_host, target_port)
+        except OSError as e:
+            print(f"# conn {cid}: target unreachable: {e}", flush=True)
+            cw.close()
+            return
+        await asyncio.gather(
+            _pump(cr, tw, "->", method_re, show_args, cid),
+            _pump(tr, cw, "<-", method_re, show_args, cid),
+        )
+
+    server = await asyncio.start_server(on_conn, "0.0.0.0", listen_port)
+    addr = server.sockets[0].getsockname()
+    print(f"# rpcgrep proxy on {addr} -> {target_host}:{target_port}",
+          flush=True)
+    async with server:
+        await server.serve_forever()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--listen", type=int, required=True)
+    p.add_argument("--target", required=True, help="host:port")
+    p.add_argument("--method", default=None, help="regex filter")
+    p.add_argument("--show-args", action="store_true")
+    args = p.parse_args(argv)
+    host, port = args.target.split(":")
+    method_re = re.compile(args.method) if args.method else None
+    try:
+        asyncio.run(serve(args.listen, host, int(port), method_re,
+                          args.show_args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
