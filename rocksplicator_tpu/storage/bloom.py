@@ -73,6 +73,13 @@ def num_words_for(num_keys: int, bits_per_key: int = 10) -> int:
     return max(1, (num_keys * bits_per_key + 31) // 32)
 
 
+def _native():
+    """Native hash/build path (format-identical; parity-tested)."""
+    from .native.binding import NATIVE
+
+    return NATIVE
+
+
 class BloomFilter:
     def __init__(self, num_words: int, words: np.ndarray | None = None):
         self.num_words = num_words
@@ -84,6 +91,10 @@ class BloomFilter:
     def build(cls, keys: Iterable[bytes], bits_per_key: int = 10) -> "BloomFilter":
         keys = list(keys)
         bf = cls(num_words_for(len(keys), bits_per_key))
+        native = _native()
+        if native is not None and keys:
+            native.bloom_add_many(bf.words, keys)
+            return bf
         for key in keys:
             bf.add(key)
         return bf
@@ -93,6 +104,9 @@ class BloomFilter:
         self.words[idx] |= np.uint32(mask)
 
     def may_contain(self, key: bytes) -> bool:
+        # Pure Python on purpose: the per-probe ctypes marshalling costs
+        # more than the hash itself (measured); the native path wins only
+        # for bulk build.
         idx, mask = word_mask(key, self.num_words)
         return (int(self.words[idx]) & mask) == mask
 

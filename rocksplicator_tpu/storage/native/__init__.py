@@ -1,0 +1,10 @@
+"""Native (C++) storage hot paths, loaded via ctypes.
+
+Build: ``make -C rocksplicator_tpu/storage/native`` (auto-attempted on
+first import). The Python implementations remain authoritative fallbacks;
+format parity is pinned by tests/test_native.py.
+"""
+
+from .binding import NATIVE, NativeLib, native_available
+
+__all__ = ["NATIVE", "NativeLib", "native_available"]

@@ -1,0 +1,243 @@
+"""ctypes binding for libtsst_native.so.
+
+No pybind11 in the image (environment constraint) — the C ABI + ctypes is
+the binding layer. Arrays cross the boundary as numpy buffers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libtsst_native.so")
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR, "-s"],
+            check=True, capture_output=True, timeout=120,
+        )
+        return os.path.isfile(_SO)
+    except Exception as e:
+        log.info("native build unavailable: %s", e)
+        return False
+
+
+class NativeLib:
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.tsst_crc32.restype = ctypes.c_uint32
+        lib.tsst_crc32.argtypes = [_u8p, ctypes.c_uint64]
+        lib.tsst_encode_block.restype = ctypes.c_int64
+        lib.tsst_encode_block.argtypes = [
+            _u8p, _u64p, _u64p, _u8p, _u8p, _u64p,
+            ctypes.c_uint64, _u8p, ctypes.c_uint64,
+        ]
+        lib.tsst_decode_block.restype = ctypes.c_int64
+        lib.tsst_decode_block.argtypes = [
+            _u8p, ctypes.c_uint64, ctypes.c_uint64,
+            _u64p, _u64p, _u64p, _u8p, _u64p, _u64p,
+        ]
+        lib.tsst_get_entries.restype = ctypes.c_int64
+        lib.tsst_get_entries.argtypes = [
+            _u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64, ctypes.c_uint64,
+            _u64p, _u8p, _u64p, _u64p, ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.wal_scan.restype = ctypes.c_int64
+        lib.wal_scan.argtypes = [
+            _u8p, ctypes.c_uint64, ctypes.c_uint64,
+            _u64p, _u64p, _u64p, _i64p,
+        ]
+        lib.wal_count_records.restype = ctypes.c_int64
+        lib.wal_count_records.argtypes = [_u8p, ctypes.c_uint64]
+        lib.bloom_add_many.restype = None
+        lib.bloom_add_many.argtypes = [
+            _u32p, ctypes.c_uint32, _u8p, _u64p, ctypes.c_uint64,
+        ]
+        lib.bloom_may_contain.restype = ctypes.c_int32
+        lib.bloom_may_contain.argtypes = [
+            _u32p, ctypes.c_uint32, _u8p, ctypes.c_uint64,
+        ]
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _u8(arr: np.ndarray):
+        return arr.ctypes.data_as(_u8p)
+
+    @staticmethod
+    def _u64(arr: np.ndarray):
+        return arr.ctypes.data_as(_u64p)
+
+    # -- API ---------------------------------------------------------------
+
+    def crc32(self, data: bytes) -> int:
+        buf = np.frombuffer(data, dtype=np.uint8)
+        return int(self._lib.tsst_crc32(self._u8(buf), len(buf)))
+
+    def encode_block(
+        self, keys: List[bytes], seqs: List[int], vtypes: List[int],
+        vals: List[bytes],
+    ) -> bytes:
+        n = len(keys)
+        key_buf = np.frombuffer(b"".join(keys), dtype=np.uint8)
+        val_buf = np.frombuffer(b"".join(vals), dtype=np.uint8)
+        key_off = np.zeros(n + 1, dtype=np.uint64)
+        val_off = np.zeros(n + 1, dtype=np.uint64)
+        np.cumsum([len(k) for k in keys], out=key_off[1:])
+        np.cumsum([len(v) for v in vals], out=val_off[1:])
+        seq_arr = np.asarray(seqs, dtype=np.uint64)
+        vt_arr = np.asarray(vtypes, dtype=np.uint8)
+        cap = int(key_off[-1] + val_off[-1] + n * 17)
+        out = np.empty(cap, dtype=np.uint8)
+        if n == 0:
+            return b""
+        wrote = self._lib.tsst_encode_block(
+            self._u8(key_buf if len(key_buf) else np.zeros(1, np.uint8)),
+            self._u64(key_off),
+            self._u64(seq_arr), self._u8(vt_arr),
+            self._u8(val_buf if len(val_buf) else np.zeros(1, np.uint8)),
+            self._u64(val_off),
+            n, self._u8(out), cap,
+        )
+        if wrote < 0:
+            raise ValueError("encode_block overflow")
+        return out[:wrote].tobytes()
+
+    def decode_block(self, raw: bytes) -> List[Tuple[bytes, int, int, bytes]]:
+        data = np.frombuffer(raw, dtype=np.uint8)
+        max_entries = max(1, len(raw) // 17)
+        key_off = np.empty(max_entries, dtype=np.uint64)
+        key_len = np.empty(max_entries, dtype=np.uint64)
+        seqs = np.empty(max_entries, dtype=np.uint64)
+        vtypes = np.empty(max_entries, dtype=np.uint8)
+        val_off = np.empty(max_entries, dtype=np.uint64)
+        val_len = np.empty(max_entries, dtype=np.uint64)
+        n = self._lib.tsst_decode_block(
+            self._u8(data), len(raw), max_entries,
+            self._u64(key_off), self._u64(key_len),
+            self._u64(seqs), self._u8(vtypes),
+            self._u64(val_off), self._u64(val_len),
+        )
+        if n < 0:
+            from ..errors import Corruption
+
+            raise Corruption("native block decode failed")
+        out = []
+        for i in range(n):
+            ko, kl = int(key_off[i]), int(key_len[i])
+            vo, vl = int(val_off[i]), int(val_len[i])
+            out.append((raw[ko:ko + kl], int(seqs[i]), int(vtypes[i]),
+                        raw[vo:vo + vl]))
+        return out
+
+    def get_entries(self, raw: bytes, key: bytes,
+                    max_matches: int = 64) -> Optional[Tuple[list, bool]]:
+        """(entries, past_end) for ``key`` in one block: entries are
+        (seq, vtype, value) newest-first as stored; past_end means the scan
+        proved no later block can hold this key. None = slow path needed."""
+        data = np.frombuffer(raw, dtype=np.uint8)
+        kbuf = (np.frombuffer(key, dtype=np.uint8) if key
+                else np.zeros(1, np.uint8))
+        seqs = np.empty(max_matches, dtype=np.uint64)
+        vtypes = np.empty(max_matches, dtype=np.uint8)
+        val_off = np.empty(max_matches, dtype=np.uint64)
+        val_len = np.empty(max_matches, dtype=np.uint64)
+        past_end = ctypes.c_int32(0)
+        n = self._lib.tsst_get_entries(
+            self._u8(data), len(raw), self._u8(kbuf), len(key), max_matches,
+            self._u64(seqs), self._u8(vtypes), self._u64(val_off),
+            self._u64(val_len), ctypes.byref(past_end),
+        )
+        if n == -1:
+            # overflow, not corruption: retry with room for a deeper merge
+            # stack instead of falling back to a full block re-decode
+            bound = max(1, len(raw) // 17)
+            if max_matches < bound:
+                return self.get_entries(raw, key, min(bound, max_matches * 8))
+            return None
+        if n < 0:
+            return None
+        return (
+            [
+                (int(seqs[i]), int(vtypes[i]),
+                 raw[int(val_off[i]):int(val_off[i]) + int(val_len[i])])
+                for i in range(n)
+            ],
+            bool(past_end.value),
+        )
+
+    def wal_scan(self, raw: bytes) -> Tuple[List[Tuple[int, int, int]], int]:
+        """Returns ([(start_seq, body_off, body_len)], bad_crc_at)."""
+        data = np.frombuffer(raw, dtype=np.uint8)
+        # exact-size output arrays via a cheap structural pre-count (a
+        # len/16 upper bound would allocate ~96MB for a 64MiB segment)
+        max_records = max(
+            1, int(self._lib.wal_count_records(self._u8(data), len(raw)))
+        )
+        seqs = np.empty(max_records, dtype=np.uint64)
+        offs = np.empty(max_records, dtype=np.uint64)
+        lens = np.empty(max_records, dtype=np.uint64)
+        bad = ctypes.c_int64(-1)
+        n = self._lib.wal_scan(
+            self._u8(data), len(raw), max_records,
+            self._u64(seqs), self._u64(offs), self._u64(lens),
+            ctypes.byref(bad),
+        )
+        return (
+            [(int(seqs[i]), int(offs[i]), int(lens[i])) for i in range(n)],
+            int(bad.value),
+        )
+
+    def bloom_add_many(self, words: np.ndarray, keys: List[bytes]) -> None:
+        n = len(keys)
+        if n == 0:
+            return
+        key_buf = np.frombuffer(b"".join(keys), dtype=np.uint8)
+        key_off = np.zeros(n + 1, dtype=np.uint64)
+        np.cumsum([len(k) for k in keys], out=key_off[1:])
+        self._lib.bloom_add_many(
+            words.ctypes.data_as(_u32p), len(words),
+            self._u8(key_buf), self._u64(key_off), n,
+        )
+
+    def bloom_may_contain(self, words: np.ndarray, key: bytes) -> bool:
+        buf = np.frombuffer(key, dtype=np.uint8) if key else np.zeros(1, np.uint8)
+        return bool(self._lib.bloom_may_contain(
+            words.ctypes.data_as(_u32p), len(words), self._u8(buf), len(key)
+        ))
+
+
+def _load() -> Optional[NativeLib]:
+    if os.environ.get("RSTPU_DISABLE_NATIVE"):
+        return None
+    # Always run make: it is a no-op when the .so is current and rebuilds
+    # it when the source changed (a stale .so would fail symbol lookup).
+    if not _build() and not os.path.isfile(_SO):
+        return None
+    try:
+        return NativeLib(ctypes.CDLL(_SO))
+    except (OSError, AttributeError) as e:
+        log.warning("native lib load failed: %s", e)
+        return None
+
+
+NATIVE: Optional[NativeLib] = _load()
+
+
+def native_available() -> bool:
+    return NATIVE is not None

@@ -1,0 +1,243 @@
+// Native storage hot paths (C ABI, loaded via ctypes).
+//
+// The reference's entire storage engine is C++ (vendored RocksDB); this
+// library provides the byte-crunching loops the Python engine spends its
+// CPU time in — TSST block encode/decode, WAL record scanning with CRC,
+// and bloom filter build/probe — with the exact same formats as the
+// Python implementations (parity-tested). The TPU owns compaction math;
+// this owns the host-side byte plumbing.
+//
+// Formats (must stay in lockstep with sst.py / wal.py / bloom.py):
+//   block entry : u32 key_len | key | u64 seq | u8 vtype | u32 val_len | val
+//   WAL record  : u64 start_seq | u32 batch_len | u32 crc32(batch) | batch
+//   bloom       : register-blocked, FNV-1a over 6 LE u32 prefix words +
+//                 length word, murmur fmix32 finalizer, K=6 bits from 5-bit
+//                 slices of h2
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// crc32 (zlib-compatible, slice-by-1 table; built on first use)
+// ---------------------------------------------------------------------------
+
+struct CrcTable {
+  uint32_t t[256];
+  CrcTable() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+
+static const CrcTable& crc_table() {
+  // C++11 magic static: thread-safe one-time init (no unsynchronized
+  // flag race between concurrent first callers).
+  static const CrcTable table;
+  return table;
+}
+
+uint32_t tsst_crc32(const uint8_t* data, uint64_t len) {
+  const CrcTable& tbl = crc_table();
+  uint32_t c = 0xFFFFFFFFu;
+  for (uint64_t i = 0; i < len; i++)
+    c = tbl.t[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// TSST block codec
+// ---------------------------------------------------------------------------
+
+static inline void put_u32(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
+static inline void put_u64(uint8_t* p, uint64_t v) { memcpy(p, &v, 8); }
+static inline uint32_t get_u32(const uint8_t* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+static inline uint64_t get_u64(const uint8_t* p) { uint64_t v; memcpy(&v, p, 8); return v; }
+
+// Encode n entries into out. keys/vals are concatenated byte arrays with
+// per-entry offsets (offsets[n] = total length). Returns bytes written,
+// or -1 if out_cap is too small.
+int64_t tsst_encode_block(
+    const uint8_t* keys, const uint64_t* key_offsets,
+    const uint64_t* seqs, const uint8_t* vtypes,
+    const uint8_t* vals, const uint64_t* val_offsets,
+    uint64_t n, uint8_t* out, uint64_t out_cap) {
+  uint64_t pos = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    uint64_t klen = key_offsets[i + 1] - key_offsets[i];
+    uint64_t vlen = val_offsets[i + 1] - val_offsets[i];
+    uint64_t need = 4 + klen + 8 + 1 + 4 + vlen;
+    if (pos + need > out_cap) return -1;
+    put_u32(out + pos, (uint32_t)klen); pos += 4;
+    memcpy(out + pos, keys + key_offsets[i], klen); pos += klen;
+    put_u64(out + pos, seqs[i]); pos += 8;
+    out[pos++] = vtypes[i];
+    put_u32(out + pos, (uint32_t)vlen); pos += 4;
+    memcpy(out + pos, vals + val_offsets[i], vlen); pos += vlen;
+  }
+  return (int64_t)pos;
+}
+
+// Decode a block: fills per-entry offset/seq/vtype arrays (caller sizes
+// them at max_entries) and returns the entry count, or -1 on corruption /
+// overflow. Key/value BYTES are not copied — offsets index into `data`.
+int64_t tsst_decode_block(
+    const uint8_t* data, uint64_t len, uint64_t max_entries,
+    uint64_t* key_off, uint64_t* key_len,
+    uint64_t* seqs, uint8_t* vtypes,
+    uint64_t* val_off, uint64_t* val_len) {
+  uint64_t pos = 0, i = 0;
+  while (pos < len) {
+    if (i >= max_entries) return -1;
+    if (pos + 4 > len) return -1;
+    uint32_t klen = get_u32(data + pos); pos += 4;
+    if (pos + klen + 8 + 1 + 4 > len) return -1;
+    key_off[i] = pos; key_len[i] = klen; pos += klen;
+    seqs[i] = get_u64(data + pos); pos += 8;
+    vtypes[i] = data[pos]; pos += 1;
+    uint32_t vlen = get_u32(data + pos); pos += 4;
+    if (pos + vlen > len) return -1;
+    val_off[i] = pos; val_len[i] = vlen; pos += vlen;
+    i++;
+  }
+  return (int64_t)i;
+}
+
+// Point lookup with early exit: walk the (sorted) block once, collect all
+// entries for `key` (MERGE stacks span multiple entries), stop as soon as
+// a greater key appears. One C call replaces a Python decode of the whole
+// block. Returns the match count (0 = absent), -1 when max_matches was too
+// small (caller retries bigger), -2 on corruption.
+// Sets *past_end=1 iff the scan proved no later entry can match.
+int64_t tsst_get_entries(
+    const uint8_t* data, uint64_t len,
+    const uint8_t* key, uint64_t klen, uint64_t max_matches,
+    uint64_t* seqs, uint8_t* vtypes,
+    uint64_t* val_off, uint64_t* val_len,
+    int32_t* past_end) {
+  *past_end = 0;
+  uint64_t pos = 0, found = 0;
+  while (pos < len) {
+    if (pos + 4 > len) return -2;
+    uint32_t eklen = get_u32(data + pos); pos += 4;
+    if (pos + eklen + 8 + 1 + 4 > len) return -2;
+    const uint8_t* ekey = data + pos; pos += eklen;
+    uint64_t seq = get_u64(data + pos); pos += 8;
+    uint8_t vt = data[pos]; pos += 1;
+    uint32_t vlen = get_u32(data + pos); pos += 4;
+    if (pos + vlen > len) return -2;
+    uint64_t voff = pos; pos += vlen;
+    uint64_t minlen = eklen < klen ? eklen : klen;
+    int cmp = memcmp(ekey, key, minlen);
+    if (cmp == 0 && eklen == klen) {
+      if (found >= max_matches) return -1;
+      seqs[found] = seq; vtypes[found] = vt;
+      val_off[found] = voff; val_len[found] = vlen;
+      found++;
+    } else if (cmp > 0 || (cmp == 0 && eklen > klen)) {
+      *past_end = 1;
+      break;  // sorted: nothing later can match
+    }
+  }
+  return (int64_t)found;
+}
+
+// ---------------------------------------------------------------------------
+// WAL record scan
+// ---------------------------------------------------------------------------
+
+// Cheap structural pass (no CRC): count of complete records, so callers
+// can allocate exact-size output arrays instead of len/16 upper bounds.
+int64_t wal_count_records(const uint8_t* data, uint64_t len) {
+  uint64_t pos = 0, i = 0;
+  while (pos + 16 <= len) {
+    uint32_t blen = get_u32(data + pos + 8);
+    if (pos + 16 + blen > len) break;
+    pos += 16 + blen;
+    i++;
+  }
+  return (int64_t)i;
+}
+
+// Scans records; fills start_seqs/body_offsets/body_lens; returns count.
+// Stops at a torn tail. Sets *bad_crc_at to the offset of a CRC-mismatched
+// record (else -1) — callers decide whether that is corruption or a tail.
+int64_t wal_scan(
+    const uint8_t* data, uint64_t len, uint64_t max_records,
+    uint64_t* start_seqs, uint64_t* body_offsets, uint64_t* body_lens,
+    int64_t* bad_crc_at) {
+  *bad_crc_at = -1;
+  uint64_t pos = 0, i = 0;
+  while (pos + 16 <= len && i < max_records) {
+    uint64_t seq = get_u64(data + pos);
+    uint32_t blen = get_u32(data + pos + 8);
+    uint32_t crc = get_u32(data + pos + 12);
+    uint64_t body = pos + 16;
+    if (body + blen > len) break;  // torn tail
+    if (tsst_crc32(data + body, blen) != crc) {
+      *bad_crc_at = (int64_t)pos;
+      break;
+    }
+    start_seqs[i] = seq;
+    body_offsets[i] = body;
+    body_lens[i] = blen;
+    pos = body + blen;
+    i++;
+  }
+  return (int64_t)i;
+}
+
+// ---------------------------------------------------------------------------
+// bloom (format-identical to storage/bloom.py)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16; h *= 0x85EBCA6Bu;
+  h ^= h >> 13; h *= 0xC2B2AE35u;
+  h ^= h >> 16; return h;
+}
+
+static inline void bloom_hash(const uint8_t* key, uint64_t klen,
+                              uint32_t* h1, uint32_t* h2) {
+  uint8_t prefix[24];
+  memset(prefix, 0, 24);
+  memcpy(prefix, key, klen < 24 ? klen : 24);
+  uint32_t h = 2166136261u;
+  for (int w = 0; w < 6; w++) {
+    uint32_t word; memcpy(&word, prefix + 4 * w, 4);
+    h = (h ^ word) * 16777619u;
+  }
+  h = (h ^ (uint32_t)klen) * 16777619u;
+  *h1 = fmix32(h);
+  *h2 = fmix32(h * 0x9E3779B1u + 1u);
+}
+
+void bloom_add_many(
+    uint32_t* words, uint32_t num_words,
+    const uint8_t* keys, const uint64_t* key_offsets, uint64_t n) {
+  for (uint64_t i = 0; i < n; i++) {
+    uint32_t h1, h2;
+    uint64_t klen = key_offsets[i + 1] - key_offsets[i];
+    bloom_hash(keys + key_offsets[i], klen, &h1, &h2);
+    uint32_t mask = 0;
+    for (int j = 0; j < 6; j++) mask |= 1u << ((h2 >> (5 * j)) & 31u);
+    words[h1 % num_words] |= mask;
+  }
+}
+
+int32_t bloom_may_contain(
+    const uint32_t* words, uint32_t num_words,
+    const uint8_t* key, uint64_t klen) {
+  uint32_t h1, h2;
+  bloom_hash(key, klen, &h1, &h2);
+  uint32_t mask = 0;
+  for (int j = 0; j < 6; j++) mask |= 1u << ((h2 >> (5 * j)) & 31u);
+  return (words[h1 % num_words] & mask) == mask;
+}
+
+}  // extern "C"

@@ -263,6 +263,11 @@ class SSTReader:
 
     @staticmethod
     def _iter_block(raw: bytes) -> Iterator[Tuple[bytes, int, int, bytes]]:
+        from .native.binding import NATIVE
+
+        if NATIVE is not None:
+            yield from NATIVE.decode_block(raw)
+            return
         pos = 0
         while pos < len(raw):
             (klen,) = _ENTRY_HEAD.unpack_from(raw, pos)
@@ -299,17 +304,31 @@ class SSTReader:
                 hi = mid - 1
         if block is None:
             return []
+        from .native.binding import NATIVE
+
         out: List[Tuple[int, int, bytes]] = []
         # Entries for one key are contiguous and (seq desc)-ordered but may
         # span a block boundary.
         for b in range(block, len(self._index)):
+            raw = self._read_block(b)
             done = False
-            for k, seq, vtype, value in self._iter_block(self._read_block(b)):
-                if k == key:
-                    out.append((self._effective_seq(seq), vtype, value))
-                elif k > key:
-                    done = True
-                    break
+            native_res = (
+                NATIVE.get_entries(raw, key) if NATIVE is not None else None
+            )
+            if native_res is not None:
+                matches, past_end = native_res
+                out.extend(
+                    (self._effective_seq(seq), vtype, value)
+                    for seq, vtype, value in matches
+                )
+                done = past_end
+            else:
+                for k, seq, vtype, value in self._iter_block(raw):
+                    if k == key:
+                        out.append((self._effective_seq(seq), vtype, value))
+                    elif k > key:
+                        done = True
+                        break
             if done or (out and b < len(self._index) - 1
                         and self._index[b][0] > key):
                 break

@@ -112,6 +112,23 @@ def _iter_segment(
     except FileNotFoundError:
         return  # segment purged between listing and open — fine, it was
         # fully persisted (purge never removes unpersisted segments)
+
+    from .native.binding import NATIVE
+
+    if NATIVE is not None:
+        records, bad_crc_at = NATIVE.wal_scan(data)
+        if bad_crc_at >= 0 and not (truncate_torn or tolerate_tail):
+            raise Corruption(f"WAL crc mismatch in {path} at offset {bad_crc_at}")
+        good_end = (
+            records[-1][1] + records[-1][2] if records else 0
+        )
+        for seq, off, ln in records:
+            yield seq, data[off:off + ln]
+        if good_end < len(data) and truncate_torn:
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+        return
+
     pos = 0
     good_end = 0
     while pos + _REC_HEAD.size <= len(data):
