@@ -1,0 +1,121 @@
+"""Replication throughput benchmark harness.
+
+Reference: rocksdb_replicator/performance.cpp:57-207 — a two-process
+benchmark (leader + follower binaries) writing N shards × M writer threads
+× K keys of fixed-size values, reporting bytes/s and a stats dump.
+
+Run the follower first, then the leader:
+
+    python -m rocksplicator_tpu.replication.performance \
+        --role follower --port 9092 --upstream_port 9091 --db_dir /tmp/f
+    python -m rocksplicator_tpu.replication.performance \
+        --role leader --port 9091 --db_dir /tmp/l \
+        --num_shards 200 --num_write_threads 2 \
+        --num_keys_per_shard_thread 10240 --value_size 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+from ..storage import DB, DBOptions, WriteBatch
+from ..utils.stats import Stats
+from .db_wrapper import StorageDbWrapper
+from .replicated_db import ReplicationFlags
+from .replicator import Replicator
+from .wire import ReplicaRole
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--role", choices=["leader", "follower"], required=True)
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--upstream_ip", default="127.0.0.1")
+    p.add_argument("--upstream_port", type=int, default=0)
+    p.add_argument("--db_dir", required=True)
+    # defaults mirror performance.cpp:57-66
+    p.add_argument("--num_shards", type=int, default=200)
+    p.add_argument("--num_write_threads", type=int, default=2)
+    p.add_argument("--num_keys_per_shard_thread", type=int, default=10240)
+    p.add_argument("--value_size", type=int, default=1024)
+    p.add_argument("--replication_mode", type=int, default=0)
+    p.add_argument("--wait_sec", type=int, default=3600,
+                   help="follower: how long to serve before exiting")
+    args = p.parse_args(argv)
+
+    replicator = Replicator(port=args.port)
+    dbs = {}
+    role = ReplicaRole.LEADER if args.role == "leader" else ReplicaRole.FOLLOWER
+    upstream = (
+        (args.upstream_ip, args.upstream_port) if args.upstream_port else None
+    )
+    for shard in range(args.num_shards):
+        name = f"perf{shard:05d}"
+        db = DB(os.path.join(args.db_dir, name),
+                DBOptions(wal_ttl_seconds=3600.0))
+        dbs[name] = db
+        replicator.add_db(
+            name, StorageDbWrapper(db), role,
+            upstream_addr=upstream, replication_mode=args.replication_mode,
+        )
+    print(f"{args.role}: {args.num_shards} shards on :{replicator.port}",
+          flush=True)
+
+    if args.role == "follower":
+        try:
+            end = time.monotonic() + args.wait_sec
+            while time.monotonic() < end:
+                time.sleep(5)
+                total = sum(db.latest_sequence_number() for db in dbs.values())
+                print(f"follower total seq: {total}", flush=True)
+        except KeyboardInterrupt:
+            pass
+        replicator.stop()
+        return 0
+
+    # leader: shard-striped writer threads (performance.cpp write loop)
+    value = b"v" * args.value_size
+    total_keys = args.num_keys_per_shard_thread
+
+    def writer(tid: int) -> None:
+        for i in range(total_keys):
+            for shard in range(tid, args.num_shards, args.num_write_threads):
+                name = f"perf{shard:05d}"
+                replicator.write(
+                    name,
+                    WriteBatch().put(f"t{tid}-k{i:08d}".encode(), value),
+                )
+
+    start = time.monotonic()
+    threads = [
+        threading.Thread(target=writer, args=(t,))
+        for t in range(args.num_write_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - start
+    # reported formula mirrors performance.cpp:150-155
+    total_bytes = (
+        args.num_write_threads * total_keys
+        * (args.num_shards // args.num_write_threads) * args.value_size
+    )
+    print(
+        f"leader wrote ~{total_bytes / 1e6:.1f} MB in {elapsed:.1f}s = "
+        f"{total_bytes / elapsed / 1e6:.2f} MB/s",
+        flush=True,
+    )
+    print(Stats.get().dump_text(), flush=True)
+    replicator.stop()
+    for db in dbs.values():
+        db.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -156,3 +156,46 @@ def _safe_call(cb: Callback, content: bytes, path: str) -> None:
         cb(content)
     except Exception:  # pragma: no cover - defensive
         log.exception("file watcher callback failed for %s", path)
+
+
+class MultiFilePoller:
+    """Multi-file registration facade over the singleton watcher.
+
+    Reference: common/MultiFilePoller.* (vendored from wangle) — one
+    callback observing a set of files, invoked with a {path: content} map
+    whenever any member changes. A cancellation id unregisters the group.
+    """
+
+    def __init__(self, watcher: "FileWatcher" = None):
+        self._watcher = watcher or FileWatcher.instance()
+        self._groups: Dict[int, List[Tuple[str, Callback]]] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def add_files(self, paths: List[str], callback) -> int:
+        """``callback(contents: Dict[str, bytes])`` fires on any change;
+        returns a cancellation id."""
+        contents: Dict[str, bytes] = {}
+        registrations: List[Tuple[str, Callback]] = []
+
+        def make_cb(path: str) -> Callback:
+            def cb(content: bytes) -> None:
+                contents[path] = content
+                callback(dict(contents))
+
+            return cb
+
+        for path in paths:
+            cb = make_cb(os.path.abspath(path))
+            registrations.append((os.path.abspath(path), cb))
+            self._watcher.add_file(path, cb)
+        with self._lock:
+            self._next_id += 1
+            self._groups[self._next_id] = registrations
+            return self._next_id
+
+    def cancel(self, cancellation_id: int) -> None:
+        with self._lock:
+            group = self._groups.pop(cancellation_id, None)
+        for path, cb in group or []:
+            self._watcher.remove_file(path, cb)

@@ -1,0 +1,40 @@
+"""Golden-file generator (run once; files are checked in).
+
+Reference: rocksdb_admin/tests/sst_load_compatibility_test.cpp +
+checked-in old_sst_binary — old/new binary x old/new data format-compat
+matrix for the ingest path. Regenerate ONLY for a deliberate format bump.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from rocksplicator_tpu.storage.sst import SSTWriter
+from rocksplicator_tpu.storage.records import OpType, WriteBatch
+from rocksplicator_tpu.storage import wal as wal_mod
+
+here = os.path.dirname(os.path.abspath(__file__))
+
+# golden TSST: mixed entry types, multiple blocks, bloom, zlib compression
+w = SSTWriter(os.path.join(here, "golden_v1.tsst"), block_bytes=256)
+for i in range(100):
+    w.add(f"key{i:04d}".encode(), i + 1, OpType.PUT, f"value-{i}".encode() * 3)
+w.add(b"zzz-deleted", 200, OpType.DELETE, b"")
+w.add(b"zzz-merge", 202, OpType.MERGE, b"\x05\x00\x00\x00\x00\x00\x00\x00")
+w.add(b"zzz-merge", 201, OpType.MERGE, b"\x02\x00\x00\x00\x00\x00\x00\x00")
+props = w.finish(extra_props={"golden": "v1"})
+print("tsst props:", props)
+
+# golden WAL segment
+wal_dir = os.path.join(here, "golden_wal_v1")
+os.makedirs(wal_dir, exist_ok=True)
+ww = wal_mod.WalWriter(wal_dir)
+seq = 1
+for i in range(20):
+    b = WriteBatch().put(f"k{i:02d}".encode(), f"v{i}".encode())
+    if i % 5 == 0:
+        b.stamp_timestamp_ms(1700000000000 + i)
+    ww.append(seq, b.encode())
+    seq += b.count()
+ww.close()
+print("wal written:", os.listdir(wal_dir))

@@ -475,3 +475,22 @@ def test_add_db_failed_start_no_zombie(hosts):
         "seg00009", StorageDbWrapper(db), ReplicaRole.LEADER
     )
     assert rdb is not None
+
+
+def test_wrapper_based_add_db_via_test_proxy(hosts):
+    """DbWrapper seam composition (reference test_db_proxy usage)."""
+    from rocksplicator_tpu.replication.test_db_proxy import TestDbProxy
+    from rocksplicator_tpu.storage import DB as _DB
+
+    leader, follower = hosts("l"), hosts("f")
+    ldb = _DB(str(leader.dir / "seg00001"))
+    leader.dbs["seg00001"] = ldb
+    proxy = TestDbProxy(ldb)
+    leader.replicator.add_db("seg00001", proxy, ReplicaRole.LEADER)
+    fdb, _ = follower.add_db("seg00001", ReplicaRole.FOLLOWER,
+                             upstream=leader.addr)
+    for i in range(5):
+        leader.replicator.write("seg00001", WriteBatch().put(f"k{i}".encode(), b"v"))
+    assert wait_until(lambda: fdb.latest_sequence_number() == 5)
+    assert proxy.writes == 5
+    assert proxy.reads >= 1  # follower pulls went through the proxy

@@ -591,3 +591,24 @@ def test_rate_limiter_set_rate_validation():
         rl.set_rate(0)
     with pytest.raises(ValueError):
         rl.set_rate(-5)
+
+
+def test_multi_file_poller(tmp_path, file_watcher):
+    from rocksplicator_tpu.utils.file_watcher import MultiFilePoller
+
+    a = tmp_path / "a.cfg"
+    b = tmp_path / "b.cfg"
+    a.write_bytes(b"A1")
+    b.write_bytes(b"B1")
+    seen = []
+    poller = MultiFilePoller(file_watcher)
+    cid = poller.add_files([str(a), str(b)], seen.append)
+    assert seen and seen[-1].get(str(a)) == b"A1"
+    b.write_bytes(b"B2")
+    file_watcher.poll_now()
+    assert seen[-1].get(str(b)) == b"B2"
+    assert seen[-1].get(str(a)) == b"A1"  # map carries all members
+    poller.cancel(cid)
+    a.write_bytes(b"A3")
+    file_watcher.poll_now()
+    assert seen[-1].get(str(a)) == b"A1"  # cancelled: no more updates
