@@ -45,6 +45,9 @@ def main(argv=None) -> int:
     p.add_argument("--replication_mode", type=int, default=0)
     p.add_argument("--wait_sec", type=int, default=3600,
                    help="follower: how long to serve before exiting")
+    p.add_argument("--linger_sec", type=int, default=30,
+                   help="leader: keep serving WAL after the write phase so "
+                        "followers (possibly in connect backoff) catch up")
     args = p.parse_args(argv)
 
     replicator = Replicator(port=args.port)
@@ -111,6 +114,10 @@ def main(argv=None) -> int:
         flush=True,
     )
     print(Stats.get().dump_text(), flush=True)
+    if args.linger_sec:
+        print(f"leader lingering {args.linger_sec}s for follower catch-up",
+              flush=True)
+        time.sleep(args.linger_sec)
     replicator.stop()
     for db in dbs.values():
         db.close()
