@@ -13,4 +13,6 @@ def counter_options_generator(segment: str) -> DBOptions:
         merge_operator=UInt64AddOperator(),
         wal_ttl_seconds=3600.0,
         bits_per_key=10,
+        # production posture: flush/compaction off the write path
+        background_compaction=True,
     )

@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..utils.misc import write_file_atomic
+from ..utils.stats import Stats
 from . import wal as wal_mod
 from .compaction import CompactionBackend, CpuCompactionBackend, resolve_stream
 from .errors import Corruption, InvalidArgument, StorageError
@@ -65,6 +66,11 @@ class DBOptions:
     target_file_bytes: int = 64 * 1024 * 1024
     compaction_backend: Optional[CompactionBackend] = None
     disable_auto_compaction: bool = False
+    # Background flush/compaction: writes swap a full memtable to the
+    # immutable slot and return immediately (stalling only when the slot is
+    # still flushing) — the BASELINE write-stall target depends on this.
+    # Off by default so single-threaded callers stay deterministic.
+    background_compaction: bool = False
 
     # Mutable at runtime via DB.set_options (reference setDBOptions RPC).
     MUTABLE = {
@@ -91,7 +97,30 @@ class DB:
         self._wal: Optional[wal_mod.WalWriter] = None
         self._closed = False
         self._backend = self.options.compaction_backend or CpuCompactionBackend()
+        # background machinery: cond signals imm-slot changes; compaction
+        # mutex serializes compactions (bg + manual) so only one remover of
+        # files runs at a time (flushes only ever add files)
+        self._cond = threading.Condition(self._lock)
+        self._compaction_mutex = threading.Lock()
+        self._bg_stop = False
+        self._bg_flush_error: Optional[BaseException] = None
+        self._bg_thread: Optional[threading.Thread] = None
+        self._compaction_thread: Optional[threading.Thread] = None
         self._open()
+        if self.options.background_compaction:
+            # Separate flush and compaction threads (as RocksDB separates
+            # its pools): a running compaction must never block the imm
+            # slot, or writers inherit the compaction's latency.
+            self._bg_thread = threading.Thread(
+                target=self._flush_loop,
+                name=f"lsm-flush-{os.path.basename(self.path)}", daemon=True,
+            )
+            self._bg_thread.start()
+            self._compaction_thread = threading.Thread(
+                target=self._compaction_loop,
+                name=f"lsm-compact-{os.path.basename(self.path)}", daemon=True,
+            )
+            self._compaction_thread.start()
 
     # ------------------------------------------------------------------
     # open / recovery
@@ -176,8 +205,58 @@ class DB:
             self._apply_to_memtable(batch, start_seq)
             self._last_seq += count
             if self._mem.approximate_bytes() >= self.options.memtable_bytes:
-                self._flush_locked()
+                if self._bg_thread is not None:
+                    self._swap_to_imm_locked()
+                else:
+                    self._flush_locked()
             return start_seq
+
+    def _swap_to_imm_locked(self, force: bool = False) -> None:
+        """Hand the full memtable to the background thread. Stalls only
+        while the previous immutable memtable is still flushing AND this
+        writer's swap is still needed — once a peer writer swapped, the
+        fresh memtable is below threshold and waiters exit immediately.
+        Never clobbers a pending imm (bails instead on stop/close)."""
+        stall_start = None
+        while (
+            self._imm is not None
+            and not self._closed
+            and not self._bg_stop
+            and (force or self._mem.approximate_bytes()
+                 >= self.options.memtable_bytes)
+        ):
+            if stall_start is None:
+                stall_start = time.monotonic()
+            self._cond.wait(0.05)
+        if stall_start is not None:
+            Stats.get().add_metric(
+                "storage.write_stall_ms",
+                (time.monotonic() - stall_start) * 1000.0,
+            )
+        if (
+            self._imm is not None  # stop/close exit: leave the imm alone
+            or self._closed
+            or self._bg_stop
+            or len(self._mem) == 0
+            or not (force or self._mem.approximate_bytes()
+                    >= self.options.memtable_bytes)
+        ):
+            return
+        self._imm = self._mem
+        self._mem = MemTable()
+        self._cond.notify_all()
+
+    def _drain_imm_locked(self) -> None:
+        """Wait until no immutable memtable is pending. Raises if the DB
+        closed underneath us or the background flusher is failing (matching
+        inline mode, where the flush error reached the caller)."""
+        while self._imm is not None and not self._closed:
+            if self._bg_flush_error is not None:
+                raise StorageError(
+                    f"background flush failing: {self._bg_flush_error!r}"
+                )
+            self._cond.wait(0.05)
+        self._check_open()
 
     def _apply_to_memtable(self, batch: WriteBatch, start_seq: int) -> None:
         seq = start_seq
@@ -330,11 +409,120 @@ class DB:
     # ------------------------------------------------------------------
 
     def flush(self) -> None:
+        """Synchronous flush: on return, everything written before the call
+        is durable in SSTs (in background mode this drains the imm slot)."""
         with self._lock:
             self._check_open()
-            self._flush_locked()
+            if self._bg_thread is None:
+                self._flush_locked()
+                return
+            if len(self._mem):
+                self._swap_to_imm_locked(force=True)
+            self._drain_imm_locked()
+
+    # ------------------------------------------------------------------
+    # background thread
+    # ------------------------------------------------------------------
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._bg_stop and self._imm is None:
+                    self._cond.wait(0.2)
+                if self._bg_stop and self._imm is None:
+                    return
+                imm = self._imm
+            if imm is not None:
+                try:
+                    self._flush_imm(imm)
+                    self._bg_flush_error = None
+                except Exception as e:
+                    self._bg_flush_error = e
+                    log.exception("%s: background flush failed; retrying",
+                                  self.path)
+                    time.sleep(1.0)
+
+    def _compaction_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._bg_stop and (
+                    self.options.disable_auto_compaction
+                    or len(self._levels[0])
+                    < self.options.level0_compaction_trigger
+                ):
+                    self._cond.wait(0.2)
+                if self._bg_stop:
+                    return
+            try:
+                self._compact_level0_bg()
+            except Exception:
+                log.exception("%s: background compaction failed", self.path)
+                time.sleep(1.0)
+
+    def _flush_imm(self, mem: MemTable) -> None:
+        """Write the immutable memtable to an L0 SST — file IO OUTSIDE the
+        lock (writes keep flowing), installation under it."""
+        with self._lock:
+            name = self._new_file_name()
+        writer = SSTWriter(
+            os.path.join(self.path, name),
+            self.options.block_bytes,
+            self.options.compression,
+            self.options.bits_per_key,
+        )
+        try:
+            for key, seq, vtype, value in mem.entries():
+                writer.add(key, seq, vtype, value)
+            writer.finish()
+        except BaseException:
+            writer.abandon()
+            raise
+        with self._lock:
+            self._readers[name] = SSTReader(os.path.join(self.path, name))
+            self._levels[0].append(name)
+            self._persisted_seq = max(self._persisted_seq, mem.max_seq)
+            self._persist_manifest()
+            self._imm = None
+            self._cond.notify_all()
+        wal_mod.purge_obsolete(
+            self._wal_dir, self._persisted_seq, self.options.wal_ttl_seconds
+        )
+
+    def _compact_level0_bg(self) -> None:
+        """L0→L1 compaction with the merge OUTSIDE the DB lock. Safe
+        because compactions (the only file removers) are serialized by
+        _compaction_mutex and flushes only add files."""
+        with self._compaction_mutex:
+            with self._lock:
+                if self._closed:
+                    return
+                inputs_l0 = list(self._levels[0])
+                inputs_l1 = list(self._levels[1])
+                inputs = inputs_l0 + inputs_l1
+                if not inputs:
+                    return
+                drop = (
+                    all(not files for files in self._levels[2:])
+                    and not self.options.allow_ingest_behind
+                )
+                runs = [self._readers[n].iterate() for n in inputs]
+            out_names = self._write_merged(runs, drop_tombstones=drop)
+            with self._lock:
+                if self._closed:
+                    return
+                # newer L0 files may have arrived during the merge — keep them
+                self._levels[0] = [
+                    n for n in self._levels[0] if n not in inputs_l0
+                ]
+                self._levels[1] = out_names
+                self._persist_manifest()
+                self._gc_files(inputs)
 
     def _flush_locked(self) -> None:
+        if self._imm is not None:
+            # callers must drain first (would clobber the pending imm and
+            # inflate persisted_seq past its unflushed sequence numbers)
+            raise StorageError("flush with immutable memtable pending")
         if len(self._mem) == 0:
             return
         mem = self._mem
@@ -369,48 +557,59 @@ class DB:
             self._wal_dir, self._persisted_seq, self.options.wal_ttl_seconds
         )
         if (
-            not self.options.disable_auto_compaction
+            self._bg_thread is None  # bg mode compacts on its own thread
+            and not self.options.disable_auto_compaction
             and len(self._levels[0]) >= self.options.level0_compaction_trigger
         ):
             self._compact_level0_locked()
 
     def _new_file_name(self) -> str:
-        name = f"sst-{self._incarnation}-{self._next_file_id:08d}.tsst"
-        self._next_file_id += 1
-        return name
+        # self-locking (RLock): callers run both inside and outside the
+        # DB lock (background merges allocate names off-lock)
+        with self._lock:
+            name = f"sst-{self._incarnation}-{self._next_file_id:08d}.tsst"
+            self._next_file_id += 1
+            return name
 
     def compact_range(
         self, start: Optional[bytes] = None, end: Optional[bytes] = None
     ) -> None:
         """Full compaction: merge everything into the bottom level (the
         reference's CompactRange(full) after ingest, admin_handler.cpp:1845).
-        ``start``/``end`` accepted for API parity; the merge is whole-range."""
-        with self._lock:
-            self._check_open()
-            self._flush_locked()
-            # allow_ingest_behind reserves the true bottom level for
-            # ingested-behind data (RocksDB does the same), so full
-            # compaction targets num_levels-2 there.
-            bottom = self.options.num_levels - 1
-            if self.options.allow_ingest_behind:
-                bottom -= 1
-            inputs: List[str] = [n for files in self._levels for n in files]
-            if not inputs:
-                return
-            runs = [self._readers[n].iterate() for n in inputs]
+        ``start``/``end`` accepted for API parity; the merge is whole-range.
+        The merge itself runs OUTSIDE the DB lock (writes keep flowing);
+        _compaction_mutex serializes against background compaction."""
+        self.flush()
+        with self._compaction_mutex:
+            with self._lock:
+                self._check_open()
+                # allow_ingest_behind reserves the true bottom level for
+                # ingested-behind data (RocksDB does the same), so full
+                # compaction targets num_levels-2 there.
+                bottom = self.options.num_levels - 1
+                if self.options.allow_ingest_behind:
+                    bottom -= 1
+                inputs: List[str] = [n for files in self._levels for n in files]
+                if not inputs:
+                    return
+                runs = [self._readers[n].iterate() for n in inputs]
             # Tombstones must survive when data can later be ingested BEHIND
             # this level — dropping them would resurrect deleted keys.
             out_names = self._write_merged(
                 runs, drop_tombstones=not self.options.allow_ingest_behind
             )
-            for files in self._levels:
-                files.clear()
-            self._levels[bottom] = out_names
-            # Manifest first, THEN delete inputs — a crash in between leaves
-            # orphan files (harmless), never a manifest pointing at deleted
-            # ones (unopenable DB).
-            self._persist_manifest()
-            self._gc_files(inputs)
+            with self._lock:
+                self._check_open()
+                input_set = set(inputs)
+                # new L0 flushes may have landed during the merge: keep them
+                for files in self._levels:
+                    files[:] = [n for n in files if n not in input_set]
+                self._levels[bottom] = out_names + self._levels[bottom]
+                # Manifest first, THEN delete inputs — a crash in between
+                # leaves orphan files (harmless), never a manifest pointing
+                # at deleted ones (unopenable DB).
+                self._persist_manifest()
+                self._gc_files(inputs)
 
     def _compact_level0_locked(self) -> None:
         """L0 → L1 compaction (tombstones kept; not bottom level)."""
@@ -534,6 +733,8 @@ class DB:
         checkpoint-backup path (admin_handler.cpp:996-1129)."""
         with self._lock:
             self._check_open()
+            # drain any in-flight background flush, then flush synchronously
+            self._drain_imm_locked()
             self._flush_locked()
             if os.path.exists(checkpoint_dir):
                 raise InvalidArgument(f"checkpoint dir exists: {checkpoint_dir}")
@@ -601,8 +802,11 @@ class DB:
                 self._levels[-1] = ordered
             else:
                 # The ingested file is newer than everything current, so the
-                # memtable must be flushed below it first (RocksDB flushes on
-                # overlapping ingest for the same reason).
+                # memtable — and any in-flight background flush, which would
+                # otherwise land in L0 ABOVE the ingested file — must be
+                # flushed below it first (RocksDB flushes on overlapping
+                # ingest for the same reason).
+                self._drain_imm_locked()
                 if len(self._mem):
                     self._flush_locked()
                 if allow_global_seqno:
@@ -646,10 +850,24 @@ class DB:
     # ------------------------------------------------------------------
 
     def close(self) -> None:
+        # Stop the background thread first (it drains a pending imm before
+        # exiting), then tear down under the lock.
+        with self._lock:
+            if self._closed:
+                return
+            self._bg_stop = True
+            self._cond.notify_all()
+        if self._bg_thread is not None:
+            self._bg_thread.join(timeout=30.0)
+            self._bg_thread = None
+        if self._compaction_thread is not None:
+            self._compaction_thread.join(timeout=60.0)
+            self._compaction_thread = None
         with self._lock:
             if self._closed:
                 return
             self._closed = True
+            self._cond.notify_all()
             if self._wal is not None:
                 self._wal.close()
             for reader in self._readers.values():
