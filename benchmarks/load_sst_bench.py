@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""BASELINE config #2/#3-shaped benchmark: multi-shard load_sst end-to-end.
+
+Drives the FULL north-star path on real DBs through the admin RPC surface:
+build per-shard SST sets → upload to the object store → addS3SstFilesToDB
+on every shard (parallel download, ingest, post-load compaction through the
+configured CompactionBackend) — measuring wall-clock and GB/s for the CPU
+backend vs the TPU backend.
+
+    python -m benchmarks.load_sst_bench --shards 64 --keys_per_shard 20000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import struct
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from rocksplicator_tpu.admin import AdminHandler
+from rocksplicator_tpu.replication import Replicator
+from rocksplicator_tpu.rpc import IoLoop, RpcClientPool, RpcServer
+from rocksplicator_tpu.storage import DBOptions, OpType, UInt64AddOperator, WriteBatch
+from rocksplicator_tpu.storage.sst import SSTWriter
+from rocksplicator_tpu.utils.objectstore import LocalObjectStore
+from rocksplicator_tpu.utils.segment_utils import segment_to_db_name
+from rocksplicator_tpu.utils.stats import Stats
+
+pack64 = struct.Struct("<q").pack
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_sst_sets(store, shards, keys_per_shard, tmp, key_bytes=16):
+    """Per-shard sorted SST files uploaded under sst/<shard:05d>/."""
+    total_bytes = 0
+    for shard in range(shards):
+        path = os.path.join(tmp, f"bulk{shard:05d}.tsst")
+        w = SSTWriter(path)
+        for i in range(keys_per_shard):
+            key = f"s{shard:03d}-key{i:08d}".encode()[:key_bytes]
+            w.add(key, 0, OpType.PUT, pack64(i))
+        w.finish()
+        total_bytes += os.path.getsize(path)
+        store.put_object(path, f"sst/{shard:05d}/bulk.tsst")
+        os.remove(path)
+    return total_bytes
+
+
+def run_load(handler_kwargs, store_uri, shards, keys_per_shard,
+             write_frac, label, rocksdb_dir):
+    replicator = Replicator(port=0)
+    handler = AdminHandler(rocksdb_dir, replicator, **handler_kwargs)
+    server = RpcServer(port=0, ioloop=replicator.ioloop)
+    server.add_handler(handler)
+    server.start()
+    ioloop = IoLoop.default()
+    pool = RpcClientPool()
+
+    def call(method, **args):
+        async def go():
+            return await pool.call("127.0.0.1", server.port, method, args,
+                                   timeout=600)
+
+        return ioloop.run_sync(go(), timeout=610)
+
+    try:
+        for shard in range(shards):
+            call("add_db", db_name=segment_to_db_name("seg", shard),
+                 role="LEADER")
+        # pre-load writes so the post-load compaction has overlap work
+        n_writes = int(keys_per_shard * write_frac)
+        for shard in range(shards):
+            app_db = handler.db_manager.get_db(segment_to_db_name("seg", shard))
+            for i in range(0, n_writes):
+                app_db.write(WriteBatch().put(
+                    f"s{shard:03d}-key{i * 7:08d}".encode()[:16], pack64(-1)))
+        t0 = time.monotonic()
+        for shard in range(shards):
+            call("add_s3_sst_files_to_db",
+                 db_name=segment_to_db_name("seg", shard),
+                 s3_bucket=store_uri, s3_path=f"sst/{shard:05d}",
+                 compact_db_after_load=True)
+        elapsed = time.monotonic() - t0
+        # correctness spot-checks
+        for shard in range(0, shards, max(1, shards // 8)):
+            app_db = handler.db_manager.get_db(segment_to_db_name("seg", shard))
+            assert app_db.get(
+                f"s{shard:03d}-key{(keys_per_shard - 1):08d}".encode()[:16]
+            ) == pack64(keys_per_shard - 1)
+        return elapsed
+    finally:
+        server.stop()
+        handler.close()
+        replicator.stop()
+        ioloop.run_sync(pool.close())
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--shards", type=int, default=16)
+    p.add_argument("--keys_per_shard", type=int, default=20000)
+    p.add_argument("--write_frac", type=float, default=0.2)
+    args = p.parse_args(argv)
+
+    tmp = tempfile.mkdtemp(prefix="loadsst-bench-")
+    store_uri = os.path.join(tmp, "bucket")
+    store = LocalObjectStore(store_uri)
+    total_bytes = build_sst_sets(store, args.shards, args.keys_per_shard, tmp)
+    log(f"built {args.shards} shard SST sets, {total_bytes / 1e6:.1f} MB")
+
+    results = {}
+    for label, kwargs in (
+        ("cpu", {}),
+        ("tpu", {"tpu_compaction": True}),
+    ):
+        elapsed = run_load(
+            kwargs, store_uri, args.shards, args.keys_per_shard,
+            args.write_frac, label, os.path.join(tmp, f"dbs-{label}"),
+        )
+        gbps = total_bytes / elapsed / 1e9
+        results[label] = gbps
+        log(f"{label}: load_sst of {args.shards} shards in {elapsed:.2f}s "
+            f"= {gbps:.3f} GB/s")
+
+    out = {
+        "metric": "load_sst_end_to_end",
+        "value": round(results["tpu"], 3),
+        "unit": "GB/s",
+        "vs_baseline": round(results["tpu"] / results["cpu"], 2)
+        if results["cpu"] else 0.0,
+    }
+    print(json.dumps(out), flush=True)
+    shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

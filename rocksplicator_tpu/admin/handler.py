@@ -108,6 +108,7 @@ class AdminHandler:
         executor_threads: int = 8,
         max_sst_loading_concurrency: int = 999,
         object_store_rate_limit_bytes: Optional[float] = None,
+        tpu_compaction: bool = False,
     ):
         self.rocksdb_dir = os.path.abspath(rocksdb_dir)
         os.makedirs(self.rocksdb_dir, exist_ok=True)
@@ -121,6 +122,7 @@ class AdminHandler:
         self._db_admin_lock = ObjectLock()
         self._store_rate_limit = object_store_rate_limit_bytes
         self._max_sst_loading = max_sst_loading_concurrency
+        self._tpu_compaction = tpu_compaction
         self._sst_loading_lock = threading.Lock()
         self._num_sst_loading = 0
         self._meta_db = DB(os.path.join(self.rocksdb_dir, "meta_db"))
@@ -144,7 +146,14 @@ class AdminHandler:
             segment = db_name_to_segment(db_name)
         except ValueError:
             segment = db_name
-        return self._options_gen(segment)
+        options = self._options_gen(segment)
+        if self._tpu_compaction:
+            # North star: the TPU compaction service registers behind the
+            # engine's CompactionBackend seam for every db this admin hosts.
+            from ..tpu.compaction_service import TpuCompactionService
+
+            TpuCompactionService.install_on_options(options)
+        return options
 
     def _get_app_db(self, db_name: str) -> ApplicationDB:
         app_db = self.db_manager.get_db(db_name)

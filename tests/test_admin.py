@@ -514,3 +514,24 @@ def test_concurrent_duplicate_add_observer_typed_error(nodes, monkeypatch):
 
     code = ioloop.run_coro(both()).result(10)
     assert code == "OBSERVER_ALREADY_EXISTS"
+
+
+def test_tpu_compaction_flag_installs_backend(nodes, call, tmp_path):
+    n = AdminNode(tmp_path, "tpunode")
+    n.handler._tpu_compaction = True
+    try:
+        call(n, "add_db", db_name="seg00001", role="LEADER")
+        app_db = n.handler.db_manager.get_db("seg00001")
+        from rocksplicator_tpu.tpu.backend import TpuCompactionBackend
+
+        assert isinstance(app_db.db.options.compaction_backend,
+                          TpuCompactionBackend)
+        # the TPU-backed compaction produces correct results end-to-end
+        app_db.write(WriteBatch().put(b"a", b"1"))
+        app_db.write(WriteBatch().delete(b"a"))
+        app_db.write(WriteBatch().put(b"b", b"2"))
+        call(n, "compact_db", db_name="seg00001")
+        assert app_db.get(b"a") is None
+        assert app_db.get(b"b") == b"2"
+    finally:
+        n.stop()
