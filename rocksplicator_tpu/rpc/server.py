@@ -39,7 +39,10 @@ class RpcServer:
         self._handlers: list = []
         self._server: Optional[asyncio.AbstractServer] = None
         self._ready = threading.Event()
-        self._conn_tasks: set = set()
+        # connection task -> its in-flight dispatch-task set (one structure
+        # serves both teardown cancellation and graceful drain)
+        self._connections: dict = {}
+        self._draining = False
 
     def add_handler(self, handler: object) -> None:
         self._handlers.append(handler)
@@ -61,20 +64,40 @@ class RpcServer:
         self._port = self._server.sockets[0].getsockname()[1]
         self._ready.set()
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout: float = 0.0) -> None:
+        """Stop serving. ``drain_timeout`` > 0 gives in-flight requests
+        that long to complete before connections are cancelled (the
+        reference's graceful-shutdown contract: stop accepting, drain,
+        then tear down — common/tests/graceful_shutdown_test.cpp)."""
         try:
-            self._ioloop.run_sync(self._stop_async(), timeout=5.0)
+            self._ioloop.run_sync(
+                self._stop_async(drain_timeout), timeout=drain_timeout + 5.0
+            )
         except Exception:
             pass
 
-    async def _stop_async(self) -> None:
-        # Cancel live connections before wait_closed(): since Python 3.12
-        # wait_closed() also waits for connection handlers to finish, and
-        # ours loop until cancelled.
-        for task in list(self._conn_tasks):
-            task.cancel()
+    async def _stop_async(self, drain_timeout: float = 0.0) -> None:
+        # Stop accepting new connections AND new work: frames arriving on
+        # existing connections during the drain get a typed SHUTDOWN error
+        # instead of a handler dispatch (a busy client must not defeat the
+        # drain window).
+        self._draining = True
         if self._server is not None:
             self._server.close()
+        if drain_timeout > 0:
+            deadline = asyncio.get_running_loop().time() + drain_timeout
+            while (
+                any(self._connections.values())
+                and asyncio.get_running_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.02)
+        # Cancel remaining connections before wait_closed(): since Python
+        # 3.12 wait_closed() also waits for connection handlers to finish,
+        # and ours loop until cancelled.
+        for task in list(self._connections):
+            if task is not None:
+                task.cancel()
+        if self._server is not None:
             await self._server.wait_closed()
             self._server = None
 
@@ -84,11 +107,10 @@ class RpcServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         task = asyncio.current_task()
-        if task is not None:
-            self._conn_tasks.add(task)
         frame_reader = FrameReader(reader)
         write_lock = asyncio.Lock()
         inflight: set = set()
+        self._connections[task] = inflight
         try:
             while True:
                 header, payload = await frame_reader.read_frame()
@@ -109,13 +131,12 @@ class RpcServer:
         finally:
             for t in inflight:
                 t.cancel()
+            self._connections.pop(task, None)
             writer.close()
             try:
                 await writer.wait_closed()
             except (ConnectionError, OSError):
                 pass
-            if task is not None:
-                self._conn_tasks.discard(task)
 
     async def _dispatch(
         self,
@@ -128,6 +149,18 @@ class RpcServer:
         args = msg.get("args") or {}
         stats = Stats.get()
         stats.incr(f"rpc.{method}.received")
+        if self._draining:
+            header, chunks = encode_message({
+                "id": req_id, "ok": False,
+                "error": {"code": "SHUTDOWN",
+                          "message": "server draining", "data": {}},
+            })
+            try:
+                async with write_lock:
+                    await write_frame(writer, header, chunks)
+            except (ConnectionError, OSError):
+                pass
+            return
         try:
             fn = self._find_handler(method)
             result = await fn(**args)

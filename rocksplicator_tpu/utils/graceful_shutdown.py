@@ -19,7 +19,8 @@ class GracefulShutdownHandler:
     servers (anything with a ``stop()``), run post-hooks, then set an event
     the main thread can wait on."""
 
-    def __init__(self) -> None:
+    def __init__(self, drain_timeout: float = 10.0) -> None:
+        self._drain_timeout = drain_timeout
         self._pre_hooks: List[Callable[[], None]] = []
         self._post_hooks: List[Callable[[], None]] = []
         self._servers: List[object] = []
@@ -54,7 +55,15 @@ class GracefulShutdownHandler:
             for server in self._servers:
                 stop = getattr(server, "stop", None)
                 if callable(stop):
-                    _safe(stop)
+                    # servers supporting graceful drain get the window;
+                    # others (e.g. the status server) stop immediately
+                    def _stop(s=stop):
+                        try:
+                            s(drain_timeout=self._drain_timeout)
+                        except TypeError:
+                            s()
+
+                    _safe(_stop)
             for hook in self._post_hooks:
                 _safe(hook)
             self.done.set()

@@ -376,3 +376,72 @@ def test_router_close_unregisters_watcher(tmp_path, file_watcher):
     path.write_text(json.dumps({"seg": {"num_shards": 9, "1.2.3.4:1:az": ["00000:M"]}}))
     file_watcher.poll_now()
     assert router.num_shards("seg") == 1  # no longer watching
+
+
+def test_graceful_stop_drains_inflight_requests():
+    """reference common/tests/graceful_shutdown_test.cpp: a request in
+    flight at shutdown completes when a drain window is given."""
+    ioloop = IoLoop.default()
+    server = RpcServer(port=0, ioloop=ioloop)
+    server.add_handler(EchoHandler())
+    server.start()
+    port = server.port
+
+    pool = RpcClientPool()
+    fut = ioloop.run_coro(
+        pool.call("127.0.0.1", port, "slow", {"delay": 0.6}, timeout=10)
+    )
+    import time as _time
+
+    _time.sleep(0.15)  # let the request reach the server
+    server.stop(drain_timeout=5.0)  # must wait for the slow handler
+    assert fut.result(10)["done"] is True
+    ioloop.run_sync(pool.close())
+
+
+def test_hard_stop_cancels_inflight_requests():
+    ioloop = IoLoop.default()
+    server = RpcServer(port=0, ioloop=ioloop)
+    server.add_handler(EchoHandler())
+    server.start()
+    port = server.port
+    pool = RpcClientPool()
+    fut = ioloop.run_coro(
+        pool.call("127.0.0.1", port, "slow", {"delay": 30}, timeout=5)
+    )
+    import time as _time
+
+    _time.sleep(0.15)
+    server.stop()  # no drain: cancelled
+    with pytest.raises(Exception):
+        fut.result(10)
+    ioloop.run_sync(pool.close())
+
+
+def test_drain_rejects_new_requests_on_live_connections():
+    """A busy client on an existing connection cannot defeat the drain:
+    frames arriving during the window get a typed SHUTDOWN error."""
+    import threading as _threading
+    import time as _time
+
+    ioloop = IoLoop.default()
+    server = RpcServer(port=0, ioloop=ioloop)
+    server.add_handler(EchoHandler())
+    server.start()
+    port = server.port
+    pool = RpcClientPool()
+    slow = ioloop.run_coro(
+        pool.call("127.0.0.1", port, "slow", {"delay": 0.5}, timeout=10)
+    )
+    _time.sleep(0.15)
+    stopper = _threading.Thread(target=lambda: server.stop(drain_timeout=5.0))
+    stopper.start()
+    _time.sleep(0.2)  # drain in progress, slow request still running
+    with pytest.raises(RpcApplicationError) as ei:
+        ioloop.run_coro(
+            pool.call("127.0.0.1", port, "echo", {"text": "late"}, timeout=5)
+        ).result(10)
+    assert ei.value.code == "SHUTDOWN"
+    assert slow.result(10)["done"] is True  # pre-drain request completed
+    stopper.join(10)
+    ioloop.run_sync(pool.close())
