@@ -2,33 +2,49 @@
 
 Frame layout (all little-endian):
     magic   u16  = 0x5254 ("RT")
-    flags   u16  (reserved; bit 0 = header compressed — not yet used)
+    flags   u16  (bit 0 = payload zlib-compressed)
     hlen    u32  header length
-    plen    u32  payload length
+    plen    u32  payload length (on-wire, i.e. compressed when flagged)
     header  [hlen] JSON
     payload [plen] raw binary region
 
 The reference's analog is the fbthrift header protocol with optional
-snappy/zstd transforms (common/thrift_client_pool.h:277-284); compression
-flags are reserved in the header for the same purpose.
+snappy/zstd channel transforms (common/thrift_client_pool.h:277-284);
+payloads above a threshold are transparently zlib-compressed here (zlib is
+the in-image codec; the flag word leaves room for others).
 """
 
 from __future__ import annotations
 
 import asyncio
 import struct
+import zlib
 from typing import List, Tuple
 
 MAGIC = 0x5254
+FLAG_PAYLOAD_ZLIB = 1
 _HEADER = struct.Struct("<HHII")
 MAX_FRAME_BYTES = 256 * 1024 * 1024
+# payloads in this size band are compressed (WAL batches and other mid-size
+# messages); tiny ones aren't worth the CPU and huge ones would stall the
+# event loop with synchronous zlib (bulk data rides the object store, not
+# RPC frames)
+COMPRESS_THRESHOLD = 4096
+COMPRESS_MAX = 8 * 1024 * 1024
 
 
 async def write_frame(
     writer: asyncio.StreamWriter, header: bytes, payload_chunks: List[bytes]
 ) -> None:
     plen = sum(len(c) for c in payload_chunks)
-    writer.write(_HEADER.pack(MAGIC, 0, len(header), plen))
+    flags = 0
+    if COMPRESS_THRESHOLD <= plen <= COMPRESS_MAX:
+        compressed = zlib.compress(b"".join(payload_chunks), 1)
+        if len(compressed) < plen:
+            payload_chunks = [compressed]
+            plen = len(compressed)
+            flags |= FLAG_PAYLOAD_ZLIB
+    writer.write(_HEADER.pack(MAGIC, flags, len(header), plen))
     writer.write(header)
     for chunk in payload_chunks:
         writer.write(chunk)
@@ -43,11 +59,20 @@ class FrameReader:
         """Returns (header, payload) memoryviews. Raises
         asyncio.IncompleteReadError on clean EOF."""
         head = await self._reader.readexactly(_HEADER.size)
-        magic, _flags, hlen, plen = _HEADER.unpack(head)
+        magic, flags, hlen, plen = _HEADER.unpack(head)
         if magic != MAGIC:
             raise ValueError(f"bad frame magic: {magic:#x}")
         if hlen + plen > MAX_FRAME_BYTES:
             raise ValueError(f"frame too large: {hlen + plen}")
         body = await self._reader.readexactly(hlen + plen)
         view = memoryview(body)
-        return view[:hlen], view[hlen:]
+        header, payload = view[:hlen], view[hlen:]
+        if flags & FLAG_PAYLOAD_ZLIB:
+            # bounded decompression: never materialize more than the frame
+            # cap no matter what the peer claims (zip-bomb guard)
+            d = zlib.decompressobj()
+            raw = d.decompress(bytes(payload), MAX_FRAME_BYTES + 1)
+            if len(raw) > MAX_FRAME_BYTES or d.unconsumed_tail or d.unused_data:
+                raise ValueError("malformed or oversized compressed frame")
+            payload = memoryview(raw)
+        return header, payload

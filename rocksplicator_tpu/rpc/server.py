@@ -58,6 +58,7 @@ class RpcServer:
         self._ioloop.run_sync(self._start_async())
 
     async def _start_async(self) -> None:
+        self._draining = False  # a restarted server serves again
         self._server = await asyncio.start_server(
             self._on_connection, self._host, self._port
         )
@@ -149,19 +150,9 @@ class RpcServer:
         args = msg.get("args") or {}
         stats = Stats.get()
         stats.incr(f"rpc.{method}.received")
-        if self._draining:
-            header, chunks = encode_message({
-                "id": req_id, "ok": False,
-                "error": {"code": "SHUTDOWN",
-                          "message": "server draining", "data": {}},
-            })
-            try:
-                async with write_lock:
-                    await write_frame(writer, header, chunks)
-            except (ConnectionError, OSError):
-                pass
-            return
         try:
+            if self._draining:
+                raise RpcApplicationError("SHUTDOWN", "server draining")
             fn = self._find_handler(method)
             result = await fn(**args)
             reply = {"id": req_id, "ok": True, "result": result}

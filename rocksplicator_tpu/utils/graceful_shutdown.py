@@ -6,6 +6,7 @@ that stops the thrift server, with registered pre- and post-stop hooks.
 
 from __future__ import annotations
 
+import inspect
 import logging
 import signal
 import threading
@@ -56,11 +57,17 @@ class GracefulShutdownHandler:
                 stop = getattr(server, "stop", None)
                 if callable(stop):
                     # servers supporting graceful drain get the window;
-                    # others (e.g. the status server) stop immediately
+                    # others (e.g. the status server) stop immediately —
+                    # decided by signature, not by catching TypeError (which
+                    # would double-invoke stop() and mask real errors)
                     def _stop(s=stop):
                         try:
+                            params = inspect.signature(s).parameters
+                        except (TypeError, ValueError):
+                            params = {}
+                        if "drain_timeout" in params:
                             s(drain_timeout=self._drain_timeout)
-                        except TypeError:
+                        else:
                             s()
 
                     _safe(_stop)

@@ -445,3 +445,71 @@ def test_drain_rejects_new_requests_on_live_connections():
     assert slow.result(10)["done"] is True  # pre-drain request completed
     stopper.join(10)
     ioloop.run_sync(pool.close())
+
+
+def test_frame_compression_roundtrip_and_bomb_guard():
+    import asyncio as _a
+    import zlib as _z
+
+    from rocksplicator_tpu.rpc import framing
+
+    async def go():
+        # loopback stream pair
+        server_reader = None
+
+        async def on_conn(r, w):
+            nonlocal server_reader
+            server_reader = (r, w)
+
+        srv = await _a.start_server(on_conn, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        cr, cw = await _a.open_connection("127.0.0.1", port)
+        await _a.sleep(0.05)
+        sr, sw = server_reader
+        # large compressible payload: compressed on the wire
+        payload = b"A" * 100_000
+        await framing.write_frame(cw, b'{"id":1}', [payload])
+        reader = framing.FrameReader(sr)
+        header, got = await reader.read_frame()
+        assert bytes(got) == payload
+        # oversized-decompression frame is rejected
+        bomb = _z.compress(b"B" * (framing.MAX_FRAME_BYTES + 10), 1)
+        sw_head = framing._HEADER.pack(
+            framing.MAGIC, framing.FLAG_PAYLOAD_ZLIB, 2, len(bomb))
+        cw.write(sw_head + b"{}" + bomb)
+        await cw.drain()
+        try:
+            await reader.read_frame()
+            raised = False
+        except ValueError:
+            raised = True
+        assert raised
+        cw.close()
+        srv.close()
+
+    _a.run(go())
+
+
+def test_server_restart_serves_after_drain_stop():
+    ioloop = IoLoop.default()
+    server = RpcServer(port=0, ioloop=ioloop)
+    server.add_handler(EchoHandler())
+    server.start()
+    port = server.port
+    server.stop(drain_timeout=1.0)
+    server2 = RpcServer(port=port, host="127.0.0.1", ioloop=ioloop)
+    server2.add_handler(EchoHandler())
+    server2.start()
+    try:
+        import time as _time
+
+        _time.sleep(1.1)  # clear pool reconnect throttle
+        pool = RpcClientPool()
+
+        async def go():
+            return await pool.call("127.0.0.1", port, "echo", {"text": "hi"})
+
+        assert ioloop.run_sync(go())["text"] == "hi"
+        ioloop.run_sync(pool.close())
+    finally:
+        server2.stop()
