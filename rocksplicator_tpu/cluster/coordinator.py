@@ -54,7 +54,8 @@ class CoordinatorServer:
     on restart, which the state machines tolerate)."""
 
     def __init__(self, port: int = 0, ioloop: Optional[IoLoop] = None,
-                 session_ttl: float = DEFAULT_SESSION_TTL):
+                 session_ttl: float = DEFAULT_SESSION_TTL,
+                 data_dir: Optional[str] = None):
         self._ioloop = ioloop or IoLoop.default()
         self._nodes: Dict[str, _Node] = {"/": _Node(b"", None)}
         self._sessions: Dict[int, float] = {}  # sid -> expiry deadline
@@ -63,10 +64,84 @@ class CoordinatorServer:
         self._ttl = session_ttl
         self._change_event: Dict[str, asyncio.Event] = {}
         self._global_version = 0
+        # Durability (ZK is durable): persistent nodes snapshot to disk on
+        # mutation (debounced) and reload on restart; ephemerals die with
+        # their sessions by definition and are never persisted.
+        self._data_dir = data_dir
+        self._dirty = False
+        if data_dir:
+            self._load_snapshot()
         self._server = RpcServer(port=port, ioloop=self._ioloop)
         self._server.add_handler(self)
         self._server.start()
         self._reaper_task = self._ioloop.run_coro(self._reap_sessions())
+        self._snapshot_task = (
+            self._ioloop.run_coro(self._snapshot_loop()) if data_dir else None
+        )
+
+    # -- durability --------------------------------------------------------
+
+    def _snapshot_path(self) -> str:
+        import os
+
+        return os.path.join(self._data_dir, "coordinator_state.json")
+
+    def _load_snapshot(self) -> None:
+        import json
+        import os
+
+        os.makedirs(self._data_dir, exist_ok=True)
+        try:
+            with open(self._snapshot_path(), "r") as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            return
+        with self._lock:
+            for path, entry in raw.get("nodes", {}).items():
+                node = _Node(bytes.fromhex(entry["value"]), None)
+                node.version = entry["version"]
+                node.seq_counter = itertools.count(entry.get("seq", 0))
+                self._nodes[path] = node
+
+    def _write_snapshot(self) -> None:
+        import json
+
+        from ..utils.misc import write_file_atomic
+
+        with self._lock:
+            if not self._dirty:
+                return
+            self._dirty = False
+            nodes = {
+                path: {
+                    "value": node.value.hex(),
+                    "version": node.version,
+                    # preserve sequential-node counters across restarts
+                    "seq": next(node.seq_counter),
+                }
+                for path, node in self._nodes.items()
+                if node.ephemeral_owner is None
+            }
+            # peeking at seq_counter consumed a value; rebuild the counters
+            for path, node in self._nodes.items():
+                if node.ephemeral_owner is None:
+                    node.seq_counter = itertools.count(nodes[path]["seq"])
+        write_file_atomic(
+            self._snapshot_path(),
+            json.dumps({"nodes": nodes}).encode("utf-8"),
+        )
+
+    async def _snapshot_loop(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                self._write_snapshot()
+            except Exception:
+                log.exception("coordinator snapshot failed")
+
+    def _mark_dirty(self) -> None:
+        if self._data_dir:
+            self._dirty = True
 
     @property
     def port(self) -> int:
@@ -74,6 +149,12 @@ class CoordinatorServer:
 
     def stop(self) -> None:
         self._reaper_task.cancel()
+        if self._snapshot_task is not None:
+            self._snapshot_task.cancel()
+            try:
+                self._write_snapshot()
+            except Exception:
+                pass
         self._server.stop()
 
     # ------------------------------------------------------------------
@@ -92,6 +173,7 @@ class CoordinatorServer:
 
     def _signal_change(self, *paths: str) -> None:
         self._global_version += 1
+        self._mark_dirty()
         for path in paths:
             ev = self._change_event.get(path)
             if ev is not None:

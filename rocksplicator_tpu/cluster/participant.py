@@ -81,6 +81,33 @@ class Participant:
             self._path("assignments", instance.instance_id),
             self._on_assignments,
         )
+        # PartitionStateUpdater (reference utils/PartitionStateUpdater.java):
+        # periodically checkpoint led partitions' seqs so the 3-node-failure
+        # guard compares against fresh numbers, not just promotion-time ones.
+        self._seq_updater = threading.Thread(
+            target=self._partition_seq_loop, name="partition-seq-updater",
+            daemon=True,
+        )
+        self._seq_updater.start()
+
+    def _partition_seq_loop(self, interval: float = 5.0) -> None:
+        from ..utils.segment_utils import partition_name_to_db_name
+
+        while not self._stopped:
+            time.sleep(interval)
+            try:
+                for partition, state in self.current_states.items():
+                    if state not in ("LEADER", "MASTER"):
+                        continue
+                    seq = self.admin.get_sequence_number(
+                        self.ctx.local_admin_addr,
+                        partition_name_to_db_name(partition),
+                    )
+                    if seq is not None:
+                        self.ctx.set_partition_seq(partition, seq)
+            except Exception:
+                if not self._stopped:
+                    log.exception("partition seq updater failed")
 
     # ------------------------------------------------------------------
 

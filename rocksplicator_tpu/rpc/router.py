@@ -227,6 +227,40 @@ class RpcRouter:
                 break
         return clients
 
+    async def hedged_call(
+        self,
+        segment: str,
+        shard: int,
+        method: str,
+        args: Optional[dict] = None,
+        role: Role = Role.ANY,
+        backup_delay_sec: float = 0.05,
+        timeout: float = 30.0,
+    ):
+        """Hedged request (reference: future_util speculative futures at the
+        router level): fire at the best replica; if it hasn't answered
+        within ``backup_delay_sec``, also fire at the next replica and take
+        the first success."""
+        from ..utils.future_util import speculate
+
+        hosts = self.get_hosts_for(segment, shard, role, Quantity.TWO)
+        if not hosts:
+            raise RpcConnectionError(f"no hosts for {segment}:{shard}")
+        if len(hosts) == 1:
+            return await self._pool.call(
+                hosts[0].ip, hosts[0].port, method, args, timeout=timeout
+            )
+
+        def make(host: Host):
+            async def call():
+                return await self._pool.call(
+                    host.ip, host.port, method, args, timeout=timeout
+                )
+
+            return call
+
+        return await speculate(make(hosts[0]), make(hosts[1]), backup_delay_sec)
+
     @property
     def pool(self) -> RpcClientPool:
         return self._pool

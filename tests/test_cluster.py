@@ -475,3 +475,30 @@ def test_full_production_flow_counter_service(control_plane, tmp_path):
         assert total == 30
     finally:
         ioloop.run_sync(pool.close())
+
+
+def test_coordinator_durability(tmp_path):
+    """Persistent nodes (resources, configs, partition state) survive a
+    coordinator restart; ephemerals do not."""
+    data_dir = str(tmp_path / "coord_data")
+    s1 = CoordinatorServer(port=0, session_ttl=1.5, data_dir=data_dir)
+    c1 = CoordinatorClient("127.0.0.1", s1.port)
+    c1.create("/clusters/prod/resources/seg", b'{"num_shards": 4}')
+    c1.create("/clusters/prod/config/seg", b'{"x": 1}')
+    c1.create("/eph", b"gone", ephemeral=True)
+    seq1 = c1.create("/clusters/prod/locks/n-", sequential=True)
+    c1.close()
+    s1.stop()
+    # restart from the same data dir
+    s2 = CoordinatorServer(port=0, session_ttl=1.5, data_dir=data_dir)
+    c2 = CoordinatorClient("127.0.0.1", s2.port)
+    try:
+        assert c2.get("/clusters/prod/resources/seg")[0] == b'{"num_shards": 4}'
+        assert c2.get("/clusters/prod/config/seg")[0] == b'{"x": 1}'
+        assert not c2.exists("/eph")
+        # sequential counters do not regress (no name collisions)
+        seq2 = c2.create("/clusters/prod/locks/n-", sequential=True)
+        assert seq2 > seq1
+    finally:
+        c2.close()
+        s2.stop()

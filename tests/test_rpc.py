@@ -513,3 +513,53 @@ def test_server_restart_serves_after_drain_stop():
         ioloop.run_sync(pool.close())
     finally:
         server2.stop()
+
+
+def test_router_hedged_call(rpc_server):
+    """Router-level hedged reads (reference future_util speculation): a
+    stuck primary is covered by the backup replica."""
+    server, ioloop = rpc_server
+
+    class StuckHandler:
+        async def handle_probe(self):
+            await asyncio.sleep(30)
+            return {"who": "stuck"}
+
+    stuck_server = RpcServer(port=0, ioloop=ioloop)
+    stuck_server.add_handler(StuckHandler())
+    stuck_server.start()
+
+    class FastHandler:
+        async def handle_probe(self):
+            return {"who": "fast"}
+
+    fast_server = RpcServer(port=0, ioloop=ioloop)
+    fast_server.add_handler(FastHandler())
+    fast_server.start()
+    try:
+        shard_map = {
+            "seg": {
+                "num_shards": 1,
+                f"127.0.0.1:{stuck_server.port}:az1": ["00000:M"],
+                f"127.0.0.1:{fast_server.port}:az1": ["00000:S"],
+            }
+        }
+        router = RpcRouter(local_az="az1")
+        router.update_layout(ClusterLayout.parse(json.dumps(shard_map).encode()))
+
+        async def go():
+            return await router.hedged_call(
+                "seg", 0, "probe", role=Role.ANY,
+                backup_delay_sec=0.05, timeout=10,
+            )
+
+        result = ioloop.run_sync(go(), timeout=15)
+        assert result["who"] == "fast"  # backup replica answered
+
+        async def cleanup():
+            await router.pool.close()
+
+        ioloop.run_sync(cleanup())
+    finally:
+        stuck_server.stop()
+        fast_server.stop()
