@@ -27,8 +27,9 @@ log = logging.getLogger(__name__)
 
 _PUT, _DELETE, _MERGE = 1, 2, 3
 
-# Largest batch the single-shot kernel accepts before falling back (keeps
-# device memory bounded; multi-pass chunked merge is a later-round item).
+# Boundary between the single-shot kernel and the hierarchical chunked
+# merge (tpu/chunked.py): batches up to this size launch once; larger ones
+# fold per-run chunks then summaries at this fixed launch shape.
 MAX_TPU_ENTRIES = 1 << 22
 
 
@@ -57,20 +58,28 @@ class TpuCompactionBackend(CompactionBackend):
         if merge_op is not None and not isinstance(merge_op, UInt64AddOperator):
             # custom operators run arbitrary Python — CPU path
             return self._fallback.merge_runs(runs, merge_op, drop_tombstones)
-        entries: List[Entry] = [e for run in runs for e in run]
-        if not entries:
+        run_lists: List[List[Entry]] = [list(run) for run in runs]
+        total = sum(len(r) for r in run_lists)
+        if total == 0:
             return iter(())
 
         def cpu():
+            entries = [e for run in run_lists for e in run]
             return self._fallback.merge_runs(
                 [sorted(entries, key=lambda e: (e[0], -e[1]))],
                 merge_op, drop_tombstones,
             )
 
-        if len(entries) > MAX_TPU_ENTRIES:
-            return cpu()
+        if total > MAX_TPU_ENTRIES:
+            # hierarchical chunked merge: per-run folding then summary
+            # merging, each launch at one fixed shape (tpu/chunked.py)
+            result = self._chunked(run_lists, merge_op, drop_tombstones)
+            if result is None:
+                return cpu()
+            return iter(result)
+        entries = [e for run in run_lists for e in run]
         try:
-            batch = pack_entries(entries, capacity=_next_pow2(len(entries)))
+            batch = pack_entries(entries, capacity=_next_pow2(total))
         except UnsupportedBatch as e:
             log.debug("TPU compaction fallback: %s", e)
             return cpu()
@@ -84,6 +93,38 @@ class TpuCompactionBackend(CompactionBackend):
         if result is None:  # kernel flagged limb-overflow risk
             return cpu()
         return iter(result)
+
+    def _chunked(self, runs, merge_op, drop_tombstones) -> Optional[List[Entry]]:
+        from .chunked import chunked_merge
+        from ..ops.compaction_kernel import MergeKind as MK
+
+        kind = (
+            MK.UINT64_ADD if isinstance(merge_op, UInt64AddOperator)
+            else MK.NONE
+        )
+        try:
+            run_batches = [pack_entries(run) for run in runs]
+        except UnsupportedBatch as e:
+            log.debug("TPU chunked fallback: %s", e)
+            return None
+        if kind is MK.NONE and any(
+            bool((b.vtype[: b.num_valid()] == _MERGE).any())
+            for b in run_batches
+        ):
+            return None
+        result = chunked_merge(
+            run_batches, kind, drop_tombstones,
+            chunk_entries=MAX_TPU_ENTRIES // 4,
+            launch_entries=MAX_TPU_ENTRIES,
+        )
+        if result is None:
+            return None
+        arrays, count = result
+        return unpack_entries(
+            arrays["key_words_be"], arrays["key_len"], arrays["seq_hi"],
+            arrays["seq_lo"], arrays["vtype"], arrays["val_words"],
+            arrays["val_len"], count,
+        )
 
     def _run_batch(
         self, batch: KVBatch, merge_op: Optional[MergeOperator],

@@ -1,0 +1,172 @@
+"""Chunked hierarchical TPU merges for batches beyond one kernel launch.
+
+Correctness rests on the engine's run invariant (the same one the mesh
+block axis uses): for any key, two input runs' entries occupy disjoint,
+ordered sequence ranges (L0 files partition by flush order; deeper levels
+are key-disjoint; ingested files carry one global seqno). Under that
+invariant LSM resolution is associative:
+
+- a chunk of ONE run holds a contiguous newest-first slice of each key's
+  stack, so folding it yields either a resolved base (shadowing the rest)
+  or a partial-merge summary strictly newer than the remainder;
+- merging two run summaries composes the same way (newest base shadows).
+
+Pipeline: fold each run's chunks bottom-up, then greedily group run
+summaries into kernel launches, with tombstones kept until the final pass.
+Intermediate results stay as packed numpy lanes — no Python tuples until
+the caller unpacks the final output.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.compaction_kernel import MergeKind, merge_resolve_kernel
+from ..ops.kv_format import KVBatch, UnsupportedBatch, pack_entries
+
+log = logging.getLogger(__name__)
+
+FIELDS = (
+    "key_words_be", "key_words_le", "key_len", "seq_hi", "seq_lo",
+    "vtype", "val_words", "val_len",
+)
+
+
+def _run_kernel(batch_arrays: dict, n_valid: int, merge_kind: MergeKind,
+                drop_tombstones: bool,
+                pad_to: Optional[int] = None) -> Tuple[Optional[dict], int]:
+    """One launch over packed arrays; returns (output arrays trimmed to
+    count, count) or (None, 0) on kernel-flagged fallback. ``pad_to``
+    fixes the launch shape so the whole merge tree reuses ONE compiled
+    kernel instead of recompiling per group size."""
+    import jax.numpy as jnp
+
+    n_rows = batch_arrays["key_len"].shape[0]
+    if pad_to is not None and n_rows < pad_to:
+        pad = pad_to - n_rows
+        batch_arrays = {
+            f: np.pad(batch_arrays[f],
+                      [(0, pad)] + [(0, 0)] * (batch_arrays[f].ndim - 1))
+            for f in FIELDS
+        }
+        n_rows = pad_to
+    valid = np.zeros(n_rows, dtype=bool)
+    valid[:n_valid] = True
+    out = merge_resolve_kernel(
+        *(jnp.asarray(batch_arrays[f]) for f in FIELDS),
+        jnp.asarray(valid),
+        merge_kind=merge_kind, drop_tombstones=drop_tombstones,
+    )
+    if bool(out["needs_cpu_fallback"]):
+        return None, 0
+    count = int(out["count"])
+    return {f: np.asarray(out[f])[:count] for f in FIELDS}, count
+
+
+def _concat(parts: List[dict]) -> Tuple[dict, int]:
+    merged = {f: np.concatenate([p[f] for p in parts]) for f in FIELDS}
+    return merged, merged["key_len"].shape[0]
+
+
+def _batch_to_arrays(batch: KVBatch) -> Tuple[dict, int]:
+    n = batch.num_valid()
+    return {f: getattr(batch, f)[:n] for f in FIELDS}, n
+
+
+def chunked_merge(
+    run_batches: List[KVBatch],
+    merge_kind: MergeKind,
+    drop_tombstones: bool,
+    chunk_entries: int,
+    launch_entries: int,
+) -> Optional[Tuple[dict, int]]:
+    """Merge packed per-run batches hierarchically. Returns (final output
+    arrays, count), or None when the kernel demands CPU fallback."""
+    # 1) fold each run's chunks to one summary per run
+    summaries: List[Tuple[dict, int]] = []
+    for batch in run_batches:
+        arrays, n = _batch_to_arrays(batch)
+        pieces = [
+            ({f: arrays[f][i:i + chunk_entries] for f in FIELDS},
+             min(chunk_entries, n - i))
+            for i in range(0, n, chunk_entries)
+        ] or [(arrays, 0)]
+        multi_chunk = len(pieces) > 1
+        while len(pieces) > 1:
+            next_level: List[Tuple[dict, int]] = []
+            group: List[dict] = []
+            group_n = 0
+            for part, pn in pieces:
+                if group and group_n + pn > launch_entries:
+                    merged, _total = _concat(group)
+                    out = _run_kernel(merged, _total, merge_kind, False, pad_to=launch_entries)
+                    if out[0] is None:
+                        return None
+                    next_level.append(out)
+                    group, group_n = [], 0
+                group.append(part)
+                group_n += pn
+            if group:
+                merged, _total = _concat(group)
+                out = _run_kernel(merged, _total, merge_kind, False, pad_to=launch_entries)
+                if out[0] is None:
+                    return None
+                next_level.append(out)
+            pieces = next_level
+        part, pn = pieces[0]
+        if multi_chunk:
+            # the reduction loop's last output is already deduplicated
+            summaries.append((part, pn))
+        else:
+            # single raw chunk: fold once so the summary is deduplicated
+            out = _run_kernel(part, pn, merge_kind, False,
+                              pad_to=launch_entries)
+            if out[0] is None:
+                return None
+            summaries.append(out)
+
+    # 2) merge run summaries hierarchically, final pass applies the real
+    #    tombstone policy. Grouping folds CONSECUTIVE summaries, which is
+    #    only associativity-safe for ADJACENT seq intervals — engine run
+    #    lists arrive level-ordered ([L0 old..new, L1, ...]), NOT seq-
+    #    ordered, so sort summaries by their max seq first (runs occupy
+    #    globally disjoint seq intervals in this engine).
+    def _max_seq(part_n) -> int:
+        part, n = part_n
+        if n == 0:
+            return 0
+        hi = part["seq_hi"][:n].astype(np.uint64)
+        lo = part["seq_lo"][:n].astype(np.uint64)
+        return int(((hi << np.uint64(32)) | lo).max())
+
+    summaries.sort(key=_max_seq)
+    while True:
+        total = sum(n for _p, n in summaries)
+        if total <= launch_entries:
+            merged, _n = _concat([p for p, _ in summaries])
+            return _run_kernel(merged, total, merge_kind, drop_tombstones, pad_to=launch_entries)
+        next_level = []
+        group, group_n = [], 0
+        for part, pn in summaries:
+            if group and group_n + pn > launch_entries:
+                merged, _t = _concat(group)
+                out = _run_kernel(merged, _t, merge_kind, False, pad_to=launch_entries)
+                if out[0] is None:
+                    return None
+                next_level.append(out)
+                group, group_n = [], 0
+            group.append(part)
+            group_n += pn
+        if group:
+            merged, _t = _concat(group)
+            out = _run_kernel(merged, _t, merge_kind, False, pad_to=launch_entries)
+            if out[0] is None:
+                return None
+            next_level.append(out)
+        if len(next_level) >= len(summaries):
+            # no reduction possible (too many distinct keys per summary)
+            return None
+        summaries = next_level
