@@ -628,6 +628,36 @@ class DB:
         self._gc_files(inputs)
 
     def _write_merged(self, runs: List, drop_tombstones: bool) -> List[str]:
+        # Backends with a direct file sink (the TPU pipeline: kernel output
+        # arrays → vectorized block assembly + kernel-built bloom) skip the
+        # per-entry tuple path entirely, splitting at target_file_bytes.
+        direct = getattr(self._backend, "merge_runs_to_files", None)
+        if direct is not None:
+            runs = [list(r) for r in runs]  # reusable on fallback
+            allocated: List[str] = []
+
+            def path_factory() -> str:
+                name = self._new_file_name()
+                allocated.append(name)
+                return os.path.join(self.path, name)
+
+            try:
+                outputs = direct(
+                    runs, self.options.merge_operator, drop_tombstones,
+                    path_factory, self.options.block_bytes,
+                    self.options.compression, self.options.bits_per_key,
+                    self.options.target_file_bytes,
+                )
+            except Exception:
+                log.exception("direct merge sink failed; using tuple path")
+                outputs = None
+            if outputs is not None:
+                names: List[str] = []
+                for path, _props in outputs:
+                    name = os.path.basename(path)
+                    self._readers[name] = SSTReader(path)
+                    names.append(name)
+                return names
         stream = self._backend.merge_runs(
             runs, self.options.merge_operator, drop_tombstones
         )

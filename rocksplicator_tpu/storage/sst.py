@@ -154,12 +154,18 @@ class SSTWriter:
         self._block_size = 0
 
     def finish(self, global_seqno: Optional[int] = None,
-               extra_props: Optional[Dict] = None) -> Dict:
+               extra_props: Optional[Dict] = None,
+               precomputed_bloom: Optional[BloomFilter] = None) -> Dict:
+        """``precomputed_bloom`` lets a kernel-built bitmap (byte-identical
+        format) be written directly — the TPU pipeline's sink path."""
         if self._finished:
             raise InvalidArgument("finish() called twice")
         self._flush_block()
         bloom_off = self._offset
-        bloom = BloomFilter.build(self._keys, self._bits_per_key)
+        bloom = (
+            precomputed_bloom if precomputed_bloom is not None
+            else BloomFilter.build(self._keys, self._bits_per_key)
+        )
         bloom_bytes = bloom.to_bytes()
         self._file.write(bloom_bytes)
         index_off = bloom_off + len(bloom_bytes)

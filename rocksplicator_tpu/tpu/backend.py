@@ -14,6 +14,7 @@ does without hand-written SIMD).
 from __future__ import annotations
 
 import logging
+import os
 from typing import Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -38,6 +39,12 @@ def _next_pow2(n: int) -> int:
     while p < n:
         p <<= 1
     return p
+
+
+def _batch_fields(batch: KVBatch) -> dict:
+    from .chunked import FIELDS
+
+    return {f: getattr(batch, f) for f in FIELDS}
 
 
 class TpuCompactionBackend(CompactionBackend):
@@ -125,6 +132,105 @@ class TpuCompactionBackend(CompactionBackend):
             arrays["seq_lo"], arrays["vtype"], arrays["val_words"],
             arrays["val_len"], count,
         )
+
+    def merge_runs_to_files(
+        self,
+        runs: List[Iterable[Entry]],
+        merge_op: Optional[MergeOperator],
+        drop_tombstones: bool,
+        path_factory,
+        block_bytes: int,
+        compression: int,
+        bits_per_key: int,
+        target_file_bytes: int,
+    ) -> Optional[List[Tuple[str, dict]]]:
+        """Merge + write output SSTs with the vectorized array sink and
+        kernel-built blooms (no per-entry Python on the output side),
+        splitting at ``target_file_bytes``. Returns [(path, props)] — empty
+        list for an all-tombstoned result — or None → tuple path."""
+        from ..ops.bloom_tpu import bloom_build_tpu
+        from ..storage.bloom import num_words_for
+        from .chunked import run_kernel_arrays
+        from .format import uniform_widths, write_sst_from_arrays
+
+        if merge_op is not None and not isinstance(merge_op, UInt64AddOperator):
+            return None
+        run_lists = [list(run) for run in runs]
+        total = sum(len(r) for r in run_lists)
+        if total == 0 or total > MAX_TPU_ENTRIES:
+            return None  # chunked/CPU paths return entries, not files (yet)
+        try:
+            batch = pack_entries(
+                [e for r in run_lists for e in r],
+                capacity=_next_pow2(total),
+            )
+        except UnsupportedBatch:
+            return None
+        if merge_op is None and bool((batch.vtype == _MERGE).any()):
+            return None
+        # Cheap pre-check BEFORE the kernel: the sink needs uniform output
+        # widths. Keys must be uniform; values must be uniform among the
+        # entries that can survive (deletes contribute no value at the
+        # bottom; kept tombstones mid-level make widths mixed).
+        n = batch.num_valid()
+        kl = batch.key_len[:n]
+        if n and not (kl == kl[0]).all():
+            return None
+        is_del = batch.vtype[:n] == _DELETE
+        vlens = batch.val_len[:n]
+        non_del_vlens = vlens[~is_del]
+        if len(non_del_vlens) and not (non_del_vlens == non_del_vlens[0]).all():
+            return None
+        if not drop_tombstones and is_del.any() and len(non_del_vlens):
+            if non_del_vlens[0] != 0:
+                return None  # kept tombstones (len 0) would mix widths
+        kind = (
+            MergeKind.UINT64_ADD if isinstance(merge_op, UInt64AddOperator)
+            else MergeKind.NONE
+        )
+        arrays, count = run_kernel_arrays(
+            _batch_fields(batch), n, kind, drop_tombstones,
+            pad_to=batch.capacity,
+        )
+        if arrays is None:
+            return None
+        if count == 0:
+            return []  # fully compacted away — nothing to write
+        if uniform_widths(arrays, count) is None:
+            return None
+        stride = int(arrays["key_len"][0]) + int(arrays["val_len"][0]) + 17
+        entries_per_file = max(1024, target_file_bytes // max(1, stride))
+        block_entries = max(64, block_bytes // max(1, stride))
+        outputs: List[Tuple[str, dict]] = []
+        for start in range(0, count, entries_per_file):
+            end = min(start + entries_per_file, count)
+            sub = {f: arrays[f][start:end] for f in arrays}
+            sub_valid = np.ones(end - start, dtype=bool)
+            num_words = num_words_for(end - start, bits_per_key)
+            import jax.numpy as jnp
+
+            bloom = bloom_build_tpu(
+                jnp.asarray(sub["key_words_le"]),
+                jnp.asarray(sub["key_len"]),
+                jnp.asarray(sub_valid), num_words=num_words,
+            )
+            path = path_factory()
+            props = write_sst_from_arrays(
+                sub, end - start, path,
+                bloom_words=np.asarray(bloom),
+                block_entries=block_entries,
+                compression=compression,
+                bits_per_key=bits_per_key,
+            )
+            if props is None:  # should not happen after the width checks
+                for p, _ in outputs:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+                return None
+            outputs.append((path, props))
+        return outputs
 
     def _run_batch(
         self, batch: KVBatch, merge_op: Optional[MergeOperator],

@@ -11,21 +11,21 @@ invariant LSM resolution is associative:
   or a partial-merge summary strictly newer than the remainder;
 - merging two run summaries composes the same way (newest base shadows).
 
-Pipeline: fold each run's chunks bottom-up, then greedily group run
-summaries into kernel launches, with tombstones kept until the final pass.
-Intermediate results stay as packed numpy lanes — no Python tuples until
-the caller unpacks the final output.
+Pipeline: fold each run's chunks bottom-up, then seq-sort and greedily
+group summaries into fixed-shape launches, with tombstones kept until the
+final pass. Intermediate results stay as packed numpy lanes — no Python
+tuples until the caller unpacks the final output.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Iterable, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 from ..ops.compaction_kernel import MergeKind, merge_resolve_kernel
-from ..ops.kv_format import KVBatch, UnsupportedBatch, pack_entries
+from ..ops.kv_format import KVBatch
 
 log = logging.getLogger(__name__)
 
@@ -35,13 +35,15 @@ FIELDS = (
 )
 
 
-def _run_kernel(batch_arrays: dict, n_valid: int, merge_kind: MergeKind,
-                drop_tombstones: bool,
-                pad_to: Optional[int] = None) -> Tuple[Optional[dict], int]:
-    """One launch over packed arrays; returns (output arrays trimmed to
-    count, count) or (None, 0) on kernel-flagged fallback. ``pad_to``
-    fixes the launch shape so the whole merge tree reuses ONE compiled
-    kernel instead of recompiling per group size."""
+def run_kernel_arrays(
+    batch_arrays: dict, n_valid: int, merge_kind: MergeKind,
+    drop_tombstones: bool, pad_to: Optional[int] = None,
+) -> Tuple[Optional[dict], int]:
+    """THE kernel invocation wrapper (shared by the chunked tree and the
+    backend's direct file sink): one launch over packed arrays; returns
+    (output arrays trimmed to count, count) or (None, 0) on kernel-flagged
+    fallback. ``pad_to`` fixes the launch shape so callers reuse one
+    compiled kernel."""
     import jax.numpy as jnp
 
     n_rows = batch_arrays["key_len"].shape[0]
@@ -76,6 +78,40 @@ def _batch_to_arrays(batch: KVBatch) -> Tuple[dict, int]:
     return {f: getattr(batch, f)[:n] for f in FIELDS}, n
 
 
+def _fold_groups(
+    parts: List[Tuple[dict, int]], merge_kind: MergeKind,
+    launch_entries: int,
+) -> Optional[List[Tuple[dict, int]]]:
+    """One greedy pass: group consecutive parts up to the launch size and
+    fold each group (tombstones kept — not the final pass)."""
+    next_level: List[Tuple[dict, int]] = []
+    group: List[dict] = []
+    group_n = 0
+
+    def flush() -> bool:
+        nonlocal group, group_n
+        if not group:
+            return True
+        merged, total = _concat(group)
+        out = run_kernel_arrays(merged, total, merge_kind, False,
+                                pad_to=launch_entries)
+        if out[0] is None:
+            return False
+        next_level.append(out)
+        group, group_n = [], 0
+        return True
+
+    for part, pn in parts:
+        if group and group_n + pn > launch_entries:
+            if not flush():
+                return None
+        group.append(part)
+        group_n += pn
+    if not flush():
+        return None
+    return next_level
+
+
 def chunked_merge(
     run_batches: List[KVBatch],
     merge_kind: MergeKind,
@@ -85,54 +121,32 @@ def chunked_merge(
 ) -> Optional[Tuple[dict, int]]:
     """Merge packed per-run batches hierarchically. Returns (final output
     arrays, count), or None when the kernel demands CPU fallback."""
-    # 1) fold each run's chunks to one summary per run
+    chunk_entries = min(chunk_entries, launch_entries)
+    # 1) per-run: multi-chunk runs reduce to one summary; single-chunk
+    #    runs pass through raw (already sorted per the run contract — a
+    #    dedup fold would be a wasted full-size launch)
     summaries: List[Tuple[dict, int]] = []
     for batch in run_batches:
         arrays, n = _batch_to_arrays(batch)
-        pieces = [
+        pieces: List[Tuple[dict, int]] = [
             ({f: arrays[f][i:i + chunk_entries] for f in FIELDS},
              min(chunk_entries, n - i))
             for i in range(0, n, chunk_entries)
         ] or [(arrays, 0)]
-        multi_chunk = len(pieces) > 1
         while len(pieces) > 1:
-            next_level: List[Tuple[dict, int]] = []
-            group: List[dict] = []
-            group_n = 0
-            for part, pn in pieces:
-                if group and group_n + pn > launch_entries:
-                    merged, _total = _concat(group)
-                    out = _run_kernel(merged, _total, merge_kind, False, pad_to=launch_entries)
-                    if out[0] is None:
-                        return None
-                    next_level.append(out)
-                    group, group_n = [], 0
-                group.append(part)
-                group_n += pn
-            if group:
-                merged, _total = _concat(group)
-                out = _run_kernel(merged, _total, merge_kind, False, pad_to=launch_entries)
-                if out[0] is None:
-                    return None
-                next_level.append(out)
-            pieces = next_level
-        part, pn = pieces[0]
-        if multi_chunk:
-            # the reduction loop's last output is already deduplicated
-            summaries.append((part, pn))
-        else:
-            # single raw chunk: fold once so the summary is deduplicated
-            out = _run_kernel(part, pn, merge_kind, False,
-                              pad_to=launch_entries)
-            if out[0] is None:
+            folded = _fold_groups(pieces, merge_kind, launch_entries)
+            if folded is None:
                 return None
-            summaries.append(out)
+            if len(folded) >= len(pieces):
+                return None  # cannot reduce further
+            pieces = folded
+        summaries.append(pieces[0])
 
-    # 2) merge run summaries hierarchically, final pass applies the real
-    #    tombstone policy. Grouping folds CONSECUTIVE summaries, which is
-    #    only associativity-safe for ADJACENT seq intervals — engine run
-    #    lists arrive level-ordered ([L0 old..new, L1, ...]), NOT seq-
-    #    ordered, so sort summaries by their max seq first (runs occupy
+    # 2) merge run summaries hierarchically; the final pass applies the
+    #    real tombstone policy. Grouping folds CONSECUTIVE summaries,
+    #    which is only associativity-safe for ADJACENT seq intervals —
+    #    engine run lists arrive level-ordered ([L0 old..new, L1, ...]),
+    #    NOT seq-ordered, so sort summaries by max seq first (runs occupy
     #    globally disjoint seq intervals in this engine).
     def _max_seq(part_n) -> int:
         part, n = part_n
@@ -147,26 +161,9 @@ def chunked_merge(
         total = sum(n for _p, n in summaries)
         if total <= launch_entries:
             merged, _n = _concat([p for p, _ in summaries])
-            return _run_kernel(merged, total, merge_kind, drop_tombstones, pad_to=launch_entries)
-        next_level = []
-        group, group_n = [], 0
-        for part, pn in summaries:
-            if group and group_n + pn > launch_entries:
-                merged, _t = _concat(group)
-                out = _run_kernel(merged, _t, merge_kind, False, pad_to=launch_entries)
-                if out[0] is None:
-                    return None
-                next_level.append(out)
-                group, group_n = [], 0
-            group.append(part)
-            group_n += pn
-        if group:
-            merged, _t = _concat(group)
-            out = _run_kernel(merged, _t, merge_kind, False, pad_to=launch_entries)
-            if out[0] is None:
-                return None
-            next_level.append(out)
-        if len(next_level) >= len(summaries):
-            # no reduction possible (too many distinct keys per summary)
-            return None
-        summaries = next_level
+            return run_kernel_arrays(merged, total, merge_kind,
+                                     drop_tombstones, pad_to=launch_entries)
+        folded = _fold_groups(summaries, merge_kind, launch_entries)
+        if folded is None or len(folded) >= len(summaries):
+            return None  # too many distinct keys to converge
+        summaries = folded
