@@ -1,0 +1,19 @@
+PY ?= python
+
+.PHONY: test native bench loadsst-bench clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+native:
+	$(MAKE) -C rocksplicator_tpu/storage/native
+
+bench:
+	$(PY) bench.py
+
+loadsst-bench:
+	$(PY) -m benchmarks.load_sst_bench --shards 16
+
+clean:
+	$(MAKE) -C rocksplicator_tpu/storage/native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +
