@@ -190,6 +190,7 @@ class AdminHandler:
         role: ReplicaRole,
         upstream: Optional[Tuple[str, int]],
         overwrite: bool = False,
+        replication_mode: Optional[int] = None,
     ) -> ApplicationDB:
         path = self._db_path(db_name)
         if overwrite:
@@ -200,6 +201,7 @@ class AdminHandler:
             db_name, db, role,
             replicator=self.replicator,
             upstream_addr=upstream,
+            replication_mode=replication_mode,
             leader_resolver=self._leader_resolver,
         )
         if not self.db_manager.add_db(db_name, app_db):
@@ -275,7 +277,8 @@ class AdminHandler:
             with self._db_admin_lock.locked(db_name):
                 if self.db_manager.get_db(db_name) is not None:
                     raise RpcApplicationError(DB_ALREADY_EXISTS, db_name)
-                self._open_app_db(db_name, parsed, upstream, overwrite)
+                self._open_app_db(db_name, parsed, upstream, overwrite,
+                                  replication_mode=replication_mode)
 
         await self._run(do)
         return {}
