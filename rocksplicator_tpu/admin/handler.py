@@ -90,6 +90,13 @@ class DBMetaData:
         return cls(**d)
 
 
+def _current_mode(app_db: ApplicationDB) -> Optional[int]:
+    """The db's live ack mode, for preserving across reopen/role change."""
+    if app_db.replicated_db is not None:
+        return app_db.replicated_db.replication_mode
+    return None
+
+
 def _parse_role(role: str) -> ReplicaRole:
     r = _ROLE_ALIASES.get(role.upper())
     if r is None:
@@ -301,16 +308,18 @@ class AdminHandler:
         def do():
             with self._db_admin_lock.locked(db_name):
                 app_db = self.db_manager.get_db(db_name)
-                role, upstream = ReplicaRole.NOOP, None
+                role, upstream, mode = ReplicaRole.NOOP, None, None
                 if app_db is not None:
                     role = app_db.role
+                    mode = _current_mode(app_db)
                     if app_db.replicated_db is not None:
                         upstream = app_db.replicated_db.upstream_addr
                     self.db_manager.remove_db(db_name)
                 destroy_db(self._db_path(db_name))
                 self.clear_meta_data(db_name)
                 if reopen_db:
-                    self._open_app_db(db_name, role, upstream)
+                    self._open_app_db(db_name, role, upstream,
+                                      replication_mode=mode)
 
         await self._run(do)
         return {}
@@ -334,8 +343,12 @@ class AdminHandler:
                 app_db = self.db_manager.get_db(db_name)
                 if app_db is None:
                     raise RpcApplicationError(DB_NOT_FOUND, db_name)
+                # the ack mode survives role changes (an explicit addDB mode
+                # must not silently revert to the dbconfig default)
+                mode = _current_mode(app_db)
                 self.db_manager.remove_db(db_name)  # closes storage + repl
-                self._open_app_db(db_name, parsed, upstream)
+                self._open_app_db(db_name, parsed, upstream,
+                                  replication_mode=mode)
 
         await self._run(do)
         return {}
@@ -481,13 +494,15 @@ class AdminHandler:
             if not allow_overlapping_keys and not ingest_behind:
                 # full replace: close → destroy → reopen → re-add (:1774-1817)
                 role = app_db.role
+                mode = _current_mode(app_db)
                 upstream = (
                     app_db.replicated_db.upstream_addr
                     if app_db.replicated_db else None
                 )
                 self.db_manager.remove_db(db_name)
                 destroy_db(self._db_path(db_name))
-                target_db = self._open_app_db(db_name, role, upstream)
+                target_db = self._open_app_db(db_name, role, upstream,
+                                              replication_mode=mode)
             with Timer("admin.sst_ingest_ms"):
                 target_db.db.ingest_external_file(
                     sst_files,

@@ -7,26 +7,22 @@ leader crash + catch-up, verifying zero lost acknowledged writes.
 """
 
 import os
-import struct
 import threading
 import time
 
 import pytest
 
 pytestmark = pytest.mark.skipif(
-    not os.environ.get("RSTPU_SLOW_TESTS"),
+    os.environ.get("RSTPU_SLOW_TESTS", "0") in ("0", "", "false"),
     reason="slow soak (RSTPU_SLOW_TESTS=1 to enable)",
 )
-
-pack64 = struct.Struct("<q").pack
-
 
 def test_mixed_workload_storm_with_failover(tmp_path):
     from tests.test_cluster import ServiceNode, wait_until
     from rocksplicator_tpu.cluster.controller import Controller
     from rocksplicator_tpu.cluster.coordinator import CoordinatorServer
     from rocksplicator_tpu.cluster.model import ResourceDef
-    from rocksplicator_tpu.storage import WriteBatch
+    from rocksplicator_tpu.storage import DBOptions, WriteBatch
     from rocksplicator_tpu.utils.segment_utils import segment_to_db_name
 
     from rocksplicator_tpu.utils.dbconfig import DBConfigManager
@@ -42,9 +38,7 @@ def test_mixed_workload_storm_with_failover(tmp_path):
     ]
     # storm posture: small memtables force continuous flush+compaction
     for node in nodes:
-        node.handler._options_gen = lambda seg: __import__(
-            "rocksplicator_tpu.storage", fromlist=["DBOptions"]
-        ).DBOptions(
+        node.handler._options_gen = lambda seg: DBOptions(
             memtable_bytes=64 * 1024, level0_compaction_trigger=3,
             background_compaction=True,
         )
@@ -61,9 +55,10 @@ def test_mixed_workload_storm_with_failover(tmp_path):
                     out[s] = n
         return out
 
+    stop = threading.Event()
+    threads = []
     try:
         assert wait_until(lambda: len(leaders()) == n_shards, timeout=60)
-        stop = threading.Event()
         written = [0]
         errors = [0]
         lock = threading.Lock()
@@ -91,8 +86,10 @@ def test_mixed_workload_storm_with_failover(tmp_path):
                         errors[0] += 1
                 i += 1
 
-        threads = [threading.Thread(target=writer, args=(t,))
-                   for t in range(4)]
+        threads.extend(
+            threading.Thread(target=writer, args=(t,), daemon=True)
+            for t in range(4)
+        )
         for t in threads:
             t.start()
         time.sleep(5)
@@ -140,6 +137,9 @@ def test_mixed_workload_storm_with_failover(tmp_path):
               f"total_seq={total_seq} "
               f"loss={(written[0] - total_seq) / max(1, written[0]):.2%}")
     finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
         for n in nodes:
             try:
                 n.stop()
@@ -147,3 +147,4 @@ def test_mixed_workload_storm_with_failover(tmp_path):
                 pass
         ctrl.stop()
         coord.stop()
+        DBConfigManager.reset_for_test()
