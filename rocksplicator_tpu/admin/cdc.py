@@ -10,7 +10,6 @@ removeObserver, checkObserver, getSequenceNumber.
 
 from __future__ import annotations
 
-import asyncio
 import collections
 import logging
 import threading

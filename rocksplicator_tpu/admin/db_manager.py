@@ -9,9 +9,9 @@ reference); DB-size stats text dump (application_db_manager.cpp:140-150).
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
-from ..utils.stats import Stats, tagged
+from ..utils.stats import Stats
 from .application_db import ApplicationDB
 
 

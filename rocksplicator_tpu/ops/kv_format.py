@@ -20,7 +20,6 @@ The same 24-byte-prefix convention is shared with the storage bloom filter
 
 from __future__ import annotations
 
-import struct
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 

@@ -11,7 +11,6 @@ Mesh axes:
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Optional, Tuple
 
 import numpy as np
