@@ -93,7 +93,7 @@ def bench_tpu(stacked):
 
     from rocksplicator_tpu.models import CompactionModel
 
-    model = CompactionModel(capacity=ENTRIES)
+    model = CompactionModel(capacity=ENTRIES, uniform_klen=True, seq32=True)
     fwd = jax.jit(jax.vmap(model.forward))
     log(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}")
     dev = {k: jnp.asarray(v) for k, v in stacked.items()}

@@ -30,6 +30,10 @@ class CompactionModel:
     bits_per_key: int = 10
     merge_kind: MergeKind = MergeKind.UINT64_ADD
     drop_tombstones: bool = True
+    # caller-verified fast-path promises (see ops/compaction_kernel):
+    # synthetic/counter workloads have one key width and 32-bit seqs
+    uniform_klen: bool = False
+    seq32: bool = False
 
     @property
     def num_bloom_words(self) -> int:
@@ -49,6 +53,7 @@ class CompactionModel:
             vtype, val_words, val_len, valid,
             merge_kind=self.merge_kind,
             drop_tombstones=self.drop_tombstones,
+            uniform_klen=self.uniform_klen, seq32=self.seq32,
         )
         out_valid = jax.lax.iota(jnp.int32, key_len.shape[0]) < out["count"]
         out["bloom"] = bloom_build_tpu(

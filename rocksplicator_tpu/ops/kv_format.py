@@ -155,3 +155,13 @@ def unpack_entries(
             )
         )
     return out
+
+
+def fast_flags(key_len: np.ndarray, seq_hi: np.ndarray,
+               valid: np.ndarray) -> Tuple[bool, bool]:
+    """(uniform_klen, seq32) host-side checks enabling the kernel's
+    reduced-operand sort (see ops/compaction_kernel._sort_batch)."""
+    kl = key_len[valid]
+    uniform = bool(len(kl) == 0 or (kl == kl[0]).all())
+    seq32 = bool((seq_hi[valid] == 0).all())
+    return uniform, seq32

@@ -22,7 +22,8 @@ import numpy as np
 from ..storage.compaction import CompactionBackend, CpuCompactionBackend, Entry
 from ..storage.merge import MergeOperator, UInt64AddOperator
 from ..ops.compaction_kernel import MergeKind, merge_resolve_kernel
-from ..ops.kv_format import KVBatch, UnsupportedBatch, pack_entries, unpack_entries
+from ..ops.kv_format import (KVBatch, UnsupportedBatch, fast_flags,
+                             pack_entries, unpack_entries)
 
 log = logging.getLogger(__name__)
 
@@ -188,9 +189,12 @@ class TpuCompactionBackend(CompactionBackend):
             MergeKind.UINT64_ADD if isinstance(merge_op, UInt64AddOperator)
             else MergeKind.NONE
         )
+        uniform_klen, seq32 = fast_flags(batch.key_len, batch.seq_hi,
+                                         batch.valid)
         arrays, count = run_kernel_arrays(
             _batch_fields(batch), n, kind, drop_tombstones,
             pad_to=batch.capacity,
+            uniform_klen=uniform_klen, seq32=seq32,
         )
         if arrays is None:
             return None
@@ -243,6 +247,8 @@ class TpuCompactionBackend(CompactionBackend):
             MergeKind.UINT64_ADD if isinstance(merge_op, UInt64AddOperator)
             else MergeKind.NONE
         )
+        uniform_klen, seq32 = fast_flags(batch.key_len, batch.seq_hi,
+                                         batch.valid)
         out = merge_resolve_kernel(
             jnp.asarray(batch.key_words_be), jnp.asarray(batch.key_words_le),
             jnp.asarray(batch.key_len), jnp.asarray(batch.seq_hi),
@@ -250,6 +256,7 @@ class TpuCompactionBackend(CompactionBackend):
             jnp.asarray(batch.val_words), jnp.asarray(batch.val_len),
             jnp.asarray(batch.valid),
             merge_kind=kind, drop_tombstones=drop_tombstones,
+            uniform_klen=uniform_klen, seq32=seq32,
         )
         if bool(out["needs_cpu_fallback"]):
             return None

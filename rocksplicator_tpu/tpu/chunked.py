@@ -38,6 +38,7 @@ FIELDS = (
 def run_kernel_arrays(
     batch_arrays: dict, n_valid: int, merge_kind: MergeKind,
     drop_tombstones: bool, pad_to: Optional[int] = None,
+    uniform_klen: bool = False, seq32: bool = False,
 ) -> Tuple[Optional[dict], int]:
     """THE kernel invocation wrapper (shared by the chunked tree and the
     backend's direct file sink): one launch over packed arrays; returns
@@ -61,6 +62,7 @@ def run_kernel_arrays(
         *(jnp.asarray(batch_arrays[f]) for f in FIELDS),
         jnp.asarray(valid),
         merge_kind=merge_kind, drop_tombstones=drop_tombstones,
+        uniform_klen=uniform_klen, seq32=seq32,
     )
     if bool(out["needs_cpu_fallback"]):
         return None, 0

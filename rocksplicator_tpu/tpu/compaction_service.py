@@ -27,7 +27,7 @@ from ..storage.bloom import num_words_for
 from ..storage.engine import DBOptions
 from ..ops.bloom_tpu import bloom_build_tpu
 from ..ops.compaction_kernel import MergeKind, merge_resolve_kernel
-from ..ops.kv_format import KVBatch, unpack_entries
+from ..ops.kv_format import KVBatch, fast_flags, unpack_entries
 from .backend import TpuCompactionBackend, _next_pow2
 
 log = logging.getLogger(__name__)
@@ -69,8 +69,9 @@ class TpuCompactionService:
     # ------------------------------------------------------------------
 
     def _pipeline(self, merge_kind: MergeKind, drop_tombstones: bool,
-                  num_words: int):
-        key = (merge_kind, drop_tombstones, num_words)
+                  num_words: int, uniform_klen: bool = False,
+                  seq32: bool = False):
+        key = (merge_kind, drop_tombstones, num_words, uniform_klen, seq32)
         fn = self._vmapped_cache.get(key)
         if fn is None:
             jax = self._jax
@@ -79,6 +80,7 @@ class TpuCompactionService:
                 out = merge_resolve_kernel(
                     kwbe, kwle, klen, shi, slo, vt, vw, vl, valid,
                     merge_kind=merge_kind, drop_tombstones=drop_tombstones,
+                    uniform_klen=uniform_klen, seq32=seq32,
                 )
                 out_valid = (
                     jax.lax.iota(jax.numpy.int32, klen.shape[0]) < out["count"]
@@ -117,7 +119,11 @@ class TpuCompactionService:
                 "seq_lo", "vtype", "val_words", "val_len", "valid",
             )
         }
-        fn = self._pipeline(merge_kind, drop_tombstones, num_words)
+        flags = [fast_flags(b.key_len, b.seq_hi, b.valid) for b in batches]
+        uniform_klen = all(u for u, _ in flags)
+        seq32 = all(s for _, s in flags)
+        fn = self._pipeline(merge_kind, drop_tombstones, num_words,
+                            uniform_klen, seq32)
         out = fn(
             stacked["key_words_be"], stacked["key_words_le"],
             stacked["key_len"], stacked["seq_hi"], stacked["seq_lo"],

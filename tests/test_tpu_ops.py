@@ -240,3 +240,59 @@ def test_service_cpu_recompute_on_oversize_group():
     assert results[0]["count"] == 1
     k, s, vt, v = results[0]["entries"][0]
     assert k == b"hot" and v == pack64(n)  # exact despite 2^17 operands
+
+
+def test_fast_flags_variants_match_baseline():
+    """uniform_klen/seq32 fast paths must be result-identical."""
+    import jax.numpy as jnp
+
+    from rocksplicator_tpu.ops.kv_format import fast_flags
+
+    entries = [
+        (b"k0000001", 5, OpType.MERGE, pack64(3)),
+        (b"k0000001", 2, OpType.PUT, pack64(10)),
+        (b"k0000002", 4, OpType.DELETE, b""),
+        (b"k0000003", 1, OpType.PUT, pack64(7)),
+    ]
+    batch = pack_entries(entries, capacity=16)
+    uk, s32 = fast_flags(batch.key_len, batch.seq_hi, batch.valid)
+    assert uk is True   # all keys are 8 bytes
+    assert s32 is True  # seqs < 2^32
+
+    def run(uniform_klen, seq32):
+        out = merge_resolve_kernel(
+            jnp.asarray(batch.key_words_be), jnp.asarray(batch.key_words_le),
+            jnp.asarray(batch.key_len), jnp.asarray(batch.seq_hi),
+            jnp.asarray(batch.seq_lo), jnp.asarray(batch.vtype),
+            jnp.asarray(batch.val_words), jnp.asarray(batch.val_len),
+            jnp.asarray(batch.valid),
+            merge_kind=MergeKind.UINT64_ADD, drop_tombstones=True,
+            uniform_klen=uniform_klen, seq32=seq32,
+        )
+        return unpack_entries(
+            np.asarray(out["key_words_be"]), np.asarray(out["key_len"]),
+            np.asarray(out["seq_hi"]), np.asarray(out["seq_lo"]),
+            np.asarray(out["vtype"]), np.asarray(out["val_words"]),
+            np.asarray(out["val_len"]), int(out["count"]),
+        )
+
+    base = run(False, False)
+    assert run(True, True) == base
+    assert run(True, False) == base
+    assert run(False, True) == base
+    assert [k for k, *_ in base] == [b"k0000001", b"k0000003"]
+
+
+def test_fast_flags_negative_cases():
+    from rocksplicator_tpu.ops.kv_format import fast_flags
+
+    mixed = pack_entries([
+        (b"ab", 1, OpType.PUT, b"v"),
+        (b"ab\x00", 2, OpType.PUT, b"w"),  # same padded words, diff length!
+    ])
+    uk, s32 = fast_flags(mixed.key_len, mixed.seq_hi, mixed.valid)
+    assert uk is False  # promising uniform here would merge distinct keys
+    big_seq = pack_entries([(b"k", (1 << 40), OpType.PUT, b"v")])
+    uk2, s32_2 = fast_flags(big_seq.key_len, big_seq.seq_hi, big_seq.valid)
+    assert s32_2 is False
+    assert uk2 is True
