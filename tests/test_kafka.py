@@ -348,12 +348,14 @@ def test_rpcgrep_decodes_proxied_traffic(tmp_path, capsys):
         loop = asyncio.new_event_loop()
         stop_loop["loop"] = loop
         asyncio.set_event_loop(loop)
+        task = loop.create_task(rpcgrep.serve(
+            proxy_port, "127.0.0.1", node.admin_port,
+            re.compile("ping"), False,
+        ))
+        stop_loop["task"] = task
         try:
-            loop.run_until_complete(rpcgrep.serve(
-                proxy_port, "127.0.0.1", node.admin_port,
-                re.compile("ping"), False,
-            ))
-        except Exception:
+            loop.run_until_complete(task)
+        except (Exception, asyncio.CancelledError):
             pass
 
     t = threading.Thread(target=run_proxy, daemon=True)
@@ -373,5 +375,8 @@ def test_rpcgrep_decodes_proxied_traffic(tmp_path, capsys):
         assert "reply id=" in out
     finally:
         ioloop.run_sync(pool.close())
-        stop_loop["loop"].call_soon_threadsafe(stop_loop["loop"].stop)
+        # cancel the serve task (not loop.stop) so the coroutine finishes
+        # cleanly instead of leaking a never-awaited warning
+        stop_loop["loop"].call_soon_threadsafe(stop_loop["task"].cancel)
+        t.join(timeout=5)
         node.stop()
