@@ -44,6 +44,7 @@ class Participant:
         transition_workers: int = 4,
         catch_up_timeout: float = 30.0,
         error_retry_backoff: float = 1.0,
+        view_cluster: Optional[str] = None,
     ):
         self.error_retry_backoff = error_retry_backoff
         self.cluster = cluster
@@ -54,6 +55,7 @@ class Participant:
             self.coord, self.admin, cluster, instance,
             backup_store_uri=backup_store_uri,
             catch_up_timeout=catch_up_timeout,
+            view_cluster=view_cluster,
         )
         factory_cls = FACTORIES[state_model]
         self.factory = factory_cls(self.ctx)

@@ -87,16 +87,24 @@ class ClusterContext:
 
     def __init__(self, coord, admin, cluster: str, instance,
                  backup_store_uri: Optional[str] = None,
-                 catch_up_timeout: float = 60.0):
+                 catch_up_timeout: float = 60.0,
+                 view_cluster: Optional[str] = None):
         from ..model import cluster_path
 
         self.coord = coord            # CoordinatorClient
         self.admin = admin            # AdminClient
         self.cluster = cluster
+        # The cluster whose topology (instances / current states) the
+        # state models observe. Differs from ``cluster`` for CDC
+        # participants, which join their own cluster but watch the DATA
+        # cluster's leaders (reference: CdcUtils reads the data cluster's
+        # external view).
+        self.view_cluster = view_cluster or cluster
         self.instance = instance      # InstanceInfo (me)
         self.backup_store_uri = backup_store_uri
         self.catch_up_timeout = catch_up_timeout
         self._path = lambda *p: cluster_path(cluster, *p)
+        self._view_path = lambda *p: cluster_path(self.view_cluster, *p)
 
     # -- identity ----------------------------------------------------------
 
@@ -114,8 +122,8 @@ class ClusterContext:
         from ..model import InstanceInfo
 
         out = {}
-        for iid in self.coord.list(self._path("instances")):
-            raw = self.coord.get_or_none(self._path("instances", iid))
+        for iid in self.coord.list(self._view_path("instances")):
+            raw = self.coord.get_or_none(self._view_path("instances", iid))
             if raw:
                 out[iid] = InstanceInfo.decode(raw)
         return out
@@ -125,9 +133,9 @@ class ClusterContext:
         from ..model import decode_states
 
         out = {}
-        for iid in self.coord.list(self._path("currentstates")):
+        for iid in self.coord.list(self._view_path("currentstates")):
             states = decode_states(
-                self.coord.get_or_none(self._path("currentstates", iid))
+                self.coord.get_or_none(self._view_path("currentstates", iid))
             )
             if partition in states:
                 out[iid] = states[partition]
@@ -136,7 +144,7 @@ class ClusterContext:
     def instance_info(self, instance_id: str):
         from ..model import InstanceInfo
 
-        raw = self.coord.get_or_none(self._path("instances", instance_id))
+        raw = self.coord.get_or_none(self._view_path("instances", instance_id))
         return InstanceInfo.decode(raw) if raw else None
 
     # -- per-partition lock (reference: zk InterProcessMutex) -------------

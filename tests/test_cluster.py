@@ -165,7 +165,7 @@ class ServiceNode:
     """Data plane (admin+replication) + participant for one 'host'."""
 
     def __init__(self, tmp_path, name, coord_port, cluster,
-                 backup_store_uri=None):
+                 backup_store_uri=None, **participant_kw):
         self.name = name
         self.replicator = Replicator(port=0, flags=FAST)
         self.handler = AdminHandler(str(tmp_path / name), self.replicator)
@@ -182,6 +182,7 @@ class ServiceNode:
         self.participant = Participant(
             "127.0.0.1", coord_port, cluster, self.instance,
             backup_store_uri=backup_store_uri, catch_up_timeout=10.0,
+            **participant_kw,
         )
 
     def stop(self, graceful=True):

@@ -1,0 +1,178 @@
+"""State-model coverage beyond LeaderFollower/MasterSlave: OnlineOffline,
+Cache, Bootstrap (message-ingestion), CdcLeaderStandby (observers) —
+driven through real participants + controller (reference: the per-factory
+Java tests)."""
+
+import time
+
+import pytest
+
+from rocksplicator_tpu.admin.cdc import CdcAdminHandler, MemoryPublisher
+from rocksplicator_tpu.cluster.controller import Controller
+from rocksplicator_tpu.cluster.coordinator import (
+    CoordinatorClient,
+    CoordinatorServer,
+)
+from rocksplicator_tpu.cluster.model import ResourceDef, cluster_path
+from rocksplicator_tpu.kafka.broker import get_cluster, reset_clusters_for_test
+from rocksplicator_tpu.storage import WriteBatch
+from tests.test_cluster import ServiceNode, wait_until
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    reset_clusters_for_test()
+    coord = CoordinatorServer(port=0, session_ttl=1.5)
+    created = {"nodes": [], "ctrls": []}
+
+    def node(name, **kw):
+        n = ServiceNode(tmp_path, name, coord.port, "c1", **kw)
+        created["nodes"].append(n)
+        return n
+
+    def controller():
+        c = Controller("127.0.0.1", coord.port, "c1", "ctrl",
+                       reconcile_interval=0.3)
+        created["ctrls"].append(c)
+        return c
+
+    yield coord, node, controller
+    for c in created["ctrls"]:
+        c.stop()
+    for n in created["nodes"]:
+        try:
+            n.stop()
+        except Exception:
+            pass
+    coord.stop()
+    reset_clusters_for_test()
+
+
+def test_online_offline_state_model(tmp_path, plane):
+    coord, make_node, make_controller = plane
+    a = make_node("a", state_model="OnlineOffline")
+    ctrl = make_controller()
+    ctrl.add_resource(ResourceDef("ro", num_shards=2, replicas=1,
+                                  state_model="OnlineOffline"))
+    assert wait_until(lambda: all(
+        a.participant.current_states.get(f"ro_{s}") == "ONLINE"
+        for s in range(2)
+    ), timeout=30)
+    # the dbs are open standalone (NOOP role)
+    db = a.handler.db_manager.get_db("ro00000")
+    assert db is not None
+    db.write(WriteBatch().put(b"k", b"v"))
+    assert db.get(b"k") == b"v"
+    # dropping the resource takes partitions offline and away
+    ctrl.remove_resource("ro")
+    assert wait_until(
+        lambda: not a.participant.current_states, timeout=30
+    )
+    assert a.handler.db_manager.get_db("ro00000") is None
+
+
+def test_cache_state_model(tmp_path, plane):
+    coord, make_node, make_controller = plane
+    a = make_node("a", state_model="Cache")
+    ctrl = make_controller()
+    ctrl.add_resource(ResourceDef("cache", num_shards=1, replicas=1,
+                                  state_model="Cache"))
+    assert wait_until(
+        lambda: a.participant.current_states.get("cache_0") == "ONLINE",
+        timeout=30,
+    )
+    # cache nodes host no storage — membership only
+    assert a.handler.db_manager.get_db("cache00000") is None
+
+
+def test_bootstrap_state_model_ingests(tmp_path, plane):
+    coord, make_node, make_controller = plane
+    cluster = get_cluster("default")
+    cluster.create_topic("boot-topic", 2)
+    cluster.produce("boot-topic", 0, b"k1", b"v1", timestamp_ms=100)
+    cluster.produce("boot-topic", 1, b"k2", b"v2", timestamp_ms=100)
+    a = make_node("a", state_model="Bootstrap")
+    # resource config carries the topic (reference: ZK resource_configs)
+    client = CoordinatorClient("127.0.0.1", coord.port)
+    client.put(
+        cluster_path("c1", "config", "boot"),
+        b'{"kafka_topic": "boot-topic", '
+        b'"kafka_broker_serverset_path": "embedded://default"}',
+    )
+    ctrl = make_controller()
+    ctrl.add_resource(ResourceDef("boot", num_shards=2, replicas=1,
+                                  state_model="Bootstrap"))
+    assert wait_until(lambda: all(
+        a.participant.current_states.get(f"boot_{s}") == "ONLINE"
+        for s in range(2)
+    ), timeout=30)
+    db0 = a.handler.db_manager.get_db("boot00000")
+    db1 = a.handler.db_manager.get_db("boot00001")
+    assert wait_until(lambda: db0.get(b"k1") == b"v1", timeout=15)
+    assert wait_until(lambda: db1.get(b"k2") == b"v2", timeout=15)
+    # live tail keeps flowing per shard partition
+    cluster.produce("boot-topic", 0, b"k3", b"v3")
+    assert wait_until(lambda: db0.get(b"k3") == b"v3", timeout=15)
+    client.close()
+
+
+def test_cdc_leader_standby_state_model(tmp_path, plane):
+    """Reference pattern: CDC participants join their OWN cluster but
+    observe the DATA cluster's leaders (CdcUtils); the CDC cluster's
+    controller runs the CdcLeaderStandby machine."""
+    from rocksplicator_tpu.admin import AdminHandler
+    from rocksplicator_tpu.cluster.model import InstanceInfo
+    from rocksplicator_tpu.cluster.participant import Participant
+    from rocksplicator_tpu.replication import Replicator
+    from rocksplicator_tpu.rpc import RpcServer
+    from tests.test_cluster import FAST
+
+    coord, make_node, make_controller = plane
+    # data cluster "c1": one node, one leader partition
+    data = make_node("data")
+    ctrl = make_controller()
+    ctrl.add_resource(ResourceDef("seg", num_shards=1, replicas=1))
+    assert wait_until(
+        lambda: data.participant.current_states.get("seg_0") == "LEADER",
+        timeout=30,
+    )
+    # CDC cluster "cdc-c": node hosts CdcAdmin; participant views "c1"
+    replicator = Replicator(port=0, flags=FAST)
+    handler = AdminHandler(str(tmp_path / "cdcnode"), replicator)
+    server = RpcServer(port=0, ioloop=replicator.ioloop)
+    server.add_handler(handler)
+    publisher = MemoryPublisher()
+    cdc_handler = CdcAdminHandler(replicator, publisher)
+    server.add_handler(cdc_handler)
+    server.start()
+    participant = Participant(
+        "127.0.0.1", coord.port, "cdc-c",
+        InstanceInfo(f"127.0.0.1_{server.port}", "127.0.0.1",
+                     server.port, replicator.port, "az-cdc"),
+        state_model="CdcLeaderStandby", view_cluster="c1",
+        catch_up_timeout=10.0,
+    )
+    cdc_ctrl = Controller("127.0.0.1", coord.port, "cdc-c", "cdc-ctrl",
+                          reconcile_interval=0.3)
+    try:
+        cdc_ctrl.add_resource(ResourceDef(
+            "seg", num_shards=1, replicas=1,
+            state_model="CdcLeaderStandby",
+        ))
+        assert wait_until(
+            lambda: participant.current_states.get("seg_0") == "LEADER",
+            timeout=30,
+        )
+        # observer is live: data-plane writes publish to the CDC publisher
+        app = data.handler.db_manager.get_db("seg00000")
+        app.write(WriteBatch().put(b"cdc-k", b"cdc-v"))
+        assert wait_until(lambda: len(publisher.buffer) >= 1, timeout=20)
+        db_name, start_seq, raw, ts = publisher.buffer[0]
+        assert db_name == "seg00000"
+    finally:
+        cdc_ctrl.stop()
+        participant.stop()
+        cdc_handler.close()
+        server.stop()
+        handler.close()
+        replicator.stop()
