@@ -505,7 +505,7 @@ class DB:
                     all(not files for files in self._levels[2:])
                     and not self.options.allow_ingest_behind
                 )
-                runs = [self._readers[n].iterate() for n in inputs]
+                runs = [self._readers[n] for n in inputs]
             out_names = self._write_merged(runs, drop_tombstones=drop)
             with self._lock:
                 if self._closed:
@@ -592,7 +592,7 @@ class DB:
                 inputs: List[str] = [n for files in self._levels for n in files]
                 if not inputs:
                     return
-                runs = [self._readers[n].iterate() for n in inputs]
+                runs = [self._readers[n] for n in inputs]
             # Tombstones must survive when data can later be ingested BEHIND
             # this level — dropping them would resurrect deleted keys.
             out_names = self._write_merged(
@@ -616,7 +616,7 @@ class DB:
         inputs = list(self._levels[0]) + list(self._levels[1])
         if not inputs:
             return
-        runs = [self._readers[n].iterate() for n in inputs]
+        runs = [self._readers[n] for n in inputs]
         drop = (
             all(not files for files in self._levels[2:])
             and not self.options.allow_ingest_behind
@@ -633,7 +633,11 @@ class DB:
         # per-entry tuple path entirely, splitting at target_file_bytes.
         direct = getattr(self._backend, "merge_runs_to_files", None)
         if direct is not None:
-            runs = [list(r) for r in runs]  # reusable on fallback
+            # readers are re-iterable; materialize only raw iterables so a
+            # failed direct attempt can still fall back to the tuple path
+            runs = [
+                r if hasattr(r, "iterate") else list(r) for r in runs
+            ]
             allocated: List[str] = []
 
             def path_factory() -> str:
@@ -658,8 +662,9 @@ class DB:
                     self._readers[name] = SSTReader(path)
                     names.append(name)
                 return names
+        streams = [r.iterate() if hasattr(r, "iterate") else r for r in runs]
         stream = self._backend.merge_runs(
-            runs, self.options.merge_operator, drop_tombstones
+            streams, self.options.merge_operator, drop_tombstones
         )
         out_names: List[str] = []
         writer: Optional[SSTWriter] = None

@@ -42,10 +42,13 @@ def _next_pow2(n: int) -> int:
     return p
 
 
-def _batch_fields(batch: KVBatch) -> dict:
-    from .chunked import FIELDS
+def _arrays_from_entries(entries: List[Entry]) -> Optional[dict]:
+    """Entry tuples → valid-prefix lane arrays (tuple-source fallback)."""
+    if not entries:
+        return None
+    from .chunked import _batch_to_arrays
 
-    return {f: getattr(batch, f) for f in FIELDS}
+    return _batch_to_arrays(pack_entries(entries))[0]
 
 
 class TpuCompactionBackend(CompactionBackend):
@@ -136,7 +139,7 @@ class TpuCompactionBackend(CompactionBackend):
 
     def merge_runs_to_files(
         self,
-        runs: List[Iterable[Entry]],
+        runs: List,
         merge_op: Optional[MergeOperator],
         drop_tombstones: bool,
         path_factory,
@@ -146,39 +149,54 @@ class TpuCompactionBackend(CompactionBackend):
         target_file_bytes: int,
     ) -> Optional[List[Tuple[str, dict]]]:
         """Merge + write output SSTs with the vectorized array sink and
-        kernel-built blooms (no per-entry Python on the output side),
-        splitting at ``target_file_bytes``. Returns [(path, props)] — empty
-        list for an all-tombstoned result — or None → tuple path."""
+        kernel-built blooms, splitting at ``target_file_bytes``. Inputs may
+        be SSTReader objects — sink-written uniform files decode straight
+        to lanes (no per-entry Python on the SOURCE side either) — or
+        entry iterables. Returns [(path, props)] — empty list for an
+        all-tombstoned result — or None → tuple path."""
         from ..ops.bloom_tpu import bloom_build_tpu
         from ..storage.bloom import num_words_for
-        from .chunked import run_kernel_arrays
-        from .format import uniform_widths, write_sst_from_arrays
+        from .chunked import FIELDS, run_kernel_arrays
+        from .format import read_sst_arrays, uniform_widths, write_sst_from_arrays
 
         if merge_op is not None and not isinstance(merge_op, UInt64AddOperator):
             return None
-        run_lists = [list(run) for run in runs]
-        total = sum(len(r) for r in run_lists)
-        if total == 0 or total > MAX_TPU_ENTRIES:
-            return None  # chunked/CPU paths return entries, not files (yet)
+        parts: List[dict] = []
         try:
-            batch = pack_entries(
-                [e for r in run_lists for e in r],
-                capacity=_next_pow2(total),
-            )
+            for run in runs:
+                if hasattr(run, "iterate"):  # an SSTReader
+                    arr = read_sst_arrays(run)
+                    if arr is None:
+                        arr = _arrays_from_entries(list(run.iterate()))
+                else:
+                    arr = _arrays_from_entries(list(run))
+                if arr is not None:
+                    parts.append(arr)
         except UnsupportedBatch:
             return None
-        if merge_op is None and bool((batch.vtype == _MERGE).any()):
+        total = sum(p["key_len"].shape[0] for p in parts)
+        if total == 0 or total > MAX_TPU_ENTRIES:
+            return None  # chunked/CPU paths return entries, not files (yet)
+        # normalize value-lane widths (sources may carry different paddings)
+        vw = max(p["val_words"].shape[1] for p in parts)
+        for p in parts:
+            w = p["val_words"].shape[1]
+            if w < vw:
+                p["val_words"] = np.pad(p["val_words"], [(0, 0), (0, vw - w)])
+        lanes = {
+            f: np.concatenate([p[f] for p in parts]) for f in FIELDS
+        }
+        if merge_op is None and bool((lanes["vtype"] == _MERGE).any()):
             return None
         # Cheap pre-check BEFORE the kernel: the sink needs uniform output
         # widths. Keys must be uniform; values must be uniform among the
         # entries that can survive (deletes contribute no value at the
         # bottom; kept tombstones mid-level make widths mixed).
-        n = batch.num_valid()
-        kl = batch.key_len[:n]
-        if n and not (kl == kl[0]).all():
+        kl = lanes["key_len"]
+        if total and not (kl == kl[0]).all():
             return None
-        is_del = batch.vtype[:n] == _DELETE
-        vlens = batch.val_len[:n]
+        is_del = lanes["vtype"] == _DELETE
+        vlens = lanes["val_len"]
         non_del_vlens = vlens[~is_del]
         if len(non_del_vlens) and not (non_del_vlens == non_del_vlens[0]).all():
             return None
@@ -189,11 +207,11 @@ class TpuCompactionBackend(CompactionBackend):
             MergeKind.UINT64_ADD if isinstance(merge_op, UInt64AddOperator)
             else MergeKind.NONE
         )
-        uniform_klen, seq32 = fast_flags(batch.key_len, batch.seq_hi,
-                                         batch.valid)
+        all_valid = np.ones(total, dtype=bool)
+        uniform_klen, seq32 = fast_flags(kl, lanes["seq_hi"], all_valid)
         arrays, count = run_kernel_arrays(
-            _batch_fields(batch), n, kind, drop_tombstones,
-            pad_to=batch.capacity,
+            lanes, total, kind, drop_tombstones,
+            pad_to=_next_pow2(total),
             uniform_klen=uniform_klen, seq32=seq32,
         )
         if arrays is None:

@@ -68,6 +68,61 @@ def encode_uniform_block(arrays: Dict[str, np.ndarray], start: int, end: int,
     return out.tobytes()
 
 
+def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
+    """Vectorized SOURCE: decode a sink-written uniform-stride TSST file
+    straight into kernel lanes (no per-entry Python). Returns the arrays
+    dict (+ implicit count = rows) or None when the file lacks the uniform
+    property (flush-written / foreign files use the tuple path)."""
+    widths = reader.props.get("uniform")
+    if not widths:
+        return None
+    klen, vlen = int(widths[0]), int(widths[1])
+    if not (0 < klen <= 24) or vlen < 0:
+        return None  # foreign/crafted prop — tuple path validates
+    stride = _ENTRY_FIXED_OVERHEAD + klen + vlen
+    blocks = [reader._read_block(i) for i in range(len(reader._index))]
+    raw = b"".join(blocks)
+    if len(raw) % stride:
+        return None  # inconsistent — let the tuple path validate/complain
+    n = len(raw) // stride
+    mat = np.frombuffer(raw, dtype=np.uint8).reshape(n, stride)
+    pos = 0
+    klens = mat[:, pos:pos + 4].copy().view("<u4").reshape(n)
+    pos += 4
+    key_bytes = mat[:, pos:pos + klen]
+    pos += klen
+    seqs = mat[:, pos:pos + 8].copy().view("<u8").reshape(n)
+    pos += 8
+    vtypes = mat[:, pos].astype(np.uint32)
+    pos += 1
+    vlens = mat[:, pos:pos + 4].copy().view("<u4").reshape(n)
+    pos += 4
+    val_bytes = mat[:, pos:pos + vlen]
+    if not (klens == klen).all():
+        return None
+    key_buf = np.zeros((n, 24), dtype=np.uint8)
+    key_buf[:, :klen] = key_bytes
+    # at least the default width so arrays from different runs concatenate
+    vw = max(2, (vlen + 3) // 4)
+    val_buf = np.zeros((n, vw * 4), dtype=np.uint8)
+    if vlen:
+        val_buf[:, :vlen] = val_bytes
+    # ingestion-time global seqno overrides per-entry seqs, same as the
+    # reader's _effective_seq
+    if reader.global_seqno is not None:
+        seqs = np.full(n, reader.global_seqno, dtype=np.uint64)
+    return {
+        "key_words_be": key_buf.view(">u4").astype(np.uint32).reshape(n, 6),
+        "key_words_le": key_buf.view("<u4").reshape(n, 6).copy(),
+        "key_len": klens.astype(np.uint32),
+        "seq_hi": (seqs >> np.uint64(32)).astype(np.uint32),
+        "seq_lo": (seqs & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+        "vtype": vtypes,
+        "val_words": val_buf.view("<u4").reshape(n, vw).copy(),
+        "val_len": vlens.astype(np.uint32),
+    }
+
+
 def write_sst_from_arrays(
     arrays: Dict[str, np.ndarray],
     count: int,
@@ -122,9 +177,13 @@ def write_sst_from_arrays(
             bloom = BloomFilter.build(
                 [key_bytes[i].tobytes() for i in range(count)], bits_per_key
             )
-        # kernel output has one entry per key
-        return writer.finish(precomputed_bloom=bloom,
-                             extra_props={"num_keys": int(count)})
+        # kernel output has one entry per key; the uniform prop lets the
+        # vectorized SOURCE reader decode this file array-to-array
+        return writer.finish(
+            precomputed_bloom=bloom,
+            extra_props={"num_keys": int(count),
+                         "uniform": [int(klen), int(vlen)]},
+        )
     except BaseException:
         writer.abandon()
         raise
