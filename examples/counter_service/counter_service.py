@@ -39,6 +39,18 @@ class CounterHandler(AdminHandler):
     def __init__(self, *args, router: Optional[RpcRouter] = None, **kw):
         super().__init__(*args, **kw)
         self.router = CounterRouter(router) if router else None
+        # hot-key detection on the access path (reference HotKeyDetector
+        # integration: find runaway counters before they melt a shard)
+        from rocksplicator_tpu.utils.hot_key_detector import HotKeyDetector
+
+        self.hot_keys = HotKeyDetector(num_buckets=100)
+
+    def hot_keys_text(self) -> str:
+        """/hotkeys.txt status-server endpoint body."""
+        lines = [
+            f"{name} rate={rate:.1f}" for name, rate in self.hot_keys.top(20)
+        ]
+        return "\n".join(lines) + "\n"
 
     # -- helpers -----------------------------------------------------------
 
@@ -63,6 +75,7 @@ class CounterHandler(AdminHandler):
     async def handle_get_counter(
         self, counter_name: str = "", need_routing: bool = False
     ) -> dict:
+        self.hot_keys.record(counter_name)
         db_name, app_db = self._local_db_for(counter_name)
         if app_db is None:
             if need_routing:
@@ -94,6 +107,7 @@ class CounterHandler(AdminHandler):
     async def handle_bump_counter(
         self, counter_name: str = "", delta: int = 1, need_routing: bool = False
     ) -> dict:
+        self.hot_keys.record(counter_name)
         db_name, app_db = self._local_db_for(counter_name)
         if app_db is None or (
             app_db.role is not ReplicaRole.LEADER
@@ -171,7 +185,10 @@ def main(argv=None) -> int:
     server.start()
     status = StatusServer.start_status_server(
         args.status_port,
-        extra_endpoints={"/storage_info.txt": handler.storage_info_text},
+        extra_endpoints={
+            "/storage_info.txt": handler.storage_info_text,
+            "/hotkeys.txt": handler.hot_keys_text,
+        },
     )
     shutdown = GracefulShutdownHandler()
     shutdown.add_server(server)

@@ -206,3 +206,17 @@ def test_stress_tool_runs(cluster):
         "--threads", "2", "--requests", "50", "--counters", "10",
     ])
     assert rc == 0
+
+
+def test_hot_key_detection_on_access_path(cluster, call):
+    a, b = cluster
+    leader, _ = _owner(a, b, "viral", call)
+    for i in range(200):
+        call(leader.port, "bump_counter", counter_name="viral", delta=1)
+        call(leader.port, "get_counter", counter_name=f"cold{i}",
+             need_routing=True)
+    top = leader.handler.hot_keys.top(3)
+    assert top and top[0][0] == "viral"
+    assert leader.handler.hot_keys.is_above("viral", 0.3)
+    text = leader.handler.hot_keys_text()
+    assert "viral" in text.splitlines()[0]
