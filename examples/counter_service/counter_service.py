@@ -21,6 +21,7 @@ from rocksplicator_tpu.rpc import RpcApplicationError, RpcServer
 from rocksplicator_tpu.rpc.router import Quantity, Role, RpcRouter
 from rocksplicator_tpu.storage.records import WriteBatch
 from rocksplicator_tpu.utils.graceful_shutdown import GracefulShutdownHandler
+from rocksplicator_tpu.utils.hot_key_detector import HotKeyDetector
 from rocksplicator_tpu.utils.misc import availability_zone, local_ip
 from rocksplicator_tpu.utils.segment_utils import segment_to_db_name
 from rocksplicator_tpu.utils.stats import Stats
@@ -41,14 +42,15 @@ class CounterHandler(AdminHandler):
         self.router = CounterRouter(router) if router else None
         # hot-key detection on the access path (reference HotKeyDetector
         # integration: find runaway counters before they melt a shard)
-        from rocksplicator_tpu.utils.hot_key_detector import HotKeyDetector
-
         self.hot_keys = HotKeyDetector(num_buckets=100)
 
     def hot_keys_text(self) -> str:
-        """/hotkeys.txt status-server endpoint body."""
+        """/hotkeys.txt status-server endpoint body: decayed access count
+        plus the share of total traffic (the quantity is_above compares)."""
+        total = max(1e-9, self.hot_keys.total())
         lines = [
-            f"{name} rate={rate:.1f}" for name, rate in self.hot_keys.top(20)
+            f"{name} count={count:.1f} share={count / total:.3f}"
+            for name, count in self.hot_keys.top(20)
         ]
         return "\n".join(lines) + "\n"
 
@@ -88,6 +90,7 @@ class CounterHandler(AdminHandler):
         self, counter_name: str = "", counter_value: int = 0,
         need_routing: bool = False,
     ) -> dict:
+        self.hot_keys.record(counter_name)
         db_name, app_db = self._local_db_for(counter_name)
         if app_db is None or (
             app_db.role is not ReplicaRole.LEADER

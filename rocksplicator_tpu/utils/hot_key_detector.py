@@ -70,3 +70,9 @@ class HotKeyDetector:
             self._decay(time.monotonic())
             items = sorted(self._counts.items(), key=lambda kv: -kv[1])
             return items[:n]
+
+    def total(self) -> float:
+        """Decayed total access count (the denominator of is_above)."""
+        with self._lock:
+            self._decay(time.monotonic())
+            return self._total

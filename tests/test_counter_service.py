@@ -220,3 +220,4 @@ def test_hot_key_detection_on_access_path(cluster, call):
     assert leader.handler.hot_keys.is_above("viral", 0.3)
     text = leader.handler.hot_keys_text()
     assert "viral" in text.splitlines()[0]
+    assert "share=" in text
