@@ -165,6 +165,9 @@ def main(argv=None) -> int:
     p.add_argument("--replicator_port", type=int, default=0,
                    help="default: service port + 1 (shard-map convention)")
     p.add_argument("--status_port", type=int, default=9999)
+    p.add_argument("--status_host", default="127.0.0.1",
+                   help="status server bind address; pass 0.0.0.0 to allow "
+                        "remote scraping (reference parity)")
     p.add_argument("--shard_map_path", default=None)
     p.add_argument("--az", default=None)
     args = p.parse_args(argv)
@@ -192,6 +195,7 @@ def main(argv=None) -> int:
             "/storage_info.txt": handler.storage_info_text,
             "/hotkeys.txt": handler.hot_keys_text,
         },
+        host=args.status_host,
     )
     shutdown = GracefulShutdownHandler()
     shutdown.add_server(server)

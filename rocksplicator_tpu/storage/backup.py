@@ -42,7 +42,7 @@ def backup_db(
     tmp = tempfile.mkdtemp(prefix="rstpu-backup-")
     ckpt_dir = os.path.join(tmp, "ckpt")
     try:
-        db.checkpoint(ckpt_dir)
+        ckpt_seq = db.checkpoint(ckpt_dir)
         files = sorted(
             f for f in os.listdir(ckpt_dir) if os.path.isfile(os.path.join(ckpt_dir, f))
         )
@@ -59,7 +59,9 @@ def backup_db(
             "db_name": os.path.basename(db.path),
             "files": files,
             "timestamp_ms": int(time.time() * 1000),
-            "seq": db.latest_sequence_number(),
+            # seq captured at checkpoint time, not after the upload: writes
+            # landing during the upload are not in this backup.
+            "seq": ckpt_seq,
         }
         if meta:
             dbmeta.update(meta)

@@ -762,10 +762,12 @@ class DB:
     # checkpoint / ingest / destroy
     # ------------------------------------------------------------------
 
-    def checkpoint(self, checkpoint_dir: str) -> None:
+    def checkpoint(self, checkpoint_dir: str) -> int:
         """Consistent on-disk snapshot via hardlinks (rocksdb::Checkpoint).
         Flushes first so the checkpoint is WAL-free, like the reference's
-        checkpoint-backup path (admin_handler.cpp:996-1129)."""
+        checkpoint-backup path (admin_handler.cpp:996-1129). Returns the
+        sequence number the snapshot actually contains, captured under the
+        DB lock — writes landing after this call are not in the snapshot."""
         with self._lock:
             self._check_open()
             # drain any in-flight background flush, then flush synchronously
@@ -783,6 +785,7 @@ class DB:
                     except OSError:
                         shutil.copyfile(src, dst)
             self._persist_manifest(target_dir=checkpoint_dir)
+            return self._last_seq
 
     def ingest_external_file(
         self,

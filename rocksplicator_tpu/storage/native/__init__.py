@@ -1,10 +1,16 @@
 """Native (C++) storage hot paths, loaded via ctypes.
 
 Build: ``make -C rocksplicator_tpu/storage/native`` (auto-attempted on
-first import). The Python implementations remain authoritative fallbacks;
-format parity is pinned by tests/test_native.py.
+first *use*, never at import). The Python implementations remain
+authoritative fallbacks; format parity is pinned by tests/test_native.py.
 """
 
-from .binding import NATIVE, NativeLib, native_available
+from .binding import NativeLib, get_native, native_available
 
-__all__ = ["NATIVE", "NativeLib", "native_available"]
+__all__ = ["NATIVE", "NativeLib", "get_native", "native_available"]
+
+
+def __getattr__(name: str):
+    if name == "NATIVE":
+        return get_native()
+    raise AttributeError(name)

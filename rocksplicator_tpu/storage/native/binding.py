@@ -10,6 +10,7 @@ import ctypes
 import logging
 import os
 import subprocess
+import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -23,6 +24,20 @@ _u8p = ctypes.POINTER(ctypes.c_uint8)
 _u32p = ctypes.POINTER(ctypes.c_uint32)
 _u64p = ctypes.POINTER(ctypes.c_uint64)
 _i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+_SRC = os.path.join(_DIR, "tsst_native.cc")
+_MAKEFILE = os.path.join(_DIR, "Makefile")
+
+
+def _so_current() -> bool:
+    """True when the .so exists and is at least as new as its inputs
+    (source and Makefile — a flag change must trigger a rebuild too)."""
+    try:
+        so = os.path.getmtime(_SO)
+        return so >= os.path.getmtime(_SRC) and so >= os.path.getmtime(_MAKEFILE)
+    except OSError:
+        return False
 
 
 def _build() -> bool:
@@ -225,9 +240,15 @@ class NativeLib:
 def _load() -> Optional[NativeLib]:
     if os.environ.get("RSTPU_DISABLE_NATIVE"):
         return None
-    # Always run make: it is a no-op when the .so is current and rebuilds
-    # it when the source changed (a stale .so would fail symbol lookup).
-    if not _build() and not os.path.isfile(_SO):
+    # Never load a .so older than its source: it is either a stale build
+    # or a binary of unknown provenance. Rebuild from tsst_native.cc; on
+    # build failure fall back to the pure-Python paths, loudly.
+    if not _so_current() and not _build():
+        if os.path.isfile(_SO):
+            log.warning(
+                "refusing stale/unverified %s (build failed); "
+                "using pure-Python fallback paths", _SO,
+            )
         return None
     try:
         return NativeLib(ctypes.CDLL(_SO))
@@ -236,8 +257,29 @@ def _load() -> Optional[NativeLib]:
         return None
 
 
-NATIVE: Optional[NativeLib] = _load()
+_UNSET = object()
+_native: object = _UNSET
+_native_lock = threading.Lock()
+
+
+def get_native() -> Optional[NativeLib]:
+    """Lazily build+load the native library on first use (not at import).
+    Locked: first use happens on hot paths from multiple threads, and two
+    concurrent `make` runs could dlopen a partially written .so."""
+    global _native
+    if _native is _UNSET:
+        with _native_lock:
+            if _native is _UNSET:
+                _native = _load()
+    return _native  # type: ignore[return-value]
 
 
 def native_available() -> bool:
-    return NATIVE is not None
+    return get_native() is not None
+
+
+def __getattr__(name: str):
+    # PEP 562: keep `binding.NATIVE` working without import-time side effects.
+    if name == "NATIVE":
+        return get_native()
+    raise AttributeError(name)

@@ -3,9 +3,10 @@
 Reference: common/stats/status_server.{h,cpp} — libmicrohttpd server on port
 9999 exposing ``/stats.txt``, ``/gflags.txt``, ``/dump_heap``,
 ``/rocksdb_info.txt`` via a pluggable endpoint→handler map, plus an index at
-``/``. Here: stdlib ThreadingHTTPServer; ``/dump_heap`` is replaced by
-``/threads.txt`` (Python stack dump — the equivalent introspection surface)
-and ``/rocksdb_info.txt`` by ``/storage_info.txt``.
+``/``. Here: stdlib ThreadingHTTPServer; ``/dump_heap`` is a
+tracemalloc-based heap profile (start on first hit, report+stop on the
+next), ``/threads.txt`` is a Python stack dump, and ``/rocksdb_info.txt``
+maps to ``/storage_info.txt``.
 """
 
 from __future__ import annotations
@@ -27,13 +28,23 @@ class StatusServer:
     _instance: Optional["StatusServer"] = None
     _instance_lock = threading.Lock()
 
-    def __init__(self, port: int = 9999, extra_endpoints: Optional[Dict[str, EndpointHandler]] = None):
+    def __init__(
+        self,
+        port: int = 9999,
+        extra_endpoints: Optional[Dict[str, EndpointHandler]] = None,
+        host: str = "127.0.0.1",
+    ):
+        # Loopback by default: the endpoints expose thread stacks, flags,
+        # and live counter key names. Binding all interfaces (the
+        # reference's behavior) is an explicit opt-in via host="0.0.0.0".
+        self._host = host
         self._port = port
         self._endpoints: Dict[str, EndpointHandler] = {
             "/stats.txt": lambda: Stats.get().dump_text(),
             "/flags.txt": FLAGS.dump_text,
             "/gflags.txt": FLAGS.dump_text,  # reference-compatible alias
             "/threads.txt": _dump_threads,
+            "/dump_heap": _dump_heap,
         }
         if extra_endpoints:
             self._endpoints.update(extra_endpoints)
@@ -42,11 +53,14 @@ class StatusServer:
 
     @classmethod
     def start_status_server(
-        cls, port: int = 9999, extra_endpoints: Optional[Dict[str, EndpointHandler]] = None
+        cls,
+        port: int = 9999,
+        extra_endpoints: Optional[Dict[str, EndpointHandler]] = None,
+        host: str = "127.0.0.1",
     ) -> "StatusServer":
         with cls._instance_lock:
             if cls._instance is None:
-                cls._instance = cls(port, extra_endpoints)
+                cls._instance = cls(port, extra_endpoints, host=host)
                 cls._instance.start()
             return cls._instance
 
@@ -94,7 +108,7 @@ class StatusServer:
             def log_message(self, *args) -> None:  # silence per-request logs
                 pass
 
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self._port), Handler)
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
         self._port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="status-server", daemon=True
@@ -124,4 +138,30 @@ def _dump_threads() -> str:
         if frame:
             traceback.print_stack(frame, file=out)
         out.write("\n")
+    return out.getvalue()
+
+
+def _dump_heap() -> str:
+    """Heap profile endpoint (reference: /dump_heap via gperftools,
+    status_server.cpp:125-143). tracemalloc is the Python-native profiler.
+    First request starts tracing; the next request reports the top
+    allocation sites and STOPS tracing, so one stray probe cannot leave
+    the per-allocation overhead enabled for the process lifetime."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(16)
+        return (
+            "tracemalloc started (16-frame traces). "
+            "Request /dump_heap again for a snapshot (tracing then stops).\n"
+        )
+    snap = tracemalloc.take_snapshot()
+    current, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    stats = snap.statistics("lineno")
+    out = io.StringIO()
+    out.write(f"traced current={current}B peak={peak}B (tracing stopped)\n")
+    out.write(f"top {min(50, len(stats))} allocation sites by size:\n")
+    for s in stats[:50]:
+        out.write(f"{s.size:>12}B {s.count:>8}x {s.traceback}\n")
     return out.getvalue()
