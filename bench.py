@@ -4,15 +4,23 @@
 Models BASELINE config ladder steps 1-3 in miniature: S shards of counter
 workload (PUT/MERGE/DELETE mix) run the fused merge-resolve + bloom
 pipeline. The TPU number is the vmapped single-launch pipeline; the CPU
-baseline is the best of (vectorized numpy lexsort+reduceat, pure-Python
-heap-merge extrapolated) on the identical workload.
+baseline ladder is:
+
+  1. single-core vectorized numpy (lexsort+reduceat, native-C bloom);
+  2. the same, multiprocess over shards on every available core;
+  3. a 32-core extrapolation: single-core GB/s x 32 (perfect scaling —
+     flattering to the CPU, so ``vs_baseline`` is a lower bound). This is
+     the mandated BASELINE.json comparator ("≥5x vs 32-core CPU"); on
+     hosts with 32+ cores the measured multiprocess number is used
+     directly.
 
 Prints exactly ONE JSON line on stdout:
-    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, ...}
 Diagnostics go to stderr.
 """
 
 import json
+import multiprocessing
 import os
 import sys
 import time
@@ -34,6 +42,8 @@ VAL_BYTES = 8
 # what a CPU compaction would read per entry in the SST encoding:
 # u32 klen + key + u64 seq + u8 vtype + u32 vlen + value
 ENTRY_BYTES = 4 + KEY_BYTES + 8 + 1 + 4 + VAL_BYTES
+TOTAL_BYTES = SHARDS * ENTRIES * ENTRY_BYTES
+BASELINE_CORES = 32  # the BASELINE.json comparator
 
 
 def build_inputs():
@@ -64,8 +74,6 @@ def _probe_devices(q):
 
 def _start_device_watchdog():
     """Spawn the accelerator-init probe (overlaps with input building)."""
-    import multiprocessing
-
     ctx = multiprocessing.get_context("spawn")
     q = ctx.Queue()
     p = ctx.Process(target=_probe_devices, args=(q,), daemon=True)
@@ -87,101 +95,163 @@ def _join_device_watchdog(p, q, timeout_sec: float = 120.0) -> bool:
         return False
 
 
+def _model_args(dev):
+    return (
+        dev["key_words_be"], dev["key_words_le"], dev["key_len"],
+        dev["seq_hi"], dev["seq_lo"], dev["vtype"], dev["val_words"],
+        dev["val_len"], dev["valid"],
+    )
+
+
 def bench_tpu(stacked):
+    """Returns (kernel_gbps, transfer_inclusive_gbps)."""
     import jax
     import jax.numpy as jnp
 
     from rocksplicator_tpu.models import CompactionModel
 
-    model = CompactionModel(capacity=ENTRIES, uniform_klen=True, seq32=True)
+    # 16-byte keys + 32-bit seqs: 7-operand sort (see _sort_batch)
+    model = CompactionModel(capacity=ENTRIES, uniform_klen=True, seq32=True,
+                            key_words=KEY_BYTES // 4)
     fwd = jax.jit(jax.vmap(model.forward))
     log(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}")
     dev = {k: jnp.asarray(v) for k, v in stacked.items()}
-    args = (
-        dev["key_words_be"], dev["key_words_le"], dev["key_len"],
-        dev["seq_hi"], dev["seq_lo"], dev["vtype"], dev["val_words"],
-        dev["val_len"], dev["valid"],
-    )
+    args = _model_args(dev)
     t0 = time.monotonic()
     out = fwd(*args)
     jax.block_until_ready(out)
     log(f"tpu compile+first run: {time.monotonic() - t0:.1f}s, "
         f"counts={np.asarray(out['count'])[:4]}...")
-    # steady state
+    # steady state, resident inputs
     t0 = time.monotonic()
     for _ in range(ITERS):
         out = fwd(*args)
     jax.block_until_ready(out)
     dt = (time.monotonic() - t0) / ITERS
-    total_bytes = SHARDS * ENTRIES * ENTRY_BYTES
-    gbps = total_bytes / dt / 1e9
-    log(f"tpu: {dt * 1e3:.1f} ms/iter over {total_bytes / 1e6:.0f} MB "
+    gbps = TOTAL_BYTES / dt / 1e9
+    log(f"tpu kernel: {dt * 1e3:.1f} ms/iter over {TOTAL_BYTES / 1e6:.0f} MB "
         f"=> {gbps:.2f} GB/s")
 
-    # transfer-inclusive variant (fresh H2D each iteration)
+    # transfer-inclusive, double-buffered: shards stream H2D in per-shard
+    # slices while the previous slice's kernel runs (device_put and
+    # dispatch are async — block only at the end of the pipeline).
+    fwd1 = jax.jit(model.forward)  # per-shard launch for the pipeline
+    host_shards = [
+        {k: np.ascontiguousarray(v[s]) for k, v in stacked.items()}
+        for s in range(SHARDS)
+    ]
+    # warm up the per-shard compile outside the timed loop
+    w = {k: jnp.asarray(v) for k, v in host_shards[0].items()}
+    jax.block_until_ready(fwd1(*_model_args(w)))
+    reps = max(1, ITERS // 3)
     t0 = time.monotonic()
-    for _ in range(max(1, ITERS // 3)):
-        dev2 = {k: jnp.asarray(v) for k, v in stacked.items()}
-        out = fwd(
-            dev2["key_words_be"], dev2["key_words_le"], dev2["key_len"],
-            dev2["seq_hi"], dev2["seq_lo"], dev2["vtype"],
-            dev2["val_words"], dev2["val_len"], dev2["valid"],
-        )
-        jax.block_until_ready(out)
-    dt_x = (time.monotonic() - t0) / max(1, ITERS // 3)
-    log(f"tpu transfer-inclusive: {dt_x * 1e3:.1f} ms/iter "
-        f"=> {total_bytes / dt_x / 1e9:.2f} GB/s")
-    return gbps
-
-
-def bench_numpy(stacked):
-    from rocksplicator_tpu.ops.kv_format import KVBatch
-    from rocksplicator_tpu.tpu.backend import numpy_merge_resolve
-    from rocksplicator_tpu.storage.bloom import BloomFilter, num_words_for
-
-    def one_pass():
-        total = 0
+    for _ in range(reps):
+        outs = []
+        nxt = {k: jax.device_put(v) for k, v in host_shards[0].items()}
         for s in range(SHARDS):
-            batch = KVBatch(
-                key_words_be=stacked["key_words_be"][s],
-                key_words_le=stacked["key_words_le"][s],
-                key_len=stacked["key_len"][s],
-                seq_hi=stacked["seq_hi"][s],
-                seq_lo=stacked["seq_lo"][s],
-                vtype=stacked["vtype"][s],
-                val_words=stacked["val_words"][s],
-                val_len=stacked["val_len"][s],
-                valid=stacked["valid"][s],
-                val_bytes=VAL_BYTES,
-            )
-            arrays, count = numpy_merge_resolve(
-                batch, uint64_add=True, drop_tombstones=True
-            )
-            # bloom build is part of the compaction job on CPU too
-            bf = BloomFilter(num_words_for(count or 1, 10))
-            kw = arrays[0]
-            kl = arrays[1]
-            kb = (
-                np.ascontiguousarray(kw.astype(">u4"))
-                .view(np.uint8).reshape(len(kw), 24)
-            )
-            for i in range(count):
-                bf.add(kb[i, : kl[i]].tobytes())
-            total += count
-        return total
+            cur = nxt
+            if s + 1 < SHARDS:  # prefetch next shard while this one runs
+                nxt = {k: jax.device_put(v)
+                       for k, v in host_shards[s + 1].items()}
+            outs.append(fwd1(*_model_args(cur)))
+        jax.block_until_ready(outs)
+    dt_x = (time.monotonic() - t0) / reps
+    gbps_x = TOTAL_BYTES / dt_x / 1e9
+    log(f"tpu transfer-inclusive (double-buffered): {dt_x * 1e3:.1f} ms/iter "
+        f"=> {gbps_x:.2f} GB/s  (ratio {dt_x / dt:.2f}x kernel-only)")
+    return gbps, gbps_x
 
+
+def _shard_batch(stacked, s):
+    from rocksplicator_tpu.ops.kv_format import KVBatch
+
+    return KVBatch(
+        key_words_be=stacked["key_words_be"][s],
+        key_words_le=stacked["key_words_le"][s],
+        key_len=stacked["key_len"][s],
+        seq_hi=stacked["seq_hi"][s],
+        seq_lo=stacked["seq_lo"][s],
+        vtype=stacked["vtype"][s],
+        val_words=stacked["val_words"][s],
+        val_len=stacked["val_len"][s],
+        valid=stacked["valid"][s],
+        val_bytes=VAL_BYTES,
+    )
+
+
+def _cpu_one_shard(stacked, s) -> int:
+    """Single shard: merge-resolve + bloom build (the same job the TPU
+    pipeline does), best available CPU implementation."""
+    from rocksplicator_tpu.storage.bloom import BloomFilter
+    from rocksplicator_tpu.tpu.backend import numpy_merge_resolve
+
+    arrays, count = numpy_merge_resolve(
+        _shard_batch(stacked, s), uint64_add=True, drop_tombstones=True
+    )
+    kw = arrays[0]
+    kl = arrays[1]
+    kb = (
+        np.ascontiguousarray(kw.astype(">u4"))
+        .view(np.uint8).reshape(len(kw), 24)
+    )
+    BloomFilter.build(kb[i, : kl[i]].tobytes() for i in range(count))
+    return count
+
+
+# The pool workers read the dataset through this module global, set
+# before fork: map() then ships only shard indices, not the data.
+_MP_STACKED = None
+
+
+def _mp_shard(s):
+    return _cpu_one_shard(_MP_STACKED, s)
+
+
+def bench_numpy_single(stacked):
     t0 = time.monotonic()
-    total = one_pass()
+    total = 0
+    for s in range(SHARDS):
+        total += _cpu_one_shard(stacked, s)
     dt = time.monotonic() - t0
-    total_bytes = SHARDS * ENTRIES * ENTRY_BYTES
-    gbps = total_bytes / dt / 1e9
-    log(f"numpy cpu: {dt * 1e3:.0f} ms/pass (out={total}) => {gbps:.3f} GB/s")
+    gbps = TOTAL_BYTES / dt / 1e9
+    log(f"cpu single-core numpy: {dt * 1e3:.0f} ms/pass (out={total}) "
+        f"=> {gbps:.3f} GB/s")
     return gbps
+
+
+def bench_numpy_multiproc(stacked):
+    """Multiprocess over shards on every available core — the honest
+    measured CPU parallel number on THIS host. Returns
+    (gbps_or_None, cores_available, workers_used). MUST run before any
+    jax device init in this process: fork inherits the dataset via
+    _MP_STACKED, and forking a live multithreaded runtime is
+    deadlock-prone."""
+    global _MP_STACKED
+    cores = len(os.sched_getaffinity(0))
+    workers = min(cores, SHARDS)
+    if workers <= 1:
+        log("cpu multiprocess: 1 core available — same as single-core")
+        return None, cores, 1
+    if cores > SHARDS:
+        log(f"cpu multiprocess: host has {cores} cores but only {SHARDS} "
+            f"shards — raise BENCH_SHARDS to use them all")
+    _MP_STACKED = stacked
+    try:
+        ctx = multiprocessing.get_context("fork")
+        with ctx.Pool(workers) as pool:
+            t0 = time.monotonic()
+            counts = pool.map(_mp_shard, range(SHARDS))
+            dt = time.monotonic() - t0
+    finally:
+        _MP_STACKED = None
+    gbps = TOTAL_BYTES / dt / 1e9
+    log(f"cpu multiprocess ({workers} workers / {cores} cores): "
+        f"{dt * 1e3:.0f} ms (out={sum(counts)}) => {gbps:.3f} GB/s")
+    return gbps, cores, workers
 
 
 def bench_python(stacked):
     """Reference-style interpreter heap-merge, extrapolated from a sample."""
-    from rocksplicator_tpu.ops.kv_format import KVBatch, unpack_entries
     from rocksplicator_tpu.storage.compaction import CpuCompactionBackend
     from rocksplicator_tpu.storage.merge import UInt64AddOperator
 
@@ -205,14 +275,45 @@ def bench_python(stacked):
         ))
     entries.sort(key=lambda e: (e[0], -e[1]))
     t0 = time.monotonic()
-    out = list(CpuCompactionBackend().merge_runs(
+    list(CpuCompactionBackend().merge_runs(
         [entries], UInt64AddOperator(), True
     ))
     dt = time.monotonic() - t0
     gbps = sample * ENTRY_BYTES / dt / 1e9
-    log(f"python cpu (heapq, {sample} sample): {dt * 1e3:.0f} ms "
+    log(f"cpu python (heapq, {sample} sample): {dt * 1e3:.0f} ms "
         f"=> {gbps:.3f} GB/s")
     return gbps
+
+
+def measure_write_stall_p99() -> float:
+    """BASELINE target: write-stall p99 < 10 ms under a compaction storm.
+    Runs a quick storm against the real engine and reads the
+    storage.write_stall_ms histogram."""
+    import shutil
+    import tempfile
+
+    from rocksplicator_tpu.storage.engine import DB, DBOptions
+    from rocksplicator_tpu.utils.stats import Stats
+
+    Stats.reset_for_test()
+    d = tempfile.mkdtemp(prefix="rstpu-bench-stall-")
+    try:
+        opts = DBOptions(
+            memtable_bytes=64 << 10,  # tiny memtables force flush/compaction
+            level0_compaction_trigger=2,
+        )
+        db = DB(os.path.join(d, "db"), opts)
+        val = b"v" * 64
+        for i in range(20000):
+            db.put(f"k{i % 4096:08d}".encode(), val)
+        db.close()
+        stats = Stats.get()
+        p99 = stats.metric_percentile("storage.write_stall_ms", 99)
+        n = stats.metric_count("storage.write_stall_ms")
+        log(f"write-stall p99 under storm: {p99:.2f} ms (samples={n})")
+        return round(p99, 3)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def main():
@@ -231,20 +332,52 @@ def main():
         import __graft_entry__ as graft
 
         graft._honor_platform_env()
+    # CPU parallel baseline first: it forks, which must happen before
+    # jax initializes its multithreaded runtime in this process.
+    try:
+        mp_gbps, cores, workers = bench_numpy_multiproc(stacked)
+    except Exception as e:  # a failed fork must not kill the JSON output
+        log(f"cpu multiprocess baseline failed: {e!r}")
+        mp_gbps, cores, workers = None, len(os.sched_getaffinity(0)), 1
     import jax
 
-    tpu_gbps = bench_tpu(stacked)
-    numpy_gbps = bench_numpy(stacked)
+    tpu_gbps, tpu_xfer_gbps = bench_tpu(stacked)
+    single_gbps = bench_numpy_single(stacked)
     py_gbps = bench_python(stacked)
-    baseline = max(numpy_gbps, py_gbps)
+    single_best = max(single_gbps, py_gbps)
+    if workers >= BASELINE_CORES and mp_gbps:
+        cpu32_gbps = mp_gbps
+        cpu32_kind = f"measured_{workers}core"
+    else:
+        # perfect-scaling extrapolation — flattering to the CPU, so the
+        # reported ratio is a lower bound on the real one
+        cpu32_gbps = single_best * BASELINE_CORES
+        cpu32_kind = "extrapolated_32x_single_core"
+        if mp_gbps and workers > 1:
+            # sanity: never extrapolate below what was actually measured
+            cpu32_gbps = max(cpu32_gbps, mp_gbps)
+    log(f"cpu 32-core baseline ({cpu32_kind}): {cpu32_gbps:.3f} GB/s")
+    try:
+        stall_p99 = measure_write_stall_p99()
+    except Exception as e:  # never let the stall probe kill the bench
+        log(f"write-stall probe failed: {e!r}")
+        stall_p99 = None
     result = {
         "metric": "shard_batched_compaction_throughput",
         "value": round(tpu_gbps, 3),
         "unit": "GB/s",
-        "vs_baseline": round(tpu_gbps / baseline, 2) if baseline > 0 else 0.0,
+        "vs_baseline": round(tpu_gbps / cpu32_gbps, 3) if cpu32_gbps else 0.0,
         # machine consumers must be able to tell a degraded run apart
         "platform": jax.default_backend(),
         "degraded_no_accelerator": not device_ok,
+        "transfer_inclusive_gbps": round(tpu_xfer_gbps, 3),
+        "cpu_single_core_gbps": round(single_best, 3),
+        "cpu_multiproc_gbps": round(mp_gbps, 3) if mp_gbps else None,
+        "cpu_cores_available": cores,
+        "cpu_32core_baseline_gbps": round(cpu32_gbps, 3),
+        "cpu_32core_baseline_kind": cpu32_kind,
+        "vs_single_core": round(tpu_gbps / single_best, 2) if single_best else 0.0,
+        "write_stall_p99_ms": stall_p99,
     }
     print(json.dumps(result), flush=True)
 

@@ -16,6 +16,7 @@ import numpy as np
 
 from ..ops.bloom_tpu import bloom_build_tpu
 from ..ops.compaction_kernel import MergeKind, merge_resolve_kernel
+from ..ops.kv_format import KEY_WORDS
 from ..storage.bloom import num_words_for
 
 _PUT, _DELETE, _MERGE = 1, 2, 3
@@ -31,9 +32,11 @@ class CompactionModel:
     merge_kind: MergeKind = MergeKind.UINT64_ADD
     drop_tombstones: bool = True
     # caller-verified fast-path promises (see ops/compaction_kernel):
-    # synthetic/counter workloads have one key width and 32-bit seqs
+    # synthetic/counter workloads have one key width and 32-bit seqs;
+    # key_words bounds the u32 lanes that actually carry key bytes
     uniform_klen: bool = False
     seq32: bool = False
+    key_words: int = KEY_WORDS
 
     @property
     def num_bloom_words(self) -> int:
@@ -54,6 +57,7 @@ class CompactionModel:
             merge_kind=self.merge_kind,
             drop_tombstones=self.drop_tombstones,
             uniform_klen=self.uniform_klen, seq32=self.seq32,
+            key_words=self.key_words,
         )
         out_valid = jax.lax.iota(jnp.int32, key_len.shape[0]) < out["count"]
         out["bloom"] = bloom_build_tpu(

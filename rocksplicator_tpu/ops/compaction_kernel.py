@@ -58,20 +58,23 @@ def _sort_batch(
     valid: jnp.ndarray,         # (N,) bool
     uniform_klen: bool = False,
     seq32: bool = False,
+    key_words: int = KEY_WORDS,
 ) -> jnp.ndarray:
     """Returns the permutation ordering entries by (invalid-last, key asc,
     seq desc). The static fast-path flags drop sort operands the batch
     provably doesn't need (callers verify on host): ``uniform_klen`` — all
     valid keys share one length, so the length operand is constant among
     comparable rows; ``seq32`` — every seq fits 32 bits, so the high word
-    is zero. Multi-operand sort cost scales with operand count, so the
-    common counter-workload case saves 2 of 10 key operands."""
+    is zero; ``key_words`` — every valid key fits the first ``key_words``
+    u32 lanes, so the later lanes are all-zero and can't affect ordering.
+    Multi-operand sort cost scales with operand count, so the common
+    counter workload (16B keys, 32-bit seqs) runs 7 operands, not 10."""
     n = key_len.shape[0]
     iota = lax.iota(jnp.uint32, n)
     invalid_key = jnp.where(valid, jnp.uint32(0), jnp.uint32(1))
     operands = [
         invalid_key,
-        *(key_words_be[:, w] for w in range(KEY_WORDS)),
+        *(key_words_be[:, w] for w in range(key_words)),
     ]
     if not uniform_klen:
         operands.append(key_len)
@@ -101,7 +104,8 @@ def _limb_combine(lo16_0, lo16_1, hi16_0, hi16_1):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("merge_kind", "drop_tombstones", "uniform_klen", "seq32"),
+    static_argnames=("merge_kind", "drop_tombstones", "uniform_klen",
+                     "seq32", "key_words"),
 )
 def merge_resolve_kernel(
     key_words_be: jnp.ndarray,  # (N, 6) u32
@@ -118,19 +122,21 @@ def merge_resolve_kernel(
     drop_tombstones: bool = True,
     uniform_klen: bool = False,
     seq32: bool = False,
+    key_words: int = KEY_WORDS,
 ) -> Dict[str, jnp.ndarray]:
     """Merge + resolve a concatenated batch of runs (order-free input).
 
     Returns dense output arrays (capacity N, first ``count`` rows live):
     key_words_be/le, key_len, seq_hi/lo, vtype, val_words, val_len, count.
-    ``uniform_klen``/``seq32`` are caller-verified fast-path promises (see
-    _sort_batch); results are identical either way.
+    ``uniform_klen``/``seq32``/``key_words`` are caller-verified fast-path
+    promises (see _sort_batch); results are identical either way.
     """
     n = key_len.shape[0]
     iota = lax.iota(jnp.int32, n)
 
     perm = _sort_batch(key_words_be, key_len, seq_hi, seq_lo, valid,
-                       uniform_klen=uniform_klen, seq32=seq32)
+                       uniform_klen=uniform_klen, seq32=seq32,
+                       key_words=key_words)
     take = lambda a: jnp.take(a, perm, axis=0)
     key_words_be = take(key_words_be)
     key_words_le = take(key_words_le)
@@ -143,8 +149,11 @@ def merge_resolve_kernel(
     valid = take(valid)
 
     # --- key boundaries (sorted order) --------------------------------
+    # (key_words promise: lanes >= key_words are zero for valid rows, so
+    # comparing them cannot change equality among valid rows; invalid rows
+    # get their own segments below regardless)
     prev_equal = jnp.ones(n - 1, dtype=bool)
-    for w in range(KEY_WORDS):
+    for w in range(key_words):
         prev_equal &= key_words_be[1:, w] == key_words_be[:-1, w]
     if not uniform_klen:
         # with uniform lengths, equal words imply equal keys among valid

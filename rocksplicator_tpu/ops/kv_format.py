@@ -158,10 +158,15 @@ def unpack_entries(
 
 
 def fast_flags(key_len: np.ndarray, seq_hi: np.ndarray,
-               valid: np.ndarray) -> Tuple[bool, bool]:
-    """(uniform_klen, seq32) host-side checks enabling the kernel's
-    reduced-operand sort (see ops/compaction_kernel._sort_batch)."""
+               valid: np.ndarray) -> Tuple[bool, bool, int]:
+    """(uniform_klen, seq32, key_words) host-side checks enabling the
+    kernel's reduced-operand sort (see ops/compaction_kernel._sort_batch).
+    ``key_words`` = u32 lanes actually carrying key bytes: lanes beyond
+    ceil(max_klen/4) are zero-padding for every valid row, so the sort and
+    boundary compare can skip them."""
     kl = key_len[valid]
     uniform = bool(len(kl) == 0 or (kl == kl[0]).all())
     seq32 = bool((seq_hi[valid] == 0).all())
-    return uniform, seq32
+    max_kl = int(kl.max()) if len(kl) else 0
+    key_words = max(1, (max_kl + 3) // 4)
+    return uniform, seq32, key_words

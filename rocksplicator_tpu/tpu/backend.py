@@ -208,11 +208,12 @@ class TpuCompactionBackend(CompactionBackend):
             else MergeKind.NONE
         )
         all_valid = np.ones(total, dtype=bool)
-        uniform_klen, seq32 = fast_flags(kl, lanes["seq_hi"], all_valid)
+        uniform_klen, seq32, key_words = fast_flags(
+            kl, lanes["seq_hi"], all_valid)
         arrays, count = run_kernel_arrays(
             lanes, total, kind, drop_tombstones,
             pad_to=_next_pow2(total),
-            uniform_klen=uniform_klen, seq32=seq32,
+            uniform_klen=uniform_klen, seq32=seq32, key_words=key_words,
         )
         if arrays is None:
             return None
@@ -265,8 +266,8 @@ class TpuCompactionBackend(CompactionBackend):
             MergeKind.UINT64_ADD if isinstance(merge_op, UInt64AddOperator)
             else MergeKind.NONE
         )
-        uniform_klen, seq32 = fast_flags(batch.key_len, batch.seq_hi,
-                                         batch.valid)
+        uniform_klen, seq32, key_words = fast_flags(
+            batch.key_len, batch.seq_hi, batch.valid)
         out = merge_resolve_kernel(
             jnp.asarray(batch.key_words_be), jnp.asarray(batch.key_words_le),
             jnp.asarray(batch.key_len), jnp.asarray(batch.seq_hi),
@@ -274,7 +275,7 @@ class TpuCompactionBackend(CompactionBackend):
             jnp.asarray(batch.val_words), jnp.asarray(batch.val_len),
             jnp.asarray(batch.valid),
             merge_kind=kind, drop_tombstones=drop_tombstones,
-            uniform_klen=uniform_klen, seq32=seq32,
+            uniform_klen=uniform_klen, seq32=seq32, key_words=key_words,
         )
         if bool(out["needs_cpu_fallback"]):
             return None

@@ -39,6 +39,7 @@ def run_kernel_arrays(
     batch_arrays: dict, n_valid: int, merge_kind: MergeKind,
     drop_tombstones: bool, pad_to: Optional[int] = None,
     uniform_klen: bool = False, seq32: bool = False,
+    key_words: Optional[int] = None,
 ) -> Tuple[Optional[dict], int]:
     """THE kernel invocation wrapper (shared by the chunked tree and the
     backend's direct file sink): one launch over packed arrays; returns
@@ -58,11 +59,13 @@ def run_kernel_arrays(
         n_rows = pad_to
     valid = np.zeros(n_rows, dtype=bool)
     valid[:n_valid] = True
+    kw = (key_words if key_words is not None
+          else batch_arrays["key_words_be"].shape[1])
     out = merge_resolve_kernel(
         *(jnp.asarray(batch_arrays[f]) for f in FIELDS),
         jnp.asarray(valid),
         merge_kind=merge_kind, drop_tombstones=drop_tombstones,
-        uniform_klen=uniform_klen, seq32=seq32,
+        uniform_klen=uniform_klen, seq32=seq32, key_words=kw,
     )
     if bool(out["needs_cpu_fallback"]):
         return None, 0

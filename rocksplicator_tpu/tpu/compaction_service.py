@@ -27,7 +27,7 @@ from ..storage.bloom import num_words_for
 from ..storage.engine import DBOptions
 from ..ops.bloom_tpu import bloom_build_tpu
 from ..ops.compaction_kernel import MergeKind, merge_resolve_kernel
-from ..ops.kv_format import KVBatch, fast_flags, unpack_entries
+from ..ops.kv_format import KEY_WORDS, KVBatch, fast_flags, unpack_entries
 from .backend import TpuCompactionBackend, _next_pow2
 
 log = logging.getLogger(__name__)
@@ -70,8 +70,9 @@ class TpuCompactionService:
 
     def _pipeline(self, merge_kind: MergeKind, drop_tombstones: bool,
                   num_words: int, uniform_klen: bool = False,
-                  seq32: bool = False):
-        key = (merge_kind, drop_tombstones, num_words, uniform_klen, seq32)
+                  seq32: bool = False, key_words: int = KEY_WORDS):
+        key = (merge_kind, drop_tombstones, num_words, uniform_klen, seq32,
+               key_words)
         fn = self._vmapped_cache.get(key)
         if fn is None:
             jax = self._jax
@@ -81,6 +82,7 @@ class TpuCompactionService:
                     kwbe, kwle, klen, shi, slo, vt, vw, vl, valid,
                     merge_kind=merge_kind, drop_tombstones=drop_tombstones,
                     uniform_klen=uniform_klen, seq32=seq32,
+                    key_words=key_words,
                 )
                 out_valid = (
                     jax.lax.iota(jax.numpy.int32, klen.shape[0]) < out["count"]
@@ -120,10 +122,11 @@ class TpuCompactionService:
             )
         }
         flags = [fast_flags(b.key_len, b.seq_hi, b.valid) for b in batches]
-        uniform_klen = all(u for u, _ in flags)
-        seq32 = all(s for _, s in flags)
+        uniform_klen = all(u for u, _, _ in flags)
+        seq32 = all(s for _, s, _ in flags)
+        key_words = max(k for _, _, k in flags)
         fn = self._pipeline(merge_kind, drop_tombstones, num_words,
-                            uniform_klen, seq32)
+                            uniform_klen, seq32, key_words)
         out = fn(
             stacked["key_words_be"], stacked["key_words_le"],
             stacked["key_len"], stacked["seq_hi"], stacked["seq_lo"],

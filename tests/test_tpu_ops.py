@@ -255,11 +255,12 @@ def test_fast_flags_variants_match_baseline():
         (b"k0000003", 1, OpType.PUT, pack64(7)),
     ]
     batch = pack_entries(entries, capacity=16)
-    uk, s32 = fast_flags(batch.key_len, batch.seq_hi, batch.valid)
+    uk, s32, kwords = fast_flags(batch.key_len, batch.seq_hi, batch.valid)
     assert uk is True   # all keys are 8 bytes
     assert s32 is True  # seqs < 2^32
+    assert kwords == 2  # 8-byte keys live in the first 2 u32 lanes
 
-    def run(uniform_klen, seq32):
+    def run(uniform_klen, seq32, key_words=6):
         out = merge_resolve_kernel(
             jnp.asarray(batch.key_words_be), jnp.asarray(batch.key_words_le),
             jnp.asarray(batch.key_len), jnp.asarray(batch.seq_hi),
@@ -267,7 +268,7 @@ def test_fast_flags_variants_match_baseline():
             jnp.asarray(batch.val_words), jnp.asarray(batch.val_len),
             jnp.asarray(batch.valid),
             merge_kind=MergeKind.UINT64_ADD, drop_tombstones=True,
-            uniform_klen=uniform_klen, seq32=seq32,
+            uniform_klen=uniform_klen, seq32=seq32, key_words=key_words,
         )
         return unpack_entries(
             np.asarray(out["key_words_be"]), np.asarray(out["key_len"]),
@@ -280,6 +281,8 @@ def test_fast_flags_variants_match_baseline():
     assert run(True, True) == base
     assert run(True, False) == base
     assert run(False, True) == base
+    assert run(True, True, key_words=kwords) == base
+    assert run(False, False, key_words=kwords) == base
     assert [k for k, *_ in base] == [b"k0000001", b"k0000003"]
 
 
@@ -290,9 +293,10 @@ def test_fast_flags_negative_cases():
         (b"ab", 1, OpType.PUT, b"v"),
         (b"ab\x00", 2, OpType.PUT, b"w"),  # same padded words, diff length!
     ])
-    uk, s32 = fast_flags(mixed.key_len, mixed.seq_hi, mixed.valid)
+    uk, s32, kw = fast_flags(mixed.key_len, mixed.seq_hi, mixed.valid)
     assert uk is False  # promising uniform here would merge distinct keys
+    assert kw == 1      # 3-byte max key still needs one lane
     big_seq = pack_entries([(b"k", (1 << 40), OpType.PUT, b"v")])
-    uk2, s32_2 = fast_flags(big_seq.key_len, big_seq.seq_hi, big_seq.valid)
+    uk2, s32_2, _ = fast_flags(big_seq.key_len, big_seq.seq_hi, big_seq.valid)
     assert s32_2 is False
     assert uk2 is True
