@@ -153,6 +153,96 @@ class TpuCompactionService:
             })
         return results
 
+    def compact_shard_stream(
+        self,
+        batches: Sequence[KVBatch],
+        merge_kind: MergeKind = MergeKind.UINT64_ADD,
+        drop_tombstones: bool = True,
+        group_size: int = 8,
+    ) -> List[dict]:
+        """Pipelined variant of compact_shard_batch for big shard counts:
+        shards run in fixed-size groups with double-buffered transfers —
+        group i+1's H2D upload is issued while group i's kernel runs, and
+        group i's D2H readback happens under group i+1's compute
+        (device_put and jit dispatch are async; only np.asarray blocks).
+        One compiled shape serves every group (the last one is padded
+        with empty shards). Addresses the round-1 finding that H2D
+        staging cost ~3.7x the kernel (SURVEY §7 front-load item 2)."""
+        if not batches:
+            return []
+        jax = self._jax
+        capacity = _next_pow2(max(b.capacity for b in batches))
+        num_words = num_words_for(capacity, self._bits_per_key)
+        flags = [fast_flags(b.key_len, b.seq_hi, b.valid) for b in batches]
+        uniform_klen = all(u for u, _, _ in flags)
+        seq32 = all(s for _, s, _ in flags)
+        key_words = max(k for _, _, k in flags)
+        fn = self._pipeline(merge_kind, drop_tombstones, num_words,
+                            uniform_klen, seq32, key_words)
+        names = (
+            "key_words_be", "key_words_le", "key_len", "seq_hi",
+            "seq_lo", "vtype", "val_words", "val_len", "valid",
+        )
+
+        def stage(lo: int) -> Dict[str, object]:
+            """Stack one group on host and issue its async H2D."""
+            group = list(batches[lo:lo + group_size])
+            pad_shards = group_size - len(group)
+            stacked = {}
+            for name in names:
+                arr = np.stack([_pad_to(getattr(b, name), capacity)
+                                for b in group])
+                if pad_shards:
+                    arr = np.pad(
+                        arr, [(0, pad_shards)] + [(0, 0)] * (arr.ndim - 1))
+                stacked[name] = jax.device_put(arr)
+            return stacked
+
+        groups = list(range(0, len(batches), group_size))
+        results: List[dict] = []
+        pending: List[Tuple[int, dict]] = []  # (group_lo, device outputs)
+        dev = stage(groups[0])
+        for gi, lo in enumerate(groups):
+            out = fn(*(dev[name] for name in names))  # async dispatch
+            if gi + 1 < len(groups):
+                dev = stage(groups[gi + 1])  # H2D overlaps the kernel
+            pending.append((lo, out))
+            # drain the PREVIOUS group while this one computes: its
+            # np.asarray blocks only on already-finished work
+            if len(pending) > 1:
+                results.extend(self._drain(
+                    *pending.pop(0), batches, merge_kind, drop_tombstones,
+                    num_words))
+        while pending:
+            results.extend(self._drain(
+                *pending.pop(0), batches, merge_kind, drop_tombstones,
+                num_words))
+        return results
+
+    def _drain(self, lo: int, out, batches, merge_kind, drop_tombstones,
+               num_words) -> List[dict]:
+        """Readback + unpack one group's device outputs."""
+        host = {k: np.asarray(v) for k, v in out.items()}
+        group = batches[lo:lo + len(host["count"])]
+        results = []
+        for s in range(min(len(group), len(host["count"]))):
+            if bool(host["needs_cpu_fallback"][s]):
+                results.append(self._cpu_recompute(
+                    group[s], merge_kind, drop_tombstones, num_words))
+                continue
+            count = int(host["count"][s])
+            entries = unpack_entries(
+                host["key_words_be"][s], host["key_len"][s],
+                host["seq_hi"][s], host["seq_lo"][s], host["vtype"][s],
+                host["val_words"][s], host["val_len"][s], count,
+            )
+            results.append({
+                "entries": entries,
+                "bloom_words": host["bloom"][s],
+                "count": count,
+            })
+        return results
+
     def _cpu_recompute(self, batch: KVBatch, merge_kind: MergeKind,
                        drop_tombstones: bool, num_words: int) -> dict:
         """Host recompute for shards the kernel flagged (e.g. one key with

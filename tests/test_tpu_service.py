@@ -121,6 +121,29 @@ def test_service_shard_batch():
             assert bf.may_contain(k)
 
 
+def test_service_shard_stream_matches_batch():
+    """The double-buffered streaming path is result-identical to the
+    single-launch batch path, across group boundaries and padding."""
+    service = TpuCompactionService()
+    batches = []
+    for s in range(7):  # not a multiple of group_size: last group padded
+        entries = [
+            (f"s{s}k{i:02d}".encode(), i + 1, OpType.MERGE, pack64(i))
+            for i in range(16)
+        ] + [(f"s{s}k00".encode(), 99, OpType.PUT, pack64(3))]
+        batches.append(pack_entries(
+            sorted(entries, key=lambda e: (e[0], -e[1]))
+        ))
+    want = service.compact_shard_batch(batches)
+    got = service.compact_shard_stream(batches, group_size=3)
+    assert len(got) == len(want) == 7
+    for w, g in zip(want, got):
+        assert g["count"] == w["count"]
+        assert g["entries"] == w["entries"]
+        assert np.array_equal(np.asarray(g["bloom_words"]),
+                              np.asarray(w["bloom_words"]))
+
+
 def test_model_forward_and_example_args():
     import jax
 
