@@ -6,11 +6,13 @@ delete/copy, ``getObjects(prefix, local_dir)`` batch download
 limiter hookup, and a ``BuildS3Util`` factory keyed by bucket + rate limit.
 
 TPU-first design: a small ``ObjectStore`` interface with two backends:
-``LocalObjectStore`` (a directory tree standing in for a bucket — used by all
-tests and local deployments, filling the reference's missing S3 mock, SURVEY
-§4) and a gated ``S3ObjectStore`` stub that raises unless boto3 is present
-(no cloud deps are baked into the image). Parallel batched transfer mirrors
-the reference's 8-thread upload/download executors (admin_handler.cpp:399-407).
+``LocalObjectStore`` (a directory tree standing in for a bucket — used by
+all tests and local deployments) and ``S3ObjectStore``, a real S3 backend
+over the stdlib SigV4 wire client in ``utils/s3.py`` (works against AWS or
+any S3-compatible endpoint; the in-process ``utils/s3_stub.py`` server
+fills the reference's missing S3 mock, SURVEY §4). Parallel batched
+transfer mirrors the reference's 8-thread upload/download executors
+(admin_handler.cpp:399-407).
 """
 
 from __future__ import annotations
@@ -29,7 +31,24 @@ class ObjectStoreError(Exception):
 
 
 class ObjectStore:
-    """Abstract object store. Keys are '/'-separated paths within a bucket."""
+    """Abstract object store. Keys are '/'-separated paths within a bucket.
+    Subclasses share the rate-limiter plumbing via ``_init_limiter`` /
+    ``_charge`` (reference: S3Util rate limiter hookup)."""
+
+    _limiter: Optional[ConcurrentRateLimiter] = None
+
+    def _init_limiter(
+        self, rate_limit_bytes_per_sec: Optional[float]
+    ) -> None:
+        self._limiter = (
+            ConcurrentRateLimiter(rate_limit_bytes_per_sec)
+            if rate_limit_bytes_per_sec
+            else None
+        )
+
+    def _charge(self, nbytes: int) -> None:
+        if self._limiter is not None and nbytes > 0:
+            self._limiter.apply_cost(nbytes)
 
     def get_object(self, key: str, local_path: str) -> None:
         raise NotImplementedError
@@ -111,11 +130,7 @@ class LocalObjectStore(ObjectStore):
     ):
         self._root = os.path.abspath(root)
         os.makedirs(self._root, exist_ok=True)
-        self._limiter = (
-            ConcurrentRateLimiter(rate_limit_bytes_per_sec)
-            if rate_limit_bytes_per_sec
-            else None
-        )
+        self._init_limiter(rate_limit_bytes_per_sec)
 
     def _path(self, key: str) -> str:
         key = key.lstrip("/")
@@ -123,10 +138,6 @@ class LocalObjectStore(ObjectStore):
         if not path.startswith(self._root + os.sep) and path != self._root:
             raise ObjectStoreError(f"key escapes bucket root: {key!r}")
         return path
-
-    def _charge(self, nbytes: int) -> None:
-        if self._limiter is not None and nbytes > 0:
-            self._limiter.apply_cost(nbytes)
 
     def get_object(self, key: str, local_path: str) -> None:
         src = self._path(key)
@@ -199,20 +210,76 @@ class LocalObjectStore(ObjectStore):
 
 
 class S3ObjectStore(ObjectStore):
-    """Real-S3 backend, gated like the reference's integration tests
-    (admin_handler_test.cpp --enable_integration_test). Requires boto3 at
-    runtime; not available in the build image."""
+    """Real S3 backend over the stdlib SigV4 client (utils/s3.py) — works
+    against AWS or any S3-compatible endpoint (minio, the s3_stub test
+    server). Mirrors the reference S3Util surface (common/s3util.cpp:
+    get/put/listV2/delete/copy + batch transfer + rate limiting). Cloud
+    integration tests stay gated behind RSTPU_S3_INTEGRATION like the
+    reference's --enable_integration_test."""
 
-    def __init__(self, bucket: str, rate_limit_bytes_per_sec: Optional[float] = None):
+    def __init__(
+        self,
+        bucket: str,
+        rate_limit_bytes_per_sec: Optional[float] = None,
+        endpoint: Optional[str] = None,
+    ):
+        from .s3 import S3Client, S3Config, S3Error
+
+        self._S3Error = S3Error
+        cfg = S3Config()
+        if endpoint:
+            cfg.endpoint = endpoint
         try:
-            import boto3  # type: ignore
-        except ImportError as e:  # pragma: no cover
-            raise ObjectStoreError(
-                "S3ObjectStore requires boto3; use LocalObjectStore or run "
-                "with --enable_integration_test on a host with AWS deps"
-            ) from e
-        self._bucket = bucket  # pragma: no cover
-        self._s3 = boto3.client("s3")  # pragma: no cover
+            self._client = S3Client(bucket, cfg)
+        except S3Error as e:
+            raise ObjectStoreError(str(e)) from e
+        self._init_limiter(rate_limit_bytes_per_sec)
+
+    def _wrap(self, fn, *args):
+        try:
+            return fn(*args)
+        except self._S3Error as e:
+            raise ObjectStoreError(str(e)) from e
+
+    def get_object(self, key: str, local_path: str) -> None:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
+        n = self._wrap(
+            self._client.get_object_to_file, key.lstrip("/"), local_path)
+        self._charge(n)
+
+    def get_object_bytes(self, key: str) -> bytes:
+        data = self._wrap(self._client.get_object, key.lstrip("/"))
+        self._charge(len(data))
+        return data
+
+    def put_object(self, local_path: str, key: str) -> None:
+        if not os.path.isfile(local_path):
+            raise ObjectStoreError(f"no such local file: {local_path}")
+        self._charge(os.path.getsize(local_path))
+        self._wrap(
+            self._client.put_object_from_file, key.lstrip("/"), local_path)
+
+    def put_object_bytes(self, key: str, data: bytes) -> None:
+        self._charge(len(data))
+        self._wrap(self._client.put_object, key.lstrip("/"), data)
+
+    def list_objects(self, prefix: str) -> List[str]:
+        return self._wrap(self._client.list_objects, prefix.lstrip("/"))
+
+    def delete_object(self, key: str) -> None:
+        key = key.lstrip("/")
+        # S3 DELETE is idempotent (204 for absent keys); preserve the
+        # ObjectStore contract that deleting a missing object raises.
+        # (Best-effort: the HEAD+DELETE pair is not atomic — concurrent
+        # deleters may both succeed, which is acceptable for backup GC.)
+        if not self._wrap(self._client.head_object, key):
+            raise ObjectStoreError(f"no such object: {key}")
+        self._wrap(self._client.delete_object, key)
+
+    def copy_object(self, src_key: str, dst_key: str) -> None:
+        self._wrap(self._client.copy_object, src_key.lstrip("/"),
+                   dst_key.lstrip("/"))
 
 
 # -- factory (reference: S3Util::BuildS3Util keyed by bucket+ratelimit) ----
