@@ -16,7 +16,11 @@ from rocksplicator_tpu.kafka.watcher import KafkaWatcher
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--cluster", default="default")
+    p.add_argument("--cluster", default="default",
+                   help="embedded cluster name (ignored with --broker)")
+    p.add_argument("--broker", default=None,
+                   help="host:port of a networked BrokerServer "
+                        "(kafka/network.py) — tails across processes")
     p.add_argument("--topic", required=True)
     p.add_argument("--partitions", default="0",
                    help="comma-separated partition ids")
@@ -25,7 +29,6 @@ def main(argv=None) -> int:
                    help="exit after N messages (0 = run forever)")
     args = p.parse_args(argv)
 
-    cluster = get_cluster(args.cluster)
     partitions = [int(x) for x in args.partitions.split(",")]
     count = [0]
 
@@ -36,8 +39,17 @@ def main(argv=None) -> int:
               flush=True)
         count[0] += 1
 
+    if args.broker:
+        from rocksplicator_tpu.kafka.network import NetworkConsumer
+
+        host, _, port = args.broker.rpartition(":")
+        if not host or not port.isdigit():
+            p.error(f"--broker must be host:port, got {args.broker!r}")
+        consumer = NetworkConsumer(host, int(port), "consumer-app")
+    else:
+        consumer = MockConsumer(get_cluster(args.cluster), "consumer-app")
     watcher = KafkaWatcher(
-        "consumer-app", MockConsumer(cluster, "consumer-app"),
+        "consumer-app", consumer,
         args.topic, partitions, args.replay_timestamp_ms,
         on_message=on_message,
     ).start()

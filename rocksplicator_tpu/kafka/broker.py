@@ -59,6 +59,10 @@ class MockKafkaCluster:
         with self._cond:
             return len(self._topics.get(topic, []))
 
+    def topics(self) -> List[str]:
+        with self._cond:
+            return sorted(self._topics)
+
     def produce(self, topic: str, partition: int, key: bytes, value: bytes,
                 timestamp_ms: Optional[int] = None) -> int:
         with self._cond:

@@ -65,36 +65,75 @@ class IngestionWatcher(KafkaWatcher):
             self._persist_timestamp(self.last_timestamp_ms)
 
 
+def _resolve_consumer(broker_path: str, topic_name: str, group_id: str):
+    """(consumer, num_partitions) for a broker address.
+
+    ``embedded://<name>`` (or empty) → in-process MockKafkaCluster;
+    ``broker://host:port`` / ``host:port`` → networked BrokerServer
+    (kafka/network.py, the librdkafka analog); an existing file path →
+    broker-serverset file whose first line is ``host:port`` (reference
+    KafkaBrokerFileWatcher reads the broker list from such files)."""
+    import os
+
+    if broker_path.startswith("embedded://") or not broker_path:
+        cluster_name = broker_path[len("embedded://"):] or "default"
+        cluster = get_cluster(cluster_name)
+        return (
+            MockConsumer(cluster, group_id=group_id),
+            cluster.num_partitions(topic_name),
+        )
+    addr = broker_path
+    if addr.startswith("broker://"):
+        addr = addr[len("broker://"):]
+    elif os.path.isfile(addr):
+        # serverset format (KafkaBrokerFileWatcher): one host:port per
+        # line, comments/blanks skipped; use the first broker listed
+        with open(addr) as f:
+            lines = [ln.strip() for ln in f
+                     if ln.strip() and not ln.lstrip().startswith("#")]
+        if not lines:
+            raise RpcApplicationError(
+                "DB_ADMIN_ERROR", f"empty broker serverset: {broker_path}")
+        addr = lines[0]
+    host, _, port_s = addr.rpartition(":")
+    if not host or not port_s.isdigit():
+        raise RpcApplicationError(
+            "DB_ADMIN_ERROR", f"bad broker address: {broker_path!r}")
+    from .network import NetworkConsumer
+
+    consumer = NetworkConsumer(host, int(port_s), group_id=group_id)
+    try:
+        n = consumer.call("broker_num_partitions",
+                          topic=topic_name)["num_partitions"]
+    except BaseException:
+        consumer.close()
+        raise
+    return consumer, n
+
+
 def start_ingestion(handler, db_name: str, app_db, topic_name: str,
                     broker_path: str, start_ts: int) -> IngestionWatcher:
     """The admin RPC seam (handler.py start/stopMessageIngestion)."""
     if not topic_name:
         raise RpcApplicationError("DB_ADMIN_ERROR", "topic_name required")
-    if broker_path.startswith("embedded://") or not broker_path:
-        cluster_name = broker_path[len("embedded://"):] or "default"
-        cluster = get_cluster(cluster_name)
-    else:
-        # networked backend goes here (librdkafka analog); the serverset
-        # file is watched via KafkaBrokerFileWatcherManager
-        raise RpcApplicationError(
-            "NOT_IMPLEMENTED",
-            f"networked brokers not available in this image: {broker_path}",
-        )
-    if cluster.num_partitions(topic_name) == 0:
+    consumer, num_partitions = _resolve_consumer(
+        broker_path, topic_name, group_id=f"ingest-{db_name}")
+    if num_partitions == 0:
+        consumer.close()
         raise RpcApplicationError(
             "DB_ADMIN_ERROR", f"no such topic: {topic_name}"
         )
     # The partition IS the shard id (reference rejects any mismatch rather
     # than silently ingesting another shard's data).
     shard = extract_shard_id(db_name)
-    if not (0 <= shard < cluster.num_partitions(topic_name)):
+    if not (0 <= shard < num_partitions):
+        consumer.close()
         raise RpcApplicationError(
             "DB_ADMIN_ERROR",
             f"shard {shard} of {db_name} has no partition in topic "
-            f"{topic_name} ({cluster.num_partitions(topic_name)} partitions)",
+            f"{topic_name} ({num_partitions} partitions)",
         )
     partition = shard
-    consumer = MockConsumer(cluster, group_id=f"ingest-{db_name}")
     watcher = IngestionWatcher(
         handler, db_name, app_db, consumer, topic_name, [partition], start_ts
     )

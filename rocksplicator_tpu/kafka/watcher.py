@@ -128,8 +128,16 @@ class KafkaWatcher:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
-        self._consumer.commit()
-        self._consumer.close()
+        # Best-effort: a networked consumer's commit RPC can fail when the
+        # broker is down — the watcher must still stop cleanly and close
+        # its consumer (the reference ignores commit errors on teardown).
+        try:
+            self._consumer.commit()
+        except Exception:
+            log.warning("%s: final commit failed (broker down?)", self.name,
+                        exc_info=True)
+        finally:
+            self._consumer.close()
 
 
 class KafkaConsumerPool:

@@ -317,11 +317,12 @@ def test_message_ingestion_error_paths(nodes, call):
         call(n, "start_message_ingestion", db_name="seg00001",
              topic_name="no-such-topic")
     assert ei.value.code == "DB_ADMIN_ERROR"
-    # networked brokers are not available in this image
+    # an unparseable broker address (no host:port, no such serverset file)
     with pytest.raises(RpcApplicationError) as ei3:
         call(n, "start_message_ingestion", db_name="seg00001",
              topic_name="t", kafka_broker_serverset_path="/etc/brokers")
-    assert ei3.value.code == "NOT_IMPLEMENTED"
+    assert ei3.value.code == "DB_ADMIN_ERROR"
+    assert "bad broker address" in ei3.value.message
     with pytest.raises(RpcApplicationError) as ei2:
         call(n, "stop_message_ingestion", db_name="seg00001")
     assert ei2.value.code == "DB_NOT_FOUND"

@@ -196,6 +196,180 @@ def test_message_ingestion_end_to_end(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# networked broker (kafka/network.py — the librdkafka-analog backend)
+# ---------------------------------------------------------------------------
+
+
+def test_network_broker_roundtrip():
+    from rocksplicator_tpu.kafka.network import (
+        BrokerServer, NetworkConsumer, NetworkProducer,
+    )
+
+    srv = BrokerServer(port=0).start()
+    try:
+        prod = NetworkProducer("127.0.0.1", srv.port)
+        prod.create_topic("t", 2)
+        for i in range(20):
+            prod.produce("t", i % 2, f"k{i}".encode(), f"v{i}".encode(),
+                         timestamp_ms=1000 + i)
+        cons = NetworkConsumer("127.0.0.1", srv.port, group_id="g1")
+        cons.assign("t", [0, 1])
+        got = {}
+        for _ in range(20):
+            m = cons.consume(5.0)
+            assert m is not None
+            got[m.key] = m.value
+        assert got[b"k7"] == b"v7" and len(got) == 20
+        assert cons.consume(0.1) is None  # drained
+        assert cons.high_watermark(0) == 10
+        # timestamp seek replays the tail
+        cons.seek_to_timestamp(1018)
+        replay = [cons.consume(5.0) for _ in range(2)]
+        assert sorted(m.key for m in replay) == [b"k18", b"k19"]
+        # commit round-trips through the broker
+        cons.commit()
+        assert cons.committed == {0: 10, 1: 10}
+    finally:
+        srv.stop()
+
+
+def test_network_broker_durable_restart(tmp_path):
+    from rocksplicator_tpu.kafka.network import (
+        BrokerServer, NetworkConsumer, NetworkProducer,
+    )
+
+    data = str(tmp_path / "broker")
+    srv = BrokerServer(port=0, data_dir=data).start()
+    prod = NetworkProducer("127.0.0.1", srv.port)
+    prod.create_topic("t", 1)
+    for i in range(5):
+        prod.produce("t", 0, f"k{i}".encode(), f"v{i}".encode(),
+                     timestamp_ms=100 + i)
+    cons = NetworkConsumer("127.0.0.1", srv.port, group_id="g")
+    cons.assign("t", [0])
+    for _ in range(5):
+        assert cons.consume(5.0) is not None
+    cons.commit()
+    srv.stop()
+    # restart on the same data_dir: log + committed offsets survive
+    srv2 = BrokerServer(port=0, data_dir=data).start()
+    try:
+        cons2 = NetworkConsumer("127.0.0.1", srv2.port, group_id="g")
+        cons2.assign("t", [0])
+        assert cons2.committed == {0: 5}
+        assert cons2.high_watermark(0) == 5
+        cons2.seek_to_timestamp(103)  # resume-from-timestamp post-restart
+        m = cons2.consume(5.0)
+        assert m is not None and m.key == b"k3"
+        prod2 = NetworkProducer("127.0.0.1", srv2.port)
+        assert prod2.produce("t", 0, b"knew", b"x") == 5  # offsets continue
+    finally:
+        srv2.stop()
+
+
+def test_consumer_app_tails_broker_across_processes(tmp_path):
+    """VERDICT item 5 'done' criterion: kafka_consumer_app tails a broker
+    in another PROCESS; resume-from-timestamp works across a broker
+    process restart."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    from rocksplicator_tpu.kafka.network import NetworkProducer
+
+    env = dict(os.environ, PYTHONPATH=os.getcwd(),
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    data = str(tmp_path / "bk")
+
+    def spawn_broker():
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "rocksplicator_tpu.kafka.network",
+             "--port", "0", "--data_dir", data],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env,
+        )
+        line = proc.stdout.readline()
+        m = re.search(r"port=(\d+)", line)
+        assert m, f"no port in broker banner: {line!r}"
+        return proc, int(m.group(1))
+
+    broker, port = spawn_broker()
+    try:
+        prod = NetworkProducer("127.0.0.1", port)
+        prod.create_topic("t", 1)
+        for i in range(6):
+            prod.produce("t", 0, f"k{i}".encode(), f"v{i}".encode(),
+                         timestamp_ms=1000 + i)
+        out = subprocess.run(
+            [sys.executable, "-m",
+             "examples.kafka_consumer_app.kafka_consumer_app",
+             "--broker", f"127.0.0.1:{port}", "--topic", "t",
+             "--replay_timestamp_ms", "1000", "--max_messages", "6"],
+            capture_output=True, text=True, timeout=60, env=env,
+        )
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.count("[replay]") + out.stdout.count("[live]") >= 6
+        assert "k5" in out.stdout
+        # kill the broker, restart on the same data, resume from ts 1004
+        broker.terminate()
+        broker.wait(timeout=10)
+        broker, port = spawn_broker()
+        out2 = subprocess.run(
+            [sys.executable, "-m",
+             "examples.kafka_consumer_app.kafka_consumer_app",
+             "--broker", f"127.0.0.1:{port}", "--topic", "t",
+             "--replay_timestamp_ms", "1004", "--max_messages", "2"],
+            capture_output=True, text=True, timeout=60, env=env,
+        )
+        assert out2.returncode == 0, out2.stderr
+        assert "k4" in out2.stdout and "k5" in out2.stdout
+        assert "k3" not in out2.stdout  # seek honored the timestamp
+    finally:
+        broker.terminate()
+        broker.wait(timeout=10)
+
+
+def test_ingestion_via_network_broker(tmp_path):
+    """start_message_ingestion with a broker://host:port path applies
+    messages from a networked broker into the DB."""
+    from tests.test_admin import AdminNode
+    from rocksplicator_tpu.kafka.network import BrokerServer, NetworkProducer
+    from rocksplicator_tpu.rpc import IoLoop, RpcClientPool
+
+    srv = BrokerServer(port=0).start()
+    prod = NetworkProducer("127.0.0.1", srv.port)
+    prod.create_topic("events", 2)
+    for i in range(5):
+        prod.produce("events", 1, f"k{i}".encode(), f"v{i}".encode(),
+                     timestamp_ms=1000 + i)
+    node = AdminNode(tmp_path, "a")
+    ioloop = IoLoop.default()
+    pool = RpcClientPool()
+
+    def call(method, **args):
+        async def go():
+            return await pool.call("127.0.0.1", node.admin_port, method, args)
+
+        return ioloop.run_sync(go())
+
+    try:
+        call("add_db", db_name="ev00001", role="LEADER")
+        call("start_message_ingestion", db_name="ev00001",
+             topic_name="events",
+             kafka_broker_serverset_path=f"broker://127.0.0.1:{srv.port}")
+        app_db = node.handler.db_manager.get_db("ev00001")
+        assert wait_until(lambda: app_db.get(b"k4") == b"v4")
+        prod.produce("events", 1, b"klive", b"y", timestamp_ms=2000)
+        assert wait_until(lambda: app_db.get(b"klive") == b"y")
+        call("stop_message_ingestion", db_name="ev00001")
+    finally:
+        ioloop.run_sync(pool.close())
+        node.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
 # CDC → queue publisher
 # ---------------------------------------------------------------------------
 
