@@ -45,13 +45,191 @@ class _Node:
         self.value = value
         self.version = 0
         self.ephemeral_owner = ephemeral_owner
-        self.seq_counter = itertools.count(0)
+        self.seq_counter = 0  # next sequential-child suffix
+
+
+class _Wal:
+    """Group-committed append-only mutation log.
+
+    Each record is one line ``<crc32 hex 8>:<json>\n``; replay stops at
+    the first torn/corrupt line (a crash mid-append), and opening the log
+    TRUNCATES that garbage so later appends are never stranded behind it.
+    Records carry ABSOLUTE resulting state (versions, seq values) so
+    replay over a newer snapshot is idempotent.
+
+    Appends go through a dedicated writer thread: ``append_async``
+    returns a Future resolved after the record is fsync'd. The writer
+    drains the queue and fsyncs once per batch (group commit), so a write
+    burst costs one fsync — and the fsync never runs on the RPC event
+    loop. A failed write/fsync FENCES the log: every pending and future
+    append fails, so no further mutation can be acked."""
+
+    def __init__(self, path: str):
+        import queue
+
+        self._path = path
+        valid = self._valid_prefix_len(path)
+        if valid is not None:
+            with open(path, "r+b") as f:
+                f.truncate(valid)
+        self._f = open(path, "ab")
+        self._q: "queue.Queue" = queue.Queue()
+        self._failed: Optional[Exception] = None
+        self._thread = threading.Thread(
+            target=self._writer_loop, name="coordinator-wal", daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _encode(rec: dict) -> bytes:
+        import json
+        import zlib
+
+        payload = json.dumps(rec, separators=(",", ":")).encode("utf-8")
+        return b"%08x:%s\n" % (zlib.crc32(payload), payload)
+
+    def append_async(self, rec: dict):
+        """Enqueue; returns a concurrent.futures.Future resolved (True)
+        once the record is durable, or failed if the WAL is broken."""
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        if self._failed is not None:
+            fut.set_exception(self._failed)
+            return fut
+        self._q.put((self._encode(rec), fut))
+        return fut
+
+    def _writer_loop(self) -> None:
+        import os
+        import queue
+
+        pending = None  # boundary item deferred mid-drain (preserves FIFO)
+        while True:
+            item = pending if pending is not None else self._q.get()
+            pending = None
+            if item is None:
+                return
+            if item[0] == "reset":
+                try:
+                    self._do_reset()
+                    item[1].set_result(True)
+                except Exception as e:
+                    self._failed = e
+                    item[1].set_exception(e)
+                    return
+                continue
+            batch = [item]
+            while True:  # drain whatever arrived — one fsync per batch
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None or nxt[0] == "reset":
+                    pending = nxt  # handle after this batch, in order
+                    break
+                batch.append(nxt)
+            try:
+                for line, _fut in batch:
+                    self._f.write(line)
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except Exception as e:  # ENOSPC/IO error: fence the log
+                self._failed = e
+                for _line, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                log.critical("coordinator WAL failed — mutations fenced: %r", e)
+                return
+            for _line, fut in batch:
+                if not fut.done():
+                    fut.set_result(True)
+
+    @staticmethod
+    def _valid_prefix_len(path: str) -> Optional[int]:
+        """Byte length of the valid record prefix, or None if no file."""
+        import json
+        import os
+        import zlib
+
+        if not os.path.isfile(path):
+            return None
+        pos = 0
+        with open(path, "rb") as f:
+            for line in f:
+                if not line.endswith(b"\n") or len(line) < 10:
+                    break
+                crc_hex, _, payload = line[:-1].partition(b":")
+                try:
+                    if int(crc_hex, 16) != zlib.crc32(payload):
+                        break
+                    json.loads(payload.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    break
+                pos += len(line)
+        return pos
+
+    @staticmethod
+    def replay(path: str):
+        import json
+        import os
+        import zlib
+
+        if not os.path.isfile(path):
+            return
+        with open(path, "rb") as f:
+            for line in f:
+                if not line.endswith(b"\n") or len(line) < 10:
+                    return  # torn tail
+                crc_hex, _, payload = line[:-1].partition(b":")
+                try:
+                    if int(crc_hex, 16) != zlib.crc32(payload):
+                        return
+                    yield json.loads(payload.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    return
+
+    @property
+    def failed(self) -> Optional[Exception]:
+        return self._failed
+
+    def reset_async(self):
+        """Truncate after a snapshot made the log's contents redundant.
+        Runs on the writer thread (never races in-flight appends); caller
+        must ensure no un-snapshotted record can be enqueued before this
+        (it holds the server lock when the dirty flag was clear)."""
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        if self._failed is not None:
+            fut.set_exception(self._failed)
+            return fut
+        self._q.put(("reset", fut))
+        return fut
+
+    def _do_reset(self) -> None:
+        import os
+
+        self._f.close()
+        self._f = open(self._path, "wb")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+        self._f.close()
 
 
 class CoordinatorServer:
-    """In-memory coordination server (durability is a later-round item —
-    the reference's ZK is durable; state here rebuilds from live sessions
-    on restart, which the state machines tolerate)."""
+    """Coordination server. With ``data_dir`` it is DURABLE the way ZK is:
+    every acknowledged mutation is fsync'd to a WAL (group commit on a
+    dedicated writer thread) before the ack, and periodic snapshots
+    truncate the log (kill -9 loses nothing acked). Ephemeral nodes die
+    with their sessions by definition and are never persisted; sequential
+    counters ARE durable so lock/election suffixes never regress across
+    restarts. A failed WAL write fences all further mutations and stops
+    snapshots (readers may briefly see the last never-acked mutation in
+    memory until restart — standard fail-stop WAL semantics)."""
 
     def __init__(self, port: int = 0, ioloop: Optional[IoLoop] = None,
                  session_ttl: float = DEFAULT_SESSION_TTL,
@@ -64,13 +242,13 @@ class CoordinatorServer:
         self._ttl = session_ttl
         self._change_event: Dict[str, asyncio.Event] = {}
         self._global_version = 0
-        # Durability (ZK is durable): persistent nodes snapshot to disk on
-        # mutation (debounced) and reload on restart; ephemerals die with
-        # their sessions by definition and are never persisted.
         self._data_dir = data_dir
         self._dirty = False
+        self._wal: Optional[_Wal] = None
         if data_dir:
             self._load_snapshot()
+            self._replay_wal()
+            self._wal = _Wal(self._wal_path())
         self._server = RpcServer(port=port, ioloop=self._ioloop)
         self._server.add_handler(self)
         self._server.start()
@@ -86,6 +264,11 @@ class CoordinatorServer:
 
         return os.path.join(self._data_dir, "coordinator_state.json")
 
+    def _wal_path(self) -> str:
+        import os
+
+        return os.path.join(self._data_dir, "coordinator_wal.log")
+
     def _load_snapshot(self) -> None:
         import json
         import os
@@ -100,14 +283,77 @@ class CoordinatorServer:
             for path, entry in raw.get("nodes", {}).items():
                 node = _Node(bytes.fromhex(entry["value"]), None)
                 node.version = entry["version"]
-                node.seq_counter = itertools.count(entry.get("seq", 0))
+                node.seq_counter = entry.get("seq", 0)
                 self._nodes[path] = node
+
+    def _replay_wal(self) -> None:
+        """Apply WAL records on top of the snapshot. Records hold absolute
+        resulting state, so re-applying ones already captured by the
+        snapshot is harmless."""
+        with self._lock:
+            for rec in _Wal.replay(self._wal_path()):
+                op = rec.get("op")
+                if op == "create":
+                    parent = self._parent(rec["path"])
+                    parts = [p for p in parent.split("/") if p]
+                    cur = ""
+                    for p in parts:
+                        cur += "/" + p
+                        self._nodes.setdefault(cur, _Node(b"", None))
+                    if rec.get("seq") is not None:
+                        pnode = self._nodes.get(parent)
+                        if pnode is not None:
+                            pnode.seq_counter = max(
+                                pnode.seq_counter, rec["seq"] + 1)
+                    if not rec.get("ephemeral"):
+                        node = self._nodes.setdefault(
+                            rec["path"], _Node(b"", None))
+                        node.value = bytes.fromhex(rec["value"])
+                elif op == "set":
+                    node = self._nodes.get(rec["path"])
+                    if node is not None:
+                        node.value = bytes.fromhex(rec["value"])
+                        node.version = rec["version"]
+                elif op == "delete":
+                    prefix = rec["path"] + "/"
+                    for p in [q for q in self._nodes
+                              if q.startswith(prefix)]:
+                        del self._nodes[p]
+                    self._nodes.pop(rec["path"], None)
+
+    def _log_mutation(self, rec: dict):
+        """Called under self._lock. Returns a durability future (or None
+        when running without a WAL); the handler must await it BEFORE
+        acknowledging. Setting _dirty here — under the lock, atomically
+        with the enqueue — is what makes snapshot truncation safe: the
+        snapshot loop only truncates when the flag was clear under the
+        same lock, which implies no un-snapshotted record exists."""
+        if self._wal is None:
+            return None
+        self._dirty = True
+        return self._wal.append_async(rec)
+
+    @staticmethod
+    async def _await_durable(futs: list) -> None:
+        """Block the ack on WAL fsync; translate failure to an RPC error.
+        The writer resolves batches in FIFO order, so awaiting each
+        future (usually just one) is cheap."""
+        for fut in futs:
+            if fut is None:
+                continue
+            try:
+                await asyncio.wrap_future(fut)
+            except Exception as e:
+                raise RpcApplicationError(
+                    "WAL_ERROR", f"mutation not durable: {e!r}")
 
     def _write_snapshot(self) -> None:
         import json
 
         from ..utils.misc import write_file_atomic
 
+        if self._wal is not None and self._wal.failed is not None:
+            return  # fenced: memory may hold never-acked state
         with self._lock:
             if not self._dirty:
                 return
@@ -117,23 +363,36 @@ class CoordinatorServer:
                     "value": node.value.hex(),
                     "version": node.version,
                     # preserve sequential-node counters across restarts
-                    "seq": next(node.seq_counter),
+                    "seq": node.seq_counter,
                 }
                 for path, node in self._nodes.items()
                 if node.ephemeral_owner is None
             }
-            # peeking at seq_counter consumed a value; rebuild the counters
-            for path, node in self._nodes.items():
-                if node.ephemeral_owner is None:
-                    node.seq_counter = itertools.count(nodes[path]["seq"])
         write_file_atomic(
             self._snapshot_path(),
             json.dumps({"nodes": nodes}).encode("utf-8"),
         )
+        # The snapshot now covers everything in the WAL; truncate it —
+        # unless a mutation landed meanwhile (_dirty set under the lock
+        # with its WAL append), in which case the next cycle handles it.
+        # (Crash between the two steps just replays idempotent records.)
+        fut = None
+        with self._lock:
+            if self._wal is not None and not self._dirty:
+                fut = self._wal.reset_async()
+        if fut is not None:
+            try:
+                fut.result(timeout=10)
+            except Exception:
+                log.exception("coordinator WAL truncation failed")
 
     async def _snapshot_loop(self) -> None:
         while True:
             await asyncio.sleep(1.0)
+            if self._wal is not None and self._wal.failed is not None:
+                # fenced WAL: in-memory state may hold never-acked
+                # mutations — do NOT persist it
+                continue
             try:
                 self._write_snapshot()
             except Exception:
@@ -156,6 +415,8 @@ class CoordinatorServer:
             except Exception:
                 pass
         self._server.stop()
+        if self._wal is not None:
+            self._wal.close()
 
     # ------------------------------------------------------------------
     # helpers
@@ -263,6 +524,7 @@ class CoordinatorServer:
             if ephemeral:
                 self._check_session(session_id)
             parent = self._parent(path)
+            created_parents: List[str] = []
             if parent not in self._nodes:
                 if not make_parents:
                     raise RpcApplicationError(NO_NODE, parent)
@@ -271,15 +533,38 @@ class CoordinatorServer:
                 cur = ""
                 for p in parts:
                     cur += "/" + p
-                    self._nodes.setdefault(cur, _Node(b"", None))
+                    if cur not in self._nodes:
+                        self._nodes[cur] = _Node(b"", None)
+                        created_parents.append(cur)
+            seq = None
             if sequential:
-                seq = next(self._nodes[parent].seq_counter)
+                pnode = self._nodes[parent]
+                seq = pnode.seq_counter
+                pnode.seq_counter += 1
                 path = f"{path}{seq:010d}"
             if path in self._nodes:
                 raise RpcApplicationError(NODE_EXISTS, path)
             self._nodes[path] = _Node(
                 value, session_id if ephemeral else None
             )
+            # WAL before ack. Ephemeral nodes die with the restart anyway,
+            # but materialized persistent ancestors and sequential suffix
+            # consumption ARE durable changes (lock ordering must never
+            # regress across restarts).
+            futs = [
+                self._log_mutation({
+                    "op": "create", "path": p, "value": "",
+                    "ephemeral": False, "seq": None,
+                })
+                for p in created_parents
+            ]
+            if not (ephemeral and seq is None):
+                futs.append(self._log_mutation({
+                    "op": "create", "path": path,
+                    "value": value.hex() if not ephemeral else "",
+                    "ephemeral": bool(ephemeral), "seq": seq,
+                }))
+        await self._await_durable(futs)
         self._signal_change(path, self._parent(path))
         return {"path": path}
 
@@ -316,6 +601,13 @@ class CoordinatorServer:
             node.value = value
             node.version += 1
             version = node.version
+            futs = []
+            if node.ephemeral_owner is None:
+                futs.append(self._log_mutation({
+                    "op": "set", "path": path, "value": value.hex(),
+                    "version": version,
+                }))
+        await self._await_durable(futs)
         self._signal_change(path)
         return {"version": version}
 
@@ -334,9 +626,16 @@ class CoordinatorServer:
             children = [p for p in self._nodes if p.startswith(prefix)]
             if children and not recursive:
                 raise RpcApplicationError(NOT_EMPTY, path)
+            durable = node.ephemeral_owner is None or any(
+                self._nodes[p].ephemeral_owner is None for p in children
+            )
             for p in children:
                 del self._nodes[p]
             del self._nodes[path]
+            futs = []
+            if durable:
+                futs.append(self._log_mutation({"op": "delete", "path": path}))
+        await self._await_durable(futs)
         self._signal_change(path, self._parent(path))
         return {}
 
@@ -576,3 +875,33 @@ class CoordinatorClient:
     def current_leader(self, election_path: str) -> Optional[str]:
         raw = self.get_or_none(f"{election_path}/leader")
         return raw.decode() if raw is not None else None
+
+
+def main(argv=None) -> int:
+    """Standalone coordinator process (the zkServer analog)."""
+    import argparse
+
+    p = argparse.ArgumentParser(description="coordination server")
+    p.add_argument("--port", type=int, default=2181)
+    p.add_argument("--data_dir", default=None,
+                   help="durable WAL+snapshot dir (omit for in-memory)")
+    p.add_argument("--session_ttl", type=float, default=DEFAULT_SESSION_TTL)
+    args = p.parse_args(argv)
+    srv = CoordinatorServer(port=args.port, session_ttl=args.session_ttl,
+                            data_dir=args.data_dir)
+    print(f"coordinator up: port={srv.port} data_dir={args.data_dir}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -58,13 +58,24 @@ def read_file(path: str) -> Optional[bytes]:
 
 
 def write_file_atomic(path: str, data: bytes) -> None:
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    # fsync the directory so the rename itself survives power loss
+    # (file fsync alone does not make the new directory entry durable)
+    try:
+        dfd = os.open(parent, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # some filesystems disallow dir fsync; best effort
 
 
 def build_revision() -> str:

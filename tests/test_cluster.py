@@ -505,6 +505,89 @@ def test_coordinator_durability(tmp_path):
         s2.stop()
 
 
+def test_coordinator_kill9_loses_no_acked_write(tmp_path):
+    """VERDICT item 6 'done' criterion: kill -9 the coordinator process
+    mid-write-stream; restart; every ACKNOWLEDGED write is present (the
+    WAL fsyncs before the ack — the 1s snapshot debounce no longer
+    defines the durability window)."""
+    import os
+    import re
+    import signal
+    import subprocess
+    import sys
+
+    data_dir = str(tmp_path / "coord_data")
+    env = dict(os.environ, PYTHONPATH=os.getcwd(),
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+
+    def spawn():
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "rocksplicator_tpu.cluster.coordinator",
+             "--port", "0", "--data_dir", data_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env,
+        )
+        line = proc.stdout.readline()
+        m = re.search(r"port=(\d+)", line)
+        assert m, f"no port in banner: {line!r}"
+        return proc, int(m.group(1))
+
+    proc, port = spawn()
+    acked = []
+    try:
+        c = CoordinatorClient("127.0.0.1", port)
+        # ack stream: every create returning IS the acknowledgement
+        for i in range(50):
+            c.put(f"/state/partition{i:03d}", f"seq={i}".encode())
+            acked.append(i)
+        # no clean close, no snapshot window wait: SIGKILL immediately
+    finally:
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+    proc2, port2 = spawn()
+    try:
+        c2 = CoordinatorClient("127.0.0.1", port2)
+        for i in acked:
+            val, _ver = c2.get(f"/state/partition{i:03d}")
+            assert val == f"seq={i}".encode(), f"lost acked write {i}"
+        c2.close()
+    finally:
+        proc2.terminate()
+        proc2.wait(timeout=10)
+
+
+def test_coordinator_wal_torn_tail_truncated(tmp_path):
+    """A torn/corrupt WAL tail (crash mid-append) must be truncated on
+    reopen so records acked AFTER the restart are not stranded behind
+    garbage and lost on the next restart."""
+    import os
+
+    data_dir = str(tmp_path / "coord_data")
+    s1 = CoordinatorServer(port=0, session_ttl=1.5, data_dir=data_dir)
+    c1 = CoordinatorClient("127.0.0.1", s1.port)
+    c1.put("/a", b"1")
+    c1.close()
+    # simulate a crash mid-append: garbage at the WAL tail
+    s1._wal._f.close()  # avoid racing the writer's handle on Windows-ish fs
+    with open(os.path.join(data_dir, "coordinator_wal.log"), "ab") as f:
+        f.write(b"ffffffff:{\"op\":\"cre")  # torn, bad-crc line
+    s1._server.stop()
+    s2 = CoordinatorServer(port=0, session_ttl=1.5, data_dir=data_dir)
+    c2 = CoordinatorClient("127.0.0.1", s2.port)
+    c2.put("/b", b"2")  # acked after restart — must survive round 3
+    c2.close()
+    s2._server.stop()  # no clean snapshot flush: rely on the WAL alone
+    s2._wal.close()
+    s3 = CoordinatorServer(port=0, session_ttl=1.5, data_dir=data_dir)
+    c3 = CoordinatorClient("127.0.0.1", s3.port)
+    try:
+        assert c3.get("/a")[0] == b"1"
+        assert c3.get("/b")[0] == b"2"
+    finally:
+        c3.close()
+        s3.stop()
+
+
 def test_offline_to_follower_rebuild_from_peer(control_plane, tmp_path,
                                                monkeypatch):
     """§3.4 needRebuildDB: a new/stale replica far behind the best peer
