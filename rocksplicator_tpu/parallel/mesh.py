@@ -16,16 +16,54 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 
+# Per-device working-set target for one block of a shard's compaction job.
+# v5e VMEM is ~128 MiB/core; the kernel's sort working set is a small
+# multiple of the block's lane bytes, so budget well below that.
+BLOCK_BYTES_TARGET = 32 << 20
+
+
+def derive_block_axis(num_devices: int,
+                      shard_bytes: Optional[int] = None,
+                      block_bytes_target: int = BLOCK_BYTES_TARGET) -> int:
+    """Block-axis size (SP-analog) from device count and job size.
+
+    Picks the smallest power-of-2 divisor of ``num_devices`` whose blocks
+    fit ``block_bytes_target`` (more block-parallelism only when a
+    shard's job exceeds one device's budget — otherwise devices are
+    better spent on the no-communication shard axis). Shards larger than
+    block capacity compose with tpu/chunked.py's hierarchical merge.
+    Without a ``shard_bytes`` hint: 2 when the device count is even
+    (exercises both collectives), else 1."""
+    if num_devices <= 1:
+        return 1
+    if shard_bytes is None:
+        return 2 if num_devices % 2 == 0 else 1
+    block = 1
+    while (
+        block < num_devices
+        and num_devices % (block * 2) == 0
+        and shard_bytes / block > block_bytes_target
+    ):
+        block *= 2
+    return block
+
+
 def make_mesh(num_devices: Optional[int] = None,
-              axis_names: Tuple[str, str] = ("shard", "block")):
-    """2D mesh over the first ``num_devices`` devices: block axis of 2 when
-    the device count is even (so both collectives are exercised), else 1."""
+              axis_names: Tuple[str, str] = ("shard", "block"),
+              block: Optional[int] = None,
+              shard_bytes: Optional[int] = None):
+    """2D mesh over the first ``num_devices`` devices. The block axis is
+    ``block`` if given, else derived from the job size (see
+    derive_block_axis)."""
     import jax
 
     devices = jax.devices()
     n = num_devices or len(devices)
     devices = devices[:n]
-    block = 2 if n % 2 == 0 and n >= 2 else 1
+    if block is None:
+        block = derive_block_axis(n, shard_bytes)
+    if n % block != 0:
+        raise ValueError(f"block axis {block} does not divide {n} devices")
     shard = n // block
     arr = np.array(devices).reshape(shard, block)
     return jax.sharding.Mesh(arr, axis_names)
