@@ -110,9 +110,11 @@ def bench_tpu(stacked):
 
     from rocksplicator_tpu.models import CompactionModel
 
-    # 16-byte keys + 32-bit seqs: 7-operand sort (see _sort_batch)
+    # 16-byte keys + 32-bit seqs: 7-operand sort (see _sort_batch);
+    # emit_rows adds on-device SST block encoding to the measured pipeline
     model = CompactionModel(capacity=ENTRIES, uniform_klen=True, seq32=True,
-                            key_words=KEY_BYTES // 4)
+                            key_words=KEY_BYTES // 4, emit_rows=True,
+                            row_klen=KEY_BYTES, row_vlen=VAL_BYTES)
     fwd = jax.jit(jax.vmap(model.forward))
     log(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}")
     dev = {k: jnp.asarray(v) for k, v in stacked.items()}

@@ -37,6 +37,12 @@ class CompactionModel:
     uniform_klen: bool = False
     seq32: bool = False
     key_words: int = KEY_WORDS
+    # (row_klen, row_vlen) enables ON-DEVICE block encoding: forward also
+    # emits the SST entry-row byte matrix (ops/block_encode.py), making
+    # the flagship pipeline merge→bloom→bytes with no host byte-work
+    emit_rows: bool = False
+    row_klen: int = 16
+    row_vlen: int = 8
 
     @property
     def num_bloom_words(self) -> int:
@@ -64,6 +70,14 @@ class CompactionModel:
             out["key_words_le"], out["key_len"], out_valid,
             num_words=self.num_bloom_words,
         )
+        if self.emit_rows:
+            from ..ops.block_encode import encode_rows_tpu
+
+            out["rows"] = encode_rows_tpu(
+                out["key_words_be"], out["seq_hi"], out["seq_lo"],
+                out["vtype"], out["val_words"],
+                klen=self.row_klen, vlen=self.row_vlen,
+            )
         return out
 
     def example_args(self, seed: int = 0) -> Tuple:

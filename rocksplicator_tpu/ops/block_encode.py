@@ -1,0 +1,120 @@
+"""On-device SST block encoding: kernel output → file bytes on the TPU.
+
+North star (BASELINE.json): "... bloom construction, and block encoding
+as batched ops over shards" — the compaction path's LAST host-side
+byte-work moves onto the device. The kernel's struct-of-array lanes are
+assembled into the TSST fixed-stride entry rows (u32 klen, key bytes,
+u64 seq LE, u8 vtype, u32 vlen, value bytes — storage/sst.py layout) as
+one (N, stride) u8 matrix, and per-block integrity checksums are
+computed on device too, so the sink just slices rows and writes.
+
+Checksum: a polynomial MAC over bytes, H = Σ (b_i + 1) · r^(i+1) mod
+2^32 with odd r — order- and position-sensitive, fully data-parallel
+(precomputed power vector + wrapping u32 ops), and cheap on both VPU and
+numpy. The TSST format carries it in the props JSON ("block_chk"), so
+v1 files without it stay readable (golden-format compatibility).
+
+Everything is static-shaped: klen/vlen are caller-verified uniform
+widths (the same promise the vectorized sink already requires).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import numpy as np
+
+from ..storage.sst import ENTRY_FIXED_OVERHEAD as _ENTRY_FIXED_OVERHEAD
+from ..utils.checksum import CHK_R as _CHK_R
+from ..utils.checksum import poly_checksum as poly_checksum_np
+
+
+@functools.partial(jax.jit, static_argnames=("klen", "vlen"))
+def encode_rows_tpu(
+    key_words_be,  # (N, 6) u32 big-endian words
+    seq_hi, seq_lo,  # (N,) u32
+    vtype,  # (N,) u32
+    val_words,  # (N, W) u32 little-endian words
+    *,
+    klen: int,
+    vlen: int,
+):
+    """(N, stride) u8 entry rows, byte-identical to the host sink's
+    encode_uniform_block (tpu/format.py) — pinned by parity tests."""
+    import jax.numpy as jnp
+
+    n = seq_lo.shape[0]
+    u8 = lambda x: x.astype(jnp.uint8)
+    cols = []
+    # u32 key_len, little-endian
+    for b in range(4):
+        cols.append(jnp.full((n,), (klen >> (8 * b)) & 0xFF, jnp.uint8))
+    # key bytes: big-endian within each u32 lane
+    for j in range(klen):
+        word = key_words_be[:, j // 4]
+        shift = 24 - 8 * (j % 4)
+        cols.append(u8((word >> shift) & 0xFF))
+    # u64 seq, little-endian (lo word first)
+    for b in range(4):
+        cols.append(u8((seq_lo >> (8 * b)) & 0xFF))
+    for b in range(4):
+        cols.append(u8((seq_hi >> (8 * b)) & 0xFF))
+    # u8 vtype
+    cols.append(u8(vtype & 0xFF))
+    # u32 val_len, little-endian
+    for b in range(4):
+        cols.append(jnp.full((n,), (vlen >> (8 * b)) & 0xFF, jnp.uint8))
+    # value bytes: little-endian within each u32 lane
+    for j in range(vlen):
+        word = val_words[:, j // 4]
+        shift = 8 * (j % 4)
+        cols.append(u8((word >> shift) & 0xFF))
+    return jnp.stack(cols, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("block_entries",))
+def block_checksums_tpu(rows, *, block_entries: int):
+    """Per-block polynomial checksums over the row matrix.
+
+    rows: (N, stride) u8; blocks are consecutive groups of
+    ``block_entries`` rows. The last block may be short; its checksum
+    covers the zero-padded canonical block length, and the reader
+    (sst.py _verify_block_chk → utils/checksum.poly_checksum with
+    length=block_bytes) pads the same way, so tail blocks verify
+    against the device value directly."""
+    import jax.numpy as jnp
+
+    n, stride = rows.shape
+    nblocks = (n + block_entries - 1) // block_entries
+    pad = nblocks * block_entries - n
+    padded = jnp.pad(rows, ((0, pad), (0, 0)))
+    blocks = padded.reshape(nblocks, block_entries * stride)
+    # powers r^1..r^L (wrapping u32): cumulative product of the constant
+    powers = jnp.cumprod(
+        jnp.full((block_entries * stride,), _CHK_R, jnp.uint32))
+    vals = blocks.astype(jnp.uint32) + jnp.uint32(1)
+    # zero-padding contributes (0+1)*r^i — the same constant the host
+    # reference adds for padded tails, so full-vs-padded stays consistent
+    return (vals * powers[None, :]).sum(axis=1, dtype=jnp.uint32)
+
+
+def encode_and_checksum(
+    arrays, count: int, klen: int, vlen: int, block_entries: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Convenience: run both device ops over kernel-output arrays and
+    return host copies — (count, stride) u8 rows and per-block u32
+    checksums (each over the zero-padded canonical block length)."""
+    import jax.numpy as jnp
+
+    rows = encode_rows_tpu(
+        jnp.asarray(arrays["key_words_be"][:count]),
+        jnp.asarray(arrays["seq_hi"][:count]),
+        jnp.asarray(arrays["seq_lo"][:count]),
+        jnp.asarray(arrays["vtype"][:count]),
+        jnp.asarray(arrays["val_words"][:count]),
+        klen=klen, vlen=vlen,
+    )
+    chk = block_checksums_tpu(rows, block_entries=block_entries)
+    return np.asarray(rows), np.asarray(chk)

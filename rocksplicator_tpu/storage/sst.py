@@ -44,6 +44,9 @@ _INDEX_ENTRY = struct.Struct("<QIB")
 COMPRESSION_NONE = 0
 COMPRESSION_ZLIB = 1
 
+# bytes per entry besides key+value: u32 klen, u64 seq, u8 vtype, u32 vlen
+ENTRY_FIXED_OVERHEAD = _ENTRY_HEAD.size + _ENTRY_META.size
+
 FLAG_HAS_GLOBAL_SEQNO = 1
 
 
@@ -257,15 +260,47 @@ class SSTReader:
             self._fd, file_size - _FOOTER.size - props_off, props_off
         )
         self.props: Dict = json.loads(props_raw.decode("utf-8")) if props_raw else {}
+        self._verified_blocks: set = set()
 
     # -- reads ------------------------------------------------------------
 
     def _read_block(self, block_idx: int) -> bytes:
         _last_key, off, size, codec = self._index[block_idx]
         payload = os.pread(self._fd, size, off)
-        if codec == COMPRESSION_ZLIB:
-            return zlib.decompress(payload)
-        return payload
+        raw = zlib.decompress(payload) if codec == COMPRESSION_ZLIB else payload
+        self._verify_block_chk(block_idx, raw)
+        return raw
+
+    def _verify_block_chk(self, block_idx: int, raw: bytes) -> None:
+        """Device-computed per-block integrity checksums (props
+        "block_chk", written by the TPU sink — ops/block_encode.py).
+        Files without the prop (v1 / flush-written) skip verification;
+        crafted/foreign prop shapes degrade to no verification rather
+        than raising arbitrary exceptions (same convention as the
+        'uniform' prop). A verified block index is cached so repeated
+        point lookups don't recompute the checksum."""
+        chk = self.props.get("block_chk")
+        try:
+            if (
+                not isinstance(chk, dict)
+                or chk.get("algo") != "poly1"
+                or block_idx >= len(chk["values"])
+                or block_idx in self._verified_blocks
+            ):
+                return
+            block_len = int(chk["block_bytes"])
+            want = int(chk["values"][block_idx]) & 0xFFFFFFFF
+        except (KeyError, TypeError, ValueError):
+            return  # foreign/crafted prop — treat as absent
+        from ..utils.checksum import poly_checksum
+
+        got = poly_checksum(raw, length=block_len)
+        if got != want:
+            raise Corruption(
+                f"block {block_idx} checksum mismatch: "
+                f"{got:#010x} != {want:#010x}"
+            )
+        self._verified_blocks.add(block_idx)
 
     @staticmethod
     def _iter_block(raw: bytes) -> Iterator[Tuple[bytes, int, int, bytes]]:

@@ -224,6 +224,8 @@ class TpuCompactionBackend(CompactionBackend):
         stride = int(arrays["key_len"][0]) + int(arrays["val_len"][0]) + 17
         entries_per_file = max(1024, target_file_bytes // max(1, stride))
         block_entries = max(64, block_bytes // max(1, stride))
+        klen0 = int(arrays["key_len"][0])
+        vlen0 = int(arrays["val_len"][0])
         outputs: List[Tuple[str, dict]] = []
         for start in range(0, count, entries_per_file):
             end = min(start + entries_per_file, count)
@@ -237,6 +239,13 @@ class TpuCompactionBackend(CompactionBackend):
                 jnp.asarray(sub["key_len"]),
                 jnp.asarray(sub_valid), num_words=num_words,
             )
+            # block encoding + checksums happen ON DEVICE (north star:
+            # "block encoding as batched ops"); the sink writes the byte
+            # matrix as-is
+            from ..ops.block_encode import encode_and_checksum
+
+            rows, chks = encode_and_checksum(
+                sub, end - start, klen0, vlen0, block_entries)
             path = path_factory()
             props = write_sst_from_arrays(
                 sub, end - start, path,
@@ -244,6 +253,8 @@ class TpuCompactionBackend(CompactionBackend):
                 block_entries=block_entries,
                 compression=compression,
                 bits_per_key=bits_per_key,
+                device_rows=rows,
+                device_checksums=chks,
             )
             if props is None:  # should not happen after the width checks
                 for p, _ in outputs:

@@ -16,9 +16,9 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..storage.bloom import BloomFilter
-from ..storage.sst import COMPRESSION_ZLIB, SSTWriter
+from ..storage.sst import (COMPRESSION_ZLIB, ENTRY_FIXED_OVERHEAD, SSTWriter)
 
-_ENTRY_FIXED_OVERHEAD = 4 + 8 + 1 + 4  # klen u32, seq u64, vtype u8, vlen u32
+_ENTRY_FIXED_OVERHEAD = ENTRY_FIXED_OVERHEAD
 
 
 def uniform_widths(arrays: Dict[str, np.ndarray], count: int):
@@ -131,14 +131,24 @@ def write_sst_from_arrays(
     block_entries: int = 1024,
     compression: int = COMPRESSION_ZLIB,
     bits_per_key: int = 10,
+    device_rows: Optional[np.ndarray] = None,
+    device_checksums: Optional[np.ndarray] = None,
 ) -> Optional[dict]:
     """Write kernel-output arrays as a TSST file without per-entry Python.
     Returns the props dict, or None when rows aren't uniform-width (caller
-    falls back to the tuple path)."""
+    falls back to the tuple path).
+
+    ``device_rows``/``device_checksums``: the on-device block encoder's
+    output (ops/block_encode.py) — the (count, stride) byte matrix is
+    written as-is (no host re-encoding) and the per-block checksums land
+    in the "block_chk" prop, which readers verify on every block read."""
     widths = uniform_widths(arrays, count)
     if widths is None:
         return None
     klen, vlen = widths
+    stride = _ENTRY_FIXED_OVERHEAD + klen + vlen
+    if device_rows is not None and device_rows.shape != (count, stride):
+        return None  # shape mismatch — let the host path handle it
     writer = SSTWriter(path, compression=compression,
                        bits_per_key=bits_per_key)
     try:
@@ -152,7 +162,10 @@ def write_sst_from_arrays(
         ) | arrays["seq_lo"][:count].astype(np.uint64)
         for start in range(0, count, block_entries):
             end = min(start + block_entries, count)
-            raw = encode_uniform_block(arrays, start, end, klen, vlen)
+            if device_rows is not None:
+                raw = device_rows[start:end].tobytes()
+            else:
+                raw = encode_uniform_block(arrays, start, end, klen, vlen)
             codec = compression
             payload = zlib.compress(raw, 1) if codec == COMPRESSION_ZLIB else raw
             if len(payload) >= len(raw):
@@ -179,10 +192,17 @@ def write_sst_from_arrays(
             )
         # kernel output has one entry per key; the uniform prop lets the
         # vectorized SOURCE reader decode this file array-to-array
+        extra_props = {"num_keys": int(count),
+                       "uniform": [int(klen), int(vlen)]}
+        if device_checksums is not None:
+            extra_props["block_chk"] = {
+                "algo": "poly1",
+                "block_bytes": block_entries * stride,
+                "values": [int(c) for c in device_checksums],
+            }
         return writer.finish(
             precomputed_bloom=bloom,
-            extra_props={"num_keys": int(count),
-                         "uniform": [int(klen), int(vlen)]},
+            extra_props=extra_props,
         )
     except BaseException:
         writer.abandon()

@@ -1,0 +1,43 @@
+"""Polynomial block checksum — host (numpy) side.
+
+The device implementation lives in ops/block_encode.py; this module is
+jax-free so the storage layer can verify device-written blocks without
+touching the accelerator stack. H = Σ (b_i + 1) · r^(i+1) mod 2^32 over
+the zero-padded canonical block length (r = odd FNV prime): order- and
+position-sensitive, fully vectorizable on both sides.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CHK_R = np.uint32(0x01000193)
+
+_powers_cache: dict = {}
+
+
+def _powers(length: int) -> np.ndarray:
+    """r^1..r^length (wrapping u32), cached per length — verification
+    runs on every block read, and the vector depends only on length."""
+    arr = _powers_cache.get(length)
+    if arr is None:
+        with np.errstate(over="ignore"):
+            arr = np.cumprod(np.full(length, CHK_R, np.uint32),
+                             dtype=np.uint32)
+        if len(_powers_cache) > 64:  # block sizes are few; bound anyway
+            _powers_cache.clear()
+        _powers_cache[length] = arr
+    return arr
+
+
+def poly_checksum(data: bytes, length: int | None = None) -> int:
+    """Checksum of ``data`` zero-padded to ``length`` bytes (a short tail
+    block verifies against the same padded value the device computed)."""
+    buf = np.frombuffer(data, dtype=np.uint8)
+    if length is not None and len(buf) < length:
+        buf = np.pad(buf, (0, length - len(buf)))
+    with np.errstate(over="ignore"):
+        return int(
+            ((buf.astype(np.uint32) + np.uint32(1))
+             * _powers(len(buf))).sum(dtype=np.uint32)
+        )
