@@ -46,6 +46,8 @@ class Replicator:
         ioloop: Optional[IoLoop] = None,
         flags: Optional[ReplicationFlags] = None,
         executor_threads: int = _EXECUTOR_THREADS,
+        server_ssl_manager=None,
+        client_ssl_manager=None,
     ):
         self._ioloop = ioloop or IoLoop.default()
         self._flags = flags or ReplicationFlags()
@@ -53,8 +55,13 @@ class Replicator:
         self._executor = ThreadPoolExecutor(
             max_workers=executor_threads, thread_name_prefix="replicator"
         )
-        self._pool = RpcClientPool()
-        self._server = RpcServer(port=port, ioloop=self._ioloop)
+        # TLS for the WAL-shipping plane (reference: SSL in the thrift
+        # client pool, thrift_client_pool.h:254-290; refreshable context
+        # ssl_context_manager.h) — both sides optional, mutual-TLS when
+        # the managers carry a CA.
+        self._pool = RpcClientPool(ssl_manager=client_ssl_manager)
+        self._server = RpcServer(port=port, ioloop=self._ioloop,
+                                 ssl_manager=server_ssl_manager)
         self._server.add_handler(ReplicatorHandler(self._dbs))
         self._server.start()
         self._maintenance_stop = threading.Event()

@@ -25,10 +25,12 @@ log = logging.getLogger(__name__)
 class RpcClient:
     """Async RPC client bound to the event loop that created it."""
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 5.0):
+    def __init__(self, host: str, port: int, connect_timeout: float = 5.0,
+                 ssl_manager=None):
         self.host = host
         self.port = port
         self._connect_timeout = connect_timeout
+        self._ssl_manager = ssl_manager
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: Dict[int, asyncio.Future] = {}
@@ -46,10 +48,16 @@ class RpcClient:
         self.last_connect_attempt = time.monotonic()
         try:
             self._reader, self._writer = await asyncio.wait_for(
-                asyncio.open_connection(self.host, self.port),
+                asyncio.open_connection(
+                    self.host, self.port,
+                    ssl=(self._ssl_manager.get()
+                         if self._ssl_manager else None),
+                ),
                 self._connect_timeout,
             )
         except (OSError, asyncio.TimeoutError) as e:
+            # (ssl.SSLError is an OSError subclass: handshake failures
+            # funnel into RpcConnectionError too)
             self.is_good = False
             raise RpcConnectionError(f"connect {self.host}:{self.port}: {e}") from e
         self.is_good = True

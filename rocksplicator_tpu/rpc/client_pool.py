@@ -20,10 +20,13 @@ RECONNECT_THROTTLE_SEC = 1.0
 
 
 class RpcClientPool:
-    def __init__(self, connect_timeout: float = 5.0):
+    def __init__(self, connect_timeout: float = 5.0, ssl_manager=None):
         self._clients: Dict[Tuple[str, int], RpcClient] = {}
         self._connect_timeout = connect_timeout
         self._locks: Dict[Tuple[str, int], asyncio.Lock] = {}
+        # client-side SslContextManager: enables TLS (and presents the
+        # client cert for mutual-TLS auth) on every pooled connection
+        self._ssl_manager = ssl_manager
 
     async def get_client(self, host: str, port: int) -> RpcClient:
         addr = (host, port)
@@ -47,7 +50,8 @@ class RpcClientPool:
                 )
             if client is not None:
                 await client.close()
-            client = RpcClient(host, port, self._connect_timeout)
+            client = RpcClient(host, port, self._connect_timeout,
+                               ssl_manager=self._ssl_manager)
             # Register before connecting so a failed attempt is remembered
             # for throttling.
             self._clients[addr] = client

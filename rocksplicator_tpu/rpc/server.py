@@ -32,7 +32,7 @@ class RpcServer:
     """
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
-                 ioloop: Optional[IoLoop] = None):
+                 ioloop: Optional[IoLoop] = None, ssl_manager=None):
         self._host = host
         self._port = port
         self._ioloop = ioloop or IoLoop.default()
@@ -43,6 +43,10 @@ class RpcServer:
         # serves both teardown cancellation and graceful drain)
         self._connections: dict = {}
         self._draining = False
+        # TLS: an SslContextManager (utils/ssl_context_manager) — the
+        # SAME context object is handed to asyncio once; cert refreshes
+        # reload into it, so new handshakes pick up rotated certs
+        self._ssl_manager = ssl_manager
 
     def add_handler(self, handler: object) -> None:
         self._handlers.append(handler)
@@ -59,8 +63,14 @@ class RpcServer:
 
     async def _start_async(self) -> None:
         self._draining = False  # a restarted server serves again
+        ssl_ctx = None
+        if self._ssl_manager is not None:
+            ssl_ctx = self._ssl_manager.get()
+            # servers call get() only here; the background thread keeps
+            # rotated certs flowing into the pinned context
+            self._ssl_manager.ensure_auto_refresh()
         self._server = await asyncio.start_server(
-            self._on_connection, self._host, self._port
+            self._on_connection, self._host, self._port, ssl=ssl_ctx,
         )
         self._port = self._server.sockets[0].getsockname()[1]
         self._ready.set()

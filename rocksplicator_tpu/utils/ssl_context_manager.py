@@ -1,0 +1,284 @@
+"""Refreshable TLS contexts + per-connection auth for the RPC plane.
+
+Reference: common/ssl_context_manager.{h,cpp} — a periodically-refreshed
+SSLContext picked up by the thrift client pool/server
+(thrift_client_pool.h:254-290 configures SSL on channels). Here:
+
+- ``SslContextManager`` owns ONE ``ssl.SSLContext`` and reloads the
+  cert chain into it when the cert/key/CA files change on disk (checked
+  at most every ``refresh_interval`` seconds). Reloading into the same
+  context object means in-flight asyncio servers pick the new certs up
+  for every subsequent handshake without rebinding.
+- Mutual TLS IS the per-connection auth: with ``ca_path`` set, the
+  server requires and verifies a client certificate signed by that CA
+  (``verify_mode=CERT_REQUIRED``), and clients verify the server chain.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import ssl
+import threading
+import time
+from typing import Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+DEFAULT_REFRESH_INTERVAL = 300.0
+
+
+class SslContextManager:
+    """One refreshable context, server- or client-side."""
+
+    def __init__(
+        self,
+        cert_path: str,
+        key_path: str,
+        ca_path: Optional[str] = None,
+        server_side: bool = True,
+        refresh_interval: float = DEFAULT_REFRESH_INTERVAL,
+        check_hostname: bool = False,
+    ):
+        self._cert_path = cert_path
+        self._key_path = key_path
+        self._ca_path = ca_path
+        self._server_side = server_side
+        self._refresh_interval = refresh_interval
+        self._lock = threading.Lock()
+        self._last_check = 0.0
+        self._mtimes: Tuple = ()
+        if server_side:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        else:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            # RPC peers are addressed by IP from shard maps; identity is
+            # proven by the CA-signed cert, not the hostname
+            ctx.check_hostname = check_hostname
+            if ca_path is None:
+                # encrypt-without-verify mode (PROTOCOL_TLS_CLIENT
+                # defaults to CERT_REQUIRED, which would fail every
+                # handshake with no CA loaded)
+                ctx.verify_mode = ssl.CERT_NONE
+        self._ctx = ctx
+        self._refresh_thread: Optional[threading.Thread] = None
+        self._refresh_stop = threading.Event()
+        self._load(initial=True)
+
+    # -- internals ---------------------------------------------------------
+
+    def _file_mtimes(self) -> Tuple:
+        out = []
+        for p in (self._cert_path, self._key_path, self._ca_path):
+            if p is None:
+                out.append(None)
+                continue
+            try:
+                out.append(os.path.getmtime(p))
+            except OSError:
+                out.append(-1)
+        return tuple(out)
+
+    def _load(self, initial: bool = False) -> None:
+        mtimes = self._file_mtimes()
+        if not initial and mtimes == self._mtimes:
+            return
+        ca_changed = (
+            not initial and self._ca_path is not None
+            and mtimes[2] != self._mtimes[2]
+        )
+        self._ctx.load_cert_chain(self._cert_path, self._key_path)
+        if self._ca_path:
+            self._ctx.load_verify_locations(self._ca_path)
+            if self._server_side:
+                # mutual TLS: the client must present a CA-signed cert
+                self._ctx.verify_mode = ssl.CERT_REQUIRED
+        self._mtimes = mtimes
+        if ca_changed:
+            # load_verify_locations ACCUMULATES trust anchors on a live
+            # context; rotating a CA to DISTRUST the old one requires a
+            # process restart (asyncio pins the context object).
+            log.warning(
+                "ssl CA file %s changed: new CA added, but previously "
+                "trusted CAs remain trusted until process restart",
+                self._ca_path,
+            )
+        if not initial:
+            log.info("ssl context refreshed from %s", self._cert_path)
+
+    # -- API ---------------------------------------------------------------
+
+    def get(self) -> ssl.SSLContext:
+        """The context, refreshed from disk if files changed and the
+        refresh interval elapsed. Always the SAME object — safe to hand
+        to a long-lived asyncio server once."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_check >= self._refresh_interval:
+                self._last_check = now
+                try:
+                    self._load()
+                except (OSError, ssl.SSLError):
+                    log.exception("ssl context refresh failed; keeping old")
+        return self._ctx
+
+    def force_refresh(self) -> None:
+        with self._lock:
+            self._last_check = time.monotonic()
+            self._load()
+
+    def ensure_auto_refresh(self) -> None:
+        """Start the background refresh thread (idempotent). Needed by
+        LONG-LIVED SERVERS: clients drive refresh via get() on every
+        connect, but a server calls get() once at bind time — without
+        this, a rotated cert would never be picked up."""
+        if self._refresh_interval <= 0 or self._refresh_thread is not None:
+            return
+        with self._lock:
+            if self._refresh_thread is not None:
+                return
+
+            def loop() -> None:
+                while not self._refresh_stop.wait(self._refresh_interval):
+                    try:
+                        with self._lock:
+                            self._last_check = time.monotonic()
+                            self._load()
+                    except (OSError, ssl.SSLError):
+                        log.exception("ssl auto-refresh failed; keeping old")
+
+            self._refresh_thread = threading.Thread(
+                target=loop, name="ssl-refresh", daemon=True)
+            self._refresh_thread.start()
+
+    def close(self) -> None:
+        self._refresh_stop.set()
+        if self._refresh_thread is not None:
+            self._refresh_thread.join(timeout=2.0)
+            self._refresh_thread = None
+
+
+def make_test_ca(dir_path: str, common_name: str = "rstpu-test-ca"):
+    """Generate a CA + signed server/client certs for tests (the
+    reference's tests ship fixture certs; we mint them fresh with the
+    ``cryptography`` package). Returns a dict of file paths."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(dir_path, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def new_key():
+        return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+    def write_key(key, path):
+        with open(path, "wb") as f:
+            f.write(key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            ))
+
+    def write_cert(cert, path):
+        with open(path, "wb") as f:
+            f.write(cert.public_bytes(serialization.Encoding.PEM))
+
+    ca_key = new_key()
+    ca_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name).issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                       critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    def issue(cn: str, san_ip: Optional[str] = "127.0.0.1"):
+        key = new_key()
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(x509.Name(
+                [x509.NameAttribute(NameOID.COMMON_NAME, cn)]))
+            .issuer_name(ca_name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+        )
+        if san_ip:
+            import ipaddress
+
+            builder = builder.add_extension(
+                x509.SubjectAlternativeName(
+                    [x509.IPAddress(ipaddress.ip_address(san_ip))]),
+                critical=False,
+            )
+        return key, builder.sign(ca_key, hashes.SHA256())
+
+    paths = {
+        "ca_cert": os.path.join(dir_path, "ca.pem"),
+        "ca_key": os.path.join(dir_path, "ca.key"),
+    }
+    write_cert(ca_cert, paths["ca_cert"])
+    write_key(ca_key, paths["ca_key"])
+    for role in ("server", "client"):
+        key, cert = issue(f"rstpu-test-{role}")
+        paths[f"{role}_cert"] = os.path.join(dir_path, f"{role}.pem")
+        paths[f"{role}_key"] = os.path.join(dir_path, f"{role}.key")
+        write_cert(cert, paths[f"{role}_cert"])
+        write_key(key, paths[f"{role}_key"])
+    return paths
+
+
+def reissue_cert(certs: dict, role: str, out_cert: str, out_key: str,
+                 san_ip: str = "127.0.0.1") -> None:
+    """Mint a NEW cert for ``role`` under an existing test CA (rotation
+    scenarios: genuinely different bytes, same trust chain)."""
+    import datetime
+    import ipaddress
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    with open(certs["ca_key"], "rb") as f:
+        ca_key = serialization.load_pem_private_key(f.read(), password=None)
+    with open(certs["ca_cert"], "rb") as f:
+        ca_cert = x509.load_pem_x509_certificate(f.read())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME,
+                                f"rstpu-test-{role}-rotated")]))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.IPAddress(ipaddress.ip_address(san_ip))]),
+            critical=False,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+    with open(out_cert, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(out_key, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        ))

@@ -37,11 +37,14 @@ FAST = ReplicationFlags(
 class Host:
     """One 'node': a private Replicator + its DBs (reference Host struct)."""
 
-    def __init__(self, tmp_path, name, flags=FAST):
+    def __init__(self, tmp_path, name, flags=FAST, server_ssl=None,
+                 client_ssl=None):
         self.name = name
         self.dir = tmp_path / name
         self.dir.mkdir(parents=True, exist_ok=True)
-        self.replicator = Replicator(port=0, flags=flags)
+        self.replicator = Replicator(port=0, flags=flags,
+                                     server_ssl_manager=server_ssl,
+                                     client_ssl_manager=client_ssl)
         self.dbs = {}
 
     @property
@@ -494,3 +497,51 @@ def test_wrapper_based_add_db_via_test_proxy(hosts):
     assert wait_until(lambda: fdb.latest_sequence_number() == 5)
     assert proxy.writes == 5
     assert proxy.reads >= 1  # follower pulls went through the proxy
+
+
+# ---------------------------------------------------------------------------
+# replication over mutual TLS (VERDICT item 8)
+# ---------------------------------------------------------------------------
+
+
+def test_replication_over_mutual_tls(tmp_path):
+    """Leader/follower WAL shipping end-to-end over mutual TLS — every
+    node verifies its peer's CA-signed cert in both directions."""
+    from rocksplicator_tpu.utils.ssl_context_manager import (
+        SslContextManager, make_test_ca,
+    )
+
+    certs = make_test_ca(str(tmp_path / "certs"))
+
+    def managers():
+        server = SslContextManager(
+            certs["server_cert"], certs["server_key"],
+            ca_path=certs["ca_cert"], server_side=True)
+        client = SslContextManager(
+            certs["client_cert"], certs["client_key"],
+            ca_path=certs["ca_cert"], server_side=False)
+        return server, client
+
+    created = []
+    try:
+        def make(name):
+            s, c = managers()
+            h = Host(tmp_path, name, FAST, server_ssl=s, client_ssl=c)
+            created.append(h)
+            return h
+
+        leader, follower = make("tls-leader"), make("tls-follower")
+        ldb, _ = leader.add_db("seg00001", ReplicaRole.LEADER)
+        fdb, _ = follower.add_db("seg00001", ReplicaRole.FOLLOWER,
+                                 upstream=leader.addr)
+        for i in range(25):
+            leader.replicator.write(
+                "seg00001",
+                WriteBatch().put(f"k{i}".encode(), f"v{i}".encode()))
+        assert wait_until(
+            lambda: fdb.latest_sequence_number() == ldb.latest_sequence_number())
+        for i in range(25):
+            assert fdb.get(f"k{i}".encode()) == f"v{i}".encode()
+    finally:
+        for h in created:
+            h.stop()
