@@ -96,8 +96,9 @@ def _join_device_watchdog(p, q, timeout_sec: float = 120.0) -> bool:
 
 
 def _model_args(dev):
+    # (key_words_le is not shipped: the kernel byteswap-derives LE lanes)
     return (
-        dev["key_words_be"], dev["key_words_le"], dev["key_len"],
+        dev["key_words_be"], dev["key_len"],
         dev["seq_hi"], dev["seq_lo"], dev["vtype"], dev["val_words"],
         dev["val_len"], dev["valid"],
     )
@@ -110,7 +111,7 @@ def bench_tpu(stacked):
 
     from rocksplicator_tpu.models import CompactionModel
 
-    # 16-byte keys + 32-bit seqs: 7-operand sort (see _sort_batch);
+    # 16-byte keys + 32-bit seqs: reduced-key sort (_sort_merge_order);
     # emit_rows adds on-device SST block encoding to the measured pipeline
     model = CompactionModel(capacity=ENTRIES, uniform_klen=True, seq32=True,
                             key_words=KEY_BYTES // 4, emit_rows=True,
@@ -122,6 +123,10 @@ def bench_tpu(stacked):
     t0 = time.monotonic()
     out = fwd(*args)
     jax.block_until_ready(out)
+    # NOTE: this small D2H readback is load-bearing on the tunneled
+    # (axon) platform: block_until_ready does NOT drain the launch queue
+    # there, but a readback does — and flips the session into synchronous
+    # dispatch, making the timed loop below honest per-iteration time.
     log(f"tpu compile+first run: {time.monotonic() - t0:.1f}s, "
         f"counts={np.asarray(out['count'])[:4]}...")
     # steady state, resident inputs

@@ -1,0 +1,203 @@
+"""Component-level device profiling for the compaction pipeline.
+
+Times pipeline stages and rewrite candidates in isolation on the live
+device to locate the wall-clock. Probe sets:
+
+  components — sorts, gathers, scans, bloom, encode, full model
+  variants   — rewrite candidates (payload-through-sort, seg-scan bloom,
+               encode layouts, scatter)
+
+Measurement note (axon tunnel): ``jax.block_until_ready`` does NOT block
+on the tunneled platform — launches queue and "complete" instantly. Only
+a device-to-host readback drains the queue (and flips the session into
+synchronous dispatch). Every timing here forces a readback, and the first
+readback happens before t0, so numbers are true per-iteration wall-clock
+*including* the per-dispatch floor (~23 ms measured; see the ``floor``
+probe).
+
+Usage:  python -m benchmarks.profile_device [--set components|variants|all]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _readback(out):
+    """Force a real host sync (see module docstring)."""
+    leaves = jax.tree_util.tree_leaves(out)
+    np.asarray(leaves[0]).ravel()[:1]
+
+
+def timeit(fn, args, iters=3, name="?"):
+    out = fn(*args)
+    _readback(out)
+    t0 = time.monotonic()
+    for _ in range(iters):
+        out = fn(*args)
+    _readback(out)
+    dt = (time.monotonic() - t0) / iters
+    log(f"{name:<46s} {dt * 1e3:9.2f} ms/iter")
+    return dt
+
+
+def build_inputs(n: int, s: int):
+    import jax.numpy as jnp
+
+    from rocksplicator_tpu.models.compaction_model import synth_counter_batch
+
+    shards = [
+        synth_counter_batch(n, key_space=n // 8, seed=1234 + i, key_bytes=16)
+        for i in range(s)
+    ]
+    st = {k: jnp.asarray(np.stack([b[k] for b in shards])) for k in shards[0]}
+    _readback(st["seq_lo"])  # flip the tunnel session into sync dispatch
+    return st
+
+
+def probe_components(st, n, iters, results):
+    import jax.numpy as jnp
+    from jax import lax
+
+    from rocksplicator_tpu.models import CompactionModel
+    from rocksplicator_tpu.ops.bloom_tpu import bloom_build_tpu
+    from rocksplicator_tpu.ops.compaction_kernel import (
+        _sort_merge_order, merge_resolve_kernel)
+
+    small = jnp.arange(1024, dtype=jnp.uint32)
+    results["floor"] = timeit(
+        jax.jit(lambda x: x + 1), (small,), iters, "floor (tiny launch)")
+
+    u32 = st["seq_lo"]
+
+    def sort2(x):
+        iota = lax.iota(jnp.uint32, x.shape[0])
+        return lax.sort((x, iota), num_keys=1, is_stable=False)
+
+    results["sort_2op"] = timeit(
+        jax.jit(jax.vmap(sort2)), (u32,), iters, "sort 2-op u32 (argsort)")
+
+    def sort_fast(kwb, klen, shi, slo, valid):
+        return _sort_merge_order(kwb, klen, shi, slo, valid, (),
+                                 uniform_klen=True, seq32=True,
+                                 key_words=4)[3]
+
+    results["sort_6key"] = timeit(
+        jax.jit(jax.vmap(sort_fast)),
+        (st["key_words_be"], st["key_len"], st["seq_hi"], st["seq_lo"],
+         st["valid"]),
+        iters, "sort 6-key fast path (no payload)")
+
+    idx = jnp.argsort(st["seq_lo"], axis=-1).astype(jnp.uint32)
+    _readback(idx)
+
+    def take1d(c, idx):
+        return jnp.take_along_axis(c, idx, axis=-1)
+
+    results["take_1d"] = timeit(
+        jax.jit(take1d), (u32, idx), iters, "take 1-D (the gather cost)")
+
+    def scans(x):
+        iota = lax.iota(jnp.int32, x.shape[0])
+        return jnp.cumsum(x) + lax.cummax(jnp.where(x > 0, iota, 0))
+
+    results["scans"] = timeit(
+        jax.jit(jax.vmap(scans)), (st["seq_lo"].astype(jnp.int32),),
+        iters, "cumsum+cummax")
+
+    model = CompactionModel(capacity=n, uniform_klen=True, seq32=True,
+                            key_words=4)
+    margs = (st["key_words_be"], st["key_len"],
+             st["seq_hi"], st["seq_lo"], st["vtype"], st["val_words"],
+             st["val_len"], st["valid"])
+
+    def mrk(*a):
+        return merge_resolve_kernel(
+            *a, uniform_klen=True, seq32=True, key_words=4)
+
+    results["merge_resolve"] = timeit(
+        jax.jit(jax.vmap(mrk)), margs, iters, "merge_resolve_kernel")
+
+    results["bloom"] = timeit(
+        jax.jit(jax.vmap(lambda kwl, kl, v: bloom_build_tpu(
+            kwl, kl, v, num_words=model.num_bloom_words))),
+        (st["key_words_le"], st["key_len"], st["valid"]),
+        iters, "bloom_build_tpu")
+
+    results["full_model"] = timeit(
+        jax.jit(jax.vmap(model.forward)), margs, iters, "FULL model.forward")
+
+
+def probe_variants(st, n, iters, results):
+    import jax.numpy as jnp
+    from jax import lax
+
+    kw = st["key_words_be"]
+
+    def sort10(kw, slo, vt, vw, vl, valid):
+        inval = jnp.where(valid, jnp.uint32(0), jnp.uint32(1))
+        ops = (inval, kw[:, 0], kw[:, 1], kw[:, 2], kw[:, 3], ~slo,
+               vt, vw[:, 0], vw[:, 1], vl)
+        return lax.sort(ops, num_keys=6, is_stable=False)
+
+    results["sort_10op_payload"] = timeit(
+        jax.jit(jax.vmap(sort10)),
+        (kw, st["seq_lo"], st["vtype"], st["val_words"], st["val_len"],
+         st["valid"]),
+        iters, "sort 10-op (payload-through)")
+
+    # minor-dim materialization: why rows must stay planar
+    def stack_rows(slo, shi, vt, vw):
+        m = slo.shape[0]
+        lanes = [jnp.full((m,), jnp.uint32(16)), slo, shi, vt,
+                 vw[:, 0], vw[:, 1]]
+        return jnp.stack(lanes, axis=1)
+
+    results["stack_minor6"] = timeit(
+        jax.jit(jax.vmap(stack_rows)),
+        (st["seq_lo"], st["seq_hi"], st["vtype"], st["val_words"]),
+        iters, "stack 6 lanes -> (n, 6) minor-dim")
+
+    def scatter_only(sidx, val):
+        out = jnp.zeros(n + 1, dtype=jnp.uint32)
+        return out.at[sidx].set(val, mode="drop")[:n]
+
+    sidx = jnp.argsort(st["seq_lo"], axis=-1).astype(jnp.int32)
+    _readback(sidx)
+    results["scatter_set"] = timeit(
+        jax.jit(jax.vmap(scatter_only)), (sidx, st["seq_lo"]),
+        iters, "scatter .at[].set one lane")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--entries", type=int, default=1 << 17)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--set", default="components",
+                    choices=("components", "variants", "all"))
+    args = ap.parse_args()
+
+    log(f"platform={jax.default_backend()} shards={args.shards} "
+        f"entries={args.entries}")
+    st = build_inputs(args.entries, args.shards)
+    results = {}
+    if args.set in ("components", "all"):
+        probe_components(st, args.entries, args.iters, results)
+    if args.set in ("variants", "all"):
+        probe_variants(st, args.entries, args.iters, results)
+    print(json.dumps({k: round(v * 1e3, 2) for k, v in results.items()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -50,15 +50,16 @@ class CompactionModel:
 
     def forward(
         self,
-        key_words_be, key_words_le, key_len,
+        key_words_be, key_len,
         seq_hi, seq_lo, vtype, val_words, val_len, valid,
     ) -> Dict:
-        """One shard's compaction: merged entries + bloom + count."""
+        """One shard's compaction: merged entries + bloom + count.
+        (LE key lanes are byteswap-derived on device — not an input.)"""
         import jax
         import jax.numpy as jnp
 
         out = merge_resolve_kernel(
-            key_words_be, key_words_le, key_len, seq_hi, seq_lo,
+            key_words_be, key_len, seq_hi, seq_lo,
             vtype, val_words, val_len, valid,
             merge_kind=self.merge_kind,
             drop_tombstones=self.drop_tombstones,
@@ -85,7 +86,7 @@ class CompactionModel:
         b = synth_counter_batch(self.capacity, seed=seed,
                                 val_words=self.val_words)
         return (
-            b["key_words_be"], b["key_words_le"], b["key_len"],
+            b["key_words_be"], b["key_len"],
             b["seq_hi"], b["seq_lo"], b["vtype"], b["val_words"],
             b["val_len"], b["valid"],
         )

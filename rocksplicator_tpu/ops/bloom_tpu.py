@@ -6,10 +6,12 @@ slices of a second hash) was designed for exactly this kernel: the FNV fold
 
 TPU design note: scatter-OR does not exist and per-bit plane scatters are
 slow, so the bitmap materializes scatter-free except for one final store:
-sort keys by word index, compute each word's OR via bit-plane prefix-sum
-differences over the sorted segments, then ONE scatter of (identical-
-per-segment) word values. Sorts + scans + a single scatter — the same
-op-diet as the merge kernel.
+sort rows by word index (mask riding the sort as payload), compute each
+word's OR with ONE flagged segmented OR-scan (``lax.associative_scan``),
+then a single scatter-max of (nonzero only at segment ends) word values.
+Sorts + scans + a single scatter — the same op-diet as the merge kernel.
+Round-2 device profiling: this formulation is ~4x the bit-plane cumsum +
+index-gather version it replaced (41 ms vs 165 ms at 8x131k rows).
 """
 
 from __future__ import annotations
@@ -68,34 +70,29 @@ def bloom_build_tpu(
     num_words: int,
 ) -> jnp.ndarray:
     """Returns the (num_words,) u32 bloom bitmap."""
-    n = key_len.shape[0]
     word_idx, mask = bloom_word_mask(key_words_le, key_len, num_words)
     word_idx = jnp.where(valid, word_idx, num_words)  # invalid -> spill word
-    # group rows by word: 2-operand sort
+    # group rows by word: 2-operand sort, the mask riding as payload
     sorted_idx, sorted_mask = lax.sort(
         (word_idx.astype(jnp.uint32), mask), num_keys=1, is_stable=False
     )
     sorted_idx = sorted_idx.astype(jnp.int32)
-    iota = lax.iota(jnp.int32, n)
     new_word = jnp.concatenate(
         [jnp.ones(1, bool), sorted_idx[1:] != sorted_idx[:-1]]
     )
     last_word = jnp.concatenate([new_word[1:], jnp.ones(1, bool)])
-    seg_start = lax.cummax(jnp.where(new_word, iota, 0))
-    seg_end = jnp.flip(lax.cummin(jnp.flip(jnp.where(last_word, iota, n - 1))))
-    # per-word OR via bit-plane prefix sums
-    bits = ((sorted_mask[:, None] >> jnp.arange(32, dtype=_U32)[None, :])
-            & _U32(1)).astype(jnp.int32)
-    csum = jnp.cumsum(bits, axis=0)
-    seg_or = (
-        jnp.take(csum, seg_end, axis=0)
-        - (jnp.take(csum, seg_start, axis=0) - jnp.take(bits, seg_start, axis=0))
-    ) > 0
-    word_val = jnp.sum(
-        seg_or.astype(_U32) << jnp.arange(32, dtype=_U32)[None, :],
-        axis=1, dtype=_U32,
-    )
-    # every row of a segment writes the same value -> single scatter
+
+    # flagged segmented OR-scan: row i holds OR of masks from its
+    # segment's start through i; at the segment's last row that is the
+    # whole word's value. No index gathers, no bit-plane expansion.
+    def comb(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, av | bv)
+
+    _, seg_or = lax.associative_scan(comb, (new_word, sorted_mask))
+    word_val = jnp.where(last_word, seg_or, _U32(0))
+    # only segment-end rows carry nonzero values; max == the word's OR
     bitmap = jnp.zeros(num_words + 1, dtype=_U32)
-    bitmap = bitmap.at[sorted_idx].set(word_val, mode="drop")
+    bitmap = bitmap.at[sorted_idx].max(word_val, mode="drop")
     return bitmap[:num_words]

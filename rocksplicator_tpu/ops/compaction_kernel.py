@@ -5,20 +5,32 @@ SURVEY §3.3) with a fixed-shape array program:
 
 1. one multi-key ``lax.sort`` orders every entry by (validity, key lex asc,
    seq desc) — the k-way merge collapses into a sort because the runs are
-   concatenated into one batch (XLA's TPU sort is highly tuned; a Pallas
-   path exists in ops/pallas_kernels.py for tile-local work);
-2. key-boundary detection + per-row segment-start/end indices — computed
-   with cumulative max/min, NOT segment scatters;
+   concatenated into one batch. Every payload lane RIDES THE SORT as a
+   non-key operand: round-2 device profiling showed TPU row gathers cost
+   ~16 ms/lane at 131k rows while extra sort operands are nearly free
+   (an 18-operand sort times the same as a 10-operand one), so the kernel
+   carries payload through the sort network instead of gathering by the
+   sorted permutation;
+2. key-boundary detection with adjacent-lane compares, then per-segment
+   aggregates via cumulative sums + two flagged segmented fills
+   (``lax.associative_scan``) — one forward fill of segment-start values,
+   one backward fill of segment-end prefix sums. No index gathers;
 3. vectorized LSM resolution per key: newest PUT/DELETE wins, MERGE
    operands above the base fold via the uint64-add operator as 16-bit-limb
    prefix-sum differences (carry-safe for < 2^16 operands per key);
-4. stream compaction via a second (2-operand) sort.
+4. stream compaction via a second stable sort, again carrying every output
+   lane as payload.
 
-**TPU design note:** everything here is sorts, cumulative scans, gathers,
-and elementwise ops — no scatters and no ``jax.ops.segment_*`` (those lower
-to serialized TPU scatters and were measured ~5× slower than this
-formulation). Static shapes throughout: capacity N in → capacity N out +
+**TPU design note:** everything here is sorts, cumulative/associative
+scans, and elementwise ops — ZERO gathers, zero scatters, and no
+``jax.ops.segment_*``. Gathers were the round-1 kernel's actual bottleneck
+(~70% of its 500 ms/launch on hardware); this formulation removes them
+entirely. Static shapes throughout: capacity N in → capacity N out +
 count; the whole pipeline jits once and vmaps over shards.
+
+``key_words_le`` is never carried: a little-endian key word is the
+byteswap of the big-endian word over the same bytes, so it is recomputed
+from the sorted BE lanes with 4 shift/mask ops per word.
 
 Reference semantics being reproduced: compaction.py's resolve_stream
 (heap-merge + _resolve_group), pinned by test_tpu_ops parity tests.
@@ -50,27 +62,34 @@ class MergeKind(enum.Enum):
     UINT64_ADD = "uint64add"  # the counter operator (merge_operator.h:20-40)
 
 
-def _sort_batch(
+def bswap32(w: jnp.ndarray) -> jnp.ndarray:
+    """Byteswap u32 lanes: the LE word over the same 4 bytes as a BE word."""
+    return ((w >> 24) | ((w >> 8) & jnp.uint32(0xFF00))
+            | ((w << 8) & jnp.uint32(0xFF0000)) | (w << 24))
+
+
+def _sort_merge_order(
     key_words_be: jnp.ndarray,  # (N, 6) u32
     key_len: jnp.ndarray,       # (N,) u32
     seq_hi: jnp.ndarray,
     seq_lo: jnp.ndarray,
     valid: jnp.ndarray,         # (N,) bool
+    payload: Tuple[jnp.ndarray, ...],
     uniform_klen: bool = False,
     seq32: bool = False,
     key_words: int = KEY_WORDS,
-) -> jnp.ndarray:
-    """Returns the permutation ordering entries by (invalid-last, key asc,
-    seq desc). The static fast-path flags drop sort operands the batch
-    provably doesn't need (callers verify on host): ``uniform_klen`` — all
-    valid keys share one length, so the length operand is constant among
-    comparable rows; ``seq32`` — every seq fits 32 bits, so the high word
-    is zero; ``key_words`` — every valid key fits the first ``key_words``
-    u32 lanes, so the later lanes are all-zero and can't affect ordering.
-    Multi-operand sort cost scales with operand count, so the common
-    counter workload (16B keys, 32-bit seqs) runs 7 operands, not 10."""
-    n = key_len.shape[0]
-    iota = lax.iota(jnp.uint32, n)
+):
+    """One variadic sort into (invalid-last, key asc, seq desc) order,
+    carrying ``payload`` lanes through the sort network. Returns
+    (key_lanes_sorted, klen_sorted_or_None, seq_hi_sorted_or_None,
+    seq_lo_sorted, valid_sorted, payload_sorted).
+
+    The static fast-path flags drop sort operands the batch provably
+    doesn't need (callers verify on host): ``uniform_klen`` — all valid
+    keys share one length; ``seq32`` — every seq fits 32 bits; and
+    ``key_words`` — lanes beyond it are zero for valid rows. Operand
+    count barely affects TPU sort cost (measured), but fewer key operands
+    still shorten the comparator."""
     invalid_key = jnp.where(valid, jnp.uint32(0), jnp.uint32(1))
     operands = [
         invalid_key,
@@ -81,10 +100,56 @@ def _sort_batch(
     if not seq32:
         operands.append(~seq_hi)  # descending seq == ascending complement
     operands.append(~seq_lo)
-    operands.append(iota)
-    sorted_ops = lax.sort(tuple(operands), num_keys=len(operands) - 1,
+    num_keys = len(operands)
+    operands.extend(payload)
+    sorted_ops = lax.sort(tuple(operands), num_keys=num_keys,
                           is_stable=False)
-    return sorted_ops[-1]  # the permutation
+    pos = 1
+    key_lanes = sorted_ops[pos:pos + key_words]
+    pos += key_words
+    klen_s = None
+    if not uniform_klen:
+        klen_s = sorted_ops[pos]
+        pos += 1
+    shi_s = None
+    if not seq32:
+        shi_s = ~sorted_ops[pos]
+        pos += 1
+    slo_s = ~sorted_ops[pos]
+    pos += 1
+    valid_s = sorted_ops[0] == 0
+    return key_lanes, klen_s, shi_s, slo_s, valid_s, sorted_ops[pos:]
+
+
+def _seg_fill_forward(flag: jnp.ndarray, values):
+    """Segmented forward fill: every row receives each value as it was at
+    its segment's FIRST row. ``flag`` marks segment starts (row 0 must be
+    flagged). One flagged associative scan — no index gathers."""
+    def comb(a, b):
+        af, bf = a[0], b[0]
+        return (af | bf,) + tuple(
+            jnp.where(bf, bv, av) for av, bv in zip(a[1:], b[1:])
+        )
+
+    out = lax.associative_scan(comb, (flag,) + tuple(values))
+    return out[1:]
+
+
+def _seg_fill_backward(flag_last: jnp.ndarray, values):
+    """Segmented backward fill: every row receives each value as it is at
+    its segment's LAST row (``flag_last`` marks segment ends; the final
+    row must be flagged). Same flagged combine as the forward fill, run
+    as a reverse scan (reverse=True ≡ flip∘scan∘flip, without the
+    materialized flips)."""
+    def comb(a, b):
+        af, bf = a[0], b[0]
+        return (af | bf,) + tuple(
+            jnp.where(bf, bv, av) for av, bv in zip(a[1:], b[1:])
+        )
+
+    out = lax.associative_scan(comb, (flag_last,) + tuple(values),
+                               reverse=True)
+    return out[1:]
 
 
 def _limb_combine(lo16_0, lo16_1, hi16_0, hi16_1):
@@ -109,7 +174,6 @@ def _limb_combine(lo16_0, lo16_1, hi16_0, hi16_1):
 )
 def merge_resolve_kernel(
     key_words_be: jnp.ndarray,  # (N, 6) u32
-    key_words_le: jnp.ndarray,  # (N, 6) u32 (carried for bloom)
     key_len: jnp.ndarray,       # (N,) u32
     seq_hi: jnp.ndarray,
     seq_lo: jnp.ndarray,
@@ -128,25 +192,34 @@ def merge_resolve_kernel(
 
     Returns dense output arrays (capacity N, first ``count`` rows live):
     key_words_be/le, key_len, seq_hi/lo, vtype, val_words, val_len, count.
+    (LE key lanes are not an input: they are byteswaps of the BE lanes,
+    recomputed on the outputs — callers save the H2D transfer.)
     ``uniform_klen``/``seq32``/``key_words`` are caller-verified fast-path
-    promises (see _sort_batch); results are identical either way.
+    promises (see _sort_merge_order); results are identical either way.
     """
     n = key_len.shape[0]
     iota = lax.iota(jnp.int32, n)
+    n_val_words = val_words.shape[1]
+    # uniform_klen reconstruction constant: the one valid key length
+    # (input order differs from output order, so the lane itself can't be
+    # passed through; invalid rows may carry zero lengths)
+    klen_const = jnp.max(jnp.where(valid, key_len, jnp.uint32(0)))
 
-    perm = _sort_batch(key_words_be, key_len, seq_hi, seq_lo, valid,
-                       uniform_klen=uniform_klen, seq32=seq32,
-                       key_words=key_words)
-    take = lambda a: jnp.take(a, perm, axis=0)
-    key_words_be = take(key_words_be)
-    key_words_le = take(key_words_le)
-    key_len = take(key_len)
-    seq_hi = take(seq_hi)
-    seq_lo = take(seq_lo)
-    vtype = take(vtype)
-    val_words = take(val_words)
-    val_len = take(val_len)
-    valid = take(valid)
+    # --- phase 1: merge-order sort, payload riding the network ---------
+    payload = (vtype, val_len) + tuple(
+        val_words[:, w] for w in range(n_val_words)
+    )
+    key_lanes, klen_s, shi_s, slo_s, valid, payload = _sort_merge_order(
+        key_words_be, key_len, seq_hi, seq_lo, valid, payload,
+        uniform_klen=uniform_klen, seq32=seq32, key_words=key_words,
+    )
+    vtype, val_len = payload[0], payload[1]
+    vw_lanes = list(payload[2:])
+    seq_lo = slo_s
+    seq_hi = shi_s if shi_s is not None else jnp.zeros_like(seq_lo)
+    # sorted-order key_len lane; None in the uniform path (the input lane
+    # would be misaligned after the sort — outputs use klen_const instead)
+    key_len = klen_s
 
     # --- key boundaries (sorted order) --------------------------------
     # (key_words promise: lanes >= key_words are zero for valid rows, so
@@ -154,7 +227,7 @@ def merge_resolve_kernel(
     # get their own segments below regardless)
     prev_equal = jnp.ones(n - 1, dtype=bool)
     for w in range(key_words):
-        prev_equal &= key_words_be[1:, w] == key_words_be[:-1, w]
+        prev_equal &= key_lanes[w][1:] == key_lanes[w][:-1]
     if not uniform_klen:
         # with uniform lengths, equal words imply equal keys among valid
         # rows (invalid rows get their own segments below regardless)
@@ -163,34 +236,21 @@ def merge_resolve_kernel(
     new_key = new_key | ~valid  # each invalid row = its own segment
     last_key = jnp.concatenate([new_key[1:], jnp.ones(1, bool)])
 
-    # per-row segment start/end indices via cumulative max/min (no scatter)
-    seg_start = lax.cummax(jnp.where(new_key, iota, 0))
-    seg_end = jnp.flip(lax.cummin(jnp.flip(jnp.where(last_key, iota, n - 1))))
-
     is_put = (vtype == _PUT) & valid
     is_del = (vtype == _DELETE) & valid
     is_merge = (vtype == _MERGE) & valid
     is_base = is_put | is_del
 
     # prefix counts of base entries: how many bases strictly before row i
-    # within its segment
+    # within its segment. Segment-start values arrive via ONE forward
+    # flagged fill (associative scan) instead of index gathers.
     base_incl = jnp.cumsum(is_base.astype(jnp.int32))
     base_excl = base_incl - is_base.astype(jnp.int32)
-    base_before = base_excl - jnp.take(base_excl, seg_start)
+    (base_excl_start, iota_start) = _seg_fill_forward(
+        new_key, (base_excl, iota))
+    base_before = base_excl - base_excl_start
     operand_mask = is_merge & (base_before == 0)
     first_base_mask = is_base & (base_before == 0)
-
-    # per-segment flags evaluated at every row via prefix-count differences
-    def seg_any(mask: jnp.ndarray) -> jnp.ndarray:
-        c = jnp.cumsum(mask.astype(jnp.int32))
-        c_excl_start = jnp.take(c, seg_start) - jnp.take(
-            mask.astype(jnp.int32), seg_start
-        )
-        return (jnp.take(c, seg_end) - c_excl_start) > 0
-
-    seg_has_operands = seg_any(operand_mask)
-    seg_base_put = seg_any(first_base_mask & is_put)
-    seg_base_del = seg_any(first_base_mask & is_del)
 
     if merge_kind is MergeKind.UINT64_ADD:
         # Reference parity (merge.py UInt64AddOperator._parse): values whose
@@ -198,8 +258,8 @@ def merge_resolve_kernel(
         contrib = (
             (operand_mask | (first_base_mask & is_put)) & (val_len == 8)
         )
-        lo = val_words[:, 0]
-        hi = val_words[:, 1] if val_words.shape[1] > 1 else jnp.zeros_like(lo)
+        lo = vw_lanes[0]
+        hi = vw_lanes[1] if n_val_words > 1 else jnp.zeros_like(lo)
         zero = jnp.uint32(0)
         limbs = [
             jnp.where(contrib, lo & 0xFFFF, zero),
@@ -208,19 +268,39 @@ def merge_resolve_kernel(
             jnp.where(contrib, hi >> 16, zero),
         ]
 
-        def seg_sum(x: jnp.ndarray) -> jnp.ndarray:
-            c = jnp.cumsum(x)
-            return jnp.take(c, seg_end) - (jnp.take(c, seg_start) - jnp.take(x, seg_start))
+        # inclusive prefix sums; their value AT THE SEGMENT END comes back
+        # to every row via one backward flagged fill. Segment total for a
+        # row = end_prefix - (own_prefix - own_x) — all local afterwards.
+        pref = [jnp.cumsum(x) for x in limbs] + [
+            jnp.cumsum(operand_mask.astype(jnp.int32)),
+            jnp.cumsum((first_base_mask & is_put).astype(jnp.int32)),
+            jnp.cumsum((first_base_mask & is_del).astype(jnp.int32)),
+            iota,
+        ]
+        ends = _seg_fill_backward(last_key, tuple(pref))
+        excl = lambda c, x: c - x  # noqa: E731
 
-        sums = [seg_sum(limb) for limb in limbs]
+        sums = [
+            ends[i] - excl(pref[i], limbs[i]) for i in range(4)
+        ]
+        seg_has_operands = (
+            ends[4] - excl(pref[4], operand_mask.astype(jnp.int32))
+        ) > 0
+        seg_base_put = (
+            ends[5] - excl(pref[5], (first_base_mask & is_put).astype(jnp.int32))
+        ) > 0
+        seg_base_del = (
+            ends[6] - excl(pref[6], (first_base_mask & is_del).astype(jnp.int32))
+        ) > 0
+        seg_size = ends[7] - iota_start + 1
         sum_lo, sum_hi = _limb_combine(*sums)
 
         folded = seg_has_operands
         out_lo = jnp.where(folded, sum_lo, lo)
         out_hi = jnp.where(folded, sum_hi, hi)
-        val_words = val_words.at[:, 0].set(out_lo)
-        if val_words.shape[1] > 1:
-            val_words = val_words.at[:, 1].set(out_hi)
+        vw_lanes[0] = out_lo
+        if n_val_words > 1:
+            vw_lanes[1] = out_hi
         val_len = jnp.where(folded, jnp.uint32(8), val_len)
         pure_operands = seg_has_operands & ~seg_base_put & ~seg_base_del
         resolved_put = seg_base_put | (seg_has_operands & seg_base_del)
@@ -232,46 +312,68 @@ def merge_resolve_kernel(
         rep = new_key & valid
         vtype = jnp.where(rep, out_vtype, vtype)
         dropped = seg_base_del & ~seg_has_operands
+        # Limb sums are exact only below 2^16 contributing operands per
+        # key; flag oversize groups so callers fall back to CPU instead of
+        # silently wrapping (generous: 65k updates of ONE key in ONE batch).
+        overflow_risk = jnp.any((seg_size >= (1 << 16)) & valid)
     else:
         rep = new_key & valid
         dropped = is_del
+        overflow_risk = jnp.asarray(False)
 
     if drop_tombstones:
         keep = rep & ~dropped
     else:
         keep = rep
 
-    # --- stream compaction via a 2-operand sort (no scatter) -----------
+    # --- stream compaction: stable sort, output lanes as payload -------
     not_keep = jnp.where(keep, jnp.uint32(0), jnp.uint32(1))
-    _, perm2 = lax.sort((not_keep, lax.iota(jnp.uint32, n)), num_keys=1,
-                        is_stable=True)
-    take2 = lambda a: jnp.take(a, perm2, axis=0)
+    out_payload = list(key_lanes) + [seq_lo, vtype, val_len] + vw_lanes
+    if not seq32:
+        out_payload.append(seq_hi)
+    if not uniform_klen:
+        out_payload.append(key_len)
+    sorted2 = lax.sort(tuple([not_keep] + out_payload), num_keys=1,
+                       is_stable=True)
     count = jnp.sum(keep.astype(jnp.int32))
     live = lax.iota(jnp.int32, n) < count
 
-    def masked(a: jnp.ndarray) -> jnp.ndarray:
-        m = live if a.ndim == 1 else live[:, None]
-        return jnp.where(m, take2(a), jnp.zeros_like(a))
+    def m1(a: jnp.ndarray) -> jnp.ndarray:
+        return jnp.where(live, a, jnp.zeros_like(a))
 
-    # Limb sums are exact only below 2^16 contributing operands per key;
-    # flag oversize groups so callers fall back to CPU instead of silently
-    # wrapping (the limit is generous: 65k updates of ONE key in ONE batch).
-    seg_size = seg_end - seg_start + 1
-    overflow_risk = (
-        jnp.any((seg_size >= (1 << 16)) & valid)
-        if merge_kind is MergeKind.UINT64_ADD
-        else jnp.asarray(False)
-    )
+    pos = 1
+    out_key_lanes = [m1(sorted2[pos + w]) for w in range(key_words)]
+    pos += key_words
+    out_seq_lo = m1(sorted2[pos]); pos += 1
+    out_vtype = m1(sorted2[pos]); pos += 1
+    out_val_len = m1(sorted2[pos]); pos += 1
+    out_vw = [m1(sorted2[pos + w]) for w in range(n_val_words)]
+    pos += n_val_words
+    if not seq32:
+        out_seq_hi = m1(sorted2[pos]); pos += 1
+    else:
+        out_seq_hi = jnp.zeros_like(out_seq_lo)
+    if not uniform_klen:
+        out_key_len = m1(sorted2[pos]); pos += 1
+    else:
+        out_key_len = jnp.where(live, klen_const, jnp.uint32(0))
+
+    # full-width (6-lane) key matrices; lanes >= key_words are zero by the
+    # caller-verified promise, LE lanes are byteswaps of the BE lanes
+    zeros_tail = [jnp.zeros_like(out_seq_lo)] * (KEY_WORDS - key_words)
+    out_kw_be = jnp.stack(out_key_lanes + zeros_tail, axis=1)
+    out_kw_le = jnp.stack(
+        [bswap32(w) for w in out_key_lanes] + zeros_tail, axis=1)
 
     return {
-        "key_words_be": masked(key_words_be),
-        "key_words_le": masked(key_words_le),
-        "key_len": masked(key_len),
-        "seq_hi": masked(seq_hi),
-        "seq_lo": masked(seq_lo),
-        "vtype": masked(vtype),
-        "val_words": masked(val_words),
-        "val_len": masked(val_len),
+        "key_words_be": out_kw_be,
+        "key_words_le": out_kw_le,
+        "key_len": out_key_len,
+        "seq_hi": out_seq_hi,
+        "seq_lo": out_seq_lo,
+        "vtype": out_vtype,
+        "val_words": jnp.stack(out_vw, axis=1),
+        "val_len": out_val_len,
         "count": count,
         "needs_cpu_fallback": overflow_risk,
     }

@@ -160,7 +160,7 @@ def unpack_entries(
 def fast_flags(key_len: np.ndarray, seq_hi: np.ndarray,
                valid: np.ndarray) -> Tuple[bool, bool, int]:
     """(uniform_klen, seq32, key_words) host-side checks enabling the
-    kernel's reduced-operand sort (see ops/compaction_kernel._sort_batch).
+    kernel's reduced-operand sort (ops/compaction_kernel._sort_merge_order).
     ``key_words`` = u32 lanes actually carrying key bytes: lanes beyond
     ceil(max_klen/4) are zero-padding for every valid row, so the sort and
     boundary compare can skip them."""

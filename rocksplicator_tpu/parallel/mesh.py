@@ -100,7 +100,7 @@ def sharded_compaction_step(mesh, model=None):
     model = model or CompactionModel()
     merge_kind = model.merge_kind
 
-    def local_step(kwbe, kwle, klen, shi, slo, vt, vw, vl, valid):
+    def local_step(kwbe, klen, shi, slo, vt, vw, vl, valid):
         # local shapes: (s, 1, N, ...) — one block column per device
         s, b, n = klen.shape
         squeeze = lambda a: a.reshape((s * b, n) + a.shape[3:])
@@ -112,11 +112,14 @@ def sharded_compaction_step(mesh, model=None):
 
         # 1) block-local merge (keep tombstones: blocks are partial views)
         local = dict(jax.vmap(lambda *a: run(a, False))(
-            squeeze(kwbe), squeeze(kwle), squeeze(klen), squeeze(shi),
+            squeeze(kwbe), squeeze(klen), squeeze(shi),
             squeeze(slo), squeeze(vt), squeeze(vw), squeeze(vl),
             squeeze(valid),
         ))
         local_fallback = jnp.any(local.pop("needs_cpu_fallback"))
+        # LE lanes are byteswap-derived wherever needed — don't pay the
+        # all_gather for them
+        local.pop("key_words_le")
         # 2) assemble the shard's blocks: all_gather over the block axis
         gathered = {
             k: jax.lax.all_gather(v, "block", axis=1)
@@ -141,7 +144,7 @@ def sharded_compaction_step(mesh, model=None):
                 drop_tombstones=model.drop_tombstones,
             )
         )(
-            flat["key_words_be"], flat["key_words_le"], flat["key_len"],
+            flat["key_words_be"], flat["key_len"],
             flat["seq_hi"], flat["seq_lo"], flat["vtype"],
             flat["val_words"], flat["val_len"], valid2,
         ))
@@ -175,7 +178,7 @@ def sharded_compaction_step(mesh, model=None):
     step = shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(in_spec,) * 9,
+        in_specs=(in_spec,) * 8,
         out_specs=(
             {k: P("shard", None) for k in (
                 "key_words_be", "key_words_le", "key_len", "seq_hi",

@@ -280,7 +280,7 @@ class TpuCompactionBackend(CompactionBackend):
         uniform_klen, seq32, key_words = fast_flags(
             batch.key_len, batch.seq_hi, batch.valid)
         out = merge_resolve_kernel(
-            jnp.asarray(batch.key_words_be), jnp.asarray(batch.key_words_le),
+            jnp.asarray(batch.key_words_be),
             jnp.asarray(batch.key_len), jnp.asarray(batch.seq_hi),
             jnp.asarray(batch.seq_lo), jnp.asarray(batch.vtype),
             jnp.asarray(batch.val_words), jnp.asarray(batch.val_len),

@@ -33,6 +33,10 @@ FIELDS = (
     "key_words_be", "key_words_le", "key_len", "seq_hi", "seq_lo",
     "vtype", "val_words", "val_len",
 )
+# kernel INPUT lanes: LE key words are byteswap-derived on device, so they
+# are carried between passes (FIELDS — outputs include them for the sinks)
+# but never shipped into a launch
+INPUT_FIELDS = tuple(f for f in FIELDS if f != "key_words_le")
 
 
 def run_kernel_arrays(
@@ -62,7 +66,7 @@ def run_kernel_arrays(
     kw = (key_words if key_words is not None
           else batch_arrays["key_words_be"].shape[1])
     out = merge_resolve_kernel(
-        *(jnp.asarray(batch_arrays[f]) for f in FIELDS),
+        *(jnp.asarray(batch_arrays[f]) for f in INPUT_FIELDS),
         jnp.asarray(valid),
         merge_kind=merge_kind, drop_tombstones=drop_tombstones,
         uniform_klen=uniform_klen, seq32=seq32, key_words=kw,

@@ -77,9 +77,9 @@ class TpuCompactionService:
         if fn is None:
             jax = self._jax
 
-            def one_shard(kwbe, kwle, klen, shi, slo, vt, vw, vl, valid):
+            def one_shard(kwbe, klen, shi, slo, vt, vw, vl, valid):
                 out = merge_resolve_kernel(
-                    kwbe, kwle, klen, shi, slo, vt, vw, vl, valid,
+                    kwbe, klen, shi, slo, vt, vw, vl, valid,
                     merge_kind=merge_kind, drop_tombstones=drop_tombstones,
                     uniform_klen=uniform_klen, seq32=seq32,
                     key_words=key_words,
@@ -117,7 +117,7 @@ class TpuCompactionService:
                 _pad_to(getattr(b, name), capacity) for b in batches
             ]))
             for name in (
-                "key_words_be", "key_words_le", "key_len", "seq_hi",
+                "key_words_be", "key_len", "seq_hi",
                 "seq_lo", "vtype", "val_words", "val_len", "valid",
             )
         }
@@ -128,7 +128,7 @@ class TpuCompactionService:
         fn = self._pipeline(merge_kind, drop_tombstones, num_words,
                             uniform_klen, seq32, key_words)
         out = fn(
-            stacked["key_words_be"], stacked["key_words_le"],
+            stacked["key_words_be"],
             stacked["key_len"], stacked["seq_hi"], stacked["seq_lo"],
             stacked["vtype"], stacked["val_words"], stacked["val_len"],
             stacked["valid"],
@@ -180,7 +180,7 @@ class TpuCompactionService:
         fn = self._pipeline(merge_kind, drop_tombstones, num_words,
                             uniform_klen, seq32, key_words)
         names = (
-            "key_words_be", "key_words_le", "key_len", "seq_hi",
+            "key_words_be", "key_len", "seq_hi",
             "seq_lo", "vtype", "val_words", "val_len", "valid",
         )
 

@@ -34,7 +34,7 @@ def run_kernel(entries, merge_kind=MergeKind.UINT64_ADD, drop_tombstones=True,
                capacity=None):
     batch = pack_entries(entries, capacity=capacity)
     out = merge_resolve_kernel(
-        jnp.asarray(batch.key_words_be), jnp.asarray(batch.key_words_le),
+        jnp.asarray(batch.key_words_be),
         jnp.asarray(batch.key_len), jnp.asarray(batch.seq_hi),
         jnp.asarray(batch.seq_lo), jnp.asarray(batch.vtype),
         jnp.asarray(batch.val_words), jnp.asarray(batch.val_len),
@@ -218,7 +218,7 @@ def test_kernel_flags_oversize_merge_group():
     n = 1 << 17
     entries_kw = np.zeros((n, 6), dtype=np.uint32)  # all same key
     out = merge_resolve_kernel(
-        jnp.asarray(entries_kw), jnp.asarray(entries_kw),
+        jnp.asarray(entries_kw),
         jnp.full(n, 8, jnp.uint32),
         jnp.zeros(n, jnp.uint32), jnp.asarray(np.arange(n, dtype=np.uint32)),
         jnp.full(n, 3, jnp.uint32),  # all MERGE
@@ -262,7 +262,7 @@ def test_fast_flags_variants_match_baseline():
 
     def run(uniform_klen, seq32, key_words=6):
         out = merge_resolve_kernel(
-            jnp.asarray(batch.key_words_be), jnp.asarray(batch.key_words_le),
+            jnp.asarray(batch.key_words_be),
             jnp.asarray(batch.key_len), jnp.asarray(batch.seq_hi),
             jnp.asarray(batch.seq_lo), jnp.asarray(batch.vtype),
             jnp.asarray(batch.val_words), jnp.asarray(batch.val_len),

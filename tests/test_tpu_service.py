@@ -204,7 +204,7 @@ def test_sharded_step_matches_single_device(block):
                                  entries_per_block=128, model=model)
     out_final, bloom, counts, global_count, needs_fallback = step(
         *(jnp.asarray(arrays[k]) for k in (
-            "key_words_be", "key_words_le", "key_len", "seq_hi", "seq_lo",
+            "key_words_be", "key_len", "seq_hi", "seq_lo",
             "vtype", "val_words", "val_len", "valid"))
     )
     # reference: single-device merge over each shard's concatenated blocks
@@ -218,7 +218,6 @@ def test_sharded_step_matches_single_device(block):
         }
         ref = merge_resolve_kernel(
             jnp.asarray(concat["key_words_be"]),
-            jnp.asarray(concat["key_words_le"]),
             jnp.asarray(concat["key_len"]), jnp.asarray(concat["seq_hi"]),
             jnp.asarray(concat["seq_lo"]), jnp.asarray(concat["vtype"]),
             jnp.asarray(concat["val_words"]), jnp.asarray(concat["val_len"]),
@@ -297,7 +296,7 @@ def test_chunked_merge_matches_single_shot():
         all_entries = [e for r in runs for e in r]
         big = pack_entries(all_entries)
         ref = merge_resolve_kernel(
-            jnp.asarray(big.key_words_be), jnp.asarray(big.key_words_le),
+            jnp.asarray(big.key_words_be),
             jnp.asarray(big.key_len), jnp.asarray(big.seq_hi),
             jnp.asarray(big.seq_lo), jnp.asarray(big.vtype),
             jnp.asarray(big.val_words), jnp.asarray(big.val_len),
