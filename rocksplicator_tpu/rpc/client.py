@@ -60,6 +60,21 @@ class RpcClient:
             # funnel into RpcConnectionError too)
             self.is_good = False
             raise RpcConnectionError(f"connect {self.host}:{self.port}: {e}") from e
+        if self._ssl_manager is not None:
+            # role binding: the peer must hold a SERVER cert — CA
+            # membership alone would let any cluster client cert
+            # impersonate a server (utils/ssl_context_manager)
+            from ..utils.ssl_context_manager import (
+                PeerRoleError, check_peer_role)
+
+            try:
+                check_peer_role(
+                    self._writer.get_extra_info("ssl_object"), "server")
+            except PeerRoleError as e:
+                self._writer.close()
+                self.is_good = False
+                raise RpcConnectionError(
+                    f"connect {self.host}:{self.port}: {e}") from e
         self.is_good = True
         self._recv_task = asyncio.ensure_future(self._recv_loop())
 

@@ -25,8 +25,13 @@ class RpcClientPool:
         self._connect_timeout = connect_timeout
         self._locks: Dict[Tuple[str, int], asyncio.Lock] = {}
         # client-side SslContextManager: enables TLS (and presents the
-        # client cert for mutual-TLS auth) on every pooled connection
+        # client cert for mutual-TLS auth) on every pooled connection.
+        # The pool claims the manager's background refresh thread so that
+        # get() on the event loop never does cert-file IO inline.
         self._ssl_manager = ssl_manager
+        self._ssl_claimed = ssl_manager is not None
+        if ssl_manager is not None:
+            ssl_manager.ensure_auto_refresh()
 
     async def get_client(self, host: str, port: int) -> RpcClient:
         addr = (host, port)
@@ -70,3 +75,7 @@ class RpcClientPool:
         for client in list(self._clients.values()):
             await client.close()
         self._clients.clear()
+        if self._ssl_manager is not None and self._ssl_claimed:
+            # claim released exactly once even if close() is called again
+            self._ssl_claimed = False
+            self._ssl_manager.release_auto_refresh()

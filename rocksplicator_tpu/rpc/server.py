@@ -45,8 +45,12 @@ class RpcServer:
         self._draining = False
         # TLS: an SslContextManager (utils/ssl_context_manager) — the
         # SAME context object is handed to asyncio once; cert refreshes
-        # reload into it, so new handshakes pick up rotated certs
+        # reload into it, so new handshakes pick up rotated certs.
+        # _ssl_claimed tracks whether THIS server currently holds a
+        # refresh-thread claim (managers are shared; an unpaired stop()
+        # must not release someone else's claim).
         self._ssl_manager = ssl_manager
+        self._ssl_claimed = False
 
     def add_handler(self, handler: object) -> None:
         self._handlers.append(handler)
@@ -66,12 +70,16 @@ class RpcServer:
         ssl_ctx = None
         if self._ssl_manager is not None:
             ssl_ctx = self._ssl_manager.get()
-            # servers call get() only here; the background thread keeps
-            # rotated certs flowing into the pinned context
-            self._ssl_manager.ensure_auto_refresh()
         self._server = await asyncio.start_server(
             self._on_connection, self._host, self._port, ssl=ssl_ctx,
         )
+        if self._ssl_manager is not None and not self._ssl_claimed:
+            # claim the refresh thread only for a server that actually
+            # bound (a failed bind has no stop() to pair the release);
+            # the background thread keeps rotated certs flowing into the
+            # pinned context — servers call get() only at bind time
+            self._ssl_manager.ensure_auto_refresh()
+            self._ssl_claimed = True
         self._port = self._server.sockets[0].getsockname()[1]
         self._ready.set()
 
@@ -86,6 +94,14 @@ class RpcServer:
             )
         except Exception:
             pass
+        if self._ssl_manager is not None and self._ssl_claimed:
+            # drop this server's claim on the refresh thread (refcounted:
+            # the manager may be shared with other servers/pools; the
+            # thread stops when the last user releases). Only if THIS
+            # server holds a claim — double stop() or stop() without
+            # start() must not release someone else's.
+            self._ssl_claimed = False
+            self._ssl_manager.release_auto_refresh()
 
     async def _stop_async(self, drain_timeout: float = 0.0) -> None:
         # Stop accepting new connections AND new work: frames arriving on
@@ -118,6 +134,19 @@ class RpcServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         task = asyncio.current_task()
+        if self._ssl_manager is not None:
+            # role binding: a connecting peer presenting a cert must hold
+            # a CLIENT cert (utils/ssl_context_manager.check_peer_role)
+            from ..utils.ssl_context_manager import (
+                PeerRoleError, check_peer_role)
+
+            try:
+                check_peer_role(
+                    writer.get_extra_info("ssl_object"), "client")
+            except PeerRoleError as e:
+                log.warning("rejecting connection: %s", e)
+                writer.close()
+                return
         frame_reader = FrameReader(reader)
         write_lock = asyncio.Lock()
         inflight: set = set()

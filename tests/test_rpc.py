@@ -670,6 +670,157 @@ def test_tls_server_requires_client_cert(tls_certs, tmp_path):
         server.stop()
 
 
+def test_tls_role_binding_rejects_swapped_certs(tls_certs):
+    """EKU role binding: CA membership alone must not authenticate a
+    role. A server presenting a CLIENT cert is rejected by connecting
+    clients; a client presenting a SERVER cert is rejected by the
+    server (utils/ssl_context_manager.check_peer_role)."""
+    from rocksplicator_tpu.rpc import IoLoop, RpcClientPool, RpcServer
+    from rocksplicator_tpu.rpc.errors import RpcConnectionError, RpcError
+    from rocksplicator_tpu.utils.ssl_context_manager import SslContextManager
+
+    ioloop = IoLoop.default()
+
+    async def go(pool, port):
+        return await pool.call("127.0.0.1", port, "echo", {}, timeout=3)
+
+    # case 1: server wearing the CLIENT cert — client must refuse it
+    impostor_mgr = SslContextManager(
+        tls_certs["client_cert"], tls_certs["client_key"],
+        ca_path=tls_certs["ca_cert"], server_side=True,
+    )
+    server = RpcServer(port=0, ssl_manager=impostor_mgr)
+    server.add_handler(EchoHandler())
+    server.start()
+    _, client_mgr = _managers(tls_certs)
+    pool = RpcClientPool(ssl_manager=client_mgr)
+    try:
+        with pytest.raises((RpcError, RpcConnectionError)):
+            ioloop.run_sync(go(pool, server.port), timeout=10)
+    finally:
+        ioloop.run_sync(pool.close())
+        server.stop()
+
+    # case 2: client wearing the SERVER cert — server must refuse it
+    server_mgr, _ = _managers(tls_certs)
+    server2 = RpcServer(port=0, ssl_manager=server_mgr)
+    server2.add_handler(EchoHandler())
+    server2.start()
+    swapped_mgr = SslContextManager(
+        tls_certs["server_cert"], tls_certs["server_key"],
+        ca_path=tls_certs["ca_cert"], server_side=False,
+    )
+    pool2 = RpcClientPool(ssl_manager=swapped_mgr)
+    try:
+        with pytest.raises((RpcError, RpcConnectionError)):
+            ioloop.run_sync(go(pool2, server2.port), timeout=10)
+    finally:
+        ioloop.run_sync(pool2.close())
+        server2.stop()
+
+
+def test_check_peer_role_reads_eku_from_der(tls_certs):
+    """check_peer_role must actually parse the EKU (ssl's dict-form
+    getpeercert() does not expose it) — exercised directly with a stub
+    ssl_object so the check can't silently regress into a no-op that
+    only passes because OpenSSL's handshake happened to reject first."""
+    from rocksplicator_tpu.utils.ssl_context_manager import (
+        PeerRoleError, check_peer_role)
+
+    import ssl as ssl_mod
+
+    class StubContext:
+        verify_mode = ssl_mod.CERT_REQUIRED
+
+    class StubSslObject:
+        context = StubContext()
+
+        def __init__(self, pem_path):
+            from cryptography import x509
+            from cryptography.hazmat.primitives.serialization import Encoding
+
+            with open(pem_path, "rb") as f:
+                cert = x509.load_pem_x509_certificate(f.read())
+            self._der = cert.public_bytes(Encoding.DER)
+
+        def getpeercert(self, binary_form=False):
+            assert binary_form, "role check must request the DER form"
+            return self._der
+
+    # right roles pass
+    check_peer_role(StubSslObject(tls_certs["server_cert"]), "server")
+    check_peer_role(StubSslObject(tls_certs["client_cert"]), "client")
+    # swapped roles raise
+    with pytest.raises(PeerRoleError):
+        check_peer_role(StubSslObject(tls_certs["client_cert"]), "server")
+    with pytest.raises(PeerRoleError):
+        check_peer_role(StubSslObject(tls_certs["server_cert"]), "client")
+    # CA cert (no EKU) passes either role — externally-provisioned certs
+    check_peer_role(StubSslObject(tls_certs["ca_cert"]), "server")
+
+
+def test_tls_release_unpaired_stop_keeps_shared_thread(tls_certs):
+    """Double stop() / stop()-without-start must not release another
+    holder's refresh-thread claim."""
+    import threading
+
+    from rocksplicator_tpu.rpc import RpcServer
+    from rocksplicator_tpu.utils.ssl_context_manager import SslContextManager
+
+    def refresh_threads():
+        return sum(1 for t in threading.enumerate()
+                   if t.name == "ssl-refresh" and t.is_alive())
+
+    base = refresh_threads()
+    mgr = SslContextManager(
+        tls_certs["server_cert"], tls_certs["server_key"],
+        ca_path=tls_certs["ca_cert"], server_side=True,
+        refresh_interval=30.0,
+    )
+    holder = RpcServer(port=0, ssl_manager=mgr)
+    holder.add_handler(EchoHandler())
+    holder.start()
+    assert refresh_threads() == base + 1
+    # a server that never started: its stop() must not steal the claim
+    never_started = RpcServer(port=0, ssl_manager=mgr)
+    never_started.stop()
+    assert refresh_threads() == base + 1
+    holder.stop()
+    holder.stop()  # double stop: second release is a no-op
+    assert refresh_threads() == base
+
+
+def test_tls_refresh_thread_refcounted_across_servers(tls_certs):
+    """A shared SslContextManager's refresh thread survives one server's
+    stop and is reaped when the LAST user releases it."""
+    import threading
+
+    from rocksplicator_tpu.rpc import RpcServer
+    from rocksplicator_tpu.utils.ssl_context_manager import SslContextManager
+
+    def refresh_threads():
+        return sum(1 for t in threading.enumerate()
+                   if t.name == "ssl-refresh" and t.is_alive())
+
+    base = refresh_threads()
+    mgr = SslContextManager(
+        tls_certs["server_cert"], tls_certs["server_key"],
+        ca_path=tls_certs["ca_cert"], server_side=True,
+        refresh_interval=30.0,
+    )
+    a = RpcServer(port=0, ssl_manager=mgr)
+    b = RpcServer(port=0, ssl_manager=mgr)
+    a.add_handler(EchoHandler())
+    b.add_handler(EchoHandler())
+    a.start()
+    b.start()
+    assert refresh_threads() == base + 1  # one shared thread
+    a.stop()
+    assert refresh_threads() == base + 1  # b still needs it
+    b.stop()
+    assert refresh_threads() == base  # last user out: reaped
+
+
 def test_tls_context_refresh_picks_up_rotated_certs(tls_certs, tmp_path):
     """Rotating cert files and force_refresh()ing must keep new
     handshakes working (the refreshable-context contract)."""
