@@ -517,6 +517,16 @@ def test_status_server_endpoints():
         assert info == "dbs=0\n"
         threads_txt = urllib.request.urlopen(base + "/threads.txt").read().decode()
         assert "thread" in threads_txt
+        # /dump_heap is two-phase: first hit arms tracemalloc, second
+        # reports top allocation sites and stops tracing
+        armed = urllib.request.urlopen(base + "/dump_heap").read().decode()
+        assert "started" in armed
+        _garbage = [bytearray(4096) for _ in range(64)]
+        report = urllib.request.urlopen(base + "/dump_heap").read().decode()
+        assert "allocation sites by size" in report and "B " in report
+        import tracemalloc
+
+        assert not tracemalloc.is_tracing()
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(base + "/nope")
     finally:
