@@ -34,7 +34,17 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+# CPU baselines run at BASE shards; the TPU phase then CLIMBS the batch
+# size (8 -> 16 -> 32 shards) to amortize the ~23 ms per-dispatch floor,
+# keeping the best completed number. Each climb step costs a fresh XLA
+# compile, which on the shared pool can take minutes — so the climb stops
+# once BENCH_TIME_BUDGET is spent, and an atexit hook prints the
+# best-so-far JSON even if the driver's timeout TERMs a hung attempt.
 SHARDS = int(os.environ.get("BENCH_SHARDS", "8"))
+CLIMB_SHARDS = tuple(
+    int(s) for s in os.environ.get("BENCH_CLIMB", "8,16,32").split(",") if s
+)
+TIME_BUDGET = float(os.environ.get("BENCH_TIME_BUDGET", "420"))
 ENTRIES = int(os.environ.get("BENCH_ENTRIES", str(1 << 17)))
 ITERS = int(os.environ.get("BENCH_ITERS", "10"))
 KEY_BYTES = 16
@@ -104,21 +114,49 @@ def _model_args(dev):
     )
 
 
-def bench_tpu(stacked):
-    """Returns (kernel_gbps, transfer_inclusive_gbps)."""
-    import jax
-    import jax.numpy as jnp
-
+def _make_model():
     from rocksplicator_tpu.models import CompactionModel
 
     # 16-byte keys + 32-bit seqs: reduced-key sort (_sort_merge_order);
     # emit_rows adds on-device SST block encoding to the measured pipeline
-    model = CompactionModel(capacity=ENTRIES, uniform_klen=True, seq32=True,
-                            key_words=KEY_BYTES // 4, emit_rows=True,
-                            row_klen=KEY_BYTES, row_vlen=VAL_BYTES)
+    return CompactionModel(capacity=ENTRIES, uniform_klen=True, seq32=True,
+                           key_words=KEY_BYTES // 4, emit_rows=True,
+                           row_klen=KEY_BYTES, row_vlen=VAL_BYTES)
+
+
+def bench_tpu_kernel(shards) -> float:
+    """Kernel-only GB/s at one batch size. Inputs are GENERATED ON
+    DEVICE (same distribution as the host generator, jax PRNG): the
+    tunnel moves ~30 MB/s, so shipping a 32-shard batch (222 MB of
+    lanes) would take minutes and says nothing about the kernel.
+    Host↔device costs are measured by bench_tpu_transfer."""
+    import jax
+    import jax.numpy as jnp
+
+    from rocksplicator_tpu.models.compaction_model import (
+        synth_counter_batch_jax)
+
+    total_bytes = shards * ENTRIES * ENTRY_BYTES
+    model = _make_model()
     fwd = jax.jit(jax.vmap(model.forward))
-    log(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}")
-    dev = {k: jnp.asarray(v) for k, v in stacked.items()}
+
+    def gen_all():
+        batches = [
+            synth_counter_batch_jax(
+                ENTRIES, key_space=ENTRIES // 8, seed=1234 + s,
+                key_bytes=KEY_BYTES)
+            for s in range(shards)
+        ]
+        return {
+            k: jnp.stack([b[k] for b in batches])
+            for k in batches[0]
+        }
+
+    t0 = time.monotonic()
+    dev = jax.jit(gen_all)()
+    jax.block_until_ready(dev)
+    log(f"on-device input gen dispatched: {time.monotonic() - t0:.1f}s "
+        f"({shards} shards x {ENTRIES})")
     args = _model_args(dev)
     t0 = time.monotonic()
     out = fwd(*args)
@@ -135,38 +173,50 @@ def bench_tpu(stacked):
         out = fwd(*args)
     jax.block_until_ready(out)
     dt = (time.monotonic() - t0) / ITERS
-    gbps = TOTAL_BYTES / dt / 1e9
-    log(f"tpu kernel: {dt * 1e3:.1f} ms/iter over {TOTAL_BYTES / 1e6:.0f} MB "
-        f"=> {gbps:.2f} GB/s")
+    gbps = total_bytes / dt / 1e9
+    log(f"tpu kernel [{shards} shards]: {dt * 1e3:.1f} ms/iter over "
+        f"{total_bytes / 1e6:.0f} MB => {gbps:.2f} GB/s")
+    return gbps
 
-    # transfer-inclusive, double-buffered: shards stream H2D in per-shard
-    # slices while the previous slice's kernel runs (device_put and
-    # dispatch are async — block only at the end of the pipeline).
+
+def bench_tpu_transfer(stacked, kernel_gbps: float) -> float:
+    """Transfer-inclusive GB/s: per-shard slices stream H2D
+    double-buffered while the previous slice's kernel runs (device_put
+    and dispatch are async — block only at the end of the pipeline).
+    Runs at 8 shards: this phase measures host→device streaming, which
+    the tunnel bandwidth bounds regardless of batch size."""
+    import jax
+    import jax.numpy as jnp
+
+    xfer_shards = min(len(stacked["key_len"]), 8)
+    model = _make_model()
     fwd1 = jax.jit(model.forward)  # per-shard launch for the pipeline
     host_shards = [
         {k: np.ascontiguousarray(v[s]) for k, v in stacked.items()}
-        for s in range(SHARDS)
+        for s in range(xfer_shards)
     ]
     # warm up the per-shard compile outside the timed loop
     w = {k: jnp.asarray(v) for k, v in host_shards[0].items()}
     jax.block_until_ready(fwd1(*_model_args(w)))
     reps = max(1, ITERS // 3)
+    xfer_bytes = xfer_shards * ENTRIES * ENTRY_BYTES
     t0 = time.monotonic()
     for _ in range(reps):
         outs = []
         nxt = {k: jax.device_put(v) for k, v in host_shards[0].items()}
-        for s in range(SHARDS):
+        for s in range(xfer_shards):
             cur = nxt
-            if s + 1 < SHARDS:  # prefetch next shard while this one runs
+            if s + 1 < xfer_shards:  # prefetch next shard while this runs
                 nxt = {k: jax.device_put(v)
                        for k, v in host_shards[s + 1].items()}
             outs.append(fwd1(*_model_args(cur)))
         jax.block_until_ready(outs)
     dt_x = (time.monotonic() - t0) / reps
-    gbps_x = TOTAL_BYTES / dt_x / 1e9
-    log(f"tpu transfer-inclusive (double-buffered): {dt_x * 1e3:.1f} ms/iter "
-        f"=> {gbps_x:.2f} GB/s  (ratio {dt_x / dt:.2f}x kernel-only)")
-    return gbps, gbps_x
+    gbps_x = xfer_bytes / dt_x / 1e9
+    log(f"tpu transfer-inclusive (double-buffered, {xfer_shards} shards): "
+        f"{dt_x * 1e3:.1f} ms/iter => {gbps_x:.2f} GB/s  "
+        f"({kernel_gbps / gbps_x:.1f}x slower than kernel-only per byte)")
+    return gbps_x
 
 
 def _shard_batch(stacked, s):
@@ -292,12 +342,16 @@ def bench_python(stacked):
     return gbps
 
 
-def measure_write_stall_p99() -> float:
+def measure_write_stall_p99():
     """BASELINE target: write-stall p99 < 10 ms under a compaction storm.
-    Runs a quick storm against the real engine and reads the
-    storage.write_stall_ms histogram."""
+    Runs a concurrent-writer storm against the real engine (tiny
+    memtables + aggressive L0 trigger keep flush and compaction
+    saturated) and reads the storage.write_stall_ms histogram. Returns
+    (p99_ms, samples) — zero samples is itself the result: the engine's
+    flush/compaction threads kept up and no writer ever stalled."""
     import shutil
     import tempfile
+    import threading
 
     from rocksplicator_tpu.storage.engine import DB, DBOptions
     from rocksplicator_tpu.utils.stats import Stats
@@ -306,25 +360,64 @@ def measure_write_stall_p99() -> float:
     d = tempfile.mkdtemp(prefix="rstpu-bench-stall-")
     try:
         opts = DBOptions(
-            memtable_bytes=64 << 10,  # tiny memtables force flush/compaction
+            memtable_bytes=32 << 10,  # tiny memtables force flush/compaction
             level0_compaction_trigger=2,
         )
         db = DB(os.path.join(d, "db"), opts)
-        val = b"v" * 64
-        for i in range(20000):
-            db.put(f"k{i % 4096:08d}".encode(), val)
+        val = b"v" * 512
+
+        def writer(tid: int) -> None:
+            for i in range(6000):
+                db.put(f"t{tid}k{i % 2048:08d}".encode(), val)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
         db.close()
         stats = Stats.get()
         p99 = stats.metric_percentile("storage.write_stall_ms", 99)
         n = stats.metric_count("storage.write_stall_ms")
         log(f"write-stall p99 under storm: {p99:.2f} ms (samples={n})")
-        return round(p99, 3)
+        return round(p99, 3), n
     finally:
         shutil.rmtree(d, ignore_errors=True)
 
 
+# Best-so-far result shared with the SIGTERM handler: the batch-size
+# climb can hit a minutes-long pool-side compile, and the driver's
+# timeout must still receive a complete JSON line for the work that DID
+# finish. Emission happens exactly once.
+_RESULT = {"emitted": False, "data": None}
+
+
+def _emit_result() -> None:
+    if _RESULT["data"] is not None and not _RESULT["emitted"]:
+        _RESULT["emitted"] = True
+        print(json.dumps(_RESULT["data"]), flush=True)
+
+
+def _install_term_handler() -> None:
+    import atexit
+    import signal
+
+    def on_term(signum, frame):
+        log("SIGTERM: emitting best-so-far result")
+        _emit_result()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, on_term)
+    # unhandled exceptions / normal exits also emit whatever is recorded
+    atexit.register(_emit_result)
+
+
 def main():
-    log(f"bench config: shards={SHARDS} entries/shard={ENTRIES} iters={ITERS}")
+    log(f"bench config: shards={SHARDS} entries/shard={ENTRIES} "
+        f"iters={ITERS} climb={CLIMB_SHARDS} budget={TIME_BUDGET}s")
+    _install_term_handler()
+    start = time.monotonic()
     wd = _start_device_watchdog()  # overlaps with input construction
     stacked = build_inputs()
     device_ok = _join_device_watchdog(
@@ -348,7 +441,40 @@ def main():
         mp_gbps, cores, workers = None, len(os.sched_getaffinity(0)), 1
     import jax
 
-    tpu_gbps, tpu_xfer_gbps = bench_tpu(stacked)
+    log(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}")
+
+    def record(tpu_gbps, tpu_shards, tpu_xfer_gbps):
+        """Fold the current best TPU numbers + all host numbers into the
+        emit-on-exit result."""
+        _RESULT["data"] = {
+            "metric": "shard_batched_compaction_throughput",
+            "value": round(tpu_gbps, 3),
+            "unit": "GB/s",
+            "vs_baseline": round(tpu_gbps / cpu32_gbps, 3)
+            if cpu32_gbps else 0.0,
+            # machine consumers must tell a degraded run apart
+            "platform": jax.default_backend(),
+            "degraded_no_accelerator": not device_ok,
+            "tpu_shards": tpu_shards,
+            "entries_per_shard": ENTRIES,
+            "transfer_inclusive_gbps": round(tpu_xfer_gbps, 3)
+            if tpu_xfer_gbps else None,
+            "cpu_single_core_gbps": round(single_best, 3),
+            "cpu_multiproc_gbps": round(mp_gbps, 3) if mp_gbps else None,
+            "cpu_cores_available": cores,
+            "cpu_32core_baseline_gbps": round(cpu32_gbps, 3),
+            "cpu_32core_baseline_kind": cpu32_kind,
+            "vs_single_core": round(tpu_gbps / single_best, 2)
+            if single_best else 0.0,
+            "write_stall_p99_ms": stall_p99,
+            # 0 samples: no writer ever stalled during the storm — the
+            # target holds trivially; consumers can see the distinction
+            "write_stall_samples": stall_samples,
+        }
+
+    # Host-side numbers FIRST: they are cheap and every later phase
+    # (including a hung first compile killed by the driver's timeout)
+    # must be able to emit a complete JSON line around them.
     single_gbps = bench_numpy_single(stacked)
     py_gbps = bench_python(stacked)
     single_best = max(single_gbps, py_gbps)
@@ -365,28 +491,55 @@ def main():
             cpu32_gbps = max(cpu32_gbps, mp_gbps)
     log(f"cpu 32-core baseline ({cpu32_kind}): {cpu32_gbps:.3f} GB/s")
     try:
-        stall_p99 = measure_write_stall_p99()
+        stall_p99, stall_samples = measure_write_stall_p99()
     except Exception as e:  # never let the stall probe kill the bench
         log(f"write-stall probe failed: {e!r}")
-        stall_p99 = None
-    result = {
-        "metric": "shard_batched_compaction_throughput",
-        "value": round(tpu_gbps, 3),
-        "unit": "GB/s",
-        "vs_baseline": round(tpu_gbps / cpu32_gbps, 3) if cpu32_gbps else 0.0,
-        # machine consumers must be able to tell a degraded run apart
-        "platform": jax.default_backend(),
-        "degraded_no_accelerator": not device_ok,
-        "transfer_inclusive_gbps": round(tpu_xfer_gbps, 3),
-        "cpu_single_core_gbps": round(single_best, 3),
-        "cpu_multiproc_gbps": round(mp_gbps, 3) if mp_gbps else None,
-        "cpu_cores_available": cores,
-        "cpu_32core_baseline_gbps": round(cpu32_gbps, 3),
-        "cpu_32core_baseline_kind": cpu32_kind,
-        "vs_single_core": round(tpu_gbps / single_best, 2) if single_best else 0.0,
-        "write_stall_p99_ms": stall_p99,
-    }
-    print(json.dumps(result), flush=True)
+        stall_p99, stall_samples = None, None
+    # placeholder so a TERM/crash during the first (riskiest) TPU compile
+    # still emits a complete, clearly-incomplete-TPU result
+    record(0.0, 0, None)
+    _RESULT["data"]["tpu_phase_incomplete"] = True
+
+    # first climb step: the guaranteed real-TPU number
+    first = CLIMB_SHARDS[0] if CLIMB_SHARDS else SHARDS
+    try:
+        tpu_gbps = bench_tpu_kernel(first)
+        tpu_shards = first
+    except Exception as e:
+        log(f"tpu kernel bench at {first} shards failed: {e!r}")
+        _emit_result()  # the placeholder, marked incomplete
+        return
+    record(tpu_gbps, tpu_shards, None)
+
+    # transfer-inclusive phase (8 shards, tunnel-bound)
+    tpu_xfer_gbps = None
+    try:
+        tpu_xfer_gbps = bench_tpu_transfer(stacked, tpu_gbps)
+    except Exception as e:
+        log(f"transfer-inclusive phase failed: {e!r}")
+    record(tpu_gbps, tpu_shards, tpu_xfer_gbps)
+
+    # climb: larger batches amortize the per-dispatch floor. Each step
+    # costs a fresh compile (minutes on a contended pool), so stop
+    # climbing once the budget is spent; SIGTERM mid-step still emits.
+    # A degraded (CPU-fallback) run skips the climb: its number is only
+    # ever consumed as a labeled-degraded value.
+    for shards in (CLIMB_SHARDS[1:] if device_ok else ()):
+        elapsed = time.monotonic() - start
+        if elapsed > TIME_BUDGET:
+            log(f"climb stopped at {tpu_shards} shards "
+                f"({elapsed:.0f}s > {TIME_BUDGET:.0f}s budget)")
+            break
+        try:
+            g = bench_tpu_kernel(shards)
+        except Exception as e:
+            log(f"climb step {shards} shards failed: {e!r}")
+            break
+        if g > tpu_gbps:
+            tpu_gbps, tpu_shards = g, shards
+            record(tpu_gbps, tpu_shards, tpu_xfer_gbps)
+
+    _emit_result()
 
 
 if __name__ == "__main__":

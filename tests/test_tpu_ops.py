@@ -300,3 +300,35 @@ def test_fast_flags_negative_cases():
     uk2, s32_2, _ = fast_flags(big_seq.key_len, big_seq.seq_hi, big_seq.valid)
     assert s32_2 is False
     assert uk2 is True
+
+
+def test_synth_counter_batch_jax_matches_numpy_contract():
+    """The device-side input generator must produce the same lane
+    shapes/dtypes and distribution as the numpy generator (the bench
+    compares throughput across the two — distribution-matched data)."""
+    import jax
+
+    from rocksplicator_tpu.models.compaction_model import (
+        synth_counter_batch, synth_counter_batch_jax)
+
+    n = 4096
+    ref = synth_counter_batch(n, seed=7)
+    got = {k: np.asarray(v)
+           for k, v in jax.jit(
+               lambda: synth_counter_batch_jax(n, seed=7))().items()}
+    assert set(got) == set(ref)
+    for k in ref:
+        assert got[k].shape == ref[k].shape, k
+        assert got[k].dtype == ref[k].dtype, k
+    # LE lanes really are byteswaps of the BE lanes over the same bytes
+    kb = np.ascontiguousarray(got["key_words_be"].astype(">u4")).view(np.uint8)
+    assert (kb.reshape(n, 24).view("<u4") == got["key_words_le"]).all()
+    # distribution: vtype mix within a few percent of the configured fracs
+    frac_merge = (got["vtype"] == 3).mean()
+    frac_del = (got["vtype"] == 2).mean()
+    assert abs(frac_merge - 0.6) < 0.05 and abs(frac_del - 0.05) < 0.02
+    # key ids live in the first 8 BE bytes within key_space
+    assert (got["key_words_be"][:, 0] == 0).all()
+    assert got["key_words_be"][:, 1].max() < n // 8
+    assert (got["val_len"] == np.where(got["vtype"] == 2, 0, 8)).all()
+    assert got["valid"].all()
