@@ -386,6 +386,63 @@ def measure_write_stall_p99():
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _tpu_phase_child(phase: str, shards: int, kernel_gbps: float, q):
+    """One TPU phase in a SPAWNED CHILD. The parent never initializes an
+    accelerator backend: a pool-side XLA compile can hang for minutes
+    inside one C call, and CPython only delivers signal handlers between
+    bytecodes — a parent compiling inline could never run its SIGTERM
+    best-so-far emitter (the exact scenario it exists for). The child
+    hangs instead; the parent stays responsive."""
+    try:
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            import __graft_entry__ as graft
+
+            graft._honor_platform_env()
+        if phase == "kernel":
+            g = bench_tpu_kernel(shards)
+        else:
+            g = bench_tpu_transfer(build_inputs(), kernel_gbps)
+        import jax
+
+        q.put({"ok": True, "gbps": g, "backend": jax.default_backend()})
+    except Exception as e:  # noqa: BLE001 — child reports, parent decides
+        q.put({"ok": False, "err": repr(e)})
+
+
+def _run_tpu_phase(phase: str, shards: int, timeout_sec: float,
+                   kernel_gbps: float = 0.0):
+    """Spawn a TPU phase child and wait in 1s join slices (signal-
+    interruptible). On timeout the child is ABANDONED, not killed:
+    SIGKILLing a process holding a live tunnel session wedges the grant
+    pool-side (round-1 postmortem). Returns the child's result dict or
+    None."""
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_tpu_phase_child,
+                    args=(phase, shards, kernel_gbps, q), daemon=True)
+    p.start()
+    deadline = time.monotonic() + timeout_sec
+    while p.is_alive() and time.monotonic() < deadline:
+        p.join(1.0)
+    if p.is_alive():
+        log(f"tpu phase {phase}@{shards} still running after "
+            f"{timeout_sec:.0f}s — abandoning child pid={p.pid} "
+            f"(not killed: SIGKILL wedges the tunnel grant)")
+        # Truly abandon: multiprocessing's atexit handler TERMINATES any
+        # still-registered daemon child at parent exit — which would be
+        # the abrupt kill-while-holding-a-grant this design avoids.
+        # Deregistering the child leaves it to finish (or hang) on its
+        # own; it is a daemon of init after the parent exits.
+        import multiprocessing.process as _mpp
+
+        _mpp._children.discard(p)
+        return None
+    try:
+        return q.get(timeout=5)
+    except Exception:
+        return None
+
+
 # Best-so-far result shared with the SIGTERM handler: the batch-size
 # climb can hit a minutes-long pool-side compile, and the driver's
 # timeout must still receive a complete JSON line for the work that DID
@@ -425,13 +482,13 @@ def main():
     )
     if not device_ok:
         # Wedged/absent accelerator: force the CPU platform so the run
-        # still completes — and LABEL the result as degraded.
+        # still completes — and LABEL the result as degraded. The env
+        # propagates to the spawned phase children, which call
+        # _honor_platform_env (env alone is not enough: sitecustomize
+        # re-registers the tunnel in every fresh interpreter).
         log("accelerator init timed out — falling back to CPU platform")
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        import __graft_entry__ as graft
-
-        graft._honor_platform_env()
     # CPU parallel baseline first: it forks, which must happen before
     # jax initializes its multithreaded runtime in this process.
     try:
@@ -439,9 +496,9 @@ def main():
     except Exception as e:  # a failed fork must not kill the JSON output
         log(f"cpu multiprocess baseline failed: {e!r}")
         mp_gbps, cores, workers = None, len(os.sched_getaffinity(0)), 1
-    import jax
-
-    log(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}")
+    # The parent NEVER initializes jax (see _tpu_phase_child); the
+    # platform label comes back from the phase children.
+    platform = {"name": "cpu" if not device_ok else "unknown"}
 
     def record(tpu_gbps, tpu_shards, tpu_xfer_gbps):
         """Fold the current best TPU numbers + all host numbers into the
@@ -453,7 +510,7 @@ def main():
             "vs_baseline": round(tpu_gbps / cpu32_gbps, 3)
             if cpu32_gbps else 0.0,
             # machine consumers must tell a degraded run apart
-            "platform": jax.default_backend(),
+            "platform": platform["name"],
             "degraded_no_accelerator": not device_ok,
             "tpu_shards": tpu_shards,
             "entries_per_shard": ENTRIES,
@@ -500,23 +557,30 @@ def main():
     record(0.0, 0, None)
     _RESULT["data"]["tpu_phase_incomplete"] = True
 
+    def budget_left():
+        return max(60.0, TIME_BUDGET - (time.monotonic() - start))
+
     # first climb step: the guaranteed real-TPU number
     first = CLIMB_SHARDS[0] if CLIMB_SHARDS else SHARDS
-    try:
-        tpu_gbps = bench_tpu_kernel(first)
-        tpu_shards = first
-    except Exception as e:
-        log(f"tpu kernel bench at {first} shards failed: {e!r}")
+    res = _run_tpu_phase("kernel", first, budget_left() + 240)
+    if not (res and res.get("ok")):
+        log(f"tpu kernel bench at {first} shards failed: "
+            f"{(res or {}).get('err', 'timeout')}")
         _emit_result()  # the placeholder, marked incomplete
         return
+    tpu_gbps, tpu_shards = res["gbps"], first
+    platform["name"] = res["backend"]
     record(tpu_gbps, tpu_shards, None)
 
     # transfer-inclusive phase (8 shards, tunnel-bound)
     tpu_xfer_gbps = None
-    try:
-        tpu_xfer_gbps = bench_tpu_transfer(stacked, tpu_gbps)
-    except Exception as e:
-        log(f"transfer-inclusive phase failed: {e!r}")
+    res = _run_tpu_phase("transfer", first, budget_left(),
+                         kernel_gbps=tpu_gbps)
+    if res and res.get("ok"):
+        tpu_xfer_gbps = res["gbps"]
+    else:
+        log(f"transfer-inclusive phase failed: "
+            f"{(res or {}).get('err', 'timeout')}")
     record(tpu_gbps, tpu_shards, tpu_xfer_gbps)
 
     # climb: larger batches amortize the per-dispatch floor. Each step
@@ -530,13 +594,13 @@ def main():
             log(f"climb stopped at {tpu_shards} shards "
                 f"({elapsed:.0f}s > {TIME_BUDGET:.0f}s budget)")
             break
-        try:
-            g = bench_tpu_kernel(shards)
-        except Exception as e:
-            log(f"climb step {shards} shards failed: {e!r}")
+        res = _run_tpu_phase("kernel", shards, budget_left())
+        if not (res and res.get("ok")):
+            log(f"climb step {shards} shards failed: "
+                f"{(res or {}).get('err', 'timeout')}")
             break
-        if g > tpu_gbps:
-            tpu_gbps, tpu_shards = g, shards
+        if res["gbps"] > tpu_gbps:
+            tpu_gbps, tpu_shards = res["gbps"], shards
             record(tpu_gbps, tpu_shards, tpu_xfer_gbps)
 
     _emit_result()

@@ -179,13 +179,96 @@ def probe_variants(st, n, iters, results):
         iters, "scatter .at[].set one lane")
 
 
+def probe_mergenet(st, n, iters, results):
+    """Full-sort kernel vs the sorted-runs bitonic merge network at the
+    bench shape. Run pre-sorting happens OUTSIDE the timed region — real
+    compaction inputs (SSTs, memtable dumps) arrive sorted."""
+    import jax.numpy as jnp
+
+    from rocksplicator_tpu.ops.compaction_kernel import (
+        _sort_merge_order, merge_resolve_kernel)
+    from rocksplicator_tpu.ops.merge_network import (
+        merge_resolve_runs_kernel, merge_sorted_lanes)
+
+    margs = (st["key_words_be"], st["key_len"], st["seq_hi"], st["seq_lo"],
+             st["vtype"], st["val_words"], st["val_len"], st["valid"])
+
+    def mrk(*a):
+        return merge_resolve_kernel(
+            *a, uniform_klen=True, seq32=True, key_words=4)
+
+    results["kernel_fullsort"] = timeit(
+        jax.jit(jax.vmap(mrk)), margs, iters,
+        "merge_resolve_kernel (full sort)")
+
+    def presort_runs(runs):
+        """(S, n) shard lanes -> (S, R, L) per-run-sorted lanes."""
+        L = n // runs
+
+        def sort_one(kwb, klen, shi, slo, vt, vw, vl, valid):
+            key_lanes, _, _, slo_s, valid_s, payload = _sort_merge_order(
+                kwb, klen, shi, slo, valid,
+                (vt, vw[:, 0], vw[:, 1], vl),
+                uniform_klen=True, seq32=True, key_words=4)
+            kw6 = jnp.stack(
+                list(key_lanes) + [jnp.zeros_like(slo_s)] * 2, axis=1)
+            # klen/shi come back None from the fast-path sort; rebuild
+            # them as the constants the promises assert so every lane in
+            # the dict is aligned with the sorted row order
+            return {
+                "key_words_be": kw6,
+                "key_len": jnp.full_like(klen, 16),
+                "seq_hi": jnp.zeros_like(shi),
+                "seq_lo": slo_s,
+                "vtype": payload[0],
+                "val_words": jnp.stack(payload[1:3], axis=1),
+                "val_len": payload[3],
+                "valid": valid_s,
+            }
+
+        def shard_to_runs(kwb, klen, shi, slo, vt, vw, vl, valid):
+            rs = (kwb.reshape(runs, L, 6), klen.reshape(runs, L),
+                  shi.reshape(runs, L), slo.reshape(runs, L),
+                  vt.reshape(runs, L), vw.reshape(runs, L, 2),
+                  vl.reshape(runs, L), valid.reshape(runs, L))
+            return jax.vmap(sort_one)(*rs)
+
+        out = jax.jit(jax.vmap(shard_to_runs))(*margs)
+        _readback(out)
+        return out
+
+    for runs in (8, 32):
+        rst = presort_runs(runs)
+        rargs = (rst["key_words_be"], rst["key_len"], rst["seq_hi"],
+                 rst["seq_lo"], rst["vtype"], rst["val_words"],
+                 rst["val_len"], rst["valid"])
+
+        def tree_only(kwb, slo, valid):
+            inval = jnp.where(valid, jnp.uint32(0), jnp.uint32(1))
+            lanes = [inval] + [kwb[:, :, w] for w in range(4)] + [~slo]
+            return merge_sorted_lanes(lanes, 6)
+
+        results[f"mergenet_tree_only_r{runs}"] = timeit(
+            jax.jit(jax.vmap(tree_only)),
+            (rst["key_words_be"], rst["seq_lo"], rst["valid"]),
+            iters, f"merge tree only ({runs} runs, no payload)")
+
+        def mrrk(*a):
+            return merge_resolve_runs_kernel(
+                *a, uniform_klen=True, seq32=True, key_words=4)
+
+        results[f"kernel_mergenet_r{runs}"] = timeit(
+            jax.jit(jax.vmap(mrrk)), rargs, iters,
+            f"merge_resolve_RUNS_kernel ({runs} runs)")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--entries", type=int, default=1 << 17)
     ap.add_argument("--shards", type=int, default=8)
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--set", default="components",
-                    choices=("components", "variants", "all"))
+                    choices=("components", "variants", "mergenet", "all"))
     args = ap.parse_args()
 
     log(f"platform={jax.default_backend()} shards={args.shards} "
@@ -196,6 +279,8 @@ def main():
         probe_components(st, args.entries, args.iters, results)
     if args.set in ("variants", "all"):
         probe_variants(st, args.entries, args.iters, results)
+    if args.set in ("mergenet", "all"):
+        probe_mergenet(st, args.entries, args.iters, results)
     print(json.dumps({k: round(v * 1e3, 2) for k, v in results.items()}))
 
 

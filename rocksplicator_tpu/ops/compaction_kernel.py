@@ -68,6 +68,47 @@ def bswap32(w: jnp.ndarray) -> jnp.ndarray:
             | ((w << 8) & jnp.uint32(0xFF0000)) | (w << 24))
 
 
+def composite_key_lanes(invalid, key_word_lanes, key_len, seq_hi, seq_lo,
+                        *, uniform_klen: bool, seq32: bool):
+    """THE canonical comparator lane order — (invalid-last, key words BE
+    asc, [key_len], [~seq_hi], ~seq_lo) — as a lane list. Every consumer
+    of the composite order builds it here so they cannot desync: the
+    full-sort kernel (_sort_merge_order), the sorted-runs merge network
+    (ops/merge_network.py), and its host-side precondition check
+    (runs_are_sorted — numpy arrays work too: only list-building and
+    ``~`` are used)."""
+    keys = [invalid, *key_word_lanes]
+    if not uniform_klen:
+        keys.append(key_len)
+    if not seq32:
+        keys.append(~seq_hi)
+    keys.append(~seq_lo)
+    return keys
+
+
+def split_composite_lanes(lanes, key_words: int, *, uniform_klen: bool,
+                          seq32: bool):
+    """Inverse of composite_key_lanes over an ordered lane sequence (the
+    comparator lanes, already reordered by a sort/merge). Returns
+    (key_word_lanes, key_len_or_None, seq_hi_or_None, seq_lo, valid,
+    next_pos) — seq lanes are un-complemented."""
+    pos = 1
+    key_lanes = list(lanes[pos:pos + key_words])
+    pos += key_words
+    klen = None
+    if not uniform_klen:
+        klen = lanes[pos]
+        pos += 1
+    shi = None
+    if not seq32:
+        shi = ~lanes[pos]
+        pos += 1
+    slo = ~lanes[pos]
+    pos += 1
+    valid = lanes[0] == 0
+    return key_lanes, klen, shi, slo, valid, pos
+
+
 def _sort_merge_order(
     key_words_be: jnp.ndarray,  # (N, 6) u32
     key_len: jnp.ndarray,       # (N,) u32
@@ -91,33 +132,15 @@ def _sort_merge_order(
     count barely affects TPU sort cost (measured), but fewer key operands
     still shorten the comparator."""
     invalid_key = jnp.where(valid, jnp.uint32(0), jnp.uint32(1))
-    operands = [
-        invalid_key,
-        *(key_words_be[:, w] for w in range(key_words)),
-    ]
-    if not uniform_klen:
-        operands.append(key_len)
-    if not seq32:
-        operands.append(~seq_hi)  # descending seq == ascending complement
-    operands.append(~seq_lo)
+    operands = composite_key_lanes(
+        invalid_key, (key_words_be[:, w] for w in range(key_words)),
+        key_len, seq_hi, seq_lo, uniform_klen=uniform_klen, seq32=seq32)
     num_keys = len(operands)
     operands.extend(payload)
     sorted_ops = lax.sort(tuple(operands), num_keys=num_keys,
                           is_stable=False)
-    pos = 1
-    key_lanes = sorted_ops[pos:pos + key_words]
-    pos += key_words
-    klen_s = None
-    if not uniform_klen:
-        klen_s = sorted_ops[pos]
-        pos += 1
-    shi_s = None
-    if not seq32:
-        shi_s = ~sorted_ops[pos]
-        pos += 1
-    slo_s = ~sorted_ops[pos]
-    pos += 1
-    valid_s = sorted_ops[0] == 0
+    key_lanes, klen_s, shi_s, slo_s, valid_s, pos = split_composite_lanes(
+        sorted_ops, key_words, uniform_klen=uniform_klen, seq32=seq32)
     return key_lanes, klen_s, shi_s, slo_s, valid_s, sorted_ops[pos:]
 
 
@@ -167,59 +190,33 @@ def _limb_combine(lo16_0, lo16_1, hi16_0, hi16_1):
     return l0 | (l1 << 16), l2 | (l3 << 16)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("merge_kind", "drop_tombstones", "uniform_klen",
-                     "seq32", "key_words"),
-)
-def merge_resolve_kernel(
-    key_words_be: jnp.ndarray,  # (N, 6) u32
-    key_len: jnp.ndarray,       # (N,) u32
-    seq_hi: jnp.ndarray,
-    seq_lo: jnp.ndarray,
-    vtype: jnp.ndarray,         # (N,) u32
-    val_words: jnp.ndarray,     # (N, W) u32
-    val_len: jnp.ndarray,       # (N,) u32
-    valid: jnp.ndarray,         # (N,) bool
+def resolve_sorted_lanes(
+    key_lanes,                  # list of (N,) u32, length == key_words
+    key_len,                    # (N,) u32 or None (uniform_klen path)
+    seq_hi,                     # (N,) u32 or None (seq32 path)
+    seq_lo,                     # (N,) u32
+    valid,                      # (N,) bool
+    vtype,                      # (N,) u32
+    val_len,                    # (N,) u32
+    vw_lanes,                   # list of (N,) u32 value-word lanes
+    klen_const,                 # scalar u32 (uniform_klen reconstruction)
     *,
-    merge_kind: MergeKind = MergeKind.UINT64_ADD,
-    drop_tombstones: bool = True,
-    uniform_klen: bool = False,
-    seq32: bool = False,
-    key_words: int = KEY_WORDS,
+    merge_kind: MergeKind,
+    drop_tombstones: bool,
+    uniform_klen: bool,
+    seq32: bool,
+    key_words: int,
 ) -> Dict[str, jnp.ndarray]:
-    """Merge + resolve a concatenated batch of runs (order-free input).
-
-    Returns dense output arrays (capacity N, first ``count`` rows live):
-    key_words_be/le, key_len, seq_hi/lo, vtype, val_words, val_len, count.
-    (LE key lanes are not an input: they are byteswaps of the BE lanes,
-    recomputed on the outputs — callers save the H2D transfer.)
-    ``uniform_klen``/``seq32``/``key_words`` are caller-verified fast-path
-    promises (see _sort_merge_order); results are identical either way.
-    """
-    n = key_len.shape[0]
+    """Phases 2-4 of the kernel on ALREADY merge-ordered lanes
+    ((invalid-last, key asc, seq desc) order): boundary detection,
+    segmented LSM resolution, stream compaction. Shared by the full-sort
+    kernel below and the sorted-runs merge-network kernel
+    (ops/merge_network.py), which produce that order two different ways."""
+    n = seq_lo.shape[0]
     iota = lax.iota(jnp.int32, n)
-    n_val_words = val_words.shape[1]
-    # uniform_klen reconstruction constant: the one valid key length
-    # (input order differs from output order, so the lane itself can't be
-    # passed through; invalid rows may carry zero lengths)
-    klen_const = jnp.max(jnp.where(valid, key_len, jnp.uint32(0)))
-
-    # --- phase 1: merge-order sort, payload riding the network ---------
-    payload = (vtype, val_len) + tuple(
-        val_words[:, w] for w in range(n_val_words)
-    )
-    key_lanes, klen_s, shi_s, slo_s, valid, payload = _sort_merge_order(
-        key_words_be, key_len, seq_hi, seq_lo, valid, payload,
-        uniform_klen=uniform_klen, seq32=seq32, key_words=key_words,
-    )
-    vtype, val_len = payload[0], payload[1]
-    vw_lanes = list(payload[2:])
-    seq_lo = slo_s
-    seq_hi = shi_s if shi_s is not None else jnp.zeros_like(seq_lo)
-    # sorted-order key_len lane; None in the uniform path (the input lane
-    # would be misaligned after the sort — outputs use klen_const instead)
-    key_len = klen_s
+    n_val_words = len(vw_lanes)
+    vw_lanes = list(vw_lanes)
+    seq_hi = seq_hi if seq_hi is not None else jnp.zeros_like(seq_lo)
 
     # --- key boundaries (sorted order) --------------------------------
     # (key_words promise: lanes >= key_words are zero for valid rows, so
@@ -377,3 +374,55 @@ def merge_resolve_kernel(
         "count": count,
         "needs_cpu_fallback": overflow_risk,
     }
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("merge_kind", "drop_tombstones", "uniform_klen",
+                     "seq32", "key_words"),
+)
+def merge_resolve_kernel(
+    key_words_be: jnp.ndarray,  # (N, 6) u32
+    key_len: jnp.ndarray,       # (N,) u32
+    seq_hi: jnp.ndarray,
+    seq_lo: jnp.ndarray,
+    vtype: jnp.ndarray,         # (N,) u32
+    val_words: jnp.ndarray,     # (N, W) u32
+    val_len: jnp.ndarray,       # (N,) u32
+    valid: jnp.ndarray,         # (N,) bool
+    *,
+    merge_kind: MergeKind = MergeKind.UINT64_ADD,
+    drop_tombstones: bool = True,
+    uniform_klen: bool = False,
+    seq32: bool = False,
+    key_words: int = KEY_WORDS,
+) -> Dict[str, jnp.ndarray]:
+    """Merge + resolve a concatenated batch of runs (order-free input).
+
+    Returns dense output arrays (capacity N, first ``count`` rows live):
+    key_words_be/le, key_len, seq_hi/lo, vtype, val_words, val_len, count.
+    (LE key lanes are not an input: they are byteswaps of the BE lanes,
+    recomputed on the outputs — callers save the H2D transfer.)
+    ``uniform_klen``/``seq32``/``key_words`` are caller-verified fast-path
+    promises (see _sort_merge_order); results are identical either way.
+    """
+    n_val_words = val_words.shape[1]
+    # uniform_klen reconstruction constant: the one valid key length
+    # (input order differs from output order, so the lane itself can't be
+    # passed through; invalid rows may carry zero lengths)
+    klen_const = jnp.max(jnp.where(valid, key_len, jnp.uint32(0)))
+
+    # --- phase 1: merge-order sort, payload riding the network ---------
+    payload = (vtype, val_len) + tuple(
+        val_words[:, w] for w in range(n_val_words)
+    )
+    key_lanes, klen_s, shi_s, slo_s, valid_s, payload = _sort_merge_order(
+        key_words_be, key_len, seq_hi, seq_lo, valid, payload,
+        uniform_klen=uniform_klen, seq32=seq32, key_words=key_words,
+    )
+    return resolve_sorted_lanes(
+        list(key_lanes), klen_s, shi_s, slo_s, valid_s,
+        payload[0], payload[1], list(payload[2:]), klen_const,
+        merge_kind=merge_kind, drop_tombstones=drop_tombstones,
+        uniform_klen=uniform_klen, seq32=seq32, key_words=key_words,
+    )

@@ -332,3 +332,154 @@ def test_synth_counter_batch_jax_matches_numpy_contract():
     assert got["key_words_be"][:, 1].max() < n // 8
     assert (got["val_len"] == np.where(got["vtype"] == 2, 0, 8)).all()
     assert got["valid"].all()
+
+
+# ---------------------------------------------------------------------
+# sorted-runs merge network (ops/merge_network.py)
+# ---------------------------------------------------------------------
+
+def _pack_runs(runs, run_capacity):
+    """Per-run entry lists -> stacked (R, L) lanes + valid matrix."""
+    batches = [pack_entries(r, capacity=run_capacity) for r in runs]
+    stack = lambda f: np.stack([getattr(b, f) for b in batches])  # noqa: E731
+    return {
+        "key_words_be": stack("key_words_be"),
+        "key_len": stack("key_len"),
+        "seq_hi": stack("seq_hi"),
+        "seq_lo": stack("seq_lo"),
+        "vtype": stack("vtype"),
+        "val_words": stack("val_words"),
+        "val_len": stack("val_len"),
+        "valid": stack("valid"),
+    }
+
+
+def _run_runs_kernel(runs, run_capacity, merge_kind=MergeKind.UINT64_ADD,
+                     drop_tombstones=True, **flags):
+    from rocksplicator_tpu.ops.merge_network import (
+        merge_resolve_runs_kernel, runs_are_sorted)
+
+    lanes = _pack_runs(runs, run_capacity)
+    assert runs_are_sorted(
+        lanes["key_words_be"], lanes["key_len"], lanes["seq_hi"],
+        lanes["seq_lo"], lanes["valid"])
+    out = merge_resolve_runs_kernel(
+        jnp.asarray(lanes["key_words_be"]), jnp.asarray(lanes["key_len"]),
+        jnp.asarray(lanes["seq_hi"]), jnp.asarray(lanes["seq_lo"]),
+        jnp.asarray(lanes["vtype"]), jnp.asarray(lanes["val_words"]),
+        jnp.asarray(lanes["val_len"]), jnp.asarray(lanes["valid"]),
+        merge_kind=merge_kind, drop_tombstones=drop_tombstones, **flags)
+    return unpack_entries(
+        np.asarray(out["key_words_be"]), np.asarray(out["key_len"]),
+        np.asarray(out["seq_hi"]), np.asarray(out["seq_lo"]),
+        np.asarray(out["vtype"]), np.asarray(out["val_words"]),
+        np.asarray(out["val_len"]), int(out["count"]),
+    )
+
+
+def _split_sorted_runs(entries, n_runs, rng):
+    """Assign entries to runs at random; each run sorted (key asc, seq
+    desc) — the precondition real SST/memtable runs satisfy."""
+    runs = [[] for _ in range(n_runs)]
+    for e in entries:
+        runs[rng.randrange(n_runs)].append(e)
+    return [sorted(r, key=lambda e: (e[0], -e[1])) for r in runs]
+
+
+@pytest.mark.parametrize("merge_kind,drop", [
+    (MergeKind.UINT64_ADD, True),
+    (MergeKind.UINT64_ADD, False),
+    (MergeKind.NONE, True),
+    (MergeKind.NONE, False),
+])
+def test_merge_network_matches_full_sort_kernel(merge_kind, drop):
+    rng = random.Random(42)
+    entries = []
+    seq = 1
+    for _ in range(500):
+        k = f"key{rng.randrange(60):04d}".encode()
+        r = rng.random()
+        if merge_kind is MergeKind.NONE:
+            vt = OpType.PUT if r < 0.8 else OpType.DELETE
+        else:
+            vt = (OpType.MERGE if r < 0.5
+                  else OpType.PUT if r < 0.85 else OpType.DELETE)
+        v = b"" if vt == OpType.DELETE else pack64(rng.randrange(1000))
+        entries.append((k, seq, vt, v))
+        seq += 1
+    want = run_kernel(entries, merge_kind=merge_kind, drop_tombstones=drop,
+                      capacity=1024)
+    for n_runs in (1, 2, 4, 8):
+        runs = _split_sorted_runs(entries, n_runs, random.Random(n_runs))
+        cap = 1
+        while cap < max(len(r) for r in runs):
+            cap *= 2
+        got = _run_runs_kernel(runs, cap, merge_kind=merge_kind,
+                               drop_tombstones=drop)
+        assert got == want, f"n_runs={n_runs}"
+
+
+def test_merge_network_fast_flags_parity():
+    rng = random.Random(7)
+    entries = []
+    for i in range(300):
+        k = f"k{rng.randrange(40):06d}".encode()  # uniform 7-byte keys
+        entries.append((k, i + 1, OpType.MERGE, pack64(i)))
+    want = run_kernel(entries, capacity=512)
+    runs = _split_sorted_runs(entries, 4, rng)
+    got = _run_runs_kernel(runs, 128, uniform_klen=True, seq32=True,
+                           key_words=2)
+    assert got == want
+
+
+def test_merge_network_uneven_and_empty_runs():
+    entries = [
+        (b"a", 3, OpType.PUT, pack64(1)),
+        (b"b", 2, OpType.DELETE, b""),
+        (b"c", 1, OpType.PUT, pack64(2)),
+    ]
+    want = run_kernel(entries, capacity=8)
+    runs = [sorted(entries, key=lambda e: (e[0], -e[1])), []]
+    got = _run_runs_kernel(runs, 4)
+    assert got == want
+
+
+def test_runs_are_sorted_detects_violations():
+    from rocksplicator_tpu.ops.merge_network import runs_are_sorted
+
+    ok = _pack_runs([[
+        (b"a", 2, OpType.PUT, b"x"),
+        (b"a", 1, OpType.PUT, b"y"),  # same key: seq desc
+        (b"b", 9, OpType.PUT, b"z"),
+    ]], 4)
+    assert runs_are_sorted(ok["key_words_be"], ok["key_len"], ok["seq_hi"],
+                           ok["seq_lo"], ok["valid"])
+    bad_key = _pack_runs([[
+        (b"b", 1, OpType.PUT, b"x"),
+        (b"a", 2, OpType.PUT, b"y"),
+    ]], 2)
+    assert not runs_are_sorted(
+        bad_key["key_words_be"], bad_key["key_len"], bad_key["seq_hi"],
+        bad_key["seq_lo"], bad_key["valid"])
+    bad_seq = _pack_runs([[
+        (b"a", 1, OpType.PUT, b"x"),
+        (b"a", 2, OpType.PUT, b"y"),  # seq ascending: newest must be first
+    ]], 2)
+    assert not runs_are_sorted(
+        bad_seq["key_words_be"], bad_seq["key_len"], bad_seq["seq_hi"],
+        bad_seq["seq_lo"], bad_seq["valid"])
+    # valid rows must form a prefix (a hole breaks run order)
+    hole = _pack_runs([[(b"a", 1, OpType.PUT, b"x")]], 2)
+    hole["valid"][0] = np.array([False, True])
+    assert not runs_are_sorted(
+        hole["key_words_be"], hole["key_len"], hole["seq_hi"],
+        hole["seq_lo"], hole["valid"])
+
+
+def test_merge_network_rejects_non_pow2_shapes():
+    from rocksplicator_tpu.ops.merge_network import merge_sorted_lanes
+
+    with pytest.raises(ValueError):
+        merge_sorted_lanes([jnp.zeros((2, 6), jnp.uint32)], 1)
+    with pytest.raises(ValueError):
+        merge_sorted_lanes([jnp.zeros((3, 4), jnp.uint32)], 1)
