@@ -118,9 +118,10 @@ def _make_model():
     from rocksplicator_tpu.models import CompactionModel
 
     # 16-byte keys + 32-bit seqs: reduced-key sort (_sort_merge_order);
-    # emit_rows adds on-device SST block encoding to the measured pipeline
+    # emit_planar adds on-device SST block encoding (plane words +
+    # checksums — the production sink format) to the measured pipeline
     return CompactionModel(capacity=ENTRIES, uniform_klen=True, seq32=True,
-                           key_words=KEY_BYTES // 4, emit_rows=True,
+                           key_words=KEY_BYTES // 4, emit_planar=True,
                            row_klen=KEY_BYTES, row_vlen=VAL_BYTES)
 
 

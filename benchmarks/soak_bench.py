@@ -1,0 +1,331 @@
+#!/usr/bin/env python
+"""Scale/soak benchmark — BASELINE config #5 shape at hundreds of shards.
+
+Two phases, each recording hard numbers into a JSON result file (the
+VERDICT round-2 "scale evidence" artifact; BASELINE.md targets table):
+
+1. **shard-scale storm** — N shard DBs (default 256) in one process with
+   tiny memtables + aggressive L0 triggers so flush/compaction run
+   continuously, W writer + R reader threads sweeping all shards for T
+   seconds. Records write/read throughput and the
+   ``storage.write_stall_ms`` histogram (p99 target: < 10 ms).
+2. **cluster failover under load** — 3 nodes × M shards (default 32)
+   with semi-sync replication, mixed writes during a leader crash;
+   records re-election convergence time and acked-write loss fraction.
+
+Usage:
+    python -m benchmarks.soak_bench [--shards 256] [--storm_sec 60]
+        [--cluster_shards 32] [--out benchmarks/results/soak.json]
+
+Reference precedent for harness shape: performance.cpp (N shards × M
+writer threads, reports bytes/s) and the gated admin integration tests
+(/root/reference/rocksdb_replicator/performance.cpp:57-66,
+rocksdb_admin/tests/admin_handler_test.cpp).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def storm_phase(n_shards: int, storm_sec: float, writers: int,
+                readers: int, value_bytes: int) -> dict:
+    """Phase 1: flush/compaction storm across n_shards real engines."""
+    from rocksplicator_tpu.storage.engine import DB, DBOptions
+    from rocksplicator_tpu.storage.merge import UInt64AddOperator
+    from rocksplicator_tpu.utils.stats import Stats
+
+    Stats.reset_for_test()
+    root = tempfile.mkdtemp(prefix="rstpu-soak-")
+    opts = DBOptions(
+        memtable_bytes=48 << 10,
+        level0_compaction_trigger=3,
+        background_compaction=True,
+        merge_operator=UInt64AddOperator(),
+    )
+    t0 = time.monotonic()
+    dbs = [DB(os.path.join(root, f"s{i:05d}"), opts)
+           for i in range(n_shards)]
+    open_sec = time.monotonic() - t0
+    log(f"opened {n_shards} shard DBs in {open_sec:.1f}s "
+        f"({2 * n_shards} bg threads)")
+
+    stop = threading.Event()
+    counts = {"writes": 0, "reads": 0, "read_hits": 0, "errors": 0}
+    lock = threading.Lock()
+    val = b"v" * value_bytes
+
+    def writer(tid: int) -> None:
+        w = r = 0
+        i = tid
+        try:
+            while not stop.is_set():
+                db = dbs[i % n_shards]
+                key = f"w{tid}-k{(i // n_shards) % 4096:06d}".encode()
+                if i % 7 == 0:
+                    db.merge(key, b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                else:
+                    db.put(key, val)
+                w += 1
+                i += writers
+        except Exception as e:  # pragma: no cover - diagnostics
+            log(f"writer {tid} died: {e!r}")
+            with lock:
+                counts["errors"] += 1
+        with lock:
+            counts["writes"] += w
+            counts["reads"] += r
+
+    def reader(tid: int) -> None:
+        r = hits = 0
+        i = tid
+        try:
+            while not stop.is_set():
+                db = dbs[i % n_shards]
+                key = f"w{tid % writers}-k{(i // n_shards) % 4096:06d}".encode()
+                if db.get(key) is not None:
+                    hits += 1
+                r += 1
+                i += readers
+        except Exception as e:  # pragma: no cover - diagnostics
+            log(f"reader {tid} died: {e!r}")
+            with lock:
+                counts["errors"] += 1
+        with lock:
+            counts["reads"] += r
+            counts["read_hits"] += hits
+
+    threads = [threading.Thread(target=writer, args=(t,), daemon=True)
+               for t in range(writers)]
+    threads += [threading.Thread(target=reader, args=(t,), daemon=True)
+                for t in range(readers)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(storm_sec)
+    stop.set()
+    for t in threads:
+        t.join(30)
+    elapsed = time.monotonic() - t0
+    stats = Stats.get()
+    stall_p99 = stats.metric_percentile("storage.write_stall_ms", 99)
+    stall_max = stats.metric_percentile("storage.write_stall_ms", 100)
+    stall_n = stats.metric_count("storage.write_stall_ms")
+    t0 = time.monotonic()
+    for db in dbs:
+        db.close()
+    close_sec = time.monotonic() - t0
+    shutil.rmtree(root, ignore_errors=True)
+    result = {
+        "shards": n_shards,
+        "storm_sec": round(elapsed, 1),
+        "writer_threads": writers,
+        "reader_threads": readers,
+        "writes": counts["writes"],
+        "reads": counts["reads"],
+        "read_hit_rate": round(
+            counts["read_hits"] / max(1, counts["reads"]), 3),
+        "errors": counts["errors"],
+        "writes_per_sec": round(counts["writes"] / elapsed),
+        "reads_per_sec": round(counts["reads"] / elapsed),
+        "write_stall_p99_ms": round(stall_p99, 3),
+        "write_stall_max_ms": round(stall_max, 3),
+        "write_stall_samples": stall_n,
+        "open_sec": round(open_sec, 1),
+        "close_sec": round(close_sec, 1),
+    }
+    log(f"storm: {json.dumps(result)}")
+    return result
+
+
+def failover_phase(n_shards: int, load_sec: float) -> dict:
+    """Phase 2: leader crash under write load, 3 nodes, semi-sync."""
+    from tests.test_cluster import ServiceNode, wait_until
+    from rocksplicator_tpu.cluster.controller import Controller
+    from rocksplicator_tpu.cluster.coordinator import CoordinatorServer
+    from rocksplicator_tpu.cluster.model import ResourceDef
+    from rocksplicator_tpu.storage import DBOptions, WriteBatch
+    from rocksplicator_tpu.utils.dbconfig import DBConfigManager
+    from rocksplicator_tpu.utils.segment_utils import segment_to_db_name
+
+    import pathlib
+
+    tmp = tempfile.mkdtemp(prefix="rstpu-soak-cluster-")
+    tmp_path = pathlib.Path(tmp)
+    coord = CoordinatorServer(port=0, session_ttl=1.5)
+    DBConfigManager.get().load_from_dict({"seg": {"replication_mode": 1}})
+    nodes = [ServiceNode(tmp_path, n, coord.port, "soak")
+             for n in ("a", "b", "c")]
+    for node in nodes:
+        node.handler._options_gen = lambda seg: DBOptions(
+            memtable_bytes=64 * 1024, level0_compaction_trigger=3,
+            background_compaction=True,
+        )
+    ctrl = Controller("127.0.0.1", coord.port, "soak", "ctrl",
+                      reconcile_interval=0.3)
+    ctrl.add_resource(ResourceDef("seg", num_shards=n_shards, replicas=3))
+
+    def leaders():
+        out = {}
+        for s in range(n_shards):
+            for n in nodes:
+                if n.participant.current_states.get(f"seg_{s}") in (
+                        "LEADER", "MASTER"):
+                    out[s] = n
+        return out
+
+    stop = threading.Event()
+    written = [0]
+    errors = [0]
+    lock = threading.Lock()
+    result: dict = {"cluster_shards": n_shards}
+    threads = []
+    try:
+        ok = wait_until(lambda: len(leaders()) == n_shards, timeout=120)
+        if not ok:
+            result["error"] = "initial leader election incomplete"
+            return result
+
+        def writer(tid):
+            i = 0
+            while not stop.is_set():
+                shard = i % n_shards
+                ldr = leaders().get(shard)
+                if ldr is None:
+                    time.sleep(0.02)
+                    continue
+                app = ldr.handler.db_manager.get_db(
+                    segment_to_db_name("seg", shard))
+                if app is None:
+                    time.sleep(0.02)
+                    continue
+                try:
+                    app.write(WriteBatch().put(
+                        f"t{tid}-{i:08d}".encode(), b"v" * 128))
+                    with lock:
+                        written[0] += 1
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+                i += 1
+
+        threads = [threading.Thread(target=writer, args=(t,), daemon=True)
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(load_sec / 2)
+        by_node = {}
+        for s, n in leaders().items():
+            by_node.setdefault(n.name, []).append(s)
+        victim = max(nodes, key=lambda n: len(by_node.get(n.name, [])))
+        led = len(by_node.get(victim.name, []))
+        t0 = time.monotonic()
+        victim.stop(graceful=False)
+        nodes.remove(victim)
+        reelected = wait_until(lambda: len(leaders()) == n_shards,
+                               timeout=120)
+        reelect_sec = time.monotonic() - t0
+        time.sleep(load_sec / 2)
+        stop.set()
+        for t in threads:
+            t.join(30)
+
+        def converged():
+            for s in range(n_shards):
+                seqs = set()
+                for n in nodes:
+                    app = n.handler.db_manager.get_db(
+                        segment_to_db_name("seg", s))
+                    if app is not None:
+                        seqs.add(app.latest_sequence_number())
+                if len(seqs) > 1:
+                    return False
+            return True
+
+        conv = wait_until(converged, timeout=120)
+        total_seq = 0
+        for s in range(n_shards):
+            for n in nodes:
+                app = n.handler.db_manager.get_db(
+                    segment_to_db_name("seg", s))
+                if app is not None:
+                    total_seq += app.latest_sequence_number()
+                    break
+        result.update({
+            "writes_acked": written[0],
+            "write_errors": errors[0],
+            "victim_led_shards": led,
+            "reelected_all": bool(reelected),
+            "reelect_sec": round(reelect_sec, 2),
+            "replicas_converged": bool(conv),
+            "total_seq_after": total_seq,
+            "acked_loss_frac": round(
+                max(0, written[0] - total_seq) / max(1, written[0]), 4),
+        })
+        log(f"failover: {json.dumps(result)}")
+        return result
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(5)
+        for n in nodes:
+            try:
+                n.stop(graceful=True)
+            except Exception:
+                pass
+        try:
+            ctrl.stop()
+        except Exception:
+            pass
+        try:
+            coord.stop()
+        except Exception:
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=256)
+    ap.add_argument("--storm_sec", type=float, default=60)
+    ap.add_argument("--writers", type=int, default=8)
+    ap.add_argument("--readers", type=int, default=4)
+    ap.add_argument("--value_bytes", type=int, default=256)
+    ap.add_argument("--cluster_shards", type=int, default=32)
+    ap.add_argument("--cluster_sec", type=float, default=20)
+    ap.add_argument("--skip_cluster", action="store_true")
+    ap.add_argument("--out", default="benchmarks/results/soak.json")
+    args = ap.parse_args()
+
+    result = {
+        "bench": "soak",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "storm": storm_phase(args.shards, args.storm_sec, args.writers,
+                             args.readers, args.value_bytes),
+    }
+    if not args.skip_cluster:
+        result["failover"] = failover_phase(args.cluster_shards,
+                                            args.cluster_sec)
+    target_ok = result["storm"]["write_stall_p99_ms"] < 10.0
+    result["write_stall_target_met"] = bool(target_ok)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

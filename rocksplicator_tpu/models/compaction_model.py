@@ -43,6 +43,12 @@ class CompactionModel:
     emit_rows: bool = False
     row_klen: int = 16
     row_vlen: int = 8
+    # PLANAR alternative (the production sink format): emit block plane
+    # words + word-domain checksums instead of interleaved rows — on this
+    # hardware the row matrix is the most expensive layout op in the
+    # pipeline while planar is concatenation (PERF.md)
+    emit_planar: bool = False
+    planar_block_entries: int = 1024
 
     @property
     def num_bloom_words(self) -> int:
@@ -79,6 +85,18 @@ class CompactionModel:
                 out["vtype"], out["val_words"],
                 klen=self.row_klen, vlen=self.row_vlen,
             )
+        if self.emit_planar:
+            from ..ops.block_encode import (encode_planar_words_tpu,
+                                            planar_checksums_tpu)
+
+            words = encode_planar_words_tpu(
+                out["key_words_be"], out["seq_hi"], out["seq_lo"],
+                out["vtype"], out["val_words"],
+                klen=self.row_klen, vlen=self.row_vlen, seq32=self.seq32,
+                block_entries=self.planar_block_entries,
+            )
+            out["planar_words"] = words
+            out["planar_chk"] = planar_checksums_tpu(words)
         return out
 
     def example_args(self, seed: int = 0) -> Tuple:

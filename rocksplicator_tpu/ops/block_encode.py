@@ -118,3 +118,72 @@ def encode_and_checksum(
     )
     chk = block_checksums_tpu(rows, block_entries=block_entries)
     return np.asarray(rows), np.asarray(chk)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("klen", "vlen", "seq32", "block_entries"))
+def encode_planar_words_tpu(
+    key_words_be,  # (N, 6) u32
+    seq_hi, seq_lo,  # (N,) u32
+    vtype,  # (N,) u32
+    val_words,  # (N, W) u32
+    *,
+    klen: int,
+    vlen: int,
+    seq32: bool,
+    block_entries: int,
+):
+    """PLANAR block encoding on device: (nblocks, words_per_block) u32 —
+    each row is one block's plane words, byte-identical (as LE u32) to
+    storage/planar.encode_planar_block for FULL blocks. N must be a
+    multiple of block_entries (kernel capacities are powers of two); rows
+    past the live count are zero, so only the tail block differs from
+    the host layout (the sink re-packs that one block on host).
+
+    This is what makes the planar format the TPU-first choice: where the
+    row encoder interleaves bytes into an (N, stride) minor-dim matrix
+    (the most expensive layout op this hardware has — PERF.md), the
+    planar encoder only packs the vtype u8 lane and CONCATENATES existing
+    lanes."""
+    import jax.numpy as jnp
+
+    n = seq_lo.shape[0]
+    # zero-pad to a whole number of blocks (static — shapes are traced):
+    # rows past the live count are zero anyway, and the sink only uses
+    # blocks that lie fully inside the count
+    pad = (-n) % block_entries
+    nblocks = (n + pad) // block_entries
+    kw = (klen + 3) // 4
+    vw = (vlen + 3) // 4
+    b = block_entries
+
+    def blocked(lane):  # (N,) -> (nblocks, b)
+        if pad:
+            lane = jnp.pad(lane, (0, pad))
+        return lane.reshape(nblocks, b)
+
+    parts = [blocked(key_words_be[:, w]) for w in range(kw)]
+    # plane order within a block: key lanes, seq_lo, [seq_hi], vtype, vals
+    parts.append(blocked(seq_lo))
+    if not seq32:
+        parts.append(blocked(seq_hi))
+    # vtype: 4 entries per word, little-endian byte order
+    vt = blocked(vtype & jnp.uint32(0xFF)).reshape(nblocks, b // 4, 4)
+    shifts = jnp.array([0, 8, 16, 24], jnp.uint32)
+    parts.append((vt << shifts[None, None, :]).sum(
+        axis=2, dtype=jnp.uint32))
+    for w in range(vw):
+        parts.append(blocked(val_words[:, w]))
+    return jnp.concatenate(parts, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def planar_checksums_tpu(words):
+    """Word-domain poly MAC per block row: H = Σ (w_i + 1) · r^(i+1)
+    mod 2^32 — matches utils/checksum.poly_checksum_words."""
+    import jax.numpy as jnp
+
+    nblocks, wpb = words.shape
+    powers = jnp.cumprod(jnp.full((wpb,), _CHK_R, jnp.uint32))
+    return ((words + jnp.uint32(1)) * powers[None, :]).sum(
+        axis=1, dtype=jnp.uint32)

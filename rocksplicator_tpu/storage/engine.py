@@ -66,11 +66,16 @@ class DBOptions:
     target_file_bytes: int = 64 * 1024 * 1024
     compaction_backend: Optional[CompactionBackend] = None
     disable_auto_compaction: bool = False
-    # Background flush/compaction: writes swap a full memtable to the
-    # immutable slot and return immediately (stalling only when the slot is
-    # still flushing) — the BASELINE write-stall target depends on this.
+    # Background flush/compaction: writes swap a full memtable into the
+    # immutable queue and return immediately (stalling only when the queue
+    # is full) — the BASELINE write-stall target depends on this.
     # Off by default so single-threaded callers stay deterministic.
     background_compaction: bool = False
+    # Total memtables (1 active + up to N-1 immutable awaiting flush) —
+    # RocksDB's max_write_buffer_number. A burst that fills one memtable
+    # while another flushes no longer stalls the writer; only a sustained
+    # rate above flush throughput fills the queue and stalls.
+    max_write_buffers: int = 4
 
     # Mutable at runtime via DB.set_options (reference setDBOptions RPC).
     MUTABLE = {
@@ -87,7 +92,7 @@ class DB:
         self.options = options or DBOptions()
         self._lock = threading.RLock()
         self._mem = MemTable()
-        self._imm: Optional[MemTable] = None  # memtable being flushed
+        self._imms: List[MemTable] = []  # immutable queue, oldest first
         self._last_seq = 0
         self._persisted_seq = 0  # highest seq durable in SSTs
         self._next_file_id = 1
@@ -212,14 +217,15 @@ class DB:
             return start_seq
 
     def _swap_to_imm_locked(self, force: bool = False) -> None:
-        """Hand the full memtable to the background thread. Stalls only
-        while the previous immutable memtable is still flushing AND this
-        writer's swap is still needed — once a peer writer swapped, the
-        fresh memtable is below threshold and waiters exit immediately.
-        Never clobbers a pending imm (bails instead on stop/close)."""
+        """Hand the full memtable to the background flusher. Stalls only
+        while the immutable QUEUE is full AND this writer's swap is still
+        needed — once a peer writer swapped, the fresh memtable is below
+        threshold and waiters exit immediately. Never exceeds the queue
+        bound (bails instead on stop/close)."""
+        cap = max(1, self.options.max_write_buffers - 1)
         stall_start = None
         while (
-            self._imm is not None
+            len(self._imms) >= cap
             and not self._closed
             and not self._bg_stop
             and (force or self._mem.approximate_bytes()
@@ -234,7 +240,7 @@ class DB:
                 (time.monotonic() - stall_start) * 1000.0,
             )
         if (
-            self._imm is not None  # stop/close exit: leave the imm alone
+            len(self._imms) >= cap  # stop/close exit: leave the queue alone
             or self._closed
             or self._bg_stop
             or len(self._mem) == 0
@@ -242,7 +248,7 @@ class DB:
                     >= self.options.memtable_bytes)
         ):
             return
-        self._imm = self._mem
+        self._imms.append(self._mem)
         self._mem = MemTable()
         self._cond.notify_all()
 
@@ -250,7 +256,7 @@ class DB:
         """Wait until no immutable memtable is pending. Raises if the DB
         closed underneath us or the background flusher is failing (matching
         inline mode, where the flush error reached the caller)."""
-        while self._imm is not None and not self._closed:
+        while self._imms and not self._closed:
             if self._bg_flush_error is not None:
                 raise StorageError(
                     f"background flush failing: {self._bg_flush_error!r}"
@@ -285,9 +291,8 @@ class DB:
             self._check_open()
             merge_op = self.options.merge_operator
             operands: List[bytes] = []
-            for mem in (self._mem, self._imm):
-                if mem is None:
-                    continue
+            # newest first: active memtable, then immutables newest->oldest
+            for mem in (self._mem, *reversed(self._imms)):
                 resolved, value, pending = mem.get(key, merge_op)
                 if resolved and not operands:
                     return value
@@ -357,7 +362,7 @@ class DB:
         with self._lock:
             self._check_open()
             runs: List[Iterator] = []
-            mems = [m for m in (self._mem, self._imm) if m is not None]
+            mems = [self._mem, *self._imms]
             for mem in mems:
                 runs.append(iter(list(mem.entries())))
             for name in self._levels[0]:
@@ -427,11 +432,11 @@ class DB:
     def _flush_loop(self) -> None:
         while True:
             with self._lock:
-                while not self._bg_stop and self._imm is None:
+                while not self._bg_stop and not self._imms:
                     self._cond.wait(0.2)
-                if self._bg_stop and self._imm is None:
+                if self._bg_stop and not self._imms:
                     return
-                imm = self._imm
+                imm = self._imms[0] if self._imms else None
             if imm is not None:
                 try:
                     self._flush_imm(imm)
@@ -482,7 +487,8 @@ class DB:
             self._levels[0].append(name)
             self._persisted_seq = max(self._persisted_seq, mem.max_seq)
             self._persist_manifest()
-            self._imm = None
+            if self._imms and self._imms[0] is mem:
+                self._imms.pop(0)
             self._cond.notify_all()
         wal_mod.purge_obsolete(
             self._wal_dir, self._persisted_seq, self.options.wal_ttl_seconds
@@ -519,14 +525,14 @@ class DB:
                 self._gc_files(inputs)
 
     def _flush_locked(self) -> None:
-        if self._imm is not None:
-            # callers must drain first (would clobber the pending imm and
-            # inflate persisted_seq past its unflushed sequence numbers)
-            raise StorageError("flush with immutable memtable pending")
+        if self._imms:
+            # callers must drain first (would flush out of queue order and
+            # inflate persisted_seq past unflushed sequence numbers)
+            raise StorageError("flush with immutable memtables pending")
         if len(self._mem) == 0:
             return
         mem = self._mem
-        self._imm = mem
+        self._imms.append(mem)
         self._mem = MemTable()
         writer: Optional[SSTWriter] = None
         try:
@@ -552,7 +558,8 @@ class DB:
             self._mem.absorb_older(mem)
             raise
         finally:
-            self._imm = None
+            if mem in self._imms:
+                self._imms.remove(mem)
         wal_mod.purge_obsolete(
             self._wal_dir, self._persisted_seq, self.options.wal_ttl_seconds
         )

@@ -1,0 +1,167 @@
+"""PLANAR (struct-of-arrays) TSST block codec — host side.
+
+The round-2 device profiling (PERF.md) showed minor-dim byte interleaving
+is the most expensive thing a TPU can do with kernel output, while the
+kernel's struct-of-array u32 lanes ARE already the data. The planar block
+format therefore writes each data block as u32 *planes* in lane order —
+on-device "encoding" degenerates to packing one u8 lane (vtype) and
+concatenating, files shrink (no per-entry klen/vlen/seq_hi overhead:
+41 B/entry → 33 B at 16/8 widths, less with seq32), and block checksums
+become pure u32 word math on both sides.
+
+Block layout (all little-endian), after the 16-byte header:
+
+    u32 n_entries | u8 klen | u8 vlen | u8 flags | u8 0 | u64 0
+    key planes   ceil(klen/4) × n u32   (big-endian WORD VALUES — the
+                                         kernel's key_words_be lanes)
+    seq_lo plane n u32
+    seq_hi plane n u32                  (omitted when flags & SEQ32)
+    vtype plane  ceil(n/4) u32          (4 entries packed per word, LE)
+    val planes   ceil(vlen/4) × n u32   (the kernel's val_words lanes)
+
+Entries within a block are key-ascending (same contract as entry-stream
+blocks); klen/vlen are uniform per FILE (the vectorized-sink promise).
+The codec nibble in the block index distinguishes planar blocks, so one
+file could mix encodings; readers dispatch per block. v1 entry-stream
+files stay readable unchanged (golden-format compatibility); planar
+files are new-format output of the TPU sink.
+
+Reference seam being reproduced: the SST files rocksdb ingests/compacts
+(SURVEY §3.3 addS3SstFilesToDB); the planar layout is the TPU-first
+re-design of their data blocks.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+PLANAR_HEADER = struct.Struct("<IBBBBQ")  # n, klen, vlen, flags, 0, 0
+PLANAR_FLAG_SEQ32 = 1
+
+
+def plane_words(n: int, klen: int, vlen: int, seq32: bool) -> int:
+    """u32 words of plane data for a planar block of n entries."""
+    kw = (klen + 3) // 4
+    vw = (vlen + 3) // 4
+    return n * (kw + 1 + (0 if seq32 else 1) + vw) + (n + 3) // 4
+
+
+def pack_vtype_plane(vtype: np.ndarray) -> np.ndarray:
+    """(n,) u32 vtype values -> (ceil(n/4),) u32, 4 per word LE."""
+    n = len(vtype)
+    pad = (-n) % 4
+    v = np.pad(vtype.astype(np.uint8), (0, pad))
+    return v.view("<u4").copy()
+
+
+def unpack_vtype_plane(words: np.ndarray, n: int) -> np.ndarray:
+    return words.view(np.uint8)[:n].astype(np.uint32)
+
+
+def encode_planar_block(
+    arrays: Dict[str, np.ndarray], start: int, end: int,
+    klen: int, vlen: int, seq32: bool,
+) -> bytes:
+    """Kernel-output lanes [start, end) -> planar block bytes (numpy —
+    the host fallback; the device path produces the identical plane words
+    via ops/block_encode.encode_planar_words_tpu)."""
+    n = end - start
+    kw = (klen + 3) // 4
+    vw = (vlen + 3) // 4
+    parts: List[np.ndarray] = [
+        np.ascontiguousarray(
+            arrays["key_words_be"][start:end, :kw].T).reshape(-1),
+        arrays["seq_lo"][start:end].astype(np.uint32),
+    ]
+    if not seq32:
+        parts.append(arrays["seq_hi"][start:end].astype(np.uint32))
+    parts.append(pack_vtype_plane(arrays["vtype"][start:end]))
+    if vw:
+        parts.append(np.ascontiguousarray(
+            arrays["val_words"][start:end, :vw].T).reshape(-1))
+    words = np.concatenate(parts).astype("<u4")
+    header = PLANAR_HEADER.pack(
+        n, klen, vlen, PLANAR_FLAG_SEQ32 if seq32 else 0, 0, 0)
+    return header + words.tobytes()
+
+
+def decode_planar_block(raw: bytes) -> Dict[str, np.ndarray]:
+    """Planar block bytes -> lane arrays (pure views/reshapes)."""
+    n, klen, vlen, flags, _, _ = PLANAR_HEADER.unpack_from(raw, 0)
+    seq32 = bool(flags & PLANAR_FLAG_SEQ32)
+    kw = (klen + 3) // 4
+    vw = (vlen + 3) // 4
+    want = PLANAR_HEADER.size + 4 * plane_words(n, klen, vlen, seq32)
+    if len(raw) != want:
+        from .errors import Corruption
+
+        raise Corruption(
+            f"planar block: {len(raw)} bytes, layout wants {want}")
+    words = np.frombuffer(raw, dtype="<u4", offset=PLANAR_HEADER.size)
+    pos = 0
+    kw_lanes = words[pos:pos + kw * n].reshape(kw, n)
+    pos += kw * n
+    seq_lo = words[pos:pos + n]
+    pos += n
+    if seq32:
+        seq_hi = np.zeros(n, dtype=np.uint32)
+    else:
+        seq_hi = words[pos:pos + n]
+        pos += n
+    nv = (n + 3) // 4
+    vtype = unpack_vtype_plane(words[pos:pos + nv], n)
+    pos += nv
+    val_lanes = words[pos:pos + vw * n].reshape(vw, n)
+
+    key_buf = np.zeros((n, 24), dtype=np.uint8)
+    kb = np.ascontiguousarray(
+        kw_lanes.T.astype(">u4")).view(np.uint8).reshape(n, kw * 4)
+    key_buf[:, :klen] = kb[:, :klen]
+    vval = max(2, vw)
+    val_words = np.zeros((n, vval), dtype=np.uint32)
+    if vw:
+        val_words[:, :vw] = val_lanes.T
+    return {
+        "key_words_be": key_buf.view(">u4").astype(np.uint32).reshape(n, 6),
+        "key_words_le": key_buf.view("<u4").reshape(n, 6).copy(),
+        "key_len": np.full(n, klen, dtype=np.uint32),
+        "seq_hi": seq_hi.astype(np.uint32),
+        "seq_lo": seq_lo.astype(np.uint32),
+        "vtype": vtype,
+        "val_words": val_words,
+        "val_len": np.where(vtype == 2, 0, vlen).astype(np.uint32),
+    }
+
+
+def iter_planar_block(raw: bytes) -> Iterator[Tuple[bytes, int, int, bytes]]:
+    """Planar block -> (key, seq, vtype, value) tuples (the generic
+    reader path; array consumers use decode_planar_block directly)."""
+    lanes = decode_planar_block(raw)
+    n = len(lanes["key_len"])
+    klen = int(lanes["key_len"][0]) if n else 0
+    kb = (
+        np.ascontiguousarray(lanes["key_words_be"].astype(">u4"))
+        .view(np.uint8).reshape(n, 24)
+    )
+    vb = (
+        np.ascontiguousarray(lanes["val_words"].astype("<u4"))
+        .view(np.uint8).reshape(n, -1)
+    )
+    seqs = (
+        lanes["seq_hi"].astype(np.uint64) << np.uint64(32)
+    ) | lanes["seq_lo"].astype(np.uint64)
+    vtypes = lanes["vtype"]
+    vlens = lanes["val_len"]
+    for i in range(n):
+        yield (
+            kb[i, :klen].tobytes(), int(seqs[i]), int(vtypes[i]),
+            vb[i, :int(vlens[i])].tobytes(),
+        )
+
+
+def planar_props(klen: int, vlen: int, seq32: bool) -> List[int]:
+    """The "planar" props value: [klen, vlen, seq32] (ints for JSON)."""
+    return [int(klen), int(vlen), int(bool(seq32))]

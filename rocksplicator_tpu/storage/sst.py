@@ -43,6 +43,11 @@ _INDEX_ENTRY = struct.Struct("<QIB")
 
 COMPRESSION_NONE = 0
 COMPRESSION_ZLIB = 1
+# PLANAR block encodings (storage/planar.py): struct-of-array u32 planes
+# instead of an entry byte stream. Same index/footer container; the codec
+# nibble selects decoding per block.
+BLOCK_PLANAR = 2
+BLOCK_PLANAR_ZLIB = 3
 
 # bytes per entry besides key+value: u32 klen, u64 seq, u8 vtype, u32 vlen
 ENTRY_FIXED_OVERHEAD = _ENTRY_HEAD.size + _ENTRY_META.size
@@ -117,16 +122,19 @@ class SSTWriter:
                           num_entries: int, keys: List[bytes],
                           min_key: bytes, max_key: bytes,
                           min_seq: int, max_seq: int,
-                          compressed: bool) -> None:
+                          compressed: bool, codec: Optional[int] = None
+                          ) -> None:
         """Accepts a pre-encoded data block — the TPU encode kernel's output
         path: blocks arrive already packed (and optionally compressed) and
-        are appended without re-serialization."""
+        are appended without re-serialization. ``codec`` overrides the
+        compressed flag for non-entry-stream encodings (BLOCK_PLANAR*)."""
         if self._block:
             self._flush_block()
         self._file.write(block_payload)
+        if codec is None:
+            codec = COMPRESSION_ZLIB if compressed else COMPRESSION_NONE
         self._index.append(
-            (last_key, self._offset, len(block_payload),
-             COMPRESSION_ZLIB if compressed else COMPRESSION_NONE)
+            (last_key, self._offset, len(block_payload), codec)
         )
         self._offset += len(block_payload)
         self._keys.extend(keys)
@@ -267,9 +275,15 @@ class SSTReader:
     def _read_block(self, block_idx: int) -> bytes:
         _last_key, off, size, codec = self._index[block_idx]
         payload = os.pread(self._fd, size, off)
-        raw = zlib.decompress(payload) if codec == COMPRESSION_ZLIB else payload
+        raw = (
+            zlib.decompress(payload)
+            if codec in (COMPRESSION_ZLIB, BLOCK_PLANAR_ZLIB) else payload
+        )
         self._verify_block_chk(block_idx, raw)
         return raw
+
+    def _block_is_planar(self, block_idx: int) -> bool:
+        return self._index[block_idx][3] in (BLOCK_PLANAR, BLOCK_PLANAR_ZLIB)
 
     def _verify_block_chk(self, block_idx: int, raw: bytes) -> None:
         """Device-computed per-block integrity checksums (props
@@ -283,18 +297,42 @@ class SSTReader:
         try:
             if (
                 not isinstance(chk, dict)
-                or chk.get("algo") != "poly1"
+                or chk.get("algo") not in ("poly1", "poly1w")
                 or block_idx >= len(chk["values"])
                 or block_idx in self._verified_blocks
             ):
                 return
-            block_len = int(chk["block_bytes"])
+            algo = chk["algo"]
             want = int(chk["values"][block_idx]) & 0xFFFFFFFF
+            if algo == "poly1w":
+                block_len = int(chk["block_words"])
+            else:
+                block_len = int(chk["block_bytes"])
         except (KeyError, TypeError, ValueError):
             return  # foreign/crafted prop — treat as absent
-        from ..utils.checksum import poly_checksum
+        if algo == "poly1w":
+            # word-domain MAC over a planar block's plane words (the
+            # 16-byte header is host-written and excluded)
+            import numpy as np
 
-        got = poly_checksum(raw, length=block_len)
+            from .planar import PLANAR_HEADER
+            from ..utils.checksum import poly_checksum_words
+
+            if (
+                len(raw) < PLANAR_HEADER.size
+                or (len(raw) - PLANAR_HEADER.size) % 4
+            ):
+                raise Corruption(
+                    f"block {block_idx}: truncated planar block "
+                    f"({len(raw)} bytes)"
+                )
+            words = np.frombuffer(raw, dtype="<u4",
+                                  offset=PLANAR_HEADER.size)
+            got = poly_checksum_words(words, length=block_len)
+        else:
+            from ..utils.checksum import poly_checksum
+
+            got = poly_checksum(raw, length=block_len)
         if got != want:
             raise Corruption(
                 f"block {block_idx} checksum mismatch: "
@@ -320,6 +358,17 @@ class SSTReader:
             value = raw[pos:pos + vlen]
             pos += vlen
             yield key, seq, vtype, value
+
+    def _block_iter(
+        self, block_idx: int, raw: bytes
+    ) -> Iterator[Tuple[bytes, int, int, bytes]]:
+        """Per-block decode dispatch: planar blocks (codec nibble) decode
+        via the plane codec; entry-stream blocks via _iter_block."""
+        if self._block_is_planar(block_idx):
+            from .planar import iter_planar_block
+
+            return iter_planar_block(raw)
+        return self._iter_block(raw)
 
     def _effective_seq(self, seq: int) -> int:
         return self.global_seqno if self.global_seqno is not None else seq
@@ -354,7 +403,9 @@ class SSTReader:
             raw = self._read_block(b)
             done = False
             native_res = (
-                NATIVE.get_entries(raw, key) if NATIVE is not None else None
+                NATIVE.get_entries(raw, key)
+                if NATIVE is not None and not self._block_is_planar(b)
+                else None  # native decoder speaks the entry-stream only
             )
             if native_res is not None:
                 matches, past_end = native_res
@@ -364,7 +415,7 @@ class SSTReader:
                 )
                 done = past_end
             else:
-                for k, seq, vtype, value in self._iter_block(raw):
+                for k, seq, vtype, value in self._block_iter(b, raw):
                     if k == key:
                         out.append((self._effective_seq(seq), vtype, value))
                     elif k > key:
@@ -387,7 +438,8 @@ class SSTReader:
         for i, (last_key, _off, _size, _codec) in enumerate(self._index):
             if start is not None and last_key < start:
                 continue
-            for key, seq, vtype, value in self._iter_block(self._read_block(i)):
+            for key, seq, vtype, value in self._block_iter(
+                    i, self._read_block(i)):
                 if start is not None and key < start:
                     continue
                 if end is not None and key >= end:

@@ -157,7 +157,8 @@ class TpuCompactionBackend(CompactionBackend):
         from ..ops.bloom_tpu import bloom_build_tpu
         from ..storage.bloom import num_words_for
         from .chunked import FIELDS, run_kernel_arrays
-        from .format import read_sst_arrays, uniform_widths, write_sst_from_arrays
+        from .format import (planar_widths, read_sst_arrays,
+                             write_sst_from_arrays)
 
         if merge_op is not None and not isinstance(merge_op, UInt64AddOperator):
             return None
@@ -188,10 +189,10 @@ class TpuCompactionBackend(CompactionBackend):
         }
         if merge_op is None and bool((lanes["vtype"] == _MERGE).any()):
             return None
-        # Cheap pre-check BEFORE the kernel: the sink needs uniform output
-        # widths. Keys must be uniform; values must be uniform among the
-        # entries that can survive (deletes contribute no value at the
-        # bottom; kept tombstones mid-level make widths mixed).
+        # Cheap pre-check BEFORE the kernel: the PLANAR sink needs uniform
+        # keys and uniform non-delete value widths (kept tombstones are
+        # fine — the planar layout derives val_len from vtype, so deletes
+        # coexist with fixed-width values, unlike the old row sink).
         kl = lanes["key_len"]
         if total and not (kl == kl[0]).all():
             return None
@@ -200,9 +201,6 @@ class TpuCompactionBackend(CompactionBackend):
         non_del_vlens = vlens[~is_del]
         if len(non_del_vlens) and not (non_del_vlens == non_del_vlens[0]).all():
             return None
-        if not drop_tombstones and is_del.any() and len(non_del_vlens):
-            if non_del_vlens[0] != 0:
-                return None  # kept tombstones (len 0) would mix widths
         kind = (
             MergeKind.UINT64_ADD if isinstance(merge_op, UInt64AddOperator)
             else MergeKind.NONE
@@ -219,13 +217,13 @@ class TpuCompactionBackend(CompactionBackend):
             return None
         if count == 0:
             return []  # fully compacted away — nothing to write
-        if uniform_widths(arrays, count) is None:
+        widths = planar_widths(arrays, count)
+        if widths is None:
             return None
-        stride = int(arrays["key_len"][0]) + int(arrays["val_len"][0]) + 17
+        klen0, vlen0 = widths
+        stride = klen0 + vlen0 + 9  # planar: key + seq_lo + vtype + value
         entries_per_file = max(1024, target_file_bytes // max(1, stride))
         block_entries = max(64, block_bytes // max(1, stride))
-        klen0 = int(arrays["key_len"][0])
-        vlen0 = int(arrays["val_len"][0])
         outputs: List[Tuple[str, dict]] = []
         for start in range(0, count, entries_per_file):
             end = min(start + entries_per_file, count)
@@ -239,22 +237,17 @@ class TpuCompactionBackend(CompactionBackend):
                 jnp.asarray(sub["key_len"]),
                 jnp.asarray(sub_valid), num_words=num_words,
             )
-            # block encoding + checksums happen ON DEVICE (north star:
-            # "block encoding as batched ops"); the sink writes the byte
-            # matrix as-is
-            from ..ops.block_encode import encode_and_checksum
-
-            rows, chks = encode_and_checksum(
-                sub, end - start, klen0, vlen0, block_entries)
             path = path_factory()
+            # PLANAR output: the kernel's struct-of-array lanes ARE the
+            # block planes (storage/planar.py) — no byte interleaving on
+            # either side, ~29% smaller uncompressed than the row format
             props = write_sst_from_arrays(
                 sub, end - start, path,
                 bloom_words=np.asarray(bloom),
                 block_entries=block_entries,
                 compression=compression,
                 bits_per_key=bits_per_key,
-                device_rows=rows,
-                device_checksums=chks,
+                planar=True,
             )
             if props is None:  # should not happen after the width checks
                 for p, _ in outputs:

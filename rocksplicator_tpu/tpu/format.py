@@ -16,7 +16,11 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..storage.bloom import BloomFilter
-from ..storage.sst import (COMPRESSION_ZLIB, ENTRY_FIXED_OVERHEAD, SSTWriter)
+from ..storage.planar import (decode_planar_block, encode_planar_block,
+                              plane_words, planar_props)
+from ..storage.sst import (BLOCK_PLANAR, BLOCK_PLANAR_ZLIB, COMPRESSION_ZLIB,
+                           ENTRY_FIXED_OVERHEAD, SSTWriter)
+from ..utils.checksum import poly_checksum_words
 
 _ENTRY_FIXED_OVERHEAD = ENTRY_FIXED_OVERHEAD
 
@@ -73,6 +77,8 @@ def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
     straight into kernel lanes (no per-entry Python). Returns the arrays
     dict (+ implicit count = rows) or None when the file lacks the uniform
     property (flush-written / foreign files use the tuple path)."""
+    if reader.props.get("planar"):
+        return _read_planar_arrays(reader)
     widths = reader.props.get("uniform")
     if not widths:
         return None
@@ -123,6 +129,141 @@ def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
     }
 
 
+def planar_widths(arrays: Dict[str, np.ndarray], count: int):
+    """(klen, vlen) for the PLANAR sink. Laxer than uniform_widths:
+    DELETE rows carry no value in the planar layout (val_len derives from
+    vtype on read), so kept tombstones coexist with fixed-width values."""
+    if count == 0:
+        return None
+    kl = arrays["key_len"][:count]
+    k0 = int(kl[0])
+    if not ((kl == k0).all() and 0 < k0 <= 24):
+        return None
+    vt = arrays["vtype"][:count]
+    vl = arrays["val_len"][:count]
+    non_del = vl[vt != 2]
+    v0 = int(non_del[0]) if len(non_del) else 0
+    if len(non_del) and not (non_del == v0).all():
+        return None
+    if not (vl[vt == 2] == 0).all():
+        return None
+    return k0, v0
+
+
+def _write_planar(
+    arrays: Dict[str, np.ndarray], count: int, path: str,
+    bloom_words: Optional[np.ndarray], block_entries: int,
+    compression: int, bits_per_key: int, klen: int, vlen: int,
+    device_words: Optional[np.ndarray],
+    device_checksums: Optional[np.ndarray],
+) -> Optional[dict]:
+    """PLANAR sink body: per-block plane bytes + word-domain checksums."""
+    seq32 = bool((arrays["seq_hi"][:count] == 0).all())
+    full_words = plane_words(block_entries, klen, vlen, seq32)
+    writer = SSTWriter(path, compression=compression,
+                       bits_per_key=bits_per_key)
+    try:
+        key_bytes = (
+            np.ascontiguousarray(
+                arrays["key_words_be"][:count].astype(">u4"))
+            .view(np.uint8).reshape(count, 24)[:, :klen]
+        )
+        seqs = (
+            arrays["seq_hi"][:count].astype(np.uint64) << np.uint64(32)
+        ) | arrays["seq_lo"][:count].astype(np.uint64)
+        from ..storage.planar import PLANAR_HEADER, PLANAR_FLAG_SEQ32
+        import struct as _struct
+
+        chks: List[int] = []
+        nblocks = (count + block_entries - 1) // block_entries
+        for bi, start in enumerate(range(0, count, block_entries)):
+            end = min(start + block_entries, count)
+            full = end - start == block_entries
+            if device_words is not None and full and bi < len(device_words):
+                words = np.ascontiguousarray(
+                    device_words[bi], dtype="<u4")
+                raw = PLANAR_HEADER.pack(
+                    block_entries, klen, vlen,
+                    PLANAR_FLAG_SEQ32 if seq32 else 0, 0, 0,
+                ) + words.tobytes()
+                if device_checksums is not None and bi < len(
+                        device_checksums):
+                    chks.append(int(device_checksums[bi]))
+                else:
+                    chks.append(poly_checksum_words(words, full_words))
+            else:
+                raw = encode_planar_block(
+                    arrays, start, end, klen, vlen, seq32)
+                words = np.frombuffer(
+                    raw, dtype="<u4", offset=PLANAR_HEADER.size)
+                chks.append(poly_checksum_words(words, full_words))
+            codec = BLOCK_PLANAR
+            payload = raw
+            if compression == COMPRESSION_ZLIB:
+                z = zlib.compress(raw, 1)
+                if len(z) < len(raw):
+                    codec, payload = BLOCK_PLANAR_ZLIB, z
+            writer.add_encoded_block(
+                payload,
+                last_key=key_bytes[end - 1].tobytes(),
+                num_entries=end - start,
+                keys=[],
+                min_key=key_bytes[start].tobytes(),
+                max_key=key_bytes[end - 1].tobytes(),
+                min_seq=int(seqs[start:end].min()),
+                max_seq=int(seqs[start:end].max()),
+                compressed=False,
+                codec=codec,
+            )
+        if bloom_words is not None:
+            bloom = BloomFilter(
+                len(bloom_words), np.asarray(bloom_words, dtype=np.uint32)
+            )
+        else:
+            bloom = BloomFilter.build(
+                [key_bytes[i].tobytes() for i in range(count)], bits_per_key
+            )
+        extra_props = {
+            "num_keys": int(count),
+            "planar": planar_props(klen, vlen, seq32),
+            "block_chk": {
+                "algo": "poly1w",
+                "block_words": int(full_words),
+                "values": chks,
+            },
+        }
+        return writer.finish(precomputed_bloom=bloom,
+                             extra_props=extra_props)
+    except BaseException:
+        writer.abandon()
+        raise
+
+
+def _read_planar_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
+    """PLANAR source path: per-block plane decode (views + reshapes),
+    lanes concatenated across blocks."""
+    try:
+        parts = [
+            decode_planar_block(reader._read_block(i))
+            for i in range(len(reader._index))
+        ]
+    except Exception:
+        return None  # foreign/corrupt planar props — tuple path validates
+    if not parts:
+        return None
+    lanes = {
+        f: np.concatenate([p[f] for p in parts])
+        for f in parts[0]
+    }
+    if reader.global_seqno is not None:
+        n = len(lanes["seq_lo"])
+        lanes["seq_lo"] = np.full(
+            n, reader.global_seqno & 0xFFFFFFFF, dtype=np.uint32)
+        lanes["seq_hi"] = np.full(
+            n, reader.global_seqno >> 32, dtype=np.uint32)
+    return lanes
+
+
 def write_sst_from_arrays(
     arrays: Dict[str, np.ndarray],
     count: int,
@@ -133,6 +274,8 @@ def write_sst_from_arrays(
     bits_per_key: int = 10,
     device_rows: Optional[np.ndarray] = None,
     device_checksums: Optional[np.ndarray] = None,
+    planar: bool = False,
+    device_words: Optional[np.ndarray] = None,
 ) -> Optional[dict]:
     """Write kernel-output arrays as a TSST file without per-entry Python.
     Returns the props dict, or None when rows aren't uniform-width (caller
@@ -141,7 +284,21 @@ def write_sst_from_arrays(
     ``device_rows``/``device_checksums``: the on-device block encoder's
     output (ops/block_encode.py) — the (count, stride) byte matrix is
     written as-is (no host re-encoding) and the per-block checksums land
-    in the "block_chk" prop, which readers verify on every block read."""
+    in the "block_chk" prop, which readers verify on every block read.
+
+    ``planar=True`` writes PLANAR blocks (storage/planar.py): u32 planes
+    in kernel lane order — smaller files and no byte interleaving on
+    either side. ``device_words`` optionally carries the device planar
+    encoder's (nblocks, words) matrix for full blocks (the tail block is
+    host-packed: its plane lengths differ from the fixed device shape)."""
+    if planar:
+        widths = planar_widths(arrays, count)
+        if widths is None:
+            return None
+        return _write_planar(
+            arrays, count, path, bloom_words, block_entries, compression,
+            bits_per_key, widths[0], widths[1], device_words,
+            device_checksums)
     widths = uniform_widths(arrays, count)
     if widths is None:
         return None

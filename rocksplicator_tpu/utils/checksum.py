@@ -41,3 +41,17 @@ def poly_checksum(data: bytes, length: int | None = None) -> int:
             ((buf.astype(np.uint32) + np.uint32(1))
              * _powers(len(buf))).sum(dtype=np.uint32)
         )
+
+
+def poly_checksum_words(words: np.ndarray, length: int | None = None) -> int:
+    """Word-domain variant for PLANAR blocks: H = Σ (w_i + 1) · r^(i+1)
+    mod 2^32 over u32 plane words zero-padded to ``length`` words. The
+    device computes the identical value over its plane matrix
+    (ops/block_encode.py planar_checksums_tpu)."""
+    buf = np.asarray(words, dtype=np.uint32).ravel()
+    if length is not None and len(buf) < length:
+        buf = np.pad(buf, (0, length - len(buf)))
+    with np.errstate(over="ignore"):
+        return int(
+            ((buf + np.uint32(1)) * _powers(len(buf))).sum(dtype=np.uint32)
+        )

@@ -1,0 +1,224 @@
+"""PLANAR block format tests: codec round-trip, container integration,
+host/device encode parity, checksum verification, reader dispatch.
+
+Format-compat discipline per SURVEY §4 (sst_load_compatibility_test):
+entry-stream (v1) files must stay readable alongside planar output —
+tests/test_golden_formats.py pins the old format; these pin the new.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from rocksplicator_tpu.ops.kv_format import pack_entries
+from rocksplicator_tpu.storage.errors import Corruption
+from rocksplicator_tpu.storage.planar import (
+    decode_planar_block, encode_planar_block, iter_planar_block,
+    plane_words, PLANAR_HEADER)
+from rocksplicator_tpu.storage.records import OpType
+from rocksplicator_tpu.storage.sst import SSTReader
+from rocksplicator_tpu.tpu.format import (
+    planar_widths, read_sst_arrays, write_sst_from_arrays)
+
+pack64 = struct.Struct("<q").pack
+
+
+def _arrays(entries):
+    b = pack_entries(entries)
+    n = b.num_valid()
+    return {
+        "key_words_be": b.key_words_be[:n],
+        "key_words_le": b.key_words_le[:n],
+        "key_len": b.key_len[:n],
+        "seq_hi": b.seq_hi[:n],
+        "seq_lo": b.seq_lo[:n],
+        "vtype": b.vtype[:n],
+        "val_words": b.val_words[:n],
+        "val_len": b.val_len[:n],
+    }, n
+
+
+def _entries(n, klen=16, with_deletes=False, big_seq=False):
+    out = []
+    for i in range(n):
+        key = f"key{i:08d}".encode().ljust(klen, b"x")[:klen]
+        seq = (1 << 40) + i if big_seq else 1000 + i
+        if with_deletes and i % 7 == 3:
+            out.append((key, seq, OpType.DELETE, b""))
+        else:
+            out.append((key, seq, OpType.PUT, pack64(i * 3)))
+    return out
+
+
+@pytest.mark.parametrize("seq32", [True, False])
+@pytest.mark.parametrize("with_deletes", [False, True])
+def test_planar_block_roundtrip(seq32, with_deletes):
+    entries = _entries(37, with_deletes=with_deletes, big_seq=not seq32)
+    arrays, n = _arrays(entries)
+    raw = encode_planar_block(arrays, 0, n, 16, 8, seq32)
+    assert len(raw) == PLANAR_HEADER.size + 4 * plane_words(n, 16, 8, seq32)
+    got = list(iter_planar_block(raw))
+    want = [(k, s, int(vt), v) for k, s, vt, v in entries]
+    assert [(k, s, vt, v) for k, s, vt, v in got] == want
+    lanes = decode_planar_block(raw)
+    assert (lanes["key_len"] == 16).all()
+    assert (lanes["val_len"] == arrays["val_len"]).all()
+
+
+def test_planar_block_rejects_truncation():
+    arrays, n = _arrays(_entries(8))
+    raw = encode_planar_block(arrays, 0, n, 16, 8, True)
+    with pytest.raises(Corruption):
+        decode_planar_block(raw[:-4])
+
+
+def test_planar_sst_roundtrip_and_reader_dispatch(tmp_path):
+    entries = _entries(1000, with_deletes=True)
+    arrays, n = _arrays(entries)
+    path = str(tmp_path / "planar.tsst")
+    props = write_sst_from_arrays(
+        arrays, n, path, block_entries=256, planar=True)
+    assert props is not None
+    r = SSTReader(path)
+    assert r.props["planar"] == [16, 8, 1]
+    # generic tuple iteration (reader dispatch on the codec nibble)
+    got = list(r.iterate())
+    assert got == entries
+    # point lookups hit the planar decode path too
+    k, s, vt, v = entries[500]
+    assert r.get_entries(k) == [(s, int(vt), v)]
+    assert r.get_entries(b"absent-key-000000") == []
+    # array source path: lanes come back without per-entry work
+    lanes = read_sst_arrays(r)
+    assert lanes is not None and len(lanes["seq_lo"]) == n
+    assert (lanes["vtype"] == arrays["vtype"]).all()
+    assert (lanes["seq_lo"] == arrays["seq_lo"]).all()
+    r.close()
+
+
+def test_planar_sst_smaller_than_rows(tmp_path):
+    import os
+
+    entries = _entries(4096)
+    arrays, n = _arrays(entries)
+    p_rows = str(tmp_path / "rows.tsst")
+    p_planar = str(tmp_path / "planar.tsst")
+    # compression off isolates the encoding-size difference
+    assert write_sst_from_arrays(
+        arrays, n, p_rows, block_entries=1024, compression=0) is not None
+    assert write_sst_from_arrays(
+        arrays, n, p_planar, block_entries=1024, compression=0,
+        planar=True) is not None
+    rows_sz = os.path.getsize(p_rows)
+    planar_sz = os.path.getsize(p_planar)
+    # 41 B/entry -> 29 B (16B key + 4B seq_lo + 1B vtype + 8B val): ~29%
+    assert planar_sz < rows_sz * 0.78, (planar_sz, rows_sz)
+
+
+def test_planar_checksum_detects_corruption(tmp_path):
+    entries = _entries(512)
+    arrays, n = _arrays(entries)
+    path = str(tmp_path / "planar.tsst")
+    # compression=0 keeps on-disk bytes == block bytes so a flipped file
+    # byte lands in a plane word
+    props = write_sst_from_arrays(
+        arrays, n, path, block_entries=256, compression=0, planar=True)
+    assert props["block_chk"]["algo"] == "poly1w"
+    with open(path, "r+b") as f:
+        f.seek(PLANAR_HEADER.size + 64)  # inside block 0's planes
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0x40]))
+    r = SSTReader(path)
+    with pytest.raises(Corruption):
+        list(r.iterate())
+    r.close()
+
+
+def test_planar_widths_allows_tombstones_rejects_mixed():
+    arrays, n = _arrays(_entries(50, with_deletes=True))
+    assert planar_widths(arrays, n) == (16, 8)
+    # mixed non-delete value widths are not planar-expressible
+    mixed, m = _arrays([
+        (b"k" * 16, 2, OpType.PUT, b"12345678"),
+        (b"m" * 16, 1, OpType.PUT, b"1234"),
+    ])
+    assert planar_widths(mixed, m) is None
+
+
+def test_planar_global_seqno_override(tmp_path):
+    entries = _entries(10)
+    arrays, n = _arrays(entries)
+    path = str(tmp_path / "planar.tsst")
+    assert write_sst_from_arrays(
+        arrays, n, path, block_entries=8, planar=True) is not None
+    # simulate ingestion stamping (reference global-seqno semantics)
+    from rocksplicator_tpu.storage import sst as sst_mod
+
+    r = SSTReader(path)
+    r.global_seqno = 777
+    lanes = read_sst_arrays(r)
+    assert (lanes["seq_lo"] == 777).all() and (lanes["seq_hi"] == 0).all()
+    for k, s, vt, v in r.iterate():
+        assert s == 777
+    r.close()
+
+
+def test_device_planar_encode_matches_host():
+    import jax.numpy as jnp
+
+    from rocksplicator_tpu.ops.block_encode import (
+        encode_planar_words_tpu, planar_checksums_tpu)
+    from rocksplicator_tpu.storage.planar import PLANAR_FLAG_SEQ32
+    from rocksplicator_tpu.utils.checksum import poly_checksum_words
+
+    entries = _entries(512, with_deletes=True)
+    arrays, n = _arrays(entries)
+    be = 128  # block_entries; n == 4 full blocks
+    for seq32 in (True, False):
+        dev = np.asarray(encode_planar_words_tpu(
+            jnp.asarray(arrays["key_words_be"]),
+            jnp.asarray(arrays["seq_hi"]), jnp.asarray(arrays["seq_lo"]),
+            jnp.asarray(arrays["vtype"]), jnp.asarray(arrays["val_words"]),
+            klen=16, vlen=8, seq32=seq32, block_entries=be,
+        ))
+        chk = np.asarray(planar_checksums_tpu(jnp.asarray(dev)))
+        for bi in range(n // be):
+            host = encode_planar_block(
+                arrays, bi * be, (bi + 1) * be, 16, 8, seq32)
+            host_words = np.frombuffer(
+                host, dtype="<u4", offset=PLANAR_HEADER.size)
+            assert (dev[bi] == host_words).all(), (seq32, bi)
+            assert int(chk[bi]) == poly_checksum_words(
+                host_words, plane_words(be, 16, 8, seq32))
+
+
+def test_planar_sink_device_words_path(tmp_path):
+    import jax.numpy as jnp
+
+    from rocksplicator_tpu.ops.block_encode import (
+        encode_planar_words_tpu, planar_checksums_tpu)
+
+    entries = _entries(600)  # 2 full blocks of 256 + tail of 88
+    arrays, n = _arrays(entries)
+    cap = 1024
+    padded = {
+        k: np.pad(v, [(0, cap - n)] + [(0, 0)] * (v.ndim - 1))
+        for k, v in arrays.items()
+    }
+    words = np.asarray(encode_planar_words_tpu(
+        jnp.asarray(padded["key_words_be"]),
+        jnp.asarray(padded["seq_hi"]), jnp.asarray(padded["seq_lo"]),
+        jnp.asarray(padded["vtype"]), jnp.asarray(padded["val_words"]),
+        klen=16, vlen=8, seq32=True, block_entries=256,
+    ))
+    chks = np.asarray(planar_checksums_tpu(jnp.asarray(words)))
+    path = str(tmp_path / "dev.tsst")
+    props = write_sst_from_arrays(
+        arrays, n, path, block_entries=256, planar=True,
+        device_words=words, device_checksums=chks)
+    assert props is not None
+    r = SSTReader(path)
+    assert list(r.iterate()) == entries  # tail host-packed, checksums ok
+    r.close()
