@@ -37,6 +37,7 @@ from ..rpc.errors import RpcApplicationError
 from ..storage import backup as backup_mod
 from ..storage.engine import DB, DBOptions, destroy_db
 from ..storage.errors import StorageError
+from ..utils.flags import FLAGS, define_flag
 from ..utils.object_lock import ObjectLock
 from ..utils.objectstore import build_object_store
 from ..utils.segment_utils import db_name_to_segment
@@ -46,6 +47,12 @@ from .application_db import ApplicationDB
 from .db_manager import ApplicationDBManager
 
 log = logging.getLogger(__name__)
+
+# Reference gflag parity: direct-IO SST downloads keep a restore/ingest
+# storm from evicting the serving working set (s3util.h:82-103)
+define_flag("s3_direct_io", False,
+            "download ingest SSTs through O_DIRECT sinks (page-cache "
+            "bypass)")
 
 # AdminErrorCode parity (rocksdb_admin.thrift)
 DB_NOT_FOUND = "DB_NOT_FOUND"
@@ -486,7 +493,9 @@ class AdminHandler:
         tmp = tempfile.mkdtemp(prefix=f"rstpu-ingest-{db_name}-")
         try:
             with Timer("admin.sst_download_ms"):
-                local_files = store.get_objects(s3_path, tmp)  # :1724-1726
+                local_files = store.get_objects(  # :1724-1726
+                    s3_path, tmp,
+                    direct_io=bool(FLAGS.get("s3_direct_io")))
             sst_files = [p for p in local_files if p.endswith(".tsst")]
             if not sst_files:
                 raise RpcApplicationError(DB_ADMIN_ERROR, f"no .tsst under {s3_path}")

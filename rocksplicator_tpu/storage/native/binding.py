@@ -72,6 +72,17 @@ class NativeLib:
             _u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64, ctypes.c_uint64,
             _u64p, _u8p, _u64p, _u64p, ctypes.POINTER(ctypes.c_int32),
         ]
+        # planar lookup may be absent in stale builds; probe and gate
+        try:
+            lib.tsst_planar_get_entries.restype = ctypes.c_int64
+            lib.tsst_planar_get_entries.argtypes = [
+                _u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64,
+                ctypes.c_uint64, _u64p, _u8p, _u8p, ctypes.c_uint64,
+                _u64p, ctypes.POINTER(ctypes.c_int32),
+            ]
+            self._has_planar = True
+        except AttributeError:
+            self._has_planar = False
         lib.wal_scan.restype = ctypes.c_int64
         lib.wal_scan.argtypes = [
             _u8p, ctypes.c_uint64, ctypes.c_uint64,
@@ -191,6 +202,47 @@ class NativeLib:
             [
                 (int(seqs[i]), int(vtypes[i]),
                  raw[int(val_off[i]):int(val_off[i]) + int(val_len[i])])
+                for i in range(n)
+            ],
+            bool(past_end.value),
+        )
+
+    def planar_get_entries(self, raw: bytes, key: bytes,
+                           max_matches: int = 64
+                           ) -> Optional[Tuple[list, bool]]:
+        """get_entries over a PLANAR block (storage/planar.py): binary
+        search in C over the key planes, values reassembled from the
+        value planes. None = slow path needed."""
+        if not self._has_planar:
+            return None
+        data = np.frombuffer(raw, dtype=np.uint8)
+        kbuf = (np.frombuffer(key, dtype=np.uint8) if key
+                else np.zeros(1, np.uint8))
+        vlen_cap = int(raw[5]) if len(raw) >= 16 else 0
+        seqs = np.empty(max_matches, dtype=np.uint64)
+        vtypes = np.empty(max_matches, dtype=np.uint8)
+        vals = np.zeros((max_matches, max(1, vlen_cap)), dtype=np.uint8)
+        val_len = np.empty(max_matches, dtype=np.uint64)
+        past_end = ctypes.c_int32(0)
+        n = self._lib.tsst_planar_get_entries(
+            self._u8(data), len(raw), self._u8(kbuf), len(key),
+            max_matches, self._u64(seqs), self._u8(vtypes),
+            self._u8(vals), max(1, vlen_cap), self._u64(val_len),
+            ctypes.byref(past_end),
+        )
+        if n == -1:
+            if len(raw) >= 16:
+                total = int.from_bytes(raw[:4], "little")
+                if max_matches < total:
+                    return self.planar_get_entries(
+                        raw, key, min(total, max_matches * 8))
+            return None
+        if n < 0:
+            return None
+        return (
+            [
+                (int(seqs[i]), int(vtypes[i]),
+                 vals[i, :int(val_len[i])].tobytes())
                 for i in range(n)
             ],
             bool(past_end.value),

@@ -241,3 +241,79 @@ int32_t bloom_may_contain(
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// PLANAR block point lookup (storage/planar.py layout)
+// ---------------------------------------------------------------------------
+//
+// Block: u32 n | u8 klen | u8 vlen | u8 flags | u8 0 | u64 0, then u32
+// planes: key words (BE values, ceil(klen/4) x n), seq_lo (n), seq_hi
+// (n, absent when flags&1), vtype (ceil(n/4), 4 packed/word), value
+// words (LE values, ceil(vlen/4) x n). Keys ascending -> binary search,
+// then the contiguous match run (MERGE stacks). -2 = malformed.
+
+static inline int planar_cmp_key(
+    const uint32_t* kw_planes, uint64_t n, uint64_t i,
+    uint32_t bklen, const uint8_t* key, uint64_t klen) {
+  // compare entry i's key bytes (BE bytes of each plane word) vs key
+  uint64_t min_len = bklen < klen ? bklen : klen;
+  for (uint64_t b = 0; b < min_len; b++) {
+    uint32_t w; memcpy(&w, (const uint8_t*)(kw_planes + (b / 4) * n + i), 4);
+    uint8_t eb = (uint8_t)(w >> (24 - 8 * (b % 4)));
+    if (eb != key[b]) return eb < key[b] ? -1 : 1;
+  }
+  if (bklen == klen) return 0;
+  return bklen < klen ? -1 : 1;
+}
+
+extern "C" int64_t tsst_planar_get_entries(
+    const uint8_t* data, uint64_t len,
+    const uint8_t* key, uint64_t klen, uint64_t max_matches,
+    uint64_t* seqs, uint8_t* vtypes,
+    uint8_t* out_vals, uint64_t vlen_cap, uint64_t* val_lens,
+    int32_t* past_end) {
+  *past_end = 0;
+  if (len < 16) return -2;
+  uint32_t n = get_u32(data);
+  uint8_t bklen = data[4], bvlen = data[5], flags = data[6];
+  uint64_t kw = (bklen + 3) / 4, vw = (bvlen + 3) / 4;
+  int seq32 = flags & 1;
+  uint64_t words = (uint64_t)n * (kw + 1 + (seq32 ? 0 : 1) + vw)
+                 + (n + 3) / 4;
+  if (len != 16 + 4 * words) return -2;
+  if (n == 0) return 0;
+  const uint32_t* planes = (const uint32_t*)(data + 16);
+  const uint32_t* kwp = planes;
+  const uint32_t* seq_lo = planes + kw * n;
+  const uint32_t* seq_hi = seq32 ? nullptr : seq_lo + n;
+  const uint8_t* vtp = (const uint8_t*)(seq_lo + n + (seq32 ? 0 : n));
+  const uint32_t* vvp = (const uint32_t*)(vtp + 4 * ((n + 3) / 4));
+
+  // lower_bound: first index with entry key >= query key
+  uint64_t lo = 0, hi = n;
+  while (lo < hi) {
+    uint64_t mid = (lo + hi) / 2;
+    if (planar_cmp_key(kwp, n, mid, bklen, key, klen) < 0) lo = mid + 1;
+    else hi = mid;
+  }
+  uint64_t found = 0;
+  for (uint64_t i = lo; i < n; i++) {
+    int c = planar_cmp_key(kwp, n, i, bklen, key, klen);
+    if (c != 0) { if (c > 0) *past_end = 1; break; }
+    if (found >= max_matches) return -1;
+    uint64_t s = seq_lo[i];
+    if (seq_hi) s |= ((uint64_t)seq_hi[i]) << 32;
+    seqs[found] = s;
+    uint8_t vt = vtp[i];
+    vtypes[found] = vt;
+    uint64_t vlen = (vt == 2) ? 0 : bvlen;
+    if (vlen > vlen_cap) return -2;
+    for (uint64_t b = 0; b < vlen; b++) {
+      uint32_t w; memcpy(&w, (const uint8_t*)(vvp + (b / 4) * n + i), 4);
+      out_vals[found * vlen_cap + b] = (uint8_t)(w >> (8 * (b % 4)));
+    }
+    val_lens[found] = vlen;
+    found++;
+  }
+  return (int64_t)found;
+}

@@ -402,11 +402,12 @@ class SSTReader:
         for b in range(block, len(self._index)):
             raw = self._read_block(b)
             done = False
-            native_res = (
-                NATIVE.get_entries(raw, key)
-                if NATIVE is not None and not self._block_is_planar(b)
-                else None  # native decoder speaks the entry-stream only
-            )
+            if NATIVE is None:
+                native_res = None
+            elif self._block_is_planar(b):
+                native_res = NATIVE.planar_get_entries(raw, key)
+            else:
+                native_res = NATIVE.get_entries(raw, key)
             if native_res is not None:
                 matches, past_end = native_res
                 out.extend(

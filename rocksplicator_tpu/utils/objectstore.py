@@ -50,7 +50,8 @@ class ObjectStore:
         if self._limiter is not None and nbytes > 0:
             self._limiter.apply_cost(nbytes)
 
-    def get_object(self, key: str, local_path: str) -> None:
+    def get_object(self, key: str, local_path: str,
+                   direct_io: bool = False) -> None:
         raise NotImplementedError
 
     def get_object_bytes(self, key: str) -> bytes:
@@ -75,10 +76,12 @@ class ObjectStore:
     #    parallel batched checkpoint transfer) ----------------------------
 
     def get_objects(
-        self, prefix: str, local_dir: str, parallelism: int = 8
+        self, prefix: str, local_dir: str, parallelism: int = 8,
+        direct_io: bool = False,
     ) -> List[str]:
         """Download every object under ``prefix`` into ``local_dir``.
-        Returns local file paths."""
+        ``direct_io`` bypasses the page cache (O_DIRECT sink — reference
+        s3util direct-IO download path). Returns local file paths."""
         keys = self.list_objects(prefix)
         os.makedirs(local_dir, exist_ok=True)
         results: List[str] = []
@@ -88,7 +91,7 @@ class ObjectStore:
             name = key[len(prefix):].lstrip("/") or os.path.basename(key)
             local_path = os.path.join(local_dir, name)
             os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
-            self.get_object(key, local_path)
+            self.get_object(key, local_path, direct_io=direct_io)
             with lock:
                 results.append(local_path)
 
@@ -139,13 +142,21 @@ class LocalObjectStore(ObjectStore):
             raise ObjectStoreError(f"key escapes bucket root: {key!r}")
         return path
 
-    def get_object(self, key: str, local_path: str) -> None:
+    def get_object(self, key: str, local_path: str,
+                   direct_io: bool = False) -> None:
         src = self._path(key)
         if not os.path.isfile(src):
             raise ObjectStoreError(f"no such object: {key}")
         self._charge(os.path.getsize(src))
         os.makedirs(os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
-        shutil.copyfile(src, local_path)
+        if direct_io:
+            from .directio import DirectIOFile
+
+            with open(src, "rb") as f, DirectIOFile(local_path) as out:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    out.write(chunk)
+        else:
+            shutil.copyfile(src, local_path)
 
     def get_object_bytes(self, key: str) -> bytes:
         src = self._path(key)
@@ -241,11 +252,13 @@ class S3ObjectStore(ObjectStore):
         except self._S3Error as e:
             raise ObjectStoreError(str(e)) from e
 
-    def get_object(self, key: str, local_path: str) -> None:
+    def get_object(self, key: str, local_path: str,
+                   direct_io: bool = False) -> None:
         os.makedirs(
             os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
         n = self._wrap(
-            self._client.get_object_to_file, key.lstrip("/"), local_path)
+            self._client.get_object_to_file, key.lstrip("/"), local_path,
+            direct_io)
         self._charge(n)
 
     def get_object_bytes(self, key: str) -> bytes:

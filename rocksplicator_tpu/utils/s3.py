@@ -205,6 +205,7 @@ class S3Client:
         extra_headers: Optional[Dict[str, str]] = None,
         body_path: Optional[str] = None,
         sink_path: Optional[str] = None,
+        sink_direct: bool = False,
     ) -> Tuple[int, Dict[str, str], bytes]:
         """One signed request with retries. ``body_path`` streams a file
         up (payload hashed incrementally first — SigV4 signs the hash, so
@@ -276,7 +277,15 @@ class S3Client:
                     rheaders = {k.lower(): v for k, v in resp.getheaders()}
                     if sink_path is not None and status == 200:
                         tmp = _tmp_name(sink_path)
-                        with open(tmp, "wb") as out:
+                        if sink_direct:
+                            # page-cache-bypassing sink (reference
+                            # DirectIOWritableFile, s3util.h:82-103)
+                            from .directio import DirectIOFile
+
+                            sink_cm = DirectIOFile(tmp)
+                        else:
+                            sink_cm = open(tmp, "wb")
+                        with sink_cm as out:
                             for chunk in iter(
                                     lambda: resp.read(1 << 20), b""):
                                 out.write(chunk)
@@ -318,12 +327,13 @@ class S3Client:
             raise self._error(status, data, f"getObject {key}")
         return data
 
-    def get_object_to_file(self, key: str, local_path: str) -> int:
+    def get_object_to_file(self, key: str, local_path: str,
+                           direct_io: bool = False) -> int:
         """Streams the object to ``local_path`` (1 MiB chunks, atomic
-        replace — the direct-IO-download analog, s3util.h:82-103).
-        Returns the byte count."""
+        replace; ``direct_io`` bypasses the page cache via O_DIRECT —
+        s3util.h:82-103). Returns the byte count."""
         status, headers, data = self._request(
-            "GET", key, sink_path=local_path)
+            "GET", key, sink_path=local_path, sink_direct=direct_io)
         if status != 200:
             raise self._error(status, data, f"getObject {key}")
         try:

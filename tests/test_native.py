@@ -149,3 +149,52 @@ def test_native_point_lookup_deep_merge_stack_retry():
     assert res is not None
     matches, past_end = res
     assert len(matches) == n and past_end
+
+
+def test_native_planar_get_entries_parity():
+    """Native planar point lookup vs the Python planar codec."""
+    import struct
+
+    from rocksplicator_tpu.ops.kv_format import pack_entries
+    from rocksplicator_tpu.storage.native.binding import get_native
+    from rocksplicator_tpu.storage.planar import (
+        encode_planar_block, iter_planar_block)
+    from rocksplicator_tpu.storage.records import OpType
+
+    native = get_native()
+    if native is None or not native._has_planar:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    pk = struct.Struct("<q").pack
+    entries = []
+    for i in range(40):
+        key = f"key{i:05d}".encode().ljust(12, b"p")
+        if i == 17:  # a MERGE stack: several entries for one key
+            for s in (9, 7, 5):
+                entries.append((key, 100 + s, OpType.MERGE, pk(s)))
+        entries.append((key, 50 + i, OpType.PUT, pk(i))
+                       if i % 5 else (key, 50 + i, OpType.DELETE, b""))
+    entries.sort(key=lambda e: (e[0], -e[1]))
+    b = pack_entries(entries)
+    n = b.num_valid()
+    arrays = {f: getattr(b, f)[:n] for f in (
+        "key_words_be", "key_len", "seq_hi", "seq_lo", "vtype",
+        "val_words", "val_len")}
+    for seq32 in (True, False):
+        raw = encode_planar_block(arrays, 0, n, 12, 8, seq32)
+        ref = list(iter_planar_block(raw))
+        for probe_key in {e[0] for e in entries} | {b"absent", b"key00017"}:
+            want = [(s, vt, v) for k, s, vt, v in ref if k == probe_key]
+            got = native.planar_get_entries(raw, probe_key, max_matches=2)
+            assert got is not None
+            matches, past_end = got
+            assert matches == want, (probe_key, seq32)
+            if want and probe_key != ref[-1][0]:
+                assert past_end  # stopped at a greater key
+        # absent key smaller than everything: past_end must be set
+        m, pe = native.planar_get_entries(raw, b"aaa")
+        assert m == [] and pe
+        # absent key greater than everything: later blocks may match
+        m, pe = native.planar_get_entries(raw, b"zzz")
+        assert m == [] and not pe

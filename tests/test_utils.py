@@ -727,3 +727,52 @@ def test_multi_file_poller(tmp_path, file_watcher):
     a.write_bytes(b"A3")
     file_watcher.poll_now()
     assert seen[-1].get(str(a)) == b"A1"  # cancelled: no more updates
+
+
+# ---------------------------------------------------------------------
+# direct-IO sink (utils/directio.py — reference s3util.h:82-103 parity)
+# ---------------------------------------------------------------------
+
+def test_directio_file_roundtrip(tmp_path):
+    import os
+
+    from rocksplicator_tpu.utils.directio import ALIGN, DirectIOFile
+
+    # odd chunk sizes exercise buffering, aligned flushes, and the
+    # unaligned tail path
+    chunks = [b"a" * 10, b"b" * ALIGN, b"c" * (ALIGN * 3 + 17), b"d" * 5]
+    path = str(tmp_path / "direct.bin")
+    with DirectIOFile(path, buffer_blocks=2) as f:
+        for c in chunks:
+            f.write(c)
+    want = b"".join(chunks)
+    with open(path, "rb") as f:
+        assert f.read() == want
+    assert os.path.getsize(path) == len(want)
+
+
+def test_directio_exact_multiple_no_tail(tmp_path):
+    from rocksplicator_tpu.utils.directio import ALIGN, DirectIOFile
+
+    path = str(tmp_path / "aligned.bin")
+    data = bytes(range(256)) * (ALIGN // 256) * 4  # exactly 4 blocks
+    with DirectIOFile(path) as f:
+        f.write(data)
+    with open(path, "rb") as f:
+        assert f.read() == data
+
+
+def test_objectstore_direct_io_download(tmp_path):
+    from rocksplicator_tpu.utils.objectstore import LocalObjectStore
+
+    store = LocalObjectStore(str(tmp_path / "bucket"))
+    payload = b"x" * 10000 + b"tail"
+    store.put_object_bytes("sst/a.tsst", payload)
+    out = str(tmp_path / "out" / "a.tsst")
+    store.get_object("sst/a.tsst", out, direct_io=True)
+    with open(out, "rb") as f:
+        assert f.read() == payload
+    got = store.get_objects("sst", str(tmp_path / "batch"), direct_io=True)
+    assert len(got) == 1
+    with open(got[0], "rb") as f:
+        assert f.read() == payload
