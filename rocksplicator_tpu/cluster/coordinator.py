@@ -10,7 +10,21 @@ layer:
 - sequential nodes (``path-0000000001``) for lock/election recipes;
 - long-poll watches on data and children (the same no-thread-parked
   pattern as the replication server);
-- client-side distributed lock + leader election recipes.
+- client-side distributed lock + leader election recipes;
+- **replication**: a standby server (``replica_of=(host, port)``) tails
+  the primary's mutation stream (long-poll, resumable by index, full
+  state transfer when behind), applies every mutation including
+  ephemerals and session lifecycle, persists durable state to its OWN
+  WAL+snapshot, and serves reads/watches. ``promote()`` turns it into
+  the primary: replicated sessions get a fresh TTL grace window (the ZK
+  session-re-establishment analog) so ephemeral registrations survive a
+  failover as long as owners keep heartbeating. ``CoordinatorClient``
+  accepts fallback endpoints and rotates on connection failure or
+  NOT_PRIMARY. Failover is operator/controller-driven by default;
+  ``auto_promote_after`` opts a standby into self-promotion after the
+  primary has been unreachable that long (deploy at most one such
+  standby — two could split-brain on a partition, the reason ZK uses
+  quorum; the conservative default is manual).
 """
 
 from __future__ import annotations
@@ -34,8 +48,12 @@ NODE_EXISTS = "NODE_EXISTS"
 BAD_VERSION = "BAD_VERSION"
 NO_SESSION = "NO_SESSION"
 NOT_EMPTY = "NOT_EMPTY"
+NOT_PRIMARY = "NOT_PRIMARY"
 
 DEFAULT_SESSION_TTL = 6.0
+# mutation-stream ring: a standby farther behind than this does a full
+# state transfer instead of an incremental catch-up
+RECENT_MUTATIONS_CAP = 8192
 
 
 class _Node:
@@ -233,11 +251,16 @@ class CoordinatorServer:
 
     def __init__(self, port: int = 0, ioloop: Optional[IoLoop] = None,
                  session_ttl: float = DEFAULT_SESSION_TTL,
-                 data_dir: Optional[str] = None):
+                 data_dir: Optional[str] = None,
+                 replica_of: Optional[Tuple[str, int]] = None,
+                 auto_promote_after: Optional[float] = None):
+        import collections
+
         self._ioloop = ioloop or IoLoop.default()
         self._nodes: Dict[str, _Node] = {"/": _Node(b"", None)}
         self._sessions: Dict[int, float] = {}  # sid -> expiry deadline
         self._session_ids = itertools.count(1)
+        self._max_sid_seen = 0
         self._lock = threading.Lock()
         self._ttl = session_ttl
         self._change_event: Dict[str, asyncio.Event] = {}
@@ -245,6 +268,23 @@ class CoordinatorServer:
         self._data_dir = data_dir
         self._dirty = False
         self._wal: Optional[_Wal] = None
+        # replication: every mutation gets a stream index; a bounded ring
+        # backs incremental standby catch-up. The epoch token qualifies
+        # indices (the zxid-epoch analog): a restarted primary starts a
+        # new epoch, so a standby resuming with stale indices is forced
+        # into a full state transfer instead of silently applying a
+        # divergent suffix.
+        import uuid
+
+        self._mut_index = 0
+        self._epoch = uuid.uuid4().hex
+        self._recent: "collections.deque" = collections.deque(
+            maxlen=RECENT_MUTATIONS_CAP)
+        self._stream_event = asyncio.Event()
+        self._upstream = replica_of
+        self._standby = replica_of is not None
+        self._auto_promote_after = auto_promote_after
+        self._standby_task = None
         if data_dir:
             self._load_snapshot()
             self._replay_wal()
@@ -256,6 +296,8 @@ class CoordinatorServer:
         self._snapshot_task = (
             self._ioloop.run_coro(self._snapshot_loop()) if data_dir else None
         )
+        if self._standby:
+            self._standby_task = self._ioloop.run_coro(self._standby_loop())
 
     # -- durability --------------------------------------------------------
 
@@ -289,49 +331,34 @@ class CoordinatorServer:
     def _replay_wal(self) -> None:
         """Apply WAL records on top of the snapshot. Records hold absolute
         resulting state, so re-applying ones already captured by the
-        snapshot is harmless."""
+        snapshot is harmless. Ephemeral creates are skipped — those
+        sessions died with the process (standby APPLY differs: see
+        _apply_record_locked)."""
         with self._lock:
             for rec in _Wal.replay(self._wal_path()):
-                op = rec.get("op")
-                if op == "create":
-                    parent = self._parent(rec["path"])
-                    parts = [p for p in parent.split("/") if p]
-                    cur = ""
-                    for p in parts:
-                        cur += "/" + p
-                        self._nodes.setdefault(cur, _Node(b"", None))
-                    if rec.get("seq") is not None:
-                        pnode = self._nodes.get(parent)
-                        if pnode is not None:
-                            pnode.seq_counter = max(
-                                pnode.seq_counter, rec["seq"] + 1)
-                    if not rec.get("ephemeral"):
-                        node = self._nodes.setdefault(
-                            rec["path"], _Node(b"", None))
-                        node.value = bytes.fromhex(rec["value"])
-                elif op == "set":
-                    node = self._nodes.get(rec["path"])
-                    if node is not None:
-                        node.value = bytes.fromhex(rec["value"])
-                        node.version = rec["version"]
-                elif op == "delete":
-                    prefix = rec["path"] + "/"
-                    for p in [q for q in self._nodes
-                              if q.startswith(prefix)]:
-                        del self._nodes[p]
-                    self._nodes.pop(rec["path"], None)
+                self._apply_record_locked(rec, include_ephemeral=False)
 
-    def _log_mutation(self, rec: dict):
-        """Called under self._lock. Returns a durability future (or None
-        when running without a WAL); the handler must await it BEFORE
-        acknowledging. Setting _dirty here — under the lock, atomically
-        with the enqueue — is what makes snapshot truncation safe: the
-        snapshot loop only truncates when the flag was clear under the
-        same lock, which implies no un-snapshotted record exists."""
-        if self._wal is None:
+    def _record(self, rec: dict, durable: bool = True):
+        """Called under self._lock for EVERY state mutation. Appends the
+        record to the replication stream ring (standbys tail it — session
+        lifecycle and ephemerals included), and, when ``durable``, to the
+        WAL. Returns a durability future (or None); the handler must
+        await it BEFORE acknowledging. Setting _dirty here — under the
+        lock, atomically with the enqueue — is what makes snapshot
+        truncation safe: the snapshot loop only truncates when the flag
+        was clear under the same lock, which implies no un-snapshotted
+        record exists."""
+        self._mut_index += 1
+        self._recent.append((self._mut_index, rec))
+        if not durable or self._wal is None:
             return None
         self._dirty = True
         return self._wal.append_async(rec)
+
+    def _signal_stream(self) -> None:
+        """Wake parked repl_updates long-polls (ioloop thread only)."""
+        self._stream_event.set()
+        self._stream_event = asyncio.Event()
 
     @staticmethod
     async def _await_durable(futs: list) -> None:
@@ -408,6 +435,9 @@ class CoordinatorServer:
 
     def stop(self) -> None:
         self._reaper_task.cancel()
+        if self._standby_task is not None:
+            self._standby_task.cancel()
+            self._standby_task = None
         if self._snapshot_task is not None:
             self._snapshot_task.cancel()
             try:
@@ -435,6 +465,7 @@ class CoordinatorServer:
     def _signal_change(self, *paths: str) -> None:
         self._global_version += 1
         self._mark_dirty()
+        self._signal_stream()
         for path in paths:
             ev = self._change_event.get(path)
             if ev is not None:
@@ -455,9 +486,17 @@ class CoordinatorServer:
         if sid and sid not in self._sessions:
             raise RpcApplicationError(NO_SESSION, str(sid))
 
+    def _check_primary(self) -> None:
+        if self._standby:
+            up = f"{self._upstream[0]}:{self._upstream[1]}" \
+                if self._upstream else ""
+            raise RpcApplicationError(NOT_PRIMARY, up)
+
     async def _reap_sessions(self) -> None:
         while True:
             await asyncio.sleep(self._ttl / 3)
+            if self._standby:
+                continue  # replicated deadlines are inf until promote
             now = time.monotonic()
             with self._lock:
                 dead = [s for s, dl in self._sessions.items() if dl < now]
@@ -473,6 +512,9 @@ class CoordinatorServer:
                         del self._nodes[path]
                         touched.add(path)
                         touched.add(self._parent(path))
+                    for sid in dead:
+                        self._record({"op": "expire_session", "sid": sid},
+                                     durable=False)
             for sid in dead:
                 log.info("coordinator: session %d expired", sid)
             if dead:
@@ -483,12 +525,17 @@ class CoordinatorServer:
     # ------------------------------------------------------------------
 
     async def handle_create_session(self, ttl: Optional[float] = None) -> dict:
+        self._check_primary()
         sid = next(self._session_ids)
         with self._lock:
             self._sessions[sid] = time.monotonic() + (ttl or self._ttl)
+            self._max_sid_seen = max(self._max_sid_seen, sid)
+            self._record({"op": "create_session", "sid": sid}, durable=False)
+        self._signal_stream()
         return {"session_id": sid, "ttl": ttl or self._ttl}
 
     async def handle_heartbeat(self, session_id: int = 0) -> dict:
+        self._check_primary()
         with self._lock:
             if session_id not in self._sessions:
                 raise RpcApplicationError(NO_SESSION, str(session_id))
@@ -496,6 +543,7 @@ class CoordinatorServer:
         return {}
 
     async def handle_close_session(self, session_id: int = 0) -> dict:
+        self._check_primary()
         with self._lock:
             self._sessions.pop(session_id, None)
             touched: Set[str] = set()
@@ -506,6 +554,8 @@ class CoordinatorServer:
                 del self._nodes[path]
                 touched.add(path)
                 touched.add(self._parent(path))
+            self._record({"op": "close_session", "sid": session_id},
+                         durable=False)
         self._signal_change(*touched)
         return {}
 
@@ -518,6 +568,7 @@ class CoordinatorServer:
         sequential: bool = False, session_id: int = 0,
         make_parents: bool = True,
     ) -> dict:
+        self._check_primary()
         path = self._norm(path)
         value = bytes(value)
         with self._lock:
@@ -550,20 +601,23 @@ class CoordinatorServer:
             # WAL before ack. Ephemeral nodes die with the restart anyway,
             # but materialized persistent ancestors and sequential suffix
             # consumption ARE durable changes (lock ordering must never
-            # regress across restarts).
+            # regress across restarts). The stream gets every record —
+            # standbys replicate ephemerals (incl. values) too.
             futs = [
-                self._log_mutation({
+                self._record({
                     "op": "create", "path": p, "value": "",
                     "ephemeral": False, "seq": None,
                 })
                 for p in created_parents
             ]
-            if not (ephemeral and seq is None):
-                futs.append(self._log_mutation({
-                    "op": "create", "path": path,
-                    "value": value.hex() if not ephemeral else "",
+            futs.append(self._record(
+                {
+                    "op": "create", "path": path, "value": value.hex(),
                     "ephemeral": bool(ephemeral), "seq": seq,
-                }))
+                    "sid": session_id if ephemeral else None,
+                },
+                durable=not (ephemeral and seq is None),
+            ))
         await self._await_durable(futs)
         self._signal_change(path, self._parent(path))
         return {"path": path}
@@ -588,6 +642,7 @@ class CoordinatorServer:
     async def handle_set(
         self, path: str = "", value: bytes = b"", expected_version: int = -1
     ) -> dict:
+        self._check_primary()
         path = self._norm(path)
         value = bytes(value)
         with self._lock:
@@ -601,12 +656,11 @@ class CoordinatorServer:
             node.value = value
             node.version += 1
             version = node.version
-            futs = []
-            if node.ephemeral_owner is None:
-                futs.append(self._log_mutation({
-                    "op": "set", "path": path, "value": value.hex(),
-                    "version": version,
-                }))
+            futs = [self._record(
+                {"op": "set", "path": path, "value": value.hex(),
+                 "version": version},
+                durable=node.ephemeral_owner is None,
+            )]
         await self._await_durable(futs)
         self._signal_change(path)
         return {"version": version}
@@ -615,6 +669,7 @@ class CoordinatorServer:
         self, path: str = "", expected_version: int = -1,
         recursive: bool = False,
     ) -> dict:
+        self._check_primary()
         path = self._norm(path)
         with self._lock:
             node = self._nodes.get(path)
@@ -632,9 +687,8 @@ class CoordinatorServer:
             for p in children:
                 del self._nodes[p]
             del self._nodes[path]
-            futs = []
-            if durable:
-                futs.append(self._log_mutation({"op": "delete", "path": path}))
+            futs = [self._record({"op": "delete", "path": path},
+                                 durable=durable)]
         await self._await_durable(futs)
         self._signal_change(path, self._parent(path))
         return {}
@@ -685,14 +739,319 @@ class CoordinatorServer:
             snap = snapshot()
         return snap
 
+    # ------------------------------------------------------------------
+    # replication: primary-side RPCs
+    # ------------------------------------------------------------------
+
+    async def handle_repl_state(self) -> dict:
+        """Full state transfer for a (re)joining standby: every node
+        (ephemerals included, with owners), live session ids, sequence
+        counters, and the (epoch, index) to resume from."""
+        with self._lock:
+            # copy under the lock, serialize after releasing it — the
+            # hex-encode of a large tree must not stall writes/heartbeats
+            raw_nodes = [
+                (p, n.value, n.version, n.seq_counter, n.ephemeral_owner)
+                for p, n in self._nodes.items()
+            ]
+            sessions = sorted(self._sessions)
+            max_sid = self._max_sid_seen
+            next_index = self._mut_index + 1
+        return {
+            "nodes": [
+                {"path": p, "value": v.hex(), "version": ver,
+                 "seq": seq, "sid": sid}
+                for p, v, ver, seq, sid in raw_nodes
+            ],
+            "sessions": sessions,
+            "max_sid": max_sid,
+            "next_index": next_index,
+            "epoch": self._epoch,
+        }
+
+    async def handle_repl_updates(
+        self, from_index: int = 1, max_wait_ms: int = 10_000,
+        max_updates: int = 500, epoch: str = "",
+    ) -> dict:
+        """Long-poll the mutation stream from ``from_index`` within
+        ``epoch``. Returns ``reset=True`` when the epoch doesn't match
+        this server instance or the ring no longer covers the index (the
+        standby full-transfers and resumes)."""
+        deadline = time.monotonic() + max_wait_ms / 1000.0
+        while True:
+            with self._lock:
+                ring_start = (
+                    self._recent[0][0] if self._recent
+                    else self._mut_index + 1
+                )
+                if (
+                    epoch != self._epoch
+                    or from_index < ring_start
+                    or from_index > self._mut_index + 1
+                ):
+                    return {"reset": True, "updates": [], "indices": []}
+                updates = [
+                    (i, r) for i, r in self._recent if i >= from_index
+                ][:max_updates]
+                if updates:
+                    return {
+                        "reset": False,
+                        "updates": [r for _, r in updates],
+                        "indices": [i for i, _ in updates],
+                    }
+                ev = self._stream_event
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"reset": False, "updates": [], "indices": []}
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                return {"reset": False, "updates": [], "indices": []}
+
+    # ------------------------------------------------------------------
+    # replication: standby side
+    # ------------------------------------------------------------------
+
+    def _apply_record_locked(self, rec: dict,
+                             include_ephemeral: bool) -> Set[str]:
+        """Apply one stream/WAL record; returns touched paths for watch
+        signalling. WAL replay passes include_ephemeral=False (ephemerals
+        die with the process that owned the sessions); standby apply
+        passes True (it mirrors the primary's live state)."""
+        op = rec.get("op")
+        touched: Set[str] = set()
+        if op == "create":
+            parent = self._parent(rec["path"])
+            parts = [p for p in parent.split("/") if p]
+            cur = ""
+            for p in parts:
+                cur += "/" + p
+                if cur not in self._nodes:
+                    self._nodes[cur] = _Node(b"", None)
+                    touched.add(cur)
+            if rec.get("seq") is not None:
+                pnode = self._nodes.get(parent)
+                if pnode is not None:
+                    pnode.seq_counter = max(
+                        pnode.seq_counter, rec["seq"] + 1)
+            if not rec.get("ephemeral"):
+                node = self._nodes.setdefault(rec["path"], _Node(b"", None))
+                node.value = bytes.fromhex(rec["value"])
+                touched.add(rec["path"])
+            elif include_ephemeral:
+                self._nodes[rec["path"]] = _Node(
+                    bytes.fromhex(rec["value"]), rec.get("sid"))
+                touched.add(rec["path"])
+            touched.add(parent)
+        elif op == "set":
+            node = self._nodes.get(rec["path"])
+            if node is not None:
+                node.value = bytes.fromhex(rec["value"])
+                node.version = rec["version"]
+                touched.add(rec["path"])
+        elif op == "delete":
+            prefix = rec["path"] + "/"
+            for p in [q for q in self._nodes if q.startswith(prefix)]:
+                del self._nodes[p]
+                touched.add(p)
+            if self._nodes.pop(rec["path"], None) is not None:
+                touched.add(rec["path"])
+            touched.add(self._parent(rec["path"]))
+        elif op == "create_session":
+            sid = rec["sid"]
+            self._max_sid_seen = max(self._max_sid_seen, sid)
+            # deadline inf until promote: a standby cannot observe the
+            # owner's heartbeats, so it must not expire anything
+            self._sessions[sid] = float("inf")
+        elif op in ("close_session", "expire_session"):
+            sid = rec["sid"]
+            self._sessions.pop(sid, None)
+            for p in [
+                q for q, n in self._nodes.items()
+                if n.ephemeral_owner == sid
+            ]:
+                del self._nodes[p]
+                touched.add(p)
+                touched.add(self._parent(p))
+        return touched
+
+    def _apply_stream_batch(self, updates: List[dict],
+                            indices: List[int]) -> None:
+        touched: Set[str] = set()
+        with self._lock:
+            for rec, idx in zip(updates, indices):
+                touched |= self._apply_record_locked(
+                    rec, include_ephemeral=True)
+                self._mut_index = idx
+                self._recent.append((idx, rec))
+                # persist what the primary persists (same durability
+                # filter) so a promoted standby restarts like a primary
+                durable = (
+                    rec.get("op") in ("set", "delete")
+                    or (rec.get("op") == "create"
+                        and not (rec.get("ephemeral")
+                                 and rec.get("seq") is None))
+                )
+                if durable and self._wal is not None:
+                    self._dirty = True
+                    fut = self._wal.append_async(rec)
+                    fut.add_done_callback(self._on_standby_wal_write)
+        if touched:
+            self._signal_change(*touched)
+        else:
+            self._signal_stream()
+
+    def _on_standby_wal_write(self, fut) -> None:
+        """A fenced WAL on a standby must be LOUD: persistence has
+        stopped while replication looks healthy, and a later promote +
+        restart would lose everything since the last snapshot. promote()
+        refuses while the WAL is failed (force=True overrides)."""
+        exc = fut.exception()
+        if exc is not None and not getattr(self, "_wal_fail_logged", False):
+            self._wal_fail_logged = True
+            log.error(
+                "coordinator standby: WAL append failed (%r) — durable "
+                "persistence has STOPPED; promote() will refuse until "
+                "the WAL is healthy", exc)
+
+    def _apply_state_transfer(self, state: dict) -> None:
+        with self._lock:
+            self._nodes = {"/": _Node(b"", None)}
+            for ent in state["nodes"]:
+                node = _Node(bytes.fromhex(ent["value"]), ent.get("sid"))
+                node.version = ent["version"]
+                node.seq_counter = ent.get("seq", 0)
+                self._nodes[ent["path"]] = node
+            self._sessions = {sid: float("inf")
+                              for sid in state.get("sessions", [])}
+            self._max_sid_seen = state.get("max_sid", 0)
+            self._mut_index = state["next_index"] - 1
+            self._recent.clear()
+            self._dirty = True
+        self._signal_change(*[e["path"] for e in state["nodes"]])
+
+    async def _standby_loop(self) -> None:
+        """Tail the upstream primary: full transfer, then incremental
+        long-poll catch-up; optional self-promotion after a sustained
+        outage (see class docstring for the split-brain caveat)."""
+        from ..rpc.errors import RpcConnectionError, RpcTimeout
+
+        pool = RpcClientPool()
+        host, port = self._upstream
+        next_index = None
+        epoch = ""
+        down_since: Optional[float] = None
+        try:
+            while self._standby:
+                try:
+                    if next_index is None:
+                        state = await pool.call(
+                            host, port, "repl_state", {}, timeout=30)
+                        self._apply_state_transfer(state)
+                        next_index = state["next_index"]
+                        epoch = state.get("epoch", "")
+                        log.info(
+                            "coordinator standby: state transfer done "
+                            "(%d nodes, resuming at %d epoch=%s)",
+                            len(state["nodes"]), next_index, epoch[:8])
+                    r = await pool.call(
+                        host, port, "repl_updates",
+                        {"from_index": next_index, "max_wait_ms": 5000,
+                         "epoch": epoch},
+                        timeout=35,
+                    )
+                    down_since = None
+                    if r.get("reset"):
+                        next_index = None
+                        continue
+                    if r["updates"]:
+                        self._apply_stream_batch(r["updates"], r["indices"])
+                        next_index = r["indices"][-1] + 1
+                except asyncio.CancelledError:
+                    raise
+                except (RpcConnectionError, RpcTimeout, ConnectionError,
+                        OSError) as e:
+                    # ONLY unreachability counts toward auto-promote: an
+                    # application-level error with a LIVE primary must
+                    # never trigger self-promotion (split-brain)
+                    now = time.monotonic()
+                    down_since = down_since or now
+                    outage = now - down_since
+                    if (
+                        self._auto_promote_after is not None
+                        and outage >= self._auto_promote_after
+                    ):
+                        log.warning(
+                            "coordinator standby: upstream %s:%d "
+                            "unreachable for %.1fs — self-promoting",
+                            host, port, outage)
+                        self.promote()
+                        return
+                    log.debug("coordinator standby pull error: %r", e)
+                    await asyncio.sleep(0.5)
+                except Exception:
+                    down_since = None
+                    log.exception(
+                        "coordinator standby: apply/protocol error — "
+                        "retrying with full state transfer")
+                    next_index = None
+                    await asyncio.sleep(1.0)
+        finally:
+            await pool.close()
+
+    def promote(self, force: bool = False) -> None:
+        """Standby → primary. Replicated sessions get a fresh TTL grace
+        window (owners re-establish by heartbeating, as with a ZK leader
+        change); session ids continue above everything ever seen.
+        Refuses while the local WAL is fenced (state since the last
+        snapshot would not be durable) unless ``force``."""
+        if (
+            not force and self._wal is not None
+            and self._wal.failed is not None
+        ):
+            raise RuntimeError(
+                f"refusing to promote with a fenced WAL "
+                f"({self._wal.failed!r}); pass force=True to override")
+        with self._lock:
+            if not self._standby:
+                return
+            self._standby = False
+            grace = time.monotonic() + self._ttl
+            self._sessions = {sid: grace for sid in self._sessions}
+            self._session_ids = itertools.count(self._max_sid_seen + 1)
+        if self._standby_task is not None:
+            self._standby_task.cancel()
+            self._standby_task = None
+        log.info("coordinator: promoted to primary (%d sessions in grace)",
+                 len(self._sessions))
+
+    async def handle_promote(self, force: bool = False) -> dict:
+        """Operator/controller-driven failover for standalone standby
+        processes (the in-process path calls promote() directly)."""
+        try:
+            self.promote(force=bool(force))
+        except RuntimeError as e:
+            raise RpcApplicationError("WAL_ERROR", str(e))
+        return {"standby": self._standby}
+
+    @property
+    def is_standby(self) -> bool:
+        return self._standby
+
 
 class CoordinatorClient:
     """Sync client + session keepalive + watch loops + lock/election
     recipes (the Curator equivalent)."""
 
     def __init__(self, host: str, port: int, ioloop: Optional[IoLoop] = None,
-                 session_ttl: Optional[float] = None):
+                 session_ttl: Optional[float] = None,
+                 fallbacks: Optional[List[Tuple[str, int]]] = None):
         self._host, self._port = host, port
+        # failover rotation: primary first, then standbys. A NOT_PRIMARY
+        # rejection or connection failure rotates to the next endpoint
+        # (sessions are replicated, so the session survives the switch).
+        self._endpoints: List[Tuple[str, int]] = [(host, port)]
+        self._endpoints.extend(fallbacks or [])
         self._ioloop = ioloop or IoLoop.default()
         self._pool = RpcClientPool()
         self._stop = threading.Event()
@@ -707,13 +1066,51 @@ class CoordinatorClient:
 
     # -- plumbing ---------------------------------------------------------
 
+    # mutations must NOT be silently re-sent after a connection error:
+    # the primary may have executed them before the connection died, and
+    # e.g. a duplicated ephemeral-sequential lock node deadlocks every
+    # other contender. A NOT_PRIMARY rejection is always retry-safe (the
+    # standby executed nothing). create_session is exempt: a duplicate
+    # session just expires unused.
+    _UNSAFE_RETRY = frozenset({"create", "set", "delete"})
+
     def _call(self, method: str, timeout: float = 30.0, **args):
-        async def go():
+        async def go(host: str, port: int):
             return await self._pool.call(
-                self._host, self._port, method, args, timeout=timeout
+                host, port, method, args, timeout=timeout
             )
 
-        return self._ioloop.run_sync(go(), timeout=timeout + 5)
+        last: Optional[Exception] = None
+        for attempt in range(max(2 * len(self._endpoints), 1)):
+            host, port = self._host, self._port
+            try:
+                return self._ioloop.run_sync(
+                    go(host, port), timeout=timeout + 5)
+            except RpcApplicationError as e:
+                if e.code != NOT_PRIMARY or len(self._endpoints) == 1:
+                    raise
+                last = e
+            except RpcError as e:
+                if len(self._endpoints) == 1:
+                    raise
+                last = e
+                if method in self._UNSAFE_RETRY:
+                    # rotate so the NEXT call targets a live endpoint,
+                    # but surface this failure — the caller must decide
+                    # whether the mutation may have been applied
+                    self._rotate(host, port)
+                    raise
+            # rotate to the next endpoint and retry
+            self._rotate(host, port)
+            if attempt >= len(self._endpoints):
+                time.sleep(0.3)  # full rotation failed — brief backoff
+        raise last  # type: ignore[misc]
+
+    def _rotate(self, host: str, port: int) -> None:
+        idx = self._endpoints.index((host, port)) \
+            if (host, port) in self._endpoints else 0
+        self._host, self._port = self._endpoints[
+            (idx + 1) % len(self._endpoints)]
 
     def _heartbeat_loop(self) -> None:
         interval = self._ttl / 3
@@ -886,11 +1283,22 @@ def main(argv=None) -> int:
     p.add_argument("--data_dir", default=None,
                    help="durable WAL+snapshot dir (omit for in-memory)")
     p.add_argument("--session_ttl", type=float, default=DEFAULT_SESSION_TTL)
+    p.add_argument("--replica_of", default=None, metavar="HOST:PORT",
+                   help="run as a standby tailing this primary")
+    p.add_argument("--auto_promote_after", type=float, default=None,
+                   help="standby self-promotes after the primary is "
+                        "unreachable this many seconds (deploy at most "
+                        "one such standby)")
     args = p.parse_args(argv)
+    upstream = None
+    if args.replica_of:
+        h, _, pt = args.replica_of.rpartition(":")
+        upstream = (h, int(pt))
     srv = CoordinatorServer(port=args.port, session_ttl=args.session_ttl,
-                            data_dir=args.data_dir)
-    print(f"coordinator up: port={srv.port} data_dir={args.data_dir}",
-          flush=True)
+                            data_dir=args.data_dir, replica_of=upstream,
+                            auto_promote_after=args.auto_promote_after)
+    print(f"coordinator up: port={srv.port} data_dir={args.data_dir} "
+          f"standby={srv.is_standby}", flush=True)
     try:
         while True:
             time.sleep(1)
