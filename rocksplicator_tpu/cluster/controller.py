@@ -53,10 +53,12 @@ class Controller:
         cluster: str,
         controller_id: str,
         reconcile_interval: float = 2.0,
+        coord_fallbacks: Optional[List[Tuple[str, int]]] = None,
     ):
         self.cluster = cluster
         self.controller_id = controller_id
-        self.coord = CoordinatorClient(coord_host, coord_port)
+        self.coord = CoordinatorClient(coord_host, coord_port,
+                                       fallbacks=coord_fallbacks)
         self._path = lambda *p: cluster_path(cluster, *p)
         self._interval = reconcile_interval
         self._stop = threading.Event()

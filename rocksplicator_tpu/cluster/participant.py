@@ -13,7 +13,7 @@ import logging
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .coordinator import CoordinatorClient
 from .helix_utils import AdminClient
@@ -45,11 +45,13 @@ class Participant:
         catch_up_timeout: float = 30.0,
         error_retry_backoff: float = 1.0,
         view_cluster: Optional[str] = None,
+        coord_fallbacks: Optional[List[Tuple[str, int]]] = None,
     ):
         self.error_retry_backoff = error_retry_backoff
         self.cluster = cluster
         self.instance = instance
-        self.coord = CoordinatorClient(coord_host, coord_port)
+        self.coord = CoordinatorClient(coord_host, coord_port,
+                                       fallbacks=coord_fallbacks)
         self.admin = AdminClient()
         self.ctx = ClusterContext(
             self.coord, self.admin, cluster, instance,

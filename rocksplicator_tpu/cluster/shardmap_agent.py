@@ -10,7 +10,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
 
 from ..utils.misc import write_file_atomic
 from .coordinator import CoordinatorClient
@@ -23,10 +23,12 @@ class ShardMapAgent:
     """Syncs one cluster's published shard map to a local file."""
 
     def __init__(self, coord_host: str, coord_port: int, cluster: str,
-                 target_path: str):
+                 target_path: str,
+                 coord_fallbacks: Optional[List[Tuple[str, int]]] = None):
         self.cluster = cluster
         self.target_path = target_path
-        self.coord = CoordinatorClient(coord_host, coord_port)
+        self.coord = CoordinatorClient(coord_host, coord_port,
+                                       fallbacks=coord_fallbacks)
         self._watch_stop = self.coord.watch(
             cluster_path(cluster, "shardmap"), self._on_map
         )

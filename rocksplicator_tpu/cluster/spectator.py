@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from .config_generator import generate_shard_map
 from .coordinator import CoordinatorClient
@@ -31,11 +31,13 @@ class Spectator:
         publishers: List[ShardMapPublisher],
         spectator_id: str = "spectator",
         standalone: bool = True,
+        coord_fallbacks: Optional[List[Tuple[str, int]]] = None,
     ):
         self.cluster = cluster
         self.spectator_id = spectator_id
         self._standalone = standalone
-        self.coord = CoordinatorClient(coord_host, coord_port)
+        self.coord = CoordinatorClient(coord_host, coord_port,
+                                       fallbacks=coord_fallbacks)
         self._publisher = DedupPublisher(ParallelPublisher(publishers))
         self._path = lambda *p: cluster_path(cluster, *p)
         self._kick = threading.Event()

@@ -15,7 +15,7 @@ import logging
 import threading
 import time
 import uuid
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .coordinator import CoordinatorClient
 from .helix_utils import AdminClient
@@ -176,10 +176,12 @@ class TaskWorker:
 
     def __init__(self, coord_host: str, coord_port: int, cluster: str,
                  worker_id: str = "worker",
-                 runners: Optional[Dict[str, TaskRunner]] = None):
+                 runners: Optional[Dict[str, TaskRunner]] = None,
+                 coord_fallbacks: Optional[List[Tuple[str, int]]] = None):
         self.cluster = cluster
         self.worker_id = worker_id
-        self.coord = CoordinatorClient(coord_host, coord_port)
+        self.coord = CoordinatorClient(coord_host, coord_port,
+                                       fallbacks=coord_fallbacks)
         self.admin = AdminClient()
         self.runners = runners or TASK_RUNNERS
         self._path = lambda *p: cluster_path(cluster, *p)
