@@ -464,24 +464,87 @@ class DB:
                 log.exception("%s: background compaction failed", self.path)
                 time.sleep(1.0)
 
-    def _flush_imm(self, mem: MemTable) -> None:
-        """Write the immutable memtable to an L0 SST — file IO OUTSIDE the
-        lock (writes keep flowing), installation under it."""
-        with self._lock:
-            name = self._new_file_name()
+    def _write_mem_sst(self, path: str, mem: MemTable) -> None:
+        """Write a memtable's entries as one SST. Fixed-width workloads
+        take the PLANAR sink (array-decodable — first-level compactions
+        of flush output then run array-to-array even with tombstones,
+        which planar expresses; entry-stream cannot mix widths); anything
+        else takes the per-entry writer."""
+        entries = list(mem.entries())
+        if self._try_planar_flush(path, entries):
+            return
         writer = SSTWriter(
-            os.path.join(self.path, name),
+            path,
             self.options.block_bytes,
             self.options.compression,
             self.options.bits_per_key,
         )
         try:
-            for key, seq, vtype, value in mem.entries():
+            for key, seq, vtype, value in entries:
                 writer.add(key, seq, vtype, value)
             writer.finish()
         except BaseException:
             writer.abandon()
             raise
+
+    def _try_planar_flush(self, path: str, entries) -> bool:
+        """True when the planar sink handled the flush."""
+        if not entries:
+            return False
+        # Width pre-check on the TUPLES, before any packing: pack_entries
+        # allocates n x max_vlen — one oversized value among a million
+        # small ones must bail here, not after a giant transient buffer
+        klen0 = len(entries[0][0])
+        vlen0 = None
+        for key, _seq, vtype, value in entries:
+            if len(key) != klen0 or len(key) > 24:
+                return False
+            if int(vtype) == 2:  # DELETE: no value in the planar layout
+                if value:
+                    return False
+            elif vlen0 is None:
+                vlen0 = len(value)
+            elif len(value) != vlen0:
+                return False
+        from ..ops.kv_format import UnsupportedBatch, pack_entries
+        from ..tpu.format import (planar_stride, planar_widths,
+                                  write_sst_from_arrays)
+
+        try:
+            batch = pack_entries(
+                entries, val_bytes=max(4, ((vlen0 or 0) + 3) // 4 * 4))
+        except UnsupportedBatch:
+            return False
+        n = len(entries)
+        arrays = {
+            "key_words_be": batch.key_words_be[:n],
+            "key_words_le": batch.key_words_le[:n],
+            "key_len": batch.key_len[:n],
+            "seq_hi": batch.seq_hi[:n],
+            "seq_lo": batch.seq_lo[:n],
+            "vtype": batch.vtype[:n],
+            "val_words": batch.val_words[:n],
+            "val_len": batch.val_len[:n],
+        }
+        widths = planar_widths(arrays, n)
+        if widths is None:
+            return False
+        stride = planar_stride(*widths)
+        props = write_sst_from_arrays(
+            arrays, n, path,
+            block_entries=max(64, self.options.block_bytes // stride),
+            compression=self.options.compression,
+            bits_per_key=self.options.bits_per_key,
+            planar=True,
+        )
+        return props is not None
+
+    def _flush_imm(self, mem: MemTable) -> None:
+        """Write the immutable memtable to an L0 SST — file IO OUTSIDE the
+        lock (writes keep flowing), installation under it."""
+        with self._lock:
+            name = self._new_file_name()
+        self._write_mem_sst(os.path.join(self.path, name), mem)
         with self._lock:
             self._readers[name] = SSTReader(os.path.join(self.path, name))
             self._levels[0].append(name)
@@ -534,27 +597,17 @@ class DB:
         mem = self._mem
         self._imms.append(mem)
         self._mem = MemTable()
-        writer: Optional[SSTWriter] = None
         try:
             name = self._new_file_name()
-            writer = SSTWriter(
-                os.path.join(self.path, name),
-                self.options.block_bytes,
-                self.options.compression,
-                self.options.bits_per_key,
-            )
-            for key, seq, vtype, value in mem.entries():
-                writer.add(key, seq, vtype, value)
-            writer.finish()
+            self._write_mem_sst(os.path.join(self.path, name), mem)
             self._readers[name] = SSTReader(os.path.join(self.path, name))
             self._levels[0].append(name)
             self._persisted_seq = max(self._persisted_seq, mem.max_seq)
             self._persist_manifest()
         except BaseException:
             # Keep read-your-writes: fold the unflushed entries back under
-            # any writes that raced in, and drop the partial SST.
-            if writer is not None:
-                writer.abandon()
+            # any writes that raced in. (Both sinks abandon their partial
+            # file on failure.)
             self._mem.absorb_older(mem)
             raise
         finally:

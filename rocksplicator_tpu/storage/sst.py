@@ -104,10 +104,12 @@ class SSTWriter:
         if self._last_key != key:
             self._keys.append(key)
         self._last_key, self._last_seq = key, seq
-        entry = _encode_entry(key, seq, vtype, value)
-        self._block.append(entry)
-        self._block_size += len(entry)
-        self._raw_bytes += len(entry)
+        # entries buffer as tuples; the whole block encodes in ONE native
+        # call at flush (tsst_encode_block) instead of per-entry Python
+        esize = ENTRY_FIXED_OVERHEAD + len(key) + len(value)
+        self._block.append((key, seq, int(vtype), value))
+        self._block_size += esize
+        self._raw_bytes += esize
         self._num_entries += 1
         if self._min_key is None:
             self._min_key = key
@@ -152,7 +154,15 @@ class SSTWriter:
     def _flush_block(self) -> None:
         if not self._block:
             return
-        raw = b"".join(self._block)
+        from .native.binding import NATIVE
+
+        if NATIVE is not None:
+            raw = NATIVE.encode_block(
+                [e[0] for e in self._block], [e[1] for e in self._block],
+                [e[2] for e in self._block], [e[3] for e in self._block],
+            )
+        else:
+            raw = b"".join(_encode_entry(*e) for e in self._block)
         codec = self._compression
         payload = zlib.compress(raw, 1) if codec == COMPRESSION_ZLIB else raw
         if len(payload) >= len(raw):

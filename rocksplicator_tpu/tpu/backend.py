@@ -157,7 +157,7 @@ class TpuCompactionBackend(CompactionBackend):
         from ..ops.bloom_tpu import bloom_build_tpu
         from ..storage.bloom import num_words_for
         from .chunked import FIELDS, run_kernel_arrays
-        from .format import (planar_widths, read_sst_arrays,
+        from .format import (planar_stride, planar_widths, read_sst_arrays,
                              write_sst_from_arrays)
 
         if merge_op is not None and not isinstance(merge_op, UInt64AddOperator):
@@ -221,7 +221,7 @@ class TpuCompactionBackend(CompactionBackend):
         if widths is None:
             return None
         klen0, vlen0 = widths
-        stride = klen0 + vlen0 + 9  # planar: key + seq_lo + vtype + value
+        stride = planar_stride(klen0, vlen0)
         entries_per_file = max(1024, target_file_bytes // max(1, stride))
         block_entries = max(64, block_bytes // max(1, stride))
         outputs: List[Tuple[str, dict]] = []

@@ -43,21 +43,26 @@ def run_kernel_arrays(
     batch_arrays: dict, n_valid: int, merge_kind: MergeKind,
     drop_tombstones: bool, pad_to: Optional[int] = None,
     uniform_klen: bool = False, seq32: bool = False,
-    key_words: Optional[int] = None,
+    key_words: Optional[int] = None, to_host: bool = True,
 ) -> Tuple[Optional[dict], int]:
     """THE kernel invocation wrapper (shared by the chunked tree and the
     backend's direct file sink): one launch over packed arrays; returns
     (output arrays trimmed to count, count) or (None, 0) on kernel-flagged
     fallback. ``pad_to`` fixes the launch shape so callers reuse one
-    compiled kernel."""
+    compiled kernel. ``to_host=False`` keeps the trimmed outputs as
+    DEVICE arrays — the chunked tree feeds them straight into the next
+    launch, so intermediate passes never round-trip through host numpy
+    (only the count/fallback scalars sync)."""
     import jax.numpy as jnp
 
     n_rows = batch_arrays["key_len"].shape[0]
     if pad_to is not None and n_rows < pad_to:
         pad = pad_to - n_rows
+        # jnp.pad keeps device-resident inputs on device; numpy inputs
+        # land there with the launch anyway
         batch_arrays = {
-            f: np.pad(batch_arrays[f],
-                      [(0, pad)] + [(0, 0)] * (batch_arrays[f].ndim - 1))
+            f: jnp.pad(batch_arrays[f],
+                       [(0, pad)] + [(0, 0)] * (batch_arrays[f].ndim - 1))
             for f in FIELDS
         }
         n_rows = pad_to
@@ -74,11 +79,17 @@ def run_kernel_arrays(
     if bool(out["needs_cpu_fallback"]):
         return None, 0
     count = int(out["count"])
-    return {f: np.asarray(out[f])[:count] for f in FIELDS}, count
+    if to_host:
+        return {f: np.asarray(out[f])[:count] for f in FIELDS}, count
+    return {f: out[f][:count] for f in FIELDS}, count
 
 
 def _concat(parts: List[dict]) -> Tuple[dict, int]:
-    merged = {f: np.concatenate([p[f] for p in parts]) for f in FIELDS}
+    import jax.numpy as jnp
+
+    # jnp: device-resident parts concatenate on device (host parts join
+    # them there — that is where the next launch reads them)
+    merged = {f: jnp.concatenate([p[f] for p in parts]) for f in FIELDS}
     return merged, merged["key_len"].shape[0]
 
 
@@ -103,7 +114,7 @@ def _fold_groups(
             return True
         merged, total = _concat(group)
         out = run_kernel_arrays(merged, total, merge_kind, False,
-                                pad_to=launch_entries)
+                                pad_to=launch_entries, to_host=False)
         if out[0] is None:
             return False
         next_level.append(out)
@@ -161,9 +172,19 @@ def chunked_merge(
         part, n = part_n
         if n == 0:
             return 0
-        hi = part["seq_hi"][:n].astype(np.uint64)
-        lo = part["seq_lo"][:n].astype(np.uint64)
-        return int(((hi << np.uint64(32)) | lo).max())
+        hi_lane, lo_lane = part["seq_hi"][:n], part["seq_lo"][:n]
+        if isinstance(hi_lane, np.ndarray):
+            # host part (single-chunk pass-through): pure numpy, no H2D
+            hi64 = hi_lane.astype(np.uint64) << np.uint64(32)
+            return int((hi64 | lo_lane.astype(np.uint64)).max())
+        # device part (from _fold_groups): scalar reductions + readbacks
+        # only — never pull the lanes to host
+        import jax.numpy as jnp
+
+        hi = int(jnp.max(hi_lane))
+        lo_at = int(jnp.max(jnp.where(
+            hi_lane == hi, lo_lane, jnp.uint32(0))))
+        return (hi << 32) | lo_at
 
     summaries.sort(key=_max_seq)
     while True:

@@ -79,15 +79,39 @@ def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
     property (flush-written / foreign files use the tuple path)."""
     if reader.props.get("planar"):
         return _read_planar_arrays(reader)
+    # Validate BEFORE reading the whole file: a file the array path will
+    # reject must not pay a full pread+decompress only to be read again
+    # by the tuple fallback.
     widths = reader.props.get("uniform")
-    if not widths:
-        return None
-    klen, vlen = int(widths[0]), int(widths[1])
-    if not (0 < klen <= 24) or vlen < 0:
-        return None  # foreign/crafted prop — tuple path validates
-    stride = _ENTRY_FIXED_OVERHEAD + klen + vlen
-    blocks = [reader._read_block(i) for i in range(len(reader._index))]
+    if widths:
+        klen, vlen = int(widths[0]), int(widths[1])
+        if not (0 < klen <= 24) or vlen < 0:
+            return None  # foreign/crafted prop — tuple path validates
+        blocks = [reader._read_block(i) for i in range(len(reader._index))]
+    else:
+        # No sink prop (flush-written / foreign file): INFER the uniform
+        # stride from block 0 so first-level compactions of flush output
+        # still decode array-to-array. Probe only block 0 before
+        # committing to the full read; the per-row width checks below
+        # validate the inference (non-uniform files fail them and take
+        # the tuple path).
+        if not reader.num_entries or not reader._index:
+            return None
+        b0 = reader._read_block(0)
+        if len(b0) < _ENTRY_FIXED_OVERHEAD:
+            return None
+        klen = int.from_bytes(b0[:4], "little")
+        if not (0 < klen <= 24) or len(b0) < _ENTRY_FIXED_OVERHEAD + klen:
+            return None
+        # first entry's vlen field sits after klen|key|seq|vtype
+        vlen = int.from_bytes(b0[klen + 13:klen + 17], "little")
+        if len(b0) % (_ENTRY_FIXED_OVERHEAD + klen + vlen):
+            return None
+        blocks = [b0] + [
+            reader._read_block(i) for i in range(1, len(reader._index))
+        ]
     raw = b"".join(blocks)
+    stride = _ENTRY_FIXED_OVERHEAD + klen + vlen
     if len(raw) % stride:
         return None  # inconsistent — let the tuple path validate/complain
     n = len(raw) // stride
@@ -104,8 +128,8 @@ def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
     vlens = mat[:, pos:pos + 4].copy().view("<u4").reshape(n)
     pos += 4
     val_bytes = mat[:, pos:pos + vlen]
-    if not (klens == klen).all():
-        return None
+    if not (klens == klen).all() or not (vlens == vlen).all():
+        return None  # misaligned/non-uniform — tuple path handles it
     key_buf = np.zeros((n, 24), dtype=np.uint8)
     key_buf[:, :klen] = key_bytes
     # at least the default width so arrays from different runs concatenate
@@ -127,6 +151,12 @@ def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
         "val_words": val_buf.view("<u4").reshape(n, vw).copy(),
         "val_len": vlens.astype(np.uint32),
     }
+
+
+def planar_stride(klen: int, vlen: int) -> int:
+    """Approximate PLANAR bytes per entry (seq32 layout: key + seq_lo +
+    vtype + value) — block/file sizing only, shared by every sink."""
+    return klen + vlen + 9
 
 
 def planar_widths(arrays: Dict[str, np.ndarray], count: int):

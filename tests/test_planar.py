@@ -222,3 +222,82 @@ def test_planar_sink_device_words_path(tmp_path):
     r = SSTReader(path)
     assert list(r.iterate()) == entries  # tail host-packed, checksums ok
     r.close()
+
+
+def test_read_sst_arrays_infers_uniform_flush_files(tmp_path):
+    """Flush-written files carry no sink props; the array source must
+    infer the uniform stride and decode them array-to-array, and must
+    REJECT non-uniform files (tuple path handles those)."""
+    from rocksplicator_tpu.storage.sst import SSTWriter
+    from rocksplicator_tpu.tpu.format import read_sst_arrays
+
+    uni = str(tmp_path / "uniform.tsst")
+    w = SSTWriter(uni, compression=0)
+    entries = _entries(500)
+    for e in entries:
+        w.add(*e)
+    w.finish()
+    r = SSTReader(uni)
+    lanes = read_sst_arrays(r)
+    assert lanes is not None
+    assert len(lanes["seq_lo"]) == 500
+    assert (lanes["key_len"] == 16).all() and (lanes["val_len"] == 8).all()
+    r.close()
+
+    mixed = str(tmp_path / "mixed.tsst")
+    w = SSTWriter(mixed, compression=0)
+    w.add(b"a" * 16, 2, 1, b"12345678")
+    w.add(b"b" * 16, 1, 1, b"123")  # different value width
+    w.finish()
+    r = SSTReader(mixed)
+    assert read_sst_arrays(r) is None
+    r.close()
+
+    # value widths 8, 4, 12: encoded sizes 41+37+45 = 123 = 3x41, so the
+    # block-0 divisibility probe PASSES with the mis-inferred stride 41
+    # and only the per-row klens/vlens checks can reject the misaligned
+    # decode — the guard against silent garbage
+    tricky = str(tmp_path / "tricky.tsst")
+    w = SSTWriter(tricky, compression=0)
+    w.add(b"a" * 16, 3, 1, b"12345678")
+    w.add(b"b" * 16, 2, 1, b"1234")
+    w.add(b"c" * 16, 1, 1, b"123456789012")
+    w.finish()
+    r = SSTReader(tricky)
+    assert read_sst_arrays(r) is None
+    r.close()
+
+
+def test_engine_flush_writes_planar_files(tmp_path):
+    """Fixed-width memtable flushes take the PLANAR sink, so L0 files —
+    tombstones included — decode array-to-array for first-level
+    compactions; variable-width workloads fall back to entry-stream."""
+    from rocksplicator_tpu.storage.engine import DB, DBOptions
+    from rocksplicator_tpu.tpu.format import read_sst_arrays
+
+    db = DB(str(tmp_path / "db"), DBOptions(compression=0))
+    for i in range(100):
+        db.put(f"k{i:015d}".encode(), pack64(i))
+    db.delete(b"k" + b"0" * 14 + b"7")
+    db.flush()
+    names = list(db._levels[0])
+    assert len(names) == 1
+    r = db._readers[names[0]]
+    assert r.props.get("planar"), r.props
+    lanes = read_sst_arrays(r)
+    assert lanes is not None and len(lanes["seq_lo"]) == 101
+    assert (lanes["vtype"] == 2).sum() == 1  # the tombstone rode along
+    assert db.get(b"k" + b"0" * 14 + b"7") is None
+    assert db.get(b"k" + b"0" * 14 + b"3") == pack64(3)
+    db.close()
+
+    # variable widths: entry-stream fallback, still fully readable
+    db2 = DB(str(tmp_path / "db2"), DBOptions(compression=0))
+    db2.put(b"a" * 16, b"12345678")
+    db2.put(b"b" * 16, b"123")
+    db2.flush()
+    names = list(db2._levels[0])
+    r2 = db2._readers[names[0]]
+    assert not r2.props.get("planar")
+    assert db2.get(b"b" * 16) == b"123"
+    db2.close()
