@@ -40,6 +40,7 @@ from ..rpc.client_pool import RpcClientPool
 from ..rpc.errors import RpcApplicationError, RpcError
 from ..rpc.ioloop import IoLoop
 from ..rpc.server import RpcServer
+from ..utils.stats import Stats
 
 log = logging.getLogger(__name__)
 
@@ -253,7 +254,10 @@ class CoordinatorServer:
                  session_ttl: float = DEFAULT_SESSION_TTL,
                  data_dir: Optional[str] = None,
                  replica_of: Optional[Tuple[str, int]] = None,
-                 auto_promote_after: Optional[float] = None):
+                 auto_promote_after: Optional[float] = None,
+                 min_sync_standbys: int = 0,
+                 ack_timeout: float = 2.0,
+                 ack_degrade_after: int = 100):
         import collections
 
         self._ioloop = ioloop or IoLoop.default()
@@ -285,6 +289,20 @@ class CoordinatorServer:
         self._standby = replica_of is not None
         self._auto_promote_after = auto_promote_after
         self._standby_task = None
+        # semi-sync replication (reference mode-1/2 semantics,
+        # replicated_db.cpp:236-273): a mutation acks only once
+        # min_sync_standbys standbys have RECEIVED it (their next
+        # repl_updates pull implies everything before from_index). On
+        # timeout the write proceeds (availability over durability —
+        # same as writeWaitFollowerACK) and after ack_degrade_after
+        # consecutive timeouts the wait degrades to 10 ms to fail fast,
+        # recovering on the first success.
+        self._min_sync_standbys = min_sync_standbys
+        self._ack_timeout = ack_timeout
+        self._ack_degrade_after = ack_degrade_after
+        self._ack_timeouts_in_a_row = 0
+        self._standby_acked: Dict[str, int] = {}
+        self._ack_event = asyncio.Event()
         if data_dir:
             self._load_snapshot()
             self._replay_wal()
@@ -359,6 +377,38 @@ class CoordinatorServer:
         """Wake parked repl_updates long-polls (ioloop thread only)."""
         self._stream_event.set()
         self._stream_event = asyncio.Event()
+
+    async def _await_standby_ack(self, idx: int) -> None:
+        """Semi-sync wait: block the ack until min_sync_standbys have
+        pulled past ``idx`` (or the — possibly degraded — timeout)."""
+        need = self._min_sync_standbys
+        if need <= 0 or self._standby:
+            return
+        timeout = (
+            0.01 if self._ack_timeouts_in_a_row >= self._ack_degrade_after
+            else self._ack_timeout
+        )
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                have = sum(
+                    1 for v in self._standby_acked.values() if v >= idx
+                )
+                if have >= need:
+                    self._ack_timeouts_in_a_row = 0
+                    return
+                ev = self._ack_event
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._ack_timeouts_in_a_row += 1
+                Stats.get().incr("coordinator.sync_ack_timeouts")
+                return
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                self._ack_timeouts_in_a_row += 1
+                Stats.get().incr("coordinator.sync_ack_timeouts")
+                return
 
     @staticmethod
     async def _await_durable(futs: list) -> None:
@@ -531,7 +581,9 @@ class CoordinatorServer:
             self._sessions[sid] = time.monotonic() + (ttl or self._ttl)
             self._max_sid_seen = max(self._max_sid_seen, sid)
             self._record({"op": "create_session", "sid": sid}, durable=False)
+            sync_idx = self._mut_index
         self._signal_stream()
+        await self._await_standby_ack(sync_idx)
         return {"session_id": sid, "ttl": ttl or self._ttl}
 
     async def handle_heartbeat(self, session_id: int = 0) -> dict:
@@ -556,7 +608,9 @@ class CoordinatorServer:
                 touched.add(self._parent(path))
             self._record({"op": "close_session", "sid": session_id},
                          durable=False)
+            sync_idx = self._mut_index
         self._signal_change(*touched)
+        await self._await_standby_ack(sync_idx)
         return {}
 
     # ------------------------------------------------------------------
@@ -618,7 +672,9 @@ class CoordinatorServer:
                 },
                 durable=not (ephemeral and seq is None),
             ))
+            sync_idx = self._mut_index
         await self._await_durable(futs)
+        await self._await_standby_ack(sync_idx)
         self._signal_change(path, self._parent(path))
         return {"path": path}
 
@@ -661,7 +717,9 @@ class CoordinatorServer:
                  "version": version},
                 durable=node.ephemeral_owner is None,
             )]
+            sync_idx = self._mut_index
         await self._await_durable(futs)
+        await self._await_standby_ack(sync_idx)
         self._signal_change(path)
         return {"version": version}
 
@@ -689,7 +747,9 @@ class CoordinatorServer:
             del self._nodes[path]
             futs = [self._record({"op": "delete", "path": path},
                                  durable=durable)]
+            sync_idx = self._mut_index
         await self._await_durable(futs)
+        await self._await_standby_ack(sync_idx)
         self._signal_change(path, self._parent(path))
         return {}
 
@@ -771,12 +831,21 @@ class CoordinatorServer:
 
     async def handle_repl_updates(
         self, from_index: int = 1, max_wait_ms: int = 10_000,
-        max_updates: int = 500, epoch: str = "",
+        max_updates: int = 500, epoch: str = "", standby_id: str = "",
     ) -> dict:
         """Long-poll the mutation stream from ``from_index`` within
         ``epoch``. Returns ``reset=True`` when the epoch doesn't match
         this server instance or the ring no longer covers the index (the
-        standby full-transfers and resumes)."""
+        standby full-transfers and resumes). ``standby_id`` makes the
+        pull an ACK: requesting from_index implies everything before it
+        was received — the semi-sync wait watches these (the same
+        implicit-ACK design as the replication plane's seq pulls)."""
+        if standby_id and epoch == self._epoch:
+            with self._lock:
+                prev = self._standby_acked.get(standby_id, 0)
+                self._standby_acked[standby_id] = max(prev, from_index - 1)
+            self._ack_event.set()
+            self._ack_event = asyncio.Event()
         deadline = time.monotonic() + max_wait_ms / 1000.0
         while True:
             with self._lock:
@@ -957,7 +1026,7 @@ class CoordinatorServer:
                     r = await pool.call(
                         host, port, "repl_updates",
                         {"from_index": next_index, "max_wait_ms": 5000,
-                         "epoch": epoch},
+                         "epoch": epoch, "standby_id": self._epoch},
                         timeout=35,
                     )
                     down_since = None
@@ -1019,6 +1088,7 @@ class CoordinatorServer:
             grace = time.monotonic() + self._ttl
             self._sessions = {sid: grace for sid in self._sessions}
             self._session_ids = itertools.count(self._max_sid_seen + 1)
+            self._standby_acked.clear()  # acks restart under MY serving
         if self._standby_task is not None:
             self._standby_task.cancel()
             self._standby_task = None
@@ -1289,6 +1359,9 @@ def main(argv=None) -> int:
                    help="standby self-promotes after the primary is "
                         "unreachable this many seconds (deploy at most "
                         "one such standby)")
+    p.add_argument("--min_sync_standbys", type=int, default=0,
+                   help="semi-sync: mutations ack only after this many "
+                        "standbys received them (0 = async shipping)")
     args = p.parse_args(argv)
     upstream = None
     if args.replica_of:
@@ -1296,7 +1369,8 @@ def main(argv=None) -> int:
         upstream = (h, int(pt))
     srv = CoordinatorServer(port=args.port, session_ttl=args.session_ttl,
                             data_dir=args.data_dir, replica_of=upstream,
-                            auto_promote_after=args.auto_promote_after)
+                            auto_promote_after=args.auto_promote_after,
+                            min_sync_standbys=args.min_sync_standbys)
     print(f"coordinator up: port={srv.port} data_dir={args.data_dir} "
           f"standby={srv.is_standby}", flush=True)
     try:

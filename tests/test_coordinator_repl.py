@@ -304,3 +304,60 @@ def test_cluster_survives_coordinator_failover(tmp_path):
             except Exception:
                 pass
         standby.stop()
+
+
+def test_semi_sync_acks_wait_for_standby(tmp_path):
+    """min_sync_standbys=1: a create returning implies the standby has
+    already RECEIVED it (deterministic — no wait_until needed), the
+    semi-sync analog of replication mode 1."""
+    primary = CoordinatorServer(port=0, session_ttl=2.0,
+                                min_sync_standbys=1, ack_timeout=10.0)
+    standby = CoordinatorServer(
+        port=0, replica_of=("127.0.0.1", primary.port))
+    cli = None
+    try:
+        cli = CoordinatorClient("127.0.0.1", primary.port)
+        for i in range(5):
+            cli.create(f"/sync/n{i}", b"v")
+            # acked => the standby's next pull has passed this index =>
+            # it applied the record already
+            assert f"/sync/n{i}" in _standby_nodes(standby), i
+    finally:
+        if cli is not None:
+            cli.close()
+        primary.stop()
+        standby.stop()
+
+
+def test_semi_sync_degrades_without_standby():
+    """No standby connected: writes still succeed after the (degraded)
+    ack timeout — availability over durability, the reference's
+    writeWaitFollowerACK behavior with its 100-consecutive-timeouts
+    fail-fast mode (replicated_db.cpp:236-273)."""
+    from rocksplicator_tpu.utils.stats import Stats
+
+    Stats.reset_for_test()
+    # threshold 3: the client's create_session consumes one timeout, the
+    # two slow creates the second and third; everything after fails fast
+    primary = CoordinatorServer(port=0, session_ttl=2.0,
+                                min_sync_standbys=1, ack_timeout=0.3,
+                                ack_degrade_after=3)
+    cli = None
+    try:
+        cli = CoordinatorClient("127.0.0.1", primary.port)
+        t0 = time.monotonic()
+        cli.create("/d/slow1", b"v")
+        cli.create("/d/slow2", b"v")
+        slow = time.monotonic() - t0
+        assert slow >= 0.5  # two full ack timeouts
+        t0 = time.monotonic()
+        for i in range(5):
+            cli.create(f"/d/fast{i}", b"v")
+        fast = time.monotonic() - t0
+        assert fast < 0.5  # degraded: ~10ms waits fail fast
+        assert Stats.get().get_counter(
+            "coordinator.sync_ack_timeouts") >= 7
+    finally:
+        if cli is not None:
+            cli.close()
+        primary.stop()
