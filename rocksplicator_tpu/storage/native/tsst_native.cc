@@ -246,7 +246,9 @@ int32_t bloom_may_contain(
 // PLANAR block point lookup (storage/planar.py layout)
 // ---------------------------------------------------------------------------
 //
-// Block: u32 n | u8 klen | u8 vlen | u8 flags | u8 0 | u64 0, then u32
+// Block: u32 n | u8 klen | u8 vlen_lo | u8 flags | u8 vlen_hi | u64 0
+// (vlen = vlen_lo | vlen_hi<<8 — u16, byte 7 was reserved-zero so old
+// files read back unchanged), then u32
 // planes: key words (BE values, ceil(klen/4) x n), seq_lo (n), seq_hi
 // (n, absent when flags&1), vtype (ceil(n/4), 4 packed/word), value
 // words (LE values, ceil(vlen/4) x n). Keys ascending -> binary search,
@@ -275,8 +277,10 @@ extern "C" int64_t tsst_planar_get_entries(
   *past_end = 0;
   if (len < 16) return -2;
   uint32_t n = get_u32(data);
-  uint8_t bklen = data[4], bvlen = data[5], flags = data[6];
-  uint64_t kw = (bklen + 3) / 4, vw = (bvlen + 3) / 4;
+  uint8_t bklen = data[4], flags = data[6];
+  uint16_t bvlen = (uint16_t)data[5] | ((uint16_t)data[7] << 8);
+  if (bklen == 0 || bklen > 24) return -2;
+  uint64_t kw = (bklen + 3) / 4, vw = ((uint64_t)bvlen + 3) / 4;
   int seq32 = flags & 1;
   uint64_t words = (uint64_t)n * (kw + 1 + (seq32 ? 0 : 1) + vw)
                  + (n + 3) / 4;

@@ -38,8 +38,39 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-PLANAR_HEADER = struct.Struct("<IBBBBQ")  # n, klen, vlen, flags, 0, 0
+# n, klen, vlen_lo, flags, vlen_hi, reserved. vlen is u16 split across
+# bytes 5 (lo) and 7 (hi): byte 7 was a reserved zero in the original
+# layout, so every previously-written file reads back with vlen_hi == 0 —
+# the widening is backward-compatible. klen stays u8 (bounded at 24, the
+# TPU key-lane width).
+PLANAR_HEADER = struct.Struct("<IBBBBQ")
 PLANAR_FLAG_SEQ32 = 1
+PLANAR_MAX_KLEN = 24
+PLANAR_MAX_VLEN = 0xFFFF
+
+
+def pack_planar_header(n: int, klen: int, vlen: int, flags: int) -> bytes:
+    """The ONLY planar-header packer (every sink goes through here so the
+    vlen bound is enforced in one place — the round-2 crash was a sink
+    packing vlen straight into a 'B' field)."""
+    if not (0 < klen <= PLANAR_MAX_KLEN):
+        raise ValueError(f"planar klen out of range: {klen}")
+    if not (0 <= vlen <= PLANAR_MAX_VLEN):
+        raise ValueError(f"planar vlen out of range: {vlen}")
+    return PLANAR_HEADER.pack(n, klen, vlen & 0xFF, flags, vlen >> 8, 0)
+
+
+def unpack_planar_header(raw: bytes) -> Tuple[int, int, int, int]:
+    """(n, klen, vlen, flags) with bounds validation → Corruption."""
+    from .errors import Corruption
+
+    if len(raw) < PLANAR_HEADER.size:
+        raise Corruption(f"planar block: {len(raw)} bytes < header")
+    n, klen, vlen_lo, flags, vlen_hi, _ = PLANAR_HEADER.unpack_from(raw, 0)
+    vlen = vlen_lo | (vlen_hi << 8)
+    if not (0 < klen <= PLANAR_MAX_KLEN):
+        raise Corruption(f"planar block: klen {klen} out of range")
+    return n, klen, vlen, flags
 
 
 def plane_words(n: int, klen: int, vlen: int, seq32: bool) -> int:
@@ -83,14 +114,14 @@ def encode_planar_block(
         parts.append(np.ascontiguousarray(
             arrays["val_words"][start:end, :vw].T).reshape(-1))
     words = np.concatenate(parts).astype("<u4")
-    header = PLANAR_HEADER.pack(
-        n, klen, vlen, PLANAR_FLAG_SEQ32 if seq32 else 0, 0, 0)
+    header = pack_planar_header(
+        n, klen, vlen, PLANAR_FLAG_SEQ32 if seq32 else 0)
     return header + words.tobytes()
 
 
 def decode_planar_block(raw: bytes) -> Dict[str, np.ndarray]:
     """Planar block bytes -> lane arrays (pure views/reshapes)."""
-    n, klen, vlen, flags, _, _ = PLANAR_HEADER.unpack_from(raw, 0)
+    n, klen, vlen, flags = unpack_planar_header(raw)
     seq32 = bool(flags & PLANAR_FLAG_SEQ32)
     kw = (klen + 3) // 4
     vw = (vlen + 3) // 4

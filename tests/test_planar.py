@@ -301,3 +301,106 @@ def test_engine_flush_writes_planar_files(tmp_path):
     assert not r2.props.get("planar")
     assert db2.get(b"b" * 16) == b"123"
     db2.close()
+
+
+def test_planar_wide_values_roundtrip():
+    """vlen is a u16 in the header (byte 7 carries the high byte — the
+    round-2 crash was values >= 256 B overflowing a u8 field). Pin the
+    codec at 300 B and at the 65535-B boundary."""
+    for vlen in (300, 65535):
+        vb = (vlen + 3) // 4 * 4
+        entries = [
+            (f"k{i:07d}".encode(), 10 + i, int(OpType.PUT),
+             bytes([i + 1]) * vlen)
+            for i in range(3)
+        ]
+        arrays, n = _arrays_val_bytes(entries, vb)
+        raw = encode_planar_block(arrays, 0, n, 8, vlen, seq32=False)
+        got = list(iter_planar_block(raw))
+        assert [g[0] for g in got] == [e[0] for e in entries]
+        assert [g[3] for g in got] == [e[3] for e in entries]
+
+
+def _arrays_val_bytes(entries, val_bytes):
+    b = pack_entries(entries, val_bytes=val_bytes)
+    n = b.num_valid()
+    return {
+        "key_words_be": b.key_words_be[:n],
+        "key_words_le": b.key_words_le[:n],
+        "key_len": b.key_len[:n],
+        "seq_hi": b.seq_hi[:n],
+        "seq_lo": b.seq_lo[:n],
+        "vtype": b.vtype[:n],
+        "val_words": b.val_words[:n],
+        "val_len": b.val_len[:n],
+    }, n
+
+
+def test_planar_widths_bounds_vlen():
+    """Values wider than the u16 header field must refuse the planar sink
+    (entry-stream handles them), never crash the header packer."""
+    from rocksplicator_tpu.storage.planar import (PLANAR_MAX_VLEN,
+                                                  pack_planar_header)
+
+    entries = [(b"k" * 8, 1, int(OpType.PUT), b"v" * (PLANAR_MAX_VLEN + 1))]
+    arrays, n = _arrays_val_bytes(entries, PLANAR_MAX_VLEN + 5)
+    assert planar_widths(arrays, n) is None
+    with pytest.raises(ValueError):
+        pack_planar_header(1, 8, PLANAR_MAX_VLEN + 1, 0)
+    with pytest.raises(ValueError):
+        pack_planar_header(1, 25, 8, 0)  # klen beyond the TPU key lanes
+
+
+def test_decode_planar_block_bad_klen_raises_corruption():
+    """A length-self-consistent block with klen > 24 must raise Corruption
+    (not a numpy broadcast error) on the generic reader path."""
+    n, klen, vlen = 4, 30, 8
+    words = plane_words(n, klen, vlen, seq32=False)
+    raw = PLANAR_HEADER.pack(n, klen, vlen, 0, 0, 0) + b"\x00" * (4 * words)
+    with pytest.raises(Corruption):
+        decode_planar_block(raw)
+    with pytest.raises(Corruption):
+        list(iter_planar_block(raw))
+
+
+def test_engine_flush_512b_values_planar(tmp_path):
+    """The round-2 repro: 200 puts of 512-byte uniform values crashed
+    every flush. Now they take the planar sink and read back, including
+    across reopen."""
+    from rocksplicator_tpu.storage.engine import DB, DBOptions
+
+    path = str(tmp_path / "db")
+    db = DB(path, DBOptions(memtable_bytes=64 * 1024, compression=0))
+    for i in range(200):
+        db.put(b"key%08d" % i, bytes([i % 251]) * 512)
+    db.flush()
+    assert any(
+        db._readers[nm].props.get("planar")
+        for files in db._levels for nm in files
+    )
+    db.close()
+    db = DB(path)
+    for i in range(200):
+        assert db.get(b"key%08d" % i) == bytes([i % 251]) * 512
+    db.close()
+
+
+def test_engine_flush_64kb_values_fallback(tmp_path):
+    """Values beyond the u16 planar bound fall back to the entry-stream
+    writer — flush still succeeds and data reads back."""
+    from rocksplicator_tpu.storage.engine import DB, DBOptions
+
+    path = str(tmp_path / "db")
+    db = DB(path, DBOptions(compression=0))
+    big = 64 * 1024  # 65536 > PLANAR_MAX_VLEN
+    for i in range(4):
+        db.put(b"wide%04d" % i, bytes([i + 1]) * big)
+    db.flush()
+    for files in db._levels:
+        for nm in files:
+            assert not db._readers[nm].props.get("planar")
+    db.close()
+    db = DB(path)
+    for i in range(4):
+        assert db.get(b"wide%04d" % i) == bytes([i + 1]) * big
+    db.close()

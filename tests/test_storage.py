@@ -781,3 +781,56 @@ def test_background_flush_ordering_vs_ingest(tmp_path):
         db.put(b"k", b"old-memtable")
         db.ingest_external_file([str(ext)])
         assert db.get(b"k") == b"ingested"  # ingest is newer than old write
+
+
+def test_background_flush_failure_surfaces_to_writers(tmp_path, monkeypatch):
+    """A permanently failing background flusher must fail writes after
+    max_flush_failures consecutive retries instead of silently accepting
+    data it can never persist (the round-2 silent-forever failure mode)."""
+    db = DB(
+        str(tmp_path / "db"),
+        DBOptions(
+            memtable_bytes=1024,
+            background_compaction=True,
+            max_flush_failures=2,
+        ),
+    )
+    try:
+        calls = {"n": 0}
+        real = DB._write_mem_sst
+
+        def boom(self, path, mem):
+            calls["n"] += 1
+            raise OSError("disk full")
+
+        monkeypatch.setattr(DB, "_write_mem_sst", boom)
+        # write until memtables swap to the imm queue, the bg flusher
+        # starts failing, and the failure reaches a writer
+        deadline = time.time() + 30.0
+        raised = None
+        i = 0
+        while time.time() < deadline and raised is None:
+            try:
+                db.put(b"k%06d" % i, b"v" * 64)
+                i += 1
+            except StorageError as e:
+                raised = e
+                break
+            time.sleep(0.001)
+        assert raised is not None, "writes kept succeeding under dead flusher"
+        assert "background flush failed" in str(raised)
+        assert calls["n"] >= 2
+        # flusher recovery clears the gate: restore the sink and the DB
+        # accepts writes again once the backlog drains
+        monkeypatch.setattr(DB, "_write_mem_sst", real)
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            try:
+                db.put(b"after", b"recovery")
+                break
+            except StorageError:
+                time.sleep(0.05)
+        db.flush()
+        assert db.get(b"after") == b"recovery"
+    finally:
+        db.close()
