@@ -15,6 +15,9 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..errors import Corruption
+from ..planar import unpack_planar_header
+
 log = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -218,7 +221,10 @@ class NativeLib:
         data = np.frombuffer(raw, dtype=np.uint8)
         kbuf = (np.frombuffer(key, dtype=np.uint8) if key
                 else np.zeros(1, np.uint8))
-        vlen_cap = int(raw[5]) if len(raw) >= 16 else 0
+        try:
+            _, _, vlen_cap, _ = unpack_planar_header(raw)
+        except Corruption:
+            return None  # slow path will raise the descriptive error
         seqs = np.empty(max_matches, dtype=np.uint64)
         vtypes = np.empty(max_matches, dtype=np.uint8)
         vals = np.zeros((max_matches, max(1, vlen_cap)), dtype=np.uint8)

@@ -198,3 +198,40 @@ def test_native_planar_get_entries_parity():
         # absent key greater than everything: later blocks may match
         m, pe = native.planar_get_entries(raw, b"zzz")
         assert m == [] and not pe
+
+
+def test_native_planar_get_entries_wide_values():
+    """vlen >= 256 must stay on the native fast path (the u16 header high
+    byte lives at byte 7; the binding must pass the full cap, not just
+    the low byte — regression for the round-3 truncated-cap bug)."""
+    from rocksplicator_tpu.ops.kv_format import pack_entries
+    from rocksplicator_tpu.storage.native.binding import get_native
+    from rocksplicator_tpu.storage.planar import (
+        encode_planar_block, iter_planar_block)
+    from rocksplicator_tpu.storage.records import OpType
+
+    native = get_native()
+    if native is None or not native._has_planar:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    vlen = 300
+    vb = (vlen + 3) // 4 * 4
+    entries = [
+        (f"wk{i:06d}".encode(), 10 + i, int(OpType.PUT),
+         bytes([i + 1]) * vlen)
+        for i in range(8)
+    ]
+    b = pack_entries(entries, val_bytes=vb)
+    n = b.num_valid()
+    arrays = {f: getattr(b, f)[:n] for f in (
+        "key_words_be", "key_len", "seq_hi", "seq_lo", "vtype",
+        "val_words", "val_len")}
+    raw = encode_planar_block(arrays, 0, n, 8, vlen, seq32=False)
+    ref = list(iter_planar_block(raw))
+    for k, s, vt, v in ref:
+        got = native.planar_get_entries(raw, k)
+        assert got is not None, "wide values fell off the native fast path"
+        matches, _ = got
+        assert matches == [(s, vt, v)]
+        assert len(matches[0][2]) == vlen

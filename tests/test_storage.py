@@ -24,7 +24,11 @@ from rocksplicator_tpu.storage import (
     destroy_db,
 )
 from rocksplicator_tpu.storage.bloom import BloomFilter, word_mask
-from rocksplicator_tpu.storage.errors import Corruption, InvalidArgument
+from rocksplicator_tpu.storage.errors import (
+    Corruption,
+    InvalidArgument,
+    StorageError,
+)
 from rocksplicator_tpu.storage.records import _TS
 from rocksplicator_tpu.storage.sst import SSTReader, SSTWriter
 from rocksplicator_tpu.storage import wal as wal_mod
