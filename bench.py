@@ -22,6 +22,7 @@ Diagnostics go to stderr.
 import json
 import multiprocessing
 import os
+import queue as queue_mod
 import sys
 import time
 
@@ -71,38 +72,115 @@ def build_inputs():
     return stacked
 
 
-def _probe_devices(q):
-    """Watchdog child (module-level: spawn must pickle it)."""
+def _tpu_worker_main(cmd_q, res_q):
+    """Persistent TPU worker child (module-level: spawn must pickle it).
+
+    Initializes jax ONCE — reported as a readiness message so the parent's
+    init watchdog and the phase runner are the SAME process — then serves
+    phase commands off a queue. Rounds 1-3 paid full jax init (the thing
+    that times out on the shared pool) per phase in throwaway children;
+    the warmed runtime and in-process XLA cache now serve every phase and
+    every climb step. A persistent on-disk compilation cache additionally
+    survives bench re-runs on the same host."""
     try:
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            import __graft_entry__ as graft
+
+            graft._honor_platform_env()
         import jax
 
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get("BENCH_XLA_CACHE", "/tmp/rstpu_xla_cache"),
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception as e:  # older jax: knobs absent — cache is a bonus
+            log(f"worker: no persistent compile cache ({e!r})")
+        t0 = time.monotonic()
         jax.devices()
-        q.put(True)
-    except Exception:
-        q.put(False)
+        res_q.put({"ok": True, "ready": True,
+                   "backend": jax.default_backend(),
+                   "init_sec": round(time.monotonic() - t0, 1)})
+    except Exception as e:
+        res_q.put({"ok": False, "ready": True, "err": repr(e)})
+        return
+    while True:
+        cmd = cmd_q.get()
+        if not cmd or cmd.get("phase") == "quit":
+            return
+        try:
+            if cmd["phase"] == "kernel":
+                g = bench_tpu_kernel(cmd["shards"])
+            else:
+                g = bench_tpu_transfer(build_inputs(), cmd["kernel_gbps"])
+            res_q.put({"ok": True, "gbps": g,
+                       "backend": jax.default_backend()})
+        except Exception as e:  # noqa: BLE001 — child reports, parent decides
+            res_q.put({"ok": False, "err": repr(e)})
 
 
-def _start_device_watchdog():
-    """Spawn the accelerator-init probe (overlaps with input building)."""
-    ctx = multiprocessing.get_context("spawn")
-    q = ctx.Queue()
-    p = ctx.Process(target=_probe_devices, args=(q,), daemon=True)
-    p.start()
-    return p, q
+class _TpuWorker:
+    """Parent-side handle. The parent NEVER initializes jax itself: a
+    pool-side XLA compile can hang for minutes inside one C call and
+    CPython delivers signals only between bytecodes — a parent compiling
+    inline could never run its SIGTERM best-so-far emitter. All waits
+    happen in 1s queue slices (signal-interruptible)."""
 
+    def __init__(self):
+        ctx = multiprocessing.get_context("spawn")
+        self.cmd_q = ctx.Queue()
+        self.res_q = ctx.Queue()
+        self.proc = ctx.Process(
+            target=_tpu_worker_main, args=(self.cmd_q, self.res_q),
+            daemon=True,
+        )
+        self.proc.start()
 
-def _join_device_watchdog(p, q, timeout_sec: float = 120.0) -> bool:
-    """True iff the accelerator initialized within the timeout. A wedged
-    TPU tunnel must degrade the bench to CPU, never hang it."""
-    p.join(timeout_sec)
-    if p.is_alive():
-        p.kill()
-        p.join(5)
-        return False
-    try:
-        return bool(q.get_nowait())
-    except Exception:
-        return False
+    def _wait_result(self, timeout_sec: float):
+        """Result dict, {"ok": False, err} if the worker died, or None on
+        timeout (caller decides whether to abandon)."""
+        deadline = time.monotonic() + timeout_sec
+        while time.monotonic() < deadline:
+            try:
+                return self.res_q.get(timeout=1.0)
+            except queue_mod.Empty:
+                if not self.proc.is_alive():
+                    return {"ok": False, "err": "worker process died"}
+        return None
+
+    def wait_ready(self, timeout_sec: float):
+        return self._wait_result(timeout_sec)
+
+    def run_phase(self, phase: str, shards: int, timeout_sec: float,
+                  kernel_gbps: float = 0.0):
+        self.cmd_q.put(
+            {"phase": phase, "shards": shards, "kernel_gbps": kernel_gbps})
+        return self._wait_result(timeout_sec)
+
+    def abandon(self):
+        """Walk away from a hung worker WITHOUT killing it: SIGKILLing a
+        process holding a live tunnel session wedges the grant pool-side
+        (round-1 postmortem), and multiprocessing's atexit handler TERMs
+        any still-registered daemon child — so deregister it and let it
+        finish (or hang) on its own."""
+        log(f"abandoning tpu worker pid={self.proc.pid} "
+            f"(not killed: SIGKILL wedges the tunnel grant)")
+        try:
+            import multiprocessing.process as _mpp
+
+            children = getattr(_mpp, "_children", None)
+            if children is not None:
+                children.discard(self.proc)
+        except Exception as e:
+            log(f"worker deregistration failed (harmless): {e!r}")
+
+    def quit(self):
+        try:
+            self.cmd_q.put({"phase": "quit"})
+        except Exception:
+            pass
 
 
 def _model_args(dev):
@@ -346,10 +424,11 @@ def bench_python(stacked):
 def measure_write_stall_p99():
     """BASELINE target: write-stall p99 < 10 ms under a compaction storm.
     Runs a concurrent-writer storm against the real engine (tiny
-    memtables + aggressive L0 trigger keep flush and compaction
-    saturated) and reads the storage.write_stall_ms histogram. Returns
-    (p99_ms, samples) — zero samples is itself the result: the engine's
-    flush/compaction threads kept up and no writer ever stalled."""
+    memtables + aggressive L0 trigger + depth-1 imm queue keep the
+    background flusher saturated) and reads the storage.write_stall_ms
+    histogram. Returns (p99_ms, samples) with samples > 0 — the storm
+    escalates until writers demonstrably stalled, so the p99 reflects
+    the real stall path, not a workload that never entered it."""
     import shutil
     import tempfile
     import threading
@@ -357,91 +436,97 @@ def measure_write_stall_p99():
     from rocksplicator_tpu.storage.engine import DB, DBOptions
     from rocksplicator_tpu.utils.stats import Stats
 
-    Stats.reset_for_test()
-    d = tempfile.mkdtemp(prefix="rstpu-bench-stall-")
-    try:
-        opts = DBOptions(
-            memtable_bytes=32 << 10,  # tiny memtables force flush/compaction
-            level0_compaction_trigger=2,
-        )
-        db = DB(os.path.join(d, "db"), opts)
-        val = b"v" * 512
+    # background_compaction=True is load-bearing: without it writes take
+    # the inline-flush path and the stall loop that records
+    # storage.write_stall_ms can never run — rounds 1-3 reported a
+    # vacuous "p99 = 0.00 ms, samples=0". The storm escalates pressure
+    # until writers actually stall (imm queue full), so the reported p99
+    # is a measurement, not an artifact of never entering the code path.
+    for memtable_kb, n_writes, vlen in ((64, 8000, 512), (16, 8000, 2048)):
+        Stats.reset_for_test()
+        d = tempfile.mkdtemp(prefix="rstpu-bench-stall-")
+        try:
+            opts = DBOptions(
+                memtable_bytes=memtable_kb << 10,
+                level0_compaction_trigger=2,
+                background_compaction=True,
+            )
+            db = DB(os.path.join(d, "db"), opts)
+            val = b"v" * vlen
 
-        def writer(tid: int) -> None:
-            for i in range(6000):
-                db.put(f"t{tid}k{i % 2048:08d}".encode(), val)
+            def writer(tid: int) -> None:
+                for i in range(n_writes):
+                    db.put(f"t{tid}k{i % 2048:08d}".encode(), val)
 
-        threads = [threading.Thread(target=writer, args=(t,))
-                   for t in range(4)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        db.close()
-        stats = Stats.get()
-        p99 = stats.metric_percentile("storage.write_stall_ms", 99)
-        n = stats.metric_count("storage.write_stall_ms")
-        log(f"write-stall p99 under storm: {p99:.2f} ms (samples={n})")
-        return round(p99, 3), n
-    finally:
-        shutil.rmtree(d, ignore_errors=True)
+            threads = [threading.Thread(target=writer, args=(t,))
+                       for t in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            db.close()
+            stats = Stats.get()
+            p99 = stats.metric_percentile("storage.write_stall_ms", 99)
+            n = stats.metric_count("storage.write_stall_ms")
+            log(f"write-stall p99 under storm (memtable={memtable_kb}K "
+                f"val={vlen}B): {p99:.2f} ms (samples={n})")
+            if n > 0:
+                return round(p99, 3), n
+            log("storm produced zero stall samples — escalating pressure")
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    return None, 0
 
 
-def _tpu_phase_child(phase: str, shards: int, kernel_gbps: float, q):
-    """One TPU phase in a SPAWNED CHILD. The parent never initializes an
-    accelerator backend: a pool-side XLA compile can hang for minutes
-    inside one C call, and CPython only delivers signal handlers between
-    bytecodes — a parent compiling inline could never run its SIGTERM
-    best-so-far emitter (the exact scenario it exists for). The child
-    hangs instead; the parent stays responsive."""
-    try:
-        if os.environ.get("JAX_PLATFORMS") == "cpu":
-            import __graft_entry__ as graft
-
-            graft._honor_platform_env()
-        if phase == "kernel":
-            g = bench_tpu_kernel(shards)
+def _acquire_worker(start: float):
+    """Bring up a ready TPU worker, retrying once on failure, degrading
+    to the CPU platform as the last resort. Returns (worker, device_ok,
+    backend_name). Round-3 postmortem: the 120s init default expired
+    every round while the chip was in fact reachable (PERF.md measured
+    it interactively) — init now gets the bulk of the time budget, a
+    second attempt, and overlaps all the host-side phases that already
+    ran before this is called."""
+    init_budget = float(os.environ.get("BENCH_INIT_TIMEOUT", "0")) or max(
+        300.0, TIME_BUDGET - (time.monotonic() - start))
+    worker = _acquire_worker.pending or _TpuWorker()
+    _acquire_worker.pending = None
+    for attempt in (1, 2):
+        t0 = time.monotonic()
+        msg = worker.wait_ready(init_budget)
+        if msg and msg.get("ok"):
+            log(f"accelerator ready in {msg.get('init_sec', '?')}s "
+                f"(attempt {attempt}, backend={msg.get('backend')})")
+            return worker, True, msg.get("backend", "unknown")
+        if msg is None:
+            # hung init: abandon (never kill — tunnel grant) and retry
+            # once in case the pool freed up
+            log(f"accelerator init timed out after "
+                f"{time.monotonic() - t0:.0f}s (attempt {attempt})")
+            worker.abandon()
         else:
-            g = bench_tpu_transfer(build_inputs(), kernel_gbps)
-        import jax
+            log(f"accelerator init failed (attempt {attempt}): "
+                f"{msg.get('err')}")
+        if attempt == 1:
+            init_budget = float(
+                os.environ.get("BENCH_INIT_RETRY_TIMEOUT", "240"))
+            worker = _TpuWorker()
+    # Wedged/absent accelerator: force the CPU platform so the run still
+    # completes — and LABEL the result as degraded. The env propagates to
+    # the fresh spawned worker, which calls _honor_platform_env (env
+    # alone is not enough: sitecustomize re-registers the tunnel in every
+    # fresh interpreter).
+    log("falling back to CPU platform (degraded run)")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    worker = _TpuWorker()
+    msg = worker.wait_ready(120.0)
+    if msg and msg.get("ok"):
+        return worker, False, msg.get("backend", "cpu")
+    worker.abandon()
+    return None, False, "cpu"
 
-        q.put({"ok": True, "gbps": g, "backend": jax.default_backend()})
-    except Exception as e:  # noqa: BLE001 — child reports, parent decides
-        q.put({"ok": False, "err": repr(e)})
 
-
-def _run_tpu_phase(phase: str, shards: int, timeout_sec: float,
-                   kernel_gbps: float = 0.0):
-    """Spawn a TPU phase child and wait in 1s join slices (signal-
-    interruptible). On timeout the child is ABANDONED, not killed:
-    SIGKILLing a process holding a live tunnel session wedges the grant
-    pool-side (round-1 postmortem). Returns the child's result dict or
-    None."""
-    ctx = multiprocessing.get_context("spawn")
-    q = ctx.Queue()
-    p = ctx.Process(target=_tpu_phase_child,
-                    args=(phase, shards, kernel_gbps, q), daemon=True)
-    p.start()
-    deadline = time.monotonic() + timeout_sec
-    while p.is_alive() and time.monotonic() < deadline:
-        p.join(1.0)
-    if p.is_alive():
-        log(f"tpu phase {phase}@{shards} still running after "
-            f"{timeout_sec:.0f}s — abandoning child pid={p.pid} "
-            f"(not killed: SIGKILL wedges the tunnel grant)")
-        # Truly abandon: multiprocessing's atexit handler TERMINATES any
-        # still-registered daemon child at parent exit — which would be
-        # the abrupt kill-while-holding-a-grant this design avoids.
-        # Deregistering the child leaves it to finish (or hang) on its
-        # own; it is a daemon of init after the parent exits.
-        import multiprocessing.process as _mpp
-
-        _mpp._children.discard(p)
-        return None
-    try:
-        return q.get(timeout=5)
-    except Exception:
-        return None
+_acquire_worker.pending = None
 
 
 # Best-so-far result shared with the SIGTERM handler: the batch-size
@@ -476,30 +561,24 @@ def main():
         f"iters={ITERS} climb={CLIMB_SHARDS} budget={TIME_BUDGET}s")
     _install_term_handler()
     start = time.monotonic()
-    wd = _start_device_watchdog()  # overlaps with input construction
+    # Kick off accelerator init FIRST: it overlaps every host-side phase
+    # below (inputs, CPU baselines, stall storm — minutes of free cover
+    # for the slow pool-side init that timed out in rounds 1-3).
+    _acquire_worker.pending = _TpuWorker()
     stacked = build_inputs()
-    device_ok = _join_device_watchdog(
-        *wd, float(os.environ.get("BENCH_INIT_TIMEOUT", "120"))
-    )
-    if not device_ok:
-        # Wedged/absent accelerator: force the CPU platform so the run
-        # still completes — and LABEL the result as degraded. The env
-        # propagates to the spawned phase children, which call
-        # _honor_platform_env (env alone is not enough: sitecustomize
-        # re-registers the tunnel in every fresh interpreter).
-        log("accelerator init timed out — falling back to CPU platform")
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     # CPU parallel baseline first: it forks, which must happen before
-    # jax initializes its multithreaded runtime in this process.
+    # jax initializes a multithreaded runtime in THIS process (it never
+    # does — see _TpuWorker — but keep the safe order anyway).
     try:
         mp_gbps, cores, workers = bench_numpy_multiproc(stacked)
     except Exception as e:  # a failed fork must not kill the JSON output
         log(f"cpu multiprocess baseline failed: {e!r}")
         mp_gbps, cores, workers = None, len(os.sched_getaffinity(0)), 1
-    # The parent NEVER initializes jax (see _tpu_phase_child); the
-    # platform label comes back from the phase children.
-    platform = {"name": "cpu" if not device_ok else "unknown"}
+    # Pessimistic until acquisition resolves: a SIGTERM mid-acquire must
+    # emit the placeholder as degraded, not as a healthy run with no
+    # accelerator number.
+    device_ok = False
+    platform = {"name": "unknown"}
 
     def record(tpu_gbps, tpu_shards, tpu_xfer_gbps):
         """Fold the current best TPU numbers + all host numbers into the
@@ -558,12 +637,36 @@ def main():
     record(0.0, 0, None)
     _RESULT["data"]["tpu_phase_incomplete"] = True
 
+    # All host phases done — now claim the (hopefully long-since-warm)
+    # accelerator worker.
+    worker, device_ok, backend = _acquire_worker(start)
+    platform["name"] = backend
+    record(0.0, 0, None)
+    _RESULT["data"]["tpu_phase_incomplete"] = True
+    if worker is None:
+        log("no usable backend at all — emitting host-only result")
+        _emit_result()
+        return
+
     def budget_left():
         return max(60.0, TIME_BUDGET - (time.monotonic() - start))
 
+    def phase(name, shards, timeout, kernel_gbps=0.0):
+        """Run one phase on the persistent worker; a TIMEOUT abandons the
+        worker and disables all further TPU phases (commands would just
+        queue behind the wedged one)."""
+        if worker.proc is None:
+            return None
+        res = worker.run_phase(name, shards, timeout, kernel_gbps)
+        if res is None:
+            log(f"tpu phase {name}@{shards} timed out after {timeout:.0f}s")
+            worker.abandon()
+            worker.proc = None
+        return res
+
     # first climb step: the guaranteed real-TPU number
     first = CLIMB_SHARDS[0] if CLIMB_SHARDS else SHARDS
-    res = _run_tpu_phase("kernel", first, budget_left() + 240)
+    res = phase("kernel", first, budget_left() + 240)
     if not (res and res.get("ok")):
         log(f"tpu kernel bench at {first} shards failed: "
             f"{(res or {}).get('err', 'timeout')}")
@@ -575,8 +678,7 @@ def main():
 
     # transfer-inclusive phase (8 shards, tunnel-bound)
     tpu_xfer_gbps = None
-    res = _run_tpu_phase("transfer", first, budget_left(),
-                         kernel_gbps=tpu_gbps)
+    res = phase("transfer", first, budget_left(), kernel_gbps=tpu_gbps)
     if res and res.get("ok"):
         tpu_xfer_gbps = res["gbps"]
     else:
@@ -584,18 +686,18 @@ def main():
             f"{(res or {}).get('err', 'timeout')}")
     record(tpu_gbps, tpu_shards, tpu_xfer_gbps)
 
-    # climb: larger batches amortize the per-dispatch floor. Each step
-    # costs a fresh compile (minutes on a contended pool), so stop
-    # climbing once the budget is spent; SIGTERM mid-step still emits.
-    # A degraded (CPU-fallback) run skips the climb: its number is only
-    # ever consumed as a labeled-degraded value.
+    # climb: larger batches amortize the per-dispatch floor. Compiles are
+    # cheap now (warm worker + persistent cache) but still bounded by the
+    # budget; SIGTERM mid-step still emits best-so-far. A degraded
+    # (CPU-fallback) run skips the climb: its number is only ever
+    # consumed as a labeled-degraded value.
     for shards in (CLIMB_SHARDS[1:] if device_ok else ()):
         elapsed = time.monotonic() - start
         if elapsed > TIME_BUDGET:
             log(f"climb stopped at {tpu_shards} shards "
                 f"({elapsed:.0f}s > {TIME_BUDGET:.0f}s budget)")
             break
-        res = _run_tpu_phase("kernel", shards, budget_left())
+        res = phase("kernel", shards, budget_left())
         if not (res and res.get("ok")):
             log(f"climb step {shards} shards failed: "
                 f"{(res or {}).get('err', 'timeout')}")
@@ -604,6 +706,8 @@ def main():
             tpu_gbps, tpu_shards = res["gbps"], shards
             record(tpu_gbps, tpu_shards, tpu_xfer_gbps)
 
+    if worker.proc is not None:
+        worker.quit()
     _emit_result()
 
 
