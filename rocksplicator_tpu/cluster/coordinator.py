@@ -332,6 +332,7 @@ class CoordinatorServer:
         self._quorum_size = quorum_size
         self._leader_lease_sec = leader_lease_sec
         self._standby_last_pull: Dict[str, float] = {}
+        self._standby_parked: Dict[str, int] = {}  # live long-polls
         # Fencing token (monotonic, the ZK-epoch analog): bumped by every
         # promote, carried on repl_state/repl_updates (standbys adopt the
         # max) and on mutation acks (clients remember the max and refuse
@@ -473,8 +474,9 @@ class CoordinatorServer:
         now = time.monotonic()
         with self._lock:
             live = sum(
-                1 for t in self._standby_last_pull.values()
+                1 for sid, t in self._standby_last_pull.items()
                 if now - t <= self._leader_lease_sec
+                or self._standby_parked.get(sid, 0) > 0
             )
         if live < need:
             raise RpcApplicationError(
@@ -674,6 +676,9 @@ class CoordinatorServer:
 
     async def handle_close_session(self, session_id: int = 0) -> dict:
         self._check_primary()
+        # mutates the tree (drops ephemerals): same lease gate as every
+        # other mutation — a minority primary must not diverge its stream
+        self._check_quorum_lease()
         with self._lock:
             self._sessions.pop(session_id, None)
             touched: Set[str] = set()
@@ -966,39 +971,56 @@ class CoordinatorServer:
                 self._ack_event.set()
                 self._ack_event = asyncio.Event()
         deadline = time.monotonic() + max_wait_ms / 1000.0
-        while True:
-            with self._lock:
-                ring_start = (
-                    self._recent[0][0] if self._recent
-                    else self._mut_index + 1
-                )
-                if (
-                    epoch != self._epoch
-                    or from_index < ring_start
-                    or from_index > self._mut_index + 1
-                ):
-                    return {"reset": True, "updates": [], "indices": [],
+        # A standby PARKED in this long-poll is in contact by definition:
+        # count it for the quorum lease for the whole poll (its
+        # _standby_last_pull stamp otherwise ages up to max_wait_ms,
+        # letting a healthy primary spuriously lose its lease), and
+        # refresh the stamp on the way out.
+        if standby_id:
+            self._standby_parked[standby_id] = (
+                self._standby_parked.get(standby_id, 0) + 1)
+        try:
+            while True:
+                with self._lock:
+                    ring_start = (
+                        self._recent[0][0] if self._recent
+                        else self._mut_index + 1
+                    )
+                    if (
+                        epoch != self._epoch
+                        or from_index < ring_start
+                        or from_index > self._mut_index + 1
+                    ):
+                        return {"reset": True, "updates": [], "indices": [],
+                                "ftoken": self._fencing_token}
+                    updates = [
+                        (i, r) for i, r in self._recent if i >= from_index
+                    ][:max_updates]
+                    if updates:
+                        return {
+                            "reset": False,
+                            "updates": [r for _, r in updates],
+                            "indices": [i for i, _ in updates],
+                            "ftoken": self._fencing_token,
+                        }
+                    ev = self._stream_event
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"reset": False, "updates": [], "indices": [],
                             "ftoken": self._fencing_token}
-                updates = [
-                    (i, r) for i, r in self._recent if i >= from_index
-                ][:max_updates]
-                if updates:
-                    return {
-                        "reset": False,
-                        "updates": [r for _, r in updates],
-                        "indices": [i for i, _ in updates],
-                        "ftoken": self._fencing_token,
-                    }
-                ev = self._stream_event
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                return {"reset": False, "updates": [], "indices": [],
-                        "ftoken": self._fencing_token}
-            try:
-                await asyncio.wait_for(ev.wait(), remaining)
-            except asyncio.TimeoutError:
-                return {"reset": False, "updates": [], "indices": [],
-                        "ftoken": self._fencing_token}
+                try:
+                    await asyncio.wait_for(ev.wait(), remaining)
+                except asyncio.TimeoutError:
+                    return {"reset": False, "updates": [], "indices": [],
+                            "ftoken": self._fencing_token}
+        finally:
+            if standby_id:
+                n = self._standby_parked.get(standby_id, 1) - 1
+                if n <= 0:
+                    self._standby_parked.pop(standby_id, None)
+                else:
+                    self._standby_parked[standby_id] = n
+                self._standby_last_pull[standby_id] = time.monotonic()
 
     # ------------------------------------------------------------------
     # replication: standby side
@@ -1225,6 +1247,7 @@ class CoordinatorServer:
             self._session_ids = itertools.count(self._max_sid_seen + 1)
             self._standby_acked.clear()  # acks restart under MY serving
             self._standby_last_pull.clear()  # lease restarts too
+            self._standby_parked.clear()
             self._fencing_token += 1
             self._dirty = True
         if self._standby_task is not None:

@@ -96,6 +96,12 @@ class DBOptions:
     delayed_write_rate: int = 16 * 1024 * 1024  # bytes/s, rocksdb default
     level0_slowdown_writes_trigger: int = 12
     level0_stop_writes_trigger: int = 24
+    # WAL archival (storage.archive.WalArchiver.sink, or any
+    # callable(path)): sealed WAL segments are shipped here before TTL
+    # deletion, enabling point-in-time restore (restore_db(..., to_seq))
+    # — the BackupEngine-incremental-chain analog. None = segments are
+    # simply deleted at TTL, as before.
+    wal_archive_sink: Optional[object] = None
 
     # Mutable at runtime via DB.set_options (reference setDBOptions RPC).
     MUTABLE = {
@@ -587,10 +593,17 @@ class DB:
             self._check_open()
             if self._bg_thread is None:
                 self._flush_locked()
-                return
-            if len(self._mem):
-                self._swap_to_imm_locked(force=True)
-            self._drain_imm_locked()
+            else:
+                if len(self._mem):
+                    self._swap_to_imm_locked(force=True)
+                self._drain_imm_locked()
+            persisted = self._persisted_seq
+        if self.options.wal_archive_sink is not None:
+            # archive + purge OFF the DB lock (the sink is network IO)
+            wal_mod.purge_obsolete(
+                self._wal_dir, persisted, self.options.wal_ttl_seconds,
+                archive_sink=self.options.wal_archive_sink,
+            )
 
     # ------------------------------------------------------------------
     # background thread
@@ -755,7 +768,8 @@ class DB:
             self._cond.notify_all()
         self._write_manifest_payload(*snapshot)
         wal_mod.purge_obsolete(
-            self._wal_dir, self._persisted_seq, self.options.wal_ttl_seconds
+            self._wal_dir, self._persisted_seq, self.options.wal_ttl_seconds,
+            archive_sink=self.options.wal_archive_sink,
         )
 
     def _compact_level0_bg(self) -> None:
@@ -821,9 +835,15 @@ class DB:
         finally:
             if mem in self._imms:
                 self._imms.remove(mem)
-        wal_mod.purge_obsolete(
-            self._wal_dir, self._persisted_seq, self.options.wal_ttl_seconds
-        )
+        if self.options.wal_archive_sink is None:
+            # cheap unlink-only purge. With an archive sink the purge
+            # does network IO and _flush_locked runs UNDER the DB lock —
+            # the off-lock purgers (_flush_imm in bg mode, flush() after
+            # it releases the lock) handle archival instead.
+            wal_mod.purge_obsolete(
+                self._wal_dir, self._persisted_seq,
+                self.options.wal_ttl_seconds,
+            )
         if (
             self._bg_thread is None  # bg mode compacts on its own thread
             and not self.options.disable_auto_compaction

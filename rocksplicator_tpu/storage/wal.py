@@ -22,6 +22,7 @@ Torn tails (crash mid-append) are truncated on recovery.
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import time
@@ -194,10 +195,16 @@ def purge_obsolete(
     persisted_seq: int,
     ttl_seconds: float,
     now: Optional[float] = None,
+    archive_sink=None,
 ) -> int:
     """Delete segments that are (a) fully persisted into SSTs AND (b) older
     than the TTL. Keeping flushed WAL for the TTL is what lets followers
-    catch up from the leader's log (reference WAL TTL). Returns count."""
+    catch up from the leader's log (reference WAL TTL). Returns count.
+
+    ``archive_sink(path)`` (storage.archive.WalArchiver.sink) is called on
+    each sealed segment BEFORE deletion — point-in-time restore replays
+    the archive over a checkpoint. A sink failure stops the purge and
+    keeps the segment: history is never destroyed un-archived."""
     now = time.time() if now is None else now
     segs = _segments(wal_dir)
     removed = 0
@@ -209,6 +216,13 @@ def purge_obsolete(
             break  # contains unpersisted updates
         if now - os.path.getmtime(path) < ttl_seconds:
             break
+        if archive_sink is not None:
+            try:
+                archive_sink(path)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "WAL archive of %s failed; keeping segment", path)
+                break
         os.remove(path)
         removed += 1
     return removed
