@@ -950,7 +950,7 @@ def _pitr_stack(tmp_path):
     from rocksplicator_tpu.storage.archive import WalArchiver
     from rocksplicator_tpu.utils.objectstore import LocalObjectStore
 
-    store = LocalObjectStore("local://" + str(tmp_path / "store"))
+    store = LocalObjectStore(str(tmp_path / "store"))
     arch = WalArchiver(store, "bk/wal")
     opts = DBOptions(
         wal_segment_bytes=256,   # roll constantly so purge has work
