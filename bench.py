@@ -197,10 +197,16 @@ def _make_model():
 
     # 16-byte keys + 32-bit seqs: reduced-key sort (_sort_merge_order);
     # emit_planar adds on-device SST block encoding (plane words +
-    # checksums — the production sink format) to the measured pipeline
-    return CompactionModel(capacity=ENTRIES, uniform_klen=True, seq32=True,
-                           key_words=KEY_BYTES // 4, emit_planar=True,
-                           row_klen=KEY_BYTES, row_vlen=VAL_BYTES)
+    # checksums — the production sink format) to the measured pipeline.
+    # BENCH_PALLAS_SORT=1 swaps in the VMEM-resident bitonic sort.
+    return CompactionModel(
+        capacity=ENTRIES, uniform_klen=True, seq32=True,
+        key_words=KEY_BYTES // 4, emit_planar=True,
+        row_klen=KEY_BYTES, row_vlen=VAL_BYTES,
+        sort_backend=("pallas"
+                      if int(os.environ.get("BENCH_PALLAS_SORT", "0"))
+                      else "lax"),
+    )
 
 
 def bench_tpu_kernel(shards) -> float:

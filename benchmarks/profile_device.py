@@ -26,6 +26,7 @@ import sys
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -262,13 +263,54 @@ def probe_mergenet(st, n, iters, results):
             f"merge_resolve_RUNS_kernel ({runs} runs)")
 
 
+def probe_pallas_sort(st, n, iters, results):
+    """lax.sort vs the VMEM-resident Pallas bitonic sort, standalone and
+    inside the full merge-resolve kernel (PERF.md round-2 lever: the
+    sort's HBM traffic is the dominant device cost)."""
+    from rocksplicator_tpu.ops.compaction_kernel import (
+        composite_key_lanes, merge_resolve_kernel)
+    from rocksplicator_tpu.ops.pallas_sort import sort_lanes
+
+    def lanes_of(kwb, klen, shi, slo, vt, vw, vl, valid):
+        inval = jnp.where(valid, jnp.uint32(0), jnp.uint32(1))
+        keys = composite_key_lanes(
+            inval, (kwb[:, w] for w in range(4)), klen, shi, slo,
+            uniform_klen=True, seq32=True)
+        payload = [vt, vl] + [vw[:, w] for w in range(vw.shape[1])]
+        return keys, payload
+
+    margs = (st["key_words_be"], st["key_len"], st["seq_hi"],
+             st["seq_lo"], st["vtype"], st["val_words"], st["val_len"],
+             st["valid"])
+
+    for backend in ("lax", "pallas"):
+        def sort_only(*a, _b=backend):
+            keys, payload = lanes_of(*a)
+            return sort_lanes(tuple(keys + payload), num_keys=len(keys),
+                              backend=_b)
+
+        results[f"sort_only_{backend}"] = timeit(
+            jax.jit(jax.vmap(sort_only)), margs, iters,
+            f"10-operand sort, {backend} backend")
+
+        def full(*a, _b=backend):
+            return merge_resolve_kernel(
+                *a, uniform_klen=True, seq32=True, key_words=4,
+                sort_backend=_b)
+
+        results[f"kernel_{backend}_sort"] = timeit(
+            jax.jit(jax.vmap(full)), margs, iters,
+            f"merge_resolve_kernel, {backend} sort")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--entries", type=int, default=1 << 17)
     ap.add_argument("--shards", type=int, default=8)
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--set", default="components",
-                    choices=("components", "variants", "mergenet", "all"))
+                    choices=("components", "variants", "mergenet",
+                             "pallas", "all"))
     args = ap.parse_args()
 
     log(f"platform={jax.default_backend()} shards={args.shards} "
@@ -281,6 +323,8 @@ def main():
         probe_variants(st, args.entries, args.iters, results)
     if args.set in ("mergenet", "all"):
         probe_mergenet(st, args.entries, args.iters, results)
+    if args.set in ("pallas", "all"):
+        probe_pallas_sort(st, args.entries, args.iters, results)
     print(json.dumps({k: round(v * 1e3, 2) for k, v in results.items()}))
 
 

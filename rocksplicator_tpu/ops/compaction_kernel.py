@@ -119,6 +119,7 @@ def _sort_merge_order(
     uniform_klen: bool = False,
     seq32: bool = False,
     key_words: int = KEY_WORDS,
+    sort_backend: str = "lax",
 ):
     """One variadic sort into (invalid-last, key asc, seq desc) order,
     carrying ``payload`` lanes through the sort network. Returns
@@ -137,8 +138,14 @@ def _sort_merge_order(
         key_len, seq_hi, seq_lo, uniform_klen=uniform_klen, seq32=seq32)
     num_keys = len(operands)
     operands.extend(payload)
-    sorted_ops = lax.sort(tuple(operands), num_keys=num_keys,
-                          is_stable=False)
+    if sort_backend == "pallas":
+        from .pallas_sort import sort_lanes
+
+        sorted_ops = sort_lanes(tuple(operands), num_keys=num_keys,
+                                backend="pallas")
+    else:
+        sorted_ops = lax.sort(tuple(operands), num_keys=num_keys,
+                              is_stable=False)
     key_lanes, klen_s, shi_s, slo_s, valid_s, pos = split_composite_lanes(
         sorted_ops, key_words, uniform_klen=uniform_klen, seq32=seq32)
     return key_lanes, klen_s, shi_s, slo_s, valid_s, sorted_ops[pos:]
@@ -379,7 +386,7 @@ def resolve_sorted_lanes(
 @functools.partial(
     jax.jit,
     static_argnames=("merge_kind", "drop_tombstones", "uniform_klen",
-                     "seq32", "key_words"),
+                     "seq32", "key_words", "sort_backend"),
 )
 def merge_resolve_kernel(
     key_words_be: jnp.ndarray,  # (N, 6) u32
@@ -396,6 +403,7 @@ def merge_resolve_kernel(
     uniform_klen: bool = False,
     seq32: bool = False,
     key_words: int = KEY_WORDS,
+    sort_backend: str = "lax",
 ) -> Dict[str, jnp.ndarray]:
     """Merge + resolve a concatenated batch of runs (order-free input).
 
@@ -419,6 +427,7 @@ def merge_resolve_kernel(
     key_lanes, klen_s, shi_s, slo_s, valid_s, payload = _sort_merge_order(
         key_words_be, key_len, seq_hi, seq_lo, valid, payload,
         uniform_klen=uniform_klen, seq32=seq32, key_words=key_words,
+        sort_backend=sort_backend,
     )
     return resolve_sorted_lanes(
         list(key_lanes), klen_s, shi_s, slo_s, valid_s,
