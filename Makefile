@@ -1,9 +1,14 @@
 PY ?= python
 
-.PHONY: test native bench loadsst-bench clean
+.PHONY: test test-fast native bench loadsst-bench soak-bench clean
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# parallel across cores (pytest-xdist); per-process jax compiles also hit
+# the persistent XLA cache set up in tests/conftest.py
+test-fast:
+	$(PY) -m pytest tests/ -q -n auto
 
 native:
 	$(MAKE) -C rocksplicator_tpu/storage/native
@@ -13,6 +18,9 @@ bench:
 
 loadsst-bench:
 	$(PY) -m benchmarks.load_sst_bench --shards 16
+
+soak-bench:
+	$(PY) -m benchmarks.soak_bench --shards 256
 
 clean:
 	$(MAKE) -C rocksplicator_tpu/storage/native clean

@@ -135,10 +135,14 @@ def main(argv=None) -> int:
 
     out = {
         "metric": "load_sst_end_to_end",
-        "value": round(results["tpu"], 3),
+        "value": round(results["tpu"], 4),
         "unit": "GB/s",
         "vs_baseline": round(results["tpu"] / results["cpu"], 2)
         if results["cpu"] else 0.0,
+        "shards": args.shards,
+        "keys_per_shard": args.keys_per_shard,
+        "total_mb": round(total_bytes / 1e6, 1),
+        "cpu_gbps": round(results["cpu"], 4),
     }
     print(json.dumps(out), flush=True)
     shutil.rmtree(tmp, ignore_errors=True)

@@ -28,14 +28,31 @@ class ApplicationDBBackupManager:
         prefix: str = "incremental_backups",
         interval_sec: float = 300.0,
         parallelism: int = 8,
+        archive_wal: bool = False,
     ):
         self._db_manager = db_manager
         self._store = store
         self._prefix = prefix.rstrip("/")
         self._interval = interval_sec
         self._parallelism = parallelism
+        # WAL archival rider (storage/archive.py): each backup pass also
+        # ships every live WAL segment under <prefix>/<db>/wal and
+        # installs the archiver as the DB's TTL-purge sink, so restores
+        # can replay to ANY point since the oldest checkpoint
+        # (restore_db_to_seq) — the BackupEngine-chain parity.
+        self._archive_wal = archive_wal
+        self._archivers: dict = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _archiver(self, db_name: str):
+        from ..storage.archive import WalArchiver
+
+        arch = self._archivers.get(db_name)
+        if arch is None:
+            arch = WalArchiver(self._store, f"{self._prefix}/{db_name}/wal")
+            self._archivers[db_name] = arch
+        return arch
 
     def start(self) -> None:
         if self._thread is not None:
@@ -65,6 +82,13 @@ class ApplicationDBBackupManager:
                     app_db.db, self._store, f"{self._prefix}/{name}",
                     parallelism=self._parallelism, incremental=True,
                 )
+                if self._archive_wal:
+                    arch = self._archiver(name)
+                    arch.archive_live(app_db.db)
+                    # one shared archiver per DB: its mutex serializes the
+                    # purge-time sink against this pass's live shipping
+                    if app_db.db.options.wal_archive_sink is None:
+                        app_db.db.options.wal_archive_sink = arch.sink
                 ok += 1
                 Stats.get().incr("backup_manager.backups_ok")
             except Exception:

@@ -371,9 +371,10 @@ class AdminHandler:
 
     async def handle_restore_db(
         self, db_name: str = "", hdfs_backup_dir: str = "",
-        upstream_ip: str = "", upstream_port: int = 0,
+        upstream_ip: str = "", upstream_port: int = 0, to_seq: int = 0,
     ) -> dict:
-        return await self._restore(db_name, hdfs_backup_dir, "", upstream_ip, upstream_port)
+        return await self._restore(db_name, hdfs_backup_dir, "",
+                                   upstream_ip, upstream_port, to_seq)
 
     async def handle_backup_db_to_s3(
         self, db_name: str = "", s3_bucket: str = "", s3_backup_dir: str = "",
@@ -385,8 +386,14 @@ class AdminHandler:
     async def handle_restore_db_from_s3(
         self, db_name: str = "", s3_bucket: str = "", s3_backup_dir: str = "",
         upstream_ip: str = "", upstream_port: int = 0, limit_mbs: int = 0,
+        to_seq: int = 0,
     ) -> dict:
-        return await self._restore(db_name, s3_bucket, s3_backup_dir, upstream_ip, upstream_port)
+        """restoreDBFromS3 + PITR extension: ``to_seq > 0`` replays the
+        backup's WAL archive (<prefix>/wal, written by the backup
+        manager's archive_wal rider) over the checkpoint up to that
+        sequence point."""
+        return await self._restore(db_name, s3_bucket, s3_backup_dir,
+                                   upstream_ip, upstream_port, to_seq)
 
     async def _backup(self, db_name: str, store_uri: str, sub_path: str) -> dict:
         app_db = self._get_app_db(db_name)
@@ -406,7 +413,7 @@ class AdminHandler:
 
     async def _restore(
         self, db_name: str, store_uri: str, sub_path: str,
-        upstream_ip: str, upstream_port: int,
+        upstream_ip: str, upstream_port: int, to_seq: int = 0,
     ) -> dict:
         store = self._store(store_uri)
         prefix = sub_path or db_name
@@ -418,7 +425,15 @@ class AdminHandler:
                 if self.db_manager.get_db(db_name) is not None:
                     self.db_manager.remove_db(db_name)
                 destroy_db(self._db_path(db_name))
-                dbmeta = backup_mod.restore_db(store, prefix, self._db_path(db_name))
+                if to_seq > 0:
+                    from ..storage.archive import restore_db_to_seq
+
+                    dbmeta = restore_db_to_seq(
+                        store, prefix, f"{prefix}/wal",
+                        self._db_path(db_name), to_seq=to_seq)
+                else:
+                    dbmeta = backup_mod.restore_db(
+                        store, prefix, self._db_path(db_name))
                 self._open_app_db(db_name, role, upstream)
                 ts = dbmeta.get("last_kafka_msg_timestamp_ms")
                 if ts:
@@ -426,7 +441,9 @@ class AdminHandler:
                 return dbmeta
 
         dbmeta = await self._run(do)
-        return {"seq": dbmeta["seq"]}
+        # PITR restores report the seq actually reached after WAL replay,
+        # not the checkpoint's
+        return {"seq": dbmeta.get("restored_seq", dbmeta["seq"])}
 
     # ------------------------------------------------------------------
     # RPC: SST bulk ingest — the north-star workload (§3.3)

@@ -179,7 +179,7 @@ def cmd_restore(admin: AdminClient, args) -> int:
         upstream = (ip, int(port))
     r = admin.restore_db_from_store(
         (args.host, args.port), args.db, args.store_uri, args.backup_path,
-        upstream,
+        upstream, to_seq=args.to_seq,
     )
     print(json.dumps(r))
     return 0
@@ -241,6 +241,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--store_uri", required=True)
     sp.add_argument("--backup_path", required=True)
     sp.add_argument("--upstream", default=None, help="ip:repl_port")
+    sp.add_argument("--to_seq", type=int, default=0,
+                    help="point-in-time restore: replay the WAL archive "
+                         "up to this sequence number (0 = plain restore)")
     sp.set_defaults(fn=cmd_restore)
 
     return p

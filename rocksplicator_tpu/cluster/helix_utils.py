@@ -92,13 +92,18 @@ class AdminClient:
     def restore_db_from_store(
         self, addr, db_name: str, store_uri: str, backup_path: str,
         upstream: Optional[Tuple[str, int]] = None,
+        to_seq: int = 0,
     ) -> dict:
+        """``to_seq > 0`` = point-in-time restore: replay the backup's
+        WAL archive over the newest checkpoint <= to_seq."""
         args: Dict[str, Any] = {
             "db_name": db_name, "s3_bucket": store_uri,
             "s3_backup_dir": backup_path,
         }
         if upstream:
             args["upstream_ip"], args["upstream_port"] = upstream
+        if to_seq:
+            args["to_seq"] = int(to_seq)
         return self.call(addr, "restore_db_from_s3", timeout=600.0, **args)
 
     def ingest_from_store(self, addr, db_name: str, store_uri: str,

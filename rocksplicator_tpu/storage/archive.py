@@ -135,21 +135,44 @@ def restore_db_to_seq(
     parallelism: int = 8,
 ) -> Dict:
     """Point-in-time restore: checkpoint backup + archived-WAL replay up
-    to ``to_seq`` (None = latest archived). The checkpoint must be from
-    a seq <= to_seq. Returns the backup's dbmeta augmented with
+    to ``to_seq`` (None = latest archived). Picks the NEWEST checkpoint
+    with seq <= to_seq from the prefix's versioned dbmeta chain
+    (``dbmeta-<seq>``, written by every backup pass) — successive
+    incremental backups into one prefix therefore advance nothing past
+    restorability. Returns the chosen dbmeta augmented with
     ``restored_seq``. The restored DB is closed on return (same contract
     as restore_db: the caller reopens)."""
-    from .backup import restore_db
+    from .backup import DBMETA_KEY, restore_db
 
+    dbmeta_key = DBMETA_KEY
+    if to_seq is not None:
+        base = backup_prefix.rstrip("/") + "/" + DBMETA_KEY + "-"
+        chain = []
+        for key in store.list_objects(
+                backup_prefix.rstrip("/") + "/" + DBMETA_KEY):
+            tail = key[len(base):] if key.startswith(base) else ""
+            if tail.isdigit():
+                chain.append(int(tail))
+        usable = sorted(s for s in chain if s <= to_seq)
+        if usable:
+            dbmeta_key = f"{DBMETA_KEY}-{usable[-1]:020d}"
+        elif chain:
+            # decide from the listing BEFORE downloading anything: the
+            # requested point predates the whole chain
+            raise StorageError(
+                f"PITR: every checkpoint in {backup_prefix} is past seq "
+                f"{to_seq} (oldest is {min(chain)}); the requested point "
+                f"predates the backup chain")
     dbmeta = restore_db(
         store, backup_prefix, db_path, options=options,
-        parallelism=parallelism)
+        parallelism=parallelism, dbmeta_key=dbmeta_key)
     ckpt_seq = int(dbmeta.get("seq", 0))
     if to_seq is not None and to_seq < ckpt_seq:
         shutil.rmtree(db_path, ignore_errors=True)
         raise StorageError(
-            f"PITR: backup checkpoint is at seq {ckpt_seq}, past the "
-            f"requested {to_seq}; use an older backup")
+            f"PITR: every checkpoint in {backup_prefix} is past seq "
+            f"{to_seq} (oldest usable is {ckpt_seq}); the requested "
+            f"point predates the backup chain")
     tmp = tempfile.mkdtemp(prefix="rstpu-pitr-wal-")
     db = None
     try:

@@ -55,9 +55,18 @@ def backup_db(
             if f not in existing or f == "MANIFEST"
         ]
         store.put_objects(to_upload, prefix, parallelism=parallelism)
+        # The MANIFEST is the one mutable file: a later incremental pass
+        # into the same prefix overwrites it, which would break every
+        # OLDER checkpoint in the chain (its dbmeta would download a
+        # manifest referencing SSTs it never listed). Keep a versioned
+        # copy per pass; the SSTs themselves are immutable and retained.
+        manifest_key = f"MANIFEST-{ckpt_seq:020d}"
+        store.copy_object(prefix.rstrip("/") + "/MANIFEST",
+                          prefix.rstrip("/") + "/" + manifest_key)
         dbmeta = {
             "db_name": os.path.basename(db.path),
             "files": files,
+            "manifest_key": manifest_key,
             "timestamp_ms": int(time.time() * 1000),
             # seq captured at checkpoint time, not after the upload: writes
             # landing during the upload are not in this backup.
@@ -65,10 +74,13 @@ def backup_db(
         }
         if meta:
             dbmeta.update(meta)
+        payload = json.dumps(dbmeta).encode("utf-8")
+        store.put_object_bytes(prefix.rstrip("/") + "/" + DBMETA_KEY, payload)
+        # Versioned dbmeta: every past checkpoint stays restorable, which
+        # is what lets point-in-time restore pick the newest checkpoint
+        # <= to_seq (rocksdb BackupEngine's numbered-backup chain analog).
         store.put_object_bytes(
-            prefix.rstrip("/") + "/" + DBMETA_KEY,
-            json.dumps(dbmeta).encode("utf-8"),
-        )
+            f"{prefix.rstrip('/')}/{DBMETA_KEY}-{ckpt_seq:020d}", payload)
         return dbmeta
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -80,20 +92,29 @@ def restore_db(
     db_path: str,
     options: Optional[DBOptions] = None,
     parallelism: int = 8,
+    dbmeta_key: str = DBMETA_KEY,
 ) -> Dict:
     """Download a backup into ``db_path`` (which must not exist) and
     validate against its dbmeta. Returns the dbmeta. The caller opens the
-    DB afterwards (reference restoreDBHelper then re-adds the db)."""
+    DB afterwards (reference restoreDBHelper then re-adds the db).
+    ``dbmeta_key`` selects a specific checkpoint from the versioned chain
+    (``dbmeta-<seq>``); the default is the latest."""
     if os.path.exists(db_path):
         raise StorageError(f"restore target exists: {db_path}")
-    raw = store.get_object_bytes(prefix.rstrip("/") + "/" + DBMETA_KEY)
+    raw = store.get_object_bytes(prefix.rstrip("/") + "/" + dbmeta_key)
     dbmeta = json.loads(raw.decode("utf-8"))
     tmp = db_path + ".restoring"
     shutil.rmtree(tmp, ignore_errors=True)
     os.makedirs(tmp)
     try:
         for f in dbmeta["files"]:
-            store.get_object(prefix.rstrip("/") + "/" + f, os.path.join(tmp, f))
+            key = f
+            if f == "MANIFEST" and dbmeta.get("manifest_key"):
+                # download THIS checkpoint's manifest version (the bare
+                # MANIFEST object tracks the newest pass in the prefix)
+                key = dbmeta["manifest_key"]
+            store.get_object(prefix.rstrip("/") + "/" + key,
+                             os.path.join(tmp, f))
         os.replace(tmp, db_path)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -13,6 +13,9 @@ import sys
 # sets jax_platforms itself, so the env var alone is not enough — the jax
 # config must be overridden before any backend initializes.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# dryrun_multichip defaults to the 131k bench shape (driver validation);
+# the in-suite mesh test runs a small shape to keep the suite fast
+os.environ.setdefault("RSTPU_DRYRUN_ENTRIES", "2048")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,6 +25,17 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent XLA compile cache: the suite's dominant cost is jax-CPU
+# compilation of the kernel shapes, identical run to run — cache them
+# across invocations (first run pays, reruns load from disk).
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("RSTPU_TEST_XLA_CACHE", "/tmp/rstpu_test_xla_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass  # older jax: no persistent-cache knobs
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

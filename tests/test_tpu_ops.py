@@ -495,8 +495,9 @@ def test_pallas_bitonic_sort_parity_with_lax():
     from rocksplicator_tpu.ops.pallas_sort import bitonic_sort_lanes
 
     rng = _np.random.default_rng(7)
-    n = 1024
-    for num_keys, n_payload in ((1, 0), (3, 2), (6, 4)):
+    n = 512  # interpret mode executes the full 45-stage network in pure
+    # python — keep the size small; the network is size-generic
+    for num_keys, n_payload in ((1, 0), (6, 4)):
         ops = [rng.integers(0, 1 << 32, n, dtype=_np.uint32)
                for _ in range(num_keys + n_payload)]
         # duplicate keys to exercise payload stability-independence:
