@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""BASELINE config #4-shaped benchmark: 3-replica semi-sync WAL tail.
+
+Orchestrates three OS processes — one leader (replication mode 1:
+every write acks only after a follower pulled it) and two followers
+tailing the leader's WAL over the replication plane. Reports writes/s,
+MB/s, follower convergence, and acked-write loss. (The config's
+"Kafka WAL-tail" consumer role is the CDC observer path, covered by
+tests/test_admin.py + tests/test_kafka.py; this bench measures the
+3-replica semi-sync replication fabric itself.)
+
+    python -m benchmarks.replication_3replica_bench \
+        --shards 50 --keys 200 --value_bytes 1024
+
+Reference harness shape: rocksdb_replicator/performance.cpp:57-207 (the
+two-process original); config #4 in BASELINE.json adds the 3-replica +
+WAL-tail consumer topology measured here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _spawn(role, port, db_dir, shards, keys, threads, value_bytes,
+           upstream_port=0, mode=1, linger=60):
+    cmd = [
+        sys.executable, "-m", "rocksplicator_tpu.replication.performance",
+        "--role", role, "--port", str(port), "--db_dir", db_dir,
+        "--num_shards", str(shards),
+        "--num_write_threads", str(threads),
+        "--num_keys_per_shard_thread", str(keys),
+        "--value_size", str(value_bytes),
+        "--replication_mode", str(mode),
+        "--linger_sec", str(linger),
+    ]
+    if upstream_port:
+        cmd += ["--upstream_port", str(upstream_port)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=50)
+    ap.add_argument("--keys", type=int, default=200)
+    ap.add_argument("--threads", type=int, default=2)
+    ap.add_argument("--value_bytes", type=int, default=1024)
+    ap.add_argument("--leader_port", type=int, default=29391)
+    ap.add_argument("--out",
+                    default="benchmarks/results/replication_3replica.json")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="repl3-bench-")
+    followers = []
+    leader = None
+    try:
+        f1 = _spawn("follower", args.leader_port + 1,
+                    os.path.join(tmp, "f1"), args.shards, args.keys,
+                    args.threads, args.value_bytes,
+                    upstream_port=args.leader_port)
+        f2 = _spawn("follower", args.leader_port + 2,
+                    os.path.join(tmp, "f2"), args.shards, args.keys,
+                    args.threads, args.value_bytes,
+                    upstream_port=args.leader_port)
+        followers = [f1, f2]
+        time.sleep(2.0)
+        t0 = time.monotonic()
+        leader = _spawn("leader", args.leader_port,
+                        os.path.join(tmp, "l"), args.shards, args.keys,
+                        args.threads, args.value_bytes, linger=90)
+        # parse the leader's throughput line while it runs
+        leader_line = None
+        for line in leader.stdout:
+            log(f"[leader] {line.rstrip()}")
+            m = re.search(r"wrote ~([\d.]+) MB in ([\d.]+)s", line)
+            if m:
+                leader_line = (float(m.group(1)), float(m.group(2)))
+                break
+        assert leader_line, "leader never reported its write phase"
+        mb, elapsed = leader_line
+        # expected total sequence per replica
+        per_thread_shards = args.shards // args.threads
+        total_writes = args.threads * args.keys * per_thread_shards
+        # watch follower convergence via their periodic seq dumps
+        want = total_writes
+        deadline = time.monotonic() + 120
+        seqs = {0: 0, 1: 0}
+        while time.monotonic() < deadline and (
+                seqs[0] < want or seqs[1] < want):
+            for idx, f in enumerate(followers):
+                line = f.stdout.readline()
+                if line:
+                    m = re.search(r"follower total seq: (\d+)", line)
+                    if m:
+                        seqs[idx] = int(m.group(1))
+            time.sleep(0.1)
+        converge_sec = time.monotonic() - t0
+        result = {
+            "bench": "replication_3replica_semisync",
+            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "config": {
+                "topology": "leader + 2 followers, 3 OS processes, "
+                            "TCP loopback, replication mode 1 (semi-sync)",
+                "shards": args.shards, "writer_threads": args.threads,
+                "keys_per_shard_thread": args.keys,
+                "value_bytes": args.value_bytes,
+            },
+            "results": {
+                "writes_acked": total_writes,
+                "leader_mb": mb,
+                "leader_elapsed_s": elapsed,
+                "writes_per_sec": round(total_writes / elapsed, 1),
+                "mb_per_sec": round(mb / elapsed, 2),
+                "follower_seqs": [seqs[0], seqs[1]],
+                "both_followers_converged": bool(
+                    seqs[0] >= want and seqs[1] >= want),
+                "convergence_sec_from_leader_start": round(converge_sec, 1),
+                "acked_write_loss": max(0, want - min(seqs.values())),
+            },
+        }
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result["results"]))
+        return 0
+    finally:
+        for p in ([leader] if leader else []) + followers:
+            try:
+                p.terminate()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -319,8 +319,15 @@ def main():
     if not args.skip_cluster:
         result["failover"] = failover_phase(args.cluster_shards,
                                             args.cluster_sec)
-    target_ok = result["storm"]["write_stall_p99_ms"] < 10.0
-    result["write_stall_target_met"] = bool(target_ok)
+    # samples == 0 means the stall path never ran (writes spread over
+    # many shards may never fill any one imm queue) — that's
+    # indeterminate, NOT a met target; bench.py's dedicated storm is the
+    # authoritative p99 measurement.
+    if result["storm"].get("write_stall_samples", 0) > 0:
+        result["write_stall_target_met"] = bool(
+            result["storm"]["write_stall_p99_ms"] < 10.0)
+    else:
+        result["write_stall_target_met"] = None
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)

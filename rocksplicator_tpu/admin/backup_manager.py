@@ -78,17 +78,22 @@ class ApplicationDBBackupManager:
             if app_db is None:
                 continue
             try:
+                if self._archive_wal:
+                    # Install the purge sink BEFORE the checkpoint upload:
+                    # a long upload overlaps live writes, and any WAL
+                    # segment the engine purges during it must hit the
+                    # archive or PITR into that range is lost forever.
+                    # (One shared archiver per DB: its mutex serializes
+                    # the purge-time sink against this pass's shipping.)
+                    arch = self._archiver(name)
+                    if app_db.db.options.wal_archive_sink is None:
+                        app_db.db.options.wal_archive_sink = arch.sink
                 backup_mod.backup_db(
                     app_db.db, self._store, f"{self._prefix}/{name}",
                     parallelism=self._parallelism, incremental=True,
                 )
                 if self._archive_wal:
-                    arch = self._archiver(name)
-                    arch.archive_live(app_db.db)
-                    # one shared archiver per DB: its mutex serializes the
-                    # purge-time sink against this pass's live shipping
-                    if app_db.db.options.wal_archive_sink is None:
-                        app_db.db.options.wal_archive_sink = arch.sink
+                    self._archiver(name).archive_live(app_db.db)
                 ok += 1
                 Stats.get().incr("backup_manager.backups_ok")
             except Exception:

@@ -53,6 +53,9 @@ class WalArchiver:
         # its stale put last — permanently truncating archived history.
         # Engine purge and backup thread must share ONE archiver per DB.
         self._mutex = threading.Lock()
+        # names shipped while SEALED (immutable): archive_live skips them
+        # on later passes instead of re-uploading identical bytes
+        self._sealed_shipped: set = set()
 
     def sink(self, path: str) -> None:
         """wal.purge_obsolete archive hook: ship one sealed segment."""
@@ -60,6 +63,7 @@ class WalArchiver:
         with self._mutex:
             with open(path, "rb") as f:
                 self._store.put_object_bytes(key, f.read())
+            self._sealed_shipped.add(os.path.basename(path))
         log.info("archived WAL segment %s -> %s", path, key)
 
     def archive_live(self, db: DB) -> int:
@@ -73,9 +77,22 @@ class WalArchiver:
         periodic backup thread (admin.backup_manager), right after its
         checkpoint upload."""
         n = 0
-        for _first_seq, path in wal_mod._segments(db._wal_dir):
+        segs = wal_mod._segments(db._wal_dir)
+        for i, (_first_seq, path) in enumerate(segs):
+            name = os.path.basename(path)
+            sealed = i + 1 < len(segs)  # every segment but the ACTIVE one
+            if sealed and name in self._sealed_shipped:
+                continue  # immutable + already in the archive
             try:
-                self.sink(path)
+                if sealed:
+                    self.sink(path)
+                else:
+                    # ship the active tail WITHOUT marking it sealed: it
+                    # is still growing and must re-ship next pass
+                    key = f"{self._prefix}/{name}"
+                    with self._mutex:
+                        with open(path, "rb") as f:
+                            self._store.put_object_bytes(key, f.read())
             except FileNotFoundError:
                 continue  # purged (and therefore archived) under us
             n += 1
