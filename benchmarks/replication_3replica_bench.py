@@ -98,9 +98,9 @@ def main():
                 break
         assert leader_line, "leader never reported its write phase"
         mb, elapsed = leader_line
-        # expected total sequence per replica
-        per_thread_shards = args.shards // args.threads
-        total_writes = args.threads * args.keys * per_thread_shards
+        # expected total sequence per replica: each shard is written by
+        # exactly one thread (stride tid, tid+T, ...), keys times
+        total_writes = args.keys * args.shards
         # watch follower convergence via their periodic seq dumps
         want = total_writes
         deadline = time.monotonic() + 120

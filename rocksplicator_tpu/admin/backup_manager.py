@@ -45,13 +45,22 @@ class ApplicationDBBackupManager:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def _archiver(self, db_name: str):
+    def _archiver(self, db_name: str, db):
+        """One archiver per (db, incarnation). A destroyed+recreated DB
+        reuses WAL segment names with NEW content — a fresh incarnation
+        gets a fresh archive prefix (recorded in each backup's dbmeta as
+        ``wal_prefix``), so stale same-named segments can neither be
+        skipped as already-shipped nor mixed into a later replay."""
         from ..storage.archive import WalArchiver
 
-        arch = self._archivers.get(db_name)
+        incarnation = getattr(db, "_incarnation", "0")
+        key = (db_name, incarnation)
+        arch = self._archivers.get(key)
         if arch is None:
-            arch = WalArchiver(self._store, f"{self._prefix}/{db_name}/wal")
-            self._archivers[db_name] = arch
+            arch = WalArchiver(
+                self._store,
+                f"{self._prefix}/{db_name}/wal-{incarnation}")
+            self._archivers[key] = arch
         return arch
 
     def start(self) -> None:
@@ -78,6 +87,7 @@ class ApplicationDBBackupManager:
             if app_db is None:
                 continue
             try:
+                meta = None
                 if self._archive_wal:
                     # Install the purge sink BEFORE the checkpoint upload:
                     # a long upload overlaps live writes, and any WAL
@@ -85,15 +95,17 @@ class ApplicationDBBackupManager:
                     # archive or PITR into that range is lost forever.
                     # (One shared archiver per DB: its mutex serializes
                     # the purge-time sink against this pass's shipping.)
-                    arch = self._archiver(name)
+                    arch = self._archiver(name, app_db.db)
                     if app_db.db.options.wal_archive_sink is None:
                         app_db.db.options.wal_archive_sink = arch.sink
+                    meta = {"wal_prefix": arch.prefix}
                 backup_mod.backup_db(
                     app_db.db, self._store, f"{self._prefix}/{name}",
                     parallelism=self._parallelism, incremental=True,
+                    meta=meta,
                 )
                 if self._archive_wal:
-                    self._archiver(name).archive_live(app_db.db)
+                    self._archiver(name, app_db.db).archive_live(app_db.db)
                 ok += 1
                 Stats.get().incr("backup_manager.backups_ok")
             except Exception:

@@ -54,8 +54,15 @@ class WalArchiver:
         # Engine purge and backup thread must share ONE archiver per DB.
         self._mutex = threading.Lock()
         # names shipped while SEALED (immutable): archive_live skips them
-        # on later passes instead of re-uploading identical bytes
+        # on later passes instead of re-uploading identical bytes.
+        # Callers must use one archiver per DB INCARNATION (segment names
+        # repeat with new content across a destroy+recreate — see
+        # backup_manager._archiver).
         self._sealed_shipped: set = set()
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
 
     def sink(self, path: str) -> None:
         """wal.purge_obsolete archive hook: ship one sealed segment."""
@@ -193,7 +200,10 @@ def restore_db_to_seq(
     tmp = tempfile.mkdtemp(prefix="rstpu-pitr-wal-")
     db = None
     try:
-        WalArchiver(store, wal_prefix).fetch_all(tmp)
+        # A dbmeta written by the backup manager names its own archive
+        # prefix (per DB incarnation); it wins over the caller's guess
+        WalArchiver(store, dbmeta.get("wal_prefix")
+                    or wal_prefix).fetch_all(tmp)
         db = DB(db_path, options)
         replay_wal_dir(db, tmp, to_seq)
         dbmeta["restored_seq"] = db.latest_sequence_number()

@@ -554,3 +554,65 @@ def test_rpcgrep_decodes_proxied_traffic(tmp_path, capsys):
         stop_loop["loop"].call_soon_threadsafe(stop_loop["task"].cancel)
         t.join(timeout=5)
         node.stop()
+
+
+def test_rpcgrep_passive_sniff_decodes_live_traffic(tmp_path):
+    """tgrep parity: the AF_PACKET passive mode must decode request and
+    reply frames off live loopback traffic with NO proxy in the path.
+    Skipped where CAP_NET_RAW is unavailable."""
+    import os
+    import socket
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    try:
+        probe = socket.socket(socket.AF_PACKET, socket.SOCK_RAW,
+                              socket.htons(0x0003))
+        probe.close()
+    except (PermissionError, AttributeError, OSError):
+        pytest.skip("CAP_NET_RAW unavailable")
+
+    from rocksplicator_tpu.admin import AdminHandler
+    from rocksplicator_tpu.replication import Replicator
+    from rocksplicator_tpu.rpc import IoLoop, RpcClientPool, RpcServer
+
+    repl = Replicator(port=0)
+    handler = AdminHandler(str(tmp_path / "dbs"), repl)
+    server = RpcServer(port=0, ioloop=repl.ioloop)
+    server.add_handler(handler)
+    server.start()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sniffer = subprocess.Popen(
+        [_sys.executable, os.path.join(repo_root, "tools", "rpcgrep.py"),
+         "--sniff", str(server.port), "--iface", "lo", "--show-args"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=repo_root)
+    try:
+        # wait for the sniffer to report its socket is bound (python
+        # startup under a loaded CI box can take seconds)
+        banner = sniffer.stdout.readline()
+        assert "sniffing" in banner, banner
+        _time.sleep(0.5)
+        ioloop, pool = IoLoop.default(), RpcClientPool()
+
+        def call(method, **a):
+            async def go():
+                return await pool.call("127.0.0.1", server.port, method, a,
+                                       timeout=30)
+
+            return ioloop.run_sync(go())
+
+        call("add_db", db_name="seg00042", role="LEADER")
+        call("get_sequence_number", db_name="seg00042")
+        _time.sleep(1.5)
+    finally:
+        sniffer.terminate()
+        out, _ = sniffer.communicate(timeout=15)
+        server.stop()
+        handler.close()
+        repl.stop()
+    assert "method=add_db" in out, out[-2000:]  # banner already consumed
+    assert "method=get_sequence_number" in out
+    assert "ok=True" in out
+    assert "seg00042" in out  # --show-args decoded the payload
