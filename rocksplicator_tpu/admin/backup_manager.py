@@ -57,6 +57,10 @@ class ApplicationDBBackupManager:
         key = (db_name, incarnation)
         arch = self._archivers.get(key)
         if arch is None:
+            # drop prior-incarnation entries for this db (a clear/restore
+            # cycle would otherwise leak one archiver per recreate)
+            for stale in [k for k in self._archivers if k[0] == db_name]:
+                del self._archivers[stale]
             arch = WalArchiver(
                 self._store,
                 f"{self._prefix}/{db_name}/wal-{incarnation}")

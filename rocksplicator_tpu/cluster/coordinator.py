@@ -333,6 +333,7 @@ class CoordinatorServer:
         self._leader_lease_sec = leader_lease_sec
         self._standby_last_pull: Dict[str, float] = {}
         self._standby_parked: Dict[str, int] = {}  # live long-polls
+        self._sync_pool: Optional[RpcClientPool] = None  # handle_sync
         # Fencing token (monotonic, the ZK-epoch analog): bumped by every
         # promote, carried on repl_state/repl_updates (standbys adopt the
         # max) and on mutation acks (clients remember the max and refuse
@@ -566,6 +567,12 @@ class CoordinatorServer:
             self._snapshot_task.cancel()
             try:
                 self._write_snapshot()
+            except Exception:
+                pass
+        if self._sync_pool is not None:
+            pool, self._sync_pool = self._sync_pool, None
+            try:
+                self._ioloop.run_sync(pool.close(), timeout=5)
             except Exception:
                 pass
         self._server.stop()
@@ -908,6 +915,43 @@ class CoordinatorServer:
     # ------------------------------------------------------------------
     # replication: primary-side RPCs
     # ------------------------------------------------------------------
+
+    async def handle_sync(self, timeout_ms: int = 10_000) -> dict:
+        """ZK sync() parity: on a STANDBY, block until this replica has
+        applied everything the upstream primary had committed when the
+        call arrived — a read issued after sync() therefore observes
+        every write acked before it, even when the client's reads were
+        rotated onto a standby. On the primary it is a no-op. (As with
+        ZK, the guarantee is relative to the CURRENT upstream: across a
+        primary restart the standby full-transfers and indices re-align
+        before acks resume.)"""
+        if not self._standby:
+            return {"index": self._mut_index,
+                    "ftoken": self._fencing_token}
+        deadline = time.monotonic() + timeout_ms / 1000  # ONE budget for
+        # the upstream probe AND the catch-up wait
+        host, port = self._upstream
+        if self._sync_pool is None:
+            self._sync_pool = RpcClientPool()
+        pos = await self._sync_pool.call(
+            host, port, "repl_position", {},
+            timeout=max(1.0, deadline - time.monotonic()))
+        target = int(pos["mut_index"])
+        while True:
+            with self._lock:
+                if self._mut_index >= target:
+                    return {"index": self._mut_index,
+                            "ftoken": self._fencing_token}
+                ev = self._stream_event
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise RpcApplicationError(
+                    "SYNC_TIMEOUT",
+                    f"applied {self._mut_index} < upstream {target}")
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass
 
     async def handle_repl_state(self) -> dict:
         """Full state transfer for a (re)joining standby: every node
@@ -1540,6 +1584,15 @@ class CoordinatorClient:
 
     def exists(self, path: str) -> bool:
         return self._call("exists", path=path)["exists"]
+
+    def sync(self, timeout_ms: int = 10_000) -> int:
+        """ZK sync() parity: make the endpoint this client currently
+        reads from catch up with its primary before the next read —
+        read-your-writes even when reads rotated onto a standby.
+        Returns the endpoint's applied index."""
+        # RPC timeout must cover the server-side wait budget
+        return self._call("sync", timeout=timeout_ms / 1000 + 5.0,
+                          timeout_ms=timeout_ms)["index"]
 
     # -- watches ----------------------------------------------------------
 

@@ -18,6 +18,14 @@ archived-WAL replay:
 Archive layout under ``<prefix>/``: the segment files verbatim
 (``wal-<first_seq:020d>.log``) — the archive directory IS a valid WAL
 directory, so ``wal.iter_updates`` replays it unchanged once fetched.
+
+Upgrade note: dbmeta written by the backup manager records its own
+``wal_prefix`` (per DB incarnation); older dbmeta without it falls back
+to the caller-passed prefix. Restoring ACROSS that layout boundary
+(checkpoint from before per-incarnation prefixes, WAL tail after) needs
+the explicit wal_prefix of the segment range being replayed — a
+``to_seq`` restore fails loudly (PITR gap / archive-ends-early) rather
+than returning silently short.
 """
 
 from __future__ import annotations

@@ -555,3 +555,37 @@ def test_client_fencing_rejects_deposed_primary_ack(tmp_path):
                 pass
         primary.stop()
         standby.stop()
+
+
+def test_sync_gives_read_your_writes_on_standby(pair):
+    """ZK sync() parity: a read from a standby AFTER sync() must observe
+    every write the primary acked before the sync — no tailing-lag
+    window."""
+    from rocksplicator_tpu.rpc.client_pool import RpcClientPool
+    from rocksplicator_tpu.rpc.ioloop import IoLoop
+
+    primary, standby = pair
+    cli = CoordinatorClient("127.0.0.1", primary.port)
+    pool = RpcClientPool()
+    loop = IoLoop.default()
+
+    def standby_call(method, **args):
+        async def go():
+            return await pool.call(
+                "127.0.0.1", standby.port, method, args, timeout=15)
+
+        return loop.run_sync(go())
+
+    try:
+        for i in range(20):
+            cli.set("/syncrw", b"v%02d" % i) if i else \
+                cli.create("/syncrw", b"v00")
+            r = standby_call("sync")
+            assert r["index"] >= 1
+            got = standby_call("get", path="/syncrw")
+            assert bytes(got["value"]) == b"v%02d" % i, i
+        # primary-side sync is a no-op that still returns an index
+        assert cli.sync() > 0
+    finally:
+        loop.run_sync(pool.close())
+        cli.close()

@@ -114,7 +114,9 @@ class _FlowAssembler:
     retransmitted bytes (seq below the cursor) are trimmed. The frame
     parser mirrors FrameReader over a byte buffer."""
 
-    MAX_BUFFER = 64 << 20  # drop a flow rather than grow unboundedly
+    # must exceed framing.MAX_FRAME_BYTES or a legitimate near-cap frame
+    # would trip the guard and kill the flow mid-assembly
+    MAX_BUFFER = (256 << 20) + (8 << 20)
 
     def __init__(self, label: str, on_frame):
         self.label = label
