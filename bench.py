@@ -82,6 +82,16 @@ def _tpu_worker_main(cmd_q, res_q):
     the warmed runtime and in-process XLA cache now serve every phase and
     every climb step. A persistent on-disk compilation cache additionally
     survives bench re-runs on the same host."""
+    # The parent's stdout is the driver-facing JSON pipe. This child
+    # inherits it across spawn; if the parent is TERM'd (os._exit skips
+    # the multiprocessing atexit reaper) a still-running worker would
+    # hold the pipe open and the driver's read would never see EOF.
+    # Redirect this process's stdout into stderr so ONLY the parent
+    # holds the JSON pipe.
+    try:
+        os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
+    except OSError:
+        pass
     try:
         if os.environ.get("JAX_PLATFORMS") == "cpu":
             import __graft_entry__ as graft
@@ -168,11 +178,7 @@ class _TpuWorker:
         log(f"abandoning tpu worker pid={self.proc.pid} "
             f"(not killed: SIGKILL wedges the tunnel grant)")
         try:
-            import multiprocessing.process as _mpp
-
-            children = getattr(_mpp, "_children", None)
-            if children is not None:
-                children.discard(self.proc)
+            _registered_children().discard(self.proc)
         except Exception as e:
             log(f"worker deregistration failed (harmless): {e!r}")
 
@@ -181,6 +187,15 @@ class _TpuWorker:
             self.cmd_q.put({"phase": "quit"})
         except Exception:
             pass
+
+
+def _registered_children():
+    """The multiprocessing registry of still-REGISTERED children (the
+    private CPython set both abandon() and the TERM reaper consult —
+    keep the introspection in one place)."""
+    import multiprocessing.process as _mpp
+
+    return getattr(_mpp, "_children", None) or set()
 
 
 def _model_args(dev):
@@ -563,6 +578,14 @@ def _install_term_handler() -> None:
     def on_term(signum, frame):
         log("SIGTERM: emitting best-so-far result")
         _emit_result()
+        # reap still-registered (healthy) workers so their stderr pipe
+        # closes too — SIGTERM, never SIGKILL (tunnel grant); abandoned
+        # hung workers were already deregistered and stay untouched
+        for child in list(_registered_children()):
+            try:
+                child.terminate()
+            except Exception:
+                pass
         os._exit(0)
 
     signal.signal(signal.SIGTERM, on_term)

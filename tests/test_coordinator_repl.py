@@ -589,3 +589,33 @@ def test_sync_gives_read_your_writes_on_standby(pair):
     finally:
         loop.run_sync(pool.close())
         cli.close()
+
+
+def test_promote_best_refuses_without_enough_standbys():
+    """Electing from fewer standbys than intersect every possible ack
+    majority can silently discard quorum-acked writes — promote_best
+    must refuse (and must also refuse while a live primary is still
+    reachable)."""
+    from rocksplicator_tpu.cluster.coordinator import promote_best
+
+    primary = CoordinatorServer(port=0, session_ttl=2.0)
+    s1 = CoordinatorServer(port=0, replica_of=("127.0.0.1", primary.port))
+    s2 = CoordinatorServer(port=0, replica_of=("127.0.0.1", primary.port))
+    try:
+        # live primary in the probe set -> refuse
+        with pytest.raises(RuntimeError, match="live primary"):
+            promote_best([("127.0.0.1", primary.port),
+                          ("127.0.0.1", s1.port)])
+        primary.stop()
+        s2.stop()
+        # ensemble of 3 but only one standby reachable: electing it could
+        # lose acked writes that only lived on s2 -> refuse
+        with pytest.raises(RuntimeError, match="standbys answered"):
+            promote_best([("127.0.0.1", s1.port), ("127.0.0.1", s2.port)])
+        assert s1.is_standby  # nothing was promoted
+    finally:
+        for srv in (primary, s1, s2):
+            try:
+                srv.stop()
+            except Exception:
+                pass
