@@ -333,6 +333,7 @@ class CoordinatorServer:
         self._leader_lease_sec = leader_lease_sec
         self._standby_last_pull: Dict[str, float] = {}
         self._standby_parked: Dict[str, int] = {}  # live long-polls
+        self._standby_addrs: Dict[str, str] = {}  # id -> "ip:port"
         self._sync_pool: Optional[RpcClientPool] = None  # handle_sync
         # Fencing token (monotonic, the ZK-epoch analog): bumped by every
         # promote, carried on repl_state/repl_updates (standbys adopt the
@@ -980,6 +981,30 @@ class CoordinatorServer:
             "ftoken": self._fencing_token,
         }
 
+    async def handle_ensemble(self) -> dict:
+        """Ensemble discovery (ZK dynamic-config analog): the serving
+        addresses of every standby in recent lease contact. A client
+        configured with ONE endpoint learns the rest and can fail over
+        without static fallback lists."""
+        now = time.monotonic()
+        window = max(self._leader_lease_sec, 15.0)
+        with self._lock:
+            live = {
+                sid: addr for sid, addr in self._standby_addrs.items()
+                if now - self._standby_last_pull.get(sid, 0) <= window * 10
+                or self._standby_parked.get(sid, 0) > 0
+            }
+            # prune long-dead ids (a crash-looping standby mints a fresh
+            # id per restart; the dict must not grow unboundedly)
+            self._standby_addrs = live
+            standbys = sorted({
+                addr for sid, addr in live.items()
+                if now - self._standby_last_pull.get(sid, 0) <= window
+                or self._standby_parked.get(sid, 0) > 0
+            })
+        return {"standbys": standbys, "is_standby": self._standby,
+                "ftoken": self._fencing_token}
+
     async def handle_repl_position(self) -> dict:
         """Election probe: (fencing token, mutation index, role). The
         failover helper promotes the reachable standby with the highest
@@ -994,6 +1019,7 @@ class CoordinatorServer:
     async def handle_repl_updates(
         self, from_index: int = 1, max_wait_ms: int = 10_000,
         max_updates: int = 500, epoch: str = "", standby_id: str = "",
+        standby_addr: str = "",
     ) -> dict:
         """Long-poll the mutation stream from ``from_index`` within
         ``epoch``. Returns ``reset=True`` when the epoch doesn't match
@@ -1007,6 +1033,8 @@ class CoordinatorServer:
                 # lease contact counts even before the epoch handshake
                 # completes (a full-transferring standby is in contact)
                 self._standby_last_pull[standby_id] = time.monotonic()
+                if standby_addr:
+                    self._standby_addrs[standby_id] = standby_addr
                 if epoch == self._epoch:
                     prev = self._standby_acked.get(standby_id, 0)
                     self._standby_acked[standby_id] = max(
@@ -1194,11 +1222,19 @@ class CoordinatorServer:
         outage (see class docstring for the split-brain caveat)."""
         from ..rpc.errors import RpcConnectionError, RpcTimeout
 
+        from ..utils.misc import local_ip
+
         pool = RpcClientPool()
         host, port = self._upstream
         next_index = None
         epoch = ""
         down_since: Optional[float] = None
+        # advertised once: constant for the process lifetime. A loopback
+        # answer is useless to REMOTE clients; advertise nothing rather
+        # than teach every client a self-pointing fallback.
+        my_ip = local_ip()
+        my_addr = ("" if my_ip.startswith("127.")
+                   else f"{my_ip}:{self.port}")
         try:
             while self._standby:
                 try:
@@ -1219,7 +1255,10 @@ class CoordinatorServer:
                     r = await pool.call(
                         host, port, "repl_updates",
                         {"from_index": next_index, "max_wait_ms": 5000,
-                         "epoch": epoch, "standby_id": self._epoch},
+                         "epoch": epoch, "standby_id": self._epoch,
+                         # advertise our serving endpoint so clients can
+                         # discover the ensemble from any one member
+                         "standby_addr": my_addr},
                         timeout=35,
                     )
                     down_since = None
@@ -1292,6 +1331,7 @@ class CoordinatorServer:
             self._standby_acked.clear()  # acks restart under MY serving
             self._standby_last_pull.clear()  # lease restarts too
             self._standby_parked.clear()
+            self._standby_addrs.clear()
             self._fencing_token += 1
             self._dirty = True
         if self._standby_task is not None:
@@ -1425,6 +1465,7 @@ class CoordinatorClient:
         r = self._call("create_session", ttl=session_ttl)
         self.session_id = r["session_id"]
         self._ttl = r["ttl"]
+        self._discover_endpoints()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="coord-heartbeat", daemon=True
         )
@@ -1495,6 +1536,25 @@ class CoordinatorClient:
                 time.sleep(0.3)  # full rotation failed — brief backoff
         raise last  # type: ignore[misc]
 
+    def _discover_endpoints(self) -> None:
+        """Learn the rest of the ensemble from whichever endpoint is
+        serving (ZK dynamic-config analog): standbys in lease contact
+        become fallback endpoints, so a client configured with one
+        address survives failovers. Best-effort; static fallbacks and
+        already-known endpoints are kept."""
+        try:
+            r = self._call("ensemble", timeout=10.0)
+        except Exception:
+            return
+        for addr in r.get("standbys") or []:
+            try:
+                host, port_s = addr.rsplit(":", 1)
+                ep = (host, int(port_s))
+            except ValueError:
+                continue
+            if ep not in self._endpoints:
+                self._endpoints.append(ep)
+
     def _rotate(self, host: str, port: int) -> None:
         idx = self._endpoints.index((host, port)) \
             if (host, port) in self._endpoints else 0
@@ -1503,6 +1563,7 @@ class CoordinatorClient:
 
     def _heartbeat_loop(self) -> None:
         interval = self._ttl / 3
+        beats = 0
         while not self._stop.wait(interval):
             try:
                 self._call("heartbeat", session_id=self.session_id)
@@ -1510,6 +1571,12 @@ class CoordinatorClient:
                 pass  # reconnects on next beat; session may expire meanwhile
             except Exception:
                 log.exception("coordinator heartbeat failed")
+            beats += 1
+            if beats % 5 == 0 or len(self._endpoints) == 1:
+                # keep the ensemble view fresh: a client created before
+                # any standby registered would otherwise never learn
+                # its failover endpoints
+                self._discover_endpoints()
 
     def close(self) -> None:
         self._stop.set()

@@ -619,3 +619,28 @@ def test_promote_best_refuses_without_enough_standbys():
                 srv.stop()
             except Exception:
                 pass
+
+
+def test_client_discovers_ensemble_and_survives_failover(pair):
+    """Ensemble discovery: a client configured with ONLY the primary's
+    address learns the standby from the ensemble RPC and keeps working
+    after the primary dies and the standby is promoted."""
+    primary, standby = pair
+    # let the standby register its serving address with the primary
+    assert wait_until(lambda: len(primary._standby_addrs) > 0)
+    cli = CoordinatorClient("127.0.0.1", primary.port)  # NO fallbacks
+    try:
+        assert len(cli._endpoints) >= 2, cli._endpoints
+        cli.create("/disc", b"v1")
+        assert wait_until(lambda: "/disc" in _standby_nodes(standby))
+        primary.stop()
+        standby.promote()
+        from rocksplicator_tpu.rpc.errors import RpcError
+
+        try:
+            cli.set("/disc", b"v2")
+        except RpcError:
+            cli.set("/disc", b"v2")  # documented caller-retry contract
+        assert cli.get("/disc")[0] == b"v2"
+    finally:
+        cli.close()
