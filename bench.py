@@ -193,6 +193,11 @@ class _TpuWorker:
     def quit(self):
         try:
             self.cmd_q.put({"phase": "quit"})
+            # flush the feeder thread NOW: callers may os._exit right
+            # after (see _finish), which would drop a buffered quit and
+            # leave the worker parked on cmd_q.get() forever
+            self.cmd_q.close()
+            self.cmd_q.join_thread()
         except Exception:
             pass
 

@@ -867,6 +867,154 @@ class CoordinatorServer:
             # if QUORUM_LOST
         return {"ftoken": self._fencing_token}
 
+    async def handle_multi(self, ops: Optional[list] = None) -> dict:
+        """ZK multi() parity: an all-or-nothing batch of mutations.
+        Each op is {"op": "create"|"set"|"delete"|"check", "path": ...}
+        with the per-op fields of the single-op RPCs (create: value/
+        ephemeral/sequential/session_id; set: value/expected_version;
+        delete: expected_version/recursive; check: expected_version).
+        EVERY op is validated under one lock hold before ANY is applied —
+        failure returns the failing op's index and error with no state
+        change. Election/lock recipes use this for check-and-act steps
+        that single CAS ops cannot express atomically."""
+        self._check_primary()
+        self._check_quorum_lease()
+        ops = ops or []
+        results: List[dict] = []
+        with self._lock:
+            # Phase 1: simulate the WHOLE batch on a shadow view —
+            # (version, exists) per path, seeded from the live tree — so
+            # later ops observe earlier ops' effects exactly as the apply
+            # phase will produce them (ZK multi semantics: ops apply in
+            # order; version checks chain through intra-batch bumps,
+            # deletes remove whole subtrees, creates materialize full
+            # ancestor chains).
+            view: Dict[str, int] = {
+                p: n.version for p, n in self._nodes.items()
+            }
+
+            def ancestors(path):
+                parts = [p for p in path.split("/") if p]
+                cur_path = ""
+                out = []
+                for part in parts[:-1]:
+                    cur_path += "/" + part
+                    out.append(cur_path)
+                return out
+
+            for i, op in enumerate(ops):
+                kind = op.get("op")
+                path = self._norm(op.get("path", ""))
+                try:
+                    if kind == "check":
+                        if path not in view:
+                            raise RpcApplicationError(NO_NODE, path)
+                        ev = int(op.get("expected_version", -1))
+                        if ev >= 0 and view[path] != ev:
+                            raise RpcApplicationError(BAD_VERSION, path)
+                    elif kind == "create":
+                        if op.get("ephemeral"):
+                            self._check_session(
+                                int(op.get("session_id", 0)))
+                        if op.get("sequential"):
+                            raise RpcApplicationError(
+                                "BAD_OP",
+                                "sequential not supported inside multi")
+                        if path in view:
+                            raise RpcApplicationError(NODE_EXISTS, path)
+                        for anc in ancestors(path):
+                            view.setdefault(anc, 0)
+                        view[path] = 0
+                    elif kind == "set":
+                        if path not in view:
+                            raise RpcApplicationError(NO_NODE, path)
+                        ev = int(op.get("expected_version", -1))
+                        if ev >= 0 and view[path] != ev:
+                            raise RpcApplicationError(BAD_VERSION, path)
+                        view[path] += 1
+                    elif kind == "delete":
+                        if path not in view:
+                            raise RpcApplicationError(NO_NODE, path)
+                        ev = int(op.get("expected_version", -1))
+                        if ev >= 0 and view[path] != ev:
+                            raise RpcApplicationError(BAD_VERSION, path)
+                        prefix = path + "/"
+                        kids = [p for p in view if p.startswith(prefix)]
+                        if kids and not op.get("recursive"):
+                            raise RpcApplicationError(NOT_EMPTY, path)
+                        for p in kids:
+                            del view[p]
+                        del view[path]
+                    else:
+                        raise RpcApplicationError(
+                            "BAD_OP", f"unknown multi op {kind!r}")
+                except RpcApplicationError as e:
+                    raise RpcApplicationError(
+                        e.code,
+                        f"multi op {i} ({kind} {path}): {e.message}")
+            # phase 2: apply — cannot fail after validation (every apply
+            # step below mirrors a validated view transition)
+            futs = []
+            touched: List[str] = []
+            for op in ops:
+                kind = op.get("op")
+                path = self._norm(op.get("path", ""))
+                if kind == "check":
+                    results.append({"op": "check", "path": path})
+                    continue
+                if kind == "create":
+                    # full ancestor chain, matching single-op create and
+                    # the standby's replay (divergence otherwise)
+                    for anc in ancestors(path):
+                        if anc not in self._nodes:
+                            self._nodes[anc] = _Node(b"", None)
+                            futs.append(self._record({
+                                "op": "create", "path": anc, "value": "",
+                                "ephemeral": False, "seq": None}))
+                            touched.append(anc)
+                    value = bytes(op.get("value", b""))
+                    eph = bool(op.get("ephemeral"))
+                    sid = int(op.get("session_id", 0))
+                    self._nodes[path] = _Node(value, sid if eph else None)
+                    futs.append(self._record(
+                        {"op": "create", "path": path,
+                         "value": value.hex(), "ephemeral": eph,
+                         "seq": None, "sid": sid if eph else None},
+                        durable=not eph))
+                    results.append({"op": "create", "path": path})
+                elif kind == "set":
+                    node = self._nodes[path]
+                    node.value = bytes(op.get("value", b""))
+                    node.version += 1
+                    futs.append(self._record(
+                        {"op": "set", "path": path,
+                         "value": node.value.hex(),
+                         "version": node.version},
+                        durable=node.ephemeral_owner is None))
+                    results.append({"op": "set", "path": path,
+                                    "version": node.version})
+                elif kind == "delete":
+                    prefix = path + "/"
+                    for p in [q for q in self._nodes
+                              if q.startswith(prefix)]:
+                        del self._nodes[p]
+                        touched.append(p)
+                    del self._nodes[path]
+                    futs.append(self._record({"op": "delete",
+                                              "path": path}))
+                    results.append({"op": "delete", "path": path})
+                touched.append(path)
+                touched.append(self._parent(path))
+            sync_idx = self._mut_index
+        await self._await_durable(futs)
+        self._signal_stream()
+        try:
+            await self._await_standby_ack(sync_idx)
+        finally:
+            if touched:
+                self._signal_change(*touched)
+        return {"results": results, "ftoken": self._fencing_token}
+
     async def handle_list(self, path: str = "") -> dict:
         path = self._norm(path)
         with self._lock:
@@ -1002,8 +1150,13 @@ class CoordinatorServer:
                 if now - self._standby_last_pull.get(sid, 0) <= window
                 or self._standby_parked.get(sid, 0) > 0
             })
+        # a STANDBY also advertises its upstream: a client that only
+        # knows standbys can still find the primary
+        upstream = ""
+        if self._standby and self._upstream:
+            upstream = f"{self._upstream[0]}:{self._upstream[1]}"
         return {"standbys": standbys, "is_standby": self._standby,
-                "ftoken": self._fencing_token}
+                "primary": upstream, "ftoken": self._fencing_token}
 
     async def handle_repl_position(self) -> dict:
         """Election probe: (fencing token, mutation index, role). The
@@ -1480,7 +1633,7 @@ class CoordinatorClient:
     # other contender. A NOT_PRIMARY rejection is always retry-safe (the
     # standby executed nothing). create_session is exempt: a duplicate
     # session just expires unused.
-    _UNSAFE_RETRY = frozenset({"create", "set", "delete"})
+    _UNSAFE_RETRY = frozenset({"create", "set", "delete", "multi"})
 
     def _call(self, method: str, timeout: float = 30.0, **args):
         async def go(host: str, port: int):
@@ -1546,7 +1699,10 @@ class CoordinatorClient:
             r = self._call("ensemble", timeout=10.0)
         except Exception:
             return
-        for addr in r.get("standbys") or []:
+        known = list(r.get("standbys") or [])
+        if r.get("primary"):
+            known.append(r["primary"])
+        for addr in known:
             try:
                 host, port_s = addr.rsplit(":", 1)
                 ep = (host, int(port_s))
@@ -1651,6 +1807,15 @@ class CoordinatorClient:
 
     def exists(self, path: str) -> bool:
         return self._call("exists", path=path)["exists"]
+
+    def multi(self, ops: List[dict]) -> List[dict]:
+        """Atomic all-or-nothing batch (ZK multi). Each op dict mirrors
+        the single-op RPC fields, e.g.
+        {"op": "check", "path": p, "expected_version": v},
+        {"op": "create", "path": p, "value": b"..."},
+        {"op": "set", "path": p, "value": b"...", "expected_version": v},
+        {"op": "delete", "path": p, "recursive": True}."""
+        return self._call("multi", ops=ops)["results"]
 
     def sync(self, timeout_ms: int = 10_000) -> int:
         """ZK sync() parity: make the endpoint this client currently
