@@ -112,6 +112,24 @@ class DBOptions:
     }
 
 
+class _MergedMemView:
+    """Read view over several immutable memtables as one sorted entry
+    stream — the source handed to the SST sinks when a flush drains a
+    multi-memtable backlog in one file. Each memtable's entries() is
+    (key asc, seq desc); the heap-merge preserves that order globally
+    (distinct memtables never share a seq)."""
+
+    def __init__(self, imms: List[MemTable]):
+        self._imms = imms
+        self.max_seq = max(m.max_seq for m in imms)
+
+    def entries(self) -> Iterator[Tuple[bytes, int, int, bytes]]:
+        return heapq.merge(
+            *(m.entries() for m in self._imms),
+            key=lambda e: (e[0], -e[1]),
+        )
+
+
 class DB:
     """One LSM database (one shard in the sharded deployment)."""
 
@@ -616,10 +634,20 @@ class DB:
                     self._cond.wait(0.2)
                 if self._bg_stop and not self._imms:
                     return
-                imm = self._imms[0] if self._imms else None
-            if imm is not None:
+                # Take EVERY pending immutable memtable: one SST per
+                # burst instead of one per memtable (rocksdb's
+                # flush-multiple-memtables behavior) — fewer flushes,
+                # fewer/larger L0 files, less compaction pressure, and
+                # the queue drains in one pass so stalled writers wake
+                # after ONE flush latency however deep the backlog.
+                imms = list(self._imms)
+            if imms:
                 try:
-                    self._flush_imm(imm)
+                    self._flush_imms(imms)
+                    # drop the last reference so the flushed memtables
+                    # free before the next idle wait, not on the next
+                    # burst
+                    imms = None
                     with self._lock:
                         self._bg_flush_error = None
                         self._bg_flush_failures = 0
@@ -746,25 +774,28 @@ class DB:
         )
         return props is not None
 
-    def _flush_imm(self, mem: MemTable) -> None:
-        """Write the immutable memtable to an L0 SST — ALL file IO outside
-        the lock (writes keep flowing): the SST write, the reader open
-        (footer+index read), and the manifest fsyncs. Only the in-memory
-        installation runs under the lock. Crash between install and the
-        manifest write is covered by the WAL (purged strictly after the
-        manifest is durable)."""
+    def _flush_imms(self, imms: List[MemTable]) -> None:
+        """Write the pending immutable memtables (oldest first) as ONE
+        L0 SST — ALL file IO outside the lock (writes keep flowing): the
+        SST write, the reader open (footer+index read), and the manifest
+        fsyncs. Only the in-memory installation runs under the lock.
+        Crash between install and the manifest write is covered by the
+        WAL (purged strictly after the manifest is durable)."""
         with self._lock:
             name = self._new_file_name()
         path = os.path.join(self.path, name)
-        self._write_mem_sst(path, mem)
+        source = imms[0] if len(imms) == 1 else _MergedMemView(imms)
+        self._write_mem_sst(path, source)
         reader = SSTReader(path)
+        max_seq = source.max_seq
         with self._lock:
             self._readers[name] = reader
             self._levels[0].append(name)
-            self._persisted_seq = max(self._persisted_seq, mem.max_seq)
+            self._persisted_seq = max(self._persisted_seq, max_seq)
             snapshot = self._manifest_snapshot_locked()
-            if self._imms and self._imms[0] is mem:
-                self._imms.pop(0)
+            for m in imms:
+                if self._imms and self._imms[0] is m:
+                    self._imms.pop(0)
             self._cond.notify_all()
         self._write_manifest_payload(*snapshot)
         wal_mod.purge_obsolete(
@@ -838,7 +869,7 @@ class DB:
         if self.options.wal_archive_sink is None:
             # cheap unlink-only purge. With an archive sink the purge
             # does network IO and _flush_locked runs UNDER the DB lock —
-            # the off-lock purgers (_flush_imm in bg mode, flush() after
+            # the off-lock purgers (_flush_imms in bg mode, flush() after
             # it releases the lock) handle archival instead.
             wal_mod.purge_obsolete(
                 self._wal_dir, self._persisted_seq,

@@ -1049,3 +1049,55 @@ def test_wal_archive_failure_keeps_segment(tmp_path):
     wal_dir = os.path.join(str(tmp_path / "db"), "wal")
     segs = [n for n in os.listdir(wal_dir) if n.startswith("wal-")]
     assert len(segs) > 1, "purge deleted segments the sink never stored"
+
+
+def test_flush_drains_multi_memtable_backlog_in_one_sst(tmp_path):
+    """A burst that queues several immutable memtables must flush as ONE
+    L0 SST (rocksdb's flush-multiple behavior) with every entry present
+    and newest-wins intact across the merged memtables."""
+    from rocksplicator_tpu.storage.engine import _MergedMemView
+    from rocksplicator_tpu.storage.memtable import MemTable
+
+    # unit level: the merged view keeps (key asc, seq desc) order
+    m1, m2 = MemTable(), MemTable()
+    m1.apply(b"a", 1, int(OpType.PUT), b"old")
+    m1.apply(b"b", 2, int(OpType.PUT), b"b1")
+    m2.apply(b"a", 5, int(OpType.PUT), b"new")
+    m2.apply(b"c", 6, int(OpType.PUT), b"c1")
+    got = list(_MergedMemView([m1, m2]).entries())
+    assert [(k, s) for k, s, _, _ in got] == [
+        (b"a", 5), (b"a", 1), (b"b", 2), (b"c", 6)]
+
+    # engine level: stall the flusher, build a backlog, release it
+    db = DB(
+        str(tmp_path / "db"),
+        DBOptions(memtable_bytes=512, background_compaction=True,
+                  max_write_buffers=4, disable_auto_compaction=True),
+    )
+    try:
+        import threading as _t
+
+        gate = _t.Event()
+        real = DB._write_mem_sst
+
+        def slow(self, path, mem):
+            gate.wait(10)
+            return real(self, path, mem)
+
+        import pytest as _pytest
+
+        with _pytest.MonkeyPatch.context() as mp:
+            mp.setattr(DB, "_write_mem_sst", slow)
+            for i in range(60):  # ~8 memtables worth
+                db.put(b"k%04d" % (i % 16), b"v%04d" % i)
+            gate.set()
+            db.flush()
+        files = [n for n in os.listdir(str(tmp_path / "db"))
+                 if n.endswith(".tsst")]
+        # backlog drained in far fewer SSTs than memtables swapped
+        assert len(files) <= 4, files
+        for i in range(16):
+            newest = max(j for j in range(60) if j % 16 == i)
+            assert db.get(b"k%04d" % i) == b"v%04d" % newest
+    finally:
+        db.close()
