@@ -293,6 +293,7 @@ def probe_pallas_sort(st, n, iters, results):
             jax.jit(jax.vmap(sort_only)), margs, iters,
             f"10-operand sort, {backend} backend")
 
+    for backend in ("lax", "pallas", "pallas_fused"):
         def full(*a, _b=backend):
             return merge_resolve_kernel(
                 *a, uniform_klen=True, seq32=True, key_words=4,

@@ -52,7 +52,9 @@ class CompactionModel:
     # "lax" = XLA's generic sort; "pallas" = the VMEM-resident bitonic
     # kernel (ops/pallas_sort.py) that holds every operand lane on-chip
     # across all compare-exchange stages — the attack on the sort's HBM
-    # traffic (PERF.md round-2 lever). Opt-in until chip-measured.
+    # traffic (PERF.md round-2 lever); "pallas_fused" = the whole
+    # merge-resolve (sort + scans + compaction) in one VMEM residency
+    # (ops/pallas_resolve.py). Opt-in until chip-measured.
     sort_backend: str = "lax"
 
     @property

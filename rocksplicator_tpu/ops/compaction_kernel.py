@@ -197,6 +197,163 @@ def _limb_combine(lo16_0, lo16_1, hi16_0, hi16_1):
     return l0 | (l1 << 16), l2 | (l3 << 16)
 
 
+class ScanPrims:
+    """The shift/scan primitive seam phases 2-3 are written against, so
+    the XLA lane path (``resolve_sorted_lanes``) and the fused VMEM
+    kernel (ops/pallas_resolve.py) share ONE copy of the resolve math:
+    the XLA instance works on (N,) lanes with ``cumsum``/
+    ``associative_scan``; the Pallas instance works on (R, 128) VMEM
+    values with Hillis-Steele shift ladders. ``iota`` is the linear
+    entry index in the instance's layout."""
+
+    def __init__(self, iota, size, shift_prev, shift_next, cumsum_tuple,
+                 fill_forward, fill_backward):
+        self.iota = iota              # linear int32 index array
+        self.size = size              # static N
+        self.shift_prev = shift_prev  # y[i] = x[i-1] (x[0] arbitrary)
+        self.shift_next = shift_next  # y[i] = x[i+1] (x[n-1] arbitrary)
+        self.cumsum_tuple = cumsum_tuple    # inclusive prefix sums
+        self.fill_forward = fill_forward    # (flag, values) seg fill
+        self.fill_backward = fill_backward  # (flag_last, values)
+
+
+def _prims_1d(n: int) -> ScanPrims:
+    iota = lax.iota(jnp.int32, n)
+
+    def shift_prev(x):
+        return jnp.concatenate([jnp.zeros((1,), x.dtype), x[:-1]])
+
+    def shift_next(x):
+        return jnp.concatenate([x[1:], jnp.zeros((1,), x.dtype)])
+
+    return ScanPrims(
+        iota, n, shift_prev, shift_next,
+        lambda values: tuple(jnp.cumsum(v) for v in values),
+        _seg_fill_forward, _seg_fill_backward)
+
+
+def resolve_decisions(
+    prims: ScanPrims, key_lanes, key_len, valid, vtype, val_len,
+    vw_lanes, *, merge_kind: MergeKind, drop_tombstones: bool,
+    uniform_klen: bool, key_words: int,
+):
+    """Phases 2-3 on merge-ordered lanes: key-boundary detection +
+    segmented LSM resolution, in terms of the ``prims`` seam only.
+    Returns ``(vtype, val_len, vw_lanes, keep, overflow_mask_or_None)``
+    — ``keep`` marks each key's representative row for the compaction
+    phase; ``overflow_mask`` (UINT64_ADD only) marks rows whose segment
+    exceeds the 2^16-operand limb-sum bound."""
+    iota = prims.iota
+    n = prims.size
+    n_val_words = len(vw_lanes)
+    vw_lanes = list(vw_lanes)
+
+    # --- key boundaries: adjacent compare via a 1-shift; row 0 and
+    # invalid rows are forced segment starts --------------------------
+    prev_equal = None
+    for w in range(key_words):
+        eq = key_lanes[w] == prims.shift_prev(key_lanes[w])
+        prev_equal = eq if prev_equal is None else prev_equal & eq
+    if not uniform_klen:
+        # with uniform lengths, equal words imply equal keys among valid
+        # rows (invalid rows get their own segments below regardless)
+        prev_equal = prev_equal & (key_len == prims.shift_prev(key_len))
+    new_key = ~prev_equal | (iota == 0) | ~valid
+    last_key = prims.shift_next(new_key) | (iota == n - 1)
+
+    is_put = (vtype == _PUT) & valid
+    is_del = (vtype == _DELETE) & valid
+    is_merge = (vtype == _MERGE) & valid
+    is_base = is_put | is_del
+
+    overflow_mask = None
+    if merge_kind is MergeKind.UINT64_ADD:
+        # prefix counts of base entries: how many bases strictly before
+        # row i within its segment. Segment-start values arrive via ONE
+        # forward flagged fill — no index gathers.
+        (base_incl,) = prims.cumsum_tuple((is_base.astype(jnp.int32),))
+        base_excl = base_incl - is_base.astype(jnp.int32)
+        base_excl_start, iota_start = prims.fill_forward(
+            new_key, (base_excl, iota))
+        base_before = base_excl - base_excl_start
+        operand_mask = is_merge & (base_before == 0)
+        first_base_mask = is_base & (base_before == 0)
+
+        # Reference parity (merge.py UInt64AddOperator._parse): values
+        # whose length is not exactly 8 parse as 0.
+        contrib = (
+            (operand_mask | (first_base_mask & is_put)) & (val_len == 8)
+        )
+        lo = vw_lanes[0]
+        hi = vw_lanes[1] if n_val_words > 1 else jnp.zeros_like(lo)
+        zero = jnp.uint32(0)
+        limbs = [
+            jnp.where(contrib, lo & 0xFFFF, zero),
+            jnp.where(contrib, lo >> 16, zero),
+            jnp.where(contrib, hi & 0xFFFF, zero),
+            jnp.where(contrib, hi >> 16, zero),
+        ]
+
+        # inclusive prefix sums; their value AT THE SEGMENT END comes
+        # back to every row via one backward flagged fill. Segment total
+        # for a row = end_prefix - (own_prefix - own_x) — all local
+        # afterwards.
+        pref = list(prims.cumsum_tuple(tuple(limbs) + (
+            operand_mask.astype(jnp.int32),
+            (first_base_mask & is_put).astype(jnp.int32),
+            (first_base_mask & is_del).astype(jnp.int32),
+        ))) + [iota]
+        ends = prims.fill_backward(last_key, tuple(pref))
+        excl = lambda c, x: c - x  # noqa: E731
+
+        sums = [
+            ends[i] - excl(pref[i], limbs[i]) for i in range(4)
+        ]
+        seg_has_operands = (
+            ends[4] - excl(pref[4], operand_mask.astype(jnp.int32))
+        ) > 0
+        seg_base_put = (
+            ends[5] - excl(pref[5],
+                           (first_base_mask & is_put).astype(jnp.int32))
+        ) > 0
+        seg_base_del = (
+            ends[6] - excl(pref[6],
+                           (first_base_mask & is_del).astype(jnp.int32))
+        ) > 0
+        seg_size = ends[7] - iota_start + 1
+        sum_lo, sum_hi = _limb_combine(*sums)
+
+        folded = seg_has_operands
+        vw_lanes[0] = jnp.where(folded, sum_lo, lo)
+        if n_val_words > 1:
+            vw_lanes[1] = jnp.where(folded, sum_hi, hi)
+        val_len = jnp.where(folded, jnp.uint32(8), val_len)
+        pure_operands = seg_has_operands & ~seg_base_put & ~seg_base_del
+        resolved_put = seg_base_put | (seg_has_operands & seg_base_del)
+        out_vtype = jnp.where(
+            resolved_put | (pure_operands & drop_tombstones),
+            jnp.uint32(_PUT),
+            jnp.where(pure_operands, jnp.uint32(_MERGE), vtype),
+        )
+        rep = new_key & valid
+        vtype = jnp.where(rep, out_vtype, vtype)
+        dropped = seg_base_del & ~seg_has_operands
+        # Limb sums are exact only below 2^16 contributing operands per
+        # key; flag oversize groups so callers fall back to CPU instead
+        # of silently wrapping (generous: 65k updates of ONE key in ONE
+        # batch).
+        overflow_mask = (seg_size >= (1 << 16)) & valid
+    else:
+        rep = new_key & valid
+        dropped = is_del
+
+    if drop_tombstones:
+        keep = rep & ~dropped
+    else:
+        keep = rep
+    return vtype, val_len, vw_lanes, keep, overflow_mask
+
+
 def resolve_sorted_lanes(
     key_lanes,                  # list of (N,) u32, length == key_words
     key_len,                    # (N,) u32 or None (uniform_klen path)
@@ -220,115 +377,15 @@ def resolve_sorted_lanes(
     kernel below and the sorted-runs merge-network kernel
     (ops/merge_network.py), which produce that order two different ways."""
     n = seq_lo.shape[0]
-    iota = lax.iota(jnp.int32, n)
     n_val_words = len(vw_lanes)
-    vw_lanes = list(vw_lanes)
     seq_hi = seq_hi if seq_hi is not None else jnp.zeros_like(seq_lo)
 
-    # --- key boundaries (sorted order) --------------------------------
-    # (key_words promise: lanes >= key_words are zero for valid rows, so
-    # comparing them cannot change equality among valid rows; invalid rows
-    # get their own segments below regardless)
-    prev_equal = jnp.ones(n - 1, dtype=bool)
-    for w in range(key_words):
-        prev_equal &= key_lanes[w][1:] == key_lanes[w][:-1]
-    if not uniform_klen:
-        # with uniform lengths, equal words imply equal keys among valid
-        # rows (invalid rows get their own segments below regardless)
-        prev_equal &= key_len[1:] == key_len[:-1]
-    new_key = jnp.concatenate([jnp.ones(1, bool), ~prev_equal])
-    new_key = new_key | ~valid  # each invalid row = its own segment
-    last_key = jnp.concatenate([new_key[1:], jnp.ones(1, bool)])
-
-    is_put = (vtype == _PUT) & valid
-    is_del = (vtype == _DELETE) & valid
-    is_merge = (vtype == _MERGE) & valid
-    is_base = is_put | is_del
-
-    # prefix counts of base entries: how many bases strictly before row i
-    # within its segment. Segment-start values arrive via ONE forward
-    # flagged fill (associative scan) instead of index gathers.
-    base_incl = jnp.cumsum(is_base.astype(jnp.int32))
-    base_excl = base_incl - is_base.astype(jnp.int32)
-    (base_excl_start, iota_start) = _seg_fill_forward(
-        new_key, (base_excl, iota))
-    base_before = base_excl - base_excl_start
-    operand_mask = is_merge & (base_before == 0)
-    first_base_mask = is_base & (base_before == 0)
-
-    if merge_kind is MergeKind.UINT64_ADD:
-        # Reference parity (merge.py UInt64AddOperator._parse): values whose
-        # length is not exactly 8 parse as 0.
-        contrib = (
-            (operand_mask | (first_base_mask & is_put)) & (val_len == 8)
-        )
-        lo = vw_lanes[0]
-        hi = vw_lanes[1] if n_val_words > 1 else jnp.zeros_like(lo)
-        zero = jnp.uint32(0)
-        limbs = [
-            jnp.where(contrib, lo & 0xFFFF, zero),
-            jnp.where(contrib, lo >> 16, zero),
-            jnp.where(contrib, hi & 0xFFFF, zero),
-            jnp.where(contrib, hi >> 16, zero),
-        ]
-
-        # inclusive prefix sums; their value AT THE SEGMENT END comes back
-        # to every row via one backward flagged fill. Segment total for a
-        # row = end_prefix - (own_prefix - own_x) — all local afterwards.
-        pref = [jnp.cumsum(x) for x in limbs] + [
-            jnp.cumsum(operand_mask.astype(jnp.int32)),
-            jnp.cumsum((first_base_mask & is_put).astype(jnp.int32)),
-            jnp.cumsum((first_base_mask & is_del).astype(jnp.int32)),
-            iota,
-        ]
-        ends = _seg_fill_backward(last_key, tuple(pref))
-        excl = lambda c, x: c - x  # noqa: E731
-
-        sums = [
-            ends[i] - excl(pref[i], limbs[i]) for i in range(4)
-        ]
-        seg_has_operands = (
-            ends[4] - excl(pref[4], operand_mask.astype(jnp.int32))
-        ) > 0
-        seg_base_put = (
-            ends[5] - excl(pref[5], (first_base_mask & is_put).astype(jnp.int32))
-        ) > 0
-        seg_base_del = (
-            ends[6] - excl(pref[6], (first_base_mask & is_del).astype(jnp.int32))
-        ) > 0
-        seg_size = ends[7] - iota_start + 1
-        sum_lo, sum_hi = _limb_combine(*sums)
-
-        folded = seg_has_operands
-        out_lo = jnp.where(folded, sum_lo, lo)
-        out_hi = jnp.where(folded, sum_hi, hi)
-        vw_lanes[0] = out_lo
-        if n_val_words > 1:
-            vw_lanes[1] = out_hi
-        val_len = jnp.where(folded, jnp.uint32(8), val_len)
-        pure_operands = seg_has_operands & ~seg_base_put & ~seg_base_del
-        resolved_put = seg_base_put | (seg_has_operands & seg_base_del)
-        out_vtype = jnp.where(
-            resolved_put | (pure_operands & drop_tombstones),
-            jnp.uint32(_PUT),
-            jnp.where(pure_operands, jnp.uint32(_MERGE), vtype),
-        )
-        rep = new_key & valid
-        vtype = jnp.where(rep, out_vtype, vtype)
-        dropped = seg_base_del & ~seg_has_operands
-        # Limb sums are exact only below 2^16 contributing operands per
-        # key; flag oversize groups so callers fall back to CPU instead of
-        # silently wrapping (generous: 65k updates of ONE key in ONE batch).
-        overflow_risk = jnp.any((seg_size >= (1 << 16)) & valid)
-    else:
-        rep = new_key & valid
-        dropped = is_del
-        overflow_risk = jnp.asarray(False)
-
-    if drop_tombstones:
-        keep = rep & ~dropped
-    else:
-        keep = rep
+    vtype, val_len, vw_lanes, keep, overflow_mask = resolve_decisions(
+        _prims_1d(n), key_lanes, key_len, valid, vtype, val_len,
+        vw_lanes, merge_kind=merge_kind, drop_tombstones=drop_tombstones,
+        uniform_klen=uniform_klen, key_words=key_words)
+    overflow_risk = (jnp.any(overflow_mask) if overflow_mask is not None
+                     else jnp.asarray(False))
 
     # --- stream compaction: stable sort, output lanes as payload -------
     not_keep = jnp.where(keep, jnp.uint32(0), jnp.uint32(1))
@@ -414,6 +471,25 @@ def merge_resolve_kernel(
     ``uniform_klen``/``seq32``/``key_words`` are caller-verified fast-path
     promises (see _sort_merge_order); results are identical either way.
     """
+    if sort_backend == "pallas_fused":
+        from .pallas_resolve import fused_merge_resolve, fused_supported
+
+        n = seq_lo.shape[0]
+        if fused_supported(n):
+            return fused_merge_resolve(
+                key_words_be, key_len, seq_hi, seq_lo, vtype, val_words,
+                val_len, valid, merge_kind=merge_kind,
+                drop_tombstones=drop_tombstones,
+                uniform_klen=uniform_klen, seq32=seq32,
+                key_words=key_words,
+            )
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "pallas_fused backend requested but capacity %d is "
+            "unsupported (needs a power of two >= 256) — falling back "
+            "to the lax path", n)
+
     n_val_words = val_words.shape[1]
     # uniform_klen reconstruction constant: the one valid key length
     # (input order differs from output order, so the lane itself can't be

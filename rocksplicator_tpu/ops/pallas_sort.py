@@ -138,6 +138,18 @@ def _stage(lanes, num_keys, r_rows, k, j):
     return out
 
 
+def bitonic_network(lanes, num_keys: int, r_rows: int):
+    """The full bitonic network over (R, 128) u32 lane VALUES (already
+    VMEM-resident inside a kernel). Shared by the standalone sort kernel
+    and the fused sort+resolve kernel (ops/pallas_resolve.py)."""
+    n = r_rows * _LANES
+    log_n = n.bit_length() - 1
+    for k in range(log_n):
+        for j in range(k, -1, -1):
+            lanes = _stage(lanes, num_keys, r_rows, k, j)
+    return lanes
+
+
 def _sort_kernel(num_keys: int, r_rows: int, n_lanes: int, *refs):
     """Pallas kernel body: refs = n_lanes input refs + n_lanes output
     refs. Loads all lanes into VMEM values, runs the full bitonic
@@ -145,11 +157,7 @@ def _sort_kernel(num_keys: int, r_rows: int, n_lanes: int, *refs):
     in_refs = refs[:n_lanes]
     out_refs = refs[n_lanes:]
     lanes = [r[:] for r in in_refs]
-    n = r_rows * _LANES
-    log_n = n.bit_length() - 1
-    for k in range(log_n):
-        for j in range(k, -1, -1):
-            lanes = _stage(lanes, num_keys, r_rows, k, j)
+    lanes = bitonic_network(lanes, num_keys, r_rows)
     for r, x in zip(out_refs, lanes):
         r[:] = x
 
