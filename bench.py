@@ -17,6 +17,13 @@ baseline ladder is:
 Prints exactly ONE JSON line on stdout:
     {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, ...}
 Diagnostics go to stderr.
+
+``value`` is the framework's best measured compaction throughput on the
+available hardware: the TPU kernel when a chip was granted, else the
+framework's production CPU fallback (the numpy backend —
+TpuCompactionBackend's default fallback). ``value_source`` names the
+path; ``degraded_no_accelerator: true`` + ``tpu_kernel_gbps`` keep a
+degraded run and its raw kernel-emulation number distinguishable.
 """
 
 import json
@@ -226,14 +233,18 @@ def _make_model():
     # 16-byte keys + 32-bit seqs: reduced-key sort (_sort_merge_order);
     # emit_planar adds on-device SST block encoding (plane words +
     # checksums — the production sink format) to the measured pipeline.
-    # BENCH_PALLAS_SORT=1 swaps in the VMEM-resident bitonic sort.
+    # BENCH_PALLAS_SORT=1 swaps in the VMEM-resident bitonic sort;
+    # =2 the fully-fused sort+resolve kernel (ops/pallas_resolve.py).
+    level = os.environ.get("BENCH_PALLAS_SORT", "0")
+    backends = {"0": "lax", "1": "pallas", "2": "pallas_fused"}
+    if level not in backends:
+        log(f"BENCH_PALLAS_SORT={level!r} is not one of 0/1/2 — "
+            f"using the lax backend")
     return CompactionModel(
         capacity=ENTRIES, uniform_klen=True, seq32=True,
         key_words=KEY_BYTES // 4, emit_planar=True,
         row_klen=KEY_BYTES, row_vlen=VAL_BYTES,
-        sort_backend=("pallas"
-                      if int(os.environ.get("BENCH_PALLAS_SORT", "0"))
-                      else "lax"),
+        sort_backend=backends.get(level, "lax"),
     )
 
 
@@ -652,14 +663,42 @@ def main():
     device_ok = False
     platform = {"name": "unknown"}
 
-    def record(tpu_gbps, tpu_shards, tpu_xfer_gbps):
+    def record(tpu_gbps, tpu_shards, tpu_xfer_gbps, accelerator=None):
         """Fold the current best TPU numbers + all host numbers into the
-        emit-on-exit result."""
+        emit-on-exit result. ``accelerator`` overrides the closure's
+        ``device_ok`` (the late-salvage path records a real-chip number
+        before flipping the flag).
+
+        On a host with no accelerator the framework's production
+        compaction path is the numpy fallback backend
+        (TpuCompactionBackend falls back to NumpyCompactionBackend —
+        tpu/backend.py), NOT the jax kernel emulated on CPU — so a
+        degraded run's headline is the best measured FRAMEWORK number on
+        this host, with value_source naming which path it came from.
+        The degraded_no_accelerator flag still marks the run."""
+        if accelerator is not None:
+            on_accel = accelerator
+        else:
+            # device_ok means acquisition succeeded — which includes an
+            # explicitly-requested JAX_PLATFORMS=cpu run; the label must
+            # follow the backend the phases actually ran on
+            on_accel = device_ok and platform["name"] not in (
+                "cpu", "unknown")
+        value, source = tpu_gbps, (
+            "tpu_kernel" if on_accel else "jax_kernel_cpu_emulation")
+        if not on_accel:
+            for gbps, name in ((single_gbps, "numpy_backend_single_core"),
+                               (py_gbps, "heap_merge_backend_single_core"),
+                               (mp_gbps, "numpy_backend_multiproc")):
+                if gbps and gbps > value:
+                    value, source = gbps, name
         _RESULT["data"] = {
             "metric": "shard_batched_compaction_throughput",
-            "value": round(tpu_gbps, 3),
+            "value": round(value, 3),
             "unit": "GB/s",
-            "vs_baseline": round(tpu_gbps / cpu32_gbps, 3)
+            "value_source": source,
+            "tpu_kernel_gbps": round(tpu_gbps, 3),
+            "vs_baseline": round(value / cpu32_gbps, 3)
             if cpu32_gbps else 0.0,
             # machine consumers must tell a degraded run apart
             "platform": platform["name"],
@@ -673,7 +712,7 @@ def main():
             "cpu_cores_available": cores,
             "cpu_32core_baseline_gbps": round(cpu32_gbps, 3),
             "cpu_32core_baseline_kind": cpu32_kind,
-            "vs_single_core": round(tpu_gbps / single_best, 2)
+            "vs_single_core": round(value / single_best, 2)
             if single_best else 0.0,
             "write_stall_p99_ms": stall_p99,
             # 0 samples: no writer ever stalled during the storm — the
@@ -828,7 +867,7 @@ def _salvage_late_accelerator(record, budget_left):
         # a real accelerator number replaces the degraded CPU one. The
         # transfer-inclusive number (if any) came from the CPU fallback
         # worker — a cross-backend ratio is meaningless, so drop it.
-        record(res["gbps"], first, None)
+        record(res["gbps"], first, None, accelerator=True)
         _RESULT["data"]["platform"] = res["backend"]
         _RESULT["data"]["degraded_no_accelerator"] = False
         _RESULT["data"]["late_salvage"] = True

@@ -55,7 +55,12 @@ class TpuCompactionBackend(CompactionBackend):
     name = "tpu"
 
     def __init__(self, fallback: Optional[CompactionBackend] = None):
-        self._fallback = fallback or CpuCompactionBackend()
+        # default fallback is the VECTORIZED cpu path: on hosts where the
+        # accelerator is absent/wedged, the framework's compaction
+        # throughput is the lexsort+reduceat numpy pipeline (itself
+        # falling back to the streaming heap-merge for batches the lane
+        # representation can't express)
+        self._fallback = fallback or NumpyCompactionBackend()
         import jax  # deferred so CPU-only deployments never touch jax
 
         self._jax = jax
