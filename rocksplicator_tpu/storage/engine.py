@@ -1032,6 +1032,10 @@ class DB:
     # ------------------------------------------------------------------
 
     def get_property(self, name: str) -> Optional[str]:
+        # accept rocksdb's property namespace ("rocksdb.num-files-at-
+        # level0") so reference callers port unchanged
+        if name.startswith("rocksdb."):
+            name = name[len("rocksdb."):]
         with self._lock:
             if name == "num-levels":
                 return str(self.options.num_levels)

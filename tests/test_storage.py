@@ -395,6 +395,9 @@ def test_db_flush_compaction_and_levels(tmp_path):
         # 3 L0 files triggered compaction into L1
         assert db.get_property("num-files-at-level0") == "0"
         assert db.get_property("num-files-at-level1") == "1"
+        # rocksdb's property namespace works unchanged for ported callers
+        assert db.get_property("rocksdb.num-files-at-level1") == "1"
+        assert db.get_property("rocksdb.estimate-num-keys") is not None
         for i in range(50):
             assert db.get(f"k{i:03d}".encode()) == b"r2"
         # deletes compact away at the bottom

@@ -650,3 +650,10 @@ def test_read_sst_arrays_rejects_foreign_uniform_props(tmp_path):
     r = SSTReader(path)
     assert read_sst_arrays(r) is None  # falls back, no ValueError
     r.close()
+
+
+def test_tpu_backend_default_fallback_is_vectorized():
+    """The production CPU fallback is the vectorized numpy path — the
+    degraded bench's value_source semantics rely on this default."""
+    assert isinstance(TpuCompactionBackend()._fallback,
+                      NumpyCompactionBackend)
