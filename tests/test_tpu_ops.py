@@ -640,3 +640,67 @@ def test_fused_merge_resolve_fallback_non_pow2():
         batch.key_words_be, batch.key_len, batch.seq_hi, batch.seq_lo,
         batch.vtype, batch.val_words, batch.val_len, batch.valid))
     _assert_fused_matches_lax(args)
+
+
+def test_vmem_scan_ladder_primitives_match_1d():
+    """The fused kernel's (R,128) Hillis-Steele shift/scan ladders must
+    reproduce the 1-D primitives exactly (cheap pinpoint coverage — the
+    interpret-mode kernel tests are minutes each; this isolates the scan
+    math in milliseconds)."""
+    import numpy as _np
+
+    from rocksplicator_tpu.ops.compaction_kernel import (
+        _seg_fill_backward, _seg_fill_forward)
+    from rocksplicator_tpu.ops.pallas_resolve import (
+        _cumsum_tuple, _fill_backward, _fill_forward, _shift_down,
+        _shift_up)
+
+    n, lanes = 1024, 128
+    r = n // lanes
+    rng = _np.random.default_rng(2)
+    x_np = rng.integers(0, 1000, n, dtype=_np.int32)
+    x1 = jnp.asarray(x_np)
+    x2 = x1.reshape(r, lanes)
+    iota2 = (jax.lax.broadcasted_iota(jnp.int32, (r, lanes), 0) * lanes
+             + jax.lax.broadcasted_iota(jnp.int32, (r, lanes), 1))
+
+    # linear-order shifts at lane, row, and multi-row distances
+    for d in (1, 2, 64, 128, 256):
+        want_dn = _np.concatenate([_np.zeros(d, _np.int32), x_np[:-d]])
+        want_up = _np.concatenate([x_np[d:], _np.zeros(d, _np.int32)])
+        _np.testing.assert_array_equal(
+            _np.asarray(_shift_down(x2, d)).reshape(n), want_dn, err_msg=f"down d={d}")
+        _np.testing.assert_array_equal(
+            _np.asarray(_shift_up(x2, d)).reshape(n), want_up, err_msg=f"up d={d}")
+
+    # batched inclusive prefix sums
+    y_np = rng.integers(0, 7, n, dtype=_np.int32)
+    got = _cumsum_tuple((x2, jnp.asarray(y_np).reshape(r, lanes)), n)
+    _np.testing.assert_array_equal(
+        _np.asarray(got[0]).reshape(n), _np.cumsum(x_np, dtype=_np.int32))
+    _np.testing.assert_array_equal(
+        _np.asarray(got[1]).reshape(n), _np.cumsum(y_np, dtype=_np.int32))
+
+    # segmented fills vs the associative_scan originals (row 0 / last
+    # row flagged per the contract)
+    flag_np = rng.random(n) < 0.07
+    flag_np[0] = True
+    flag1 = jnp.asarray(flag_np)
+    want_f = _seg_fill_forward(flag1, (x1, jnp.asarray(y_np)))
+    got_f = _fill_forward(flag1.reshape(r, lanes),
+                          (x2, jnp.asarray(y_np).reshape(r, lanes)),
+                          iota2, n)
+    for w, g in zip(want_f, got_f):
+        _np.testing.assert_array_equal(
+            _np.asarray(g).reshape(n), _np.asarray(w), err_msg="fwd")
+
+    lflag_np = rng.random(n) < 0.07
+    lflag_np[-1] = True
+    lflag1 = jnp.asarray(lflag_np)
+    want_b = _seg_fill_backward(lflag1, (x1, jnp.asarray(y_np)))
+    got_b = _fill_backward(lflag1.reshape(r, lanes),
+                           (x2, jnp.asarray(y_np).reshape(r, lanes)),
+                           iota2, n)
+    for w, g in zip(want_b, got_b):
+        _np.testing.assert_array_equal(
+            _np.asarray(g).reshape(n), _np.asarray(w), err_msg="bwd")
