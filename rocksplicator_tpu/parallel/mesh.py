@@ -99,6 +99,7 @@ def sharded_compaction_step(mesh, model=None):
 
     model = model or CompactionModel()
     merge_kind = model.merge_kind
+    sort_backend = model.sort_backend
 
     def local_step(kwbe, klen, shi, slo, vt, vw, vl, valid):
         # local shapes: (s, 1, N, ...) — one block column per device
@@ -107,7 +108,8 @@ def sharded_compaction_step(mesh, model=None):
 
         def run(args, drop):
             return merge_resolve_kernel(
-                *args, merge_kind=merge_kind, drop_tombstones=drop
+                *args, merge_kind=merge_kind, drop_tombstones=drop,
+                sort_backend=sort_backend,
             )
 
         # 1) block-local merge (keep tombstones: blocks are partial views)
@@ -142,6 +144,7 @@ def sharded_compaction_step(mesh, model=None):
             lambda *a: merge_resolve_kernel(
                 *a, merge_kind=merge_kind,
                 drop_tombstones=model.drop_tombstones,
+                sort_backend=sort_backend,
             )
         )(
             flat["key_words_be"], flat["key_len"],

@@ -657,3 +657,38 @@ def test_tpu_backend_default_fallback_is_vectorized():
     degraded bench's value_source semantics rely on this default."""
     assert isinstance(TpuCompactionBackend()._fallback,
                       NumpyCompactionBackend)
+
+
+def test_sharded_step_fused_backend_matches_lax():
+    """The fully-fused Pallas kernel must compose with the shard_map
+    mesh step (interpret mode on the virtual 8-device mesh) and produce
+    exactly what the lax mesh step produces — the multichip story holds
+    for the fused backend too."""
+    import jax.numpy as jnp
+
+    from rocksplicator_tpu.parallel.mesh import (
+        make_mesh, make_sharded_inputs, sharded_compaction_step,
+    )
+
+    mesh = make_mesh(8)
+    m_lax = CompactionModel(capacity=256)
+    m_fus = CompactionModel(capacity=256, sort_backend="pallas_fused")
+    arrays = make_sharded_inputs(mesh, shards_per_device=1,
+                                 entries_per_block=256, model=m_lax)
+    args = tuple(jnp.asarray(arrays[k]) for k in (
+        "key_words_be", "key_len", "seq_hi", "seq_lo",
+        "vtype", "val_words", "val_len", "valid"))
+    out_l, bloom_l, counts_l, gc_l, _ = sharded_compaction_step(
+        mesh, m_lax)(*args)
+    out_f, bloom_f, counts_f, gc_f, _ = sharded_compaction_step(
+        mesh, m_fus)(*args)
+    assert int(np.asarray(gc_l).reshape(-1)[0]) == int(
+        np.asarray(gc_f).reshape(-1)[0]) > 0
+    np.testing.assert_array_equal(np.asarray(counts_l),
+                                  np.asarray(counts_f))
+    for k in ("key_words_be", "key_words_le", "key_len", "seq_lo",
+              "seq_hi", "vtype", "val_words", "val_len"):
+        np.testing.assert_array_equal(
+            np.asarray(out_l[k]), np.asarray(out_f[k]), err_msg=k)
+    np.testing.assert_array_equal(np.asarray(bloom_l),
+                                  np.asarray(bloom_f))
