@@ -46,12 +46,41 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.flags import FLAGS, define_flag
 from .kv_format import KEY_WORDS
 
 # OpType values (storage/records.py) as device constants
 _PUT = 1
 _DELETE = 2
 _MERGE = 3
+
+
+_SORT_BACKENDS = ("lax", "pallas", "pallas_fused")
+
+define_flag(
+    "sort_backend", "lax",
+    "merge_resolve_kernel sort backend for consumers with no per-call "
+    "configuration (compaction service / engine-seam TPU backend / "
+    "chunked merge): lax | pallas | pallas_fused. Env override: "
+    "RSTPU_FLAG_SORT_BACKEND; runtime: FLAGS.set('sort_backend', ...)")
+
+
+def deployment_sort_backend() -> str:
+    """The deployment-wide sort backend choice — the ``sort_backend``
+    flag (utils/flags.py: env ``RSTPU_FLAG_SORT_BACKEND``, runtime
+    ``FLAGS.set``, visible in the /gflags.txt dump). One source of truth
+    for every runtime consumer of merge_resolve_kernel that has no
+    per-call configuration. An unknown value logs loudly once and runs
+    the lax path rather than silently misconfiguring the fleet."""
+    v = FLAGS.get("sort_backend")
+    if v not in _SORT_BACKENDS:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "sort_backend flag %r is not one of %s — using lax",
+            v, _SORT_BACKENDS)
+        return "lax"
+    return v
 
 
 class MergeKind(enum.Enum):

@@ -21,7 +21,8 @@ import numpy as np
 
 from ..storage.compaction import CompactionBackend, CpuCompactionBackend, Entry
 from ..storage.merge import MergeOperator, UInt64AddOperator
-from ..ops.compaction_kernel import MergeKind, merge_resolve_kernel
+from ..ops.compaction_kernel import (MergeKind, deployment_sort_backend,
+                                     merge_resolve_kernel)
 from ..ops.kv_format import (KVBatch, UnsupportedBatch, fast_flags,
                              pack_entries, unpack_entries)
 
@@ -285,6 +286,7 @@ class TpuCompactionBackend(CompactionBackend):
             jnp.asarray(batch.valid),
             merge_kind=kind, drop_tombstones=drop_tombstones,
             uniform_klen=uniform_klen, seq32=seq32, key_words=key_words,
+            sort_backend=deployment_sort_backend(),
         )
         if bool(out["needs_cpu_fallback"]):
             return None

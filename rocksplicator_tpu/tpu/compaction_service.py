@@ -26,7 +26,8 @@ import numpy as np
 from ..storage.bloom import num_words_for
 from ..storage.engine import DBOptions
 from ..ops.bloom_tpu import bloom_build_tpu
-from ..ops.compaction_kernel import MergeKind, merge_resolve_kernel
+from ..ops.compaction_kernel import (MergeKind, deployment_sort_backend,
+                                     merge_resolve_kernel)
 from ..ops.kv_format import KEY_WORDS, KVBatch, fast_flags, unpack_entries
 from .backend import TpuCompactionBackend, _next_pow2
 
@@ -37,13 +38,20 @@ class TpuCompactionService:
     _instance: Optional["TpuCompactionService"] = None
     _instance_lock = threading.Lock()
 
-    def __init__(self, bits_per_key: int = 10):
+    def __init__(self, bits_per_key: int = 10, sort_backend: str = None):
         import jax
         import jax.numpy as jnp
 
         self._jax = jax
         self._jnp = jnp
         self._bits_per_key = bits_per_key
+        # deployment knob: run the service's kernels on the lax sort, the
+        # VMEM-resident pallas sort, or the fully-fused pallas kernel —
+        # whichever the bench shootout crowned on this hardware. None =
+        # resolve the sort_backend FLAG per pipeline build, so a runtime
+        # FLAGS.set flip reaches the singleton too (the flag value is
+        # part of the pipeline cache key).
+        self._sort_backend = sort_backend
         self._vmapped_cache: Dict[tuple, object] = {}
 
     @classmethod
@@ -71,8 +79,9 @@ class TpuCompactionService:
     def _pipeline(self, merge_kind: MergeKind, drop_tombstones: bool,
                   num_words: int, uniform_klen: bool = False,
                   seq32: bool = False, key_words: int = KEY_WORDS):
+        sort_backend = self._sort_backend or deployment_sort_backend()
         key = (merge_kind, drop_tombstones, num_words, uniform_klen, seq32,
-               key_words)
+               key_words, sort_backend)
         fn = self._vmapped_cache.get(key)
         if fn is None:
             jax = self._jax
@@ -82,7 +91,7 @@ class TpuCompactionService:
                     kwbe, klen, shi, slo, vt, vw, vl, valid,
                     merge_kind=merge_kind, drop_tombstones=drop_tombstones,
                     uniform_klen=uniform_klen, seq32=seq32,
-                    key_words=key_words,
+                    key_words=key_words, sort_backend=sort_backend,
                 )
                 out_valid = (
                     jax.lax.iota(jax.numpy.int32, klen.shape[0]) < out["count"]
