@@ -11,9 +11,10 @@ tax the Pallas sort was built to remove.
 This kernel runs ALL FOUR phases inside one ``pallas_call``: lanes are
 read from HBM once, sorted by the shared bitonic network
 (pallas_sort.bitonic_network), resolved with shift-based scans, stream-
-compacted by a second in-VMEM bitonic pass (keyed ``(not_keep, index)``
-— the unique index tiebreak reproduces XLA's ``is_stable=True``
-ordering exactly), and written back once.
+compacted by a second in-VMEM bitonic pass (keyed by the packed
+``not_keep<<31 | index`` composite — one lane whose unique-index
+tiebreak reproduces XLA's ``is_stable=True`` ordering exactly), and
+written back once.
 
 Scan primitives: every ``cumsum``/segmented fill from the XLA resolve
 is re-expressed as a Hillis-Steele ladder of linear-order shifts on the
@@ -188,21 +189,23 @@ def _fused_kernel(
     else:
         ovf_u32 = jnp.zeros((1, 1), jnp.uint32)
 
-    # --- phase 4: stream compaction — second bitonic pass. The unique
-    # linear index as the second key reproduces the lax path's
-    # is_stable=True order exactly (keys there are never tied twice). -
+    # --- phase 4: stream compaction — second bitonic pass. The keep
+    # bit and the unique linear index pack into ONE u32 key lane
+    # (n <= 2^22 << 2^31): ordering by the composite == ordering by
+    # (not_keep, index), which reproduces the lax path's is_stable=True
+    # order exactly while saving a full lane through the network. -----
     not_keep = jnp.where(keep, jnp.uint32(0), jnp.uint32(1))
+    sort2_key = (not_keep << 31) | iota.astype(jnp.uint32)
     out_payload: List = list(key_lanes) + [slo, vtype, val_len] + vw
     if not seq32:
         out_payload.append(shi)
     if not uniform_klen:
         out_payload.append(klen)
-    sorted2 = bitonic_network(
-        [not_keep, iota.astype(jnp.uint32)] + out_payload, 2, r_rows)
+    sorted2 = bitonic_network([sort2_key] + out_payload, 1, r_rows)
 
     count = jnp.sum(keep.astype(jnp.int32), keepdims=True).reshape(1, 1)
     live = iota < count
-    for ref, x in zip(out_refs[:-1], sorted2[2:]):
+    for ref, x in zip(out_refs[:-1], sorted2[1:]):
         ref[:] = jnp.where(live, x, jnp.zeros_like(x))
 
     lane_ix = jax.lax.broadcasted_iota(jnp.uint32, (1, _LANES), 1)
