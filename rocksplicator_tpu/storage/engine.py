@@ -229,7 +229,7 @@ class DB:
             self._apply_to_memtable(batch, start_seq)
             self._last_seq = max(self._last_seq, end_seq)
         self._wal = wal_mod.WalWriter(
-            self._wal_dir, self.options.wal_segment_bytes, self.options.sync_writes
+            self._wal_dir, self.options.wal_segment_bytes
         )
 
     @property
@@ -280,7 +280,15 @@ class DB:
     # ------------------------------------------------------------------
 
     def write(self, batch: WriteBatch, sync: bool = False) -> int:
-        """Apply a batch atomically; returns the batch's start seq."""
+        """Apply a batch atomically; returns the batch's start seq.
+
+        Sync durability is GROUP-COMMITTED: the fsync runs OUTSIDE the
+        DB lock (readers and other writers never block on the disk) and
+        one leader's fsync covers every concurrently-waiting sync
+        writer (WalWriter.sync_to). As in rocksdb's pipelined-write
+        mode, a concurrent reader may observe a sync write in the
+        memtable shortly before its fsync returns; write() itself does
+        not return until the batch is durable."""
         count = batch.count()
         with self._lock:
             self._check_open()
@@ -291,9 +299,7 @@ class DB:
             start_seq = self._last_seq + 1
             encoded = batch.encode()
             assert self._wal is not None
-            self._wal.append(start_seq, encoded)
-            if sync or self.options.sync_writes:
-                self._wal.sync()
+            token = self._wal.append(start_seq, encoded)
             self._apply_to_memtable(batch, start_seq)
             self._last_seq += count
             if self._mem.approximate_bytes() >= self.options.memtable_bytes:
@@ -301,7 +307,10 @@ class DB:
                     self._swap_to_imm_locked()
                 else:
                     self._flush_locked()
-            return start_seq
+            wal = self._wal
+        if sync or self.options.sync_writes:
+            wal.sync_to(token)
+        return start_seq
 
     def _admission_stall_locked(self, batch_bytes: int) -> None:
         """Write-stall at ADMISSION (rocksdb WriteController analog):

@@ -25,6 +25,7 @@ from __future__ import annotations
 import logging
 import os
 import struct
+import threading
 import time
 import zlib
 from typing import Iterator, List, Optional, Tuple
@@ -35,20 +36,40 @@ _REC_HEAD = struct.Struct("<QII")
 
 
 class WalWriter:
+    """Appender with GROUP-COMMIT durability (rocksdb write-group
+    analog). ``append`` (serialized by the engine's DB lock) buffers +
+    flushes to the OS and returns a monotonically increasing token;
+    ``sync_to(token)`` — called OUTSIDE the DB lock — makes every
+    append up to that token durable with ONE fsync shared by all
+    concurrently-waiting sync writers: the first waiter in becomes the
+    leader, snapshots the published append token, fsyncs once, and
+    every writer whose token that snapshot covers returns without
+    touching the disk. Readers never block on an fsync."""
+
     def __init__(
         self,
         wal_dir: str,
         segment_bytes: int = 64 * 1024 * 1024,
-        sync_writes: bool = False,
     ):
         self._dir = wal_dir
         self._segment_bytes = segment_bytes
-        self._sync = sync_writes
         self._file = None
         self._file_size = 0
+        # group-commit state: tokens are published under the appender's
+        # lock; _sync_lock serializes fsync leaders and file swaps
+        self._sync_lock = threading.Lock()
+        self._append_token = 0
+        self._synced_token = 0
+        # non-sync workloads pay no roll-time fsync; the first sync
+        # request catches up any segments closed un-fsynced before it
+        self._sync_used = False
+        self._closed_unsynced = False
         os.makedirs(wal_dir, exist_ok=True)
 
-    def append(self, start_seq: int, batch_bytes: bytes) -> None:
+    def append(self, start_seq: int, batch_bytes: bytes) -> int:
+        """Buffer one record and flush it to the OS. Returns the sync
+        token covering it — pass to ``sync_to`` for durability. Must be
+        externally serialized (the engine holds the DB lock)."""
         if self._file is None or self._file_size >= self._segment_bytes:
             self._roll(start_seq)
         rec = _REC_HEAD.pack(
@@ -57,27 +78,106 @@ class WalWriter:
         assert self._file is not None
         self._file.write(rec)
         self._file.write(batch_bytes)
+        # flush BEFORE publishing the token: a sync leader snapshotting
+        # the token must find these bytes already in the OS, so its
+        # fsync alone durably covers them
         self._file.flush()
-        if self._sync:
-            os.fsync(self._file.fileno())
         self._file_size += len(rec) + len(batch_bytes)
+        self._append_token += 1
+        return self._append_token
+
+    def sync_to(self, token: int) -> None:
+        """Group commit: durable up to ``token`` (and opportunistically
+        everything appended by the time the leader's fsync starts).
+        Safe to call concurrently from many writers without the DB
+        lock; appends may proceed in parallel (BufferedWriter is
+        internally locked, and unsynced appends simply ride a later
+        fsync)."""
+        if token <= self._synced_token:
+            return
+        with self._sync_lock:
+            self._sync_used = True
+            if token <= self._synced_token:
+                return  # a leader's fsync covered us while we waited
+            f = self._file
+            if f is None:
+                return
+            cover = self._append_token
+            self._catchup_closed_segments_locked()
+            os.fsync(f.fileno())
+            if cover > self._synced_token:
+                self._synced_token = cover
+
+    def _catchup_closed_segments_locked(self) -> None:
+        """One-time sweep: fsync segments that rolled closed before the
+        first sync request (rolls skip the fsync until sync is in use,
+        so plain workloads never stall on it). Caller holds _sync_lock."""
+        if not self._closed_unsynced:
+            return
+        for _seq, path in _segments(self._dir):
+            try:
+                fd = os.open(path, os.O_RDONLY)
+            except FileNotFoundError:
+                continue  # purged — durability is moot
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        self._closed_unsynced = False
 
     def _roll(self, first_seq: int) -> None:
-        if self._file is not None:
-            self._file.close()
-        path = os.path.join(self._dir, f"wal-{first_seq:020d}.log")
-        self._file = open(path, "ab")
-        self._file_size = self._file.tell()
+        # the sync lock pins the outgoing file against a concurrent
+        # leader's fsync on its (about-to-be-closed) descriptor
+        with self._sync_lock:
+            if self._file is not None:
+                if self._append_token > self._synced_token:
+                    if self._sync_used:
+                        # a later sync_to can only fsync the NEW file;
+                        # make the outgoing segment durable now so its
+                        # tokens are honestly covered (one fsync per
+                        # segment roll, only once sync is in use)
+                        self._file.flush()
+                        os.fsync(self._file.fileno())
+                        self._synced_token = self._append_token
+                    else:
+                        # plain workload: skip the stall, remember that
+                        # a first sync request must sweep closed
+                        # segments before claiming coverage
+                        self._closed_unsynced = True
+                self._file.close()
+            path = os.path.join(self._dir, f"wal-{first_seq:020d}.log")
+            self._file = open(path, "ab")
+            self._file_size = self._file.tell()
 
     def sync(self) -> None:
-        if self._file is not None:
-            self._file.flush()
-            os.fsync(self._file.fileno())
+        """Unconditional full sync (flush + fsync of the active
+        segment, catching up any segments closed un-fsynced)."""
+        with self._sync_lock:
+            self._sync_used = True
+            f = self._file
+            if f is None:
+                return
+            cover = self._append_token
+            self._catchup_closed_segments_locked()
+            f.flush()
+            os.fsync(f.fileno())
+            if cover > self._synced_token:
+                self._synced_token = cover
 
     def close(self) -> None:
-        if self._file is not None:
-            self._file.close()
-            self._file = None
+        # the sync lock pins the descriptor against an in-flight group
+        # leader's fsync (same rule as _roll). A dirty tail is fsynced
+        # before closing: a sync writer that appended but has not yet
+        # reached sync_to must find its bytes durable, not a None file
+        # (its sync_to no-ops after close).
+        with self._sync_lock:
+            if self._file is not None:
+                if self._append_token > self._synced_token:
+                    self._file.flush()
+                    os.fsync(self._file.fileno())
+                    self._synced_token = self._append_token
+                self._file.close()
+                self._file = None
 
 
 def _segments(wal_dir: str) -> List[Tuple[int, str]]:
