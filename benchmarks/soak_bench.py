@@ -258,12 +258,16 @@ def failover_phase(n_shards: int, load_sec: float) -> dict:
         conv = wait_until(converged, timeout=120)
         total_seq = 0
         for s in range(n_shards):
-            for n in nodes:
-                app = n.handler.db_manager.get_db(
-                    segment_to_db_name("seg", s))
-                if app is not None:
-                    total_seq += app.latest_sequence_number()
-                    break
+            # max across replicas: acked writes live on at least the
+            # leader, so a lagging follower must not register as "loss"
+            # when the convergence wait timed out
+            apps = [
+                app for n in nodes
+                if (app := n.handler.db_manager.get_db(
+                    segment_to_db_name("seg", s))) is not None
+            ]
+            total_seq += max(
+                (a.latest_sequence_number() for a in apps), default=0)
         result.update({
             "writes_acked": written[0],
             "write_errors": errors[0],

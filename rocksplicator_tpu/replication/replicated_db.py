@@ -30,7 +30,7 @@ from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional, Tuple
 
 from ..rpc.client_pool import RpcClientPool
-from ..rpc.errors import RpcApplicationError, RpcError
+from ..rpc.errors import RpcApplicationError, RpcConnectionError, RpcError
 from ..storage.records import WriteBatch, decode_batch
 from ..utils.misc import now_ms
 from ..utils.stats import Stats, tagged
@@ -61,6 +61,12 @@ class ReplicationFlags:
     # pulls from a non-leader that return nothing this many times in a row
     # trigger an upstream reset (replicated_db.cpp:392-408 heuristic)
     empty_pulls_before_reset: int = 5
+    # consecutive CONNECTION errors to the same upstream force a resolver
+    # query (no sampling): a steady follower whose leader died gets no
+    # state transition — without escalation its repoint waits on the 10%
+    # sample × 5-10s backoff (~75 s expected; observed blowing the soak
+    # failover convergence window at 4000 shards)
+    conn_errors_before_forced_reset: int = 3
     pull_rpc_margin_ms: int = 5_000
 
 
@@ -97,6 +103,7 @@ class ReplicatedDB:
         self._consecutive_ack_timeouts = 0
         self._degraded = False
         self._empty_pulls = 0
+        self._conn_errors = 0
         self._stats = Stats.get()
 
     # ------------------------------------------------------------------
@@ -290,6 +297,7 @@ class ReplicatedDB:
         while not self._removed:
             try:
                 applied, source_role = await self._pull_once()
+                self._conn_errors = 0
                 if (
                     applied == 0
                     and self.role is ReplicaRole.FOLLOWER
@@ -308,6 +316,7 @@ class ReplicatedDB:
                 raise
             except RpcApplicationError as e:
                 self._stats.incr(M["pull_errors"])
+                self._conn_errors = 0
                 if e.code == ReplicateErrorCode.SOURCE_NOT_FOUND.value:
                     await self._maybe_reset_upstream(force_sample=False)
                 await self._pull_error_delay()
@@ -315,9 +324,24 @@ class ReplicatedDB:
                 self._stats.incr(M["pull_errors"])
                 log.warning("%s: pull error from %s: %r", self.name,
                             self.upstream_addr, e)
-                # A dead upstream looks like connection errors; consult the
-                # leader resolver (sampled) in case leadership moved.
-                await self._maybe_reset_upstream(force_sample=False)
+                # A dead upstream looks like CONNECTION errors; consult
+                # the leader resolver — sampled at first, FORCED after a
+                # few in a row (a steady follower gets no transition when
+                # its leader dies; only this path repoints it). Only
+                # connection-class errors escalate: a local apply/decode
+                # failure loop must not hammer the control plane
+                # unsampled.
+                forced = False
+                if isinstance(e, (RpcConnectionError, ConnectionError,
+                                  OSError)):
+                    self._conn_errors += 1
+                    forced = (self._conn_errors
+                              >= f.conn_errors_before_forced_reset)
+                    if forced:
+                        self._conn_errors = 0
+                else:
+                    self._conn_errors = 0
+                await self._maybe_reset_upstream(force_sample=forced)
                 await self._pull_error_delay()
 
     async def _pull_once(self) -> Tuple[int, Optional[str]]:
@@ -403,11 +427,13 @@ class ReplicatedDB:
             log.info("%s: resetting upstream %s -> %s", self.name,
                      self.upstream_addr, new_addr)
             self.upstream_addr = tuple(new_addr)
+            self._conn_errors = 0  # fresh upstream, fresh error budget
             self._stats.incr(M["upstream_resets"])
 
     def reset_upstream(self, addr: Tuple[str, int]) -> None:
         """Explicit upstream repoint (changeDBRoleAndUpStream path)."""
         self.upstream_addr = tuple(addr)
+        self._conn_errors = 0
 
     # ------------------------------------------------------------------
     # introspection (replicated_db.cpp:168-182)

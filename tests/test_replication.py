@@ -51,12 +51,14 @@ class Host:
     def addr(self):
         return ("127.0.0.1", self.replicator.port)
 
-    def add_db(self, db_name, role, upstream=None, mode=0, **db_kw):
+    def add_db(self, db_name, role, upstream=None, mode=0,
+               leader_resolver=None, **db_kw):
         db = DB(str(self.dir / db_name), DBOptions(**db_kw))
         self.dbs[db_name] = db
         rdb = self.replicator.add_db(
             db_name, StorageDbWrapper(db), role,
             upstream_addr=upstream, replication_mode=mode,
+            leader_resolver=leader_resolver,
         )
         return db, rdb
 
@@ -545,3 +547,32 @@ def test_replication_over_mutual_tls(tmp_path):
     finally:
         for h in created:
             h.stop()
+
+
+def test_connection_errors_force_upstream_repoint(hosts, tmp_path):
+    """A steady follower whose upstream host died gets NO cluster
+    transition; repeated connection errors must FORCE a leader-resolver
+    query (no sampling roulette) so the repoint is bounded by a few
+    error backoffs, not by the 10% sample rate."""
+    flags = ReplicationFlags(
+        server_long_poll_ms=200,
+        pull_error_delay_min_ms=30,
+        pull_error_delay_max_ms=60,
+        upstream_reset_sample_rate=0.0,  # sampling can NEVER repoint
+        conn_errors_before_forced_reset=2,
+    )
+    leader = hosts("leader", flags)
+    follower = hosts("follower", flags)
+    ldb, _ = leader.add_db("seg00001", ReplicaRole.LEADER)
+    for i in range(20):
+        ldb.put(b"k%02d" % i, b"v%02d" % i)
+
+    dead = ("127.0.0.1", 1)  # nothing listens there
+    fdb, rdb = follower.add_db(
+        "seg00001", ReplicaRole.FOLLOWER, upstream=dead,
+        leader_resolver=lambda name: leader.addr,
+    )
+    assert wait_until(
+        lambda: fdb.get(b"k19") == b"v19", timeout=20
+    ), f"follower never repointed (upstream={rdb.upstream_addr})"
+    assert tuple(rdb.upstream_addr) == leader.addr
