@@ -64,6 +64,11 @@ class WalWriter:
         # request catches up any segments closed un-fsynced before it
         self._sync_used = False
         self._closed_unsynced = False
+        # False whenever a segment dirent was created without a
+        # directory fsync; set True only by a SUCCESSFUL dir fsync, so
+        # a failed attempt is retried by the next sync instead of the
+        # durability claim silently standing
+        self._dir_synced = False
         os.makedirs(wal_dir, exist_ok=True)
 
     def append(self, start_seq: int, batch_bytes: bytes) -> int:
@@ -104,6 +109,9 @@ class WalWriter:
                 return
             cover = self._append_token
             self._catchup_closed_segments_locked()
+            if not self._dir_synced:
+                # segment dirents created before sync was in use
+                self._fsync_dir_locked()
             os.fsync(f.fileno())
             if cover > self._synced_token:
                 self._synced_token = cover
@@ -123,7 +131,19 @@ class WalWriter:
                 os.fsync(fd)
             finally:
                 os.close(fd)
+        self._fsync_dir_locked()  # their dirents too
         self._closed_unsynced = False
+
+    def _fsync_dir_locked(self) -> None:
+        # a failing open/fsync on our own directory must PROPAGATE: the
+        # caller is mid-durability-claim, and the sticky flag stays
+        # False so the next sync retries
+        fd = os.open(self._dir, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        self._dir_synced = True
 
     def _roll(self, first_seq: int) -> None:
         # the sync lock pins the outgoing file against a concurrent
@@ -148,6 +168,12 @@ class WalWriter:
             path = os.path.join(self._dir, f"wal-{first_seq:020d}.log")
             self._file = open(path, "ab")
             self._file_size = self._file.tell()
+            if self._sync_used:
+                # persist the new segment's directory entry: an fsynced
+                # FILE is not durable if power loss drops its dirent
+                self._fsync_dir_locked()
+            else:
+                self._dir_synced = False  # new dirent, not yet durable
 
     def sync(self) -> None:
         """Unconditional full sync (flush + fsync of the active
@@ -159,6 +185,8 @@ class WalWriter:
                 return
             cover = self._append_token
             self._catchup_closed_segments_locked()
+            if not self._dir_synced:
+                self._fsync_dir_locked()
             f.flush()
             os.fsync(f.fileno())
             if cover > self._synced_token:
@@ -166,13 +194,18 @@ class WalWriter:
 
     def close(self) -> None:
         # the sync lock pins the descriptor against an in-flight group
-        # leader's fsync (same rule as _roll). A dirty tail is fsynced
-        # before closing: a sync writer that appended but has not yet
-        # reached sync_to must find its bytes durable, not a None file
-        # (its sync_to no-ops after close).
+        # leader's fsync (same rule as _roll). A dirty tail — data OR
+        # dirents — is made fully durable before closing and claiming
+        # coverage: a sync writer that appended but has not yet reached
+        # sync_to must find its bytes durable (its sync_to no-ops after
+        # close), and a cleanly closed WAL survives power loss outright.
         with self._sync_lock:
             if self._file is not None:
-                if self._append_token > self._synced_token:
+                if (self._append_token > self._synced_token
+                        or self._closed_unsynced):
+                    self._catchup_closed_segments_locked()
+                    if not self._dir_synced:
+                        self._fsync_dir_locked()
                     self._file.flush()
                     os.fsync(self._file.fileno())
                     self._synced_token = self._append_token
