@@ -1206,6 +1206,11 @@ class DB:
                     self._persisted_seq = max(self._persisted_seq, self._last_seq)
                 else:
                     for name in new_names:
+                        # no footer rewrite on this branch — fsync the
+                        # copied pages before the manifest names the file
+                        # (ingested data has no WAL to replay)
+                        with open(os.path.join(self.path, name), "rb") as f:
+                            os.fsync(f.fileno())
                         self._readers_open(name)
                 self._levels[0].extend(new_names)
                 # the parked compactor's predicate reads len(levels[0])
@@ -1233,6 +1238,11 @@ class DB:
                 fields[6] |= FLAG_HAS_GLOBAL_SEQNO
                 f.seek(size - _FOOTER.size)
                 f.write(_FOOTER.pack(*fields))
+                # ingested data was never in the WAL: the copy AND this
+                # footer rewrite must be durable before the manifest
+                # references the file (same invariant as SSTWriter.finish)
+                f.flush()
+                os.fsync(f.fileno())
             old = self._readers.pop(name, None)
             if old is not None:
                 old.close()

@@ -219,6 +219,13 @@ class SSTWriter:
                 len(self._index), self._num_entries, flags, MAGIC,
             )
         )
+        # fsync BEFORE the manifest can reference this file: the engine
+        # purges WAL once the manifest is durable, so an un-fsynced SST
+        # would leave a durable manifest pointing at pages power loss
+        # can drop, with no WAL left to replay. (The dirent rides the
+        # manifest writer's directory fsync, which happens after this.)
+        self._file.flush()
+        os.fsync(self._file.fileno())
         self._file.close()
         # Only now is the file complete — a failure anywhere above leaves
         # _finished False so abandon() still closes and removes it.
