@@ -256,6 +256,27 @@ def failover_phase(n_shards: int, load_sec: float) -> dict:
             return True
 
         conv = wait_until(converged, timeout=120)
+        if not conv:
+            # per-shard forensics: which shards diverge, each replica's
+            # seq + the ReplicatedDB's own view (role/upstream/acked) —
+            # the data needed to tell a stalled pull loop from a
+            # mis-pointed upstream from a dead task
+            divergent = {}
+            for s in range(n_shards):
+                db_name = segment_to_db_name("seg", s)
+                seqs = {}
+                intro = {}
+                for n in nodes:
+                    app = n.handler.db_manager.get_db(db_name)
+                    if app is not None:
+                        seqs[n.name] = app.latest_sequence_number()
+                    rdb = n.replicator.get_db(db_name)
+                    if rdb is not None:
+                        intro[n.name] = rdb.introspect()
+                if len(set(seqs.values())) > 1:
+                    divergent[s] = {"seqs": seqs, "introspect": intro}
+            result["divergent_shards"] = divergent
+            log(f"divergent shards: {json.dumps(divergent, indent=1)}")
         total_seq = 0
         for s in range(n_shards):
             # max across replicas: acked writes live on at least the
@@ -336,6 +357,13 @@ def main():
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
+    # Non-convergence after failover is a correctness failure, not a perf
+    # footnote: the run must FAIL so regressions can't hide in the JSON.
+    fo = result.get("failover", {})
+    if fo and not fo.get("replicas_converged", True):
+        sys.exit(1)
+    if fo.get("error"):
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -175,6 +175,12 @@ class AdminHandler:
             raise RpcApplicationError(DB_NOT_FOUND, db_name)
         return app_db
 
+    def set_leader_resolver(self, resolver: Optional[LeaderResolver]) -> None:
+        """Install (or replace) the data-plane leader resolver. Takes
+        effect for every hosted DB, including those already open — the
+        per-DB resolver closure reads this attribute at resolve time."""
+        self._leader_resolver = resolver
+
     def get_meta_data(self, db_name: str) -> DBMetaData:
         """admin_handler.cpp:556-576."""
         raw = self._meta_db.get(db_name.encode("utf-8"))
@@ -216,7 +222,13 @@ class AdminHandler:
             replicator=self.replicator,
             upstream_addr=upstream,
             replication_mode=replication_mode,
-            leader_resolver=self._leader_resolver,
+            # late-bound: set_leader_resolver (called once the participant
+            # exists — it is constructed after the handler) must reach DBs
+            # that are already open, so the wrapper defers the lookup
+            leader_resolver=lambda name: (
+                self._leader_resolver(name) if self._leader_resolver
+                else None
+            ),
         )
         if not self.db_manager.add_db(db_name, app_db):
             app_db.close()

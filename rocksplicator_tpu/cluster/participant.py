@@ -205,9 +205,25 @@ class Participant:
                 self._applied_upstream[partition] = upstream
         except Exception:
             log.exception("%s: repoint failed", partition)
+            # paced like _run_transition: the finally-block re-evaluation
+            # below would otherwise resubmit a fast-failing repoint in a
+            # tight submit/fail loop
+            time.sleep(self.error_retry_backoff)
         finally:
             with self._state_lock:
                 self._inflight.pop(partition, None)
+            # Re-evaluate like _run_transition does: an assignment update
+            # that arrived while this repoint was in flight was skipped by
+            # _on_assignments (inflight guard) — without this re-check a
+            # final controller write landing in that window would never be
+            # applied (observed: soak failover followers stuck on a stale
+            # upstream, replicas_converged=false).
+            if not self._stopped:
+                raw = self.coord.get_or_none(
+                    self._path("assignments", self.instance.instance_id)
+                )
+                if raw is not None:
+                    self._on_assignments({"value": raw})
 
     def _set_current(self, partition: str, state: str) -> None:
         # _publish_lock serializes snapshot+put as one unit so concurrent
@@ -230,6 +246,35 @@ class Participant:
     def current_states(self) -> Dict[str, str]:
         with self._state_lock:
             return dict(self._current)
+
+    def make_leader_resolver(self):
+        """db_name -> (host, repl_port) of the partition's current leader,
+        from the coordinator's external view. Wire into the AdminHandler
+        (set_leader_resolver) so a steady follower whose leader died can
+        repoint itself from the pull loop's forced-reset path even if the
+        controller's assignment write raced its inflight repoint —
+        the data-plane half of the reference's GetLeaderInstanceId
+        (replicated_db.cpp:278-312)."""
+        from ..utils.segment_utils import db_name_to_partition_name
+
+        def resolve(db_name: str) -> Optional[Tuple[str, int]]:
+            try:
+                partition = db_name_to_partition_name(db_name)
+                view = self.ctx.external_view(partition)
+                instances = self.ctx.live_instances()
+                for iid, state in view.items():
+                    if state not in ("LEADER", "MASTER"):
+                        continue
+                    if iid == self.instance.instance_id:
+                        continue
+                    info = instances.get(iid)
+                    if info is not None:
+                        return (info.host, info.repl_port)
+            except Exception:
+                log.exception("leader resolver failed for %s", db_name)
+            return None
+
+        return resolve
 
     def stop(self) -> None:
         """shutDownParticipant (Participant.java) — drop membership."""

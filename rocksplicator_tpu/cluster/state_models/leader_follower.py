@@ -21,6 +21,7 @@ import logging
 import time
 from typing import Dict, Optional, Tuple
 
+from ...rpc.errors import RpcApplicationError
 from ...utils.segment_utils import (
     db_name_to_segment,
     partition_name_to_db_name,
@@ -77,9 +78,12 @@ class LeaderFollowerStateModel(StateModel):
                 return (info.host, info.repl_port)
         return None
 
-    def _catch_up(self, target_addr: Tuple[str, int], deadline: float) -> bool:
-        """Wait until local seq is within margin of the target's
-        (catch-up loop, LeaderFollowerStateModelFactory.java:570-599)."""
+    def _catch_up(self, target_addr: Tuple[str, int], deadline: float,
+                  margin: int = CATCH_UP_MARGIN) -> bool:
+        """Wait until local seq is within ``margin`` of the target's
+        (catch-up loop, LeaderFollowerStateModelFactory.java:570-599).
+        margin=0 demands exact catch-up — right for promotion, where the
+        peer has no leader and its seq is static."""
         ctx = self.ctx
         admin_target = target_addr
         while time.monotonic() < deadline:
@@ -89,7 +93,7 @@ class LeaderFollowerStateModel(StateModel):
             remote = ctx.admin.get_sequence_number(admin_target, self.db_name)
             if local is None or remote is None:
                 return False
-            if local + CATCH_UP_MARGIN >= remote:
+            if local + margin >= remote:
                 return True
             time.sleep(0.1)
         return False
@@ -134,9 +138,19 @@ class LeaderFollowerStateModel(StateModel):
                 else (best_addr.host, best_addr.repl_port) if best_addr
                 else ctx.local_repl_addr  # bootstrap: self-upstream, no-op
             )
-            ctx.admin.add_db(
-                ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream
-            )
+            try:
+                ctx.admin.add_db(
+                    ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream
+                )
+            except RpcApplicationError as e:
+                if e.code != "DB_ALREADY_EXISTS":
+                    raise
+                # ERROR-recovery replan lands here with the db still open
+                # (e.g. a failed promotion retries via OFFLINE): converge
+                # role/upstream instead of failing the whole transition
+                ctx.admin.change_db_role_and_upstream(
+                    ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream
+                )
             # needRebuildDB: far behind the best replica -> snapshot rebuild
             local = ctx.admin.get_sequence_number(
                 ctx.local_admin_addr, self.db_name
@@ -201,10 +215,22 @@ class LeaderFollowerStateModel(StateModel):
                     ctx.local_admin_addr, self.db_name, "FOLLOWER",
                     (best_info.host, best_info.repl_port),
                 )
-                self._catch_up(
+                # margin=0: the peer has no leader feeding it, so its seq
+                # is static and exact catch-up terminates. Promoting even
+                # a few seqs short would strand writes that exist only on
+                # the peer (it can never hand them to the new leader) and
+                # leave the replica set divergent until enough fresh
+                # writes paper over the seq gap — with none, forever
+                # (reference :230-303 promotes the caught-up candidate).
+                if not self._catch_up(
                     (best_info.host, best_info.admin_port),
                     time.monotonic() + ctx.catch_up_timeout,
-                )
+                    margin=0,
+                ):
+                    raise TransitionError(
+                        f"{self.partition}: catch-up from {best_iid} "
+                        f"(seq {best_seq}) incomplete; retrying promotion"
+                    )
             # 3-node-failure guard (reference :291-303): refuse promotion if
             # we're far behind the last known leader seq in the coordinator.
             persisted = ctx.get_partition_seq(self.partition)

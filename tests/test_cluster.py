@@ -184,6 +184,11 @@ class ServiceNode:
             backup_store_uri=backup_store_uri, catch_up_timeout=10.0,
             **participant_kw,
         )
+        # data-plane self-healing: a follower whose upstream dies can
+        # repoint from its own pull loop (forced reset after consecutive
+        # connection errors) without waiting on a controller write
+        self.handler.set_leader_resolver(
+            self.participant.make_leader_resolver())
 
     def stop(self, graceful=True):
         if graceful:
@@ -308,6 +313,93 @@ def test_cluster_assignment_replication_failover(control_plane, tmp_path):
     assert history["num_promotions"] >= 2  # initial + failover
     assert history["last_leader"] == new_leader.instance.instance_id
     client.close()
+
+
+def test_failover_converges_with_lagging_follower(control_plane, tmp_path):
+    """Regression (round-4 soak `replicas_converged: false`): after a
+    leader crash, the survivors must reach EQUAL seqs with NO fresh
+    writes. Exercises the two bugs that broke this: promotion used a
+    10-seq catch-up margin and ignored catch-up failure (a new leader
+    could stabilize permanently behind its peer), and a follower whose
+    repoint raced the controller's final assignment write never
+    re-evaluated. One follower is deliberately lagged behind a black-hole
+    upstream when the leader dies, so promotion-time seqs are uneven."""
+    import socket
+
+    coord_server, cluster, add_node, add_controller, extras = control_plane
+    nodes = [add_node(n) for n in ("a", "b", "c")]
+    ctrl = add_controller()
+    ctrl.add_resource(ResourceDef("seg", num_shards=1, replicas=3))
+    partition, db_name = "seg_0", "seg00000"
+
+    def states():
+        return [n.participant.current_states.get(partition) for n in nodes]
+
+    assert wait_until(lambda: sorted(
+        s for s in states() if s) == ["FOLLOWER", "FOLLOWER", "LEADER"],
+        timeout=30), states()
+    leader = next(n for n in nodes
+                  if n.participant.current_states.get(partition) == "LEADER")
+    followers = [n for n in nodes if n is not leader]
+    app = leader.handler.db_manager.get_db(db_name)
+    for i in range(30):
+        app.write(WriteBatch().put(f"k{i:03d}".encode(), b"x" * 32))
+    assert wait_until(lambda: all(
+        f.handler.db_manager.get_db(db_name).latest_sequence_number() == 30
+        for f in followers), timeout=20)
+
+    # black-hole upstream: accepts connections, never answers — the
+    # lagging follower's pulls hang for the full RPC timeout, so it is
+    # genuinely behind when the leader dies
+    hole = socket.socket()
+    hole.bind(("127.0.0.1", 0))
+    hole.listen(8)
+    try:
+        lagger, other = followers
+        lagger.replicator.get_db(db_name).reset_upstream(
+            ("127.0.0.1", hole.getsockname()[1]))
+        # the pull in flight at repoint time still talks to the OLD
+        # upstream and would deliver the writes below; let it drain (one
+        # long-poll period) so the next pull parks on the black hole
+        time.sleep(1.0)
+        for i in range(30, 70):
+            app.write(WriteBatch().put(f"k{i:03d}".encode(), b"x" * 32))
+        assert wait_until(
+            lambda: other.handler.db_manager.get_db(
+                db_name).latest_sequence_number() == 70, timeout=20)
+        assert lagger.handler.db_manager.get_db(
+            db_name).latest_sequence_number() < 70
+
+        leader.stop(graceful=False)
+        nodes.remove(leader)
+        assert wait_until(lambda: any(
+            n.participant.current_states.get(partition) == "LEADER"
+            for n in nodes), timeout=30), states()
+
+        # NO further writes: convergence must come from the repair paths
+        def converged():
+            # get_db can momentarily return None mid-repoint (role change
+            # reopens the db) — treat that as "not yet"
+            apps = [n.handler.db_manager.get_db(db_name) for n in nodes]
+            if any(a is None for a in apps):
+                return False
+            seqs = [a.latest_sequence_number() for a in apps]
+            return len(set(seqs)) == 1 and seqs[0] == 70
+
+        assert wait_until(converged, timeout=60), [
+            (n.name,
+             getattr(n.handler.db_manager.get_db(db_name),
+                     "latest_sequence_number", lambda: None)(),
+             getattr(n.replicator.get_db(db_name), "introspect",
+                     lambda: None)())
+            for n in nodes
+        ]
+        # content, not just seq numbers
+        for n in nodes:
+            assert n.handler.db_manager.get_db(
+                db_name).get(b"k069") == b"x" * 32
+    finally:
+        hole.close()
 
 
 def test_spectator_generates_shard_map(control_plane, tmp_path):
