@@ -182,17 +182,22 @@ class _TpuWorker:
              "sort_backend": sort_backend})
         return self._wait_result(timeout_sec)
 
-    _abandoned_any = False  # see _finish(): orphans block clean exit
+    _abandoned = []  # see _finish(): reaped with TERM at exit
 
     def abandon(self):
         """Walk away from a hung worker WITHOUT killing it: SIGKILLing a
         process holding a live tunnel session wedges the grant pool-side
         (round-1 postmortem), and multiprocessing's atexit handler TERMs
         any still-registered daemon child — so deregister it and let it
-        finish (or hang) on its own."""
+        finish (or hang) on its own until exit time, when _finish sends
+        one TERM (safe per the tunnel discipline — only KILL wedges) and
+        reaps it."""
         log(f"abandoning tpu worker pid={self.proc.pid} "
             f"(not killed: SIGKILL wedges the tunnel grant)")
-        _TpuWorker._abandoned_any = True
+        # capture the handles NOW: the phase-timeout path nulls
+        # worker.proc after abandoning, and _finish must still be able
+        # to TERM/join/close this worker
+        _TpuWorker._abandoned.append((self.proc, self.cmd_q, self.res_q))
         try:
             _registered_children().discard(self.proc)
         except Exception as e:
@@ -414,7 +419,10 @@ def bench_numpy_multiproc(stacked):
     deadlock-prone."""
     global _MP_STACKED
     cores = len(os.sched_getaffinity(0))
-    workers = min(cores, SHARDS)
+    # BENCH_MP_WORKERS forces the worker count (test seam + operator
+    # override); default remains one worker per available core
+    forced = int(os.environ.get("BENCH_MP_WORKERS", "0") or 0)
+    workers = forced if forced > 0 else min(cores, SHARDS)
     if workers <= 1:
         log("cpu multiprocess: 1 core available — same as single-core")
         return None, cores, 1
@@ -606,16 +614,44 @@ def _emit_result() -> None:
 
 
 def _finish() -> None:
-    """Emit and exit. With any ABANDONED worker still alive, a normal
-    interpreter exit blocks forever: the orphan holds the resource
-    tracker's pipe open, and the parent's shutdown waitpid()s on the
-    tracker (observed: bench hung after printing its JSON — likely the
-    real reason rounds 1-3 looked wedged to the driver). The JSON is
-    flushed, so exit HARD and leave the orphans be."""
+    """Emit and exit, reaping abandoned workers first. With an orphan
+    still alive, a normal interpreter exit blocks forever: the orphan
+    holds the resource tracker's pipe open, and the parent's shutdown
+    waitpid()s on the tracker (observed: bench hung after printing its
+    JSON). Round-4's answer was a hard os._exit — which leaked the
+    orphans' queue semaphores into the driver tail (resource_tracker
+    warnings). Now: TERM each abandoned worker (allowed by the tunnel
+    discipline — only SIGKILL wedges a grant), join briefly, and take
+    the clean-exit path when they die; the hard exit remains only as
+    the last resort for a worker that ignores TERM."""
     _emit_result()
-    if _TpuWorker._abandoned_any:
-        log("abandoned workers alive — hard exit (resource tracker "
-            "would block a clean shutdown)")
+    still_alive = False
+    for proc, _cq, _rq in _TpuWorker._abandoned:
+        try:
+            if proc.is_alive():
+                proc.terminate()
+        except Exception as e:
+            log(f"TERM of abandoned worker failed: {e!r}")
+    for proc, cmd_q, res_q in _TpuWorker._abandoned:
+        try:
+            proc.join(5.0)
+            if proc.is_alive():
+                still_alive = True
+            else:
+                # release the queues' semaphores while the resource
+                # tracker is still in a position to reap them
+                for q in (cmd_q, res_q):
+                    try:
+                        q.close()
+                        q.join_thread()
+                    except Exception:
+                        pass
+        except Exception as e:
+            log(f"join of abandoned worker failed: {e!r}")
+            still_alive = True
+    if still_alive:
+        log("abandoned worker ignored TERM — hard exit (resource "
+            "tracker would block a clean shutdown)")
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(0)
@@ -630,10 +666,17 @@ def _install_term_handler() -> None:
         _emit_result()
         # reap still-registered (healthy) workers so their stderr pipe
         # closes too — SIGTERM, never SIGKILL (tunnel grant); abandoned
-        # hung workers were already deregistered and stay untouched
+        # workers were deregistered, so TERM them explicitly as well
+        # (no join — the exit below cannot wait on a wedged child)
         for child in list(_registered_children()):
             try:
                 child.terminate()
+            except Exception:
+                pass
+        for proc, _cq, _rq in _TpuWorker._abandoned:
+            try:
+                if proc.is_alive():
+                    proc.terminate()
             except Exception:
                 pass
         os._exit(0)
@@ -648,11 +691,14 @@ def main():
         f"iters={ITERS} climb={CLIMB_SHARDS} budget={TIME_BUDGET}s")
     _install_term_handler()
     start = time.monotonic()
-    # Kick off accelerator init FIRST: it overlaps every host-side phase
-    # below (inputs, CPU baselines, stall storm — minutes of free cover
-    # for the slow pool-side init that timed out in rounds 1-3).
-    _acquire_worker.pending = _TpuWorker()
-    os.environ.pop("BENCH_WORKER_INIT_DELAY", None)  # first worker only
+    # NOTE (round-5 fix): the accelerator worker is spawned AFTER the
+    # timed host phases, not before. Rounds 1-4 overlapped jax init with
+    # the host phases to hide the slow pool-side init — but on a 1-core
+    # host the worker's XLA compile ran concurrently with the write-stall
+    # storm and CPU baselines, polluting exactly the numbers the driver
+    # records (r4: stall p99 17.4 ms under bench-inflicted contention vs
+    # 4.0 ms clean). Init still gets its full 600 s floor
+    # (_acquire_worker); the serialization costs ~1-2 min of wall clock.
     stacked = build_inputs()
     # CPU parallel baseline first: it forks, which must happen before
     # jax initializes a multithreaded runtime in THIS process (it never
@@ -757,8 +803,10 @@ def main():
     record(0.0, 0, None)
     _RESULT["data"]["tpu_phase_incomplete"] = True
 
-    # All host phases done — now claim the (hopefully long-since-warm)
-    # accelerator worker.
+    # All host phases done (and their timings clean) — only now spawn
+    # and claim the accelerator worker.
+    _acquire_worker.pending = _TpuWorker()
+    os.environ.pop("BENCH_WORKER_INIT_DELAY", None)  # first worker only
     worker, device_ok, backend = _acquire_worker(start)
     platform["name"] = backend
     record(0.0, 0, None)

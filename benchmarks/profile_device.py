@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -316,6 +317,13 @@ def main():
 
     log(f"platform={jax.default_backend()} shards={args.shards} "
         f"entries={args.entries}")
+    if os.environ.get("RSTPU_REQUIRE_ACCEL") and \
+            jax.default_backend() == "cpu":
+        # prober seam: a CPU fallback is useless here (interpret-mode
+        # pallas takes minutes per trace) — fail fast so the caller
+        # retries later instead of wedging on emulation
+        log("RSTPU_REQUIRE_ACCEL set but backend is cpu — aborting")
+        sys.exit(3)
     st = build_inputs(args.entries, args.shards)
     results = {}
     if args.set in ("components", "all"):

@@ -183,13 +183,19 @@ class Participant:
         finally:
             with self._state_lock:
                 self._inflight.pop(partition, None)
-            # re-evaluate: the target may have moved meanwhile
+            # re-evaluate: the target may have moved meanwhile. Guarded:
+            # an exception escaping here dies silently in the executor
+            # future and the missed update would never be re-applied.
             if not self._stopped:
-                raw = self.coord.get_or_none(
-                    self._path("assignments", self.instance.instance_id)
-                )
-                if raw is not None:
-                    self._on_assignments({"value": raw})
+                try:
+                    raw = self.coord.get_or_none(
+                        self._path("assignments", self.instance.instance_id)
+                    )
+                    if raw is not None:
+                        self._on_assignments({"value": raw})
+                except Exception:
+                    log.exception(
+                        "%s: post-transition re-evaluation failed", partition)
 
     def _run_repoint(self, partition: str, state: str, upstream: str) -> None:
         from ..utils.segment_utils import partition_name_to_db_name
@@ -217,13 +223,18 @@ class Participant:
             # _on_assignments (inflight guard) — without this re-check a
             # final controller write landing in that window would never be
             # applied (observed: soak failover followers stuck on a stale
-            # upstream, replicas_converged=false).
+            # upstream, replicas_converged=false). Guarded: an exception
+            # escaping here dies silently in the executor future.
             if not self._stopped:
-                raw = self.coord.get_or_none(
-                    self._path("assignments", self.instance.instance_id)
-                )
-                if raw is not None:
-                    self._on_assignments({"value": raw})
+                try:
+                    raw = self.coord.get_or_none(
+                        self._path("assignments", self.instance.instance_id)
+                    )
+                    if raw is not None:
+                        self._on_assignments({"value": raw})
+                except Exception:
+                    log.exception(
+                        "%s: post-repoint re-evaluation failed", partition)
 
     def _set_current(self, partition: str, state: str) -> None:
         # _publish_lock serializes snapshot+put as one unit so concurrent

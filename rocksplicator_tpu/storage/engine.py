@@ -165,6 +165,12 @@ class DB:
         self._bg_stop = False
         self._bg_flush_error: Optional[BaseException] = None
         self._bg_flush_failures = 0
+        # Measured flush throughput (bytes/s, EWMA over recent flushes).
+        # The delayed-write controller paces admissions to THIS, not the
+        # static delayed_write_rate knob, when the host flushes slower
+        # than the knob assumes (rocksdb's WriteController does the same:
+        # the delay rate tracks flush bandwidth). 0 = no flush measured.
+        self._flush_rate_ewma = 0.0
         self._bg_compaction_error: Optional[BaseException] = None
         self._bg_compaction_failures = 0
         self._bg_thread: Optional[threading.Thread] = None
@@ -346,10 +352,18 @@ class DB:
             or (l0_managed() and len(self._levels[0])
                 >= opts.level0_slowdown_writes_trigger)
         ):
-            # pace to delayed_write_rate; cap one delay at 10ms so the
-            # soft tier itself can't produce double-digit stalls
-            delay = min(0.010, max(batch_bytes, 64)
-                        / float(opts.delayed_write_rate))
+            # Pace to the MEASURED flush rate when it is below the
+            # configured delayed_write_rate (rocksdb WriteController
+            # semantics: delay rate follows flush bandwidth). On a
+            # contended host flushes run slower, so static pacing admits
+            # faster than the flusher drains and writers pile into the
+            # hard tier — which is where double-digit p99 comes from.
+            # One delay stays capped (8ms) so the soft tier itself can't
+            # produce double-digit stalls.
+            rate = float(opts.delayed_write_rate)
+            if self._flush_rate_ewma > 0.0:
+                rate = min(rate, max(self._flush_rate_ewma, 256.0 * 1024))
+            delay = min(0.008, max(batch_bytes, 64) / rate)
             stall_start = time.monotonic()
             self._cond.wait(delay)
         while (
@@ -800,10 +814,18 @@ class DB:
             name = self._new_file_name()
         path = os.path.join(self.path, name)
         source = imms[0] if len(imms) == 1 else _MergedMemView(imms)
+        flushed_bytes = sum(m.approximate_bytes() for m in imms)
+        t0 = time.monotonic()
         self._write_mem_sst(path, source)
+        flush_sec = max(time.monotonic() - t0, 1e-6)
         reader = SSTReader(path)
         max_seq = source.max_seq
         with self._lock:
+            rate = flushed_bytes / flush_sec
+            self._flush_rate_ewma = (
+                rate if self._flush_rate_ewma == 0.0
+                else 0.5 * self._flush_rate_ewma + 0.5 * rate
+            )
             self._readers[name] = reader
             self._levels[0].append(name)
             self._persisted_seq = max(self._persisted_seq, max_seq)

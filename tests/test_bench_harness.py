@@ -1,0 +1,96 @@
+"""bench.py harness behavior: worker lifecycle + CPU baselines.
+
+Round-5 coverage for the driver-facing bench: the abandoned-worker reap
+(r4's driver tail showed a hard exit + 12 leaked semaphores) and the
+multiprocess CPU baseline path (null for three rounds on 1-core hosts;
+BENCH_MP_WORKERS now forces the worker count so the path is provable
+anywhere)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_env(**extra):
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        BENCH_SHARDS="2",
+        BENCH_ENTRIES="2048",
+        BENCH_ITERS="2",
+        BENCH_CLIMB="2",
+        BENCH_TIME_BUDGET="30",
+    )
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def test_multiproc_baseline_forced_workers():
+    """BENCH_MP_WORKERS=2 exercises the fork-pool baseline even on a
+    1-core host (oversubscribed — the number is not meaningful here,
+    only that the path measures and returns)."""
+    env = _bench_env(BENCH_MP_WORKERS="2")
+    code = (
+        "import bench\n"
+        "st = bench.build_inputs()\n"
+        "gbps, cores, workers = bench.bench_numpy_multiproc(st)\n"
+        "print('MP', gbps is not None and gbps > 0, workers)\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "MP True 2" in out.stdout, (out.stdout, out.stderr[-2000:])
+
+
+def test_phase_timeout_abandon_still_reaped():
+    """The phase-timeout path abandons a worker and then nulls
+    worker.proc; _finish must still reap it via the handles captured at
+    abandon() time (regression: the first cut keyed off w.proc and
+    skipped these workers entirely)."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    os.environ["BENCH_WORKER_INIT_DELAY"] = "600"
+    saved = list(bench._TpuWorker._abandoned)
+    try:
+        w = bench._TpuWorker()
+        w.abandon()
+        w.proc = None  # what phase() does after a timeout
+        assert len(bench._TpuWorker._abandoned) == len(saved) + 1
+        proc = bench._TpuWorker._abandoned[-1][0]
+        assert proc.is_alive()
+        bench._finish()  # TERM + join + queue close, no os._exit
+        assert not proc.is_alive()
+    finally:
+        os.environ.pop("BENCH_WORKER_INIT_DELAY", None)
+        bench._TpuWorker._abandoned[:] = saved
+
+
+def test_abandoned_worker_reaped_clean_exit():
+    """A worker that never comes ready is abandoned, then TERM-reaped at
+    exit: rc 0, exactly one JSON line on stdout, and no resource-tracker
+    leak warnings or hard-exit fallback in the driver-visible tail."""
+    env = _bench_env(
+        BENCH_WORKER_INIT_DELAY="600",
+        BENCH_INIT_TIMEOUT="3",
+        BENCH_INIT_RETRY_TIMEOUT="3",
+        BENCH_SALVAGE_WAIT="2",
+    )
+    out = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    parsed = json.loads(lines[0])
+    assert parsed["degraded_no_accelerator"] is True
+    assert "abandoning tpu worker" in out.stderr
+    assert "resource_tracker" not in out.stderr
+    assert "hard exit" not in out.stderr

@@ -1,0 +1,50 @@
+#!/bin/bash
+# Opportunistic TPU-grant capture loop (round 5).
+#
+# The axon pool refused every grant in round 4; the one lever is to keep
+# asking all session and convert a grant into measurements the moment it
+# lands. Each cycle IS the measurement attempt: profile_device both
+# probes the device and, on success, produces the lax/pallas/pallas_fused
+# stage timings round 4 was missing; a success immediately triggers a
+# full bench.py so a complete real-chip headline JSON is persisted even
+# if the grant is gone by the driver's end-of-round run.
+#
+# Discipline (memory: tpu-tunnel-discipline): TERM-based timeouts only —
+# never SIGKILL a process that may hold a tunnel grant.
+set -u
+cd /root/repo
+RES=benchmarks/results
+LOG=$RES/prober_r05.log
+mkdir -p "$RES"
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-2400}   # round-4 failures took ~25 min
+BENCH_TIMEOUT=${BENCH_TIMEOUT:-3600}
+SLEEP_FAIL=${SLEEP_FAIL:-180}
+SLEEP_OK=${SLEEP_OK:-1800}
+
+note() { echo "[prober $(date -u +%H:%M:%S)] $*" >> "$LOG"; }
+
+cycle=0
+note "prober start pid=$$"
+while true; do
+  cycle=$((cycle + 1))
+  ts=$(date -u +%Y%m%dT%H%M%S)
+  note "cycle $cycle: profile_device attempt"
+  if RSTPU_REQUIRE_ACCEL=1 timeout --signal=TERM "$PROBE_TIMEOUT" \
+      python -m benchmarks.profile_device --set pallas \
+      > "$RES/profile_r05_$ts.json" 2>> "$LOG"; then
+    note "cycle $cycle: GRANT — profile saved profile_r05_$ts.json; running bench"
+    touch "$RES/GRANT_SEEN"
+    if timeout --signal=TERM "$BENCH_TIMEOUT" \
+        python bench.py > "$RES/bench_r05_$ts.json" 2>> "$LOG"; then
+      note "cycle $cycle: bench saved bench_r05_$ts.json"
+    else
+      note "cycle $cycle: bench rc=$? (partial output kept)"
+    fi
+    sleep "$SLEEP_OK"
+  else
+    rc=$?
+    rm -f "$RES/profile_r05_$ts.json"
+    note "cycle $cycle: probe failed rc=$rc; sleeping $SLEEP_FAIL"
+    sleep "$SLEEP_FAIL"
+  fi
+done
