@@ -21,8 +21,13 @@ import struct
 import zlib
 from typing import List, Tuple
 
+from ..storage import rlz
+
 MAGIC = 0x5254
 FLAG_PAYLOAD_ZLIB = 1
+# RLZ1 transform (storage/rlz.py): snappy-class speed — the preferred
+# codec when the native module is loaded; receivers always handle both.
+FLAG_PAYLOAD_RLZ = 2
 _HEADER = struct.Struct("<HHII")
 MAX_FRAME_BYTES = 256 * 1024 * 1024
 # payloads in this size band are compressed (WAL batches and other mid-size
@@ -39,11 +44,17 @@ async def write_frame(
     plen = sum(len(c) for c in payload_chunks)
     flags = 0
     if COMPRESS_THRESHOLD <= plen <= COMPRESS_MAX:
-        compressed = zlib.compress(b"".join(payload_chunks), 1)
+        raw = b"".join(payload_chunks)
+        # rlz only with the native codec: the pure-Python encoder would
+        # stall the event loop far longer than zlib's C one
+        if rlz.native_available():
+            compressed, flag = rlz.compress(raw), FLAG_PAYLOAD_RLZ
+        else:
+            compressed, flag = zlib.compress(raw, 1), FLAG_PAYLOAD_ZLIB
         if len(compressed) < plen:
             payload_chunks = [compressed]
             plen = len(compressed)
-            flags |= FLAG_PAYLOAD_ZLIB
+            flags |= flag
     writer.write(_HEADER.pack(MAGIC, flags, len(header), plen))
     writer.write(header)
     for chunk in payload_chunks:
@@ -62,6 +73,10 @@ class FrameReader:
         magic, flags, hlen, plen = _HEADER.unpack(head)
         if magic != MAGIC:
             raise ValueError(f"bad frame magic: {magic:#x}")
+        if flags & ~(FLAG_PAYLOAD_ZLIB | FLAG_PAYLOAD_RLZ):
+            # a transform this reader doesn't know: fail loudly instead
+            # of handing compressed bytes up as a valid payload
+            raise ValueError(f"unknown frame flags: {flags:#x}")
         if hlen + plen > MAX_FRAME_BYTES:
             raise ValueError(f"frame too large: {hlen + plen}")
         body = await self._reader.readexactly(hlen + plen)
@@ -75,4 +90,8 @@ class FrameReader:
             if len(raw) > MAX_FRAME_BYTES or d.unconsumed_tail or d.unused_data:
                 raise ValueError("malformed or oversized compressed frame")
             payload = memoryview(raw)
+        elif flags & FLAG_PAYLOAD_RLZ:
+            # rlz.decompress is bounded by construction (same guard)
+            payload = memoryview(
+                rlz.decompress(bytes(payload), MAX_FRAME_BYTES))
         return header, payload

@@ -86,6 +86,19 @@ class NativeLib:
             self._has_planar = True
         except AttributeError:
             self._has_planar = False
+        # RLZ codec may be absent in stale builds; probe and gate
+        try:
+            lib.rlz_compress.restype = ctypes.c_int64
+            lib.rlz_compress.argtypes = [
+                _u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64,
+            ]
+            lib.rlz_decompress.restype = ctypes.c_int64
+            lib.rlz_decompress.argtypes = [
+                _u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64,
+            ]
+            self.has_rlz = True
+        except AttributeError:
+            self.has_rlz = False
         lib.wal_scan.restype = ctypes.c_int64
         lib.wal_scan.argtypes = [
             _u8p, ctypes.c_uint64, ctypes.c_uint64,
@@ -253,6 +266,38 @@ class NativeLib:
             ],
             bool(past_end.value),
         )
+
+    def rlz_compress(self, data: bytes) -> bytes:
+        from ..rlz import max_compressed_len
+
+        src = (np.frombuffer(data, dtype=np.uint8) if data
+               else np.zeros(1, np.uint8))
+        cap = max_compressed_len(len(data))
+        out = np.empty(cap, dtype=np.uint8)
+        wrote = self._lib.rlz_compress(
+            self._u8(src), len(data), self._u8(out), cap)
+        if wrote < 0:  # sized by max_compressed_len — cannot happen
+            raise ValueError("rlz_compress overflow")
+        return out[:wrote].tobytes()
+
+    def rlz_decompress(self, data: bytes, max_out: int) -> Optional[bytes]:
+        """Decoded bytes, or None on malformed/oversized input (the
+        Python wrapper raises the descriptive error)."""
+        src = (np.frombuffer(data, dtype=np.uint8) if data
+               else np.zeros(1, np.uint8))
+        if len(data) >= 4:
+            declared = int.from_bytes(data[:4], "little")
+            if declared > max_out:
+                return None
+            cap = declared
+        else:
+            return None
+        out = np.empty(max(1, cap), dtype=np.uint8)
+        n = self._lib.rlz_decompress(
+            self._u8(src), len(data), self._u8(out), cap)
+        if n < 0:
+            return None
+        return out[:n].tobytes()
 
     def wal_scan(self, raw: bytes) -> Tuple[List[Tuple[int, int, int]], int]:
         """Returns ([(start_seq, body_off, body_len)], bad_crc_at)."""

@@ -240,6 +240,121 @@ int32_t bloom_may_contain(
   return (words[h1 % num_words] & mask) == mask;
 }
 
+// ---------------------------------------------------------------------------
+// RLZ1 — fast byte codec (LZ4/snappy-class; format owned by storage/rlz.py)
+// ---------------------------------------------------------------------------
+//
+// The reference compresses SST blocks with Snappy/ZSTD and RPC channels
+// with snappy transforms (thrift_client_pool.h:277-284); zlib (the only
+// in-image codec) costs real CPU on the ingest path. RLZ1 is a greedy
+// LZ77 with a depth-1 hash table — single pass, byte-aligned output,
+// decode is a straight copy loop. Format (little-endian):
+//
+//   u32 raw_len
+//   tokens until raw_len bytes are produced:
+//     0x01..0x7F        literal run of <tag> bytes (follow inline)
+//     0x80|L, u16 dist  match: copy L+4 bytes (4..131) from <dist> back
+//                       (1..65535; may overlap itself, copied bytewise)
+//
+// Worst case (incompressible): 4 + n + ceil(n/127) bytes.
+
+static inline uint32_t rlz_hash(uint32_t v) {
+  // Fibonacci multiplicative hash of the next 4 bytes -> table index.
+  return (v * 2654435761u) >> 18;  // 14-bit table
+}
+
+#define RLZ_TABLE_BITS 14
+#define RLZ_MIN_MATCH 4u
+#define RLZ_MAX_MATCH 131u
+#define RLZ_MAX_DIST 65535u
+
+int64_t rlz_compress(const uint8_t* src, uint64_t n,
+                     uint8_t* dst, uint64_t cap) {
+  if (n > 0xFFFFFFFFu) return -1;  // raw_len is a u32 header field
+  if (cap < 4) return -1;
+  put_u32(dst, (uint32_t)n);
+  uint64_t w = 4;
+  uint32_t table[1u << RLZ_TABLE_BITS];
+  for (uint32_t i = 0; i < (1u << RLZ_TABLE_BITS); i++)
+    table[i] = 0xFFFFFFFFu;
+  uint64_t lit_start = 0;
+  uint64_t i = 0;
+
+  // emit pending literals [lit_start, end) in <=127-byte runs
+  #define RLZ_FLUSH_LITS(end)                                    \
+    do {                                                         \
+      uint64_t run = (end) - lit_start;                          \
+      while (run > 0) {                                          \
+        uint64_t take = run > 127 ? 127 : run;                   \
+        if (w + 1 + take > cap) return -1;                       \
+        dst[w++] = (uint8_t)take;                                \
+        memcpy(dst + w, src + lit_start, take);                  \
+        w += take; lit_start += take; run -= take;               \
+      }                                                          \
+    } while (0)
+
+  while (i + RLZ_MIN_MATCH <= n) {
+    uint32_t v = get_u32(src + i);
+    uint32_t h = rlz_hash(v);
+    uint32_t cand = table[h];
+    table[h] = (uint32_t)i;
+    if (cand != 0xFFFFFFFFu && i - cand <= RLZ_MAX_DIST &&
+        get_u32(src + cand) == v) {
+      uint64_t len = RLZ_MIN_MATCH;
+      uint64_t max_len = n - i;
+      if (max_len > RLZ_MAX_MATCH) max_len = RLZ_MAX_MATCH;
+      while (len < max_len && src[cand + len] == src[i + len]) len++;
+      RLZ_FLUSH_LITS(i);
+      if (w + 3 > cap) return -1;
+      dst[w++] = (uint8_t)(0x80u | (len - RLZ_MIN_MATCH));
+      uint32_t dist = (uint32_t)(i - cand);
+      dst[w++] = (uint8_t)(dist & 0xFF);
+      dst[w++] = (uint8_t)(dist >> 8);
+      i += len;
+      lit_start = i;
+      // seed the table at the match tail so back-to-back repeats chain
+      if (i + RLZ_MIN_MATCH <= n)
+        table[rlz_hash(get_u32(src + i - 1))] = (uint32_t)(i - 1);
+    } else {
+      i++;
+    }
+  }
+  RLZ_FLUSH_LITS(n);
+  #undef RLZ_FLUSH_LITS
+  return (int64_t)w;
+}
+
+// Returns decoded length, or -1 on malformed/overflowing input. Never
+// reads past src+n or writes past dst+cap regardless of input bytes.
+int64_t rlz_decompress(const uint8_t* src, uint64_t n,
+                       uint8_t* dst, uint64_t cap) {
+  if (n < 4) return -1;
+  uint64_t raw_len = get_u32(src);
+  if (raw_len > cap) return -1;
+  uint64_t r = 4, w = 0;
+  while (w < raw_len) {
+    if (r >= n) return -1;
+    uint8_t tag = src[r++];
+    if (tag & 0x80u) {
+      uint64_t len = (tag & 0x7Fu) + RLZ_MIN_MATCH;
+      if (r + 2 > n) return -1;
+      uint32_t dist = (uint32_t)src[r] | ((uint32_t)src[r + 1] << 8);
+      r += 2;
+      if (dist == 0 || dist > w || w + len > raw_len) return -1;
+      // bytewise: matches may overlap their own output (run encoding)
+      for (uint64_t k = 0; k < len; k++, w++) dst[w] = dst[w - dist];
+    } else {
+      if (tag == 0) return -1;
+      uint64_t take = tag;
+      if (r + take > n || w + take > raw_len) return -1;
+      memcpy(dst + w, src + r, take);
+      r += take;
+      w += take;
+    }
+  }
+  return (int64_t)w;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------

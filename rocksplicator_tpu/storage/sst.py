@@ -30,6 +30,7 @@ import struct
 import zlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from . import rlz
 from .bloom import BloomFilter
 from .errors import Corruption, InvalidArgument
 from .records import OpType
@@ -48,6 +49,11 @@ COMPRESSION_ZLIB = 1
 # nibble selects decoding per block.
 BLOCK_PLANAR = 2
 BLOCK_PLANAR_ZLIB = 3
+# RLZ1 (storage/rlz.py + native rlz_compress): the fast owned codec —
+# snappy-class speed for the ingest path where zlib's CPU cost bites
+# (the reference's Snappy/ZSTD block compression analog)
+COMPRESSION_RLZ = 4
+BLOCK_PLANAR_RLZ = 5
 
 # bytes per entry besides key+value: u32 klen, u64 seq, u8 vtype, u32 vlen
 ENTRY_FIXED_OVERHEAD = _ENTRY_HEAD.size + _ENTRY_META.size
@@ -164,7 +170,12 @@ class SSTWriter:
         else:
             raw = b"".join(_encode_entry(*e) for e in self._block)
         codec = self._compression
-        payload = zlib.compress(raw, 1) if codec == COMPRESSION_ZLIB else raw
+        if codec == COMPRESSION_ZLIB:
+            payload = zlib.compress(raw, 1)
+        elif codec == COMPRESSION_RLZ:
+            payload = rlz.compress(raw)
+        else:
+            payload = raw
         if len(payload) >= len(raw):
             codec, payload = COMPRESSION_NONE, raw
         assert self._last_key is not None
@@ -292,15 +303,26 @@ class SSTReader:
     def _read_block(self, block_idx: int) -> bytes:
         _last_key, off, size, codec = self._index[block_idx]
         payload = os.pread(self._fd, size, off)
-        raw = (
-            zlib.decompress(payload)
-            if codec in (COMPRESSION_ZLIB, BLOCK_PLANAR_ZLIB) else payload
-        )
+        if codec in (COMPRESSION_ZLIB, BLOCK_PLANAR_ZLIB):
+            raw = zlib.decompress(payload)
+        elif codec in (COMPRESSION_RLZ, BLOCK_PLANAR_RLZ):
+            # bound: a block decodes to at most a handful of block_bytes
+            # (the writer flushes at the threshold); 64 MiB is far above
+            # any legitimate block and guards a crafted header
+            raw = rlz.decompress(payload, 64 << 20)
+        elif codec in (COMPRESSION_NONE, BLOCK_PLANAR):
+            raw = payload
+        else:
+            # a file from a newer writer (future codec) must fail LOUDLY,
+            # not parse compressed bytes as entries
+            raise Corruption(
+                f"unsupported block codec {codec} (newer writer?)")
         self._verify_block_chk(block_idx, raw)
         return raw
 
     def _block_is_planar(self, block_idx: int) -> bool:
-        return self._index[block_idx][3] in (BLOCK_PLANAR, BLOCK_PLANAR_ZLIB)
+        return self._index[block_idx][3] in (
+            BLOCK_PLANAR, BLOCK_PLANAR_ZLIB, BLOCK_PLANAR_RLZ)
 
     def _verify_block_chk(self, block_idx: int, raw: bytes) -> None:
         """Device-computed per-block integrity checksums (props

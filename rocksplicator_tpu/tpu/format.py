@@ -18,7 +18,10 @@ import numpy as np
 from ..storage.bloom import BloomFilter
 from ..storage.planar import (decode_planar_block, encode_planar_block,
                               plane_words, planar_props)
-from ..storage.sst import (BLOCK_PLANAR, BLOCK_PLANAR_ZLIB, COMPRESSION_ZLIB,
+from ..storage import rlz
+from ..storage.sst import (BLOCK_PLANAR, BLOCK_PLANAR_RLZ,
+                           BLOCK_PLANAR_ZLIB, COMPRESSION_RLZ,
+                           COMPRESSION_ZLIB,
                            ENTRY_FIXED_OVERHEAD, SSTWriter)
 from ..utils.checksum import poly_checksum_words
 
@@ -239,6 +242,10 @@ def _write_planar(
                 z = zlib.compress(raw, 1)
                 if len(z) < len(raw):
                     codec, payload = BLOCK_PLANAR_ZLIB, z
+            elif compression == COMPRESSION_RLZ:
+                z = rlz.compress(raw)
+                if len(z) < len(raw):
+                    codec, payload = BLOCK_PLANAR_RLZ, z
             writer.add_encoded_block(
                 payload,
                 last_key=key_bytes[end - 1].tobytes(),
@@ -360,7 +367,12 @@ def write_sst_from_arrays(
             else:
                 raw = encode_uniform_block(arrays, start, end, klen, vlen)
             codec = compression
-            payload = zlib.compress(raw, 1) if codec == COMPRESSION_ZLIB else raw
+            if codec == COMPRESSION_ZLIB:
+                payload = zlib.compress(raw, 1)
+            elif codec == COMPRESSION_RLZ:
+                payload = rlz.compress(raw)
+            else:
+                payload = raw
             if len(payload) >= len(raw):
                 codec, payload = 0, raw
             writer.add_encoded_block(
@@ -372,7 +384,8 @@ def write_sst_from_arrays(
                 max_key=key_bytes[end - 1].tobytes(),
                 min_seq=int(seqs[start:end].min()),
                 max_seq=int(seqs[start:end].max()),
-                compressed=codec == COMPRESSION_ZLIB,
+                compressed=False,
+                codec=codec,
             )
         bloom = None
         if bloom_words is not None:

@@ -25,6 +25,27 @@ w.add(b"zzz-merge", 201, OpType.MERGE, b"\x02\x00\x00\x00\x00\x00\x00\x00")
 props = w.finish(extra_props={"golden": "v1"})
 print("tsst props:", props)
 
+# golden RLZ1 blob + RLZ-compressed TSST (round 5: the fast codec must
+# stay decodable forever, whatever happens to the encoder's match finder)
+from rocksplicator_tpu.storage import rlz
+from rocksplicator_tpu.storage.sst import COMPRESSION_RLZ
+
+RLZ_PLAINTEXT = (
+    b"".join(f"row{i:06d}:payload-{i % 97:04d};".encode() for i in range(3000))
+    + bytes(range(256)) * 8
+)
+with open(os.path.join(here, "golden_rlz_v1.bin"), "wb") as f:
+    f.write(rlz.compress(RLZ_PLAINTEXT))
+print("rlz blob:", len(RLZ_PLAINTEXT), "->",
+      os.path.getsize(os.path.join(here, "golden_rlz_v1.bin")))
+
+wr = SSTWriter(os.path.join(here, "golden_rlz_v1.tsst"), block_bytes=256,
+               compression=COMPRESSION_RLZ)
+for i in range(100):
+    wr.add(f"key{i:04d}".encode(), i + 1, OpType.PUT,
+           f"value-{i}".encode() * 3)
+print("rlz tsst props:", wr.finish(extra_props={"golden": "rlz-v1"}))
+
 # golden WAL segment
 wal_dir = os.path.join(here, "golden_wal_v1")
 os.makedirs(wal_dir, exist_ok=True)

@@ -1,0 +1,248 @@
+"""RLZ1 fast codec: format, parity, golden stability, and integration.
+
+Reference capability: RocksDB block compression (Snappy/ZSTD) + the
+thrift channel transforms (common/thrift_client_pool.h:277-284). RLZ1 is
+the owned equivalent; these tests pin the format (golden blob + golden
+TSST), prove native<->python parity in both directions, and exercise the
+two integration seams (TSST block codec, RPC frame transform)."""
+
+import asyncio
+import os
+import random
+import zlib
+
+import pytest
+
+from rocksplicator_tpu.storage import rlz
+from rocksplicator_tpu.storage.records import OpType, WriteBatch
+from rocksplicator_tpu.storage.sst import (
+    COMPRESSION_RLZ,
+    SSTReader,
+    SSTWriter,
+)
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+def _cases():
+    random.seed(1234)
+    return [
+        b"",
+        b"x",
+        b"abc",
+        b"abcd" * 2048,
+        random.randbytes(64 * 1024),          # incompressible
+        b"the quick brown fox " * 1000,       # long-range repeats
+        bytes(random.choices(b"ab", k=4096)), # short-range repeats
+        b"\x00" * 100_000,                    # maximal run (overlap copies)
+        random.randbytes(3) * 50_000,         # period < MIN_MATCH
+    ]
+
+
+def test_roundtrip_python_impl():
+    for c in _cases():
+        comp = rlz._py_compress(c)
+        assert rlz._py_decompress(comp, len(c)) == c
+
+
+@pytest.mark.skipif(not rlz.native_available(), reason="native codec absent")
+def test_roundtrip_native_and_cross_parity():
+    lib = rlz._native()
+    for c in _cases():
+        n_comp = lib.rlz_compress(c)
+        assert lib.rlz_decompress(n_comp, len(c)) == c
+        # either encoder's output decodes on the other side
+        assert rlz._py_decompress(n_comp, len(c)) == c
+        assert lib.rlz_decompress(rlz._py_compress(c), len(c)) == c
+
+
+def test_bounded_decompress_rejects_oversize_and_malformed():
+    comp = rlz.compress(b"hello world, hello world, hello")
+    with pytest.raises(ValueError):
+        rlz._py_decompress(comp, 5)  # declared length over the cap
+    with pytest.raises(ValueError):
+        rlz._py_decompress(b"\x01\x02", 100)  # truncated header
+    # match before start of output
+    bad = (10).to_bytes(4, "little") + bytes([0x80, 0x05, 0x00])
+    with pytest.raises(ValueError):
+        rlz._py_decompress(bad, 100)
+    if rlz.native_available():
+        lib = rlz._native()
+        assert lib.rlz_decompress(comp, 5) is None
+        assert lib.rlz_decompress(b"\x01\x02", 100) is None
+        assert lib.rlz_decompress(bad, 100) is None
+
+
+def test_golden_rlz_blob_decodes():
+    """The checked-in blob was written by the round-5 encoder; every
+    future decoder must keep reading it byte-for-byte."""
+    expected = (
+        b"".join(f"row{i:06d}:payload-{i % 97:04d};".encode()
+                 for i in range(3000))
+        + bytes(range(256)) * 8
+    )
+    with open(os.path.join(DATA, "golden_rlz_v1.bin"), "rb") as f:
+        blob = f.read()
+    assert rlz._py_decompress(blob, len(expected)) == expected
+    if rlz.native_available():
+        assert rlz._native().rlz_decompress(blob, len(expected)) == expected
+
+
+def test_golden_rlz_tsst_readable():
+    r = SSTReader(os.path.join(DATA, "golden_rlz_v1.tsst"))
+    try:
+        assert r.props["golden"] == "rlz-v1"
+        assert r.get(b"key0042") == (43, OpType.PUT, b"value-42" * 3)
+        assert sum(1 for _ in r.iterate()) == 100
+    finally:
+        r.close()
+
+
+def test_sst_rlz_roundtrip(tmp_path):
+    path = str(tmp_path / "t.tsst")
+    w = SSTWriter(path, block_bytes=512, compression=COMPRESSION_RLZ)
+    for i in range(500):
+        w.add(f"k{i:05d}".encode(), i + 1, OpType.PUT,
+              f"v{i % 13}".encode() * 10)
+    w.finish()
+    r = SSTReader(path)
+    try:
+        for i in (0, 250, 499):
+            assert r.get(f"k{i:05d}".encode()) == (
+                i + 1, OpType.PUT, f"v{i % 13}".encode() * 10)
+        assert sum(1 for _ in r.iterate()) == 500
+    finally:
+        r.close()
+
+
+def test_engine_db_with_rlz_compression(tmp_path):
+    from rocksplicator_tpu.storage import DB, DBOptions
+
+    db = DB(str(tmp_path / "db"),
+            DBOptions(memtable_bytes=16 * 1024, compression=COMPRESSION_RLZ))
+    try:
+        for i in range(2000):
+            db.put(f"k{i:06d}".encode(), f"val-{i}".encode() * 4)
+        db.flush()
+        for i in (0, 999, 1999):
+            assert db.get(f"k{i:06d}".encode()) == f"val-{i}".encode() * 4
+    finally:
+        db.close()
+
+
+def test_frame_transform_rlz_roundtrip():
+    """write_frame picks the rlz transform (native present) above the
+    compression threshold; FrameReader transparently restores it."""
+    from rocksplicator_tpu.rpc import framing
+
+    payload = b"".join(
+        f"batch-{i:05d}:".encode() + b"x" * 40 for i in range(500)
+    )
+    assert len(payload) >= framing.COMPRESS_THRESHOLD
+
+    async def go():
+        server_got = {}
+
+        async def on_conn(reader, writer):
+            fr = framing.FrameReader(reader)
+            h, p = await fr.read_frame()
+            server_got["header"] = bytes(h)
+            server_got["payload"] = bytes(p)
+            writer.close()
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        await framing.write_frame(writer, b'{"m":1}', [payload])
+        await asyncio.sleep(0.1)
+        writer.close()
+        server.close()
+        await server.wait_closed()
+        return server_got
+
+    got = asyncio.run(go())
+    assert got["header"] == b'{"m":1}'
+    assert got["payload"] == payload
+
+
+def test_unknown_block_codec_rejected(tmp_path):
+    """A TSST block with a codec byte this reader doesn't know must fail
+    loudly (Corruption), not parse compressed bytes as entries."""
+    from rocksplicator_tpu.storage.errors import Corruption
+
+    path = str(tmp_path / "t.tsst")
+    w = SSTWriter(path, compression=COMPRESSION_RLZ)
+    w.add(b"k1", 1, OpType.PUT, b"v" * 600)  # compressible -> rlz sticks
+    w.finish()
+    r = SSTReader(path)
+    try:
+        assert r._index[0][3] == COMPRESSION_RLZ
+        r._index[0] = (r._index[0][0], r._index[0][1], r._index[0][2], 99)
+        with pytest.raises(Corruption):
+            r.get(b"k1")
+    finally:
+        r.close()
+
+
+def test_unknown_frame_flags_rejected():
+    from rocksplicator_tpu.rpc import framing
+
+    async def go():
+        result = {}
+
+        async def on_conn(reader, writer):
+            fr = framing.FrameReader(reader)
+            try:
+                await fr.read_frame()
+            except ValueError as e:
+                result["err"] = str(e)
+            writer.close()
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        _r, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(framing._HEADER.pack(framing.MAGIC, 0x8, 2, 3))
+        writer.write(b"{}zzz")
+        await writer.drain()
+        await asyncio.sleep(0.1)
+        writer.close()
+        server.close()
+        await server.wait_closed()
+        return result
+
+    got = asyncio.run(go())
+    assert "unknown frame flags" in got.get("err", "")
+
+
+def test_frame_zlib_still_readable():
+    """Old peers send zlib frames; the reader keeps handling the flag."""
+    from rocksplicator_tpu.rpc import framing
+
+    raw = b"legacy" * 2000
+
+    async def go():
+        results = {}
+
+        async def on_conn(reader, writer):
+            fr = framing.FrameReader(reader)
+            _h, p = await fr.read_frame()
+            results["payload"] = bytes(p)
+            writer.close()
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        _r, writer = await asyncio.open_connection("127.0.0.1", port)
+        comp = zlib.compress(raw, 1)
+        writer.write(framing._HEADER.pack(
+            framing.MAGIC, framing.FLAG_PAYLOAD_ZLIB, 2, len(comp)))
+        writer.write(b"{}")
+        writer.write(comp)
+        await writer.drain()
+        await asyncio.sleep(0.1)
+        writer.close()
+        server.close()
+        await server.wait_closed()
+        return results
+
+    got = asyncio.run(go())
+    assert got["payload"] == raw
