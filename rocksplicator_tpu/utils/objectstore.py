@@ -305,13 +305,18 @@ def build_object_store(
     uri: str, rate_limit_bytes_per_sec: Optional[float] = None
 ) -> ObjectStore:
     """``local:///path`` or bare ``/path`` → LocalObjectStore; ``s3://bucket``
-    → S3ObjectStore. Cached by (uri, ratelimit) like BuildS3Util."""
+    → S3ObjectStore; ``hdfs://namenode:port/base`` → HdfsObjectStore
+    (WebHDFS). Cached by (uri, ratelimit) like BuildS3Util."""
     key = (uri, rate_limit_bytes_per_sec)
     with _store_cache_lock:
         store = _store_cache.get(key)
         if store is None:
             if uri.startswith("s3://"):
                 store = S3ObjectStore(uri[5:], rate_limit_bytes_per_sec)
+            elif uri.startswith("hdfs://"):
+                from .hdfs import HdfsObjectStore
+
+                store = HdfsObjectStore(uri, rate_limit_bytes_per_sec)
             elif uri.startswith("local://"):
                 store = LocalObjectStore(uri[8:], rate_limit_bytes_per_sec)
             else:

@@ -1,0 +1,178 @@
+"""HDFS object store (WebHDFS) against a stub namenode/datanode.
+
+Reference: backupDB/restoreDB over NewHdfsEnv
+(rocksdb_admin/admin_handler.cpp:696-863). The stub speaks enough
+WebHDFS to exercise the real client code paths, including the
+namenode->datanode 307 redirect dance for CREATE and OPEN."""
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from rocksplicator_tpu.utils.hdfs import HdfsError, HdfsObjectStore
+from rocksplicator_tpu.utils.objectstore import build_object_store
+
+
+class _StubWebHdfs(BaseHTTPRequestHandler):
+    """In-memory WebHDFS: files is a dict path -> bytes. The first
+    CREATE/OPEN hit (no `redirected` param) answers 307 to the same
+    server — mirroring the namenode -> datanode hop."""
+
+    files = {}
+    lock = threading.Lock()
+    # HttpFS-gateway mode: answer CREATE/OPEN directly, no datanode hop
+    direct_mode = False
+
+    def log_message(self, *a):
+        pass
+
+    def _parse(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        assert parsed.path.startswith("/webhdfs/v1")
+        q = dict(urllib.parse.parse_qsl(parsed.query))
+        return urllib.parse.unquote(parsed.path[len("/webhdfs/v1"):]), q
+
+    def _redirect(self):
+        self.send_response(307)
+        host, port = self.server.server_address[:2]
+        self.send_header(
+            "Location", f"http://{host}:{port}{self.path}&redirected=1")
+        self.end_headers()
+
+    def _json(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        path, q = self._parse()
+        if q.get("op") == "MKDIRS":
+            return self._json({"boolean": True})
+        assert q.get("op") == "CREATE"
+        if "redirected" not in q and not _StubWebHdfs.direct_mode:
+            return self._redirect()
+        n = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(n)
+        with self.lock:
+            _StubWebHdfs.files[path] = data
+        self.send_response(201)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        path, q = self._parse()
+        if q.get("op") == "OPEN":
+            if "redirected" not in q and not _StubWebHdfs.direct_mode:
+                return self._redirect()
+            with self.lock:
+                data = _StubWebHdfs.files.get(path)
+            if data is None:
+                return self._json({"RemoteException": {
+                    "exception": "FileNotFoundException"}}, 404)
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        assert q.get("op") == "LISTSTATUS"
+        with self.lock:
+            if path in _StubWebHdfs.files:     # LISTSTATUS of a file
+                statuses = [{"pathSuffix": "", "type": "FILE",
+                             "length": len(_StubWebHdfs.files[path])}]
+            else:
+                prefix = path.rstrip("/") + "/"
+                children = {}
+                for p, data in _StubWebHdfs.files.items():
+                    if not p.startswith(prefix):
+                        continue
+                    rest = p[len(prefix):]
+                    if "/" in rest:
+                        children[rest.split("/", 1)[0]] = ("DIRECTORY", 0)
+                    else:
+                        children[rest] = ("FILE", len(data))
+                if not children:
+                    return self._json({"RemoteException": {
+                        "exception": "FileNotFoundException"}}, 404)
+                statuses = [
+                    {"pathSuffix": name, "type": typ, "length": ln}
+                    for name, (typ, ln) in sorted(children.items())
+                ]
+        self._json({"FileStatuses": {"FileStatus": statuses}})
+
+    def do_DELETE(self):
+        path, q = self._parse()
+        assert q.get("op") == "DELETE"
+        with self.lock:
+            existed = _StubWebHdfs.files.pop(path, None) is not None
+        self._json({"boolean": existed})
+
+
+@pytest.fixture()
+def hdfs_store():
+    _StubWebHdfs.files = {}
+    _StubWebHdfs.direct_mode = False
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubWebHdfs)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    port = srv.server_address[1]
+    yield HdfsObjectStore(f"hdfs://127.0.0.1:{port}/backups")
+    srv.shutdown()
+
+
+def test_direct_answer_gateway_does_not_drop_body(hdfs_store):
+    """HttpFS gateways / noredirect namenodes answer CREATE directly
+    with 2xx. Per spec the client sends no body on the first hop — it
+    must detect the direct answer and re-issue WITH the data, or the
+    upload is silently zero bytes."""
+    _StubWebHdfs.direct_mode = True
+    hdfs_store.put_object_bytes("direct/file", b"payload-bytes")
+    assert hdfs_store.get_object_bytes("direct/file") == b"payload-bytes"
+
+
+def test_put_get_roundtrip_via_redirect(hdfs_store):
+    hdfs_store.put_object_bytes("db1/MANIFEST", b"manifest-bytes")
+    assert hdfs_store.get_object_bytes("db1/MANIFEST") == b"manifest-bytes"
+    # overwrite
+    hdfs_store.put_object_bytes("db1/MANIFEST", b"v2")
+    assert hdfs_store.get_object_bytes("db1/MANIFEST") == b"v2"
+
+
+def test_list_delete_copy(hdfs_store):
+    hdfs_store.put_object_bytes("db1/000001.sst", b"a" * 100)
+    hdfs_store.put_object_bytes("db1/sub/000002.sst", b"b" * 100)
+    hdfs_store.put_object_bytes("db2/CURRENT", b"c")
+    assert hdfs_store.list_objects("db1") == [
+        "db1/000001.sst", "db1/sub/000002.sst"]
+    hdfs_store.copy_object("db2/CURRENT", "db1/CURRENT")
+    assert hdfs_store.get_object_bytes("db1/CURRENT") == b"c"
+    hdfs_store.delete_object("db1/000001.sst")
+    assert hdfs_store.list_objects("db1") == [
+        "db1/CURRENT", "db1/sub/000002.sst"]
+
+
+def test_file_transfer_and_batch(hdfs_store, tmp_path):
+    src = tmp_path / "seg.sst"
+    src.write_bytes(b"x" * 4096)
+    hdfs_store.put_object(str(src), "up/seg.sst")
+    dst = tmp_path / "back.sst"
+    hdfs_store.get_object("up/seg.sst", str(dst))
+    assert dst.read_bytes() == b"x" * 4096
+    # batch download through the shared ObjectStore plumbing
+    out = hdfs_store.get_objects("up", str(tmp_path / "batch"))
+    assert len(out) == 1 and out[0].endswith("seg.sst")
+
+
+def test_missing_object_raises(hdfs_store):
+    with pytest.raises(HdfsError):
+        hdfs_store.get_object_bytes("nope/missing")
+    assert hdfs_store.list_objects("nope") == []
+
+
+def test_build_object_store_routes_hdfs():
+    store = build_object_store("hdfs://127.0.0.1:19999/base")
+    assert isinstance(store, HdfsObjectStore)

@@ -1,0 +1,257 @@
+"""Kafka binary wire protocol: codec vectors, golden frames, interop.
+
+Proves the real-broker interop path (kafka/wire.py) without a broker
+binary in CI: primitive encodings against known vectors, record-batch v2
+golden bytes, and the KafkaWireConsumer driven over real TCP against the
+KafkaWireBroker front end — including the same replay-then-tail watcher
+scenario the embedded backend passes (reference
+common/kafka/kafka_consumer.h:27-118, kafka_watcher.cpp:141-350)."""
+
+import time
+
+import pytest
+
+from rocksplicator_tpu.kafka.broker import MockKafkaCluster
+from rocksplicator_tpu.kafka.watcher import KafkaWatcher
+from rocksplicator_tpu.kafka.wire import (
+    KafkaWireBroker,
+    KafkaWireConsumer,
+    crc32c,
+    decode_record_batches,
+    decode_varint,
+    encode_record_batch,
+    encode_varint,
+)
+
+
+def wait_until(pred, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- primitives -------------------------------------------------------------
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / public CRC-32C test vectors
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0x0
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_varint_zigzag_vectors():
+    # Kafka varints are zigzag LEB128 (protobuf sint semantics)
+    for value, wire in [
+        (0, b"\x00"), (-1, b"\x01"), (1, b"\x02"), (-2, b"\x03"),
+        (63, b"\x7e"), (64, b"\x80\x01"), (-64, b"\x7f"),
+        (300, b"\xd8\x04"),
+    ]:
+        assert encode_varint(value) == wire, value
+        decoded, pos = decode_varint(wire, 0)
+        assert (decoded, pos) == (value, len(wire))
+
+
+def test_record_batch_roundtrip_and_crc_guard():
+    records = [(1000, b"k1", b"v1"), (1005, b"k2", b"longer-value" * 9),
+               (1010, None, b"null-key")]
+    batch = encode_record_batch(41, records)
+    out = decode_record_batches(batch)
+    assert out == [
+        (41, 1000, b"k1", b"v1"),
+        (42, 1005, b"k2", b"longer-value" * 9),
+        (43, 1010, None, b"null-key"),
+    ]
+    # flip one payload byte: CRC-32C must catch it
+    corrupt = bytearray(batch)
+    corrupt[-1] ^= 0x40
+    with pytest.raises(ValueError, match="CRC"):
+        decode_record_batches(bytes(corrupt))
+
+
+def test_record_batch_golden_bytes():
+    """Golden frame: the v2 batch layout must never drift (offsets,
+    varints, CRC placement are all visible in these bytes)."""
+    batch = encode_record_batch(7, [(1500, b"key", b"value")])
+    assert batch.hex() == (
+        "0000000000000007"  # base_offset = 7
+        "00000040"          # batch_length = 64 (epoch+magic+crc+body)
+        "00000000"          # partition_leader_epoch
+        "02"                # magic = 2
+        "defd924f"          # crc32c of the remainder
+        "0000"              # attributes (no compression)
+        "00000000"          # last_offset_delta
+        "00000000000005dc"  # first_timestamp = 1500
+        "00000000000005dc"  # max_timestamp
+        "ffffffffffffffff"  # producer_id = -1
+        "ffff"              # producer_epoch = -1
+        "ffffffff"          # base_sequence = -1
+        "00000001"          # record count
+        "1c"                # record length = 14 (zigzag varint)
+        "00"                # record attributes
+        "00"                # timestamp_delta = 0
+        "00"                # offset_delta = 0
+        "06" "6b6579"       # key_len=3 (zigzag), "key"
+        "0a" "76616c7565"   # val_len=5 (zigzag), "value"
+        "00"                # headers = 0
+    )
+    # the CRC in the golden bytes is itself verified here: decode checks it
+    assert decode_record_batches(batch) == [(7, 1500, b"key", b"value")]
+
+
+def test_partial_trailing_batch_tolerated():
+    batch = encode_record_batch(0, [(1, b"a", b"b"), (2, b"c", b"d")])
+    # a fetch response may truncate the last batch mid-frame
+    assert decode_record_batches(batch + batch[: len(batch) // 2]) == \
+        decode_record_batches(batch)
+
+
+# -- wire interop -----------------------------------------------------------
+
+@pytest.fixture()
+def wire_pair():
+    cluster = MockKafkaCluster()
+    cluster.create_topic("t", 2)
+    broker = KafkaWireBroker(cluster)
+    consumers = []
+
+    def make_consumer(group="g1"):
+        c = KafkaWireConsumer("127.0.0.1", broker.port, group_id=group)
+        consumers.append(c)
+        return c
+
+    yield cluster, broker, make_consumer
+    for c in consumers:
+        c.close()
+    broker.stop()
+
+
+def test_wire_handshake_and_metadata(wire_pair):
+    cluster, _broker, make_consumer = wire_pair
+    c = make_consumer()
+    assert c.api_versions[1][1] >= 4      # Fetch v4 advertised
+    assert c.partitions_for("t") == 2
+    with pytest.raises(KeyError):
+        c.partitions_for("nope")
+
+
+def test_wire_produce_consume_roundtrip(wire_pair):
+    cluster, _broker, make_consumer = wire_pair
+    for i in range(10):
+        cluster.produce("t", i % 2, f"k{i}".encode(), f"v{i}".encode(),
+                        timestamp_ms=5000 + i)
+    c = make_consumer()
+    c.assign("t", [0, 1])
+    got = {}
+    for _ in range(10):
+        m = c.consume(5.0)
+        assert m is not None
+        got[m.key] = (m.value, m.partition, m.offset, m.timestamp_ms)
+    assert got[b"k3"] == (b"v3", 1, 1, 5003)
+    assert c.consume(0.2) is None         # drained
+    assert c.position(0) == 5 and c.position(1) == 5
+    assert c.high_watermark(0) == 5
+
+
+def test_wire_timestamp_seek(wire_pair):
+    cluster, _broker, make_consumer = wire_pair
+    for i in range(6):
+        cluster.produce("t", 0, f"k{i}".encode(), b"v",
+                        timestamp_ms=1000 + 10 * i)
+    c = make_consumer()
+    c.assign("t", [0])
+    c.seek_to_timestamp(1025)             # first ts >= 1025 is k3 @1030
+    m = c.consume(5.0)
+    assert m.key == b"k3" and m.offset == 3
+
+
+def test_wire_commit_recovery(wire_pair):
+    cluster, _broker, make_consumer = wire_pair
+    for i in range(4):
+        cluster.produce("t", 0, f"k{i}".encode(), b"v")
+    c1 = make_consumer("grp")
+    c1.assign("t", [0])
+    assert c1.consume(5.0).key == b"k0"
+    assert c1.consume(5.0).key == b"k1"
+    c1.commit()
+    c1.close()
+    c2 = make_consumer("grp")
+    c2.assign("t", [0])
+    committed = c2.committed_offsets()
+    assert committed == {0: 2}
+    c2.seek(0, committed[0])
+    assert c2.consume(5.0).key == b"k2"
+
+
+def test_wire_blocking_fetch_long_poll(wire_pair):
+    cluster, _broker, make_consumer = wire_pair
+    c = make_consumer()
+    c.assign("t", [0])
+    result = {}
+
+    import threading
+
+    def bg():
+        result["msg"] = c.consume(10.0)
+
+    t = threading.Thread(target=bg)
+    t.start()
+    time.sleep(0.3)                       # consumer parked in long poll
+    cluster.produce("t", 0, b"late", b"v")
+    t.join(10.0)
+    assert result["msg"] is not None and result["msg"].key == b"late"
+
+
+def test_wire_offset_out_of_range_raises(wire_pair):
+    """A broker error on fetch must surface (not wedge consume() in an
+    empty-poll loop): seek far past the high watermark and fetch."""
+    from rocksplicator_tpu.kafka.wire import KafkaWireError
+
+    cluster, _broker, make_consumer = wire_pair
+    cluster.produce("t", 0, b"k", b"v")
+    c = make_consumer()
+    c.assign("t", [0])
+    c.seek(0, 999)
+    with pytest.raises(KafkaWireError) as ei:
+        c.consume(1.0)
+    assert ei.value.error_code == 1 and ei.value.partition == 0
+    assert ei.value.high_watermark == 1
+    c.seek(0, 0)  # reseek using the surfaced watermark context
+    assert c.consume(5.0).key == b"k"
+
+
+def test_wire_broker_survives_bad_partition_fetch(wire_pair):
+    """Unknown partitions get error entries; the connection (and broker)
+    stay healthy for subsequent requests."""
+    cluster, _broker, make_consumer = wire_pair
+    from rocksplicator_tpu.kafka.wire import KafkaWireError
+
+    c = make_consumer()
+    c.assign("t", [7])  # topic t has 2 partitions
+    with pytest.raises(KafkaWireError) as ei:
+        c.consume(0.5)
+    assert ei.value.error_code == 3
+    # same connection still serves valid requests
+    cluster.produce("t", 0, b"after", b"v")
+    c.assign("t", [0])
+    assert c.consume(5.0).key == b"after"
+
+
+def test_watcher_replay_then_live_over_wire(wire_pair):
+    """The exact embedded-backend watcher scenario, over the wire."""
+    cluster, _broker, make_consumer = wire_pair
+    for i in range(5):
+        cluster.produce("t", 0, f"old{i}".encode(), b"v",
+                        timestamp_ms=1000 + i)
+    seen = []
+    watcher = KafkaWatcher(
+        "w", make_consumer(), "t", [0], start_timestamp_ms=1002,
+        on_message=lambda m, replay: seen.append((m.key, replay)),
+    ).start()
+    assert wait_until(lambda: watcher.replay_done.is_set())
+    assert seen == [(b"old2", True), (b"old3", True), (b"old4", True)]
+    cluster.produce("t", 0, b"live1", b"v")
+    assert wait_until(lambda: (b"live1", False) in seen)
+    watcher.stop()
