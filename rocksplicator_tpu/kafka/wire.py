@@ -291,6 +291,11 @@ def decode_record_batches(buf: bytes) -> List[Tuple[int, int, Optional[bytes], b
             raise ValueError(
                 f"kafka batch: compression codec {attributes & 7} "
                 f"not supported")
+        if attributes & 0x20:
+            # control batch (transaction COMMIT/ABORT markers): its
+            # records are protocol metadata, never application data
+            pos = end
+            continue
         r.i32()                      # lastOffsetDelta
         first_ts = r.i64()
         r.i64()                      # maxTimestamp
@@ -405,6 +410,12 @@ class KafkaWireBroker:
     def _dispatch(self, api_key: int, version: int, r: _R) -> _W:
         lo_hi = _SUPPORTED.get(api_key)
         if lo_hi is None or not lo_hi[0] <= version <= lo_hi[1]:
+            if api_key == API_API_VERSIONS:
+                # protocol convention: an unsupported ApiVersions version
+                # still gets error 35 PLUS the supported-versions array
+                # (in the v0 shape) so the client can fall back to v0 —
+                # modern clients open with v3+ and need this to connect
+                return self._api_versions(error=35)
             # UNSUPPORTED_VERSION (35) in the shape of the closest body
             return _W().i16(35)
         if api_key == API_API_VERSIONS:
@@ -419,8 +430,8 @@ class KafkaWireBroker:
             return self._offset_commit(r)
         return self._offset_fetch(r)
 
-    def _api_versions(self) -> _W:
-        w = _W().i16(ERR_NONE).i32(len(_SUPPORTED))
+    def _api_versions(self, error: int = ERR_NONE) -> _W:
+        w = _W().i16(error).i32(len(_SUPPORTED))
         for key, (lo, hi) in sorted(_SUPPORTED.items()):
             w.i16(key).i16(lo).i16(hi)
         return w

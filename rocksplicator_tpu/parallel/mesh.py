@@ -160,6 +160,24 @@ def sharded_compaction_step(mesh, model=None):
                 kw, kl, v, num_words=model.num_bloom_words
             )
         )(final["key_words_le"], final["key_len"], out_valid)
+        if model.emit_planar:
+            # production sink format on-device, per shard (the same
+            # encode model.forward emits single-chip): plane words +
+            # word-domain checksums for every planar block
+            from ..ops.block_encode import (encode_planar_words_tpu,
+                                            planar_checksums_tpu)
+
+            planar = jax.vmap(
+                lambda kwb, shi, slo, vt, vw: encode_planar_words_tpu(
+                    kwb, shi, slo, vt, vw,
+                    klen=model.row_klen, vlen=model.row_vlen,
+                    seq32=model.seq32,
+                    block_entries=model.planar_block_entries,
+                )
+            )(final["key_words_be"], final["seq_hi"], final["seq_lo"],
+              final["vtype"], final["val_words"])
+            final["planar_words"] = planar
+            final["planar_chk"] = jax.vmap(planar_checksums_tpu)(planar)
         global_count = jax.lax.psum(final["count"].sum(), "shard")
         # any device needing CPU fallback poisons the whole job. Reduce over
         # BOTH axes: local_fallback differs per block column, and out_spec
@@ -178,15 +196,18 @@ def sharded_compaction_step(mesh, model=None):
         )
 
     in_spec = P("shard", "block")
+    final_keys = [
+        "key_words_be", "key_words_le", "key_len", "seq_hi",
+        "seq_lo", "vtype", "val_words", "val_len",
+    ]
+    if model.emit_planar:
+        final_keys += ["planar_words", "planar_chk"]
     step = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(in_spec,) * 8,
         out_specs=(
-            {k: P("shard", None) for k in (
-                "key_words_be", "key_words_le", "key_len", "seq_hi",
-                "seq_lo", "vtype", "val_words", "val_len",
-            )},
+            {k: P("shard", None) for k in final_keys},
             P("shard", None),
             P("shard", None),
             P(None, None),

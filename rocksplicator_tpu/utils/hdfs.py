@@ -65,7 +65,7 @@ class HdfsObjectStore(ObjectStore):
     def _path(self, key: str) -> str:
         return f"{self._base}/{key.lstrip('/')}" if key else self._base
 
-    def _url(self, host: str, port: int, path: str, op: str, **params) -> str:
+    def _url(self, path: str, op: str, **params) -> str:
         q = {"op": op, "user.name": self._user, **params}
         return (f"/webhdfs/v1{urllib.parse.quote(path)}"
                 f"?{urllib.parse.urlencode(q)}")
@@ -111,7 +111,7 @@ class HdfsObjectStore(ObjectStore):
         first hop, and the op is re-issued WITH the body so the write
         is never silently dropped."""
         host, port = self._host, self._port
-        url = self._url(host, port, path, op, **params)
+        url = self._url(path, op, **params)
         body_sent = body is None
         for _ in range(_MAX_REDIRECTS):
             status, loc, data = self._send(
@@ -186,9 +186,16 @@ class HdfsObjectStore(ObjectStore):
         os.replace(tmp, local_path)
 
     def list_objects(self, prefix: str) -> List[str]:
-        """Every file under ``prefix`` (recursive), as keys."""
+        """Every file whose KEY starts with ``prefix`` — the STRING-prefix
+        contract Local/S3 implement (a prefix may be a partial filename:
+        archive.py enumerates 'dbmeta-<seq>' chains with prefix
+        '.../dbmeta'). The walk is rooted at the prefix's parent
+        DIRECTORY and filtered by string prefix, so partial-name
+        prefixes match exactly like the other backends."""
+        prefix = prefix.lstrip("/")
+        root = prefix.rstrip("/").rsplit("/", 1)[0] if "/" in prefix else ""
         out: List[str] = []
-        pending = [prefix.rstrip("/")]
+        pending = [root]
         while pending:
             cur = pending.pop()
             try:
@@ -202,10 +209,14 @@ class HdfsObjectStore(ObjectStore):
             for st in statuses:
                 # LISTSTATUS of a FILE returns one entry with empty suffix
                 name = st["pathSuffix"]
-                child = f"{cur}/{name}" if name else cur
+                child = (f"{cur}/{name}" if cur and name
+                         else (name or cur))
                 if st["type"] == "DIRECTORY":
-                    pending.append(child)
-                else:
+                    # descend only where the subtree can still match
+                    if child.startswith(prefix) or prefix.startswith(
+                            child + "/"):
+                        pending.append(child)
+                elif child.startswith(prefix):
                     out.append(child)
         return sorted(out)
 

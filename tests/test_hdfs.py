@@ -167,6 +167,22 @@ def test_file_transfer_and_batch(hdfs_store, tmp_path):
     assert len(out) == 1 and out[0].endswith("seg.sst")
 
 
+def test_list_partial_filename_prefix(hdfs_store):
+    """STRING-prefix contract parity with Local/S3: a prefix may be a
+    partial filename (archive.py enumerates 'dbmeta-<seq>' chains with
+    prefix '.../dbmeta')."""
+    hdfs_store.put_object_bytes("bk/db1/dbmeta-000010", b"a")
+    hdfs_store.put_object_bytes("bk/db1/dbmeta-000020", b"b")
+    hdfs_store.put_object_bytes("bk/db1/other", b"c")
+    assert hdfs_store.list_objects("bk/db1/dbmeta") == [
+        "bk/db1/dbmeta-000010", "bk/db1/dbmeta-000020"]
+    # directory-shaped prefixes still work, including nested
+    hdfs_store.put_object_bytes("bk/db1/sub/dbmeta-000030", b"d")
+    assert hdfs_store.list_objects("bk/db1") == [
+        "bk/db1/dbmeta-000010", "bk/db1/dbmeta-000020", "bk/db1/other",
+        "bk/db1/sub/dbmeta-000030"]
+
+
 def test_missing_object_raises(hdfs_store):
     with pytest.raises(HdfsError):
         hdfs_store.get_object_bytes("nope/missing")

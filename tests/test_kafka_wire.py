@@ -101,6 +101,56 @@ def test_record_batch_golden_bytes():
     assert decode_record_batches(batch) == [(7, 1500, b"key", b"value")]
 
 
+def test_control_batch_skipped():
+    """Transaction COMMIT/ABORT markers (attributes bit 0x20) are
+    protocol metadata — never delivered as application messages."""
+    data = encode_record_batch(0, [(1, b"k", b"v")])
+    control = bytearray(encode_record_batch(1, [(2, b"\x00\x00\x00\x01",
+                                                 b"")]))
+    # set the control bit in attributes and re-CRC
+    import struct as _s
+
+    body_off = 8 + 4 + 4 + 1 + 4
+    attrs = _s.unpack_from(">h", control, body_off)[0] | 0x20
+    _s.pack_into(">h", control, body_off, attrs)
+    _s.pack_into(">I", control, 8 + 4 + 4 + 1,
+                 crc32c(bytes(control[body_off:])))
+    out = decode_record_batches(data + bytes(control))
+    assert out == [(0, 1, b"k", b"v")]
+
+
+def test_api_versions_fallback_shape():
+    """An unsupported ApiVersions request version must still get the
+    error-35 response WITH the supported-versions array so real clients
+    can fall back to v0 (they open with v3+)."""
+    import socket
+    import struct as _s
+
+    from rocksplicator_tpu.kafka.wire import (API_API_VERSIONS,
+                                              KafkaWireBroker, _R)
+
+    cluster = MockKafkaCluster()
+    broker = KafkaWireBroker(cluster)
+    try:
+        s = socket.create_connection(("127.0.0.1", broker.port), 5.0)
+        head = _s.pack(">hhih", API_API_VERSIONS, 3, 77, -1)  # v3 request
+        s.sendall(_s.pack(">i", len(head)) + head)
+        size = _s.unpack(">i", s.recv(4))[0]
+        buf = b""
+        while len(buf) < size:
+            buf += s.recv(size - len(buf))
+        r = _R(buf)
+        assert r.i32() == 77          # correlation id
+        assert r.i16() == 35          # UNSUPPORTED_VERSION
+        n = r.i32()
+        assert n > 0                  # the fallback array is present
+        versions = {r.i16(): (r.i16(), r.i16()) for _ in range(n)}
+        assert versions[API_API_VERSIONS] == (0, 0)
+        s.close()
+    finally:
+        broker.stop()
+
+
 def test_partial_trailing_batch_tolerated():
     batch = encode_record_batch(0, [(1, b"a", b"b"), (2, b"c", b"d")])
     # a fetch response may truncate the last batch mid-frame
