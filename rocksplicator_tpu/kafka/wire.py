@@ -270,8 +270,20 @@ def encode_record_batch(base_offset: int,
 
 def decode_record_batches(buf: bytes) -> List[Tuple[int, int, Optional[bytes], bytes]]:
     """record_set bytes -> [(offset, timestamp_ms, key, value)]. Verifies
-    magic and CRC-32C per batch; rejects compressed batches."""
+    magic and CRC-32C per batch; rejects compressed batches; control
+    batches (transaction markers) are skipped."""
+    return decode_record_set(buf)[0]
+
+
+def decode_record_set(buf: bytes) -> Tuple[
+        List[Tuple[int, int, Optional[bytes], bytes]], Optional[int]]:
+    """Like :func:`decode_record_batches` but also returns the offset
+    AFTER the last complete batch (base_offset + last_offset_delta + 1),
+    or None when no complete batch was present. Consumers need it to
+    advance past control-only batches — a position parked on a
+    transaction marker would otherwise refetch it forever."""
     out: List[Tuple[int, int, Optional[bytes], bytes]] = []
+    next_offset: Optional[int] = None
     pos = 0
     while pos + _BATCH_HEAD.size + 4 <= len(buf):
         base_offset, batch_len, _epoch, magic = _BATCH_HEAD.unpack_from(buf, pos)
@@ -293,10 +305,14 @@ def decode_record_batches(buf: bytes) -> List[Tuple[int, int, Optional[bytes], b
                 f"not supported")
         if attributes & 0x20:
             # control batch (transaction COMMIT/ABORT markers): its
-            # records are protocol metadata, never application data
+            # records are protocol metadata, never application data —
+            # but its offset range still advances next_offset
+            next_offset = base_offset + r.i32() + 1
             pos = end
             continue
-        r.i32()                      # lastOffsetDelta
+        # lastOffsetDelta advances next_offset even when the batch's
+        # records were all compacted away (count may be 0)
+        next_offset = base_offset + r.i32() + 1
         first_ts = r.i64()
         r.i64()                      # maxTimestamp
         r.i64(); r.i16(); r.i32()    # producer id/epoch, base seq
@@ -316,7 +332,7 @@ def decode_record_batches(buf: bytes) -> List[Tuple[int, int, Optional[bytes], b
                         key, value))
             r.pos = rec_end
         pos = end
-    return out
+    return out, next_offset
 
 
 # ---------------------------------------------------------------------------
@@ -743,13 +759,23 @@ class KafkaWireConsumer(Consumer):
                         f"kafka fetch {self._topic}[{p}] "
                         f"@{self._positions.get(p)}: error {err}",
                         error_code=err, partition=p, high_watermark=hwm)
-                for off, ts, key, value in decode_record_batches(record_set):
+                records, next_off = decode_record_set(record_set)
+                delivered = False
+                for off, ts, key, value in records:
                     if off < self._positions[p]:
                         continue      # broker returned the whole batch
+                    delivered = True
                     self._buffers[p].append(Message(
                         topic=self._topic, partition=p, offset=off,
                         timestamp_ms=ts, key=key or b"", value=value,
                     ))
+                if (not delivered and not self._buffers[p]
+                        and next_off is not None
+                        and next_off > self._positions[p]):
+                    # nothing consumable (control markers / compacted
+                    # batches): advance past them or the next fetch
+                    # refetches the same batch forever
+                    self._positions[p] = next_off
 
     def consume(self, timeout_sec: float) -> Optional[Message]:
         assert self._topic is not None

@@ -289,12 +289,13 @@ class NativeLib:
             declared = int.from_bytes(data[:4], "little")
             if declared > max_out:
                 return None
-            cap = declared
         else:
             return None
-        out = np.empty(max(1, cap), dtype=np.uint8)
+        # +32 slack enables the decoder's 16-byte wildcopy fast path
+        # (it may scribble up to 15 bytes past the logical end)
+        out = np.empty(declared + 32, dtype=np.uint8)
         n = self._lib.rlz_decompress(
-            self._u8(src), len(data), self._u8(out), cap)
+            self._u8(src), len(data), self._u8(out), declared + 32)
         if n < 0:
             return None
         return out[:n].tobytes()

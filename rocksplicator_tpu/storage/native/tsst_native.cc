@@ -325,7 +325,11 @@ int64_t rlz_compress(const uint8_t* src, uint64_t n,
 }
 
 // Returns decoded length, or -1 on malformed/overflowing input. Never
-// reads past src+n or writes past dst+cap regardless of input bytes.
+// reads past src+n; writes stay within dst+cap. When ``cap`` exceeds
+// raw_len by >= 32 bytes of slack (the Python binding allocates it),
+// copies use unconditional 16-byte "wildcopy" chunks that may scribble
+// up to 15 bytes past the logical end — never past dst+cap — and are
+// overwritten by subsequent tokens or ignored.
 int64_t rlz_decompress(const uint8_t* src, uint64_t n,
                        uint8_t* dst, uint64_t cap) {
   if (n < 4) return -1;
@@ -341,13 +345,38 @@ int64_t rlz_decompress(const uint8_t* src, uint64_t n,
       uint32_t dist = (uint32_t)src[r] | ((uint32_t)src[r + 1] << 8);
       r += 2;
       if (dist == 0 || dist > w || w + len > raw_len) return -1;
-      // bytewise: matches may overlap their own output (run encoding)
-      for (uint64_t k = 0; k < len; k++, w++) dst[w] = dst[w - dist];
+      if (dist >= len && dist >= 16 && w + len + 16 <= cap) {
+        // wildcopy: dist >= 16 keeps every 16-byte chunk's read region
+        // disjoint from its own write (no memcpy overlap); the tail
+        // read tops out at w - dist + len + 15 < w + len + 16 <= cap
+        uint64_t k = 0;
+        do {
+          memcpy(dst + w + k, dst + w - dist + k, 16);
+          k += 16;
+        } while (k < len);
+        w += len;
+      } else if (dist >= len) {
+        memcpy(dst + w, dst + w - dist, len);  // disjoint: one copy
+        w += len;
+      } else {
+        // overlapping run: replicate the period bytewise
+        for (uint64_t k = 0; k < len; k++, w++) dst[w] = dst[w - dist];
+      }
     } else {
       if (tag == 0) return -1;
       uint64_t take = tag;
       if (r + take > n || w + take > raw_len) return -1;
-      memcpy(dst + w, src + r, take);
+      if (w + take + 16 <= cap && r + take + 16 <= n) {
+        // wildcopy needs slack on BOTH buffers (the tail chunk reads
+        // up to 15 bytes past the literal run inside src)
+        uint64_t k = 0;
+        do {
+          memcpy(dst + w + k, src + r + k, 16);
+          k += 16;
+        } while (k < take);
+      } else {
+        memcpy(dst + w, src + r, take);
+      }
       r += take;
       w += take;
     }

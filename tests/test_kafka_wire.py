@@ -101,9 +101,13 @@ def test_record_batch_golden_bytes():
     assert decode_record_batches(batch) == [(7, 1500, b"key", b"value")]
 
 
-def test_control_batch_skipped():
+def test_control_batch_skipped_but_advances_offset():
     """Transaction COMMIT/ABORT markers (attributes bit 0x20) are
-    protocol metadata — never delivered as application messages."""
+    protocol metadata — never delivered as application messages, but
+    their offset range must advance next_offset or a consumer position
+    parked on a marker would refetch it forever (livelock)."""
+    from rocksplicator_tpu.kafka.wire import decode_record_set
+
     data = encode_record_batch(0, [(1, b"k", b"v")])
     control = bytearray(encode_record_batch(1, [(2, b"\x00\x00\x00\x01",
                                                  b"")]))
@@ -117,6 +121,9 @@ def test_control_batch_skipped():
                  crc32c(bytes(control[body_off:])))
     out = decode_record_batches(data + bytes(control))
     assert out == [(0, 1, b"k", b"v")]
+    # control-only set: no records, but the position can still advance
+    records, next_off = decode_record_set(bytes(control))
+    assert records == [] and next_off == 2
 
 
 def test_api_versions_fallback_shape():

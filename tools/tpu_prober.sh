@@ -29,21 +29,25 @@ while true; do
   cycle=$((cycle + 1))
   ts=$(date -u +%Y%m%dT%H%M%S)
   note "cycle $cycle: profile_device attempt"
+  # write to .tmp and rename on success: an in-flight/failed attempt
+  # must never leave a partial or empty .json in results/
   if RSTPU_REQUIRE_ACCEL=1 timeout --signal=TERM "$PROBE_TIMEOUT" \
       python -m benchmarks.profile_device --set pallas \
-      > "$RES/profile_r05_$ts.json" 2>> "$LOG"; then
+      > "$RES/.profile_r05_$ts.tmp" 2>> "$LOG"; then
+    mv "$RES/.profile_r05_$ts.tmp" "$RES/profile_r05_$ts.json"
     note "cycle $cycle: GRANT — profile saved profile_r05_$ts.json; running bench"
     touch "$RES/GRANT_SEEN"
     if timeout --signal=TERM "$BENCH_TIMEOUT" \
-        python bench.py > "$RES/bench_r05_$ts.json" 2>> "$LOG"; then
+        python bench.py > "$RES/.bench_r05_$ts.tmp" 2>> "$LOG"; then
+      mv "$RES/.bench_r05_$ts.tmp" "$RES/bench_r05_$ts.json"
       note "cycle $cycle: bench saved bench_r05_$ts.json"
     else
-      note "cycle $cycle: bench rc=$? (partial output kept)"
+      note "cycle $cycle: bench rc=$? (partial kept as .tmp)"
     fi
     sleep "$SLEEP_OK"
   else
     rc=$?
-    rm -f "$RES/profile_r05_$ts.json"
+    rm -f "$RES/.profile_r05_$ts.tmp"
     note "cycle $cycle: probe failed rc=$rc; sleeping $SLEEP_FAIL"
     sleep "$SLEEP_FAIL"
   fi
