@@ -99,6 +99,38 @@ class BloomFilter:
             bf.add(key)
         return bf
 
+    @classmethod
+    def build_from_arrays(cls, key_bytes_matrix, key_lens,
+                          bits_per_key: int = 10) -> "BloomFilter":
+        """Bulk build from a (n, max_klen) u8 key matrix + per-row
+        lengths — no per-key Python objects (the per-key loop dominates
+        the whole CPU compaction path at scale). Native path hands the
+        concatenated buffer + offsets straight to bloom_add_many."""
+        n = int(len(key_lens))
+        bf = cls(num_words_for(n, bits_per_key))
+        if n == 0:
+            return bf
+        key_bytes_matrix = np.ascontiguousarray(
+            key_bytes_matrix, dtype=np.uint8)
+        # clip to the matrix width: the mask below truncates the BUFFER
+        # at the width, so un-clipped offsets would shift every later
+        # key's hash range (and run past the buffer) — and the Python
+        # fallback's slice truncates the same way
+        lens = np.minimum(np.asarray(key_lens, dtype=np.uint64),
+                          np.uint64(key_bytes_matrix.shape[1]))
+        native = _native()
+        if native is not None:
+            mask = (np.arange(key_bytes_matrix.shape[1], dtype=np.uint64)
+                    [None, :] < lens[:, None])
+            buf = key_bytes_matrix[mask]  # row-major: keys stay in order
+            offsets = np.zeros(n + 1, dtype=np.uint64)
+            np.cumsum(lens, out=offsets[1:])
+            native.bloom_add_concat(bf.words, buf, offsets, n)
+            return bf
+        for i in range(n):
+            bf.add(key_bytes_matrix[i, : int(lens[i])].tobytes())
+        return bf
+
     def add(self, key: bytes) -> None:
         idx, mask = word_mask(key, self.num_words)
         self.words[idx] |= np.uint32(mask)

@@ -86,6 +86,18 @@ class NativeLib:
             self._has_planar = True
         except AttributeError:
             self._has_planar = False
+        # CPU merge-resolve may be absent in stale builds; probe and gate
+        try:
+            lib.cpu_merge_resolve.restype = ctypes.c_int64
+            lib.cpu_merge_resolve.argtypes = [
+                _u32p, _u32p, _u64p, _u8p, _u32p, _u32p,
+                ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_int32, ctypes.c_int32,
+                _u32p, _u32p, _u64p, _u8p, _u32p, _u32p,
+            ]
+            self.has_merge_resolve = True
+        except AttributeError:
+            self.has_merge_resolve = False
         # RLZ codec may be absent in stale builds; probe and gate
         try:
             lib.rlz_compress.restype = ctypes.c_int64
@@ -267,6 +279,41 @@ class NativeLib:
             bool(past_end.value),
         )
 
+    def merge_resolve(self, kw, klen, seq, vtype, vw, vlen,
+                      uint64_add: bool, drop_tombstones: bool):
+        """Native LSM merge-resolve (cpu_merge_resolve): inputs are the
+        valid-prefix KVBatch lanes; returns (out_kw, out_klen, out_seq,
+        out_vtype, out_vw, out_vlen, count). Semantics parity-pinned to
+        numpy_merge_resolve (tests/test_native.py)."""
+        n = len(klen)
+        kwn = kw.shape[1]
+        vwn = vw.shape[1]
+        kw = np.ascontiguousarray(kw, dtype=np.uint32)
+        klen = np.ascontiguousarray(klen, dtype=np.uint32)
+        seq = np.ascontiguousarray(seq, dtype=np.uint64)
+        vtype = np.ascontiguousarray(vtype, dtype=np.uint8)
+        vw = np.ascontiguousarray(vw, dtype=np.uint32)
+        vlen = np.ascontiguousarray(vlen, dtype=np.uint32)
+        out_kw = np.empty((n, kwn), dtype=np.uint32)
+        out_klen = np.empty(n, dtype=np.uint32)
+        out_seq = np.empty(n, dtype=np.uint64)
+        out_vtype = np.empty(n, dtype=np.uint8)
+        out_vw = np.empty((n, vwn), dtype=np.uint32)
+        out_vlen = np.empty(n, dtype=np.uint32)
+        count = self._lib.cpu_merge_resolve(
+            kw.ctypes.data_as(_u32p), klen.ctypes.data_as(_u32p),
+            self._u64(seq), self._u8(vtype),
+            vw.ctypes.data_as(_u32p), vlen.ctypes.data_as(_u32p),
+            n, kwn, vwn, int(uint64_add), int(drop_tombstones),
+            out_kw.ctypes.data_as(_u32p), out_klen.ctypes.data_as(_u32p),
+            self._u64(out_seq), self._u8(out_vtype),
+            out_vw.ctypes.data_as(_u32p), out_vlen.ctypes.data_as(_u32p),
+        )
+        if count < 0:
+            raise ValueError("cpu_merge_resolve failed")
+        return (out_kw, out_klen, out_seq, out_vtype, out_vw, out_vlen,
+                int(count))
+
     def rlz_compress(self, data: bytes) -> bytes:
         from ..rlz import max_compressed_len
 
@@ -329,9 +376,18 @@ class NativeLib:
         key_buf = np.frombuffer(b"".join(keys), dtype=np.uint8)
         key_off = np.zeros(n + 1, dtype=np.uint64)
         np.cumsum([len(k) for k in keys], out=key_off[1:])
+        self.bloom_add_concat(words, key_buf, key_off, n)
+
+    def bloom_add_concat(self, words: np.ndarray, key_buf: np.ndarray,
+                         key_off: np.ndarray, n: int) -> None:
+        """bloom_add_many over an already-concatenated key buffer +
+        (n+1,) u64 offsets — the no-Python-objects bulk path."""
+        key_buf = np.ascontiguousarray(key_buf, dtype=np.uint8)
+        key_off = np.ascontiguousarray(key_off, dtype=np.uint64)
         self._lib.bloom_add_many(
             words.ctypes.data_as(_u32p), len(words),
-            self._u8(key_buf), self._u64(key_off), n,
+            self._u8(key_buf if len(key_buf) else np.zeros(1, np.uint8)),
+            self._u64(key_off), n,
         )
 
     def bloom_may_contain(self, words: np.ndarray, key: bytes) -> bool:

@@ -14,8 +14,10 @@
 //                 length word, murmur fmix32 finalizer, K=6 bits from 5-bit
 //                 slices of h2
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 extern "C" {
 
@@ -464,4 +466,134 @@ extern "C" int64_t tsst_planar_get_entries(
     found++;
   }
   return (int64_t)found;
+}
+
+// ---------------------------------------------------------------------------
+// CPU merge-resolve — the framework's native compaction fallback
+// ---------------------------------------------------------------------------
+//
+// Element-exact parity with tpu/backend.py numpy_merge_resolve (the same
+// LSM resolution the TPU kernel computes): sort by (key words asc,
+// key_len asc, seq desc), then per key segment resolve newest-wins with
+// uint64-add operand folding above the first base and tombstone
+// dropping. This is the single-core CPU path a host without an
+// accelerator runs; the numpy implementation remains the fallback when
+// the native library is absent.
+
+extern "C" int64_t cpu_merge_resolve(
+    const uint32_t* kw,     // (n, kwn) row-major big-endian word values
+    const uint32_t* klen,   // (n,)
+    const uint64_t* seq,    // (n,)
+    const uint8_t* vtype,   // (n,) 1=PUT 2=DELETE 3=MERGE
+    const uint32_t* vw,     // (n, vwn) little-endian value words
+    const uint32_t* vlen,   // (n,)
+    uint64_t n, uint32_t kwn, uint32_t vwn,
+    int32_t uint64_add, int32_t drop_tombstones,
+    uint32_t* out_kw, uint32_t* out_klen, uint64_t* out_seq,
+    uint8_t* out_vtype, uint32_t* out_vw, uint32_t* out_vlen) {
+  if (n == 0) return 0;
+  if (kwn > 6) return -1;  // sort-record packing bounds (KVBatch is 6)
+  const uint32_t PUT = 1, DEL = 2, MERGE = 3;
+  // Sort VALUE records (not indices): 5 packed u64s per entry compared
+  // unrolled — (kw words asc, klen asc, seq desc); idx rides in the low
+  // half of the last word (tiebreak only, entries there share key+seq).
+  struct Rec {
+    uint64_t a, b, c, d, e;
+    bool operator<(const Rec& o) const {
+      if (a != o.a) return a < o.a;
+      if (b != o.b) return b < o.b;
+      if (c != o.c) return c < o.c;
+      if (d != o.d) return d < o.d;
+      return e < o.e;
+    }
+  };
+  std::vector<Rec> recs(n);
+  for (uint64_t i = 0; i < n; i++) {
+    const uint32_t* k = kw + (size_t)i * kwn;
+    uint64_t w[6] = {0, 0, 0, 0, 0, 0};
+    for (uint32_t x = 0; x < kwn; x++) w[x] = k[x];
+    recs[i].a = (w[0] << 32) | w[1];
+    recs[i].b = (w[2] << 32) | w[3];
+    recs[i].c = (w[4] << 32) | w[5];
+    recs[i].d = ((uint64_t)klen[i] << 32)
+        | (uint32_t)~(uint32_t)(seq[i] >> 32);
+    recs[i].e = ((uint64_t)(uint32_t)~(uint32_t)seq[i] << 32) | (uint32_t)i;
+  }
+  std::sort(recs.begin(), recs.end());
+  auto val64 = [&](uint64_t row) -> uint64_t {
+    uint64_t v = vw[(size_t)row * vwn];
+    if (vwn > 1) v |= (uint64_t)vw[(size_t)row * vwn + 1] << 32;
+    return v;
+  };
+  uint64_t count = 0;
+  uint64_t i = 0;
+  while (i < n) {
+    const Rec& ri = recs[i];
+    uint64_t j = i + 1;
+    while (j < n) {
+      const Rec& rj = recs[j];
+      // same key ⇔ key words equal AND klen (high half of d) equal
+      if (!(ri.a == rj.a && ri.b == rj.b && ri.c == rj.c
+            && (ri.d >> 32) == (rj.d >> 32)))
+        break;
+      j++;
+    }
+    // segment [i, j): rows sorted newest-first
+    int64_t fb = -1;
+    bool has_op = false;
+    uint64_t sum = 0;
+    for (uint64_t k = i; k < j; k++) {
+      uint64_t row = (uint32_t)recs[k].e;
+      uint8_t t = vtype[row];
+      bool is_base = (t == PUT) || (t == DEL);
+      if (is_base && fb < 0) fb = (int64_t)k;
+      if (t == MERGE && (fb < 0 || (int64_t)k < fb)) {
+        has_op = true;
+        if (uint64_add && vlen[row] == 8) sum += val64(row);
+      }
+    }
+    uint64_t fb_row = 0;
+    bool base_is_put = false, base_is_del = false;
+    if (fb >= 0) {
+      fb_row = (uint32_t)recs[(uint64_t)fb].e;
+      base_is_put = vtype[fb_row] == PUT;
+      base_is_del = vtype[fb_row] == DEL;
+      if (uint64_add && base_is_put && vlen[fb_row] == 8)
+        sum += val64(fb_row);
+    }
+    uint64_t rep = (uint32_t)recs[i].e;
+    bool dropped;
+    uint8_t ovt = vtype[rep];
+    uint64_t ovw0 = vw[(size_t)rep * vwn];
+    uint64_t ovw1 = vwn > 1 ? vw[(size_t)rep * vwn + 1] : 0;
+    uint32_t ovl = vlen[rep];
+    if (uint64_add) {
+      bool pure_operands = has_op && !base_is_put && !base_is_del;
+      bool resolved_put = base_is_put || (has_op && base_is_del);
+      if (resolved_put || pure_operands) {
+        ovw0 = (uint32_t)(sum & 0xFFFFFFFFu);
+        ovw1 = (uint32_t)(sum >> 32);
+        ovl = 8;
+      }
+      if (resolved_put) ovt = PUT;
+      else if (pure_operands) ovt = drop_tombstones ? PUT : MERGE;
+      dropped = base_is_del && !has_op;
+    } else {
+      dropped = ovt == DEL;
+    }
+    if (!(drop_tombstones && dropped)) {
+      memcpy(out_kw + count * kwn, kw + (size_t)rep * kwn, kwn * 4);
+      out_klen[count] = klen[rep];
+      out_seq[count] = seq[rep];
+      out_vtype[count] = ovt;
+      // untouched value words beyond [0,1] come from the representative
+      memcpy(out_vw + count * vwn, vw + (size_t)rep * vwn, vwn * 4);
+      out_vw[count * vwn] = (uint32_t)ovw0;
+      if (vwn > 1) out_vw[count * vwn + 1] = (uint32_t)ovw1;
+      out_vlen[count] = ovl;
+      count++;
+    }
+    i = j;
+  }
+  return (int64_t)count;
 }

@@ -327,11 +327,45 @@ class NumpyCompactionBackend(CompactionBackend):
             return cpu()
         if merge_op is None and bool((batch.vtype == _MERGE).any()):
             return cpu()
-        arrays, count = numpy_merge_resolve(
+        arrays, count = cpu_merge_resolve(
             batch, uint64_add=merge_op is not None,
             drop_tombstones=drop_tombstones,
         )
         return iter(unpack_entries(*arrays, count))
+
+
+def cpu_merge_resolve(
+    batch: KVBatch, uint64_add: bool, drop_tombstones: bool
+) -> Tuple[tuple, int]:
+    """Best-available CPU merge-resolve: the native C implementation
+    (storage/native cpu_merge_resolve — packed-record sort + linear
+    segment resolve) when the library is loaded, else the numpy path.
+    Both are element-exact with the TPU kernel; parity is pinned in
+    tests/test_native.py."""
+    from ..storage.native.binding import get_native
+
+    lib = get_native()
+    if lib is None or not getattr(lib, "has_merge_resolve", False):
+        return numpy_merge_resolve(batch, uint64_add, drop_tombstones)
+    valid_n = batch.num_valid()
+    seq = (
+        batch.seq_hi[:valid_n].astype(np.uint64) << np.uint64(32)
+    ) | batch.seq_lo[:valid_n].astype(np.uint64)
+    out_kw, out_klen, out_seq, out_vtype, out_vw, out_vlen, count = (
+        lib.merge_resolve(
+            batch.key_words_be[:valid_n], batch.key_len[:valid_n], seq,
+            batch.vtype[:valid_n], batch.val_words[:valid_n],
+            batch.val_len[:valid_n], uint64_add, drop_tombstones,
+        )
+    )
+    out = (
+        out_kw[:count], out_klen[:count],
+        (out_seq[:count] >> np.uint64(32)).astype(np.uint32),
+        (out_seq[:count] & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+        out_vtype[:count].astype(batch.vtype.dtype), out_vw[:count],
+        out_vlen[:count],
+    )
+    return out, count
 
 
 def numpy_merge_resolve(

@@ -259,16 +259,31 @@ class TpuCompactionService:
         job-wide bloom size so fallback blooms stay interchangeable with
         the TPU-built ones."""
         from ..storage.bloom import BloomFilter
-        from .backend import numpy_merge_resolve
+        from ..storage.native.binding import get_native
+        from .backend import cpu_merge_resolve
 
-        arrays, count = numpy_merge_resolve(
+        arrays, count = cpu_merge_resolve(
             batch, uint64_add=merge_kind is MergeKind.UINT64_ADD,
             drop_tombstones=drop_tombstones,
         )
         entries = unpack_entries(*arrays, count)
         bf = BloomFilter(num_words)
-        for key, _seq, _vt, _val in entries:
-            bf.add(key)
+        lib = get_native()
+        if lib is not None and count:
+            # bulk path into the job-pinned words array (build_from_arrays
+            # would size its own filter)
+            kb = (np.ascontiguousarray(arrays[0][:count].astype(">u4"))
+                  .view(np.uint8).reshape(count, -1))
+            lens = np.asarray(arrays[1][:count], dtype=np.uint64)
+            lens = np.minimum(lens, np.uint64(kb.shape[1]))
+            mask = (np.arange(kb.shape[1], dtype=np.uint64)[None, :]
+                    < lens[:, None])
+            offsets = np.zeros(count + 1, dtype=np.uint64)
+            np.cumsum(lens, out=offsets[1:])
+            lib.bloom_add_concat(bf.words, kb[mask], offsets, count)
+        else:
+            for key, _seq, _vt, _val in entries:
+                bf.add(key)
         return {"entries": entries, "bloom_words": bf.words, "count": count}
 
 

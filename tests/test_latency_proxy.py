@@ -1,0 +1,47 @@
+"""tools/latency_proxy.py — the DCN-shaped link for single-host benches."""
+
+import asyncio
+import time
+
+
+def test_proxy_forwards_and_delays():
+    from tools.latency_proxy import serve
+
+    async def go():
+        echoed = {}
+
+        async def echo(reader, writer):
+            data = await reader.read(1024)
+            echoed["got"] = data
+            writer.write(b"pong:" + data)
+            await writer.drain()
+            writer.close()
+
+        backend = await asyncio.start_server(echo, "127.0.0.1", 0)
+        bport = backend.sockets[0].getsockname()[1]
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        pport = s.getsockname()[1]
+        s.close()
+        ready = asyncio.Event()
+        proxy_task = asyncio.create_task(
+            serve(pport, "127.0.0.1", bport, delay_ms=20.0,
+                  ready_event=ready))
+        await asyncio.wait_for(ready.wait(), 5.0)
+        t0 = time.monotonic()
+        reader, writer = await asyncio.open_connection("127.0.0.1", pport)
+        writer.write(b"ping")
+        await writer.drain()
+        resp = await asyncio.wait_for(reader.read(1024), 5.0)
+        rtt = time.monotonic() - t0
+        assert resp == b"pong:ping"
+        assert echoed["got"] == b"ping"
+        # one-way 20 ms each direction => RTT must exceed ~40 ms
+        assert rtt >= 0.04, rtt
+        writer.close()
+        proxy_task.cancel()
+        backend.close()
+
+    asyncio.run(go())

@@ -33,7 +33,8 @@ while true; do
   # must never leave a partial or empty .json in results/
   if RSTPU_REQUIRE_ACCEL=1 timeout --signal=TERM "$PROBE_TIMEOUT" \
       python -m benchmarks.profile_device --set pallas \
-      > "$RES/.profile_r05_$ts.tmp" 2>> "$LOG"; then
+      > "$RES/.profile_r05_$ts.tmp" 2>> "$LOG" \
+      && [ -s "$RES/.profile_r05_$ts.tmp" ]; then
     mv "$RES/.profile_r05_$ts.tmp" "$RES/profile_r05_$ts.json"
     note "cycle $cycle: GRANT — profile saved profile_r05_$ts.json; running bench"
     touch "$RES/GRANT_SEEN"
