@@ -59,3 +59,24 @@ def file_watcher():
     w = FileWatcher.instance()
     yield w
     FileWatcher.reset_for_test()
+
+
+def hostile_cases(rng, base: bytes, n: int, rand_max: int = 300,
+                  append_max: int = 16):
+    """Shared decoder-fuzz input generator: alternates pure-random
+    buffers with mutations of a valid stream (truncate / single-bit
+    flip / append junk). Used by the RLZ and Kafka wire fuzz tests so
+    the strategy can't drift between them."""
+    for i in range(n):
+        if i % 2 == 0:
+            yield rng.randbytes(rng.randrange(0, rand_max))
+            continue
+        b = bytearray(base)
+        op = rng.randrange(3)
+        if op == 0:
+            b = b[:rng.randrange(len(b))]
+        elif op == 1:
+            b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+        else:
+            b += rng.randbytes(rng.randrange(append_max))
+        yield bytes(b)

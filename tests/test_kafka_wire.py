@@ -158,6 +158,46 @@ def test_api_versions_fallback_shape():
         broker.stop()
 
 
+def test_decoder_hostile_input_exception_discipline():
+    """Arbitrary/mutated bytes may only raise ValueError from the batch
+    decoder (the broker connection handler catches exactly that); a
+    struct.error or IndexError escaping would kill the thread with a
+    traceback. Plain mutations mostly die at the CRC gate, so half the
+    mutated cases corrupt the BODY and re-stamp a valid CRC-32C — those
+    reach the attributes/count/varint record-parse loop, which is where
+    non-ValueError escapes would plausibly arise. RSTPU_FUZZ_N scales."""
+    import os
+    import random
+    import struct as _s
+
+    from conftest import hostile_cases
+    from rocksplicator_tpu.kafka.wire import decode_record_set
+
+    rng = random.Random(3)
+    base = encode_record_batch(
+        5, [(100 + i, f"k{i}".encode(), b"v" * 20) for i in range(10)])
+    body_off = 8 + 4 + 4 + 1 + 4  # base_offset, len, epoch, magic, crc
+
+    def recrc(buf: bytes) -> bytes:
+        """Re-stamp a valid CRC over a (possibly corrupted) body so the
+        mutation survives the CRC gate; only applicable when the header
+        through crc is intact."""
+        if len(buf) < body_off:
+            return buf
+        b = bytearray(buf)
+        _s.pack_into(">I", b, body_off - 4, crc32c(bytes(b[body_off:])))
+        return bytes(b)
+
+    n = int(os.environ.get("RSTPU_FUZZ_N", "400"))
+    for i, buf in enumerate(hostile_cases(rng, base, n)):
+        if i % 4 == 3:  # every other mutated case: corruption PAST the gate
+            buf = recrc(buf)
+        try:
+            decode_record_set(buf)
+        except ValueError:
+            pass
+
+
 def test_partial_trailing_batch_tolerated():
     batch = encode_record_batch(0, [(1, b"a", b"b"), (2, b"c", b"d")])
     # a fetch response may truncate the last batch mid-frame

@@ -73,6 +73,30 @@ def test_bounded_decompress_rejects_oversize_and_malformed():
         assert lib.rlz_decompress(bad, 100) is None
 
 
+@pytest.mark.skipif(not rlz.native_available(), reason="native codec absent")
+def test_hostile_input_fuzz_native_matches_python():
+    """The C decoder must never crash on arbitrary bytes, and must
+    accept/reject EXACTLY what the Python decoder does (an acceptance
+    divergence would let a crafted stream decode differently on hosts
+    with vs without the native library). RSTPU_FUZZ_N scales the count
+    (6000-case run recorded clean in round 5)."""
+    from conftest import hostile_cases
+
+    lib = rlz._native()
+    rng = random.Random(77)
+    n_cases = int(os.environ.get("RSTPU_FUZZ_N", "400"))
+    base = rlz.compress(b"the quick brown fox jumps " * 500)
+    for buf in hostile_cases(rng, base, n_cases, rand_max=200,
+                             append_max=8):
+        native_out = lib.rlz_decompress(buf, 1 << 20)
+        try:
+            py_out = rlz._py_decompress(buf, 1 << 20)
+        except ValueError:
+            py_out = None
+        assert (native_out is None) == (py_out is None), buf.hex()[:80]
+        assert native_out == py_out or py_out is None
+
+
 def test_golden_rlz_blob_decodes():
     """The checked-in blob was written by the round-5 encoder; every
     future decoder must keep reading it byte-for-byte."""
