@@ -147,7 +147,16 @@ class DB:
         self._readers: Dict[str, SSTReader] = {}
         self._wal: Optional[wal_mod.WalWriter] = None
         self._closed = False
-        self._backend = self.options.compaction_backend or CpuCompactionBackend()
+        if self.options.compaction_backend is not None:
+            self._backend = self.options.compaction_backend
+        else:
+            # default: heapq streaming for tuple merges PLUS the direct
+            # array sink (native C resolve + bulk bloom + planar writer)
+            # for runs that read as lanes — RocksDB-class compaction on
+            # hosts without an accelerator
+            from .native_compaction import NativeCompactionBackend
+
+            self._backend = NativeCompactionBackend()
         # background machinery: cond signals imm-slot changes; compaction
         # mutex serializes compactions (bg + manual) so only one remover of
         # files runs at a time (flushes only ever add files)

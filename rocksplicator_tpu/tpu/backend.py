@@ -79,6 +79,15 @@ class TpuCompactionBackend(CompactionBackend):
         total = sum(len(r) for r in run_lists)
         if total == 0:
             return iter(())
+        if merge_op is not None and any(
+            vtype != _DELETE and len(value) != 8
+            for run in run_lists for _k, _s, vtype, value in run
+        ):
+            # uint64-add fold semantics require 8-byte values (a lone
+            # non-8-byte PUT must stay verbatim; the fold would rewrite
+            # it to the parsed-as-zero operand sum) — stream path
+            return self._fallback.merge_runs(
+                run_lists, merge_op, drop_tombstones)
 
         def cpu():
             entries = [e for run in run_lists for e in run]
@@ -207,6 +216,12 @@ class TpuCompactionBackend(CompactionBackend):
         non_del_vlens = vlens[~is_del]
         if len(non_del_vlens) and not (non_del_vlens == non_del_vlens[0]).all():
             return None
+        # uint64-add fold semantics require 8-byte values: a lone
+        # non-8-byte PUT would be rewritten to the (zero) operand sum
+        # instead of staying verbatim as the stream path keeps it
+        if (merge_op is not None and len(non_del_vlens)
+                and not (non_del_vlens == 8).all()):
+            return None
         kind = (
             MergeKind.UINT64_ADD if isinstance(merge_op, UInt64AddOperator)
             else MergeKind.NONE
@@ -321,6 +336,13 @@ class NumpyCompactionBackend(CompactionBackend):
                 merge_op, drop_tombstones,
             )
 
+        if merge_op is not None and any(
+            vtype != _DELETE and len(value) != 8
+            for _k, _s, vtype, value in entries
+        ):
+            # uint64-add fold semantics require 8-byte values (see
+            # TpuCompactionBackend.merge_runs) — stream path
+            return cpu()
         try:
             batch = pack_entries(entries)
         except UnsupportedBatch:
