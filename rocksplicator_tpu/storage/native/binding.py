@@ -98,6 +98,17 @@ class NativeLib:
             self.has_merge_resolve = True
         except AttributeError:
             self.has_merge_resolve = False
+        try:
+            lib.cpu_merge_resolve_runs.restype = ctypes.c_int64
+            lib.cpu_merge_resolve_runs.argtypes = [
+                _u32p, _u32p, _u64p, _u8p, _u32p, _u32p, _u64p,
+                ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_uint32, ctypes.c_int32, ctypes.c_int32,
+                _u32p, _u32p, _u64p, _u8p, _u32p, _u32p,
+            ]
+            self.has_merge_resolve_runs = True
+        except AttributeError:
+            self.has_merge_resolve_runs = False
         # RLZ codec may be absent in stale builds; probe and gate
         try:
             lib.rlz_compress.restype = ctypes.c_int64
@@ -311,6 +322,45 @@ class NativeLib:
         )
         if count < 0:
             raise ValueError("cpu_merge_resolve failed")
+        return (out_kw, out_klen, out_seq, out_vtype, out_vw, out_vlen,
+                int(count))
+
+    def merge_resolve_runs(self, kw, klen, seq, vtype, vw, vlen,
+                           run_offsets, uint64_add: bool,
+                           drop_tombstones: bool):
+        """Native k-way merge-resolve over PRE-SORTED runs
+        (cpu_merge_resolve_runs): O(n log k) instead of the full-sort
+        path's O(n log n). Caller must have verified each run is sorted
+        in (key words asc, klen asc, seq desc) order."""
+        n = len(klen)
+        kwn = kw.shape[1]
+        vwn = vw.shape[1]
+        kw = np.ascontiguousarray(kw, dtype=np.uint32)
+        klen = np.ascontiguousarray(klen, dtype=np.uint32)
+        seq = np.ascontiguousarray(seq, dtype=np.uint64)
+        vtype = np.ascontiguousarray(vtype, dtype=np.uint8)
+        vw = np.ascontiguousarray(vw, dtype=np.uint32)
+        vlen = np.ascontiguousarray(vlen, dtype=np.uint32)
+        run_offsets = np.ascontiguousarray(run_offsets, dtype=np.uint64)
+        out_kw = np.empty((n, kwn), dtype=np.uint32)
+        out_klen = np.empty(n, dtype=np.uint32)
+        out_seq = np.empty(n, dtype=np.uint64)
+        out_vtype = np.empty(n, dtype=np.uint8)
+        out_vw = np.empty((n, vwn), dtype=np.uint32)
+        out_vlen = np.empty(n, dtype=np.uint32)
+        count = self._lib.cpu_merge_resolve_runs(
+            kw.ctypes.data_as(_u32p), klen.ctypes.data_as(_u32p),
+            self._u64(seq), self._u8(vtype),
+            vw.ctypes.data_as(_u32p), vlen.ctypes.data_as(_u32p),
+            self._u64(run_offsets),
+            n, len(run_offsets) - 1, kwn, vwn,
+            int(uint64_add), int(drop_tombstones),
+            out_kw.ctypes.data_as(_u32p), out_klen.ctypes.data_as(_u32p),
+            self._u64(out_seq), self._u8(out_vtype),
+            out_vw.ctypes.data_as(_u32p), out_vlen.ctypes.data_as(_u32p),
+        )
+        if count < 0:
+            raise ValueError("cpu_merge_resolve_runs failed")
         return (out_kw, out_klen, out_seq, out_vtype, out_vw, out_vlen,
                 int(count))
 

@@ -473,127 +473,251 @@ extern "C" int64_t tsst_planar_get_entries(
 // ---------------------------------------------------------------------------
 //
 // Element-exact parity with tpu/backend.py numpy_merge_resolve (the same
-// LSM resolution the TPU kernel computes): sort by (key words asc,
-// key_len asc, seq desc), then per key segment resolve newest-wins with
-// uint64-add operand folding above the first base and tombstone
-// dropping. This is the single-core CPU path a host without an
-// accelerator runs; the numpy implementation remains the fallback when
-// the native library is absent.
+// LSM resolution the TPU kernel computes): order by the canonical
+// comparator — (key words asc, key_len asc, seq desc) — then resolve
+// each key segment newest-wins with uint64-add operand folding above
+// the first base and tombstone dropping. Two entry points share one
+// comparator packing and ONE segment-resolve implementation:
+//
+//   cpu_merge_resolve       — unsorted input: packed-record std::sort
+//   cpu_merge_resolve_runs  — PRE-SORTED runs: O(n log k) binary-heap
+//                             k-way merge (callers verify sortedness)
+//
+// This is the single-core CPU path a host without an accelerator runs;
+// the numpy implementation remains the fallback when the library is
+// absent.
+
+namespace {
+
+// Comparator record: the 9 canonical u32 lanes packed pairwise into 5
+// u64s (pairwise packing preserves lexicographic order). e's low half
+// carries the input row index (tiebreak + payload lookup).
+struct MrRec {
+  uint64_t a, b, c, d, e;
+  bool operator<(const MrRec& o) const {
+    if (a != o.a) return a < o.a;
+    if (b != o.b) return b < o.b;
+    if (c != o.c) return c < o.c;
+    if (d != o.d) return d < o.d;
+    return e < o.e;
+  }
+};
+
+struct MrInput {
+  const uint32_t* kw;
+  const uint32_t* klen;
+  const uint64_t* seq;
+  const uint8_t* vtype;
+  const uint32_t* vw;
+  const uint32_t* vlen;
+  uint32_t kwn, vwn;
+};
+
+static inline void mr_pack(const MrInput& in, uint64_t i, MrRec* r) {
+  const uint32_t* k = in.kw + (size_t)i * in.kwn;
+  uint64_t w[6] = {0, 0, 0, 0, 0, 0};
+  for (uint32_t x = 0; x < in.kwn; x++) w[x] = k[x];
+  r->a = (w[0] << 32) | w[1];
+  r->b = (w[2] << 32) | w[3];
+  r->c = (w[4] << 32) | w[5];
+  r->d = ((uint64_t)in.klen[i] << 32)
+      | (uint32_t)~(uint32_t)(in.seq[i] >> 32);
+  r->e = ((uint64_t)(uint32_t)~(uint32_t)in.seq[i] << 32) | (uint32_t)i;
+}
+
+static inline bool mr_same_key(const MrRec& x, const MrRec& y) {
+  return x.a == y.a && x.b == y.b && x.c == y.c
+      && (x.d >> 32) == (y.d >> 32);
+}
+
+static inline uint64_t mr_val64(const MrInput& in, uint64_t row) {
+  uint64_t v = in.vw[(size_t)row * in.vwn];
+  if (in.vwn > 1) v |= (uint64_t)in.vw[(size_t)row * in.vwn + 1] << 32;
+  return v;
+}
+
+struct MrOutput {
+  uint32_t* kw;
+  uint32_t* klen;
+  uint64_t* seq;
+  uint8_t* vtype;
+  uint32_t* vw;
+  uint32_t* vlen;
+  uint64_t count = 0;
+};
+
+// THE segment resolver (both entry points call exactly this): rows are
+// one key's input row indices, newest (highest seq) first.
+static void mr_resolve_segment(
+    const MrInput& in, const uint64_t* rows, size_t nseg,
+    int32_t uint64_add, int32_t drop_tombstones, MrOutput* out) {
+  const uint8_t PUT = 1, DEL = 2, MERGE = 3;
+  int64_t fb = -1;
+  bool has_op = false;
+  uint64_t sum = 0;
+  for (size_t k = 0; k < nseg; k++) {
+    uint64_t row = rows[k];
+    uint8_t t = in.vtype[row];
+    bool is_base = (t == PUT) || (t == DEL);
+    if (is_base && fb < 0) fb = (int64_t)k;
+    if (t == MERGE && (fb < 0 || (int64_t)k < fb)) {
+      has_op = true;
+      if (uint64_add && in.vlen[row] == 8) sum += mr_val64(in, row);
+    }
+  }
+  bool base_is_put = false, base_is_del = false;
+  if (fb >= 0) {
+    uint64_t fb_row = rows[(size_t)fb];
+    base_is_put = in.vtype[fb_row] == PUT;
+    base_is_del = in.vtype[fb_row] == DEL;
+    if (uint64_add && base_is_put && in.vlen[fb_row] == 8)
+      sum += mr_val64(in, fb_row);
+  }
+  uint64_t rep = rows[0];
+  uint8_t ovt = in.vtype[rep];
+  uint64_t ovw0 = in.vw[(size_t)rep * in.vwn];
+  uint64_t ovw1 = in.vwn > 1 ? in.vw[(size_t)rep * in.vwn + 1] : 0;
+  uint32_t ovl = in.vlen[rep];
+  bool dropped;
+  if (uint64_add) {
+    bool pure_operands = has_op && !base_is_put && !base_is_del;
+    bool resolved_put = base_is_put || (has_op && base_is_del);
+    if (resolved_put || pure_operands) {
+      ovw0 = (uint32_t)(sum & 0xFFFFFFFFu);
+      ovw1 = (uint32_t)(sum >> 32);
+      ovl = 8;
+    }
+    if (resolved_put) ovt = PUT;
+    else if (pure_operands) ovt = drop_tombstones ? PUT : MERGE;
+    dropped = base_is_del && !has_op;
+  } else {
+    dropped = ovt == DEL;
+  }
+  if (drop_tombstones && dropped) return;
+  uint64_t c = out->count;
+  memcpy(out->kw + c * in.kwn, in.kw + (size_t)rep * in.kwn, in.kwn * 4);
+  out->klen[c] = in.klen[rep];
+  out->seq[c] = in.seq[rep];
+  out->vtype[c] = ovt;
+  // untouched value words beyond [0,1] come from the representative
+  memcpy(out->vw + c * in.vwn, in.vw + (size_t)rep * in.vwn, in.vwn * 4);
+  out->vw[c * in.vwn] = (uint32_t)ovw0;
+  if (in.vwn > 1) out->vw[c * in.vwn + 1] = (uint32_t)ovw1;
+  out->vlen[c] = ovl;
+  out->count = c + 1;
+}
+
+}  // namespace
 
 extern "C" int64_t cpu_merge_resolve(
-    const uint32_t* kw,     // (n, kwn) row-major big-endian word values
-    const uint32_t* klen,   // (n,)
-    const uint64_t* seq,    // (n,)
-    const uint8_t* vtype,   // (n,) 1=PUT 2=DELETE 3=MERGE
-    const uint32_t* vw,     // (n, vwn) little-endian value words
-    const uint32_t* vlen,   // (n,)
+    const uint32_t* kw, const uint32_t* klen, const uint64_t* seq,
+    const uint8_t* vtype, const uint32_t* vw, const uint32_t* vlen,
     uint64_t n, uint32_t kwn, uint32_t vwn,
     int32_t uint64_add, int32_t drop_tombstones,
     uint32_t* out_kw, uint32_t* out_klen, uint64_t* out_seq,
     uint8_t* out_vtype, uint32_t* out_vw, uint32_t* out_vlen) {
   if (n == 0) return 0;
-  if (kwn > 6) return -1;  // sort-record packing bounds (KVBatch is 6)
-  const uint32_t PUT = 1, DEL = 2, MERGE = 3;
-  // Sort VALUE records (not indices): 5 packed u64s per entry compared
-  // unrolled — (kw words asc, klen asc, seq desc); idx rides in the low
-  // half of the last word (tiebreak only, entries there share key+seq).
-  struct Rec {
-    uint64_t a, b, c, d, e;
-    bool operator<(const Rec& o) const {
-      if (a != o.a) return a < o.a;
-      if (b != o.b) return b < o.b;
-      if (c != o.c) return c < o.c;
-      if (d != o.d) return d < o.d;
-      return e < o.e;
-    }
-  };
-  std::vector<Rec> recs(n);
-  for (uint64_t i = 0; i < n; i++) {
-    const uint32_t* k = kw + (size_t)i * kwn;
-    uint64_t w[6] = {0, 0, 0, 0, 0, 0};
-    for (uint32_t x = 0; x < kwn; x++) w[x] = k[x];
-    recs[i].a = (w[0] << 32) | w[1];
-    recs[i].b = (w[2] << 32) | w[3];
-    recs[i].c = (w[4] << 32) | w[5];
-    recs[i].d = ((uint64_t)klen[i] << 32)
-        | (uint32_t)~(uint32_t)(seq[i] >> 32);
-    recs[i].e = ((uint64_t)(uint32_t)~(uint32_t)seq[i] << 32) | (uint32_t)i;
-  }
+  if (kwn > 6) return -1;  // MrRec packs at most 6 key words
+  MrInput in{kw, klen, seq, vtype, vw, vlen, kwn, vwn};
+  MrOutput out{out_kw, out_klen, out_seq, out_vtype, out_vw, out_vlen};
+  std::vector<MrRec> recs(n);
+  for (uint64_t i = 0; i < n; i++) mr_pack(in, i, &recs[i]);
   std::sort(recs.begin(), recs.end());
-  auto val64 = [&](uint64_t row) -> uint64_t {
-    uint64_t v = vw[(size_t)row * vwn];
-    if (vwn > 1) v |= (uint64_t)vw[(size_t)row * vwn + 1] << 32;
-    return v;
-  };
-  uint64_t count = 0;
+  std::vector<uint64_t> seg;
+  seg.reserve(64);
   uint64_t i = 0;
   while (i < n) {
-    const Rec& ri = recs[i];
-    uint64_t j = i + 1;
-    while (j < n) {
-      const Rec& rj = recs[j];
-      // same key ⇔ key words equal AND klen (high half of d) equal
-      if (!(ri.a == rj.a && ri.b == rj.b && ri.c == rj.c
-            && (ri.d >> 32) == (rj.d >> 32)))
-        break;
+    uint64_t j = i;
+    seg.clear();
+    while (j < n && mr_same_key(recs[i], recs[j])) {
+      seg.push_back((uint32_t)recs[j].e);
       j++;
     }
-    // segment [i, j): rows sorted newest-first
-    int64_t fb = -1;
-    bool has_op = false;
-    uint64_t sum = 0;
-    for (uint64_t k = i; k < j; k++) {
-      uint64_t row = (uint32_t)recs[k].e;
-      uint8_t t = vtype[row];
-      bool is_base = (t == PUT) || (t == DEL);
-      if (is_base && fb < 0) fb = (int64_t)k;
-      if (t == MERGE && (fb < 0 || (int64_t)k < fb)) {
-        has_op = true;
-        if (uint64_add && vlen[row] == 8) sum += val64(row);
-      }
-    }
-    uint64_t fb_row = 0;
-    bool base_is_put = false, base_is_del = false;
-    if (fb >= 0) {
-      fb_row = (uint32_t)recs[(uint64_t)fb].e;
-      base_is_put = vtype[fb_row] == PUT;
-      base_is_del = vtype[fb_row] == DEL;
-      if (uint64_add && base_is_put && vlen[fb_row] == 8)
-        sum += val64(fb_row);
-    }
-    uint64_t rep = (uint32_t)recs[i].e;
-    bool dropped;
-    uint8_t ovt = vtype[rep];
-    uint64_t ovw0 = vw[(size_t)rep * vwn];
-    uint64_t ovw1 = vwn > 1 ? vw[(size_t)rep * vwn + 1] : 0;
-    uint32_t ovl = vlen[rep];
-    if (uint64_add) {
-      bool pure_operands = has_op && !base_is_put && !base_is_del;
-      bool resolved_put = base_is_put || (has_op && base_is_del);
-      if (resolved_put || pure_operands) {
-        ovw0 = (uint32_t)(sum & 0xFFFFFFFFu);
-        ovw1 = (uint32_t)(sum >> 32);
-        ovl = 8;
-      }
-      if (resolved_put) ovt = PUT;
-      else if (pure_operands) ovt = drop_tombstones ? PUT : MERGE;
-      dropped = base_is_del && !has_op;
-    } else {
-      dropped = ovt == DEL;
-    }
-    if (!(drop_tombstones && dropped)) {
-      memcpy(out_kw + count * kwn, kw + (size_t)rep * kwn, kwn * 4);
-      out_klen[count] = klen[rep];
-      out_seq[count] = seq[rep];
-      out_vtype[count] = ovt;
-      // untouched value words beyond [0,1] come from the representative
-      memcpy(out_vw + count * vwn, vw + (size_t)rep * vwn, vwn * 4);
-      out_vw[count * vwn] = (uint32_t)ovw0;
-      if (vwn > 1) out_vw[count * vwn + 1] = (uint32_t)ovw1;
-      out_vlen[count] = ovl;
-      count++;
-    }
+    mr_resolve_segment(in, seg.data(), seg.size(), uint64_add,
+                       drop_tombstones, &out);
     i = j;
   }
-  return (int64_t)count;
+  return (int64_t)out.count;
+}
+
+// K-way entry point over PRE-SORTED runs: run boundaries arrive as
+// offsets into the concatenated input lanes. A run that is NOT sorted
+// would silently merge wrong — the Python wrapper verifies sortedness
+// per run (vectorized, cheap) before calling.
+extern "C" int64_t cpu_merge_resolve_runs(
+    const uint32_t* kw, const uint32_t* klen, const uint64_t* seq,
+    const uint8_t* vtype, const uint32_t* vw, const uint32_t* vlen,
+    const uint64_t* run_offsets,  // (n_runs+1,) into the n entries
+    uint64_t n, uint32_t n_runs, uint32_t kwn, uint32_t vwn,
+    int32_t uint64_add, int32_t drop_tombstones,
+    uint32_t* out_kw, uint32_t* out_klen, uint64_t* out_seq,
+    uint8_t* out_vtype, uint32_t* out_vw, uint32_t* out_vlen) {
+  if (n == 0) return 0;
+  if (kwn > 6 || n_runs == 0) return -1;
+  MrInput in{kw, klen, seq, vtype, vw, vlen, kwn, vwn};
+  MrOutput out{out_kw, out_klen, out_seq, out_vtype, out_vw, out_vlen};
+  // run cursors + current head record per run; a binary heap of run ids
+  // keyed by the head record (k is small — a heap is within noise of a
+  // loser tree for k <= 64 and much simpler)
+  std::vector<uint64_t> cur(n_runs);
+  std::vector<MrRec> head(n_runs);
+  std::vector<uint32_t> heap;
+  heap.reserve(n_runs);
+  for (uint32_t r = 0; r < n_runs; r++) {
+    cur[r] = run_offsets[r];
+    if (cur[r] < run_offsets[r + 1]) {
+      mr_pack(in, cur[r], &head[r]);
+      heap.push_back(r);
+    }
+  }
+  auto heap_lt = [&](uint32_t x, uint32_t y) { return head[x] < head[y]; };
+  auto sift_down = [&](size_t i) {
+    size_t sz = heap.size();
+    while (true) {
+      size_t l = 2 * i + 1, r = 2 * i + 2, m = i;
+      if (l < sz && heap_lt(heap[l], heap[m])) m = l;
+      if (r < sz && heap_lt(heap[r], heap[m])) m = r;
+      if (m == i) return;
+      std::swap(heap[i], heap[m]);
+      i = m;
+    }
+  };
+  for (size_t i = heap.size(); i-- > 0;) sift_down(i);
+
+  auto pop_min = [&](uint64_t* row_out, MrRec* rec_out) -> bool {
+    if (heap.empty()) return false;
+    uint32_t r = heap[0];
+    *row_out = cur[r];
+    *rec_out = head[r];
+    cur[r]++;
+    if (cur[r] < run_offsets[r + 1]) {
+      mr_pack(in, cur[r], &head[r]);
+    } else {
+      heap[0] = heap.back();
+      heap.pop_back();
+    }
+    if (!heap.empty()) sift_down(0);
+    return true;
+  };
+
+  std::vector<uint64_t> seg;
+  seg.reserve(64);
+  MrRec seg_key{};
+  bool have = false;
+  uint64_t row;
+  MrRec rec;
+  while (pop_min(&row, &rec)) {
+    if (have && !mr_same_key(seg_key, rec)) {
+      mr_resolve_segment(in, seg.data(), seg.size(), uint64_add,
+                         drop_tombstones, &out);
+      seg.clear();
+    }
+    seg_key = rec;
+    have = true;
+    seg.push_back(row);
+  }
+  if (have)
+    mr_resolve_segment(in, seg.data(), seg.size(), uint64_add,
+                       drop_tombstones, &out);
+  return (int64_t)out.count;
 }

@@ -126,7 +126,7 @@ class NativeCompactionBackend(CpuCompactionBackend):
                 and not (non_del_vlens == 8).all()):
             return None
 
-        arrays, count = self._resolve(lanes, total, vw, merge_op,
+        arrays, count = self._resolve(parts, lanes, total, vw, merge_op,
                                       drop_tombstones)
         if count == 0:
             return []  # fully compacted away — nothing to write
@@ -189,10 +189,75 @@ class NativeCompactionBackend(CpuCompactionBackend):
         }
 
     @staticmethod
-    def _resolve(lanes: dict, total: int, vw: int, merge_op,
-                 drop_tombstones: bool):
+    def _sort_cols(part: dict):
+        """The merge comparator's lexicographic columns, built by THE
+        canonical helper (ops/compaction_kernel.composite_key_lanes —
+        every consumer of the composite order shares it). The native
+        MrRec packs these lanes pairwise into u64s, which preserves
+        lexicographic order, so a run sorted by these columns is sorted
+        for the k-way merge."""
+        from ..ops.compaction_kernel import composite_key_lanes
+
+        kw = np.asarray(part["key_words_be"], dtype=np.uint32)
+        lanes = composite_key_lanes(
+            np.zeros(kw.shape[0], dtype=np.uint32),  # all rows valid
+            (kw[:, w] for w in range(kw.shape[1])),
+            np.asarray(part["key_len"], dtype=np.uint32),
+            np.asarray(part["seq_hi"], dtype=np.uint32),
+            np.asarray(part["seq_lo"], dtype=np.uint32),
+            uniform_klen=False, seq32=False,
+        )
+        return [np.asarray(lane) for lane in lanes]
+
+    @classmethod
+    def _run_is_sorted(cls, part: dict) -> bool:
+        cols = cls._sort_cols(part)
+        n = len(cols[0])
+        if n <= 1:
+            return True
+        gt = np.zeros(n - 1, dtype=bool)
+        eq = np.ones(n - 1, dtype=bool)
+        for col in cols:
+            x, y = col[:-1], col[1:]
+            gt |= eq & (y > x)
+            eq &= y == x
+        return bool((gt | eq).all())
+
+    @classmethod
+    def _resolve(cls, parts: List[dict], lanes: dict, total: int, vw: int,
+                 merge_op, drop_tombstones: bool):
         from ..ops.kv_format import KVBatch
+        from ..storage.native.binding import get_native
         from ..tpu.backend import cpu_merge_resolve
+
+        lib = get_native()
+        if (lib is not None
+                and getattr(lib, "has_merge_resolve_runs", False)
+                and lanes["key_words_be"].shape[1] == 6
+                and all(cls._run_is_sorted(p) for p in parts)):
+            # pre-sorted runs (the normal compaction case): O(n log k)
+            # k-way merge instead of the O(n log n) full re-sort
+            offsets = np.zeros(len(parts) + 1, dtype=np.uint64)
+            np.cumsum([p["key_len"].shape[0] for p in parts],
+                      out=offsets[1:])
+            seq = (lanes["seq_hi"].astype(np.uint64) << np.uint64(32)) \
+                | lanes["seq_lo"].astype(np.uint64)
+            out = lib.merge_resolve_runs(
+                lanes["key_words_be"], lanes["key_len"], seq,
+                lanes["vtype"], lanes["val_words"], lanes["val_len"],
+                offsets, merge_op is not None, drop_tombstones,
+            )
+            count = out[6]
+            arrays = {
+                "key_words_be": out[0][:count], "key_len": out[1][:count],
+                "seq_hi": (out[2][:count] >> np.uint64(32)).astype(
+                    np.uint32),
+                "seq_lo": (out[2][:count]
+                           & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                "vtype": out[3][:count].astype(lanes["vtype"].dtype),
+                "val_words": out[4][:count], "val_len": out[5][:count],
+            }
+            return arrays, count
 
         batch = KVBatch(
             key_words_be=lanes["key_words_be"],

@@ -414,3 +414,70 @@ def test_uint64add_non8byte_puts_survive_compaction(tmp_path):
     finally:
         NativeCompactionBackend.merge_runs_to_files = orig
     assert called.get("engaged") is True
+
+
+def test_native_kway_runs_merge_parity():
+    """cpu_merge_resolve_runs (k-way merge over pre-sorted runs) must be
+    element-exact with the full-sort resolve over the same concatenated
+    lanes — runs in the engine's own comparator order."""
+    import numpy as np
+
+    from rocksplicator_tpu.models.compaction_model import synth_counter_batch
+    from rocksplicator_tpu.ops.kv_format import KVBatch
+    from rocksplicator_tpu.storage.native.binding import get_native
+    from rocksplicator_tpu.storage.native_compaction import (
+        NativeCompactionBackend,
+    )
+    from rocksplicator_tpu.tpu.backend import cpu_merge_resolve
+
+    lib = get_native()
+    if lib is None or not getattr(lib, "has_merge_resolve_runs", False):
+        pytest.skip("native k-way merge unavailable")
+    runs = []
+    for r in range(5):
+        d = synth_counter_batch(2048, key_space=512, seed=100 + r,
+                                key_bytes=16)
+        cols = NativeCompactionBackend._sort_cols(d)
+        order = np.lexsort(tuple(reversed(cols)))
+        run = {k: v[order] for k, v in d.items()}
+        assert NativeCompactionBackend._run_is_sorted(run)
+        runs.append(run)
+    fields = ("key_words_be", "key_len", "seq_hi", "seq_lo", "vtype",
+              "val_words", "val_len")
+    lanes = {f: np.concatenate([p[f] for p in runs]) for f in fields}
+    total = len(lanes["key_len"])
+    offsets = np.zeros(len(runs) + 1, dtype=np.uint64)
+    np.cumsum([2048] * len(runs), out=offsets[1:])
+    seq = (lanes["seq_hi"].astype(np.uint64) << np.uint64(32)) \
+        | lanes["seq_lo"].astype(np.uint64)
+    batch = KVBatch(
+        key_words_be=lanes["key_words_be"],
+        key_words_le=lanes["key_words_be"], key_len=lanes["key_len"],
+        seq_hi=lanes["seq_hi"], seq_lo=lanes["seq_lo"],
+        vtype=lanes["vtype"], val_words=lanes["val_words"],
+        val_len=lanes["val_len"], valid=np.ones(total, bool), val_bytes=8)
+    for ua in (True, False):
+        for drop in (True, False):
+            kway = lib.merge_resolve_runs(
+                lanes["key_words_be"], lanes["key_len"], seq,
+                lanes["vtype"], lanes["val_words"], lanes["val_len"],
+                offsets, ua, drop)
+            full, count = cpu_merge_resolve(batch, ua, drop)
+            n = kway[6]
+            assert n == count, (ua, drop, n, count)
+            assert np.array_equal(kway[0][:n], full[0])
+            assert np.array_equal(kway[1][:n], full[1])
+            assert np.array_equal(
+                (kway[2][:n] >> np.uint64(32)).astype(np.uint32), full[2])
+            assert np.array_equal(
+                (kway[2][:n] & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                full[3])
+            assert np.array_equal(kway[3][:n].astype(full[4].dtype),
+                                  full[4])
+            assert np.array_equal(kway[4][:n], full[5])
+            assert np.array_equal(kway[5][:n], full[6])
+
+    # an UNSORTED run must fail the sortedness gate (the wrapper's
+    # contract: callers verify before dispatching to the k-way path)
+    shuffled = {k: v[::-1] for k, v in runs[0].items()}
+    assert not NativeCompactionBackend._run_is_sorted(shuffled)
