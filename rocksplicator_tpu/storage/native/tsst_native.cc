@@ -622,7 +622,58 @@ extern "C" int64_t cpu_merge_resolve(
   MrOutput out{out_kw, out_klen, out_seq, out_vtype, out_vw, out_vlen};
   std::vector<MrRec> recs(n);
   for (uint64_t i = 0; i < n; i++) mr_pack(in, i, &recs[i]);
-  std::sort(recs.begin(), recs.end());
+  // MSD bucket pass, then std::sort per bucket: n log(n/2048) instead
+  // of n log n. The bucket key is the first 11 VARYING bits of the
+  // comparator — real keysets share constant prefixes ("key000...", a
+  // tenant id), so the varying-bit window is found by xor-folding each
+  // packed word and bucketing just below the first difference. Order
+  // is preserved because every more-significant bit is constant across
+  // the dataset. Degenerate spreads (one bucket holding >n/2) fall
+  // back to the plain whole-array sort.
+  const uint32_t BUCKET_BITS = 11;
+  const uint32_t NBUCKETS = 1u << BUCKET_BITS;
+  bool bucketed = false;
+  if (n >= 4096) {
+    uint64_t xors[4] = {0, 0, 0, 0};
+    for (uint64_t i = 0; i < n; i++) {
+      xors[0] |= recs[i].a ^ recs[0].a;
+      xors[1] |= recs[i].b ^ recs[0].b;
+      xors[2] |= recs[i].c ^ recs[0].c;
+      xors[3] |= recs[i].d ^ recs[0].d;
+    }
+    int word = -1;
+    for (int w = 0; w < 4; w++)
+      if (xors[w]) { word = w; break; }
+    if (word >= 0) {
+      int top = 63 - __builtin_clzll(xors[word]);
+      uint32_t shift = top >= (int)BUCKET_BITS - 1
+          ? (uint32_t)(top - (BUCKET_BITS - 1)) : 0u;
+      auto key_of = [&](const MrRec& r) -> uint32_t {
+        uint64_t w = word == 0 ? r.a : word == 1 ? r.b
+            : word == 2 ? r.c : r.d;
+        return (uint32_t)((w >> shift) & (NBUCKETS - 1));
+      };
+      std::vector<uint64_t> counts(NBUCKETS + 1, 0);
+      for (uint64_t i = 0; i < n; i++) counts[key_of(recs[i]) + 1]++;
+      uint64_t biggest = 0;
+      for (uint32_t b = 1; b <= NBUCKETS; b++)
+        if (counts[b] > biggest) biggest = counts[b];
+      if (biggest <= n / 2) {
+        for (uint32_t b = 0; b < NBUCKETS; b++)
+          counts[b + 1] += counts[b];
+        std::vector<MrRec> dist(n);
+        std::vector<uint64_t> cursor(counts.begin(), counts.end() - 1);
+        for (uint64_t i = 0; i < n; i++)
+          dist[cursor[key_of(recs[i])]++] = recs[i];
+        for (uint32_t b = 0; b < NBUCKETS; b++)
+          std::sort(dist.begin() + counts[b],
+                    dist.begin() + counts[b + 1]);
+        recs.swap(dist);
+        bucketed = true;
+      }
+    }
+  }
+  if (!bucketed) std::sort(recs.begin(), recs.end());
   std::vector<uint64_t> seg;
   seg.reserve(64);
   uint64_t i = 0;
