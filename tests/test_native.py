@@ -481,3 +481,35 @@ def test_native_kway_runs_merge_parity():
     # contract: callers verify before dispatching to the k-way path)
     shuffled = {k: v[::-1] for k, v in runs[0].items()}
     assert not NativeCompactionBackend._run_is_sorted(shuffled)
+
+
+def test_direct_sink_midloop_failure_cleans_outputs(tmp_path):
+    """A failure while writing output file N must remove files 1..N-1:
+    the engine falls back to the tuple path and nothing would ever
+    reference or GC the orphans."""
+
+    from rocksplicator_tpu.storage.merge import UInt64AddOperator
+    from rocksplicator_tpu.storage.native_compaction import (
+        NativeCompactionBackend,
+    )
+
+    entries = [(f"k{i:08d}".encode(), i + 1, 1,
+                (i).to_bytes(8, "little")) for i in range(5000)]
+    backend = NativeCompactionBackend()
+    made = []
+
+    def path_factory():
+        if len(made) == 1:
+            raise OSError("disk full (simulated)")
+        p = str(tmp_path / f"out{len(made)}.tsst")
+        made.append(p)
+        return p
+
+    with pytest.raises(OSError):
+        backend.merge_runs_to_files(
+            [entries], UInt64AddOperator(), True, path_factory,
+            block_bytes=4096, compression=0, bits_per_key=10,
+            target_file_bytes=16_000,  # forces multiple output files
+        )
+    assert made and not os.path.exists(made[0]), (
+        "orphaned output file left on disk after mid-loop failure")
