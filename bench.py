@@ -20,10 +20,12 @@ Diagnostics go to stderr.
 
 ``value`` is the framework's best measured compaction throughput on the
 available hardware: the TPU kernel when a chip was granted, else the
-framework's production CPU fallback (the numpy backend —
-TpuCompactionBackend's default fallback). ``value_source`` names the
-path; ``degraded_no_accelerator: true`` + ``tpu_kernel_gbps`` keep a
-degraded run and its raw kernel-emulation number distinguishable.
+framework's production CPU fallback (the native C merge-resolve + bulk
+bloom when storage/native is loaded, the numpy backend otherwise —
+the same dispatch NumpyCompactionBackend/TpuCompactionBackend use).
+``value_source`` names the path; ``degraded_no_accelerator: true`` +
+``tpu_kernel_gbps`` keep a degraded run and its raw kernel-emulation
+number distinguishable.
 """
 
 import json
