@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .broker import Consumer, Message, MockKafkaCluster
 
+API_PRODUCE = 0
 API_FETCH = 1
 API_LIST_OFFSETS = 2
 API_METADATA = 3
@@ -64,6 +65,7 @@ class KafkaWireError(Exception):
         self.high_watermark = high_watermark
 
 _SUPPORTED = {
+    API_PRODUCE: (3, 3),
     API_FETCH: (4, 4),
     API_LIST_OFFSETS: (1, 1),
     API_METADATA: (1, 1),
@@ -369,8 +371,10 @@ class KafkaWireBroker:
     """Kafka-protocol front end for the embedded cluster."""
 
     def __init__(self, cluster: MockKafkaCluster, port: int = 0,
-                 node_id: int = 0, host: str = "127.0.0.1"):
+                 node_id: int = 0, host: str = "127.0.0.1",
+                 auto_create_partitions: int = 16):
         self._cluster = cluster
+        self.auto_create_partitions = auto_create_partitions
         self.node_id = node_id
         self.host = host
         self._committed: Dict[Tuple[str, str, int], int] = {}
@@ -436,6 +440,8 @@ class KafkaWireBroker:
             return _W().i16(35)
         if api_key == API_API_VERSIONS:
             return self._api_versions()
+        if api_key == API_PRODUCE:
+            return self._produce(r)
         if api_key == API_METADATA:
             return self._metadata(r)
         if api_key == API_LIST_OFFSETS:
@@ -450,6 +456,47 @@ class KafkaWireBroker:
         w = _W().i16(error).i32(len(_SUPPORTED))
         for key, (lo, hi) in sorted(_SUPPORTED.items()):
             w.i16(key).i16(lo).i16(hi)
+        return w
+
+    def _produce(self, r: _R) -> _W:
+        """Produce v3: record batches decoded and appended to the
+        embedded cluster — any Kafka-protocol producer can publish into
+        the embedded queue. Unknown topics auto-create
+        (``auto_create_partitions``), mirroring auto.create.topics."""
+        r.string()                    # transactional_id
+        r.i16()                       # acks (the append is synchronous)
+        r.i32()                       # timeout_ms
+        n_topics = r.i32()
+        w = _W().i32(n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            n_parts = r.i32()
+            w.string(topic).i32(n_parts)
+            for _ in range(n_parts):
+                p = r.i32()
+                record_set = r.bytes_() or b""
+                if (self._cluster.num_partitions(topic) == 0
+                        and self.auto_create_partitions > 0):
+                    self._cluster.create_topic(
+                        topic, max(self.auto_create_partitions, p + 1))
+                if not 0 <= p < self._cluster.num_partitions(topic):
+                    w.i32(p).i16(ERR_UNKNOWN_TOPIC_OR_PARTITION)
+                    w.i64(-1).i64(-1)
+                    continue
+                try:
+                    records = decode_record_batches(record_set)
+                except ValueError:
+                    w.i32(p).i16(87)  # INVALID_RECORD
+                    w.i64(-1).i64(-1)
+                    continue
+                base_offset = -1
+                for off, ts, key, value in records:
+                    got = self._cluster.produce(
+                        topic, p, key or b"", value, timestamp_ms=ts)
+                    if base_offset < 0:
+                        base_offset = got
+                w.i32(p).i16(ERR_NONE).i64(base_offset).i64(-1)
+        w.i32(0)                      # throttle_time_ms (trails in v1+)
         return w
 
     def _metadata(self, r: _R) -> _W:
@@ -836,3 +883,89 @@ class KafkaWireConsumer(Consumer):
             self._sock.close()
         except OSError:
             pass
+
+
+class KafkaWireProducer:
+    """Minimal Kafka-protocol producer (Produce v3, acks=1): one record
+    batch per request — the CDC publish cadence, not a bulk pipeline.
+    Works against any Kafka-protocol broker (the reference publishes CDC
+    updates through librdkafka producers)."""
+
+    def __init__(self, host: str, port: int, client_id: str = "rstpu-wire",
+                 connect_timeout: float = 10.0):
+        self._client_id = client_id
+        self._corr = 0
+        self._lock = threading.Lock()
+        self._sock = socket.create_connection((host, port), connect_timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _request(self, api_key: int, api_version: int, body: bytes) -> _R:
+        with self._lock:
+            self._corr += 1
+            corr = self._corr
+            head = _W().i16(api_key).i16(api_version).i32(corr)
+            head.string(self._client_id)
+            _send_frame(self._sock, bytes(head.b) + body)
+            resp = _R(_read_frame(self._sock))
+        got = resp.i32()
+        if got != corr:
+            raise ValueError(f"kafka: correlation mismatch {got} != {corr}")
+        return resp
+
+    def produce(self, topic: str, partition: int, key: bytes, value: bytes,
+                timestamp_ms: int) -> int:
+        """Appends one record; returns its offset."""
+        record_set = encode_record_batch(
+            0, [(timestamp_ms, key, value)])
+        body = _W().string(None).i16(1).i32(30_000)
+        body.i32(1).string(topic).i32(1)
+        body.i32(partition).bytes_(record_set)
+        r = self._request(API_PRODUCE, 3, bytes(body.b))
+        base_offset = -1
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                p = r.i32()
+                err = r.i16()
+                off = r.i64()
+                r.i64()               # log_append_time
+                if err:
+                    raise KafkaWireError(
+                        f"kafka produce {topic}[{p}]: error {err}",
+                        error_code=err, partition=p)
+                base_offset = off
+        return base_offset
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class KafkaWirePublisher:
+    """CDC Publisher callable over the wire protocol — the drop-in
+    real-Kafka variant of kafka/publisher.QueuePublisher (same partition
+    routing: shard id mod partitions)."""
+
+    def __init__(self, topic: str, host: str, port: int,
+                 num_partitions: int = 16):
+        from ..utils.segment_utils import extract_shard_id
+
+        self._extract_shard_id = extract_shard_id
+        self._topic = topic
+        self._num_partitions = num_partitions
+        self._producer = KafkaWireProducer(host, port)
+
+    def __call__(self, db_name: str, start_seq: int, raw: bytes,
+                 timestamp_ms) -> None:
+        shard = self._extract_shard_id(db_name)
+        partition = shard % self._num_partitions if shard >= 0 else 0
+        self._producer.produce(
+            self._topic, partition,
+            key=f"{db_name}:{start_seq}".encode(), value=bytes(raw),
+            timestamp_ms=int(timestamp_ms) if timestamp_ms else 0,
+        )
+
+    def close(self) -> None:
+        self._producer.close()
