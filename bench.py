@@ -216,6 +216,45 @@ class _TpuWorker:
         except Exception:
             pass
 
+    def reap(self, timeout: float = 5.0) -> bool:
+        """TERM (never KILL — only SIGKILL wedges a tunnel grant), join,
+        release the queues, and drop this worker from the exit-time
+        _abandoned list. Returns True when the process is gone. The
+        salvage path calls this so a degraded run's process table is
+        clean when the JSON is emitted, not only at interpreter exit
+        (VERDICT item 6b)."""
+        proc = self.proc
+        if proc is None:
+            return True
+        try:
+            if proc.is_alive():
+                proc.terminate()
+        except Exception as e:
+            log(f"reap: TERM failed: {e!r}")
+        try:
+            proc.join(timeout)
+        except Exception as e:
+            log(f"reap: join failed: {e!r}")
+        try:
+            alive = proc.is_alive()
+        except Exception:
+            alive = True
+        if alive:
+            log(f"reap: worker pid={proc.pid} ignored TERM; leaving to "
+                f"the exit reaper")
+            return False
+        for q in (self.cmd_q, self.res_q):
+            try:
+                q.close()
+                q.join_thread()
+            except Exception:
+                pass
+        _TpuWorker._abandoned = [
+            t for t in _TpuWorker._abandoned if t[0] is not proc
+        ]
+        self.proc = None
+        return True
+
 
 def _registered_children():
     """The multiprocessing registry of still-REGISTERED children (the
@@ -766,7 +805,13 @@ def main():
             "value": round(value, 3),
             "unit": "GB/s",
             "value_source": source,
-            "tpu_kernel_gbps": round(tpu_gbps, 3),
+            # TPU-named field carries ONLY real-chip numbers (VERDICT
+            # item 6a): on a CPU/emulation run it is null and the raw
+            # jax-on-CPU number moves to an explicitly-emulated field,
+            # so no JSON reader can mistake emulation for silicon.
+            "tpu_kernel_gbps": round(tpu_gbps, 3) if on_accel else None,
+            "tpu_kernel_emulated_gbps": (
+                None if on_accel else round(tpu_gbps, 3)),
             "vs_baseline": round(value / cpu32_gbps, 3)
             if cpu32_gbps else 0.0,
             # machine consumers must tell a degraded run apart
@@ -951,19 +996,26 @@ def _salvage_late_accelerator(record, budget_left):
     late = _acquire_worker.abandoned
     if late is None:
         return
+    # whatever happens below, this worker is either recovered for one
+    # measurement or reaped — no path leaves it orphaned for the rest of
+    # the run (VERDICT item 6b: "recover or reap before exit")
+    _acquire_worker.abandoned = None
     try:
         # short grace window (a just-granted chip may be mid-handshake;
         # a non-blocking poll can also miss a still-in-pipe message)
         msg = late.res_q.get(timeout=float(
             os.environ.get("BENCH_SALVAGE_WAIT", "20")))
     except queue_mod.Empty:
-        log("late-salvage: abandoned worker still not ready")
+        log("late-salvage: abandoned worker still not ready — reaping")
+        late.reap()
         return
     except Exception as e:
         log(f"late-salvage: {e!r}")
+        late.reap()
         return
     if not (msg and msg.get("ok")):
         log(f"late-salvage: abandoned worker failed: {msg}")
+        late.reap()
         return
     backend = msg.get("backend", "unknown")
     if backend == "cpu":
@@ -971,6 +1023,7 @@ def _salvage_late_accelerator(record, budget_left):
         # CPU number only to discard it
         log("late-salvage: worker came up on backend=cpu — skipping")
         late.quit()
+        late.reap()
         return
     log(f"late-salvage: accelerator came up AFTER fallback "
         f"(backend={backend}, init={msg.get('init_sec')}s) — measuring")
@@ -987,16 +1040,21 @@ def _salvage_late_accelerator(record, budget_left):
         _RESULT["data"].pop("tpu_phase_incomplete", None)
         log(f"late-salvage: kernel {res['gbps']:.3f} GB/s recorded")
         late.quit()
+        late.reap()
     elif res and res.get("ok"):
         # phase ran but on the CPU backend: not an accelerator number —
         # the degraded result stands
         log(f"late-salvage: worker came up on backend="
             f"{res.get('backend')} — not recording")
         late.quit()
+        late.reap()
     else:
         log(f"late-salvage measurement failed: "
             f"{(res or {}).get('err', 'timeout')}")
-        late.abandon()
+        # already in _abandoned from acquisition (abandon() here again
+        # would double-register it); TERM+join it now instead of leaving
+        # an orphan until interpreter exit
+        late.reap()
 
 
 if __name__ == "__main__":

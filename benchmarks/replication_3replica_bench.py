@@ -37,7 +37,7 @@ def log(msg):
 
 
 def _spawn(role, port, db_dir, shards, keys, threads, value_bytes,
-           upstream_port=0, mode=1, linger=60):
+           upstream_port=0, mode=1, linger=60, trace=False):
     cmd = [
         sys.executable, "-m", "rocksplicator_tpu.replication.performance",
         "--role", role, "--port", str(port), "--db_dir", db_dir,
@@ -48,6 +48,8 @@ def _spawn(role, port, db_dir, shards, keys, threads, value_bytes,
         "--replication_mode", str(mode),
         "--linger_sec", str(linger),
     ]
+    if trace:
+        cmd += ["--trace"]
     if upstream_port:
         cmd += ["--upstream_port", str(upstream_port)]
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -108,6 +110,10 @@ def main():
     ap.add_argument("--threads", type=int, default=2)
     ap.add_argument("--value_bytes", type=int, default=1024)
     ap.add_argument("--leader_port", type=int, default=29391)
+    ap.add_argument("--trace", action="store_true",
+                    help="sample per-write traces in the leader and report "
+                         "the slowest sampled write's span tree (per-phase "
+                         "attribution: wal fsync vs follower-ack wait)")
     ap.add_argument("--out",
                     default="benchmarks/results/replication_3replica.json")
     args = ap.parse_args()
@@ -129,11 +135,23 @@ def main():
         t0 = time.monotonic()
         leader = _spawn("leader", args.leader_port,
                         os.path.join(tmp, "l"), args.shards, args.keys,
-                        args.threads, args.value_bytes, linger=90)
-        # parse the leader's throughput line while it runs
+                        args.threads, args.value_bytes, linger=90,
+                        trace=args.trace)
+        # parse the leader's throughput line while it runs; with --trace
+        # the slowest-write span tree is emitted (between markers) BEFORE
+        # the throughput line, so this same loop captures it
         leader_line = None
+        trace_lines = []
+        in_trace = False
         for line in leader.stdout:
             log(f"[leader] {line.rstrip()}")
+            if line.startswith("TRACE-SLOWEST-WRITE-BEGIN"):
+                in_trace = True
+            if in_trace:
+                trace_lines.append(line.rstrip("\n"))
+                if line.startswith("TRACE-SLOWEST-WRITE-END"):
+                    in_trace = False
+                continue
             m = re.search(r"wrote ~([\d.]+) MB in ([\d.]+)s", line)
             if m:
                 leader_line = (float(m.group(1)), float(m.group(2)))
@@ -180,6 +198,8 @@ def main():
                 "acked_write_loss": max(0, want - min(seqs.values())),
             },
         }
+        if args.trace and trace_lines:
+            result["slowest_write_trace"] = trace_lines
         roof = host_roofline(tmp, args.value_bytes)
         raw_wps = roof["engine_writes_per_sec_no_replication"]
         result["host_roofline"] = roof

@@ -12,6 +12,7 @@ import logging
 import threading
 from typing import Optional
 
+from ..observability.span import start_span
 from ..storage import backup as backup_mod
 from ..utils.objectstore import ObjectStore
 from ..utils.stats import Stats
@@ -91,31 +92,40 @@ class ApplicationDBBackupManager:
             if app_db is None:
                 continue
             try:
-                meta = None
-                if self._archive_wal:
-                    # Install the purge sink BEFORE the checkpoint upload:
-                    # a long upload overlaps live writes, and any WAL
-                    # segment the engine purges during it must hit the
-                    # archive or PITR into that range is lost forever.
-                    # (One shared archiver per DB: its mutex serializes
-                    # the purge-time sink against this pass's shipping.)
-                    arch = self._archiver(name, app_db.db)
-                    if app_db.db.options.wal_archive_sink is None:
-                        app_db.db.options.wal_archive_sink = arch.sink
-                    meta = {"wal_prefix": arch.prefix}
-                backup_mod.backup_db(
-                    app_db.db, self._store, f"{self._prefix}/{name}",
-                    parallelism=self._parallelism, incremental=True,
-                    meta=meta,
-                )
-                if self._archive_wal:
-                    self._archiver(name, app_db.db).archive_live(app_db.db)
+                # one always-sampled trace per (db, pass): the incremental
+                # backup inherits the same checkpoint→upload breakdown as
+                # the admin backup_db path
+                with start_span("backup_manager.backup", always=True,
+                                db=name):
+                    self._backup_one(name, app_db)
                 ok += 1
                 Stats.get().incr("backup_manager.backups_ok")
             except Exception:
                 Stats.get().incr("backup_manager.backups_failed")
                 log.exception("incremental backup failed for %s", name)
         return ok
+
+    def _backup_one(self, name: str, app_db) -> None:
+        meta = None
+        if self._archive_wal:
+            # Install the purge sink BEFORE the checkpoint upload:
+            # a long upload overlaps live writes, and any WAL
+            # segment the engine purges during it must hit the
+            # archive or PITR into that range is lost forever.
+            # (One shared archiver per DB: its mutex serializes
+            # the purge-time sink against this pass's shipping.)
+            arch = self._archiver(name, app_db.db)
+            if app_db.db.options.wal_archive_sink is None:
+                app_db.db.options.wal_archive_sink = arch.sink
+            meta = {"wal_prefix": arch.prefix}
+        backup_mod.backup_db(
+            app_db.db, self._store, f"{self._prefix}/{name}",
+            parallelism=self._parallelism, incremental=True,
+            meta=meta,
+        )
+        if self._archive_wal:
+            with start_span("backup.wal_archive"):
+                self._archiver(name, app_db.db).archive_live(app_db.db)
 
     def _loop(self) -> None:
         while not self._stop.wait(self._interval):

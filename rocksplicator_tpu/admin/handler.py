@@ -30,6 +30,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import asdict, dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..observability.context import wire_context
+from ..observability.span import start_span
 from ..replication.replicated_db import LeaderResolver
 from ..replication.replicator import Replicator
 from ..replication.wire import ReplicaRole
@@ -411,9 +413,18 @@ class AdminHandler:
         app_db = self._get_app_db(db_name)
         store = self._store(store_uri)
         prefix = sub_path or db_name
+        # run_in_executor drops contextvars: carry the rpc.server span's
+        # context across the hop so the backup phases join the RPC trace.
+        # always=True: control-plane ops are rare enough to trace
+        # unconditionally — the 45 s backup round trip gets a per-phase
+        # breakdown (checkpoint → upload batches → dbmeta) every time.
+        tctx = wire_context()
 
         def do():
-            with self._db_admin_lock.locked(db_name), Timer("admin.backup_ms"):
+            with self._db_admin_lock.locked(db_name), \
+                    Timer("admin.backup_ms"), \
+                    start_span("admin.backup_db", always=True, remote=tctx,
+                               db=db_name):
                 meta = self.get_meta_data(db_name)
                 return backup_mod.backup_db(
                     app_db.db, store, prefix,
@@ -431,9 +442,13 @@ class AdminHandler:
         prefix = sub_path or db_name
         upstream = (upstream_ip, upstream_port) if upstream_ip else None
         role = ReplicaRole.FOLLOWER if upstream else ReplicaRole.NOOP
+        tctx = wire_context()
 
         def do():
-            with self._db_admin_lock.locked(db_name), Timer("admin.restore_ms"):
+            with self._db_admin_lock.locked(db_name), \
+                    Timer("admin.restore_ms"), \
+                    start_span("admin.restore_db", always=True, remote=tctx,
+                               db=db_name, to_seq=to_seq):
                 if self.db_manager.get_db(db_name) is not None:
                     self.db_manager.remove_db(db_name)
                 destroy_db(self._db_path(db_name))
@@ -577,12 +592,16 @@ class AdminHandler:
         return {}
 
     async def handle_compact_db(self, db_name: str = "") -> dict:
+        tctx = wire_context()
+
         def do():
             # per-db lock: a concurrent clearDB/closeDB must not destroy the
             # directory under a running compaction
             with self._db_admin_lock.locked(db_name):
                 app_db = self._get_app_db(db_name)
-                with Timer("admin.compact_ms"):
+                with Timer("admin.compact_ms"), \
+                        start_span("admin.compact_db", always=True,
+                                   remote=tctx, db=db_name):
                     app_db.compact_range()
 
         await self._run(do)

@@ -23,9 +23,13 @@ Implemented APIs (fixed, non-flexible versions — pre-KIP-482 encodings):
   ========== ===== =============================================
 
 Record batches are the v2 format: zigzag-varint records inside a
-CRC-32C-protected batch frame. No compression attribute is produced;
-incoming compressed batches are rejected loudly (codec bytes must never
-be handed up as record bytes).
+CRC-32C-protected batch frame. Compression: incoming gzip batches
+(attributes codec 1 — what a default Java/librdkafka producer with
+``compression.type=gzip`` ships) are decoded via stdlib zlib with bounded
+decompression; snappy/lz4/zstd are still rejected loudly (codec bytes
+must never be handed up as record bytes; snappy awaits the native-module
+codec). Produced batches are uncompressed by default (``codec="gzip"``
+opt-in).
 """
 
 from __future__ import annotations
@@ -34,6 +38,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -230,21 +235,50 @@ class _R:
 
 _BATCH_HEAD = struct.Struct(">qiib")  # base_offset, batch_len, leader_epoch, magic
 
+# decompressed-records cap, matching the fetch frame cap (_read_frame):
+# a gzip bomb must not balloon past what an uncompressed record set
+# could legally carry. The cap is CUMULATIVE across one record set's
+# batches — per-batch bounding alone would let a 64MB frame packed with
+# many maximally-compressed batches decode to frame_cap × batch_count
+_MAX_DECOMPRESSED = 64 << 20
+
+
+def _gunzip_bounded(data: bytes, cap: int) -> bytes:
+    """gzip/zlib decode with an output bound (wbits=47 auto-detects both
+    wrappers — Java producers write gzip format; tolerate zlib too)."""
+    d = zlib.decompressobj(wbits=47)
+    try:
+        raw = d.decompress(data, cap + 1)
+    except zlib.error as e:
+        raise ValueError(f"kafka batch: bad gzip records: {e}") from None
+    if len(raw) > cap or d.unconsumed_tail:
+        raise ValueError("kafka batch: gzip records exceed size cap")
+    return raw
+
 
 def encode_record_batch(base_offset: int,
-                        records: Sequence[Tuple[int, bytes, bytes]]) -> bytes:
-    """records: [(timestamp_ms, key, value)] -> one v2 batch, uncompressed."""
+                        records: Sequence[Tuple[int, bytes, bytes]],
+                        codec: Optional[str] = None) -> bytes:
+    """records: [(timestamp_ms, key, value)] -> one v2 batch.
+    ``codec="gzip"`` compresses the records section (attributes codec 1,
+    the v2 layout: batch header through recordCount stays uncompressed,
+    only the records array is wrapped); default is uncompressed."""
     if not records:
         return b""
+    if codec not in (None, "gzip"):
+        raise ValueError(f"unsupported kafka codec: {codec}")
     first_ts = records[0][0]
     max_ts = max(r[0] for r in records)
     body = _W()
-    body.i16(0)                      # attributes: no compression
+    body.i16(1 if codec == "gzip" else 0)  # attributes: compression codec
     body.i32(len(records) - 1)       # lastOffsetDelta
     body.i64(first_ts)
     body.i64(max_ts)
     body.i64(-1).i16(-1).i32(-1)     # producerId/Epoch, baseSequence
     body.i32(len(records))
+    # uncompressed (the hot default): records append straight into body;
+    # gzip diverts them through an intermediate buffer for the wrapper
+    recs = _W() if codec == "gzip" else body
     for delta, (ts, key, value) in enumerate(records):
         rec = _W()
         rec.i8(0)                    # record attributes
@@ -258,8 +292,13 @@ def encode_record_batch(base_offset: int,
         rec.raw(encode_varint(len(value)))
         rec.raw(bytes(value))
         rec.raw(encode_varint(0))    # headers
-        body.raw(encode_varint(len(rec.b)))
-        body.raw(bytes(rec.b))
+        recs.raw(encode_varint(len(rec.b)))
+        recs.raw(bytes(rec.b))
+    if codec == "gzip":
+        # wbits=31 → gzip wrapper (what Kafka's gzip codec is); mtime
+        # defaults to 0 in zlib's stream header, keeping output stable
+        c = zlib.compressobj(wbits=31)
+        body.raw(c.compress(bytes(recs.b)) + c.flush())
     crc = crc32c(bytes(body.b))
     # batch_length counts everything after the length field itself
     batch_len = 4 + 1 + 4 + len(body.b)  # leader_epoch + magic + crc + body
@@ -285,6 +324,7 @@ def decode_record_set(buf: bytes) -> Tuple[
     advance past control-only batches — a position parked on a
     transaction marker would otherwise refetch it forever."""
     out: List[Tuple[int, int, Optional[bytes], bytes]] = []
+    gunzip_budget = _MAX_DECOMPRESSED  # shared across the set's batches
     next_offset: Optional[int] = None
     pos = 0
     while pos + _BATCH_HEAD.size + 4 <= len(buf):
@@ -301,9 +341,13 @@ def decode_record_set(buf: bytes) -> Tuple[
             raise ValueError("kafka batch: CRC-32C mismatch")
         r = _R(body)
         attributes = r.i16()
-        if attributes & 0x07:
+        codec = attributes & 0x07
+        if codec not in (0, 1):
+            # snappy(2)/lz4(3)/zstd(4): no in-image codec — reject loudly
+            # rather than hand codec bytes up as record bytes (snappy
+            # lands with the native module)
             raise ValueError(
-                f"kafka batch: compression codec {attributes & 7} "
+                f"kafka batch: compression codec {codec} "
                 f"not supported")
         if attributes & 0x20:
             # control batch (transaction COMMIT/ABORT markers): its
@@ -319,20 +363,29 @@ def decode_record_set(buf: bytes) -> Tuple[
         r.i64()                      # maxTimestamp
         r.i64(); r.i16(); r.i32()    # producer id/epoch, base seq
         count = r.i32()
+        # v2 layout: only the records array (after recordCount) is
+        # compressed; the CRC above covered the on-wire (compressed)
+        # bytes. Codec 1 = gzip — stdlib zlib, bounded so a hostile
+        # batch cannot balloon memory past the frame cap.
+        rbuf, rpos = body, r.pos
+        if codec == 1:
+            rbuf = _gunzip_bounded(body[r.pos:], gunzip_budget)
+            gunzip_budget -= len(rbuf)
+            rpos = 0
         for _ in range(count):
-            rec_len, p = decode_varint(body, r.pos)
+            rec_len, p = decode_varint(rbuf, rpos)
             rec_end = p + rec_len
-            rr = _R(body[:rec_end], p)
+            rr = _R(rbuf[:rec_end], p)
             rr.i8()                  # record attributes
-            ts_delta, rr.pos = decode_varint(body, rr.pos)
-            off_delta, rr.pos = decode_varint(body, rr.pos)
-            klen, rr.pos = decode_varint(body, rr.pos)
+            ts_delta, rr.pos = decode_varint(rbuf, rr.pos)
+            off_delta, rr.pos = decode_varint(rbuf, rr.pos)
+            klen, rr.pos = decode_varint(rbuf, rr.pos)
             key = bytes(rr._take(klen)) if klen >= 0 else None
-            vlen, rr.pos = decode_varint(body, rr.pos)
+            vlen, rr.pos = decode_varint(rbuf, rr.pos)
             value = bytes(rr._take(vlen)) if vlen >= 0 else b""
             out.append((base_offset + off_delta, first_ts + ts_delta,
                         key, value))
-            r.pos = rec_end
+            rpos = rec_end
         pos = end
     return out, next_offset
 

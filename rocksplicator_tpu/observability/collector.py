@@ -1,0 +1,216 @@
+"""SpanCollector: per-process ring buffer of finished spans + exporters.
+
+Reference points: RESYSTANCE / "Characterize LSM-tree Compaction
+Performance" (PAPERS.md) argue per-phase timing — not aggregate counters —
+is what exposes hidden stalls; this is the in-process, sample-gated
+equivalent for this stack.
+
+Write path ("lock-free-ish"): finished spans land in a fixed-size ring via
+``next(itertools.count())`` (atomic under the GIL) + a slot store — no
+lock, no allocation beyond the span's export dict. Memory is bounded by
+``capacity``; once the ring wraps, the oldest spans are overwritten and
+counted in ``dropped`` (the read side reports it, so a truncated window
+is never mistaken for complete coverage).
+
+Head sampling: the sampling decision is made once at the trace ROOT
+(``sample()``, default ~1/1024) and inherited by every descendant,
+including across process hops (the wire context carries ``sampled``).
+``sample_rate=0`` disables tracing; the instrumented hot paths then cost
+one contextvar read + one roll per would-be root.
+
+Read path (cold): ``traces()`` groups the ring by trace id,
+``to_json_text()`` feeds the status server's ``/traces`` endpoint and
+``waterfall_text()`` renders the human ``/traces.txt`` view.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+DEFAULT_CAPACITY = 4096
+DEFAULT_SAMPLE_RATE = 1.0 / 1024.0
+
+
+class SpanCollector:
+    _instance: Optional["SpanCollector"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 sample_rate: float = DEFAULT_SAMPLE_RATE):
+        self._capacity = max(1, int(capacity))
+        self._ring: List[Optional[dict]] = [None] * self._capacity
+        self._seq = itertools.count()
+        self._recorded = 0  # highest seq observed + 1 (approximate is fine)
+        env_rate = os.environ.get("RSTPU_TRACE_SAMPLE_RATE")
+        if env_rate is not None:
+            # the singleton is constructed lazily inside the first traced
+            # hot-path op: a malformed env value must degrade to the
+            # default, never raise out of an application write/RPC
+            try:
+                sample_rate = float(env_rate)
+            except ValueError:
+                pass
+        self.sample_rate = float(sample_rate)
+        # global kill switch: RSTPU_TRACING=0 disables EVERYTHING,
+        # including always=True control-plane spans — the ops escape
+        # hatch when any tracing overhead at all is unwanted
+        self.enabled = os.environ.get("RSTPU_TRACING", "1") != "0"
+        # joined into every exported span so cross-process traces remain
+        # attributable after stitching; services may relabel (e.g.
+        # "leader:9091") via configure()
+        self.process = f"pid:{os.getpid()}"
+
+    # -- singleton --------------------------------------------------------
+
+    @classmethod
+    def get(cls) -> "SpanCollector":
+        inst = cls._instance
+        if inst is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+                inst = cls._instance
+        return inst
+
+    @classmethod
+    def reset_for_test(cls) -> None:
+        with cls._instance_lock:
+            cls._instance = cls()
+
+    # -- config -----------------------------------------------------------
+
+    def configure(self, sample_rate: Optional[float] = None,
+                  capacity: Optional[int] = None,
+                  process: Optional[str] = None) -> None:
+        if sample_rate is not None:
+            self.sample_rate = float(sample_rate)
+        if process is not None:
+            self.process = process
+        if capacity is not None and int(capacity) != self._capacity:
+            self._capacity = max(1, int(capacity))
+            self._ring = [None] * self._capacity
+            self._seq = itertools.count()
+            self._recorded = 0
+
+    # -- hot write path ---------------------------------------------------
+
+    def sample(self) -> bool:
+        rate = self.sample_rate
+        return self.enabled and rate > 0.0 and random.random() < rate
+
+    def record(self, span) -> None:
+        """Called once per finished SAMPLED span (span.py __exit__)."""
+        d = span.to_dict(self.process)
+        i = next(self._seq)
+        ring = self._ring
+        ring[i % len(ring)] = d
+        self._recorded = i + 1
+
+    # -- cold read path ---------------------------------------------------
+
+    @property
+    def recorded(self) -> int:
+        return self._recorded
+
+    @property
+    def dropped(self) -> int:
+        """Spans overwritten before they could be read (ring evictions)."""
+        return max(0, self._recorded - self._capacity)
+
+    def snapshot(self) -> List[dict]:
+        """All retained spans, oldest first (by wall-clock start)."""
+        spans = [d for d in list(self._ring) if d is not None]
+        spans.sort(key=lambda d: d["start_ms"])
+        return spans
+
+    def traces(self, trace_id: Optional[str] = None,
+               limit: int = 64) -> List[Dict[str, Any]]:
+        """Retained spans grouped per trace, newest trace first. Each
+        entry: {trace_id, start_ms, duration_ms, span_count, spans}."""
+        by_trace: Dict[str, List[dict]] = {}
+        for d in self.snapshot():
+            by_trace.setdefault(d["trace_id"], []).append(d)
+        out = []
+        for tid, spans in by_trace.items():
+            if trace_id is not None and tid != trace_id:
+                continue
+            start = min(s["start_ms"] for s in spans)
+            end = max(s["start_ms"] + s["duration_ms"] for s in spans)
+            out.append({
+                "trace_id": tid,
+                "start_ms": start,
+                "duration_ms": round(end - start, 3),
+                "span_count": len(spans),
+                "spans": spans,
+            })
+        out.sort(key=lambda t: t["start_ms"], reverse=True)
+        return out[:limit]
+
+    def to_json_text(self, limit: int = 64) -> str:
+        """The ``/traces`` status-server endpoint body."""
+        return json.dumps({
+            "process": self.process,
+            "sample_rate": self.sample_rate,
+            "capacity": self._capacity,
+            "recorded": self.recorded,
+            "dropped": self.dropped,
+            "traces": self.traces(limit=limit),
+        }, indent=1, default=str)
+
+    def waterfall_text(self, trace_id: Optional[str] = None,
+                       limit: int = 16) -> str:
+        """Human-readable per-trace waterfall (``/traces.txt``)."""
+        lines: List[str] = [
+            f"# spans recorded={self.recorded} dropped={self.dropped} "
+            f"sample_rate={self.sample_rate:g} process={self.process}",
+        ]
+        for tr in self.traces(trace_id=trace_id, limit=limit):
+            lines.append("")
+            lines.append(
+                f"trace {tr['trace_id']}  spans={tr['span_count']}  "
+                f"total={tr['duration_ms']:.3f} ms"
+            )
+            lines.extend(render_trace(tr["spans"], tr["start_ms"]))
+        return "\n".join(lines) + "\n"
+
+
+def render_trace(spans: List[dict], t0_ms: Optional[float] = None
+                 ) -> List[str]:
+    """Indented waterfall lines for one trace's span dicts. Spans whose
+    parent is missing from the set (e.g. evicted, or living in another
+    process's collector) render as roots — a stitched multi-process trace
+    passes the union of every process's spans here."""
+    if not spans:
+        return []
+    if t0_ms is None:
+        t0_ms = min(s["start_ms"] for s in spans)
+    ids = {s["span_id"] for s in spans}
+    children: Dict[Optional[str], List[dict]] = {}
+    for s in spans:
+        parent = s["parent_id"] if s["parent_id"] in ids else None
+        children.setdefault(parent, []).append(s)
+    for sibs in children.values():
+        sibs.sort(key=lambda s: s["start_ms"])
+    lines: List[str] = []
+
+    def walk(span: dict, depth: int) -> None:
+        off = span["start_ms"] - t0_ms
+        ann = " ".join(
+            f"{k}={v}" for k, v in sorted(span["annotations"].items()))
+        err = f" ERROR={span['error']}" if span.get("error") else ""
+        name = "  " * depth + span["name"]
+        lines.append(
+            f"  {name:<40} +{off:9.3f} ms  {span['duration_ms']:9.3f} ms"
+            f"  [{span['process']}]{(' ' + ann) if ann else ''}{err}"
+        )
+        for c in children.get(span["span_id"], []):
+            walk(c, depth + 1)
+
+    for root in children.get(None, []):
+        walk(root, 0)
+    return lines

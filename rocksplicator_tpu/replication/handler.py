@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..observability.context import current_span
 from ..rpc.errors import RpcApplicationError
 from ..utils.concurrent_map import FastReadMap
 from .wire import ReplicaRole, ReplicateErrorCode
@@ -26,6 +27,11 @@ class ReplicatorHandler:
         max_updates: Optional[int] = None,
         role: str = ReplicaRole.FOLLOWER.value,
     ) -> dict:
+        span = current_span()
+        if span is not None and span.sampled:
+            # tag the enclosing rpc.server span: /traces readers filter
+            # replicate traffic by shard without opening child spans
+            span.annotate(db=db_name, from_seq=seq_no)
         db = self._dbs.get(db_name)
         if db is None or db.removed:
             raise RpcApplicationError(

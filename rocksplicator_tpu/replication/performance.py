@@ -30,6 +30,33 @@ from .replicator import Replicator
 from .wire import ReplicaRole
 
 
+def _print_slowest_write_trace() -> None:
+    """Print the slowest sampled write's span tree (the bench's --trace
+    deliverable: per-phase attribution of ONE acked write — wal fsync vs
+    ack wait — instead of only an aggregate writes/s). Emitted BEFORE the
+    throughput line so harnesses that stop relaying output at that line
+    still capture it; markers make it machine-extractable."""
+    from ..observability.collector import SpanCollector, render_trace
+
+    snap = SpanCollector.get().snapshot()  # one consistent ring view
+    writes = [s for s in snap if s["name"] == "repl.write"]
+    if not writes:
+        print("TRACE-SLOWEST-WRITE-BEGIN none sampled", flush=True)
+        print("TRACE-SLOWEST-WRITE-END", flush=True)
+        return
+    slowest = max(writes, key=lambda s: s["duration_ms"])
+    trace = [s for s in snap if s["trace_id"] == slowest["trace_id"]]
+    print(
+        f"TRACE-SLOWEST-WRITE-BEGIN trace_id={slowest['trace_id']} "
+        f"duration_ms={slowest['duration_ms']:.3f} "
+        f"sampled_writes={len(writes)}",
+        flush=True,
+    )
+    for line in render_trace(trace):
+        print(line, flush=True)
+    print("TRACE-SLOWEST-WRITE-END", flush=True)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--role", choices=["leader", "follower"], required=True)
@@ -48,7 +75,22 @@ def main(argv=None) -> int:
     p.add_argument("--linger_sec", type=int, default=30,
                    help="leader: keep serving WAL after the write phase so "
                         "followers (possibly in connect backoff) catch up")
+    p.add_argument("--trace", action="store_true",
+                   help="sample per-write traces (observability/) and print "
+                        "the slowest sampled write's span tree after the "
+                        "write phase")
+    p.add_argument("--trace_rate", type=float, default=1.0 / 64.0,
+                   help="head-sampling rate for --trace")
     args = p.parse_args(argv)
+
+    if args.trace:
+        from ..observability.collector import SpanCollector
+
+        # capacity sized so a default run's sampled spans survive to the
+        # report (they'd otherwise rotate out of the 4096-slot ring)
+        SpanCollector.get().configure(
+            sample_rate=args.trace_rate, capacity=1 << 15,
+            process=f"{args.role}:{args.port}")
 
     replicator = Replicator(port=args.port)
     dbs = {}
@@ -103,6 +145,8 @@ def main(argv=None) -> int:
     for t in threads:
         t.join()
     elapsed = time.monotonic() - start
+    if args.trace:
+        _print_slowest_write_trace()
     # reported formula mirrors performance.cpp:150-155
     total_bytes = (
         args.num_write_threads * total_keys

@@ -29,6 +29,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional, Tuple
 
+from ..observability.context import wire_context
+from ..observability.span import start_span
 from ..rpc.client_pool import RpcClientPool
 from ..rpc.errors import RpcApplicationError, RpcConnectionError, RpcError
 from ..storage.records import WriteBatch, decode_batch
@@ -105,6 +107,14 @@ class ReplicatedDB:
         self._empty_pulls = 0
         self._conn_errors = 0
         self._stats = Stats.get()
+        # seq -> wire trace context of a SAMPLED write at that seq: lets the
+        # serve path attach the originating write's trace to the updates it
+        # ships, so a follower's apply span joins the LEADER's write trace
+        # (and re-records here for chained downstreams) — one stitched
+        # trace across the whole replication chain. Bounded; empty when
+        # tracing is off, so the hot serve/apply paths pay one falsy check.
+        self._write_traces: dict = {}
+        self._write_traces_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -141,17 +151,37 @@ class ReplicatedDB:
                 "NOT_LEADER", f"{self.name} role is {self.role.value}"
             )
         start = time.monotonic()
-        batch.stamp_timestamp_ms()
-        seq = self.wrapper.write_to_leader(batch)
-        end_seq = seq + batch.count() - 1
-        self._stats.incr(M["leader_writes"])
-        self._stats.incr(M["leader_write_bytes"], batch.byte_size())
-        # Wake parked follower long-polls (no thread was held by them).
-        self._notifier.notify_all_threadsafe()
-        if self.replication_mode in (1, 2) and self.role is ReplicaRole.LEADER:
-            self._write_wait_follower_ack(end_seq)
+        # The per-write trace (ISSUE: "profile one write's 4.6 ms"): root
+        # span with wal_write (through fsync) and ack_wait phases. Head
+        # sampled — with sampling off this costs one contextvar set/reset.
+        with start_span("repl.write", db=self.name) as sp:
+            batch.stamp_timestamp_ms()
+            with start_span("repl.wal_write"):
+                seq = self.wrapper.write_to_leader(batch)
+            end_seq = seq + batch.count() - 1
+            if sp.sampled:
+                sp.annotate(seq=seq, bytes=batch.byte_size())
+                self._remember_write_trace(seq, sp)
+            self._stats.incr(M["leader_writes"])
+            self._stats.incr(M["leader_write_bytes"], batch.byte_size())
+            # Wake parked follower long-polls (no thread was held by them).
+            self._notifier.notify_all_threadsafe()
+            if (self.replication_mode in (1, 2)
+                    and self.role is ReplicaRole.LEADER):
+                self._write_wait_follower_ack(end_seq)
         self._stats.add_metric(M["leader_write_ms"], (time.monotonic() - start) * 1e3)
         return seq
+
+    _WRITE_TRACE_CAP = 512
+
+    def _remember_write_trace(self, seq: int, span) -> None:
+        """Record a sampled write's (or applied update's) trace context by
+        its start seq so downstream serving can propagate it in-band."""
+        ctx = span.to_wire()
+        with self._write_traces_lock:
+            self._write_traces[seq] = ctx
+            while len(self._write_traces) > self._WRITE_TRACE_CAP:
+                self._write_traces.pop(next(iter(self._write_traces)))
 
     def _write_wait_follower_ack(self, target_seq: int) -> None:
         """replicated_db.cpp:236-273: 2000ms timeout normally; after 100
@@ -162,7 +192,10 @@ class ReplicatedDB:
             f.degraded_ack_timeout_ms if self._degraded else f.ack_timeout_ms
         )
         self._stats.incr(M["ack_waits"])
-        ok = self._acked.wait(target_seq, timeout_ms / 1000.0)
+        with start_span("repl.ack_wait", target_seq=target_seq,
+                        timeout_ms=timeout_ms) as sp:
+            ok = self._acked.wait(target_seq, timeout_ms / 1000.0)
+            sp.annotate(acked=ok, degraded=self._degraded)
         if ok:
             self._consecutive_ack_timeouts = 0
             if self._degraded:
@@ -201,53 +234,73 @@ class ReplicatedDB:
             f.max_updates_per_response if max_updates is None else max_updates
         )
         self._stats.incr(M["replicate_requests"])
-        # Mode-2 ACK: the puller's request proves it applied through seq_no
-        # (replicated_db.cpp:450-456); OBSERVERs never count (:452).
-        if role != ReplicaRole.OBSERVER.value and self.replication_mode == 2:
-            self._acked.post(seq_no)
-        # latest_sequence_number takes the storage lock, which flush/
-        # compaction can hold for seconds — never block the shared IO loop
-        # on it.
-        latest = await self._loop.run_in_executor(
-            self._executor, self.wrapper.latest_sequence_number
-        )
-        if latest <= seq_no and max_wait_ms > 0:
-            await self._notifier.wait(max_wait_ms / 1000.0)
-            if self._removed:
-                raise RpcApplicationError(
-                    ReplicateErrorCode.SOURCE_REMOVED.value, self.name
+        # Child of the puller's rpc.server span when the pull was sampled:
+        # per-phase serve breakdown (seq read vs long-poll park vs WAL
+        # read) — where a 10 s long-poll hides inside one "slow RPC".
+        with start_span("repl.serve", db=self.name, from_role=role) as sp:
+            # Mode-2 ACK: the puller's request proves it applied through
+            # seq_no (replicated_db.cpp:450-456); OBSERVERs never count.
+            if role != ReplicaRole.OBSERVER.value and self.replication_mode == 2:
+                self._acked.post(seq_no)
+            # latest_sequence_number takes the storage lock, which flush/
+            # compaction can hold for seconds — never block the shared IO
+            # loop on it.
+            with start_span("repl.seq_read"):
+                latest = await self._loop.run_in_executor(
+                    self._executor, self.wrapper.latest_sequence_number
                 )
-            latest = await self._loop.run_in_executor(
-                self._executor, self.wrapper.latest_sequence_number
+            if latest <= seq_no and max_wait_ms > 0:
+                with start_span("repl.longpoll_wait", max_wait_ms=max_wait_ms):
+                    await self._notifier.wait(max_wait_ms / 1000.0)
+                if self._removed:
+                    raise RpcApplicationError(
+                        ReplicateErrorCode.SOURCE_REMOVED.value, self.name
+                    )
+                with start_span("repl.seq_read"):
+                    latest = await self._loop.run_in_executor(
+                        self._executor, self.wrapper.latest_sequence_number
+                    )
+            if latest <= seq_no:
+                return {"updates": [], "latest_seq": latest,
+                        "source_role": self.role.value}
+            try:
+                with start_span("repl.wal_read") as sp_read:
+                    updates = await self._loop.run_in_executor(
+                        self._executor, self._read_updates, seq_no + 1,
+                        max_updates
+                    )
+                    sp_read.annotate(updates=len(updates))
+            except Exception as e:
+                log.exception("%s: WAL read failed", self.name)
+                raise RpcApplicationError(
+                    ReplicateErrorCode.SOURCE_READ_ERROR.value, repr(e)
+                ) from e
+            # In-band trace propagation: updates whose originating write
+            # (or upstream apply) was sampled carry that trace context, so
+            # the puller's apply joins the write's trace across processes.
+            if self._write_traces:
+                with self._write_traces_lock:
+                    for u in updates:
+                        ctx = self._write_traces.get(u["seq_no"])
+                        if ctx is not None:
+                            u["trace"] = ctx
+            # Mode-1 semi-sync ACK: posted when the response is handed to
+            # the transport (replicated_db.cpp:543-546).
+            if (
+                updates
+                and self.replication_mode == 1
+                and role != ReplicaRole.OBSERVER.value
+            ):
+                last = updates[-1]
+                self._acked.post(last["seq_no"] + last["count"] - 1)
+            self._stats.incr(M["replicate_updates_sent"], len(updates))
+            self._stats.incr(
+                M["replicate_bytes_sent"],
+                sum(len(u["raw_data"]) for u in updates),
             )
-        if latest <= seq_no:
-            return {"updates": [], "latest_seq": latest,
+            sp.annotate(latest_seq=latest)
+            return {"updates": updates, "latest_seq": latest,
                     "source_role": self.role.value}
-        try:
-            updates = await self._loop.run_in_executor(
-                self._executor, self._read_updates, seq_no + 1, max_updates
-            )
-        except Exception as e:
-            log.exception("%s: WAL read failed", self.name)
-            raise RpcApplicationError(
-                ReplicateErrorCode.SOURCE_READ_ERROR.value, repr(e)
-            ) from e
-        # Mode-1 semi-sync ACK: posted when the response is handed to the
-        # transport (replicated_db.cpp:543-546).
-        if (
-            updates
-            and self.replication_mode == 1
-            and role != ReplicaRole.OBSERVER.value
-        ):
-            last = updates[-1]
-            self._acked.post(last["seq_no"] + last["count"] - 1)
-        self._stats.incr(M["replicate_updates_sent"], len(updates))
-        self._stats.incr(
-            M["replicate_bytes_sent"],
-            sum(len(u["raw_data"]) for u in updates),
-        )
-        return {"updates": updates, "latest_seq": latest,
-                "source_role": self.role.value}
 
     def _read_updates(self, from_seq: int, max_updates: int) -> List[dict]:
         """Executor-side WAL read using the cursor cache.
@@ -348,53 +401,80 @@ class ReplicatedDB:
         f = self.flags
         assert self.upstream_addr is not None
         host, port = self.upstream_addr
-        client = await self._pool.get_client(host, port)
-        latest = await self._loop.run_in_executor(
-            self._executor, self.wrapper.latest_sequence_number
-        )
-        self._stats.incr(M["pull_requests"])
-        result = await client.call(
-            "replicate",
-            {
-                "db_name": self.name,
-                "seq_no": latest,
-                "max_wait_ms": f.server_long_poll_ms,
-                "max_updates": f.max_updates_per_response,
-                "role": self.role.value,
-            },
-            timeout=(f.server_long_poll_ms + f.pull_rpc_margin_ms) / 1000.0,
-        )
-        updates = result.get("updates", []) if result else []
-        source_role = result.get("source_role") if result else None
-        if not updates:
-            return 0, source_role
-        await self._loop.run_in_executor(
-            self._executor, self._apply_updates, updates
-        )
-        return len(updates), source_role
+        # Follower-rooted pull trace: pool acquire + RPC RTT (which carries
+        # the context to the upstream's serve span) + the apply phase.
+        with start_span("repl.pull", db=self.name) as sp:
+            client = await self._pool.get_client(host, port)
+            with start_span("repl.seq_read"):
+                latest = await self._loop.run_in_executor(
+                    self._executor, self.wrapper.latest_sequence_number
+                )
+            self._stats.incr(M["pull_requests"])
+            result = await client.call(
+                "replicate",
+                {
+                    "db_name": self.name,
+                    "seq_no": latest,
+                    "max_wait_ms": f.server_long_poll_ms,
+                    "max_updates": f.max_updates_per_response,
+                    "role": self.role.value,
+                },
+                timeout=(f.server_long_poll_ms + f.pull_rpc_margin_ms) / 1000.0,
+            )
+            updates = result.get("updates", []) if result else []
+            source_role = result.get("source_role") if result else None
+            if not updates:
+                return 0, source_role
+            sp.annotate(updates=len(updates))
+            # run_in_executor does not carry contextvars: hand the pull
+            # context across the hop explicitly (observability/context.py).
+            pull_ctx = wire_context()
+            await self._loop.run_in_executor(
+                self._executor, self._apply_updates, updates, pull_ctx
+            )
+            return len(updates), source_role
 
-    def _apply_updates(self, updates: List[dict]) -> None:
+    def _apply_updates(self, updates: List[dict],
+                       pull_ctx: Optional[dict] = None) -> None:
         """Executor-side ordered apply of one response's updates."""
         now = now_ms()
         total_bytes = 0
-        # Sequence-continuity guard: applying out of order would shift the
-        # local numbering below the leader's and silently diverge (re-fetch
-        # + double-apply). One storage-lock read, then track incrementally.
-        expected = self.wrapper.latest_sequence_number() + 1
-        for u in updates:
-            raw = bytes(u["raw_data"])
-            ts = u.get("timestamp")
-            got = int(u.get("seq_no", expected))
-            if got != expected:
-                raise ValueError(
-                    f"{self.name}: replication seq discontinuity: expected "
-                    f"{expected}, got {got} — rebuild required"
-                )
-            self.wrapper.handle_replicate_response(raw, ts)
-            expected += int(u.get("count") or decode_batch(raw).count())
-            total_bytes += len(raw)
-            if ts is not None:
-                self._stats.add_metric(M["replication_lag_ms"], max(0, now - ts))
+        with start_span("repl.apply_batch", remote=pull_ctx, db=self.name,
+                        updates=len(updates)):
+            # Sequence-continuity guard: applying out of order would shift
+            # the local numbering below the leader's and silently diverge
+            # (re-fetch + double-apply). One storage-lock read, then track
+            # incrementally.
+            expected = self.wrapper.latest_sequence_number() + 1
+            for u in updates:
+                raw = bytes(u["raw_data"])
+                ts = u.get("timestamp")
+                got = int(u.get("seq_no", expected))
+                if got != expected:
+                    raise ValueError(
+                        f"{self.name}: replication seq discontinuity: expected "
+                        f"{expected}, got {got} — rebuild required"
+                    )
+                tctx = u.get("trace")
+                if tctx is not None:
+                    # the update carried its originating write's sampled
+                    # context: this apply joins the WRITE's trace (child of
+                    # the leader's repl.write), and re-records the context
+                    # so chained downstreams stitch onto the same trace
+                    with start_span("repl.apply", remote=tctx, db=self.name,
+                                    seq=got) as asp:
+                        if pull_ctx is not None:
+                            asp.annotate(pull_trace=pull_ctx["trace_id"])
+                        self.wrapper.handle_replicate_response(raw, ts)
+                        if asp.sampled:
+                            self._remember_write_trace(got, asp)
+                else:
+                    self.wrapper.handle_replicate_response(raw, ts)
+                expected += int(u.get("count") or decode_batch(raw).count())
+                total_bytes += len(raw)
+                if ts is not None:
+                    self._stats.add_metric(
+                        M["replication_lag_ms"], max(0, now - ts))
         self._stats.incr(M["pull_updates_applied"], len(updates))
         self._stats.incr(M["pull_bytes_applied"], total_bytes)
         # Wake OUR parked long-polls so chained downstream followers see the

@@ -18,6 +18,8 @@ from typing import Any, Dict, Optional, Tuple
 from .errors import RpcApplicationError, RpcConnectionError, RpcTimeout
 from .framing import FrameReader, write_frame
 from .serde import decode_message, encode_message
+from ..observability.context import TRACE_KEY
+from ..observability.span import start_span
 
 log = logging.getLogger(__name__)
 
@@ -125,24 +127,34 @@ class RpcClient:
         req_id = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        header, chunks = encode_message(
-            {"id": req_id, "method": method, "args": args or {}}
-        )
-        try:
-            async with self._write_lock:
-                assert self._writer is not None
-                await write_frame(self._writer, header, chunks)
-        except (ConnectionError, OSError) as e:
-            self.is_good = False
-            self._pending.pop(req_id, None)
-            raise RpcConnectionError(f"send failed: {e}") from e
-        try:
-            if timeout is None:
-                return await fut
-            return await asyncio.wait_for(fut, timeout)
-        except asyncio.TimeoutError:
-            self._pending.pop(req_id, None)
-            raise RpcTimeout(f"{method} to {self.host}:{self.port} timed out") from None
+        # The RTT span covers serialize → send → response future. When
+        # sampled, the trace context rides the message's JSON frame header
+        # under the reserved "trace" key; the server reattaches it before
+        # dispatch, stitching the caller's trace across the process hop.
+        with start_span("rpc.rtt", method=method, peer=self.host) as sp:
+            msg: Dict[str, Any] = {
+                "id": req_id, "method": method, "args": args or {}
+            }
+            if sp.sampled:
+                msg[TRACE_KEY] = sp.to_wire()
+            header, chunks = encode_message(msg)
+            try:
+                async with self._write_lock:
+                    assert self._writer is not None
+                    await write_frame(self._writer, header, chunks)
+            except (ConnectionError, OSError) as e:
+                self.is_good = False
+                self._pending.pop(req_id, None)
+                raise RpcConnectionError(f"send failed: {e}") from e
+            try:
+                if timeout is None:
+                    return await fut
+                return await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                self._pending.pop(req_id, None)
+                raise RpcTimeout(
+                    f"{method} to {self.host}:{self.port} timed out"
+                ) from None
 
     async def close(self) -> None:
         self.is_good = False

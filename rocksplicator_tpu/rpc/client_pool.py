@@ -15,6 +15,7 @@ from typing import Dict, Optional, Tuple
 
 from .client import RpcClient
 from .errors import RpcConnectionError
+from ..observability.span import start_span
 
 RECONNECT_THROTTLE_SEC = 1.0
 
@@ -37,31 +38,43 @@ class RpcClientPool:
         addr = (host, port)
         client = self._clients.get(addr)
         if client is not None and client.is_good:
+            # healthy-client fast path stays span-free: this is the per-call
+            # hot path; only the slow (lock + connect) path is attributed
             return client
         lock = self._locks.setdefault(addr, asyncio.Lock())
-        async with lock:
-            client = self._clients.get(addr)
-            if client is not None and client.is_good:
+        # The acquire span splits the slow path into queue wait (callers
+        # serialized behind a peer's connect/throttle) vs the connect
+        # itself — the ISSUE's "queue wait vs connect vs RTT" breakdown
+        # (RTT lives in RpcClient.call).
+        with start_span("rpc.pool.acquire", peer=host, port=port) as sp:
+            t0 = time.monotonic()
+            async with lock:
+                sp.annotate(
+                    queue_wait_ms=round((time.monotonic() - t0) * 1e3, 3))
+                client = self._clients.get(addr)
+                if client is not None and client.is_good:
+                    sp.annotate(reused=True)
+                    return client
+                # Reconnect throttling: if we very recently failed to
+                # connect to this addr, fail fast instead of hammering it.
+                if (
+                    client is not None
+                    and time.monotonic() - client.last_connect_attempt
+                    < RECONNECT_THROTTLE_SEC
+                ):
+                    raise RpcConnectionError(
+                        f"{host}:{port} recently failed; throttled"
+                    )
+                if client is not None:
+                    await client.close()
+                client = RpcClient(host, port, self._connect_timeout,
+                                   ssl_manager=self._ssl_manager)
+                # Register before connecting so a failed attempt is
+                # remembered for throttling.
+                self._clients[addr] = client
+                with start_span("rpc.pool.connect"):
+                    await client.connect()
                 return client
-            # Reconnect throttling: if we very recently failed to connect to
-            # this addr, fail fast instead of hammering it.
-            if (
-                client is not None
-                and time.monotonic() - client.last_connect_attempt
-                < RECONNECT_THROTTLE_SEC
-            ):
-                raise RpcConnectionError(
-                    f"{host}:{port} recently failed; throttled"
-                )
-            if client is not None:
-                await client.close()
-            client = RpcClient(host, port, self._connect_timeout,
-                               ssl_manager=self._ssl_manager)
-            # Register before connecting so a failed attempt is remembered
-            # for throttling.
-            self._clients[addr] = client
-            await client.connect()
-            return client
 
     async def call(self, host: str, port: int, method: str, args=None,
                    timeout: Optional[float] = 30.0):

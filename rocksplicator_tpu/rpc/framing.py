@@ -12,6 +12,12 @@ The reference's analog is the fbthrift header protocol with optional
 snappy/zstd channel transforms (common/thrift_client_pool.h:277-284);
 payloads above a threshold are transparently zlib-compressed here (zlib is
 the in-image codec; the flag word leaves room for others).
+
+The JSON header doubles as the out-of-band metadata channel (the fbthrift
+THeader analog): sampled trace context rides it under the reserved
+top-level ``"trace"`` key (observability/context.py) — injected by
+rpc/client.py, restored by rpc/server.py, and printed by tools/rpcgrep.py
+so wire captures join in-process traces on one id.
 """
 
 from __future__ import annotations

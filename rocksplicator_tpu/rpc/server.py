@@ -18,6 +18,8 @@ from .errors import RpcApplicationError
 from .framing import FrameReader, write_frame
 from .ioloop import IoLoop
 from .serde import decode_message, encode_message
+from ..observability.context import TRACE_KEY
+from ..observability.span import start_span
 from ..utils.stats import Stats
 
 log = logging.getLogger(__name__)
@@ -189,36 +191,45 @@ class RpcServer:
         args = msg.get("args") or {}
         stats = Stats.get()
         stats.incr(f"rpc.{method}.received")
-        try:
-            if self._draining:
-                raise RpcApplicationError("SHUTDOWN", "server draining")
-            fn = self._find_handler(method)
-            result = await fn(**args)
-            reply = {"id": req_id, "ok": True, "result": result}
-            stats.incr(f"rpc.{method}.success")
-        except RpcApplicationError as e:
-            reply = {
-                "id": req_id,
-                "ok": False,
-                "error": {"code": e.code, "message": e.message, "data": e.data},
-            }
-            stats.incr(f"rpc.{method}.app_error")
-        except asyncio.CancelledError:
-            raise
-        except Exception as e:
-            log.exception("handler %s failed", method)
-            reply = {
-                "id": req_id,
-                "ok": False,
-                "error": {"code": "INTERNAL", "message": repr(e), "data": {}},
-            }
-            stats.incr(f"rpc.{method}.internal_error")
-        header, chunks = encode_message(reply)
-        try:
-            async with write_lock:
-                await write_frame(writer, header, chunks)
-        except (ConnectionError, OSError):
-            pass
+        # Reattach the caller's trace context (injected by RpcClient.call
+        # into the JSON frame header): the server span joins the caller's
+        # trace; without a header it rolls local head sampling. This task
+        # was just created, so the contextvar set inside start_span is
+        # scoped to this request.
+        with start_span("rpc.server", remote=msg.get(TRACE_KEY),
+                        method=method) as sp:
+            try:
+                if self._draining:
+                    raise RpcApplicationError("SHUTDOWN", "server draining")
+                fn = self._find_handler(method)
+                result = await fn(**args)
+                reply = {"id": req_id, "ok": True, "result": result}
+                stats.incr(f"rpc.{method}.success")
+            except RpcApplicationError as e:
+                reply = {
+                    "id": req_id,
+                    "ok": False,
+                    "error": {"code": e.code, "message": e.message, "data": e.data},
+                }
+                sp.annotate(error_code=e.code)
+                stats.incr(f"rpc.{method}.app_error")
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.exception("handler %s failed", method)
+                reply = {
+                    "id": req_id,
+                    "ok": False,
+                    "error": {"code": "INTERNAL", "message": repr(e), "data": {}},
+                }
+                sp.annotate(error_code="INTERNAL")
+                stats.incr(f"rpc.{method}.internal_error")
+            header, chunks = encode_message(reply)
+            try:
+                async with write_lock:
+                    await write_frame(writer, header, chunks)
+            except (ConnectionError, OSError):
+                pass
 
     def _find_handler(self, method: str):
         for handler in self._handlers:

@@ -22,6 +22,7 @@ import tempfile
 import time
 from typing import Dict, List, Optional
 
+from ..observability.span import start_span
 from ..utils.objectstore import ObjectStore
 from .engine import DB, DBOptions
 from .errors import StorageError
@@ -48,21 +49,29 @@ def backup_db(
         )
         existing = set()
         if incremental:
-            plen = len(prefix.rstrip("/")) + 1
-            existing = {k[plen:] for k in store.list_objects(prefix.rstrip("/") + "/")}
+            with start_span("backup.list_existing"):
+                plen = len(prefix.rstrip("/")) + 1
+                existing = {
+                    k[plen:]
+                    for k in store.list_objects(prefix.rstrip("/") + "/")
+                }
         to_upload = [
             os.path.join(ckpt_dir, f) for f in files
             if f not in existing or f == "MANIFEST"
         ]
-        store.put_objects(to_upload, prefix, parallelism=parallelism)
+        with start_span("backup.upload", files=len(to_upload),
+                        parallelism=parallelism) as sp:
+            sp.annotate(bytes=sum(os.path.getsize(p) for p in to_upload))
+            store.put_objects(to_upload, prefix, parallelism=parallelism)
         # The MANIFEST is the one mutable file: a later incremental pass
         # into the same prefix overwrites it, which would break every
         # OLDER checkpoint in the chain (its dbmeta would download a
         # manifest referencing SSTs it never listed). Keep a versioned
         # copy per pass; the SSTs themselves are immutable and retained.
         manifest_key = f"MANIFEST-{ckpt_seq:020d}"
-        store.copy_object(prefix.rstrip("/") + "/MANIFEST",
-                          prefix.rstrip("/") + "/" + manifest_key)
+        with start_span("backup.manifest_copy"):
+            store.copy_object(prefix.rstrip("/") + "/MANIFEST",
+                              prefix.rstrip("/") + "/" + manifest_key)
         dbmeta = {
             "db_name": os.path.basename(db.path),
             "files": files,
@@ -75,12 +84,15 @@ def backup_db(
         if meta:
             dbmeta.update(meta)
         payload = json.dumps(dbmeta).encode("utf-8")
-        store.put_object_bytes(prefix.rstrip("/") + "/" + DBMETA_KEY, payload)
-        # Versioned dbmeta: every past checkpoint stays restorable, which
-        # is what lets point-in-time restore pick the newest checkpoint
-        # <= to_seq (rocksdb BackupEngine's numbered-backup chain analog).
-        store.put_object_bytes(
-            f"{prefix.rstrip('/')}/{DBMETA_KEY}-{ckpt_seq:020d}", payload)
+        with start_span("backup.dbmeta_put"):
+            store.put_object_bytes(
+                prefix.rstrip("/") + "/" + DBMETA_KEY, payload)
+            # Versioned dbmeta: every past checkpoint stays restorable,
+            # which is what lets point-in-time restore pick the newest
+            # checkpoint <= to_seq (rocksdb BackupEngine's numbered-backup
+            # chain analog).
+            store.put_object_bytes(
+                f"{prefix.rstrip('/')}/{DBMETA_KEY}-{ckpt_seq:020d}", payload)
         return dbmeta
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -101,20 +113,22 @@ def restore_db(
     (``dbmeta-<seq>``); the default is the latest."""
     if os.path.exists(db_path):
         raise StorageError(f"restore target exists: {db_path}")
-    raw = store.get_object_bytes(prefix.rstrip("/") + "/" + dbmeta_key)
+    with start_span("restore.dbmeta_get"):
+        raw = store.get_object_bytes(prefix.rstrip("/") + "/" + dbmeta_key)
     dbmeta = json.loads(raw.decode("utf-8"))
     tmp = db_path + ".restoring"
     shutil.rmtree(tmp, ignore_errors=True)
     os.makedirs(tmp)
     try:
-        for f in dbmeta["files"]:
-            key = f
-            if f == "MANIFEST" and dbmeta.get("manifest_key"):
-                # download THIS checkpoint's manifest version (the bare
-                # MANIFEST object tracks the newest pass in the prefix)
-                key = dbmeta["manifest_key"]
-            store.get_object(prefix.rstrip("/") + "/" + key,
-                             os.path.join(tmp, f))
+        with start_span("restore.download", files=len(dbmeta["files"])):
+            for f in dbmeta["files"]:
+                key = f
+                if f == "MANIFEST" and dbmeta.get("manifest_key"):
+                    # download THIS checkpoint's manifest version (the bare
+                    # MANIFEST object tracks the newest pass in the prefix)
+                    key = dbmeta["manifest_key"]
+                store.get_object(prefix.rstrip("/") + "/" + key,
+                                 os.path.join(tmp, f))
         os.replace(tmp, db_path)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -29,6 +29,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from ..observability.span import start_span
 from ..utils.misc import write_file_atomic
 from ..utils.stats import Stats
 from . import wal as wal_mod
@@ -824,67 +825,99 @@ class DB:
         path = os.path.join(self.path, name)
         source = imms[0] if len(imms) == 1 else _MergedMemView(imms)
         flushed_bytes = sum(m.approximate_bytes() for m in imms)
-        t0 = time.monotonic()
-        self._write_mem_sst(path, source)
-        flush_sec = max(time.monotonic() - t0, 1e-6)
-        reader = SSTReader(path)
-        max_seq = source.max_seq
-        with self._lock:
-            rate = flushed_bytes / flush_sec
-            self._flush_rate_ewma = (
-                rate if self._flush_rate_ewma == 0.0
-                else 0.5 * self._flush_rate_ewma + 0.5 * rate
+        # Always-sampled flush trace: the sst-write vs install vs purge
+        # split is what write-stall attribution needs (BASELINE p99 <10 ms
+        # under compaction storm). ONE span with phase annotations, not
+        # child spans: under a storm the flusher is the writers' critical
+        # path, and per-flush overhead amplifies through the GIL on small
+        # hosts — phase timings are raw perf_counter deltas instead.
+        with start_span("storage.flush", always=True, memtables=len(imms),
+                        bytes=flushed_bytes) as fsp:
+            t0 = time.monotonic()
+            self._write_mem_sst(path, source)
+            flush_sec = max(time.monotonic() - t0, 1e-6)
+            reader = SSTReader(path)
+            max_seq = source.max_seq
+            t1 = time.monotonic()
+            with self._lock:
+                rate = flushed_bytes / flush_sec
+                self._flush_rate_ewma = (
+                    rate if self._flush_rate_ewma == 0.0
+                    else 0.5 * self._flush_rate_ewma + 0.5 * rate
+                )
+                self._readers[name] = reader
+                self._levels[0].append(name)
+                self._persisted_seq = max(self._persisted_seq, max_seq)
+                snapshot = self._manifest_snapshot_locked()
+                for m in imms:
+                    if self._imms and self._imms[0] is m:
+                        self._imms.pop(0)
+                self._cond.notify_all()
+            self._write_manifest_payload(*snapshot)
+            t2 = time.monotonic()
+            wal_mod.purge_obsolete(
+                self._wal_dir, self._persisted_seq,
+                self.options.wal_ttl_seconds,
+                archive_sink=self.options.wal_archive_sink,
             )
-            self._readers[name] = reader
-            self._levels[0].append(name)
-            self._persisted_seq = max(self._persisted_seq, max_seq)
-            snapshot = self._manifest_snapshot_locked()
-            for m in imms:
-                if self._imms and self._imms[0] is m:
-                    self._imms.pop(0)
-            self._cond.notify_all()
-        self._write_manifest_payload(*snapshot)
-        wal_mod.purge_obsolete(
-            self._wal_dir, self._persisted_seq, self.options.wal_ttl_seconds,
-            archive_sink=self.options.wal_archive_sink,
-        )
+            if fsp.sampled:
+                t3 = time.monotonic()
+                fsp.annotate(
+                    seq=max_seq,
+                    sst_write_ms=round(flush_sec * 1e3, 3),
+                    install_ms=round((t2 - t1) * 1e3, 3),
+                    wal_purge_ms=round((t3 - t2) * 1e3, 3),
+                )
 
     def _compact_level0_bg(self) -> None:
         """L0→L1 compaction with the merge OUTSIDE the DB lock. Safe
         because compactions (the only file removers) are serialized by
         _compaction_mutex and flushes only add files."""
-        with self._compaction_mutex:
-            with self._lock:
-                if self._closed:
-                    return
-                inputs_l0 = list(self._levels[0])
-                inputs_l1 = list(self._levels[1])
-                inputs = inputs_l0 + inputs_l1
-                if not inputs:
-                    return
-                drop = (
-                    all(not files for files in self._levels[2:])
-                    and not self.options.allow_ingest_behind
-                )
-                runs = [self._readers[n] for n in inputs]
-            out_names = self._write_merged(runs, drop_tombstones=drop)
-            with self._lock:
-                if self._closed:
-                    return
-                # newer L0 files may have arrived during the merge — keep them
-                self._levels[0] = [
-                    n for n in self._levels[0] if n not in inputs_l0
-                ]
-                self._levels[1] = out_names
-                snapshot = self._manifest_snapshot_locked()
-                dead = [(n, self._readers.pop(n, None)) for n in inputs]
-                # L0 just shrank: wake writers parked on the stop trigger
-                self._cond.notify_all()
-            # Durable manifest first, THEN delete the files it stopped
-            # referencing — all outside self._lock (the fsyncs + a few
-            # hundred unlinks under the lock were a write-stall tail).
-            self._write_manifest_payload(*snapshot)
-            self._remove_dead_files(dead)
+        # Always-sampled compaction trace: plan → merge (kernel or heap) →
+        # install → gc, the RESYSTANCE-style per-phase view of where a
+        # compaction's seconds go. Child spans are fine here: compactions
+        # are long relative to span cost (unlike the flush hot path).
+        with self._compaction_mutex, \
+                start_span("storage.compaction", always=True) as csp:
+            with start_span("compaction.plan"):
+                with self._lock:
+                    if self._closed:
+                        return
+                    inputs_l0 = list(self._levels[0])
+                    inputs_l1 = list(self._levels[1])
+                    inputs = inputs_l0 + inputs_l1
+                    if not inputs:
+                        return
+                    drop = (
+                        all(not files for files in self._levels[2:])
+                        and not self.options.allow_ingest_behind
+                    )
+                    runs = [self._readers[n] for n in inputs]
+            csp.annotate(inputs=len(inputs), backend=self._backend.name)
+            with start_span("compaction.merge"):
+                out_names = self._write_merged(runs, drop_tombstones=drop)
+            csp.annotate(outputs=len(out_names))
+            with start_span("compaction.install"):
+                with self._lock:
+                    if self._closed:
+                        return
+                    # newer L0 files may have arrived during the merge —
+                    # keep them
+                    self._levels[0] = [
+                        n for n in self._levels[0] if n not in inputs_l0
+                    ]
+                    self._levels[1] = out_names
+                    snapshot = self._manifest_snapshot_locked()
+                    dead = [(n, self._readers.pop(n, None)) for n in inputs]
+                    # L0 just shrank: wake writers parked on the stop
+                    # trigger
+                    self._cond.notify_all()
+                # Durable manifest first, THEN delete the files it stopped
+                # referencing — all outside self._lock (the fsyncs + a few
+                # hundred unlinks under the lock were a write-stall tail).
+                self._write_manifest_payload(*snapshot)
+            with start_span("compaction.gc", files=len(dead)):
+                self._remove_dead_files(dead)
 
     def _flush_locked(self) -> None:
         if self._imms:
@@ -945,36 +978,46 @@ class DB:
         The merge itself runs OUTSIDE the DB lock (writes keep flowing);
         _compaction_mutex serializes against background compaction."""
         self.flush()
-        with self._compaction_mutex:
-            with self._lock:
-                self._check_open()
-                # allow_ingest_behind reserves the true bottom level for
-                # ingested-behind data (RocksDB does the same), so full
-                # compaction targets num_levels-2 there.
-                bottom = self.options.num_levels - 1
-                if self.options.allow_ingest_behind:
-                    bottom -= 1
-                inputs: List[str] = [n for files in self._levels for n in files]
-                if not inputs:
-                    return
-                runs = [self._readers[n] for n in inputs]
+        with self._compaction_mutex, \
+                start_span("storage.compact_range", always=True) as csp:
+            with start_span("compaction.plan"):
+                with self._lock:
+                    self._check_open()
+                    # allow_ingest_behind reserves the true bottom level for
+                    # ingested-behind data (RocksDB does the same), so full
+                    # compaction targets num_levels-2 there.
+                    bottom = self.options.num_levels - 1
+                    if self.options.allow_ingest_behind:
+                        bottom -= 1
+                    inputs: List[str] = [
+                        n for files in self._levels for n in files
+                    ]
+                    if not inputs:
+                        return
+                    runs = [self._readers[n] for n in inputs]
+            csp.annotate(inputs=len(inputs), backend=self._backend.name)
             # Tombstones must survive when data can later be ingested BEHIND
             # this level — dropping them would resurrect deleted keys.
-            out_names = self._write_merged(
-                runs, drop_tombstones=not self.options.allow_ingest_behind
-            )
-            with self._lock:
-                self._check_open()
-                input_set = set(inputs)
-                # new L0 flushes may have landed during the merge: keep them
-                for files in self._levels:
-                    files[:] = [n for n in files if n not in input_set]
-                self._levels[bottom] = out_names + self._levels[bottom]
-                # Manifest first, THEN delete inputs — a crash in between
-                # leaves orphan files (harmless), never a manifest pointing
-                # at deleted ones (unopenable DB).
-                self._persist_manifest()
-                self._gc_files(inputs)
+            with start_span("compaction.merge"):
+                out_names = self._write_merged(
+                    runs,
+                    drop_tombstones=not self.options.allow_ingest_behind,
+                )
+            csp.annotate(outputs=len(out_names))
+            with start_span("compaction.install"):
+                with self._lock:
+                    self._check_open()
+                    input_set = set(inputs)
+                    # new L0 flushes may have landed during the merge: keep
+                    # them
+                    for files in self._levels:
+                        files[:] = [n for n in files if n not in input_set]
+                    self._levels[bottom] = out_names + self._levels[bottom]
+                    # Manifest first, THEN delete inputs — a crash in
+                    # between leaves orphan files (harmless), never a
+                    # manifest pointing at deleted ones (unopenable DB).
+                    self._persist_manifest()
+                    self._gc_files(inputs)
 
     def _compact_level0_locked(self) -> None:
         """L0 → L1 compaction (tombstones kept; not bottom level)."""
@@ -1152,23 +1195,28 @@ class DB:
         checkpoint-backup path (admin_handler.cpp:996-1129). Returns the
         sequence number the snapshot actually contains, captured under the
         DB lock — writes landing after this call are not in the snapshot."""
-        with self._lock:
+        with start_span("storage.checkpoint") as sp, self._lock:
             self._check_open()
             # drain any in-flight background flush, then flush synchronously
-            self._drain_imm_locked()
-            self._flush_locked()
+            with start_span("checkpoint.flush"):
+                self._drain_imm_locked()
+                self._flush_locked()
             if os.path.exists(checkpoint_dir):
                 raise InvalidArgument(f"checkpoint dir exists: {checkpoint_dir}")
             os.makedirs(checkpoint_dir)
-            for files in self._levels:
-                for name in files:
-                    src = os.path.join(self.path, name)
-                    dst = os.path.join(checkpoint_dir, name)
-                    try:
-                        os.link(src, dst)
-                    except OSError:
-                        shutil.copyfile(src, dst)
-            self._persist_manifest(target_dir=checkpoint_dir)
+            nfiles = 0
+            with start_span("checkpoint.link"):
+                for files in self._levels:
+                    for name in files:
+                        src = os.path.join(self.path, name)
+                        dst = os.path.join(checkpoint_dir, name)
+                        try:
+                            os.link(src, dst)
+                        except OSError:
+                            shutil.copyfile(src, dst)
+                        nfiles += 1
+                self._persist_manifest(target_dir=checkpoint_dir)
+            sp.annotate(files=nfiles, seq=self._last_seq)
             return self._last_seq
 
     def ingest_external_file(

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability.span import start_span
 from ..storage.bloom import num_words_for
 from ..storage.engine import DBOptions
 from ..ops.bloom_tpu import bloom_build_tpu
@@ -121,46 +122,65 @@ class TpuCompactionService:
         capacity = _next_pow2(max(b.capacity for b in batches))
         num_words = num_words_for(capacity, self._bits_per_key)
         jnp = self._jnp
-        stacked = {
-            name: jnp.asarray(np.stack([
-                _pad_to(getattr(b, name), capacity) for b in batches
-            ]))
-            for name in (
-                "key_words_be", "key_len", "seq_hi",
-                "seq_lo", "vtype", "val_words", "val_len", "valid",
-            )
-        }
-        flags = [fast_flags(b.key_len, b.seq_hi, b.valid) for b in batches]
-        uniform_klen = all(u for u, _, _ in flags)
-        seq32 = all(s for _, s, _ in flags)
-        key_words = max(k for _, _, k in flags)
-        fn = self._pipeline(merge_kind, drop_tombstones, num_words,
-                            uniform_klen, seq32, key_words)
-        out = fn(
-            stacked["key_words_be"],
-            stacked["key_len"], stacked["seq_hi"], stacked["seq_lo"],
-            stacked["vtype"], stacked["val_words"], stacked["val_len"],
-            stacked["valid"],
-        )
-        host = {k: np.asarray(v) for k, v in out.items()}
-        results = []
-        for s in range(len(batches)):
-            if bool(host["needs_cpu_fallback"][s]):
-                results.append(self._cpu_recompute(
-                    batches[s], merge_kind, drop_tombstones, num_words))
-                continue
-            count = int(host["count"][s])
-            entries = unpack_entries(
-                host["key_words_be"][s], host["key_len"][s],
-                host["seq_hi"][s], host["seq_lo"][s], host["vtype"][s],
-                host["val_words"][s], host["val_len"][s], count,
-            )
-            results.append({
-                "entries": entries,
-                "bloom_words": host["bloom"][s],
-                "count": count,
-            })
-        return results
+        # The job-level trace answers "where does a shard-batch's wall
+        # clock go": host stack+H2D staging vs kernel+D2H readback vs
+        # host unpack — the split the round-1 profile found dominated by
+        # transfer (SURVEY §7), now attributable per job.
+        with start_span("tpu.compact_batch", always=True,
+                        shards=len(batches), capacity=capacity) as jsp:
+            with start_span("tpu.stage"):
+                stacked = {
+                    name: jnp.asarray(np.stack([
+                        _pad_to(getattr(b, name), capacity) for b in batches
+                    ]))
+                    for name in (
+                        "key_words_be", "key_len", "seq_hi",
+                        "seq_lo", "vtype", "val_words", "val_len", "valid",
+                    )
+                }
+            flags = [fast_flags(b.key_len, b.seq_hi, b.valid)
+                     for b in batches]
+            uniform_klen = all(u for u, _, _ in flags)
+            seq32 = all(s for _, s, _ in flags)
+            key_words = max(k for _, _, k in flags)
+            fn = self._pipeline(merge_kind, drop_tombstones, num_words,
+                                uniform_klen, seq32, key_words)
+            with start_span("tpu.kernel"):
+                out = fn(
+                    stacked["key_words_be"],
+                    stacked["key_len"], stacked["seq_hi"], stacked["seq_lo"],
+                    stacked["vtype"], stacked["val_words"],
+                    stacked["val_len"], stacked["valid"],
+                )
+                # np.asarray blocks on the device: readback time lands in
+                # the kernel span (dispatch is async; the two are not
+                # separable without a device profiler)
+                host = {k: np.asarray(v) for k, v in out.items()}
+            results = []
+            fallbacks = 0
+            with start_span("tpu.unpack"):
+                for s in range(len(batches)):
+                    if bool(host["needs_cpu_fallback"][s]):
+                        fallbacks += 1
+                        results.append(self._cpu_recompute(
+                            batches[s], merge_kind, drop_tombstones,
+                            num_words))
+                        continue
+                    count = int(host["count"][s])
+                    entries = unpack_entries(
+                        host["key_words_be"][s], host["key_len"][s],
+                        host["seq_hi"][s], host["seq_lo"][s],
+                        host["vtype"][s], host["val_words"][s],
+                        host["val_len"][s], count,
+                    )
+                    results.append({
+                        "entries": entries,
+                        "bloom_words": host["bloom"][s],
+                        "count": count,
+                    })
+            if fallbacks:
+                jsp.annotate(cpu_fallbacks=fallbacks)
+            return results
 
     def compact_shard_stream(
         self,
@@ -179,6 +199,13 @@ class TpuCompactionService:
         staging cost ~3.7x the kernel (SURVEY §7 front-load item 2)."""
         if not batches:
             return []
+        with start_span("tpu.compact_stream", always=True,
+                        shards=len(batches), group_size=group_size):
+            return self._compact_shard_stream(
+                batches, merge_kind, drop_tombstones, group_size)
+
+    def _compact_shard_stream(self, batches, merge_kind, drop_tombstones,
+                              group_size):
         jax = self._jax
         capacity = _next_pow2(max(b.capacity for b in batches))
         num_words = num_words_for(capacity, self._bits_per_key)

@@ -6,7 +6,9 @@ Reference: common/stats/status_server.{h,cpp} — libmicrohttpd server on port
 ``/``. Here: stdlib ThreadingHTTPServer; ``/dump_heap`` is a
 tracemalloc-based heap profile (start on first hit, report+stop on the
 next), ``/threads.txt`` is a Python stack dump, and ``/rocksdb_info.txt``
-maps to ``/storage_info.txt``.
+maps to ``/storage_info.txt``. The tracing subsystem adds ``/traces``
+(recent sampled traces as JSON, for machines and cross-process stitching)
+and ``/traces.txt`` (per-trace waterfall, for humans).
 """
 
 from __future__ import annotations
@@ -45,6 +47,8 @@ class StatusServer:
             "/gflags.txt": FLAGS.dump_text,  # reference-compatible alias
             "/threads.txt": _dump_threads,
             "/dump_heap": _dump_heap,
+            "/traces": _dump_traces_json,
+            "/traces.txt": _dump_traces_waterfall,
         }
         if extra_endpoints:
             self._endpoints.update(extra_endpoints)
@@ -127,6 +131,21 @@ class StatusServer:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+
+
+def _dump_traces_json() -> str:
+    """Recent sampled traces as JSON (observability/collector.py). Each
+    span carries its process label, so stitching a cross-process trace is
+    'fetch /traces from every node, union spans, join on trace_id'."""
+    from ..observability.collector import SpanCollector
+
+    return SpanCollector.get().to_json_text()
+
+
+def _dump_traces_waterfall() -> str:
+    from ..observability.collector import SpanCollector
+
+    return SpanCollector.get().waterfall_text()
 
 
 def _dump_threads() -> str:

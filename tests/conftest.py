@@ -45,9 +45,11 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def _fresh_singletons():
     """Reset process-wide singletons between tests."""
+    from rocksplicator_tpu.observability.collector import SpanCollector
     from rocksplicator_tpu.utils.stats import Stats
 
     Stats.reset_for_test()
+    SpanCollector.reset_for_test()
     yield
 
 

@@ -44,11 +44,20 @@ def _summarize(direction: str, header: memoryview, payload: memoryview,
         print(f"[{conn_id}] {direction} <undecodable: {e}>")
         return
     method = msg.get("method")
+    # sampled trace context rides the frame header (rpc/client.py): print
+    # the trace id so wire captures join in-process /traces on one id.
+    # Sanitized before printing — ids are peer-supplied bytes and this
+    # line is an operator-terminal/log sink (same rule as
+    # observability/context.valid_wire_context).
+    tctx = msg.get("trace")
+    tid = tctx.get("trace_id") if isinstance(tctx, dict) else None
+    trace = (f" trace={tid[:64]}"
+             if isinstance(tid, str) and tid and tid[:64].isalnum() else "")
     if method is not None:  # request
         if method_re and not method_re.search(method):
             return
         line = (f"[{conn_id}] {direction} call id={msg.get('id')} "
-                f"method={method} payload={len(payload)}B")
+                f"method={method}{trace} payload={len(payload)}B")
         if show_args:
             args = {
                 k: (f"<{len(v)}B>" if isinstance(v, (bytes, memoryview)) else v)
