@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test test-fast native bench loadsst-bench soak-bench clean
+.PHONY: test test-fast native bench loadsst-bench soak-bench repl-bench-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -21,6 +21,13 @@ loadsst-bench:
 
 soak-bench:
 	$(PY) -m benchmarks.soak_bench --shards 256
+
+# fast pipelined-replication regression smoke: few shards, few seconds,
+# fails loudly if the write window stops pipelining or acked writes lose
+repl-bench-smoke:
+	$(PY) -m benchmarks.replication_3replica_bench --shards 8 --keys 50 \
+		--write_window 64 \
+		--out benchmarks/results/replication_3replica_smoke.json
 
 clean:
 	$(MAKE) -C rocksplicator_tpu/storage/native clean

@@ -37,7 +37,8 @@ def log(msg):
 
 
 def _spawn(role, port, db_dir, shards, keys, threads, value_bytes,
-           upstream_port=0, mode=1, linger=60, trace=False):
+           upstream_port=0, mode=1, linger=60, trace=False,
+           write_window=64, executor_threads=2):
     cmd = [
         sys.executable, "-m", "rocksplicator_tpu.replication.performance",
         "--role", role, "--port", str(port), "--db_dir", db_dir,
@@ -47,6 +48,11 @@ def _spawn(role, port, db_dir, shards, keys, threads, value_bytes,
         "--value_size", str(value_bytes),
         "--replication_mode", str(mode),
         "--linger_sec", str(linger),
+        "--write_window", str(write_window),
+        # this bench targets small (2-4 core) CI hosts: a lean executor
+        # avoids pure GIL thrash (serve is inline on the loop; executor
+        # work is cold WAL scans and follower applies)
+        "--executor_threads", str(executor_threads),
     ]
     if trace:
         cmd += ["--trace"]
@@ -109,6 +115,9 @@ def main():
     ap.add_argument("--keys", type=int, default=200)
     ap.add_argument("--threads", type=int, default=2)
     ap.add_argument("--value_bytes", type=int, default=1024)
+    ap.add_argument("--write_window", type=int, default=64,
+                    help="leader max in-flight (unacked) writes per shard; "
+                         "1 = the old serial blocking write path")
     ap.add_argument("--leader_port", type=int, default=29391)
     ap.add_argument("--trace", action="store_true",
                     help="sample per-write traces in the leader and report "
@@ -136,11 +145,13 @@ def main():
         leader = _spawn("leader", args.leader_port,
                         os.path.join(tmp, "l"), args.shards, args.keys,
                         args.threads, args.value_bytes, linger=90,
-                        trace=args.trace)
+                        trace=args.trace, write_window=args.write_window)
         # parse the leader's throughput line while it runs; with --trace
         # the slowest-write span tree is emitted (between markers) BEFORE
         # the throughput line, so this same loop captures it
         leader_line = None
+        acked_line = None
+        ack_window_line = None
         trace_lines = []
         in_trace = False
         for line in leader.stdout:
@@ -151,6 +162,17 @@ def main():
                 trace_lines.append(line.rstrip("\n"))
                 if line.startswith("TRACE-SLOWEST-WRITE-END"):
                     in_trace = False
+                continue
+            m = re.search(
+                r"TRACE-ACK-WINDOW sampled_ack_waits=(\d+) "
+                r"max_overlapping=(\d+) max_window_depth=(\d+)", line)
+            if m:
+                ack_window_line = (int(m.group(1)), int(m.group(2)),
+                                   int(m.group(3)))
+                continue
+            m = re.search(r"leader acked (\d+)/(\d+) writes", line)
+            if m:
+                acked_line = (int(m.group(1)), int(m.group(2)))
                 continue
             m = re.search(r"wrote ~([\d.]+) MB in ([\d.]+)s", line)
             if m:
@@ -184,12 +206,18 @@ def main():
                 "shards": args.shards, "writer_threads": args.threads,
                 "keys_per_shard_thread": args.keys,
                 "value_bytes": args.value_bytes,
+                "write_window": args.write_window,
             },
             "results": {
-                "writes_acked": total_writes,
+                "writes_acked": acked_line[0] if acked_line else total_writes,
+                "writes_total": total_writes,
                 "leader_mb": mb,
                 "leader_elapsed_s": elapsed,
                 "writes_per_sec": round(total_writes / elapsed, 1),
+                "acked_writes_per_sec": round(
+                    (acked_line[0] if acked_line else total_writes)
+                    / elapsed, 1),
+                "write_window": args.write_window,
                 "mb_per_sec": round(mb / elapsed, 2),
                 "follower_seqs": [seqs[0], seqs[1]],
                 "both_followers_converged": bool(
@@ -198,6 +226,12 @@ def main():
                 "acked_write_loss": max(0, want - min(seqs.values())),
             },
         }
+        if ack_window_line:
+            result["results"]["ack_window_trace"] = {
+                "sampled_ack_waits": ack_window_line[0],
+                "max_overlapping_ack_waits": ack_window_line[1],
+                "max_window_depth": ack_window_line[2],
+            }
         if args.trace and trace_lines:
             result["slowest_write_trace"] = trace_lines
         roof = host_roofline(tmp, args.value_bytes)

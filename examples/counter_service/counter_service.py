@@ -56,6 +56,17 @@ class CounterHandler(AdminHandler):
 
     # -- helpers -----------------------------------------------------------
 
+    async def _write_replicated(self, app_db, batch) -> int:
+        """Pipelined replicated write: the WAL commit runs in the admin
+        executor (it can block on flow control / storage admission), but
+        the semi-sync ACK wait is awaited on the loop via the write's ack
+        future — an executor thread is no longer parked for the whole
+        follower round-trip, so in-flight counter writes are bounded by
+        the per-shard write window instead of the executor size."""
+        waiter = await self._run(app_db.write_async, batch)
+        await asyncio.wrap_future(waiter.future)
+        return waiter.seq
+
     def _local_db_for(self, counter_name: str):
         if self.router is None or self.router.num_shards == 0:
             raise RpcApplicationError("NO_SHARD_MAP", "router not configured")
@@ -104,7 +115,7 @@ class CounterHandler(AdminHandler):
         batch = WriteBatch().put(
             counter_name.encode("utf-8"), _I64.pack(counter_value)
         )
-        await self._run(app_db.write, batch)
+        await self._write_replicated(app_db, batch)
         return {}
 
     async def handle_bump_counter(
@@ -122,7 +133,7 @@ class CounterHandler(AdminHandler):
                 )
             raise RpcApplicationError("NOT_LEADER", db_name)
         batch = WriteBatch().merge(counter_name.encode("utf-8"), _I64.pack(delta))
-        await self._run(app_db.write, batch)
+        await self._write_replicated(app_db, batch)
         return {}
 
 

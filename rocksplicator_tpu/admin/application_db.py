@@ -66,6 +66,22 @@ class ApplicationDB:
         self._stats.incr(tagged("applicationdb.writes", db=self.name))
         return seq
 
+    def write_async(self, batch: WriteBatch):
+        """Pipelined write: WAL-commit now, return an AckWaiter whose
+        ``future`` (a concurrent.futures.Future) resolves when the
+        replication ack condition is met — async handlers await it via
+        asyncio.wrap_future instead of parking an executor thread per
+        in-flight write. Unreplicated DBs return an already-resolved
+        waiter."""
+        from ..replication.ack_window import resolved_waiter
+
+        if self.replicated_db is not None:
+            waiter = self.replicated_db.write_async(batch)
+        else:
+            waiter = resolved_waiter(self.db.write(batch))
+        self._stats.incr(tagged("applicationdb.writes", db=self.name))
+        return waiter
+
     # -- reads -------------------------------------------------------------
 
     def get(self, key: bytes) -> Optional[bytes]:

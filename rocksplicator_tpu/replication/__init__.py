@@ -9,13 +9,14 @@ Per-shard leader/follower chained replication:
 """
 
 from .wire import ReplicaRole, ReplicateErrorCode, REPLICATOR_METRICS
+from .ack_window import AckWaiter, AckWindow, MaxNumberBox
 from .db_wrapper import DbWrapper, StorageDbWrapper
-from .max_number_box import MaxNumberBox
 from .replicated_db import ReplicatedDB, ReplicationFlags
 from .replicator import Replicator
 
 __all__ = [
     "ReplicaRole", "ReplicateErrorCode", "REPLICATOR_METRICS",
     "DbWrapper", "StorageDbWrapper", "MaxNumberBox",
+    "AckWaiter", "AckWindow",
     "ReplicatedDB", "ReplicationFlags", "Replicator",
 ]

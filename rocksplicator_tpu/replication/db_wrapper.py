@@ -23,6 +23,21 @@ class DbWrapper:
         """Apply a leader-side write. Returns the batch's start seq."""
         raise NotImplementedError
 
+    def write_to_leader_many(self, batches) -> int:
+        """Apply a GROUP of leader-side writes in order; returns the
+        FIRST batch's start seq (each batch occupies its own contiguous
+        seq range after it). Wrappers with a batched engine path
+        override this to amortize per-write costs (lock, WAL flush);
+        the default preserves the one-by-one contract."""
+        first = None
+        for b in batches:
+            seq = self.write_to_leader(b)
+            if first is None:
+                first = seq
+        if first is None:
+            raise ValueError("write_to_leader_many: empty group")
+        return first
+
     def get_updates_from_leader(
         self, since_seq: int
     ) -> Iterator[Tuple[int, bytes]]:
@@ -34,9 +49,25 @@ class DbWrapper:
     def latest_sequence_number(self) -> int:
         raise NotImplementedError
 
+    def latest_sequence_number_relaxed(self) -> int:
+        """Lock-free/stale-tolerant seq read for introspection paths that
+        must never block behind flush/compaction holding the storage
+        lock. Wrappers without a cheap relaxed read fall back to the
+        locking one."""
+        return self.latest_sequence_number()
+
     def handle_replicate_response(self, raw_data: bytes, timestamp_ms: Optional[int]) -> None:
         """Apply one replicated update locally (follower path)."""
         raise NotImplementedError
+
+    def handle_replicate_updates(self, updates) -> None:
+        """Apply a GROUP of replicated updates (one pull response) in
+        order. Wrappers with a batched write path override this to
+        amortize per-record costs; the default preserves the one-by-one
+        contract for existing wrappers (test proxies, CDC observers)."""
+        for u in updates:
+            self.handle_replicate_response(
+                bytes(u["raw_data"]), u.get("timestamp"))
 
 
 class StorageDbWrapper(DbWrapper):
@@ -51,18 +82,41 @@ class StorageDbWrapper(DbWrapper):
     def write_to_leader(self, batch: WriteBatch) -> int:
         return self.db.write(batch)
 
+    def write_to_leader_many(self, batches) -> int:
+        return self.db.write_many([(b, None) for b in batches])
+
     def get_updates_from_leader(
         self, since_seq: int
     ) -> Iterator[Tuple[int, bytes]]:
-        return self.db.get_updates_since(since_seq)
+        # resumable tail cursor (resumable=True): the serve path's
+        # IterCache keeps it across pulls even when a response drains to
+        # the live tail, so steady-state serving never re-scans the
+        # active WAL segment
+        return self.db.get_updates_cursor(since_seq)
 
     def latest_sequence_number(self) -> int:
         return self.db.latest_sequence_number()
+
+    def latest_sequence_number_relaxed(self) -> int:
+        return self.db.latest_sequence_number_relaxed()
 
     def handle_replicate_response(self, raw_data: bytes, timestamp_ms: Optional[int]) -> None:
         # The raw batch still carries the leader's LOG_DATA timestamp, so
         # applying it verbatim preserves the stamp for chained downstream
         # followers (reference re-stamps explicitly; here the bytes already
-        # contain it).
+        # contain it). Passing the raw bytes through skips the WAL
+        # re-encode — decode + encode per applied update was pure waste on
+        # the follower apply hot path.
         batch = decode_batch(raw_data)
-        self.db.write(batch)
+        self.db.write(batch, encoded=bytes(raw_data))
+
+    def handle_replicate_updates(self, updates) -> None:
+        """Batched apply: one engine write_many per pull response — one
+        storage-lock pass and ONE WAL flush for the whole group (the
+        per-record flush syscall dominated the apply hot path once
+        leader writes pipelined)."""
+        items = []
+        for u in updates:
+            raw = bytes(u["raw_data"])
+            items.append((decode_batch(raw), raw))
+        self.db.write_many(items)

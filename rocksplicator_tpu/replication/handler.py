@@ -26,12 +26,14 @@ class ReplicatorHandler:
         max_wait_ms: Optional[int] = None,
         max_updates: Optional[int] = None,
         role: str = ReplicaRole.FOLLOWER.value,
+        applied_seq: Optional[int] = None,
     ) -> dict:
         span = current_span()
         if span is not None and span.sampled:
             # tag the enclosing rpc.server span: /traces readers filter
             # replicate traffic by shard without opening child spans
-            span.annotate(db=db_name, from_seq=seq_no)
+            span.annotate(db=db_name, from_seq=seq_no,
+                          max_updates=max_updates)
         db = self._dbs.get(db_name)
         if db is None or db.removed:
             raise RpcApplicationError(
@@ -41,5 +43,22 @@ class ReplicatorHandler:
         # progress) and source_role (puller's stale-leader detection).
         return await db.handle_replicate_request(
             seq_no=seq_no, max_wait_ms=max_wait_ms,
-            max_updates=max_updates, role=role,
+            max_updates=max_updates, role=role, applied_seq=applied_seq,
         )
+
+    async def handle_replicate_ack(
+        self,
+        db_name: str = "",
+        applied_seq: int = 0,
+        role: str = ReplicaRole.FOLLOWER.value,
+    ) -> dict:
+        """Lightweight applied-position push from a pipelined puller whose
+        next pull is a parked long-poll: lets mode-2 ack waiters resolve
+        at the follower's apply time instead of the next pull."""
+        db = self._dbs.get(db_name)
+        if db is None or db.removed:
+            raise RpcApplicationError(
+                ReplicateErrorCode.SOURCE_NOT_FOUND.value, db_name
+            )
+        db.post_applied(applied_seq, role)
+        return {"acked_seq": db._acked.value}

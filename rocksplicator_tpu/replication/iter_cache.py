@@ -29,16 +29,34 @@ class _Cursor:
 
 
 class IterCache:
+    """next_seq → cursors. Multiple cursors may share one key: two
+    followers tailing the same shard both park at the same next_seq, and
+    a single-slot map would make them evict each other every pull (each
+    miss re-scans the active WAL segment — the exact cost the cache
+    exists to avoid)."""
+
     def __init__(self, idle_timeout_sec: float = 60.0, max_cursors: int = 8):
         self._idle_timeout = idle_timeout_sec
         self._max = max_cursors
         self._lock = threading.Lock()
-        self._cursors: Dict[int, _Cursor] = {}
+        self._cursors: Dict[int, List[_Cursor]] = {}
+
+    @staticmethod
+    def _close(cur: _Cursor) -> None:
+        close = getattr(cur.it, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
 
     def take(self, next_seq: int) -> Optional[Iterator[Tuple[int, bytes]]]:
         """Pop a cursor positioned at next_seq, if cached."""
         with self._lock:
-            cur = self._cursors.pop(next_seq, None)
+            lst = self._cursors.get(next_seq)
+            cur = lst.pop() if lst else None
+            if lst is not None and not lst:
+                self._cursors.pop(next_seq, None)
         if cur is not None:
             Stats.get().incr(M["iter_cache_hits"])
             return cur.it
@@ -46,25 +64,46 @@ class IterCache:
         return None
 
     def put(self, next_seq: int, it: Iterator[Tuple[int, bytes]]) -> None:
+        evicted = None
         with self._lock:
-            self._cursors[next_seq] = _Cursor(it, next_seq)
-            if len(self._cursors) > self._max:
-                oldest = min(self._cursors, key=lambda k: self._cursors[k].last_used)
-                del self._cursors[oldest]
+            self._cursors.setdefault(next_seq, []).append(_Cursor(it, next_seq))
+            total = sum(len(v) for v in self._cursors.values())
+            if total > self._max:
+                oldest_key = min(
+                    self._cursors,
+                    key=lambda k: min(c.last_used for c in self._cursors[k]),
+                )
+                lst = self._cursors[oldest_key]
+                lst.sort(key=lambda c: c.last_used)
+                evicted = lst.pop(0)
+                if not lst:
+                    del self._cursors[oldest_key]
+        if evicted is not None:
+            self._close(evicted)
 
     def evict_idle(self, now: Optional[float] = None) -> int:
         """Reference CachedIterCleaner behavior; called by the replicator's
         periodic maintenance task."""
         now = time.monotonic() if now is None else now
+        evicted: List[_Cursor] = []
         with self._lock:
-            stale = [
-                k for k, c in self._cursors.items()
-                if now - c.last_used > self._idle_timeout
-            ]
-            for k in stale:
-                del self._cursors[k]
-            return len(stale)
+            for k in list(self._cursors):
+                lst = self._cursors[k]
+                keep = []
+                for c in lst:
+                    (keep if now - c.last_used <= self._idle_timeout
+                     else evicted).append(c)
+                if keep:
+                    self._cursors[k] = keep
+                else:
+                    del self._cursors[k]
+        for c in evicted:
+            self._close(c)
+        return len(evicted)
 
     def clear(self) -> None:
         with self._lock:
+            dropped = [c for lst in self._cursors.values() for c in lst]
             self._cursors.clear()
+        for c in dropped:
+            self._close(c)

@@ -1,32 +1,12 @@
-"""MaxNumberBox: tracks the max ACKed sequence number and wakes waiters.
+"""Compatibility shim: MaxNumberBox moved to ack_window.py.
 
-Reference: rocksdb_replicator/max_number_box.h:38-83 — ``post(n)`` raises
-the box's number and wakes waiters whose target ≤ n; ``wait(num, timeout)``
-blocks leader writes in semi-sync/sync mode until the box reaches ``num``.
+The leader ack path now uses :class:`~.ack_window.AckWindow` (windowed
+in-flight writes with ack futures); the plain max-watermark box remains
+available here for existing importers and tests.
 """
 
 from __future__ import annotations
 
-import threading
+from .ack_window import MaxNumberBox
 
-
-class MaxNumberBox:
-    def __init__(self, initial: int = 0):
-        self._max = initial
-        self._cond = threading.Condition()
-
-    @property
-    def value(self) -> int:
-        with self._cond:
-            return self._max
-
-    def post(self, number: int) -> None:
-        with self._cond:
-            if number > self._max:
-                self._max = number
-                self._cond.notify_all()
-
-    def wait(self, number: int, timeout_sec: float) -> bool:
-        """True iff the box reached ``number`` within the timeout."""
-        with self._cond:
-            return self._cond.wait_for(lambda: self._max >= number, timeout_sec)
+__all__ = ["MaxNumberBox"]

@@ -26,20 +26,21 @@ import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional, Tuple
 
 from ..observability.context import wire_context
-from ..observability.span import start_span
+from ..observability.span import Span, start_span
 from ..rpc.client_pool import RpcClientPool
 from ..rpc.errors import RpcApplicationError, RpcConnectionError, RpcError
-from ..storage.records import WriteBatch, decode_batch
+from ..storage.records import WriteBatch, decode_batch, scan_batch_meta
 from ..utils.misc import now_ms
 from ..utils.stats import Stats, tagged
+from .ack_window import AckWaiter, AckWindow, resolved_waiter
 from .cond_var import AsyncNotifier
 from .db_wrapper import DbWrapper
 from .iter_cache import IterCache
-from .max_number_box import MaxNumberBox
 from .wire import REPLICATOR_METRICS as M
 from .wire import ReplicaRole, ReplicateErrorCode
 
@@ -70,6 +71,16 @@ class ReplicationFlags:
     # failover convergence window at 4000 shards)
     conn_errors_before_forced_reset: int = 3
     pull_rpc_margin_ms: int = 5_000
+    # leader write pipelining: max in-flight (unacked) writes per shard.
+    # write_async blocks only when the window is full — the back-pressure
+    # that bounds the unacked backlog. 1 degenerates to the old
+    # one-write-in-flight blocking behavior.
+    write_window: int = 64
+    # follower pull adaptivity: when the upstream reports a backlog, the
+    # next pull asks for up to this many updates (instead of the fixed
+    # max_updates_per_response) so one response acks a whole write
+    # window; also the server-side clamp on any requested max_updates
+    adaptive_max_updates_cap: int = 1024
 
 
 class ReplicatedDB:
@@ -97,16 +108,37 @@ class ReplicatedDB:
         self._pool = pool
         self._leader_resolver = leader_resolver
         self._notifier = AsyncNotifier(loop)
-        self._acked = MaxNumberBox()
+        self._acked = AckWindow(
+            capacity=self.flags.write_window, on_resolve=self._on_ack_resolve
+        )
         self._iter_cache = IterCache()
         self._removed = False
         self._pull_task: Optional[asyncio.Task] = None
-        # ACK degradation state (replicated_db.cpp:236-273)
+        # ACK degradation state (replicated_db.cpp:236-273); resolutions
+        # arrive from writer threads AND the loop's expiry timer, so the
+        # counters live behind a lock now that writes pipeline
+        self._ack_state_lock = threading.Lock()
         self._consecutive_ack_timeouts = 0
         self._degraded = False
+        # ack-expiry timer: one loop timer per shard, armed for the
+        # earliest pending waiter deadline (uniform timeouts ⇒ FIFO
+        # deadlines ⇒ the common registration path skips the loop hop)
+        self._expiry_lock = threading.Lock()
+        self._expiry_deadline: Optional[float] = None
+        self._expiry_handle: Optional[asyncio.TimerHandle] = None
+        # follower pull pipeline state (loop thread only)
+        self._apply_future = None
+        self._apply_target: Optional[int] = None
+        self._applied_through: Optional[int] = None
+        self._cur_max_updates = self.flags.max_updates_per_response
+        self._upstream_mode: Optional[int] = None  # learned from responses
         self._empty_pulls = 0
         self._conn_errors = 0
         self._stats = Stats.get()
+        # serves handled since start: benches/ops gate their write phase
+        # on every shard having a live puller (a shard whose pullers are
+        # all in connect backoff times out its whole first write window)
+        self.serve_count = 0
         # seq -> wire trace context of a SAMPLED write at that seq: lets the
         # serve path attach the originating write's trace to the updates it
         # ships, so a follower's apply span joins the LEADER's write trace
@@ -134,6 +166,12 @@ class ReplicatedDB:
         if task is not None:
             self._loop.call_soon_threadsafe(task.cancel)
             self._pull_task = None
+        self._acked.close()  # no writer may hang on an in-flight ack
+        with self._expiry_lock:
+            handle, self._expiry_handle = self._expiry_handle, None
+            self._expiry_deadline = None
+        if handle is not None:
+            self._loop.call_soon_threadsafe(handle.cancel)
         self._notifier.notify_all_threadsafe()
         self._iter_cache.clear()
 
@@ -146,14 +184,47 @@ class ReplicatedDB:
     # ------------------------------------------------------------------
 
     def write(self, batch: WriteBatch) -> int:
+        """Blocking write: pipeline entry + wait for the ack future.
+        Exactly the old semantics (returns the seq whether the ack landed
+        or timed out; timeouts feed the degradation state machine) but
+        expressed over write_async, so sync and async writers share one
+        code path."""
+        start = time.monotonic()
+        waiter = self.write_async(batch)
+        try:
+            # Belt and braces on the old MaxNumberBox.wait(num, timeout)
+            # contract: the future normally resolves via ack or the
+            # loop's expiry timer, but a wedged/stopped loop must not
+            # turn a 2000ms ack timeout into an unbounded hang. The
+            # margin covers timer latency; on expiry the degradation
+            # accounting still runs whenever the window resolves.
+            waiter.result(max(0.0, waiter.deadline - time.monotonic()) + 2.0)
+        except FuturesTimeoutError:
+            log.warning("%s: ack expiry timer overdue; returning after "
+                        "local wait deadline", self.name)
+        self._stats.add_metric(M["leader_write_ms"], (time.monotonic() - start) * 1e3)
+        return waiter.seq
+
+    def write_async(self, batch: WriteBatch) -> AckWaiter:
+        """Pipelined write: stamp + WAL-write immediately (fsync is
+        group-committed by the engine), register an ack waiter in the
+        AckWindow, and return without blocking on the follower
+        round-trip. The returned waiter's ``future`` resolves to the
+        batch's start seq when the ack arrives or its timeout expires;
+        ``.acked`` records which. Blocks only when the shard's write
+        window (flags.write_window) is full — the flow control that
+        bounds the unacked backlog. Must not be called from the IO loop
+        thread (it may block on flow control; the loop drives acks).
+        """
         if self.role not in (ReplicaRole.LEADER, ReplicaRole.NOOP):
             raise RpcApplicationError(
                 "NOT_LEADER", f"{self.name} role is {self.role.value}"
             )
-        start = time.monotonic()
-        # The per-write trace (ISSUE: "profile one write's 4.6 ms"): root
-        # span with wal_write (through fsync) and ack_wait phases. Head
-        # sampled — with sampling off this costs one contextvar set/reset.
+        # The per-write trace: root span with wal_write through fsync;
+        # the ack_wait phase becomes a DEFERRED child span finished at
+        # ack resolution, so sampled traces show the real (overlapping)
+        # in-flight windows. Head sampled — with sampling off this costs
+        # one contextvar set/reset.
         with start_span("repl.write", db=self.name) as sp:
             batch.stamp_timestamp_ms()
             with start_span("repl.wal_write"):
@@ -168,9 +239,161 @@ class ReplicatedDB:
             self._notifier.notify_all_threadsafe()
             if (self.replication_mode in (1, 2)
                     and self.role is ReplicaRole.LEADER):
-                self._write_wait_follower_ack(end_seq)
-        self._stats.add_metric(M["leader_write_ms"], (time.monotonic() - start) * 1e3)
-        return seq
+                return self._register_ack_wait(end_seq, seq, sp)
+        return resolved_waiter(seq)
+
+    def write_async_many(self, batches: List[WriteBatch]) -> List[AckWaiter]:
+        """Pipelined GROUP write: commit every batch with one storage
+        lock pass and ONE WAL flush (engine ``write_many``), one
+        follower wakeup, and one stats update — then register one ack
+        waiter per batch. The per-write flush syscall + notify + stats
+        were the dominant leader-side issue cost once writes pipelined;
+        a writer topping up a shard's window issues its writes
+        back-to-back, which is exactly the shape this amortizes. Same
+        per-batch ack/timeout/degradation semantics as N
+        ``write_async`` calls; may block on window flow control."""
+        if not batches:
+            return []
+        if self.role not in (ReplicaRole.LEADER, ReplicaRole.NOOP):
+            raise RpcApplicationError(
+                "NOT_LEADER", f"{self.name} role is {self.role.value}"
+            )
+        with start_span("repl.write_group", db=self.name,
+                        n=len(batches)) as sp:
+            total_bytes = 0
+            for b in batches:
+                b.stamp_timestamp_ms()
+                total_bytes += b.byte_size()
+            with start_span("repl.wal_write"):
+                first_seq = self.wrapper.write_to_leader_many(batches)
+            if sp.sampled:
+                sp.annotate(seq=first_seq, bytes=total_bytes)
+                self._remember_write_trace(first_seq, sp)
+            self._stats.incr(M["leader_writes"], len(batches))
+            self._stats.incr(M["leader_write_bytes"], total_bytes)
+            self._notifier.notify_all_threadsafe()
+            acking = (self.replication_mode in (1, 2)
+                      and self.role is ReplicaRole.LEADER)
+            waiters: List[AckWaiter] = []
+            seq = first_seq
+            for b in batches:
+                end_seq = seq + b.count() - 1
+                if acking:
+                    waiters.append(self._register_ack_wait(end_seq, seq, sp))
+                else:
+                    waiters.append(resolved_waiter(seq))
+                seq = end_seq + 1
+        return waiters
+
+    @property
+    def ack_window_depth(self) -> int:
+        """Current in-flight (unacked) writes in this shard's window."""
+        return self._acked.depth
+
+    @property
+    def ack_window_free(self) -> int:
+        """Free slots in the write window: how many write_async calls are
+        guaranteed not to block on flow control right now. Writers
+        pumping MANY shards use this to top up every shard's window
+        round-robin instead of head-of-line blocking on one full
+        window."""
+        return max(0, self._acked.capacity - self._acked.depth)
+
+    def _register_ack_wait(self, target_seq: int, seq: int,
+                           write_span) -> AckWaiter:
+        """Park an ack waiter (replicated_db.cpp:236-273 timeouts: 2000ms
+        normally; 10ms once degraded — fail fast)."""
+        f = self.flags
+        timeout_ms = (
+            f.degraded_ack_timeout_ms if self._degraded else f.ack_timeout_ms
+        )
+        self._stats.incr(M["ack_waits"])
+        ack_span = None
+        if write_span.sampled:
+            ack_span = Span(
+                "repl.ack_wait", write_span.trace_id, write_span.span_id,
+                {"target_seq": target_seq, "timeout_ms": timeout_ms,
+                 "window_depth": self._acked.depth + 1},
+            )
+        waiter = self._acked.register(
+            target_seq, seq, timeout_ms / 1000.0, span=ack_span
+        )
+        if not waiter.done:
+            self._request_expiry(waiter.deadline)
+        return waiter
+
+    def _on_ack_resolve(self, waiter: AckWaiter, acked: bool) -> None:
+        """AckWindow resolution callback (writer thread, loop expiry
+        timer, or server ack path): stats + the 100-consecutive-timeouts
+        degradation state machine + the deferred ack_wait span."""
+        if acked:
+            with self._ack_state_lock:
+                self._consecutive_ack_timeouts = 0
+                if self._degraded:
+                    self._degraded = False
+                    log.info("%s: ACK degradation recovered", self.name)
+        elif not self._removed:
+            f = self.flags
+            self._stats.incr(M["ack_timeouts"])
+            with self._ack_state_lock:
+                self._consecutive_ack_timeouts += 1
+                if (
+                    not self._degraded
+                    and self._consecutive_ack_timeouts
+                    >= f.consecutive_timeouts_to_degrade
+                ):
+                    self._degraded = True
+                    self._stats.incr(M["ack_degraded"])
+                    log.warning("%s: entering degraded ACK mode", self.name)
+        span = waiter.span
+        if span is not None:
+            waiter.span = None
+            span.annotate(acked=acked, degraded=self._degraded,
+                          window_depth_at_resolve=self._acked.depth)
+            span.finish()
+            from ..observability.collector import SpanCollector
+
+            SpanCollector.get().record(span)
+
+    # -- ack-expiry timer (per-future timeouts without a blocked thread) --
+
+    def _request_expiry(self, deadline: float) -> None:
+        """Ensure the loop's expiry timer fires by ``deadline``. With
+        uniform timeouts deadlines are FIFO, so the common case is a
+        lock-check and no loop hop."""
+        with self._expiry_lock:
+            cur = self._expiry_deadline
+            if cur is not None and cur <= deadline:
+                return
+            self._expiry_deadline = deadline
+        self._loop.call_soon_threadsafe(self._arm_expiry, deadline)
+
+    def _arm_expiry(self, deadline: float) -> None:
+        """Loop thread: (re)schedule the timer for an earlier deadline."""
+        if self._removed:
+            return
+        delay = max(0.0, deadline - time.monotonic())
+        when = self._loop.time() + delay
+        with self._expiry_lock:
+            handle = self._expiry_handle
+            if (handle is not None and not handle.cancelled()
+                    and self._loop.time() < handle.when() <= when + 1e-4):
+                return  # an earlier-or-equal fire is already armed
+            if handle is not None:
+                handle.cancel()
+            self._expiry_handle = self._loop.call_later(
+                delay, self._fire_expiry)
+
+    def _fire_expiry(self) -> None:
+        """Loop thread: resolve overdue waiters, re-arm for the next."""
+        with self._expiry_lock:
+            self._expiry_handle = None
+            self._expiry_deadline = None
+        if self._removed:
+            return
+        next_deadline = self._acked.expire_due()
+        if next_deadline is not None:
+            self._request_expiry(next_deadline)
 
     _WRITE_TRACE_CAP = 512
 
@@ -183,36 +406,6 @@ class ReplicatedDB:
             while len(self._write_traces) > self._WRITE_TRACE_CAP:
                 self._write_traces.pop(next(iter(self._write_traces)))
 
-    def _write_wait_follower_ack(self, target_seq: int) -> None:
-        """replicated_db.cpp:236-273: 2000ms timeout normally; after 100
-        consecutive timeouts drop to 10ms to fail fast; recover on the
-        first success."""
-        f = self.flags
-        timeout_ms = (
-            f.degraded_ack_timeout_ms if self._degraded else f.ack_timeout_ms
-        )
-        self._stats.incr(M["ack_waits"])
-        with start_span("repl.ack_wait", target_seq=target_seq,
-                        timeout_ms=timeout_ms) as sp:
-            ok = self._acked.wait(target_seq, timeout_ms / 1000.0)
-            sp.annotate(acked=ok, degraded=self._degraded)
-        if ok:
-            self._consecutive_ack_timeouts = 0
-            if self._degraded:
-                self._degraded = False
-                log.info("%s: ACK degradation recovered", self.name)
-        else:
-            self._stats.incr(M["ack_timeouts"])
-            self._consecutive_ack_timeouts += 1
-            if (
-                not self._degraded
-                and self._consecutive_ack_timeouts
-                >= f.consecutive_timeouts_to_degrade
-            ):
-                self._degraded = True
-                self._stats.incr(M["ack_degraded"])
-                log.warning("%s: entering degraded ACK mode", self.name)
-
     # ------------------------------------------------------------------
     # server path (loop thread)
     # ------------------------------------------------------------------
@@ -223,52 +416,89 @@ class ReplicatedDB:
         max_wait_ms: Optional[int] = None,
         max_updates: Optional[int] = None,
         role: str = ReplicaRole.FOLLOWER.value,
+        applied_seq: Optional[int] = None,
     ) -> dict:
-        """Serve updates after ``seq_no`` (the puller's latest applied seq).
+        """Serve updates after ``seq_no`` (the puller's WAL cursor).
         Returns {updates, latest_seq, source_role}; updates is empty on a
         long-poll timeout. source_role lets pullers detect they're polling
-        a non-leader (upstream-reset heuristic, replicated_db.cpp:385-399)."""
+        a non-leader (upstream-reset heuristic, replicated_db.cpp:385-399).
+
+        ``applied_seq`` is the puller's durably-APPLIED position, which a
+        pipelined puller reports separately: its cursor runs ahead of its
+        apply executor (the next pull is issued while the previous
+        response is still applying), so acking off ``seq_no`` would
+        over-claim in mode 2. Absent (legacy pullers), the cursor IS the
+        applied position."""
         f = self.flags
         max_wait_ms = f.server_long_poll_ms if max_wait_ms is None else max_wait_ms
         max_updates = (
             f.max_updates_per_response if max_updates is None else max_updates
         )
+        # bound what one response can pin in memory regardless of what
+        # the (possibly adaptive, possibly buggy) puller asked for
+        max_updates = min(max_updates, f.adaptive_max_updates_cap)
+        self.serve_count += 1
         self._stats.incr(M["replicate_requests"])
         # Child of the puller's rpc.server span when the pull was sampled:
         # per-phase serve breakdown (seq read vs long-poll park vs WAL
         # read) — where a 10 s long-poll hides inside one "slow RPC".
         with start_span("repl.serve", db=self.name, from_role=role) as sp:
             # Mode-2 ACK: the puller's request proves it applied through
-            # seq_no (replicated_db.cpp:450-456); OBSERVERs never count.
+            # applied_seq (replicated_db.cpp:450-456); OBSERVERs never
+            # count.
             if role != ReplicaRole.OBSERVER.value and self.replication_mode == 2:
-                self._acked.post(seq_no)
-            # latest_sequence_number takes the storage lock, which flush/
-            # compaction can hold for seconds — never block the shared IO
-            # loop on it.
-            with start_span("repl.seq_read"):
-                latest = await self._loop.run_in_executor(
-                    self._executor, self.wrapper.latest_sequence_number
-                )
+                self._acked.post(
+                    seq_no if applied_seq is None else applied_seq)
+            # RELAXED seq reads: the locking read would park behind flush/
+            # compaction holding the storage lock (the old code paid an
+            # executor hop per read to avoid blocking the loop on it — two
+            # hops per serve, pure scheduling latency on the hot path). A
+            # stale value is safe: the reserve-then-recheck protocol below
+            # guarantees any write bumping the seq after reserve() also
+            # notifies the reserved slot, so a stale "nothing new" can
+            # only park until that notify, never for the full long-poll.
+            latest = self.wrapper.latest_sequence_number_relaxed()
             if latest <= seq_no and max_wait_ms > 0:
-                with start_span("repl.longpoll_wait", max_wait_ms=max_wait_ms):
-                    await self._notifier.wait(max_wait_ms / 1000.0)
-                if self._removed:
-                    raise RpcApplicationError(
-                        ReplicateErrorCode.SOURCE_REMOVED.value, self.name
-                    )
-                with start_span("repl.seq_read"):
-                    latest = await self._loop.run_in_executor(
-                        self._executor, self.wrapper.latest_sequence_number
-                    )
+                slot = self._notifier.reserve()
+                latest = self.wrapper.latest_sequence_number_relaxed()
+                if latest <= seq_no:
+                    with start_span("repl.longpoll_wait",
+                                    max_wait_ms=max_wait_ms):
+                        await self._notifier.wait_reserved(
+                            slot, max_wait_ms / 1000.0)
+                    if self._removed:
+                        raise RpcApplicationError(
+                            ReplicateErrorCode.SOURCE_REMOVED.value, self.name
+                        )
+                    latest = self.wrapper.latest_sequence_number_relaxed()
+                else:
+                    self._notifier.cancel_reserved(slot)
             if latest <= seq_no:
                 return {"updates": [], "latest_seq": latest,
-                        "source_role": self.role.value}
+                        "source_role": self.role.value,
+                        "replication_mode": self.replication_mode}
             try:
                 with start_span("repl.wal_read") as sp_read:
-                    updates = await self._loop.run_in_executor(
-                        self._executor, self._read_updates, seq_no + 1,
-                        max_updates
-                    )
+                    # Cached-cursor fast path: serve INLINE on the loop.
+                    # A parked tail cursor reads freshly-appended (page-
+                    # cache-resident) bytes in microseconds; the executor
+                    # round-trip (self-pipe wakeup + future + two context
+                    # switches) costs more than the read itself and was a
+                    # measurable share of serve latency under pipelined
+                    # load. The cursor is TAKEN here (not peeked) so a
+                    # concurrent serve or idle eviction can never leave
+                    # the inline path opening a fresh cursor — a cold
+                    # segment scan must never run on the loop; no-cursor
+                    # serves go to the executor, which may touch disk.
+                    it = self._iter_cache.take(seq_no + 1)
+                    if it is not None:
+                        updates = self._read_updates(
+                            seq_no + 1, max_updates, it=it)
+                    else:
+                        updates = await self._loop.run_in_executor(
+                            self._executor, self._read_updates, seq_no + 1,
+                            max_updates
+                        )
                     sp_read.annotate(updates=len(updates))
             except Exception as e:
                 log.exception("%s: WAL read failed", self.name)
@@ -300,22 +530,36 @@ class ReplicatedDB:
             )
             sp.annotate(latest_seq=latest)
             return {"updates": updates, "latest_seq": latest,
-                    "source_role": self.role.value}
+                    "source_role": self.role.value,
+                    "replication_mode": self.replication_mode}
 
-    def _read_updates(self, from_seq: int, max_updates: int) -> List[dict]:
-        """Executor-side WAL read using the cursor cache.
+    def _read_updates(self, from_seq: int, max_updates: int,
+                      it=None) -> List[dict]:
+        """WAL read using the cursor cache (executor-side, unless the
+        caller already took a cached cursor and passes it for an inline
+        loop-side read).
 
         Raises on a WAL gap (requested updates already purged) — the analog
         of rocksdb GetUpdatesSince returning NotFound, which tells the
         puller it must rebuild from a snapshot rather than silently skip."""
-        it = self._iter_cache.take(from_seq)
+        if it is None:
+            it = self._iter_cache.take(from_seq)
         if it is None:
             it = self.wrapper.get_updates_from_leader(from_seq)
         updates: List[dict] = []
         next_seq = from_seq
         exhausted = True
         first = True
-        for start_seq, raw in it:
+        # batch read when the cursor supports it (WalTailCursor): one
+        # call parses the whole response's records out of the read-ahead
+        # buffer instead of paying iterator overhead per record
+        read_many = getattr(it, "read_many", None)
+        if read_many is not None:
+            records = read_many(max_updates)
+            exhausted = len(records) < max_updates
+        else:
+            records = it
+        for start_seq, raw in records:
             if first:
                 first = False
                 if start_seq > from_seq:
@@ -323,21 +567,26 @@ class ReplicatedDB:
                         f"WAL gap: requested seq {from_seq}, oldest available "
                         f"{start_seq} (purged — puller must rebuild)"
                     )
-            batch = decode_batch(raw)
-            count = batch.count()
+            # header skim, not decode_batch + extract_timestamp_ms: the
+            # serve path needs only (count, stamp) per shipped update
+            count, ts = scan_batch_meta(raw)
             updates.append(
                 {
                     "seq_no": start_seq,
                     "count": count,
                     "raw_data": bytes(raw),
-                    "timestamp": batch.extract_timestamp_ms(),
+                    "timestamp": ts,
                 }
             )
             next_seq = start_seq + count
-            if len(updates) >= max_updates:
+            if read_many is None and len(updates) >= max_updates:
                 exhausted = False
                 break
-        if not exhausted:
+        # Resumable cursors (WalTailCursor) stay valid at the live tail,
+        # so cache them even when this response drained the WAL — the
+        # steady pipelined state — instead of re-scanning the active
+        # segment on every pull. One-shot iterators keep the old rule.
+        if not exhausted or getattr(it, "resumable", False):
             self._iter_cache.put(next_seq, it)
         return updates
 
@@ -366,14 +615,21 @@ class ReplicatedDB:
                 else:
                     self._empty_pulls = 0
             except asyncio.CancelledError:
+                # do not await the in-flight apply here — stop() must not
+                # block on executor work; just forget the pipeline state
+                self._apply_future = None
+                self._apply_target = None
+                self._applied_through = None
                 raise
             except RpcApplicationError as e:
+                await self._drain_pending_apply()
                 self._stats.incr(M["pull_errors"])
                 self._conn_errors = 0
                 if e.code == ReplicateErrorCode.SOURCE_NOT_FOUND.value:
                     await self._maybe_reset_upstream(force_sample=False)
                 await self._pull_error_delay()
             except (RpcError, Exception) as e:
+                await self._drain_pending_apply()
                 self._stats.incr(M["pull_errors"])
                 log.warning("%s: pull error from %s: %r", self.name,
                             self.upstream_addr, e)
@@ -398,41 +654,164 @@ class ReplicatedDB:
                 await self._pull_error_delay()
 
     async def _pull_once(self) -> Tuple[int, Optional[str]]:
+        """One pull iteration, DOUBLE-BUFFERED: the pull RPC for the next
+        batch is issued while the PREVIOUS response is still applying in
+        the executor, so network long-poll/RTT and storage apply overlap
+        instead of alternating. The request cursor (``seq_no``) runs from
+        the in-flight apply's target; the durably-applied position rides
+        along as ``applied_seq`` so mode-2 acks never over-claim."""
         f = self.flags
         assert self.upstream_addr is not None
         host, port = self.upstream_addr
         # Follower-rooted pull trace: pool acquire + RPC RTT (which carries
-        # the context to the upstream's serve span) + the apply phase.
+        # the context to the upstream's serve span) + the apply handoff.
         with start_span("repl.pull", db=self.name) as sp:
             client = await self._pool.get_client(host, port)
-            with start_span("repl.seq_read"):
-                latest = await self._loop.run_in_executor(
-                    self._executor, self.wrapper.latest_sequence_number
-                )
+            if self._applied_through is None:
+                # cold pipeline: one storage-lock read seeds the cursor;
+                # afterwards apply completions keep it current without
+                # touching the storage lock per pull
+                with start_span("repl.seq_read"):
+                    self._applied_through = await self._loop.run_in_executor(
+                        self._executor, self.wrapper.latest_sequence_number
+                    )
+            from_seq = (
+                self._apply_target if self._apply_target is not None
+                else self._applied_through
+            )
             self._stats.incr(M["pull_requests"])
-            result = await client.call(
+            call_coro = client.call(
                 "replicate",
                 {
                     "db_name": self.name,
-                    "seq_no": latest,
+                    "seq_no": from_seq,
+                    "applied_seq": self._applied_through,
                     "max_wait_ms": f.server_long_poll_ms,
-                    "max_updates": f.max_updates_per_response,
+                    "max_updates": self._cur_max_updates,
                     "role": self.role.value,
                 },
                 timeout=(f.server_long_poll_ms + f.pull_rpc_margin_ms) / 1000.0,
             )
+            if self._apply_future is None:
+                result = await call_coro
+            else:
+                result = await self._call_racing_apply(client, call_coro)
             updates = result.get("updates", []) if result else []
             source_role = result.get("source_role") if result else None
+            if result and result.get("replication_mode") is not None:
+                self._upstream_mode = int(result["replication_mode"])
+            self._adapt_max_updates(result, updates)
             if not updates:
+                # idle upstream: let the pipeline drain so apply errors
+                # surface here rather than lingering across long-polls
+                await self._drain_pending_apply(reraise=True)
                 return 0, source_role
-            sp.annotate(updates=len(updates))
+            sp.annotate(updates=len(updates),
+                        pipelined=self._apply_future is not None)
+            # in-order apply: the previous response must land before this
+            # one is handed to the executor (and its failure must surface
+            # BEFORE we commit to a cursor built on top of it)
+            await self._drain_pending_apply(reraise=True)
             # run_in_executor does not carry contextvars: hand the pull
             # context across the hop explicitly (observability/context.py).
             pull_ctx = wire_context()
-            await self._loop.run_in_executor(
+            last = updates[-1]
+            self._apply_target = int(last["seq_no"]) + int(
+                last.get("count") or 1) - 1
+            self._apply_future = self._loop.run_in_executor(
                 self._executor, self._apply_updates, updates, pull_ctx
             )
             return len(updates), source_role
+
+    async def _call_racing_apply(self, client, call_coro):
+        """Await the pull RPC while the previous apply runs. If the apply
+        lands first and the RPC is a parked long-poll, roll the cursor
+        forward immediately and — for a mode-2 upstream — push the fresh
+        applied position via a lightweight replicate_ack RPC, so the
+        leader's pipelined ack waiters for the burst tail resolve at
+        apply time instead of waiting out the park."""
+        rpc_task = asyncio.ensure_future(call_coro)
+        apply_fut = self._apply_future
+        try:
+            await asyncio.wait(
+                {rpc_task, apply_fut}, return_when=asyncio.FIRST_COMPLETED
+            )
+        except asyncio.CancelledError:
+            rpc_task.cancel()
+            raise
+        if not rpc_task.done():
+            try:
+                await self._drain_pending_apply(reraise=True)
+            except Exception:
+                rpc_task.cancel()
+                raise
+            if self._upstream_mode == 2 and self._applied_through:
+                await self._send_applied_ack(client)
+        return await rpc_task
+
+    async def _send_applied_ack(self, client) -> None:
+        """Best-effort ack push (mode-2 upstreams): the next pull carries
+        applied_seq anyway, so failures only cost ack latency."""
+        try:
+            await client.call(
+                "replicate_ack",
+                {
+                    "db_name": self.name,
+                    "applied_seq": self._applied_through,
+                    "role": self.role.value,
+                },
+                timeout=2.0,
+            )
+        except Exception:
+            log.debug("%s: replicate_ack push failed", self.name,
+                      exc_info=True)
+
+    def post_applied(self, applied_seq: int, role: str) -> None:
+        """Server side of the replicate_ack push: count the follower's
+        durably-applied position toward mode-2 acks (OBSERVERs never
+        count, same as the pull path)."""
+        if role != ReplicaRole.OBSERVER.value and self.replication_mode == 2:
+            self._acked.post(int(applied_seq))
+
+    def _adapt_max_updates(self, result, updates) -> None:
+        """Size the NEXT pull to the upstream's reported backlog: behind
+        by a window, ask for the whole window in one response (one pull
+        round-trip then acks many pipelined writes at once); caught up,
+        fall back to the reference's fixed max_updates_per_response."""
+        f = self.flags
+        base = f.max_updates_per_response
+        latest_up = (result or {}).get("latest_seq")
+        if updates and latest_up is not None:
+            last = updates[-1]
+            served_through = int(last["seq_no"]) + int(
+                last.get("count") or 1) - 1
+            backlog = int(latest_up) - served_through
+            if backlog > 0:
+                self._cur_max_updates = min(
+                    f.adaptive_max_updates_cap, max(base, backlog))
+                return
+        self._cur_max_updates = base
+
+    async def _drain_pending_apply(self, reraise: bool = False) -> None:
+        """Wait out the in-flight apply (if any) and roll the cached
+        applied-through cursor forward; on apply failure the cache is
+        invalidated (next pull re-reads storage) and the error either
+        propagates (pull path) or is swallowed (error-path cleanup —
+        the pull loop is already backing off)."""
+        fut = self._apply_future
+        if fut is None:
+            return
+        self._apply_future = None
+        target, self._apply_target = self._apply_target, None
+        try:
+            await fut
+        except Exception:
+            self._applied_through = None
+            if reraise:
+                raise
+            log.exception("%s: pipelined apply failed", self.name)
+            return
+        self._applied_through = target
 
     def _apply_updates(self, updates: List[dict],
                        pull_ctx: Optional[dict] = None) -> None:
@@ -443,35 +822,55 @@ class ReplicatedDB:
                         updates=len(updates)):
             # Sequence-continuity guard: applying out of order would shift
             # the local numbering below the leader's and silently diverge
-            # (re-fetch + double-apply). One storage-lock read, then track
-            # incrementally.
+            # (re-fetch + double-apply). One storage-lock read, then the
+            # whole group is validated arithmetically BEFORE any of it is
+            # applied — a bad response applies nothing.
             expected = self.wrapper.latest_sequence_number() + 1
             for u in updates:
-                raw = bytes(u["raw_data"])
-                ts = u.get("timestamp")
                 got = int(u.get("seq_no", expected))
                 if got != expected:
                     raise ValueError(
                         f"{self.name}: replication seq discontinuity: expected "
                         f"{expected}, got {got} — rebuild required"
                     )
+                expected += int(u.get("count")
+                                or decode_batch(bytes(u["raw_data"])).count())
+                total_bytes += len(u["raw_data"])
+            # Apply: consecutive UNTRACED updates flow through the
+            # wrapper's batched group path (one storage-lock pass + one
+            # WAL flush per run — the per-record flush dominated the
+            # apply side once leader writes pipelined); a traced update
+            # breaks the run so its apply span records individually and
+            # re-propagates to chained downstreams.
+            run: List[dict] = []
+
+            def flush_run():
+                if run:
+                    self.wrapper.handle_replicate_updates(run)
+                    run.clear()
+
+            for u in updates:
                 tctx = u.get("trace")
-                if tctx is not None:
-                    # the update carried its originating write's sampled
-                    # context: this apply joins the WRITE's trace (child of
-                    # the leader's repl.write), and re-records the context
-                    # so chained downstreams stitch onto the same trace
-                    with start_span("repl.apply", remote=tctx, db=self.name,
-                                    seq=got) as asp:
-                        if pull_ctx is not None:
-                            asp.annotate(pull_trace=pull_ctx["trace_id"])
-                        self.wrapper.handle_replicate_response(raw, ts)
-                        if asp.sampled:
-                            self._remember_write_trace(got, asp)
-                else:
-                    self.wrapper.handle_replicate_response(raw, ts)
-                expected += int(u.get("count") or decode_batch(raw).count())
-                total_bytes += len(raw)
+                if tctx is None:
+                    run.append(u)
+                    continue
+                flush_run()
+                got = int(u["seq_no"])
+                # the update carried its originating write's sampled
+                # context: this apply joins the WRITE's trace (child of
+                # the leader's repl.write), and re-records the context
+                # so chained downstreams stitch onto the same trace
+                with start_span("repl.apply", remote=tctx, db=self.name,
+                                seq=got) as asp:
+                    if pull_ctx is not None:
+                        asp.annotate(pull_trace=pull_ctx["trace_id"])
+                    self.wrapper.handle_replicate_response(
+                        bytes(u["raw_data"]), u.get("timestamp"))
+                    if asp.sampled:
+                        self._remember_write_trace(got, asp)
+            flush_run()
+            for u in updates:
+                ts = u.get("timestamp")
                 if ts is not None:
                     self._stats.add_metric(
                         M["replication_lag_ms"], max(0, now - ts))
@@ -520,11 +919,16 @@ class ReplicatedDB:
     # ------------------------------------------------------------------
 
     def introspect(self) -> str:
+        # RELAXED seq read: the blocking read takes the storage lock,
+        # which flush/compaction can hold for seconds — the serve path
+        # already keeps it off the loop thread; the status-server path
+        # must not hang on it either. Staleness is fine for status text.
         return (
             f"db={self.name} role={self.role.value} "
             f"mode={self.replication_mode} "
-            f"latest_seq={self.wrapper.latest_sequence_number()} "
+            f"latest_seq={self.wrapper.latest_sequence_number_relaxed()} "
             f"acked_seq={self._acked.value} "
+            f"ack_window={self._acked.depth}/{self._acked.capacity} "
             f"upstream={self.upstream_addr} "
             f"degraded={self._degraded} removed={self._removed}"
         )

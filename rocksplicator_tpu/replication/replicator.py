@@ -152,6 +152,24 @@ class Replicator:
             raise KeyError(f"no such db: {name}")
         return rdb.write(batch)
 
+    def write_async(self, name: str, batch: WriteBatch):
+        """Pipelined write: WAL-write now, return an AckWaiter whose
+        future resolves when the replication-mode ack condition is met
+        (or its timeout expires). See ReplicatedDB.write_async."""
+        rdb = self._dbs.get(name)
+        if rdb is None:
+            raise KeyError(f"no such db: {name}")
+        return rdb.write_async(batch)
+
+    def write_async_many(self, name: str, batches):
+        """Batched pipelined writes: one WAL flush / wakeup / stats
+        update for the whole group, one AckWaiter per batch. See
+        ReplicatedDB.write_async_many."""
+        rdb = self._dbs.get(name)
+        if rdb is None:
+            raise KeyError(f"no such db: {name}")
+        return rdb.write_async_many(batches)
+
     def introspect(self) -> str:
         lines = [rdb.introspect() for _name, rdb in sorted(self._dbs.items())]
         return "\n".join(lines) + "\n"

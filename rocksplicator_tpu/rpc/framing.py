@@ -42,6 +42,9 @@ MAX_FRAME_BYTES = 256 * 1024 * 1024
 # RPC frames)
 COMPRESS_THRESHOLD = 4096
 COMPRESS_MAX = 8 * 1024 * 1024
+# frames at or below this size are sent as ONE transport write (single
+# send syscall) instead of one write per header/chunk
+_JOIN_MAX = 256 * 1024
 
 
 async def write_frame(
@@ -61,10 +64,21 @@ async def write_frame(
             payload_chunks = [compressed]
             plen = len(compressed)
             flags |= flag
-    writer.write(_HEADER.pack(MAGIC, flags, len(header), plen))
-    writer.write(header)
-    for chunk in payload_chunks:
-        writer.write(chunk)
+    # ONE transport write: each StreamWriter.write() attempts an eager
+    # send syscall when the buffer is empty, so the old 3..N-write frame
+    # cost 3..N sends. Joining costs one memcpy of an already-small
+    # (usually compressed) frame; on sandboxed/virtualized kernels where
+    # a syscall is micro-seconds, this is a large share of RPC latency.
+    # Frames above the join cap keep per-chunk writes (no big copies).
+    if plen <= _JOIN_MAX:
+        writer.write(b"".join(
+            [_HEADER.pack(MAGIC, flags, len(header), plen), header,
+             *payload_chunks]))
+    else:
+        writer.write(_HEADER.pack(MAGIC, flags, len(header), plen))
+        writer.write(header)
+        for chunk in payload_chunks:
+            writer.write(chunk)
     await writer.drain()
 
 

@@ -295,8 +295,14 @@ class DB:
     # writes
     # ------------------------------------------------------------------
 
-    def write(self, batch: WriteBatch, sync: bool = False) -> int:
+    def write(self, batch: WriteBatch, sync: bool = False,
+              encoded: Optional[bytes] = None) -> int:
         """Apply a batch atomically; returns the batch's start seq.
+
+        ``encoded`` lets a caller that already HOLDS the batch's encoded
+        bytes (a follower applying a replicated update ships the raw
+        leader bytes) skip the re-encode — the bytes must be exactly
+        ``batch.encode()``.
 
         Sync durability is GROUP-COMMITTED: the fsync runs OUTSIDE the
         DB lock (readers and other writers never block on the disk) and
@@ -313,7 +319,8 @@ class DB:
             self._check_open()
             self._check_flush_health_locked()
             start_seq = self._last_seq + 1
-            encoded = batch.encode()
+            if encoded is None:
+                encoded = batch.encode()
             assert self._wal is not None
             token = self._wal.append(start_seq, encoded)
             self._apply_to_memtable(batch, start_seq)
@@ -327,6 +334,60 @@ class DB:
         if sync or self.options.sync_writes:
             wal.sync_to(token)
         return start_seq
+
+    def write_many(
+        self,
+        items: List[Tuple[WriteBatch, Optional[bytes]]],
+        sync: bool = False,
+    ) -> int:
+        """Apply a GROUP of batches in order with one lock pass and one
+        WAL flush — the follower apply path commits a whole replication
+        pull response per call instead of paying the per-record flush
+        syscall and lock round-trip 50+ times per response. Each batch
+        still gets its own sequence range (identical numbering to N
+        ``write`` calls — replication continuity depends on it); the
+        group is NOT atomic against a crash mid-flush, which matches N
+        separate non-sync writes. Returns the FIRST batch's start seq.
+
+        ``items`` pairs each batch with its encoded bytes when the
+        caller already holds them (replicated updates ship the leader's
+        raw bytes), else None to encode here."""
+        if not items:
+            raise ValueError("write_many: empty group")
+        total_bytes = sum(
+            len(enc) if enc is not None else b.byte_size()
+            for b, enc in items
+        )
+        with self._lock:
+            self._check_open()
+            self._check_flush_health_locked()
+            self._admission_stall_locked(total_bytes)
+            self._check_open()
+            self._check_flush_health_locked()
+            assert self._wal is not None
+            first_seq = self._last_seq + 1
+            records = []
+            seq = first_seq
+            for batch, encoded in items:
+                if encoded is None:
+                    encoded = batch.encode()
+                records.append((seq, encoded))
+                seq += batch.count()
+            token = self._wal.append_many(records)
+            seq = first_seq
+            for batch, _ in items:
+                self._apply_to_memtable(batch, seq)
+                seq += batch.count()
+                self._last_seq = seq - 1
+            if self._mem.approximate_bytes() >= self.options.memtable_bytes:
+                if self._bg_thread is not None:
+                    self._swap_to_imm_locked()
+                else:
+                    self._flush_locked()
+            wal = self._wal
+        if sync or self.options.sync_writes:
+            wal.sync_to(token)
+        return first_seq
 
     def _admission_stall_locked(self, batch_bytes: int) -> None:
         """Write-stall at ADMISSION (rocksdb WriteController analog):
@@ -628,10 +689,27 @@ class DB:
         with self._lock:
             return self._last_seq
 
+    def latest_sequence_number_relaxed(self) -> int:
+        """Lock-free (possibly slightly stale) seq read for status/
+        introspection paths: flush/compaction can hold self._lock for
+        seconds, and a status scrape must never hang behind it. The GIL
+        makes the bare int read atomic; it simply may miss a write that
+        is committing concurrently."""
+        return self._last_seq
+
     def get_updates_since(self, seq: int) -> Iterator[Tuple[int, bytes]]:
         """(start_seq, raw_batch_bytes) for every batch whose start_seq >=
         ``seq``. Followers pass latest_local+1 (replicated_db.cpp:486-505)."""
         return wal_mod.iter_updates(self._wal_dir, seq)
+
+    def get_updates_cursor(self, seq: int) -> "wal_mod.WalTailCursor":
+        """Resumable tail cursor over the same records as
+        ``get_updates_since`` — survives reaching the live tail, so the
+        replication serve path can cache it across pulls instead of
+        re-scanning the active segment per response."""
+        return wal_mod.WalTailCursor(
+            self._wal_dir, seq,
+            segment_bytes=self.options.wal_segment_bytes)
 
     # ------------------------------------------------------------------
     # flush / compaction

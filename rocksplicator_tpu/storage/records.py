@@ -114,6 +114,38 @@ class WriteBatch:
         return b"".join(parts)
 
 
+def scan_batch_meta(data) -> Tuple[int, Optional[int]]:
+    """(count, timestamp_ms) by skimming op HEADERS only — no key/value
+    slicing, no WriteBatch construction. The replication serve path needs
+    exactly these two facts per shipped update; a full decode_batch +
+    extract_timestamp_ms pair cost two O(bytes) passes per update on the
+    hot serve path."""
+    buf = bytes(data)
+    if len(buf) < _U32.size:
+        raise Corruption("batch too short")
+    (num_ops,) = _U32.unpack_from(buf, 0)
+    pos = _U32.size
+    count = 0
+    ts: Optional[int] = None
+    try:
+        for _ in range(num_ops):
+            op_raw, key_len = _OPHEAD.unpack_from(buf, pos)
+            pos += _OPHEAD.size + key_len
+            (val_len,) = _U32.unpack_from(buf, pos)
+            pos += _U32.size
+            if op_raw == OpType.LOG_DATA:
+                if val_len == _TS.size:
+                    ts = _TS.unpack_from(buf, pos)[0]
+            else:
+                count += 1
+            pos += val_len
+        if pos > len(buf):
+            raise Corruption("truncated batch")
+    except struct.error as e:
+        raise Corruption(f"bad batch: {e}") from e
+    return count, ts
+
+
 def decode_batch(data) -> WriteBatch:
     buf = bytes(data)
     if len(buf) < _U32.size:

@@ -91,6 +91,40 @@ class WalWriter:
         self._append_token += 1
         return self._append_token
 
+    def append_many(self, records: List[Tuple[int, bytes]]) -> int:
+        """Buffer a GROUP of records with ONE flush (and one token
+        publish) at the end — the follower apply path commits a whole
+        pull response per call, so the per-record flush syscall (the
+        dominant cost of per-record append on the apply hot path) is
+        paid once per response instead of once per update. Same
+        serialization contract as ``append``; rolls mid-group flush the
+        outgoing segment first."""
+        assert records
+        pending = 0
+        for start_seq, batch_bytes in records:
+            if self._file is None or self._file_size >= self._segment_bytes:
+                if pending:
+                    # flush + publish the group's records in the outgoing
+                    # segment BEFORE rolling: _roll decides sync coverage
+                    # (and _closed_unsynced) from the published token
+                    self._file.flush()
+                    self._append_token += pending
+                    pending = 0
+                self._roll(start_seq)
+            rec = _REC_HEAD.pack(
+                start_seq, len(batch_bytes),
+                zlib.crc32(batch_bytes) & 0xFFFFFFFF,
+            )
+            self._file.write(rec)
+            self._file.write(batch_bytes)
+            self._file_size += len(rec) + len(batch_bytes)
+            pending += 1
+        # one flush covers the group; publish AFTER it (sync leaders
+        # snapshotting the token must find every covered byte in the OS)
+        self._file.flush()
+        self._append_token += pending
+        return self._append_token
+
     def sync_to(self, token: int) -> None:
         """Group commit: durable up to ``token`` (and opportunistically
         everything appended by the time the leader's fsync starts).
@@ -321,6 +355,252 @@ def iter_updates(
                 if start_seq + decode_batch(body).count() - 1 >= since_seq:
                     yielded_any = True
                     yield start_seq, body
+
+
+class WalTailCursor:
+    """Resumable streaming cursor over the WAL tail.
+
+    ``iter_updates`` is a one-shot generator: once it reaches the live
+    tail it is exhausted for good, so a serve path that drains to the
+    tail must re-open — re-reading and re-CRC-ing the ENTIRE active
+    segment per pull (quadratic in segment fill; measured as the
+    dominant serve cost once leader writes pipeline). This cursor stays
+    valid at the tail: iterating raises StopIteration when it runs out
+    of complete records, and iterating AGAIN later continues from the
+    remembered (segment, offset) — new appends stream with zero
+    re-scanning. Segment rolls are followed automatically (a newer
+    segment file means the current one is final).
+
+    Iterator of (start_seq, batch_bytes) with the same contract as
+    ``iter_updates``: every batch whose seq range intersects
+    [since_seq, ∞), in order, including a straddler batch.
+
+    Single-consumer; not thread-safe. ``resumable`` marks the contract
+    for cursor caches that would otherwise drop exhausted iterators.
+    """
+
+    resumable = True
+
+    # read-ahead chunk: one pread per ~chunk of records instead of three
+    # small reads per record
+    _CHUNK = 1 << 20
+
+    def __init__(self, wal_dir: str, since_seq: int = 0,
+                 segment_bytes: Optional[int] = None):
+        self._dir = wal_dir
+        self._since = since_seq
+        self._f = None
+        self._first_seq: Optional[int] = None  # current segment's name seq
+        self._offset = 0
+        self._positioned = False
+        self._yielded_any = False
+        # roll-check guard: a segment never rolls before reaching
+        # segment_bytes, so tail hits below that size skip the listdir
+        # entirely (the dominant cursor cost when serves drain to the
+        # tail every pull)
+        self._segment_bytes = segment_bytes
+        self._eof_hits = 0  # consecutive tail hits since last real roll check
+        self._buf = b""
+        self._buf_off = 0  # file offset corresponding to _buf[0]
+
+    def __iter__(self) -> "WalTailCursor":
+        return self
+
+    def __next__(self) -> Tuple[int, bytes]:
+        rec = self.read_next()
+        if rec is None:
+            raise StopIteration
+        return rec
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            finally:
+                self._f = None
+
+    # -- internals ---------------------------------------------------------
+
+    def _position(self) -> bool:
+        """First use: pick the starting segment (same skip rule as
+        iter_updates) and skip-scan record HEADERS to since_seq — no CRC
+        work, no body copies — so even the one-time cold cost is far
+        below a full-segment re-read."""
+        segs = _segments(self._dir)
+        if not segs:
+            return False
+        start_i = 0
+        for i in range(len(segs)):
+            if i + 1 < len(segs) and segs[i + 1][0] <= self._since:
+                start_i = i + 1
+        self._open_segment(segs[start_i])
+        self._skip_to_since()
+        self._positioned = True
+        return True
+
+    def _open_segment(self, seg: Tuple[int, str]) -> None:
+        self.close()
+        first_seq, path = seg
+        try:
+            self._f = open(path, "rb")
+        except FileNotFoundError:
+            # purged between listing and open: the records it held were
+            # persisted; signal a gap and let the puller rebuild
+            raise ValueError(
+                f"WAL gap: segment {path} purged under cursor"
+            ) from None
+        self._first_seq = first_seq
+        self._offset = 0
+        self._buf = b""
+        self._buf_off = 0
+
+    def _skip_to_since(self) -> None:
+        """Header-jump within the opened segment to the first record with
+        start_seq >= since, handling the straddler (previous record whose
+        range reaches since) by rewinding one record when needed. Reads
+        go through the chunked read-ahead buffer: the unbuffered version
+        paid two syscalls per skipped record, which made every cursor
+        reposition O(segment records) in syscalls."""
+        assert self._f is not None
+        size = os.fstat(self._f.fileno()).st_size
+        prev_off: Optional[int] = None
+        while True:
+            hdr = self._read_at(self._offset, _REC_HEAD.size)
+            if len(hdr) < _REC_HEAD.size:
+                break  # tail — nothing at/after since yet
+            start_seq, blen, _crc = _REC_HEAD.unpack(hdr)
+            if start_seq >= self._since:
+                if start_seq > self._since and prev_off is not None:
+                    # possible straddler: include the previous record iff
+                    # its range reaches since (one body decode, once)
+                    p_hdr = self._read_at(prev_off, _REC_HEAD.size)
+                    p_seq, p_blen, _ = _REC_HEAD.unpack(p_hdr)
+                    body = self._read_at(prev_off + _REC_HEAD.size, p_blen)
+                    if len(body) == p_blen:
+                        from .records import decode_batch
+
+                        if p_seq + decode_batch(body).count() - 1 >= self._since:
+                            self._offset = prev_off
+                break
+            if self._offset + _REC_HEAD.size + blen > size:
+                break  # torn/in-flight tail record
+            prev_off = self._offset
+            self._offset += _REC_HEAD.size + blen
+
+    def _roll_if_closed(self) -> bool:
+        """At EOF: if the writer rolled to a newer segment, the current
+        one is final — advance. Returns True when a new segment was
+        opened (caller should retry reading). Guarded so the common
+        live-tail hit costs one fstat, NOT a directory listing: a
+        SIZE-triggered roll never happens below segment_bytes. A
+        re-created WalWriter on an existing dir, however, starts a new
+        segment regardless of the old one's size, so every 32nd
+        consecutive tail hit does the real listing anyway — bounded
+        staleness instead of a silently parked-forever cursor."""
+        if self._first_seq is None or self._f is None:
+            return False
+        if self._segment_bytes is not None:
+            self._eof_hits += 1
+            if self._eof_hits & 0x1F:
+                try:
+                    size = os.fstat(self._f.fileno()).st_size
+                    if size < self._segment_bytes:
+                        return False
+                except OSError:
+                    pass
+        segs = _segments(self._dir)
+        newer = [s for s in segs if s[0] > self._first_seq]
+        if not newer:
+            return False
+        self._open_segment(min(newer))
+        return True
+
+    def _read_at(self, off: int, n: int) -> bytes:
+        """Bytes [off, off+n) of the current segment through the
+        read-ahead buffer (one big read per ~chunk of records instead of
+        seek+read syscalls per record). Short result = live tail; a
+        later call from the same offset re-reads and sees new appends."""
+        end = off + n
+        if off < self._buf_off or end > self._buf_off + len(self._buf):
+            f = self._f
+            f.seek(off)
+            self._buf = f.read(max(n, self._CHUNK))
+            self._buf_off = off
+        rel = off - self._buf_off
+        return self._buf[rel:rel + n]
+
+    def read_next(self) -> Optional[Tuple[int, bytes]]:
+        """Next complete record, or None at the live tail (cursor stays
+        valid — call again after more appends)."""
+        if not self._positioned and not self._position():
+            return None
+        while True:
+            if self._f is None:
+                return None
+            hdr = self._read_at(self._offset, _REC_HEAD.size)
+            if len(hdr) < _REC_HEAD.size:
+                if self._roll_if_closed():
+                    continue
+                return None
+            start_seq, blen, crc = _REC_HEAD.unpack(hdr)
+            body = self._read_at(self._offset + _REC_HEAD.size, blen)
+            if len(body) < blen:
+                # in-flight append (writer flushed header before body);
+                # only legitimate at the ACTIVE tail — if the writer
+                # already rolled onward, it's a truncated closed segment
+                if self._roll_if_closed():
+                    continue
+                return None
+            if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+                raise Corruption(
+                    f"WAL crc mismatch under tail cursor in segment "
+                    f"wal-{self._first_seq}.log at offset {self._offset}"
+                )
+            self._offset += _REC_HEAD.size + blen
+            self._yielded_any = True
+            self._eof_hits = 0
+            return start_seq, body
+
+    def read_many(self, max_records: int) -> List[Tuple[int, bytes]]:
+        """Up to ``max_records`` complete records in one call. Records
+        already resident in the read-ahead buffer are parsed in a tight
+        loop (one struct unpack + one slice per record) instead of two
+        ``_read_at`` round-trips each — the replication serve path reads
+        whole responses at a time, and the per-record call overhead was
+        a measurable share of serve CPU under pipelined load. Falls back
+        to ``read_next`` for refills, rolls, and the live tail."""
+        out: List[Tuple[int, bytes]] = []
+        head = _REC_HEAD
+        hsize = head.size
+        while len(out) < max_records:
+            buf = self._buf
+            end = len(buf)
+            rel = self._offset - self._buf_off
+            if self._f is not None and 0 <= rel < end:
+                while len(out) < max_records and rel + hsize <= end:
+                    start_seq, blen, crc = head.unpack_from(buf, rel)
+                    if rel + hsize + blen > end:
+                        break  # record straddles the buffer edge
+                    body = buf[rel + hsize:rel + hsize + blen]
+                    if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+                        self._offset = self._buf_off + rel
+                        raise Corruption(
+                            f"WAL crc mismatch under tail cursor in segment "
+                            f"wal-{self._first_seq}.log at offset {self._offset}"
+                        )
+                    rel += hsize + blen
+                    out.append((start_seq, body))
+                self._offset = self._buf_off + rel
+                if out:
+                    self._yielded_any = True
+                    self._eof_hits = 0
+                if len(out) >= max_records:
+                    break
+            rec = self.read_next()  # refill / roll / tail
+            if rec is None:
+                break
+            out.append(rec)
+        return out
 
 
 def purge_obsolete(

@@ -281,8 +281,13 @@ def test_upstream_repoint_failover(hosts):
 
 
 def test_batching_respects_max_updates(hosts):
+    # adaptive_max_updates_cap pinned to the base batch size: this test
+    # verifies the fixed-batching contract (a response never exceeds the
+    # requested max); adaptive backlog catch-up is covered separately by
+    # test_adaptive_pull_catches_up_in_few_responses
     flags = ReplicationFlags(
         server_long_poll_ms=300, max_updates_per_response=5,
+        adaptive_max_updates_cap=5,
         pull_error_delay_min_ms=50, pull_error_delay_max_ms=100,
     )
     leader, follower = hosts("l", flags), hosts("f", flags)
