@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test test-fast native bench loadsst-bench soak-bench repl-bench-smoke clean
+.PHONY: test test-fast native bench loadsst-bench load-sst-smoke soak-bench repl-bench-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -18,6 +18,13 @@ bench:
 
 loadsst-bench:
 	$(PY) -m benchmarks.load_sst_bench --shards 16
+
+# fast pipelined-ingest regression smoke: few small shards, cpu config
+# only (no kernel compiles), fails loudly on any spot-check miss
+load-sst-smoke:
+	$(PY) -m benchmarks.load_sst_bench --shards 4 --keys_per_shard 2000 \
+		--window 4 --configs cpu --trace \
+		--out benchmarks/results/load_sst_smoke.json
 
 soak-bench:
 	$(PY) -m benchmarks.soak_bench --shards 256

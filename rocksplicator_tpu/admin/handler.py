@@ -24,7 +24,6 @@ import logging
 import os
 import shutil
 import tempfile
-import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import asdict, dataclass
@@ -47,6 +46,8 @@ from ..utils.stats import Stats
 from ..utils.timer import Timer
 from .application_db import ApplicationDB
 from .db_manager import ApplicationDBManager
+from .ingest_pipeline import (BatchCompactor, IngestGate,
+                              default_sst_loading_concurrency)
 
 log = logging.getLogger(__name__)
 
@@ -122,9 +123,10 @@ class AdminHandler:
         options_generator: Optional[OptionsGenerator] = None,
         leader_resolver: Optional[LeaderResolver] = None,
         executor_threads: int = 8,
-        max_sst_loading_concurrency: int = 999,
+        max_sst_loading_concurrency: Optional[int] = None,
         object_store_rate_limit_bytes: Optional[float] = None,
         tpu_compaction: bool = False,
+        compact_parallelism: Optional[int] = None,
     ):
         self.rocksdb_dir = os.path.abspath(rocksdb_dir)
         os.makedirs(self.rocksdb_dir, exist_ok=True)
@@ -137,10 +139,16 @@ class AdminHandler:
         )
         self._db_admin_lock = ObjectLock()
         self._store_rate_limit = object_store_rate_limit_bytes
-        self._max_sst_loading = max_sst_loading_concurrency
+        # ingest admission gate: the 999 default made TOO_MANY_REQUESTS
+        # dead code — None now derives a sane bound from the host
+        self._ingest_gate = IngestGate(
+            max_sst_loading_concurrency
+            if max_sst_loading_concurrency is not None
+            else default_sst_loading_concurrency()
+        )
         self._tpu_compaction = tpu_compaction
-        self._sst_loading_lock = threading.Lock()
-        self._num_sst_loading = 0
+        self._batch_compactor = BatchCompactor(
+            use_tpu=tpu_compaction, compact_parallelism=compact_parallelism)
         self._meta_db = DB(os.path.join(self.rocksdb_dir, "meta_db"))
         # db_name -> message-ingestion watcher (kafka-equivalent stack)
         self._ingestion: Dict[str, object] = {}
@@ -486,87 +494,163 @@ class AdminHandler:
         s3_download_limit_mb: int = 64,
         compact_db_after_load: bool = False,
     ) -> dict:
-        """addS3SstFilesToDB (admin_handler.cpp:1635-1850). Call-stack
-        parity per SURVEY §3.3: per-db lock → meta idempotency → ingest-
-        behind validation (DBLmaxEmpty) → concurrency gate → batch download
-        → (optional full replace) → ingest → meta write → optional compact."""
+        """addS3SstFilesToDB (admin_handler.cpp:1635-1850), pipelined.
+
+        Call-stack parity per SURVEY §3.3, with the per-db admin lock
+        NARROWED (ISSUE 3): admission (idempotency + ingest-behind
+        validation) takes the lock briefly, the download + SST validation
+        run OUTSIDE it under the global ingest gate, then the lock is
+        re-taken — with a close/idempotency staleness re-check — for the
+        engine ingest + meta write only. N shards therefore download
+        while others ingest; the post-load compaction coalesces across
+        shards in the BatchCompactor."""
         store = self._store(s3_bucket)
+        tctx = wire_context()
 
         def do():
-            with self._db_admin_lock.locked(db_name):
-                # resolve the db INSIDE the lock: a concurrent closeDB must
-                # yield DB_NOT_FOUND, not operate on a stale handle
-                app_db = self._get_app_db(db_name)
-                # idempotency via meta_db (:1655-1667)
-                meta = self.get_meta_data(db_name)
-                if meta.s3_bucket == s3_bucket and meta.s3_path == s3_path:
-                    return {"skipped": True}
-                if ingest_behind:
-                    if not app_db.db.options.allow_ingest_behind:
-                        raise RpcApplicationError(
-                            DB_ADMIN_ERROR, "db not opened with allow_ingest_behind"
-                        )
-                    if not app_db.db_lmax_empty():
-                        raise RpcApplicationError(
-                            DB_ADMIN_ERROR, "bottom level not empty"
-                        )
-                # concurrency gate (:1692-1706)
-                with self._sst_loading_lock:
-                    if self._num_sst_loading >= self._max_sst_loading:
-                        raise RpcApplicationError(
-                            TOO_MANY_REQUESTS,
-                            f"{self._num_sst_loading} ingests in flight",
-                        )
-                    self._num_sst_loading += 1
-                try:
-                    return self._do_ingest(
-                        db_name, app_db, store, s3_bucket, s3_path,
-                        ingest_behind, allow_overlapping_keys,
-                        compact_db_after_load,
-                    )
-                finally:
-                    with self._sst_loading_lock:
-                        self._num_sst_loading -= 1
+            with start_span("admin.add_s3_sst", always=True, remote=tctx,
+                            db=db_name, path=s3_path) as sp:
+                return self._add_s3_sst(
+                    sp, db_name, store, s3_bucket, s3_path, ingest_behind,
+                    allow_overlapping_keys, compact_db_after_load,
+                )
 
         return await self._run(do)
 
+    def _add_s3_sst(
+        self, sp, db_name, store, s3_bucket, s3_path,
+        ingest_behind, allow_overlapping_keys, compact_after,
+    ) -> dict:
+        # -- admission: cheap checks only under the per-db lock ------------
+        with self._db_admin_lock.locked(db_name):
+            app_db = self._get_app_db(db_name)
+            # idempotency via meta_db (:1655-1667)
+            meta = self.get_meta_data(db_name)
+            if meta.s3_bucket == s3_bucket and meta.s3_path == s3_path:
+                return {"skipped": True}
+            self._check_ingest_behind(app_db, ingest_behind)
+        # concurrency gate (:1692-1706) — bounds the download/validate
+        # stage globally, NOT under any db lock
+        if not self._ingest_gate.try_enter():
+            raise RpcApplicationError(
+                TOO_MANY_REQUESTS,
+                f"{self._ingest_gate.in_flight} ingests in flight "
+                f"(max {self._ingest_gate.capacity})",
+            )
+        try:
+            return self._do_ingest(
+                sp, db_name, store, s3_bucket, s3_path,
+                ingest_behind, allow_overlapping_keys, compact_after,
+            )
+        finally:
+            self._ingest_gate.exit()
+
+    @staticmethod
+    def _check_ingest_behind(app_db: ApplicationDB, ingest_behind: bool):
+        if not ingest_behind:
+            return
+        if not app_db.db.options.allow_ingest_behind:
+            raise RpcApplicationError(
+                DB_ADMIN_ERROR, "db not opened with allow_ingest_behind"
+            )
+        if not app_db.db_lmax_empty():
+            raise RpcApplicationError(
+                DB_ADMIN_ERROR, "bottom level not empty"
+            )
+
     def _do_ingest(
-        self, db_name, app_db, store, s3_bucket, s3_path,
+        self, sp, db_name, store, s3_bucket, s3_path,
         ingest_behind, allow_overlapping_keys, compact_after,
     ) -> dict:
         tmp = tempfile.mkdtemp(prefix=f"rstpu-ingest-{db_name}-")
         try:
-            with Timer("admin.sst_download_ms"):
+            # -- download + validate: OUTSIDE the per-db admin lock --------
+            with Timer("admin.sst_download_ms"), \
+                    start_span("admin.ingest.download"):
                 local_files = store.get_objects(  # :1724-1726
                     s3_path, tmp,
                     direct_io=bool(FLAGS.get("s3_direct_io")))
             sst_files = [p for p in local_files if p.endswith(".tsst")]
             if not sst_files:
                 raise RpcApplicationError(DB_ADMIN_ERROR, f"no .tsst under {s3_path}")
-            target_db = app_db
-            if not allow_overlapping_keys and not ingest_behind:
-                # full replace: close → destroy → reopen → re-add (:1774-1817)
-                role = app_db.role
-                mode = _current_mode(app_db)
-                upstream = (
-                    app_db.replicated_db.upstream_addr
-                    if app_db.replicated_db else None
-                )
-                self.db_manager.remove_db(db_name)
-                destroy_db(self._db_path(db_name))
-                target_db = self._open_app_db(db_name, role, upstream,
-                                              replication_mode=mode)
-            with Timer("admin.sst_ingest_ms"):
-                target_db.db.ingest_external_file(
-                    sst_files,
-                    move_files=True,
-                    allow_global_seqno=True,
-                    ingest_behind=ingest_behind,
-                )  # :1819-1827
-            self.write_meta_data(db_name, s3_bucket, s3_path)  # :1836
+            with start_span("admin.ingest.validate", files=len(sst_files)):
+                from ..storage.sst import SSTReader
+
+                for path in sst_files:
+                    try:
+                        SSTReader(path).close()  # format/checksum probe
+                    except Exception as e:
+                        raise RpcApplicationError(
+                            DB_ADMIN_ERROR, f"bad SST {os.path.basename(path)}: {e}"
+                        ) from e
+                    # Break object-store download hardlinks HERE, outside
+                    # every lock: the engine's global-seqno footer rewrite
+                    # must own the inode, and its own nlink guard would
+                    # otherwise pay this copy under the DB lock.
+                    if os.stat(path).st_nlink > 1:
+                        tmp_copy = path + ".unlink"
+                        shutil.copyfile(path, tmp_copy)
+                        os.replace(tmp_copy, path)
+            # -- ingest + meta: re-take the per-db lock, with staleness
+            #    re-checks (the db and its meta may have changed while we
+            #    were downloading without the lock) ------------------------
+            with self._db_admin_lock.locked(db_name):
+                app_db = self.db_manager.get_db(db_name)
+                if app_db is None:
+                    # closeDB won the race: surface DB_NOT_FOUND, never
+                    # ingest into a closed/stale handle
+                    raise RpcApplicationError(DB_NOT_FOUND, db_name)
+                meta = self.get_meta_data(db_name)
+                if meta.s3_bucket == s3_bucket and meta.s3_path == s3_path:
+                    # a concurrent ingest of the same set won: idempotent
+                    return {"skipped": True}
+                self._check_ingest_behind(app_db, ingest_behind)
+                target_db = app_db
+                if not allow_overlapping_keys and not ingest_behind:
+                    # full replace: close → destroy → reopen → re-add
+                    # (:1774-1817)
+                    role = app_db.role
+                    mode = _current_mode(app_db)
+                    upstream = (
+                        app_db.replicated_db.upstream_addr
+                        if app_db.replicated_db else None
+                    )
+                    self.db_manager.remove_db(db_name)
+                    destroy_db(self._db_path(db_name))
+                    target_db = self._open_app_db(db_name, role, upstream,
+                                                  replication_mode=mode)
+                with Timer("admin.sst_ingest_ms"), \
+                        start_span("admin.ingest.ingest", files=len(sst_files)):
+                    target_db.db.ingest_external_file(
+                        sst_files,
+                        move_files=True,
+                        allow_global_seqno=True,
+                        ingest_behind=ingest_behind,
+                        validated=True,  # probed in the pre-lock stage
+                    )  # :1819-1827
+                with start_span("admin.ingest.meta"):
+                    self.write_meta_data(db_name, s3_bucket, s3_path)  # :1836
+            # -- post-load compaction: outside the admin lock, batched
+            #    across concurrently-loading shards ------------------------
             if compact_after:
-                with Timer("admin.post_ingest_compact_ms"):
-                    target_db.compact_range()  # :1845-1850
+                with Timer("admin.post_ingest_compact_ms"), \
+                        start_span("admin.ingest.compact") as csp:
+                    try:
+                        batched_with = self._batch_compactor.compact(
+                            db_name, target_db.db)  # :1845-1850
+                        csp.annotate(batch=batched_with)
+                    except StorageError:
+                        # compaction is advisory: a closeDB/clearDB that
+                        # raced in after our ingest+meta committed tears
+                        # the db down mid-compact — the load itself
+                        # succeeded and a closed db needs no compaction,
+                        # so don't fail the RPC for it
+                        if self.db_manager.get_db(db_name) is not None:
+                            raise
+                        csp.annotate(skipped="db closed during compact")
+                        log.info("%s closed during post-load compact; "
+                                 "ingest already committed", db_name)
+            sp.annotate(files=len(sst_files))
             self._stats.incr("admin.sst_files_ingested", len(sst_files))
             return {"ingested_files": len(sst_files)}
         finally:
@@ -665,4 +749,5 @@ class AdminHandler:
                 pass
         self._ingestion.clear()
         self._meta_db.close()
+        self._batch_compactor.close()
         self._executor.shutdown(wait=False)

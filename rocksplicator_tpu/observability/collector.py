@@ -151,6 +151,37 @@ class SpanCollector:
         out.sort(key=lambda t: t["start_ms"], reverse=True)
         return out[:limit]
 
+    def slowest_trace(self, root_name: str) -> Optional[Dict[str, Any]]:
+        """The retained trace whose ROOT span (a span whose parent is not
+        in the trace) named ``root_name`` has the largest duration — the
+        bench's slowest-shard attribution hook. Returns
+        ``{"root": span_dict, "trace": trace_dict}`` or None."""
+        best = None
+        for tr in self.traces(limit=self._capacity):
+            ids = {s["span_id"] for s in tr["spans"]}
+            for s in tr["spans"]:
+                if s["name"] != root_name or s["parent_id"] in ids:
+                    continue
+                if best is None or s["duration_ms"] > best["root"]["duration_ms"]:
+                    best = {"root": s, "trace": tr}
+        return best
+
+    def phase_totals(self, prefix: str) -> Dict[str, Dict[str, float]]:
+        """Aggregate retained span durations by name, for names starting
+        with ``prefix``: {name: {count, total_ms, max_ms}}. Feeds the
+        bench's per-phase JSON breakdown."""
+        out: Dict[str, Dict[str, float]] = {}
+        for d in self.snapshot():
+            name = d["name"]
+            if not name.startswith(prefix):
+                continue
+            agg = out.setdefault(
+                name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+            agg["count"] += 1
+            agg["total_ms"] = round(agg["total_ms"] + d["duration_ms"], 3)
+            agg["max_ms"] = max(agg["max_ms"], d["duration_ms"])
+        return out
+
     def to_json_text(self, limit: int = 64) -> str:
         """The ``/traces`` status-server endpoint body."""
         return json.dumps({

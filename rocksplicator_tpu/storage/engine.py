@@ -997,7 +997,14 @@ class DB:
             with start_span("compaction.gc", files=len(dead)):
                 self._remove_dead_files(dead)
 
-    def _flush_locked(self) -> None:
+    def _flush_locked(self, defer_manifest: bool = False) -> None:
+        """``defer_manifest=True`` (ingest_external_file's internal flush
+        only) skips the manifest persist + WAL purge + compaction-trigger
+        tail: the caller persists a manifest that covers this flush
+        moments later, halving the flush's fsync bill. Crash-safe — until
+        that manifest lands the flushed SST is an orphan file and the WAL
+        still holds every entry, so recovery replays as if the flush
+        never happened."""
         if self._imms:
             # callers must drain first (would flush out of queue order and
             # inflate persisted_seq past unflushed sequence numbers)
@@ -1013,7 +1020,8 @@ class DB:
             self._readers[name] = SSTReader(os.path.join(self.path, name))
             self._levels[0].append(name)
             self._persisted_seq = max(self._persisted_seq, mem.max_seq)
-            self._persist_manifest()
+            if not defer_manifest:
+                self._persist_manifest()
         except BaseException:
             # Keep read-your-writes: fold the unflushed entries back under
             # any writes that raced in. (Both sinks abandon their partial
@@ -1023,6 +1031,8 @@ class DB:
         finally:
             if mem in self._imms:
                 self._imms.remove(mem)
+        if defer_manifest:
+            return
         if self.options.wal_archive_sink is None:
             # cheap unlink-only purge. With an archive sink the purge
             # does network IO and _flush_locked runs UNDER the DB lock —
@@ -1152,6 +1162,12 @@ class DB:
         stream = self._backend.merge_runs(
             streams, self.options.merge_operator, drop_tombstones
         )
+        return self._write_entry_stream(stream)
+
+    def _write_entry_stream(self, stream) -> List[str]:
+        """Write an already-merged (key asc, seq desc) entry stream into
+        output SSTs, splitting at target_file_bytes. Shared by the tuple
+        merge path and the cross-db batched-compaction install."""
         out_names: List[str] = []
         writer: Optional[SSTWriter] = None
         written = 0
@@ -1176,6 +1192,91 @@ class DB:
         for name in out_names:
             self._readers[name] = SSTReader(os.path.join(self.path, name))
         return out_names
+
+    # ------------------------------------------------------------------
+    # batched full compaction (plan / install seam)
+    # ------------------------------------------------------------------
+    #
+    # compact_range does plan → merge → install in one call, holding the
+    # compaction mutex throughout. The cross-shard batched post-load
+    # compaction (tpu/compaction_service.compact_dbs_batched) needs the
+    # MERGE stage lifted out so many DBs' merges run in one padded device
+    # call; these three methods expose exactly the plan/install halves
+    # with the same locking discipline. A plan holds this DB's compaction
+    # mutex until exactly one of install_full_compaction /
+    # abort_full_compaction consumes it.
+
+    def plan_full_compaction(self) -> Optional[dict]:
+        """Flush, then snapshot a full-compaction plan (inputs + readers +
+        target level). Returns None — and retains nothing — when there is
+        nothing to compact. On a non-None return the caller OWNS the
+        compaction mutex via the plan."""
+        self.flush()
+        self._compaction_mutex.acquire()
+        try:
+            with self._lock:
+                self._check_open()
+                bottom = self.options.num_levels - 1
+                if self.options.allow_ingest_behind:
+                    bottom -= 1
+                inputs: List[str] = [
+                    n for files in self._levels for n in files
+                ]
+                if not inputs:
+                    self._compaction_mutex.release()
+                    return None
+                runs = [self._readers[n] for n in inputs]
+            return {
+                "inputs": inputs,
+                "runs": runs,
+                "bottom": bottom,
+                "drop_tombstones": not self.options.allow_ingest_behind,
+            }
+        except BaseException:
+            self._compaction_mutex.release()
+            raise
+
+    def allocate_sst(self) -> Tuple[str, str]:
+        """Reserve an SST file name for an external compaction sink;
+        returns (name, absolute path). The file only becomes live when a
+        later install names it (orphaned allocations are harmless)."""
+        name = self._new_file_name()
+        return name, os.path.join(self.path, name)
+
+    def install_full_compaction(self, plan: dict, entries=None,
+                                files: Optional[List[str]] = None) -> None:
+        """Swap in a plan's externally-merged outputs (manifest first,
+        then input GC — the compact_range crash-safety order). Outputs
+        come either as merged ``entries`` tuples written here, or as
+        ``files``: names from :meth:`allocate_sst` whose SSTs the caller
+        already wrote durably (the array-native batched sink). Consumes
+        the plan's mutex."""
+        try:
+            if files is not None:
+                out_names = list(files)
+                for name in out_names:
+                    self._readers[name] = SSTReader(
+                        os.path.join(self.path, name))
+            else:
+                out_names = self._write_entry_stream(iter(entries))
+            with self._lock:
+                self._check_open()
+                input_set = set(plan["inputs"])
+                # L0 flushes that landed during the external merge stay
+                for level_files in self._levels:
+                    level_files[:] = [
+                        n for n in level_files if n not in input_set]
+                bottom = plan["bottom"]
+                self._levels[bottom] = out_names + self._levels[bottom]
+                self._persist_manifest()
+                self._gc_files(plan["inputs"])
+        finally:
+            self._compaction_mutex.release()
+
+    def abort_full_compaction(self, plan: dict) -> None:
+        """Release a plan without installing (external merge declined or
+        failed); the DB is untouched and compact_range remains safe."""
+        self._compaction_mutex.release()
 
     def _remove_dead_files(
         self, dead: List[Tuple[str, Optional[SSTReader]]]
@@ -1303,6 +1404,7 @@ class DB:
         move_files: bool = False,
         allow_global_seqno: bool = True,
         ingest_behind: bool = False,
+        validated: bool = False,
     ) -> None:
         """IngestExternalFile parity (admin_handler.cpp:1819-1827).
 
@@ -1310,6 +1412,10 @@ class DB:
         ingest_behind: file lands in the bottom level with global_seqno 0
         (older than everything); requires ``allow_ingest_behind`` and an
         empty bottom level (the DBLmaxEmpty check).
+
+        ``validated=True``: the caller already format/checksum-probed every
+        file (the admin handler's pre-lock validate stage) — skip the
+        per-file SSTReader probe here so it doesn't run under the DB lock.
         """
         with self._lock:
             self._check_open()
@@ -1319,18 +1425,32 @@ class DB:
                 if self._levels[-1]:
                     raise InvalidArgument("bottom level not empty")
             new_names: List[str] = []
+            # Both ingest modes rewrite the adopted file's footer in place
+            # (global seqno). A multiply-linked source (the object store's
+            # zero-copy download path hands out hardlinks to the bucket
+            # object) must therefore be adopted by COPY, or the rewrite
+            # would mutate the shared inode — i.e. corrupt the bucket.
+            will_rewrite = ingest_behind or allow_global_seqno
             try:
                 for src in sst_paths:
-                    probe = SSTReader(src)  # validates format
-                    probe.close()
+                    if not validated:
+                        probe = SSTReader(src)  # validates format
+                        probe.close()
                     name = self._new_file_name()
                     dst = os.path.join(self.path, name)
                     if move_files:
-                        try:
-                            os.link(src, dst)
+                        if will_rewrite and os.stat(src).st_nlink > 1:
+                            # copy-or-fail: a rename fallback would keep
+                            # the shared inode and re-open the bucket-
+                            # corruption hole this branch exists to close
+                            shutil.copyfile(src, dst)
                             os.remove(src)
-                        except OSError:
-                            shutil.move(src, dst)
+                        else:
+                            try:
+                                os.link(src, dst)
+                                os.remove(src)
+                            except OSError:
+                                shutil.move(src, dst)
                     else:
                         shutil.copyfile(src, dst)
                     new_names.append(name)
@@ -1353,10 +1473,13 @@ class DB:
                 # memtable — and any in-flight background flush, which would
                 # otherwise land in L0 ABOVE the ingested file — must be
                 # flushed below it first (RocksDB flushes on overlapping
-                # ingest for the same reason).
+                # ingest for the same reason). The manifest persist is
+                # deferred to THIS method's final persist (one durable
+                # manifest write covers flush + ingest), with the WAL purge
+                # re-run below once that manifest is down.
                 self._drain_imm_locked()
                 if len(self._mem):
-                    self._flush_locked()
+                    self._flush_locked(defer_manifest=True)
                 if allow_global_seqno:
                     self._last_seq += 1
                     self._set_global_seqnos(new_names, self._last_seq)
@@ -1373,6 +1496,14 @@ class DB:
                 # the parked compactor's predicate reads len(levels[0])
                 self._cond.notify_all()
             self._persist_manifest()
+            if not ingest_behind and self.options.wal_archive_sink is None:
+                # the deferred flush's purge: only now that the manifest
+                # naming the flushed SST is durable is dropping the WAL
+                # entries it covers safe
+                wal_mod.purge_obsolete(
+                    self._wal_dir, self._persisted_seq,
+                    self.options.wal_ttl_seconds,
+                )
 
     def _readers_open(self, name: str) -> SSTReader:
         if name not in self._readers:

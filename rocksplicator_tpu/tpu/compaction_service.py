@@ -18,6 +18,7 @@ Two integration levels:
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -113,10 +114,14 @@ class TpuCompactionService:
         batches: Sequence[KVBatch],
         merge_kind: MergeKind = MergeKind.UINT64_ADD,
         drop_tombstones: bool = True,
+        return_arrays: bool = False,
     ) -> List[dict]:
         """Compact many shards in one launch. Returns, per shard:
         {"entries": [(key, seq, vtype, value)], "bloom_words": np.ndarray,
-        "count": int}."""
+        "count": int} — or, with ``return_arrays``, {"arrays": lane dict,
+        "bloom_words", "count"} with NO per-entry tuple unpacking (the
+        array-native sink path: callers feed the lanes straight to
+        write_sst_from_arrays)."""
         if not batches:
             return []
         capacity = _next_pow2(max(b.capacity for b in batches))
@@ -164,20 +169,10 @@ class TpuCompactionService:
                         fallbacks += 1
                         results.append(self._cpu_recompute(
                             batches[s], merge_kind, drop_tombstones,
-                            num_words))
+                            num_words, return_arrays=return_arrays))
                         continue
-                    count = int(host["count"][s])
-                    entries = unpack_entries(
-                        host["key_words_be"][s], host["key_len"][s],
-                        host["seq_hi"][s], host["seq_lo"][s],
-                        host["vtype"][s], host["val_words"][s],
-                        host["val_len"][s], count,
-                    )
-                    results.append({
-                        "entries": entries,
-                        "bloom_words": host["bloom"][s],
-                        "count": count,
-                    })
+                    results.append(_shard_result(
+                        host, s, int(host["count"][s]), return_arrays))
             if fallbacks:
                 jsp.annotate(cpu_fallbacks=fallbacks)
             return results
@@ -188,6 +183,7 @@ class TpuCompactionService:
         merge_kind: MergeKind = MergeKind.UINT64_ADD,
         drop_tombstones: bool = True,
         group_size: int = 8,
+        return_arrays: bool = False,
     ) -> List[dict]:
         """Pipelined variant of compact_shard_batch for big shard counts:
         shards run in fixed-size groups with double-buffered transfers —
@@ -202,10 +198,11 @@ class TpuCompactionService:
         with start_span("tpu.compact_stream", always=True,
                         shards=len(batches), group_size=group_size):
             return self._compact_shard_stream(
-                batches, merge_kind, drop_tombstones, group_size)
+                batches, merge_kind, drop_tombstones, group_size,
+                return_arrays)
 
     def _compact_shard_stream(self, batches, merge_kind, drop_tombstones,
-                              group_size):
+                              group_size, return_arrays=False):
         jax = self._jax
         capacity = _next_pow2(max(b.capacity for b in batches))
         num_words = num_words_for(capacity, self._bits_per_key)
@@ -248,15 +245,15 @@ class TpuCompactionService:
             if len(pending) > 1:
                 results.extend(self._drain(
                     *pending.pop(0), batches, merge_kind, drop_tombstones,
-                    num_words))
+                    num_words, return_arrays))
         while pending:
             results.extend(self._drain(
                 *pending.pop(0), batches, merge_kind, drop_tombstones,
-                num_words))
+                num_words, return_arrays))
         return results
 
     def _drain(self, lo: int, out, batches, merge_kind, drop_tombstones,
-               num_words) -> List[dict]:
+               num_words, return_arrays=False) -> List[dict]:
         """Readback + unpack one group's device outputs."""
         host = {k: np.asarray(v) for k, v in out.items()}
         group = batches[lo:lo + len(host["count"])]
@@ -264,23 +261,16 @@ class TpuCompactionService:
         for s in range(min(len(group), len(host["count"]))):
             if bool(host["needs_cpu_fallback"][s]):
                 results.append(self._cpu_recompute(
-                    group[s], merge_kind, drop_tombstones, num_words))
+                    group[s], merge_kind, drop_tombstones, num_words,
+                    return_arrays=return_arrays))
                 continue
-            count = int(host["count"][s])
-            entries = unpack_entries(
-                host["key_words_be"][s], host["key_len"][s],
-                host["seq_hi"][s], host["seq_lo"][s], host["vtype"][s],
-                host["val_words"][s], host["val_len"][s], count,
-            )
-            results.append({
-                "entries": entries,
-                "bloom_words": host["bloom"][s],
-                "count": count,
-            })
+            results.append(_shard_result(
+                host, s, int(host["count"][s]), return_arrays))
         return results
 
     def _cpu_recompute(self, batch: KVBatch, merge_kind: MergeKind,
-                       drop_tombstones: bool, num_words: int) -> dict:
+                       drop_tombstones: bool, num_words: int,
+                       return_arrays: bool = False) -> dict:
         """Host recompute for shards the kernel flagged (e.g. one key with
         ≥2^16 operands — beyond the limb-sum range). ``num_words`` is the
         job-wide bloom size so fallback blooms stay interchangeable with
@@ -293,7 +283,6 @@ class TpuCompactionService:
             batch, uint64_add=merge_kind is MergeKind.UINT64_ADD,
             drop_tombstones=drop_tombstones,
         )
-        entries = unpack_entries(*arrays, count)
         bf = BloomFilter(num_words)
         lib = get_native()
         if lib is not None and count:
@@ -309,9 +298,22 @@ class TpuCompactionService:
             np.cumsum(lens, out=offsets[1:])
             lib.bloom_add_concat(bf.words, kb[mask], offsets, count)
         else:
-            for key, _seq, _vt, _val in entries:
+            for key, _seq, _vt, _val in unpack_entries(*arrays, count):
                 bf.add(key)
-        return {"entries": entries, "bloom_words": bf.words, "count": count}
+        if return_arrays:
+            kw_be, klen, seq_hi, seq_lo, vtype, vw, vlen = (
+                a[:count] for a in arrays)
+            lanes = {
+                "key_words_be": kw_be,
+                # LE word values are the same key bytes read little-endian
+                # — a per-element byteswap of the BE values
+                "key_words_le": kw_be.byteswap(),
+                "key_len": klen, "seq_hi": seq_hi, "seq_lo": seq_lo,
+                "vtype": vtype, "val_words": vw, "val_len": vlen,
+            }
+            return {"arrays": lanes, "bloom_words": bf.words, "count": count}
+        return {"entries": unpack_entries(*arrays, count),
+                "bloom_words": bf.words, "count": count}
 
 
 def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
@@ -319,3 +321,329 @@ def _pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
         return arr
     pad = [(0, capacity - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
     return np.pad(arr, pad)
+
+
+# lane names carried through the arrays-native result path (matches
+# tpu/chunked.FIELDS; redeclared to avoid importing chunked at call time)
+_LANES = (
+    "key_words_be", "key_words_le", "key_len", "seq_hi", "seq_lo",
+    "vtype", "val_words", "val_len",
+)
+
+
+def _shard_result(host: Dict[str, np.ndarray], s: int, count: int,
+                  return_arrays: bool) -> dict:
+    """One shard's result from stacked device outputs: lane views (no
+    per-entry work) or unpacked tuples."""
+    if return_arrays:
+        return {
+            "arrays": {f: host[f][s][:count] for f in _LANES},
+            "bloom_words": host["bloom"][s],
+            "count": count,
+        }
+    return {
+        "entries": unpack_entries(
+            host["key_words_be"][s], host["key_len"][s],
+            host["seq_hi"][s], host["seq_lo"][s],
+            host["vtype"][s], host["val_words"][s],
+            host["val_len"][s], count,
+        ),
+        "bloom_words": host["bloom"][s],
+        "count": count,
+    }
+
+
+# ---------------------------------------------------------------------------
+# cross-DB batched full compaction (the post-load_sst path)
+# ---------------------------------------------------------------------------
+
+_PUT, _DELETE, _MERGE = 1, 2, 3
+
+# One shard above this entry count would inflate the whole padded launch
+# (every shard pays the max shard's capacity); such shards compact per-db.
+MAX_BATCHED_DB_ENTRIES = 1 << 20
+
+
+class _LaneBatch:
+    """Duck-typed KVBatch over pre-read lane arrays — the arrays-native
+    input to compact_shard_batch/stream (no per-entry pack loop)."""
+
+    __slots__ = ("key_words_be", "key_words_le", "key_len", "seq_hi",
+                 "seq_lo", "vtype", "val_words", "val_len", "valid")
+
+    def __init__(self, lanes: Dict[str, np.ndarray]):
+        for f in _LANES:
+            setattr(self, f, lanes[f])
+        self.valid = np.ones(lanes["key_len"].shape[0], dtype=bool)
+
+    @property
+    def capacity(self) -> int:
+        return self.key_len.shape[0]
+
+
+def _db_lanes(plan: dict) -> Optional[Dict[str, np.ndarray]]:
+    """A plan's input runs as one concatenated lane dict (planar/uniform
+    files decode straight to lanes; row-format files pay one pack). None
+    when the lane representation can't express a run."""
+    from ..ops.kv_format import UnsupportedBatch
+    from .backend import _arrays_from_entries
+    from .chunked import FIELDS
+    from .format import read_sst_arrays
+
+    parts: List[dict] = []
+    try:
+        for r in plan["runs"]:
+            arr = read_sst_arrays(r)
+            if arr is None:
+                arr = _arrays_from_entries(list(r.iterate()))
+            if arr is not None:
+                parts.append(arr)
+    except UnsupportedBatch as e:
+        log.debug("batched compaction lane read declined: %s", e)
+        return None
+    if not parts:
+        return None
+    vw = max(p["val_words"].shape[1] for p in parts)
+    for p in parts:
+        w = p["val_words"].shape[1]
+        if w < vw:
+            p["val_words"] = np.pad(p["val_words"], [(0, 0), (0, vw - w)])
+    return {f: np.concatenate([p[f] for p in parts]) for f in FIELDS}
+
+
+def _install_arrays(db, plan: dict, res: dict) -> None:
+    """Write one shard's resolved lanes as PLANAR SSTs (vectorized sink,
+    kernel-built per-file blooms) and install them; falls back to the
+    entry-tuple sink when the planar layout can't express the result."""
+    from ..storage.bloom import num_words_for as bloom_words_for
+    from .format import planar_stride, planar_widths, write_sst_from_arrays
+
+    arrays, count = res["arrays"], int(res["count"])
+    if count == 0:
+        db.install_full_compaction(plan, entries=[])
+        return
+    widths = planar_widths(arrays, count)
+    if widths is not None:
+        import jax.numpy as jnp
+
+        opts = db.options
+        stride = planar_stride(*widths)
+        entries_per_file = max(1024, opts.target_file_bytes // max(1, stride))
+        block_entries = max(64, opts.block_bytes // max(1, stride))
+        names: List[str] = []
+        paths: List[str] = []
+        ok = True
+        for start in range(0, count, entries_per_file):
+            end = min(start + entries_per_file, count)
+            sub = {f: arrays[f][start:end] for f in arrays}
+            # per-file bloom sized from THIS file's count and the DB's own
+            # bits_per_key — the job-level bloom is sized by the group's
+            # padded max capacity (and the service default bits), so
+            # reusing it would write a max-shard-sized bloom into every
+            # small shard of a mixed batch
+            bloom = np.asarray(bloom_build_tpu(
+                jnp.asarray(sub["key_words_le"]),
+                jnp.asarray(sub["key_len"]),
+                jnp.asarray(np.ones(end - start, dtype=bool)),
+                num_words=bloom_words_for(end - start, opts.bits_per_key),
+            ))
+            name, path = db.allocate_sst()
+            props = write_sst_from_arrays(
+                sub, end - start, path, bloom_words=bloom,
+                block_entries=block_entries, compression=opts.compression,
+                bits_per_key=opts.bits_per_key, planar=True,
+            )
+            if props is None:
+                ok = False
+                for p in paths:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+                break
+            names.append(name)
+            paths.append(path)
+        if ok:
+            db.install_full_compaction(plan, files=names)
+            return
+    # tuple fallback (non-uniform keys/values)
+    entries = unpack_entries(
+        arrays["key_words_be"], arrays["key_len"], arrays["seq_hi"],
+        arrays["seq_lo"], arrays["vtype"], arrays["val_words"],
+        arrays["val_len"], count,
+    )
+    db.install_full_compaction(plan, entries=entries)
+
+
+def compact_dbs_batched(dbs, group_size: int = 8, pool=None):
+    """Fully compact many DBs' key spaces with batched device launches —
+    the cross-shard post-load compaction: N shards' merge-resolve runs as
+    vmapped groups over one padded shape instead of N per-db pipelines,
+    arrays end to end (runs decode to lanes, the resolved lanes write
+    through the PLANAR sink — no per-entry Python on either side). The
+    per-db host stages (plan + lane read, then SST write + install) fan
+    out over ``pool`` (any Executor) when given; only the device launch
+    is centralized.
+
+    Per DB: plan (engine plan_full_compaction: flush + snapshot under the
+    compaction mutex), read its runs as lanes, launch the group, install
+    each shard's output files (engine install_full_compaction). DBs the
+    lane representation can't express (custom merge operators, >24B keys,
+    wide values, MERGE records with no operator, oversized shards) are
+    declined untouched.
+
+    Returns ``(handled, remaining)``: db names compacted here, and the
+    (name, db) pairs the caller must compact per-db (compact_range).
+    """
+    from ..storage.merge import UInt64AddOperator
+
+    dbs = list(dbs)
+    handled: List[str] = []
+    remaining: List[tuple] = []
+    groups: Dict[tuple, List[tuple]] = {}  # (kind, drop) -> items
+    # every un-consumed plan holds its DB's compaction mutex; the finally
+    # below releases any leaked by an unexpected raise so the caller's
+    # per-db compact_range fallback can never deadlock
+    pending: Dict[int, tuple] = {}
+    pending_lock = threading.Lock()
+
+    def _track(db, plan):
+        with pending_lock:
+            pending[id(plan)] = (db, plan)
+
+    def _untrack(plan):
+        with pending_lock:
+            pending.pop(id(plan), None)
+
+    def _abort(db, plan):
+        _untrack(plan)
+        db.abort_full_compaction(plan)
+
+    def _pmap(fn, items):
+        if pool is None or len(items) <= 1:
+            return [fn(it) for it in items]
+        return list(pool.map(fn, items))
+
+    def _stage(item):
+        """(name, db) → ("handled"|"remaining"|("grouped", key, payload)).
+
+        MUST NOT raise: staging runs through pool.map, and an exception
+        there returns control to the caller while sibling _stage tasks
+        are still acquiring compaction mutexes — a raced finally-sweep
+        could then miss a just-tracked plan and leak its mutex forever.
+        Any failure (corrupt SST read, OSError, ...) declines the db to
+        the per-db compact_range fallback instead."""
+        name, db = item
+        merge_op = db.options.merge_operator
+        if merge_op is not None and not isinstance(
+                merge_op, UInt64AddOperator):
+            return ("remaining", name, db, None)
+        try:
+            plan = db.plan_full_compaction()
+        except BaseException:
+            log.exception("plan failed for %s; declining to per-db", name)
+            return ("remaining", name, db, None)
+        if plan is None:
+            return ("handled", name, db, None)  # nothing to compact
+        _track(db, plan)
+        try:
+            lanes = _db_lanes(plan)
+        except BaseException:
+            log.exception(
+                "lane read failed for %s; declining to per-db", name)
+            _abort(db, plan)
+            return ("remaining", name, db, None)
+        total = lanes["key_len"].shape[0] if lanes is not None else 0
+        if (
+            lanes is None
+            or total == 0
+            or total > MAX_BATCHED_DB_ENTRIES
+            # uint64-add fold needs 8-byte values (backend.py parity)
+            or (merge_op is not None and bool(
+                ((lanes["vtype"] != _DELETE)
+                 & (lanes["val_len"] != 8)).any()))
+            # MERGE records without an operator: CPU path only
+            or (merge_op is None and bool((lanes["vtype"] == _MERGE).any()))
+        ):
+            _abort(db, plan)
+            return ("remaining", name, db, None)
+        kind = (
+            MergeKind.UINT64_ADD if merge_op is not None else MergeKind.NONE
+        )
+        key = (kind, plan["drop_tombstones"])
+        return ("grouped", name, db, (key, plan, _LaneBatch(lanes)))
+
+    def _install(args):
+        name, db, plan, res = args
+        _untrack(plan)  # install consumes the plan either way
+        try:
+            _install_arrays(db, plan, res)
+            return ("handled", name, db)
+        except BaseException:
+            # the mutex was released in install's finally; a per-db
+            # retry via compact_range is safe
+            log.exception(
+                "batched compaction install failed for %s; "
+                "will re-compact per-db", name)
+            return ("remaining", name, db)
+
+    try:
+        with start_span("admin.compact_stage", shards=len(dbs)):
+            staged = _pmap(_stage, dbs)
+        for verdict, name, db, payload in staged:
+            if verdict == "handled":
+                handled.append(name)
+            elif verdict == "remaining":
+                remaining.append((name, db))
+            else:
+                key, plan, batch = payload
+                groups.setdefault(key, []).append((name, db, plan, batch))
+
+        svc = TpuCompactionService.instance()
+        for (kind, drop), items in groups.items():
+            batches = [b for _n, _d, _p, b in items]
+            vw = max(b.val_words.shape[1] for b in batches)
+            for b in batches:  # group-uniform value lanes for np.stack
+                w = b.val_words.shape[1]
+                if w < vw:
+                    b.val_words = np.pad(
+                        b.val_words, [(0, 0), (0, vw - w)])
+            try:
+                if len(batches) > group_size:
+                    # one compiled (group_size, capacity) shape serves
+                    # every group; H2D of group i+1 overlaps group i's
+                    # kernel
+                    results = svc.compact_shard_stream(
+                        batches, merge_kind=kind, drop_tombstones=drop,
+                        group_size=group_size, return_arrays=True)
+                else:
+                    results = svc.compact_shard_batch(
+                        batches, merge_kind=kind, drop_tombstones=drop,
+                        return_arrays=True)
+            except BaseException:
+                log.exception(
+                    "batched compaction launch failed (%d shards); "
+                    "falling back per-db", len(items))
+                for name, db, plan, _b in items:
+                    _abort(db, plan)
+                    remaining.append((name, db))
+                continue
+            installs = [(name, db, plan, res) for (name, db, plan, _b), res
+                        in zip(items, results)]
+            with start_span("admin.compact_install", shards=len(installs)):
+                installed = _pmap(_install, installs)
+            for verdict, name, db in installed:
+                if verdict == "handled":
+                    handled.append(name)
+                else:
+                    remaining.append((name, db))
+        return handled, remaining
+    finally:
+        with pending_lock:
+            leaked = list(pending.values())
+            pending.clear()
+        for db, plan in leaked:
+            try:
+                db.abort_full_compaction(plan)
+            except Exception:
+                pass

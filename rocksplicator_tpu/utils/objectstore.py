@@ -20,7 +20,7 @@ from __future__ import annotations
 import os
 import shutil
 import threading
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Dict, List, Optional, Tuple
 
 from .rate_limiter import ConcurrentRateLimiter
@@ -81,7 +81,14 @@ class ObjectStore:
     ) -> List[str]:
         """Download every object under ``prefix`` into ``local_dir``.
         ``direct_io`` bypasses the page cache (O_DIRECT sink — reference
-        s3util direct-IO download path). Returns local file paths."""
+        s3util direct-IO download path). Returns local file paths.
+
+        All-or-nothing: a failed fetch raises an ObjectStoreError naming
+        the failing KEY (pool.map used to surface it as an opaque error
+        mid-iteration) after the remaining fetches drain, and every file
+        this call already produced — including the failing fetch's
+        partial sink — is removed, so callers never see a half-downloaded
+        batch directory."""
         keys = self.list_objects(prefix)
         os.makedirs(local_dir, exist_ok=True)
         results: List[str] = []
@@ -91,12 +98,31 @@ class ObjectStore:
             name = key[len(prefix):].lstrip("/") or os.path.basename(key)
             local_path = os.path.join(local_dir, name)
             os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
-            self.get_object(key, local_path, direct_io=direct_io)
+            try:
+                self.get_object(key, local_path, direct_io=direct_io)
+            except Exception as e:
+                try:
+                    os.remove(local_path)  # partial sink
+                except OSError:
+                    pass
+                raise ObjectStoreError(
+                    f"get_objects: fetch of {key!r} failed: {e}") from e
             with lock:
                 results.append(local_path)
 
         with ThreadPoolExecutor(max_workers=parallelism) as pool:
-            list(pool.map(fetch, keys))
+            error: Optional[ObjectStoreError] = None
+            for fut in as_completed([pool.submit(fetch, k) for k in keys]):
+                exc = fut.exception()
+                if exc is not None and error is None:
+                    error = exc  # first failure wins; let the rest drain
+        if error is not None:
+            for path in results:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            raise error
         return sorted(results)
 
     def put_objects(
@@ -156,7 +182,19 @@ class LocalObjectStore(ObjectStore):
                 for chunk in iter(lambda: f.read(1 << 20), b""):
                     out.write(chunk)
         else:
-            shutil.copyfile(src, local_path)
+            # Zero-copy fast path when bucket and sink share a filesystem:
+            # hardlink instead of copying the bytes (the dominant download
+            # cost of a local-store SST bulk-ingest). Consumers that would
+            # MUTATE the file must break the link themselves — the engine's
+            # ingest does (its global-seqno footer rewrite would otherwise
+            # write through to the bucket object). EXDEV/perm failures fall
+            # back to the copy.
+            try:
+                if os.path.lexists(local_path):
+                    os.remove(local_path)
+                os.link(src, local_path)
+            except OSError:
+                shutil.copyfile(src, local_path)
 
     def get_object_bytes(self, key: str) -> bytes:
         src = self._path(key)

@@ -1,0 +1,450 @@
+"""Pipelined SST bulk-ingest tests (ISSUE 3).
+
+Covers the narrowed per-db admin lock (download/validate outside, ingest +
+meta re-locked with staleness re-checks), the ingest admission gate, the
+cross-shard BatchCompactor, the object-store zero-copy/link hazards, and
+the get_objects failure contract. Everything here is tier-1-fast: tiny
+SSTs, in-process admin nodes, no full bench run.
+"""
+
+import os
+import struct
+import threading
+import time
+
+import pytest
+
+from rocksplicator_tpu.admin import AdminHandler
+from rocksplicator_tpu.admin.ingest_pipeline import (
+    BatchCompactor, default_sst_loading_concurrency)
+from rocksplicator_tpu.replication import ReplicationFlags, Replicator
+from rocksplicator_tpu.rpc import (IoLoop, RpcApplicationError, RpcClientPool,
+                                   RpcServer)
+from rocksplicator_tpu.storage import DB, OpType, WriteBatch
+from rocksplicator_tpu.storage.sst import SSTWriter
+from rocksplicator_tpu.utils.objectstore import (LocalObjectStore,
+                                                 ObjectStoreError)
+
+pack64 = struct.Struct("<q").pack
+
+FAST = ReplicationFlags(
+    server_long_poll_ms=400, pull_error_delay_min_ms=50,
+    pull_error_delay_max_ms=120,
+)
+
+
+class GatedStore(LocalObjectStore):
+    """LocalObjectStore whose downloads park on an event — lets tests hold
+    an ingest in its download stage (which must NOT hold the per-db admin
+    lock) while racing other admin ops against it."""
+
+    def __init__(self, root):
+        super().__init__(root)
+        self.release = threading.Event()
+        self.started = threading.Semaphore(0)
+        self.concurrent = 0
+        self.max_concurrent = 0
+        self._clock = threading.Lock()
+
+    def get_object(self, key, local_path, direct_io=False):
+        with self._clock:
+            self.concurrent += 1
+            self.max_concurrent = max(self.max_concurrent, self.concurrent)
+        self.started.release()
+        try:
+            assert self.release.wait(timeout=30), "gated download never freed"
+            return super().get_object(key, local_path, direct_io=direct_io)
+        finally:
+            with self._clock:
+                self.concurrent -= 1
+
+
+class Node:
+    def __init__(self, tmp_path, name="node", **kw):
+        self.replicator = Replicator(port=0, flags=FAST)
+        self.handler = AdminHandler(
+            str(tmp_path / name), self.replicator, **kw)
+        self.server = RpcServer(port=0, ioloop=self.replicator.ioloop)
+        self.server.add_handler(self.handler)
+        self.server.start()
+        self.ioloop = IoLoop.default()
+        self.pool = RpcClientPool()
+
+    def call(self, method, **args):
+        return self.call_async(method, **args).result(30)
+
+    def call_async(self, method, **args):
+        """Issue the RPC on the ioloop; returns a concurrent future."""
+        async def go():
+            return await self.pool.call(
+                "127.0.0.1", self.server.port, method, args, timeout=30)
+
+        return self.ioloop.run_coro(go())
+
+    def stop(self):
+        self.ioloop.run_sync(self.pool.close())
+        self.server.stop()
+        self.handler.close()
+        self.replicator.stop()
+
+
+@pytest.fixture()
+def node_factory(tmp_path):
+    made = []
+
+    def make(**kw):
+        n = Node(tmp_path, name=f"node{len(made)}", **kw)
+        made.append(n)
+        return n
+
+    yield make
+    for n in made:
+        n.stop()
+
+
+def put_sst(store, prefix, items, tmp_path, name="bulk.tsst"):
+    local = tmp_path / f"_mk_{prefix.replace('/', '_')}_{name}"
+    w = SSTWriter(str(local))
+    for k, v in items:
+        w.add(k, 0, OpType.PUT, v)
+    w.finish()
+    store.put_object(str(local), f"{prefix}/{name}")
+    os.remove(local)
+
+
+# ---------------------------------------------------------------------------
+# admission gate
+# ---------------------------------------------------------------------------
+
+
+def test_gate_default_is_cpu_derived(node_factory):
+    n = node_factory()
+    assert n.handler._ingest_gate.capacity == default_sst_loading_concurrency()
+    assert n.handler._ingest_gate.capacity < 999
+    assert default_sst_loading_concurrency() >= 4
+
+
+def test_gate_trips_too_many_requests(node_factory, tmp_path):
+    n = node_factory(max_sst_loading_concurrency=1)
+    store = GatedStore(str(tmp_path / "bucket"))
+    put_sst(store, "sst/a", [(b"a", b"1")], tmp_path)
+    put_sst(store, "sst/b", [(b"b", b"2")], tmp_path)
+    n.handler._store = lambda uri: store
+    n.call("add_db", db_name="seg00001", role="LEADER")
+    n.call("add_db", db_name="seg00002", role="LEADER")
+    fut1 = n.call_async("add_s3_sst_files_to_db", db_name="seg00001",
+                        s3_bucket="b", s3_path="sst/a")
+    assert store.started.acquire(timeout=10)  # first holds the gate slot
+    with pytest.raises(RpcApplicationError) as ei:
+        n.call("add_s3_sst_files_to_db", db_name="seg00002",
+               s3_bucket="b", s3_path="sst/b")
+    assert ei.value.code == "TOO_MANY_REQUESTS"
+    store.release.set()
+    assert fut1.result(30)["ingested_files"] == 1
+    # slot released: the rejected ingest now goes through
+    r = n.call("add_s3_sst_files_to_db", db_name="seg00002",
+               s3_bucket="b", s3_path="sst/b")
+    assert r["ingested_files"] == 1
+
+
+# ---------------------------------------------------------------------------
+# lock narrowing: races that were impossible when the whole chain held the
+# per-db admin lock
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_same_path_ingest_hits_idempotency_skip(
+        node_factory, tmp_path):
+    n = node_factory()
+    store = GatedStore(str(tmp_path / "bucket"))
+    put_sst(store, "sst/v1", [(b"a", b"1"), (b"b", b"2")], tmp_path)
+    n.handler._store = lambda uri: store
+    n.call("add_db", db_name="seg00001", role="LEADER")
+    f1 = n.call_async("add_s3_sst_files_to_db", db_name="seg00001",
+                      s3_bucket="bkt", s3_path="sst/v1")
+    f2 = n.call_async("add_s3_sst_files_to_db", db_name="seg00001",
+                      s3_bucket="bkt", s3_path="sst/v1")
+    # both passed admission (meta was empty) and are parked in download
+    assert store.started.acquire(timeout=10)
+    assert store.started.acquire(timeout=10)
+    store.release.set()
+    results = [f1.result(30), f2.result(30)]
+    # exactly one ingested; the other saw the meta staleness re-check and
+    # skipped (admin_handler.cpp:1655-1667 idempotency, now also raced)
+    assert sorted(r.get("skipped", False) for r in results) == [False, True]
+    assert [r.get("ingested_files") for r in results].count(1) == 1
+    app_db = n.handler.db_manager.get_db("seg00001")
+    assert app_db.get(b"a") == b"1"
+
+
+def test_ingest_racing_close_db_gets_db_not_found(node_factory, tmp_path):
+    n = node_factory()
+    store = GatedStore(str(tmp_path / "bucket"))
+    put_sst(store, "sst/v1", [(b"a", b"1")], tmp_path)
+    n.handler._store = lambda uri: store
+    n.call("add_db", db_name="seg00001", role="LEADER")
+    fut = n.call_async("add_s3_sst_files_to_db", db_name="seg00001",
+                       s3_bucket="bkt", s3_path="sst/v1")
+    assert store.started.acquire(timeout=10)
+    # download holds NO admin lock now — closeDB must proceed immediately
+    n.call("close_db", db_name="seg00001")
+    store.release.set()
+    with pytest.raises(RpcApplicationError) as ei:
+        fut.result(30)
+    assert ei.value.code == "DB_NOT_FOUND"
+
+
+def test_pipelined_multi_shard_ingest(node_factory, tmp_path):
+    """N shards ingested concurrently: downloads overlap (the lock
+    narrowing at work) and every shard ends with exactly its own data."""
+    shards = 4
+    n = node_factory()
+    store = GatedStore(str(tmp_path / "bucket"))
+    store.release.set()  # no parking — just record concurrency
+    for s in range(shards):
+        put_sst(store, f"sst/{s:05d}",
+                [(f"s{s}-k{i:03d}".encode(), pack64(s * 100 + i))
+                 for i in range(50)],
+                tmp_path)
+    n.handler._store = lambda uri: store
+    for s in range(shards):
+        n.call("add_db", db_name=f"seg{s:05d}", role="LEADER")
+    futs = [
+        n.call_async("add_s3_sst_files_to_db", db_name=f"seg{s:05d}",
+                     s3_bucket="bkt", s3_path=f"sst/{s:05d}",
+                     compact_db_after_load=True)
+        for s in range(shards)
+    ]
+    for f in futs:
+        assert f.result(60)["ingested_files"] == 1
+    for s in range(shards):
+        app_db = n.handler.db_manager.get_db(f"seg{s:05d}")
+        assert app_db.get(f"s{s}-k049".encode()) == pack64(s * 100 + 49)
+        # no cross-shard bleed
+        other = (s + 1) % shards
+        assert app_db.get(f"s{other}-k000".encode()) is None
+        assert n.handler.get_meta_data(f"seg{s:05d}").s3_path == f"sst/{s:05d}"
+
+
+def test_close_racing_post_load_compact_is_benign(
+        node_factory, tmp_path, monkeypatch):
+    """Post-load compaction runs outside the admin lock; a closeDB that
+    tears the db down mid-compact must NOT fail the RPC — the ingest and
+    meta write already durably committed, and a closed db needs no
+    compaction."""
+    from rocksplicator_tpu.admin.ingest_pipeline import BatchCompactor
+    from rocksplicator_tpu.storage.errors import StorageError
+
+    n = node_factory()
+    store = LocalObjectStore(str(tmp_path / "bucket"))
+    put_sst(store, "sst/v1", [(b"a", b"1")], tmp_path)
+    n.handler._store = lambda uri: store
+    n.call("add_db", db_name="seg00001", role="LEADER")
+
+    def torn_down_compact(self, db_name, db):
+        # simulate the race outcome: close lands first, compact then
+        # sees a closed engine
+        n.handler.db_manager.remove_db(db_name)
+        raise StorageError("db is closed")
+
+    monkeypatch.setattr(BatchCompactor, "compact", torn_down_compact)
+    r = n.call("add_s3_sst_files_to_db", db_name="seg00001",
+               s3_bucket="bkt", s3_path="sst/v1",
+               compact_db_after_load=True)
+    assert r["ingested_files"] == 1  # ingest committed; no error surfaced
+
+
+# ---------------------------------------------------------------------------
+# batched post-load compaction
+# ---------------------------------------------------------------------------
+
+
+class StubDB:
+    def __init__(self, log_list, name, block=None):
+        self._log = log_list
+        self._name = name
+        self._block = block
+
+    def compact_range(self):
+        if self._block is not None:
+            assert self._block.wait(timeout=30)
+        self._log.append(self._name)
+
+
+def test_batch_compactor_coalesces_concurrent_shards():
+    compactor = BatchCompactor(use_tpu=False, compact_parallelism=2)
+    try:
+        done = []
+        gate = threading.Event()
+        sizes = {}
+
+        def submit(name, db):
+            sizes[name] = compactor.compact(name, db)
+
+        # leader dispatches shard0 alone (its compact blocks on `gate`);
+        # shards 1+2 queue up meanwhile and must ride ONE batch
+        t0 = threading.Thread(
+            target=submit, args=("db0", StubDB(done, "db0", block=gate)))
+        t0.start()
+        while compactor.dispatch_count == 0:
+            time.sleep(0.01)
+        ts = [
+            threading.Thread(target=submit, args=(f"db{i}", StubDB(done, f"db{i}")))
+            for i in (1, 2)
+        ]
+        for t in ts:
+            t.start()
+        while len(compactor._queue) < 2:
+            time.sleep(0.01)
+        gate.set()
+        for t in [t0] + ts:
+            t.join(30)
+        assert sorted(done) == ["db0", "db1", "db2"]
+        assert compactor.batch_sizes == [1, 2]
+        assert sizes["db1"] == sizes["db2"] == 2
+    finally:
+        compactor.close()
+
+
+def test_batch_compactor_propagates_per_db_errors():
+    compactor = BatchCompactor(use_tpu=False, compact_parallelism=2)
+    try:
+        class Boom:
+            def compact_range(self):
+                raise RuntimeError("disk on fire")
+
+        ok = []
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            compactor.compact("bad", Boom())
+        compactor.compact("good", StubDB(ok, "good"))
+        assert ok == ["good"]
+    finally:
+        compactor.close()
+
+
+def test_compact_dbs_batched_tpu_parity(tmp_path):
+    """The one-padded-device-call path produces the same post-compaction
+    state as per-db compact_range: overlapping preload writes resolved
+    against ingested data, tombstones dropped."""
+    from rocksplicator_tpu.tpu.compaction_service import compact_dbs_batched
+
+    dbs = []
+    for s in range(2):
+        db = DB(str(tmp_path / f"db{s}"))
+        for i in range(30):
+            db.write(WriteBatch().put(f"k{i:03d}".encode(), pack64(-1)))
+        db.write(WriteBatch().delete(b"k000"))
+        sst = tmp_path / f"in{s}.tsst"
+        w = SSTWriter(str(sst))
+        for i in range(10, 40):
+            w.add(f"k{i:03d}".encode(), 0, OpType.PUT, pack64(s * 1000 + i))
+        w.finish()
+        db.ingest_external_file([str(sst)], move_files=True,
+                                allow_global_seqno=True)
+        dbs.append((f"db{s}", db))
+    handled, remaining = compact_dbs_batched(dbs)
+    assert sorted(handled) == ["db0", "db1"] and remaining == []
+    for s, (_name, db) in enumerate(dbs):
+        assert db.get(b"k000") is None              # tombstone dropped
+        assert db.get(b"k005") == pack64(-1)        # preload-only key kept
+        assert db.get(b"k015") == pack64(s * 1000 + 15)  # SST (newer) wins
+        assert db.get(b"k039") == pack64(s * 1000 + 39)
+        # fully compacted: everything in one bottom-level run
+        levels = db._levels
+        assert all(not files for files in levels[:-1])
+        db.close()
+
+
+def test_compact_dbs_batched_declines_unsupported(tmp_path):
+    """A DB the lane format can't express (>24B keys) is declined
+    UNTOUCHED (plan aborted, compact_range still works on it)."""
+    from rocksplicator_tpu.tpu.compaction_service import compact_dbs_batched
+
+    db = DB(str(tmp_path / "wide"))
+    db.write(WriteBatch().put(b"k" * 40, b"v"))
+    db.flush()
+    handled, remaining = compact_dbs_batched([("wide", db)])
+    assert handled == [] and [n for n, _ in remaining] == ["wide"]
+    db.compact_range()  # mutex was released by the abort
+    assert db.get(b"k" * 40) == b"v"
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# object store: failure contract + zero-copy fast path
+# ---------------------------------------------------------------------------
+
+
+def test_get_objects_propagates_failing_key_and_cleans_partials(tmp_path):
+    store = LocalObjectStore(str(tmp_path / "bucket"))
+    for i in range(4):
+        store.put_object_bytes(f"batch/f{i}.bin", b"x" * 128)
+
+    real = LocalObjectStore.get_object
+
+    def flaky(self, key, local_path, direct_io=False):
+        if key.endswith("f2.bin"):
+            raise ObjectStoreError("injected transport error")
+        return real(self, key, local_path, direct_io=direct_io)
+
+    store.get_object = flaky.__get__(store)
+    dest = tmp_path / "dl"
+    with pytest.raises(ObjectStoreError) as ei:
+        store.get_objects("batch", str(dest))
+    assert "f2.bin" in str(ei.value)  # the failing KEY is named
+    # all-or-nothing: no partial batch left behind
+    assert list(dest.iterdir()) == []
+
+
+def test_local_get_object_zero_copy_link(tmp_path):
+    store = LocalObjectStore(str(tmp_path / "bucket"))
+    store.put_object_bytes("a/obj.bin", b"payload")
+    sink = tmp_path / "dl" / "obj.bin"
+    store.get_object("a/obj.bin", str(sink))
+    assert sink.read_bytes() == b"payload"
+    src_ino = os.stat(tmp_path / "bucket" / "a" / "obj.bin").st_ino
+    assert os.stat(sink).st_ino == src_ino  # hardlink, not a copy
+    # refetch over an existing sink still works
+    store.get_object("a/obj.bin", str(sink))
+    assert sink.read_bytes() == b"payload"
+
+
+def test_ingest_breaks_hardlink_before_footer_rewrite(tmp_path):
+    """The global-seqno footer rewrite must never write through a
+    download hardlink into the bucket object."""
+    store = LocalObjectStore(str(tmp_path / "bucket"))
+    sst = tmp_path / "mk.tsst"
+    w = SSTWriter(str(sst))
+    w.add(b"k", 0, OpType.PUT, b"v")
+    w.finish()
+    store.put_object(str(sst), "sst/bulk.tsst")
+    bucket_file = tmp_path / "bucket" / "sst" / "bulk.tsst"
+    original = bucket_file.read_bytes()
+
+    local = store.get_objects("sst", str(tmp_path / "dl"))
+    assert os.stat(local[0]).st_nlink > 1  # zero-copy download happened
+    db = DB(str(tmp_path / "db"))
+    db.ingest_external_file(local, move_files=True, allow_global_seqno=True)
+    assert db.get(b"k") == b"v"
+    db.close()
+    assert bucket_file.read_bytes() == original  # bucket never mutated
+
+
+# ---------------------------------------------------------------------------
+# bench-path smoke (tier-1-safe: tiny config, cpu backend, in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_load_sst_bench_pipeline_smoke(tmp_path):
+    from benchmarks.load_sst_bench import build_sst_sets, run_load
+
+    store_uri = str(tmp_path / "bucket")
+    store = LocalObjectStore(store_uri)
+    total = build_sst_sets(store, 3, 200, str(tmp_path))
+    assert total > 0
+    run = run_load({}, store_uri, 3, 200, 0.2, "cpu",
+                   str(tmp_path / "dbs"), window=2)
+    assert run["spot_check_failures"] == 0
+    assert run["phase_ms"].get("admin.add_s3_sst", {}).get("count") == 3
+    assert run["slowest_shard_trace"] is not None
+    assert sum(run["compact_batch_sizes"]) == 3
