@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test test-fast native bench loadsst-bench load-sst-smoke soak-bench repl-bench-smoke clean
+.PHONY: test test-fast native bench loadsst-bench load-sst-smoke soak-bench repl-bench-smoke chaos-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -35,6 +35,20 @@ repl-bench-smoke:
 	$(PY) -m benchmarks.replication_3replica_bench --shards 8 --keys 50 \
 		--write_window 64 \
 		--out benchmarks/results/replication_3replica_smoke.json
+
+# seeded chaos smoke (<60s): 20 randomized failpoint schedules against a
+# 3-node cluster + the admin ingest path, every schedule checked for the
+# three standing invariants (hole-free WAL prefix, zero acked-write
+# loss, ingest atomicity/no-partial-meta); then a deliberately-broken
+# durability guard run that must be CAUGHT (--expect-violation). A
+# violation prints the reproducing --seed.
+chaos-smoke:
+	$(PY) -m tools.chaos_soak --schedules 20 --seed 1 \
+		--out benchmarks/results/chaos_smoke.json
+	$(PY) -m tools.chaos_soak --schedules 1 --seed 7 \
+		--break-guard wal_hole --expect-violation --conv-timeout 3
+	$(PY) -m tools.chaos_soak --schedules 1 --seed 7 --ingest-every 1 \
+		--break-guard meta_first --expect-violation --conv-timeout 10
 
 clean:
 	$(MAKE) -C rocksplicator_tpu/storage/native clean

@@ -38,6 +38,7 @@ from ..rpc.errors import RpcApplicationError
 from ..storage import backup as backup_mod
 from ..storage.engine import DB, DBOptions, destroy_db
 from ..storage.errors import StorageError
+from ..testing import failpoints as fp
 from ..utils.flags import FLAGS, define_flag
 from ..utils.object_lock import ObjectLock
 from ..utils.objectstore import build_object_store
@@ -619,6 +620,7 @@ class AdminHandler:
                     destroy_db(self._db_path(db_name))
                     target_db = self._open_app_db(db_name, role, upstream,
                                                   replication_mode=mode)
+                fp.hit("admin.ingest.engine")
                 with Timer("admin.sst_ingest_ms"), \
                         start_span("admin.ingest.ingest", files=len(sst_files)):
                     target_db.db.ingest_external_file(
@@ -628,6 +630,11 @@ class AdminHandler:
                         ingest_behind=ingest_behind,
                         validated=True,  # probed in the pre-lock stage
                     )  # :1819-1827
+                # the crash-consistency seam the chaos harness leans on:
+                # a fault HERE must leave the DB fully post-ingest with
+                # meta still pre-ingest (retryable), never meta-without-
+                # data (tests/test_failpoints.py ingest invariants)
+                fp.hit("admin.ingest.meta")
                 with start_span("admin.ingest.meta"):
                     self.write_meta_data(db_name, s3_bucket, s3_path)  # :1836
             # -- post-load compaction: outside the admin lock, batched

@@ -26,6 +26,8 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from ..testing import failpoints as fp
+
 log = logging.getLogger(__name__)
 
 
@@ -144,6 +146,8 @@ class BatchCompactor:
             self._dispatch_spanned(batch)
 
     def _dispatch_spanned(self, batch: List[Tuple[str, object, Future]]) -> None:
+        fp.hit("compact.dispatch")  # a raise must fail the batch loudly,
+        # release every waiter, and keep the leader loop draining
         self.dispatch_count += 1
         self.batch_sizes.append(len(batch))
         # Deduplicate by DB identity: the same db can legally ride one

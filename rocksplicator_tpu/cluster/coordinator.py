@@ -51,6 +51,7 @@ from ..rpc.client_pool import RpcClientPool
 from ..rpc.errors import RpcApplicationError, RpcError
 from ..rpc.ioloop import IoLoop
 from ..rpc.server import RpcServer
+from ..testing import failpoints as fp
 from ..utils.stats import Stats
 
 log = logging.getLogger(__name__)
@@ -629,6 +630,13 @@ class CoordinatorServer:
             await asyncio.sleep(self._ttl / 3)
             if self._standby:
                 continue  # replicated deadlines are inf until promote
+            try:
+                # delay = a stalled reaper (sessions outlive their TTL);
+                # fail = a reap pass lost — both must only postpone
+                # expiry, never wedge the loop
+                await fp.async_hit("coordinator.reap")
+            except OSError:
+                continue
             now = time.monotonic()
             with self._lock:
                 dead = [s for s, dl in self._sessions.items() if dl < now]
@@ -671,6 +679,10 @@ class CoordinatorServer:
                 "ftoken": self._fencing_token}
 
     async def handle_heartbeat(self, session_id: int = 0) -> dict:
+        # dropped/stalled heartbeats are how chaos drives REAL session
+        # expiry end to end (participant retry → TTL lapse → ephemeral
+        # teardown → failover), not a simulated shortcut
+        await fp.async_hit("coordinator.heartbeat")
         self._check_primary()
         # A minority-partitioned quorum primary must NOT keep sessions
         # (and their ephemeral lock nodes) alive: the majority side will

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 import threading
 import time
@@ -35,7 +36,9 @@ from ..observability.span import Span, start_span
 from ..rpc.client_pool import RpcClientPool
 from ..rpc.errors import RpcApplicationError, RpcConnectionError, RpcError
 from ..storage.records import WriteBatch, decode_batch, scan_batch_meta
+from ..testing import failpoints as fp
 from ..utils.misc import now_ms
+from ..utils.retry_policy import RetryPolicy
 from ..utils.stats import Stats, tagged
 from .ack_window import AckWaiter, AckWindow, resolved_waiter
 from .cond_var import AsyncNotifier
@@ -134,6 +137,24 @@ class ReplicatedDB:
         self._upstream_mode: Optional[int] = None  # learned from responses
         self._empty_pulls = 0
         self._conn_errors = 0
+        # pull-error backoff: exp backoff + jitter via the unified
+        # RetryPolicy (utils/retry_policy.py) — jittered within
+        # [min, cap], cap growing from the reference's min delay toward
+        # max across consecutive errors, reset on the first successful
+        # pull. The min flag stays a HARD floor (the reference's
+        # uniform(min, max) contract): an error loop must never hammer
+        # the upstream/control plane at sub-floor intervals.
+        # RSTPU_PULL_RETRY_SEED pins the jitter for reproducible chaos.
+        f = self.flags
+        self._pull_retry = RetryPolicy(
+            max_attempts=1 << 30,
+            base_delay=f.pull_error_delay_min_ms / 1000.0,
+            max_delay=f.pull_error_delay_max_ms / 1000.0,
+            floor=f.pull_error_delay_min_ms / 1000.0,
+        )
+        self._pull_retry_attempt = 0
+        _seed = os.environ.get("RSTPU_PULL_RETRY_SEED")
+        self._pull_rng = random.Random(int(_seed) if _seed else None)
         self._stats = Stats.get()
         # serves handled since start: benches/ops gate their write phase
         # on every shard having a live puller (a shard whose pullers are
@@ -391,6 +412,16 @@ class ReplicatedDB:
             self._expiry_deadline = None
         if self._removed:
             return
+        try:
+            # delay = a LATE timer (rescheduled, not a blocked loop);
+            # fail = a LOST one — the next register re-arms, and write()
+            # carries a belt-and-braces local deadline either way
+            late = fp.pending_delay("ack.expire")
+        except OSError:
+            return
+        if late > 0.0:
+            self._loop.call_later(late, self._fire_expiry)
+            return
         next_deadline = self._acked.expire_due()
         if next_deadline is not None:
             self._request_expiry(next_deadline)
@@ -600,6 +631,7 @@ class ReplicatedDB:
             try:
                 applied, source_role = await self._pull_once()
                 self._conn_errors = 0
+                self._pull_retry_attempt = 0
                 if (
                     applied == 0
                     and self.role is ReplicaRole.FOLLOWER
@@ -662,6 +694,7 @@ class ReplicatedDB:
         along as ``applied_seq`` so mode-2 acks never over-claim."""
         f = self.flags
         assert self.upstream_addr is not None
+        await fp.async_hit("repl.pull")
         host, port = self.upstream_addr
         # Follower-rooted pull trace: pool acquire + RPC RTT (which carries
         # the context to the upstream's serve span) + the apply handoff.
@@ -816,6 +849,7 @@ class ReplicatedDB:
     def _apply_updates(self, updates: List[dict],
                        pull_ctx: Optional[dict] = None) -> None:
         """Executor-side ordered apply of one response's updates."""
+        fp.hit("repl.apply")
         now = now_ms()
         total_bytes = 0
         with start_span("repl.apply_batch", remote=pull_ctx, db=self.name,
@@ -881,11 +915,12 @@ class ReplicatedDB:
         self._notifier.notify_all_threadsafe()
 
     async def _pull_error_delay(self) -> None:
-        f = self.flags
-        delay_ms = random.uniform(
-            f.pull_error_delay_min_ms, f.pull_error_delay_max_ms
-        )
-        await asyncio.sleep(delay_ms / 1000.0)
+        delay = self._pull_retry.delay(
+            self._pull_retry_attempt, self._pull_rng)
+        self._pull_retry_attempt += 1
+        self._stats.add_metric(
+            "replicator.pull_backoff_ms", delay * 1000.0)
+        await asyncio.sleep(delay)
 
     async def _maybe_reset_upstream(self, force_sample: bool) -> None:
         """Query the leader resolver (reference: Helix GetLeaderInstanceId,

@@ -15,6 +15,7 @@ import logging
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from ..testing import failpoints as fp
 from .errors import RpcApplicationError, RpcConnectionError, RpcTimeout
 from .framing import FrameReader, write_frame
 from .serde import decode_message, encode_message
@@ -49,6 +50,9 @@ class RpcClient:
     async def connect(self) -> None:
         self.last_connect_attempt = time.monotonic()
         try:
+            # inside the except net: a tripped fail policy surfaces as
+            # RpcConnectionError, a delay policy is a stuck connect
+            await fp.async_hit("rpc.connect")
             self._reader, self._writer = await asyncio.wait_for(
                 asyncio.open_connection(
                     self.host, self.port,

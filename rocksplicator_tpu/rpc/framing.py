@@ -28,6 +28,7 @@ import zlib
 from typing import List, Tuple
 
 from ..storage import rlz
+from ..testing import failpoints as fp
 
 MAGIC = 0x5254
 FLAG_PAYLOAD_ZLIB = 1
@@ -64,6 +65,19 @@ async def write_frame(
             payload_chunks = [compressed]
             plen = len(compressed)
             flags |= flag
+    await fp.async_hit("rpc.frame.send")
+    cut = fp.torn_point(
+        "rpc.frame.send", _HEADER.size + len(header) + plen)
+    if cut is not None:
+        # torn frame: a prefix reaches the peer (short/desynced stream →
+        # clean decode error + reconnect there), the sender sees a
+        # failed send (OSError) and must treat the connection as dead
+        frame = b"".join(
+            [_HEADER.pack(MAGIC, flags, len(header), plen), header,
+             *payload_chunks])[:cut]
+        writer.write(frame)
+        await writer.drain()
+        raise fp.FailpointError(f"torn frame at +{cut}B")
     # ONE transport write: each StreamWriter.write() attempts an eager
     # send syscall when the buffer is empty, so the old 3..N-write frame
     # cost 3..N sends. Joining costs one memcpy of an already-small
@@ -89,6 +103,7 @@ class FrameReader:
     async def read_frame(self) -> Tuple[memoryview, memoryview]:
         """Returns (header, payload) memoryviews. Raises
         asyncio.IncompleteReadError on clean EOF."""
+        await fp.async_hit("rpc.frame.recv")
         head = await self._reader.readexactly(_HEADER.size)
         magic, flags, hlen, plen = _HEADER.unpack(head)
         if magic != MAGIC:

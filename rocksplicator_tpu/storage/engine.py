@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..observability.span import start_span
+from ..testing import failpoints as fp
 from ..utils.misc import write_file_atomic
 from ..utils.stats import Stats
 from . import wal as wal_mod
@@ -266,6 +267,7 @@ class DB:
         the live DB it participates in the versioned ordering so it can
         never be overwritten by a stale concurrent snapshot."""
         if target_dir is not None:
+            fp.hit("manifest.persist")
             write_file_atomic(
                 os.path.join(target_dir, _MANIFEST),
                 json.dumps(self._manifest_dict()).encode("utf-8"),
@@ -287,6 +289,7 @@ class DB:
         with self._manifest_mutex:
             if version <= self._manifest_written_version:
                 return
+            fp.hit("manifest.persist")
             write_file_atomic(
                 os.path.join(self.path, _MANIFEST), payload)
             self._manifest_written_version = version
@@ -1252,6 +1255,7 @@ class DB:
         already wrote durably (the array-native batched sink). Consumes
         the plan's mutex."""
         try:
+            fp.hit("compact.install")
             if files is not None:
                 out_names = list(files)
                 for name in out_names:
@@ -1432,6 +1436,7 @@ class DB:
             # would mutate the shared inode — i.e. corrupt the bucket.
             will_rewrite = ingest_behind or allow_global_seqno
             try:
+                fp.hit("engine.ingest")
                 for src in sst_paths:
                     if not validated:
                         probe = SSTReader(src)  # validates format
@@ -1516,6 +1521,7 @@ class DB:
         from .sst import _FOOTER, FLAG_HAS_GLOBAL_SEQNO, MAGIC
 
         for name in names:
+            fp.hit("sst.ingest_footer")
             path = os.path.join(self.path, name)
             with open(path, "r+b") as f:
                 f.seek(0, os.SEEK_END)

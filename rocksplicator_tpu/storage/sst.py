@@ -30,6 +30,7 @@ import struct
 import zlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from ..testing import failpoints as fp
 from . import rlz
 from .bloom import BloomFilter
 from .errors import Corruption, InvalidArgument
@@ -236,6 +237,7 @@ class SSTWriter:
         # can drop, with no WAL left to replay. (The dirent rides the
         # manifest writer's directory fsync, which happens after this.)
         self._file.flush()
+        fp.hit("sst.fsync")
         os.fsync(self._file.fileno())
         self._file.close()
         # Only now is the file complete — a failure anywhere above leaves

@@ -30,9 +30,17 @@ import time
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
+from ..testing import failpoints as fp
 from .errors import Corruption, StorageError
 
 _REC_HEAD = struct.Struct("<QII")
+
+
+def _fsync_file(f) -> None:
+    """All WAL data/segment fsyncs funnel through the ``wal.fsync``
+    failpoint (delay = a stalling device, fail = a dying one)."""
+    fp.hit("wal.fsync")
+    os.fsync(f.fileno())
 
 
 class WalWriter:
@@ -75,18 +83,41 @@ class WalWriter:
         """Buffer one record and flush it to the OS. Returns the sync
         token covering it — pass to ``sync_to`` for durability. Must be
         externally serialized (the engine holds the DB lock)."""
+        fp.hit("wal.append")
         if self._file is None or self._file_size >= self._segment_bytes:
             self._roll(start_seq)
         rec = _REC_HEAD.pack(
             start_seq, len(batch_bytes), zlib.crc32(batch_bytes) & 0xFFFFFFFF
         )
         assert self._file is not None
-        self._file.write(rec)
-        self._file.write(batch_bytes)
-        # flush BEFORE publishing the token: a sync leader snapshotting
-        # the token must find these bytes already in the OS, so its
-        # fsync alone durably covers them
-        self._file.flush()
+        try:
+            cut = fp.torn_point("wal.append", len(rec) + len(batch_bytes))
+            if cut is not None:
+                # torn write: a prefix of the record reaches the OS and
+                # the writer sees a failed append (crash-shaped fault)
+                self._file.write((rec + batch_bytes)[:cut])
+                self._file.flush()
+                raise fp.FailpointError(f"torn WAL append at +{cut}B")
+            self._file.write(rec)
+            self._file.write(batch_bytes)
+            # flush BEFORE publishing the token: a sync leader snapshotting
+            # the token must find these bytes already in the OS, so its
+            # fsync alone durably covers them
+            self._file.flush()
+        except BaseException:
+            # A record that failed part-way (torn injection, ENOSPC, EIO)
+            # would corrupt every LATER append in this still-live process:
+            # scans stop at the first bad CRC, so subsequent committed
+            # records become unreachable. Truncate back to the record
+            # boundary so the log stays hole-free; if even that fails the
+            # reopen-time torn-tail truncation is the backstop.
+            try:
+                if not self._file.closed:
+                    self._file.truncate(self._file_size)
+                    self._file.flush()
+            except (OSError, ValueError):
+                pass
+            raise
         self._file_size += len(rec) + len(batch_bytes)
         self._append_token += 1
         return self._append_token
@@ -100,30 +131,80 @@ class WalWriter:
         serialization contract as ``append``; rolls mid-group flush the
         outgoing segment first."""
         assert records
+        fp.hit("wal.append")
         pending = 0
-        for start_seq, batch_bytes in records:
-            if self._file is None or self._file_size >= self._segment_bytes:
-                if pending:
-                    # flush + publish the group's records in the outgoing
-                    # segment BEFORE rolling: _roll decides sync coverage
-                    # (and _closed_unsynced) from the published token
+        # rollback point if the group fails part-way: the last offset
+        # covered by a PUBLISHED token, valid only for published_file —
+        # truncate() on a DIFFERENT (fresh post-roll) file would
+        # zero-EXTEND it, and 16 zero bytes decode as a valid empty
+        # record (seq 0, len 0, crc32(b"")==0): phantom records
+        published_file = self._file
+        published_size = self._file_size if self._file is not None else 0
+        try:
+            for start_seq, batch_bytes in records:
+                if (self._file is None
+                        or self._file_size >= self._segment_bytes):
+                    if pending:
+                        # flush + publish the group's records in the
+                        # outgoing segment BEFORE rolling: _roll decides
+                        # sync coverage (and _closed_unsynced) from the
+                        # published token
+                        self._file.flush()
+                        self._append_token += pending
+                        pending = 0
+                        # the rollback boundary must advance WITH the
+                        # publish: if _roll itself fails, truncating
+                        # below this point would delete records whose
+                        # tokens are already claimable by sync_to
+                        published_size = self._file_size
+                    self._roll(start_seq)
+                    published_file = self._file
+                    published_size = self._file_size
+                rec = _REC_HEAD.pack(
+                    start_seq, len(batch_bytes),
+                    zlib.crc32(batch_bytes) & 0xFFFFFFFF,
+                )
+                cut = fp.torn_point(
+                    "wal.append", len(rec) + len(batch_bytes))
+                if cut is not None:
+                    # torn group append: same crash-shaped fault as the
+                    # single-record path (the follower batched-apply WAL
+                    # is hit through HERE, not append)
+                    self._file.write((rec + batch_bytes)[:cut])
                     self._file.flush()
-                    self._append_token += pending
-                    pending = 0
-                self._roll(start_seq)
-            rec = _REC_HEAD.pack(
-                start_seq, len(batch_bytes),
-                zlib.crc32(batch_bytes) & 0xFFFFFFFF,
-            )
-            self._file.write(rec)
-            self._file.write(batch_bytes)
-            self._file_size += len(rec) + len(batch_bytes)
-            pending += 1
-        # one flush covers the group; publish AFTER it (sync leaders
-        # snapshotting the token must find every covered byte in the OS)
-        self._file.flush()
-        self._append_token += pending
-        return self._append_token
+                    raise fp.FailpointError(
+                        f"torn WAL group append at +{cut}B")
+                self._file.write(rec)
+                self._file.write(batch_bytes)
+                self._file_size += len(rec) + len(batch_bytes)
+                pending += 1
+            # one flush covers the group; publish AFTER it (sync leaders
+            # snapshotting the token must find every covered byte in the OS)
+            self._file.flush()
+            self._append_token += pending
+            return self._append_token
+        except BaseException:
+            # The group failed part-way: unpublished records (complete or
+            # torn) must not linger — the caller never committed them, so
+            # on replay/serve they would be phantoms under seqs the engine
+            # will reassign to DIFFERENT content. Truncate back to the
+            # published boundary; reopen-time torn-tail truncation is the
+            # backstop if even this fails. Only the file the boundary
+            # belongs to may be truncated: after a failed _roll the
+            # current file is a fresh segment with nothing unpublished
+            # in it (rolls publish first), so it is left alone.
+            try:
+                if (self._file is not None
+                        and self._file is published_file
+                        and not self._file.closed):
+                    self._file.truncate(published_size)
+                    self._file.flush()
+                    self._file_size = published_size
+            except (OSError, ValueError):
+                # ValueError: the file closed under us (a failed _roll);
+                # the original fault must propagate, not this cleanup
+                pass
+            raise
 
     def sync_to(self, token: int) -> None:
         """Group commit: durable up to ``token`` (and opportunistically
@@ -146,7 +227,7 @@ class WalWriter:
             if not self._dir_synced:
                 # segment dirents created before sync was in use
                 self._fsync_dir_locked()
-            os.fsync(f.fileno())
+            _fsync_file(f)
             if cover > self._synced_token:
                 self._synced_token = cover
 
@@ -180,6 +261,7 @@ class WalWriter:
         self._dir_synced = True
 
     def _roll(self, first_seq: int) -> None:
+        fp.hit("wal.roll")
         # the sync lock pins the outgoing file against a concurrent
         # leader's fsync on its (about-to-be-closed) descriptor
         with self._sync_lock:
@@ -191,7 +273,7 @@ class WalWriter:
                         # tokens are honestly covered (one fsync per
                         # segment roll, only once sync is in use)
                         self._file.flush()
-                        os.fsync(self._file.fileno())
+                        _fsync_file(self._file)
                         self._synced_token = self._append_token
                     else:
                         # plain workload: skip the stall, remember that
@@ -222,7 +304,7 @@ class WalWriter:
             if not self._dir_synced:
                 self._fsync_dir_locked()
             f.flush()
-            os.fsync(f.fileno())
+            _fsync_file(f)
             if cover > self._synced_token:
                 self._synced_token = cover
 
@@ -241,7 +323,7 @@ class WalWriter:
                     if not self._dir_synced:
                         self._fsync_dir_locked()
                     self._file.flush()
-                    os.fsync(self._file.fileno())
+                    _fsync_file(self._file)
                     self._synced_token = self._append_token
                 self._file.close()
                 self._file = None

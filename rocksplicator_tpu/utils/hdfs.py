@@ -24,13 +24,29 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import random
 import urllib.parse
 from typing import List, Optional, Tuple
 
+from ..testing import failpoints as fp
 from .objectstore import ObjectStore, ObjectStoreError
+from .retry_policy import RetryBudget, RetryPolicy, retry_call
 
 _MAX_REDIRECTS = 4
 _CHUNK = 1 << 20
+
+# transient-failure retry under the unified policy (previously WebHDFS
+# had NO retry: one namenode hiccup failed the whole backup/restore)
+_HDFS_RETRY = RetryPolicy(max_attempts=4, base_delay=0.1, max_delay=5.0)
+_HDFS_RETRY_BUDGET = RetryBudget(capacity=20.0, refill_per_sec=2.0)
+
+
+def _transient_hdfs_error(exc: BaseException) -> bool:
+    if isinstance(exc, HdfsError):
+        # 0 = transport-level; 5xx = server-side transient. 4xx (missing
+        # path, bad op) and 3xx anomalies are permanent.
+        return exc.status == 0 or exc.status >= 500
+    return isinstance(exc, (OSError, http.client.HTTPException))
 
 
 class HdfsError(ObjectStoreError):
@@ -75,6 +91,7 @@ class HdfsObjectStore(ObjectStore):
         """One HTTP exchange. Returns (status, location, data). With a
         ``sink`` file object, a 2xx response body is streamed into it in
         _CHUNK pieces and ``data`` is b""."""
+        fp.hit("hdfs.request")  # OSError-shaped: absorbed by the retry
         conn = http.client.HTTPConnection(host, port, timeout=self._timeout)
         try:
             headers = {}
@@ -104,6 +121,36 @@ class HdfsObjectStore(ObjectStore):
 
     def _request(self, method: str, path: str, op: str, body=None,
                  sink=None, **params):
+        """One WebHDFS op with transient-failure retries (exp backoff +
+        full jitter + shared budget — utils/retry_policy.py). Retries
+        are safe: CREATE is overwrite-idempotent (file bodies are
+        rewound in ``_send``), OPEN re-streams, and a partial ``sink``
+        from a failed attempt is truncated before the next one."""
+
+        if op == "DELETE":
+            # NOT retried: a retry after a transport failure that
+            # followed a server-side successful delete reads
+            # {"boolean": false} and fabricates a not-found for an op
+            # that succeeded — surface the transport ambiguity instead
+            return self._request_once(
+                method, path, op, body=body, sink=sink, **params)
+
+        def attempt():
+            if sink is not None:
+                sink.seek(0)
+                sink.truncate()
+            return self._request_once(
+                method, path, op, body=body, sink=sink, **params)
+
+        _seed = os.environ.get("RSTPU_RETRY_SEED")
+        return retry_call(
+            attempt, policy=_HDFS_RETRY, classify=_transient_hdfs_error,
+            op="hdfs.request", budget=_HDFS_RETRY_BUDGET,
+            rng=random.Random(int(_seed)) if _seed else None,
+        )
+
+    def _request_once(self, method: str, path: str, op: str, body=None,
+                      sink=None, **params):
         """Issue one WebHDFS op, following namenode->datanode redirects
         manually. Per spec the data body is only sent to the redirect
         target; a server that handles CREATE directly (HttpFS /
@@ -146,7 +193,10 @@ class HdfsObjectStore(ObjectStore):
                         f"{op} {path}: {status} {data[:200]!r}",
                         status=status)
             return status, data
-        raise HdfsError(f"{op} {path}: too many redirects")
+        # distinct non-zero, non-5xx status: a redirect loop is a
+        # PERMANENT misconfiguration — status 0 would classify it
+        # transient and re-walk the whole loop under backoff
+        raise HdfsError(f"{op} {path}: too many redirects", status=310)
 
     # -- ObjectStore API ---------------------------------------------------
 

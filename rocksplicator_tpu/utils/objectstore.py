@@ -23,11 +23,34 @@ import threading
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Dict, List, Optional, Tuple
 
+from ..testing import failpoints as fp
 from .rate_limiter import ConcurrentRateLimiter
+from .retry_policy import RetryBudget, RetryPolicy, retry_call
 
 
 class ObjectStoreError(Exception):
     pass
+
+
+# batch-transfer retry: transient per-object failures inside
+# get_objects/put_objects are retried under the unified policy (the S3
+# and WebHDFS clients also retry transport errors internally; this layer
+# catches what leaks through — and local-store EIO-class faults, which
+# previously failed the whole batch on the first hiccup)
+_BATCH_RETRY = RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=1.0)
+_BATCH_BUDGET = RetryBudget(capacity=32.0, refill_per_sec=4.0)
+
+
+def _transient_store_error(exc: BaseException) -> bool:
+    """Retryable? Permanent object-store answers (missing key, bad
+    bucket path) must surface immediately; transport-shaped failures
+    (OSError incl. injected FailpointError, 5xx-status errors) retry."""
+    status = getattr(exc, "status", None)
+    if status is not None:
+        return status == 0 or status >= 500
+    if isinstance(exc, ObjectStoreError):
+        return False
+    return isinstance(exc, (OSError, ConnectionError))
 
 
 class ObjectStore:
@@ -99,7 +122,12 @@ class ObjectStore:
             local_path = os.path.join(local_dir, name)
             os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
             try:
-                self.get_object(key, local_path, direct_io=direct_io)
+                retry_call(
+                    lambda: self.get_object(
+                        key, local_path, direct_io=direct_io),
+                    policy=_BATCH_RETRY, classify=_transient_store_error,
+                    op="objectstore.get", budget=_BATCH_BUDGET,
+                )
             except Exception as e:
                 try:
                     os.remove(local_path)  # partial sink
@@ -140,7 +168,11 @@ class ObjectStore:
 
         def push(local_path: str) -> None:
             key = prefix.rstrip("/") + "/" + os.path.basename(local_path)
-            self.put_object(local_path, key)
+            retry_call(
+                lambda: self.put_object(local_path, key),
+                policy=_BATCH_RETRY, classify=_transient_store_error,
+                op="objectstore.put", budget=_BATCH_BUDGET,
+            )
             with lock:
                 keys.append(key)
 
@@ -170,6 +202,7 @@ class LocalObjectStore(ObjectStore):
 
     def get_object(self, key: str, local_path: str,
                    direct_io: bool = False) -> None:
+        fp.hit("objectstore.get")
         src = self._path(key)
         if not os.path.isfile(src):
             raise ObjectStoreError(f"no such object: {key}")
@@ -197,6 +230,7 @@ class LocalObjectStore(ObjectStore):
                 shutil.copyfile(src, local_path)
 
     def get_object_bytes(self, key: str) -> bytes:
+        fp.hit("objectstore.get")
         src = self._path(key)
         if not os.path.isfile(src):
             raise ObjectStoreError(f"no such object: {key}")
@@ -206,6 +240,7 @@ class LocalObjectStore(ObjectStore):
         return data
 
     def put_object(self, local_path: str, key: str) -> None:
+        fp.hit("objectstore.put")
         if not os.path.isfile(local_path):
             raise ObjectStoreError(f"no such local file: {local_path}")
         dst = self._path(key)
@@ -216,6 +251,7 @@ class LocalObjectStore(ObjectStore):
         os.replace(tmp, dst)
 
     def put_object_bytes(self, key: str, data: bytes) -> None:
+        fp.hit("objectstore.put")
         dst = self._path(key)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         self._charge(len(data))
@@ -288,7 +324,11 @@ class S3ObjectStore(ObjectStore):
         try:
             return fn(*args)
         except self._S3Error as e:
-            raise ObjectStoreError(str(e)) from e
+            err = ObjectStoreError(str(e))
+            # preserve the HTTP status so the batch-retry classifier
+            # treats a 5xx/transport S3 failure like its HDFS twin
+            err.status = e.status
+            raise err from e
 
     def get_object(self, key: str, local_path: str,
                    direct_io: bool = False) -> None:

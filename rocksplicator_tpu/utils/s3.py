@@ -22,14 +22,22 @@ import hashlib
 import hmac
 import http.client
 import os
+import random
 import socket
-import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from ..testing import failpoints as fp
+from .retry_policy import RetryBudget, RetryPolicy, backoff_step
+
 _ALGORITHM = "AWS4-HMAC-SHA256"
+
+# One retry budget per process, shared by every S3Client: a hard-down
+# endpoint degrades to fail-fast instead of every caller independently
+# multiplying load (utils/retry_policy.py).
+_S3_RETRY_BUDGET = RetryBudget(capacity=20.0, refill_per_sec=2.0)
 _UNRESERVED = frozenset(
     "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-._~"
 )
@@ -167,6 +175,13 @@ class S3Client:
     def __init__(self, bucket: str, config: Optional[S3Config] = None):
         self.bucket = bucket
         self.cfg = config or S3Config()
+        # exp backoff + full jitter (was an inline 2**n*0.1 sleep);
+        # RSTPU_RETRY_SEED pins the jitter for reproducible chaos runs
+        self._retry = RetryPolicy(
+            max_attempts=self.cfg.max_retries + 1,
+            base_delay=0.1, max_delay=5.0)
+        _seed = os.environ.get("RSTPU_RETRY_SEED")
+        self._retry_rng = random.Random(int(_seed) if _seed else None)
         if not self.cfg.access_key or not self.cfg.secret_key:
             raise S3Error(
                 "missing AWS credentials (AWS_ACCESS_KEY_ID / "
@@ -256,6 +271,7 @@ class S3Client:
             )
             target = uri + ("?" + qs if qs else "")
             try:
+                fp.hit("s3.request")  # OSError-shaped: retried below
                 conn_cls = (
                     http.client.HTTPSConnection if self._secure
                     else http.client.HTTPConnection
@@ -296,16 +312,21 @@ class S3Client:
                 finally:
                     conn.close()
             except (OSError, socket.timeout, http.client.HTTPException) as e:
-                if attempt >= self.cfg.max_retries:
+                if not self._retry_sleep(attempt):
                     raise S3Error(f"S3 request failed: {e!r}") from e
-                time.sleep(min(2.0 ** attempt * 0.1, 5.0))
                 attempt += 1
                 continue
-            if status >= 500 and attempt < self.cfg.max_retries:
-                time.sleep(min(2.0 ** attempt * 0.1, 5.0))
+            if status >= 500 and self._retry_sleep(attempt):
                 attempt += 1
                 continue
             return status, rheaders, data
+
+    def _retry_sleep(self, attempt: int) -> bool:
+        """One backoff step under the unified policy; False when the
+        attempt or the process-wide retry budget is exhausted."""
+        return backoff_step(
+            self._retry, attempt, op="s3.request",
+            budget=_S3_RETRY_BUDGET, rng=self._retry_rng)
 
     @staticmethod
     def _error(status: int, data: bytes, what: str) -> S3Error:

@@ -1,0 +1,549 @@
+"""Failpoint registry + fault-injection coverage (ISSUE 4).
+
+Registry semantics (deterministic policies, env activation, /stats +
+span surfacing), torn/short RPC frames on the replication wire, WAL
+torn-append self-healing, ingest crash-consistency around the
+engine-ingest/meta-write boundary, and the seeded chaos harness
+(tools/chaos_soak.py) including its deliberately-broken-guard teeth.
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import pytest
+
+from rocksplicator_tpu.storage import DB, DBOptions
+from rocksplicator_tpu.storage.records import OpType
+from rocksplicator_tpu.storage.sst import SSTWriter
+from rocksplicator_tpu.testing import failpoints as fp
+from rocksplicator_tpu.utils.objectstore import (LocalObjectStore,
+                                                 ObjectStoreError)
+from rocksplicator_tpu.utils.stats import Stats
+
+from test_replication import FAST, Host, hosts, wait_until  # noqa: F401
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset_for_test()
+    yield
+    fp.reset_for_test()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_fail_nth_trips_exactly_once():
+    fp.activate("t.site", "fail_nth:3")
+    fp.hit("t.site")
+    fp.hit("t.site")
+    with pytest.raises(fp.FailpointError):
+        fp.hit("t.site")
+    fp.hit("t.site")  # hit 4: passes again
+    assert fp.trip_counts()["t.site"] == 1
+
+
+def test_fail_first_then_passes():
+    fp.activate("t.site", "fail_first:2")
+    for _ in range(2):
+        with pytest.raises(fp.FailpointError):
+            fp.hit("t.site")
+    fp.hit("t.site")
+    assert fp.trip_counts()["t.site"] == 2
+
+
+def test_fail_prob_deterministic_under_seed():
+    def trace(seed):
+        fp.reset_for_test()
+        fp.activate("t.site", f"fail_prob:0.5@seed{seed}")
+        out = []
+        for _ in range(64):
+            try:
+                fp.hit("t.site")
+                out.append(0)
+            except fp.FailpointError:
+                out.append(1)
+        return out
+
+    a, b, c = trace(7), trace(7), trace(8)
+    assert a == b
+    assert a != c
+    assert 1 in a and 0 in a
+
+
+def test_torn_point_deterministic_and_counts():
+    fp.activate("t.data", "torn:1.0@seed3")
+    cut1 = fp.torn_point("t.data", 1000)
+    fp.reset_for_test()
+    fp.activate("t.data", "torn:1.0@seed3")
+    cut2 = fp.torn_point("t.data", 1000)
+    assert cut1 == cut2 and 0 <= cut1 < 1000
+    # non-torn sites never mangle data
+    fp.activate("t.other", "fail_nth:99")
+    assert fp.torn_point("t.other", 100) is None
+
+
+def test_one_shot_retires_the_site():
+    fp.activate("t.site", "fail_prob:1.0,one_shot")
+    with pytest.raises(fp.FailpointError):
+        fp.hit("t.site")
+    assert not fp.is_active("t.site")
+    fp.hit("t.site")  # retired: no-op
+    assert fp.trip_counts()["t.site"] == 1
+
+
+def test_delay_policy_sleeps():
+    fp.activate("t.site", "delay_ms:30")
+    t0 = time.monotonic()
+    fp.hit("t.site")
+    assert time.monotonic() - t0 >= 0.025
+
+
+def test_env_spec_parsing():
+    n = fp.load_env(
+        "wal.fsync=fail_nth:3;rpc.frame.send=torn:0.01@seed7;"
+        "t.x=delay_ms:5:0.5@seed2,one_shot")
+    assert n == 3
+    assert fp.active_sites() == {
+        "wal.fsync": "fail_nth:3",
+        "rpc.frame.send": "torn:0.01@seed7",
+        "t.x": "delay_ms:5:0.5@seed2,one_shot",
+    }
+
+
+def test_bad_spec_rejected_before_arming():
+    with pytest.raises(ValueError):
+        fp.activate("t.site", "explode:1")
+    assert not fp.is_active("t.site")
+
+
+def test_unknown_site_name_rejected():
+    """A typo'd site would arm silently and inject nothing — the chaos
+    run would pass vacuously. Names must be registered (or t.-prefixed
+    registry-test names)."""
+    with pytest.raises(ValueError):
+        fp.activate("wal.fysnc", "fail_nth:1")  # the classic typo
+    assert not fp.is_active("wal.fysnc")
+    # every site the chaos menu can draw is registered
+    import random as _random
+
+    from tools.chaos_soak import _INGEST_FAULTS, _fault_menu
+
+    for site, _spec in _fault_menu(_random.Random(0)):
+        assert site in fp.SITES, site
+    for fault in _INGEST_FAULTS:
+        if fault is not None:
+            assert fault[0] in fp.SITES, fault
+
+
+def test_trips_surface_on_stats_and_span():
+    from rocksplicator_tpu.observability.span import start_span
+
+    fp.activate("t.site", "fail_prob:1.0")
+    with start_span("chaos.test", always=True) as sp:
+        with pytest.raises(fp.FailpointError):
+            fp.hit("t.site")
+    assert sp.annotations.get("failpoint") == "t.site"
+    assert Stats.get().get_counter("failpoint.trips site=t.site") == 1.0
+
+
+def test_unarmed_process_is_noop():
+    # the zero-cost contract: no site armed, nothing observable happens
+    fp.hit("never.armed")
+    assert fp.torn_point("never.armed", 10) is None
+
+
+# ---------------------------------------------------------------------------
+# WAL: torn append self-heals; recovery stays hole-free
+# ---------------------------------------------------------------------------
+
+
+def test_wal_torn_append_heals_and_log_stays_contiguous(tmp_path):
+    """A torn WAL append (crash-shaped write fault) must fail THAT write
+    and leave the log hole-free for every later committed write — scans
+    stop at the first bad CRC, so an un-truncated tear would silently
+    strand everything appended after it."""
+    from tools.chaos_soak import check_wal_contiguous
+
+    db = DB(str(tmp_path / "db"), DBOptions())
+    try:
+        db.put(b"before", b"1")
+        fp.activate("wal.append", "torn:1.0,one_shot")
+        with pytest.raises(OSError):
+            db.put(b"torn", b"x" * 256)
+        db.put(b"after", b"2")
+        assert check_wal_contiguous(db) is None
+        assert db.get(b"after") == b"2"
+        assert db.get(b"torn") is None
+    finally:
+        db.close()
+    # recovery replays the healed log
+    db = DB(str(tmp_path / "db"), DBOptions())
+    try:
+        assert db.get(b"before") == b"1"
+        assert db.get(b"after") == b"2"
+        assert db.get(b"torn") is None
+    finally:
+        db.close()
+
+
+def test_wal_group_roll_failure_keeps_published_records(tmp_path):
+    """A mid-group segment roll that fails must not roll back records
+    whose durability tokens were already published at the roll boundary
+    — truncating them would let a later sync_to claim durability for
+    bytes that no longer exist (the wal_hole bug class)."""
+    from rocksplicator_tpu.storage.wal import WalWriter, iter_updates
+
+    w = WalWriter(str(tmp_path / "wal"), segment_bytes=64)
+    try:
+        # each ~50B record overflows the 64B segment: every record after
+        # the first forces a roll, publishing the pending one first
+        recs = [(i, b"x" * 30) for i in range(1, 6)]
+        fp.activate("wal.roll", "fail_nth:3")  # roll 1 opens the file
+        with pytest.raises(OSError):
+            w.append_many(recs)
+        fp.deactivate("wal.roll")
+        # records published before the failed roll survive on disk
+        on_disk = [seq for seq, _ in iter_updates(str(tmp_path / "wal"))]
+        assert on_disk == list(range(1, w._append_token + 1)), \
+            (on_disk, w._append_token)
+        assert w._append_token >= 1
+        w.sync_to(w._append_token)  # claimable tokens really are durable
+    finally:
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# torn/short RPC frames on the replication wire
+# ---------------------------------------------------------------------------
+
+
+class _SinkWriter:
+    """StreamWriter stand-in capturing written bytes."""
+
+    def __init__(self):
+        self.data = b""
+
+    def write(self, b):
+        self.data += b
+
+    async def drain(self):
+        pass
+
+
+def test_torn_frame_write_surfaces_clean_decode_error():
+    """A frame cut anywhere — including mid-length-prefix — must raise a
+    clean error at the reader (IncompleteReadError/ValueError), never
+    hang or hand up a partial payload."""
+    from rocksplicator_tpu.rpc.framing import FrameReader, write_frame
+
+    async def go():
+        sink = _SinkWriter()
+        # seed 2 cuts at +7B — mid-length-prefix, the nastiest tear
+        fp.activate("rpc.frame.send", "torn:1.0@seed2,one_shot")
+        with pytest.raises(fp.FailpointError):
+            await write_frame(sink, b'{"id":1}', [b"p" * 64])
+        full = _SinkWriter()
+        await write_frame(full, b'{"id":1}', [b"p" * 64])
+        assert 0 < len(sink.data) < len(full.data)
+        reader = asyncio.StreamReader()
+        reader.feed_data(sink.data)
+        reader.feed_eof()
+        with pytest.raises((asyncio.IncompleteReadError, ValueError)):
+            await FrameReader(reader).read_frame()
+
+    asyncio.run(go())
+
+
+def test_short_frame_mid_length_prefix():
+    from rocksplicator_tpu.rpc.framing import FrameReader
+
+    async def go():
+        reader = asyncio.StreamReader()
+        reader.feed_data(b"\x54\x52\x00")  # 3 of the 12 header bytes
+        reader.feed_eof()
+        with pytest.raises(asyncio.IncompleteReadError):
+            await FrameReader(reader).read_frame()
+
+    asyncio.run(go())
+
+
+def test_torn_replication_frame_reconnects_no_half_apply(hosts):
+    """End to end over real TCP: tear frames on the replication wire and
+    verify the puller reconnects and converges byte-exact — never a
+    hang, never a half-applied batch (the seq-continuity guard would
+    wedge the puller forever if a partial batch applied)."""
+    from rocksplicator_tpu.replication.wire import ReplicaRole
+
+    leader, follower = hosts("leader"), hosts("follower")
+    ldb, _ = leader.add_db("seg00001", ReplicaRole.LEADER)
+    fdb, _ = follower.add_db(
+        "seg00001", ReplicaRole.FOLLOWER, upstream=leader.addr)
+    for i in range(10):
+        ldb.put(b"w%04d" % i, b"v%04d" % i)
+    assert wait_until(
+        lambda: fdb.latest_sequence_number() == 10, timeout=15)
+    # now tear ~every other frame for a while (requests AND responses)
+    fp.activate("rpc.frame.send", "torn:0.5@seed11")
+    for i in range(10, 40):
+        ldb.put(b"w%04d" % i, b"v%04d" % i)
+    time.sleep(0.5)
+    fp.deactivate("rpc.frame.send")
+    assert wait_until(
+        lambda: fdb.latest_sequence_number()
+        == ldb.latest_sequence_number(), timeout=30), \
+        "follower never converged after torn-frame storm"
+    for i in range(40):
+        assert fdb.get(b"w%04d" % i) == b"v%04d" % i
+    assert fp.trip_counts().get("rpc.frame.send", 0) > 0, \
+        "storm never actually tore a frame"
+
+
+def test_stuck_connect_fails_over_to_retry(hosts):
+    """fail_first on rpc.connect: the follower's first connect attempts
+    die, the retry-policy backoff reconnects, replication proceeds."""
+    from rocksplicator_tpu.replication.wire import ReplicaRole
+
+    leader = hosts("leader")
+    ldb, _ = leader.add_db("seg00001", ReplicaRole.LEADER)
+    for i in range(5):
+        ldb.put(b"k%d" % i, b"v%d" % i)
+    fp.activate("rpc.connect", "fail_first:2")
+    follower = hosts("follower")
+    fdb, _ = follower.add_db(
+        "seg00001", ReplicaRole.FOLLOWER, upstream=leader.addr)
+    assert wait_until(
+        lambda: fdb.latest_sequence_number() == 5, timeout=30)
+    assert fp.trip_counts().get("rpc.connect", 0) >= 2
+
+
+# ---------------------------------------------------------------------------
+# ingest crash-consistency (extends the r8 staleness re-check tests)
+# ---------------------------------------------------------------------------
+
+
+def _mk_admin(tmp_path, name="admin"):
+    from rocksplicator_tpu.admin.handler import AdminHandler
+    from rocksplicator_tpu.replication import Replicator
+    from rocksplicator_tpu.replication.replicated_db import ReplicationFlags
+
+    rep = Replicator(port=0, flags=FAST)
+    handler = AdminHandler(str(tmp_path / name), rep)
+    return rep, handler
+
+
+def _put_sst(store, prefix, items, tmp_path):
+    local = str(tmp_path / "_mk.tsst")
+    w = SSTWriter(local)
+    for k, v in items:
+        w.add(k, 0, OpType.PUT, v)
+    w.finish()
+    store.put_object(local, f"{prefix}/bulk.tsst")
+    os.remove(local)
+
+
+ITEMS = [(b"ik%04d" % j, b"iv%04d" % j) for j in range(100)]
+
+
+@pytest.mark.parametrize("site,data_after_fault", [
+    ("admin.ingest.meta", True),     # engine committed, meta did not
+    ("admin.ingest.engine", False),  # nothing committed
+    ("engine.ingest", False),        # inside the engine, pre-adopt
+    ("sst.ingest_footer", False),    # adopted but manifest never written
+])
+def test_ingest_fault_leaves_pre_or_post_state_on_reopen(
+        tmp_path, site, data_after_fault):
+    """A fault anywhere between download and meta-write must leave the
+    DB fully pre-ingest or fully post-ingest ON REOPEN — never a torn
+    middle — and meta must never claim a set whose data is missing. A
+    clean retry completes the load either way."""
+    rep, handler = _mk_admin(tmp_path)
+    bucket = str(tmp_path / "bucket")
+    store = LocalObjectStore(bucket)
+    _put_sst(store, "set1", ITEMS, tmp_path)
+    try:
+        asyncio.run(handler.handle_add_db(db_name="d1", role="NOOP"))
+        fp.activate(site, "fail_nth:1")
+        with pytest.raises(Exception):
+            asyncio.run(handler.handle_add_s3_sst_files_to_db(
+                db_name="d1", s3_bucket=bucket, s3_path="set1"))
+        fp.deactivate(site)
+        # invariant: no partial meta — a fault before the meta write
+        # leaves NO claim on the set
+        meta = handler.get_meta_data("d1")
+        assert meta.s3_path != "set1", "meta written despite fault"
+        # reopen from disk: the engine state must be all-or-nothing
+        handler.close()
+        rep.stop()
+        rep, handler = _mk_admin(tmp_path)
+        asyncio.run(handler.handle_add_db(db_name="d1", role="NOOP"))
+        app = handler.db_manager.get_db("d1")
+        present = [app.db.get(k) == v for k, v in ITEMS]
+        if data_after_fault:
+            assert all(present), "post-ingest reopen lost ingested keys"
+        else:
+            assert not any(present), "pre-ingest reopen shows torn data"
+        # clean retry converges to fully-post-ingest + claimed
+        asyncio.run(handler.handle_add_s3_sst_files_to_db(
+            db_name="d1", s3_bucket=bucket, s3_path="set1"))
+        meta = handler.get_meta_data("d1")
+        assert meta.s3_path == "set1"
+        for k, v in ITEMS:
+            assert app.db.get(k) == v
+    finally:
+        handler.close()
+        rep.stop()
+
+
+def test_ingest_nlink_break_fault_never_mutates_bucket(tmp_path):
+    """A fault on the global-seqno footer rewrite must never have
+    touched the bucket object: the nlink-break copy happens first, so
+    the bucket bytes stay byte-identical through a failed ingest."""
+    rep, handler = _mk_admin(tmp_path)
+    bucket = str(tmp_path / "bucket")
+    store = LocalObjectStore(bucket)
+    _put_sst(store, "set1", ITEMS, tmp_path)
+    obj = os.path.join(bucket, "set1", "bulk.tsst")
+    with open(obj, "rb") as f:
+        before = f.read()
+    try:
+        asyncio.run(handler.handle_add_db(db_name="d1", role="NOOP"))
+        fp.activate("sst.ingest_footer", "fail_nth:1")
+        with pytest.raises(Exception):
+            asyncio.run(handler.handle_add_s3_sst_files_to_db(
+                db_name="d1", s3_bucket=bucket, s3_path="set1"))
+        fp.deactivate("sst.ingest_footer")
+        with open(obj, "rb") as f:
+            assert f.read() == before, "failed ingest mutated the bucket"
+    finally:
+        handler.close()
+        rep.stop()
+
+
+# ---------------------------------------------------------------------------
+# compaction plan/install: a failed install must not leak the mutex
+# ---------------------------------------------------------------------------
+
+
+def test_failed_compaction_install_releases_mutex(tmp_path):
+    """ISSUE 4: "plan leaked → mutex released?" — a fault inside
+    install_full_compaction must consume the plan's compaction mutex so
+    a later compact_range neither deadlocks nor corrupts."""
+    db = DB(str(tmp_path / "db"), DBOptions())
+    try:
+        for i in range(50):
+            db.put(b"k%04d" % i, b"v%04d" % i)
+        db.flush()
+        plan = db.plan_full_compaction()
+        assert plan is not None
+        fp.activate("compact.install", "fail_nth:1")
+        with pytest.raises(OSError):
+            db.install_full_compaction(plan, entries=iter([]))
+        fp.deactivate("compact.install")
+        done = threading.Event()
+
+        def compact():
+            db.compact_range()
+            done.set()
+
+        t = threading.Thread(target=compact, daemon=True)
+        t.start()
+        assert done.wait(30), "compact_range deadlocked on a leaked mutex"
+        for i in range(50):
+            assert db.get(b"k%04d" % i) == b"v%04d" % i
+    finally:
+        db.close()
+
+
+def test_batch_compactor_dispatch_fault_fails_batch_loudly(tmp_path):
+    """A compact.dispatch fault must fail that batch's waiters with the
+    error and leave the compactor able to serve the next batch."""
+    from rocksplicator_tpu.admin.ingest_pipeline import BatchCompactor
+
+    bc = BatchCompactor(use_tpu=False)
+    db = DB(str(tmp_path / "db"), DBOptions())
+    try:
+        db.put(b"k", b"v")
+        db.flush()
+        fp.activate("compact.dispatch", "fail_nth:1")
+        with pytest.raises(OSError):
+            bc.compact("d", db)
+        fp.deactivate("compact.dispatch")
+        assert bc.compact("d", db) >= 1  # leadership not stranded
+        assert db.get(b"k") == b"v"
+    finally:
+        db.close()
+        bc.close()
+
+
+# ---------------------------------------------------------------------------
+# object-store / retry interplay
+# ---------------------------------------------------------------------------
+
+
+def test_batch_download_retry_absorbs_transient_fault(tmp_path):
+    store = LocalObjectStore(str(tmp_path / "bucket"))
+    for i in range(3):
+        store.put_object_bytes(f"p/f{i}.bin", b"x" * 64)
+    fp.activate("objectstore.get", "fail_first:1")
+    out = store.get_objects("p", str(tmp_path / "dl"))
+    assert len(out) == 3
+    assert fp.trip_counts()["objectstore.get"] == 1
+    assert Stats.get().get_counter(
+        "retry.attempts op=objectstore.get") >= 1.0
+
+
+def test_batch_download_fault_outlasting_retry_fails_clean(tmp_path):
+    store = LocalObjectStore(str(tmp_path / "bucket"))
+    for i in range(3):
+        store.put_object_bytes(f"p/f{i}.bin", b"x" * 64)
+    fp.activate("objectstore.get", "fail_first:99")
+    with pytest.raises(ObjectStoreError) as ei:
+        store.get_objects("p", str(tmp_path / "dl"))
+    assert "p/f" in str(ei.value)  # failing KEY named
+    assert os.listdir(str(tmp_path / "dl")) == []  # all-or-nothing held
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos harness (fast tier-1 marker; full run = make chaos-smoke)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_schedules_hold_invariants(tmp_path):
+    from tools.chaos_soak import run_chaos
+
+    result = run_chaos(
+        str(tmp_path / "chaos"), schedules=3, seed=1234, writes=40,
+        ingest_every=2, conv_timeout=25.0, log=lambda *a: None)
+    assert result["violations"] == []
+    assert result["acked"] > 0
+
+
+def test_chaos_catches_broken_wal_durability_guard(tmp_path):
+    """Teeth: a WAL that claims durability tokens without writing the
+    record (the ack-before-durability bug class) must be caught."""
+    from tools.chaos_soak import run_chaos
+
+    result = run_chaos(
+        str(tmp_path / "chaos"), schedules=1, seed=7, writes=30,
+        ingest_every=0, break_guard="wal_hole", conv_timeout=2.0,
+        log=lambda *a: None)
+    assert any("WAL hole" in v for v in result["violations"]), \
+        result["violations"]
+
+
+def test_chaos_catches_meta_before_ingest_guard(tmp_path):
+    """Teeth: writing DBMetaData before the engine ingest must be caught
+    as partial meta."""
+    from tools.chaos_soak import run_chaos
+
+    result = run_chaos(
+        str(tmp_path / "chaos"), schedules=1, seed=7, writes=10,
+        ingest_every=1, break_guard="meta_first", conv_timeout=10.0,
+        log=lambda *a: None)
+    assert any("partial meta" in v or "meta" in v
+               for v in result["violations"]), result["violations"]

@@ -1,0 +1,225 @@
+"""utils/retry_policy.py: the unified backoff story (ISSUE 4 satellite).
+
+Deterministic jittered schedules under a fixed seed, budget fail-fast,
+and the adoptions: S3's transport retry, WebHDFS's (previously absent)
+transient retry, and the follower pull loop's growing backoff.
+"""
+
+import http.client
+import time
+
+import pytest
+
+from rocksplicator_tpu.testing import failpoints as fp
+from rocksplicator_tpu.utils.retry_policy import (RetryBudget, RetryPolicy,
+                                                  retry_call)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset_for_test()
+    yield
+    fp.reset_for_test()
+
+
+def test_jittered_schedule_deterministic_under_fixed_seed():
+    p = RetryPolicy(max_attempts=6, base_delay=0.1, max_delay=5.0)
+    assert p.schedule(seed=42) == p.schedule(seed=42)
+    assert p.schedule(seed=42) != p.schedule(seed=43)
+    sched = p.schedule(seed=42)
+    assert len(sched) == 5
+    # full jitter: every delay within [0, cap(attempt)], caps growing
+    for attempt, d in enumerate(sched):
+        assert 0.0 <= d <= min(5.0, 0.1 * 2 ** attempt)
+
+
+def test_no_jitter_returns_caps():
+    p = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=0.5,
+                    jitter=False)
+    assert p.schedule() == [0.1, 0.2, 0.4, 0.5]
+
+
+def test_retry_call_retries_transient_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept = []
+    out = retry_call(
+        flaky, policy=RetryPolicy(max_attempts=4, base_delay=0.01),
+        classify=lambda e: isinstance(e, OSError), op="test",
+        sleep=slept.append)
+    assert out == "ok" and calls["n"] == 3 and len(slept) == 2
+
+
+def test_retry_call_permanent_error_not_retried():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        retry_call(bad, policy=RetryPolicy(max_attempts=5),
+                   classify=lambda e: isinstance(e, OSError),
+                   sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_retry_call_exhausts_attempts():
+    calls = {"n": 0}
+
+    def dead():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(dead, policy=RetryPolicy(max_attempts=3,
+                                            base_delay=0.001),
+                   classify=lambda e: True, sleep=lambda s: None)
+    assert calls["n"] == 3
+
+
+def test_budget_exhaustion_fails_fast():
+    budget = RetryBudget(capacity=1.0, refill_per_sec=0.0)
+
+    def dead():
+        raise OSError("down")
+
+    calls = []
+    with pytest.raises(OSError):
+        retry_call(dead, policy=RetryPolicy(max_attempts=10,
+                                            base_delay=0.001),
+                   classify=lambda e: True, budget=budget,
+                   sleep=calls.append)
+    assert len(calls) == 1  # one retry spent the whole budget
+
+
+def test_budget_refills_over_time():
+    budget = RetryBudget(capacity=2.0, refill_per_sec=1000.0)
+    assert budget.try_spend() and budget.try_spend()
+    assert not budget.try_spend() or True  # may already have refilled
+    time.sleep(0.01)
+    assert budget.try_spend()
+
+
+def test_hdfs_request_retries_transient_transport_errors():
+    """The WebHDFS backend (previously retry-free: one namenode hiccup
+    failed the whole op) now absorbs transient transport faults through
+    the unified policy."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    from test_hdfs import _StubWebHdfs
+
+    from rocksplicator_tpu.utils.hdfs import HdfsObjectStore
+
+    _StubWebHdfs.files = {}
+    _StubWebHdfs.direct_mode = False
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubWebHdfs)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        store = HdfsObjectStore(
+            f"hdfs://127.0.0.1:{srv.server_address[1]}/base", timeout=5.0)
+        store.put_object_bytes("a/f.bin", b"payload")
+        fp.activate("hdfs.request", "fail_first:2")
+        assert store.get_object_bytes("a/f.bin") == b"payload"
+        assert fp.trip_counts()["hdfs.request"] == 2
+    finally:
+        srv.shutdown()
+
+
+def test_hdfs_delete_is_not_retried():
+    """DELETE is the one non-idempotent WebHDFS op under retry: a retry
+    after a transport fault that followed a server-side success would
+    read {"boolean": false} and fabricate a not-found — so transport
+    faults on DELETE surface raw instead of being retried."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    from test_hdfs import _StubWebHdfs
+
+    from rocksplicator_tpu.utils.hdfs import HdfsObjectStore
+
+    _StubWebHdfs.files = {}
+    _StubWebHdfs.direct_mode = False
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubWebHdfs)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        store = HdfsObjectStore(
+            f"hdfs://127.0.0.1:{srv.server_address[1]}/base", timeout=5.0)
+        store.put_object_bytes("a/f.bin", b"payload")
+        fp.activate("hdfs.request", "fail_first:1")
+        with pytest.raises(OSError):
+            store.delete_object("a/f.bin")
+        assert fp.trip_counts()["hdfs.request"] == 1  # no retry happened
+        fp.deactivate("hdfs.request")
+        store.delete_object("a/f.bin")  # object survived the fault
+    finally:
+        srv.shutdown()
+
+
+def test_s3_request_retry_absorbs_transport_fault(monkeypatch):
+    """S3's inline 2**n*0.1 backoff is now the unified policy; a
+    transient transport fault inside the request loop is absorbed and
+    counted on /stats."""
+    from rocksplicator_tpu.utils.objectstore import S3ObjectStore
+    from rocksplicator_tpu.utils.s3_stub import S3StubServer
+    from rocksplicator_tpu.utils.stats import Stats
+
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "test-access")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "test-secret")
+    monkeypatch.setenv("RSTPU_RETRY_SEED", "9")
+    srv = S3StubServer(access_key="test-access", secret_key="test-secret")
+    endpoint = srv.start()
+    try:
+        store = S3ObjectStore("test-bucket", endpoint=endpoint)
+        store.put_object_bytes("a/f.bin", b"payload")
+        fp.activate("s3.request", "fail_first:2")
+        assert store.get_object_bytes("a/f.bin") == b"payload"
+        assert fp.trip_counts()["s3.request"] == 2
+        assert Stats.get().get_counter(
+            "retry.attempts op=s3.request") >= 2.0
+    finally:
+        srv.stop()
+
+
+def test_pull_backoff_grows_and_resets():
+    """The follower pull loop's error delay follows the policy: caps
+    grow across consecutive errors (bounded by the max flag), the min
+    flag stays a hard floor (the reference's uniform(min, max)
+    contract), and the attempt counter resets on a successful pull."""
+    import random
+
+    from rocksplicator_tpu.replication.replicated_db import ReplicationFlags
+
+    f = ReplicationFlags(pull_error_delay_min_ms=50,
+                         pull_error_delay_max_ms=400)
+    p = RetryPolicy(max_attempts=1 << 30,
+                    base_delay=f.pull_error_delay_min_ms / 1000.0,
+                    max_delay=f.pull_error_delay_max_ms / 1000.0,
+                    floor=f.pull_error_delay_min_ms / 1000.0)
+    assert p.cap(0) == pytest.approx(0.05)
+    assert p.cap(1) == pytest.approx(0.10)
+    assert p.cap(10) == pytest.approx(0.40)  # clamped at the max flag
+    rng = random.Random(1)
+    for attempt in range(20):
+        d = p.delay(attempt, rng)
+        assert 0.05 <= d <= 0.40  # never sub-floor, never over-cap
+
+
+def test_cap_saturates_without_overflow_at_huge_attempt_counts():
+    """A follower through an hours-long outage passes unbounded attempt
+    counts; multiplier**attempt must saturate at max_delay, not raise
+    OverflowError and kill the pull loop."""
+    p = RetryPolicy(max_attempts=1 << 30, base_delay=0.05, max_delay=10.0)
+    assert p.cap(1_000_000_000) == 10.0
+    assert p.cap(1024) == 10.0
+    assert RetryPolicy(multiplier=1.0).cap(10 ** 9) == 0.1
+    assert RetryPolicy(base_delay=0.0).cap(10 ** 9) == 0.0

@@ -846,29 +846,32 @@ def test_background_flush_failure_surfaces_to_writers(tmp_path, monkeypatch):
 def test_delayed_write_controller_bounds_stall_p99(tmp_path):
     """Write-stall behavior under a flush-saturating storm: the soft
     (delayed-write) tier must engage — recording storage.write_stall_ms
-    samples — and keep the stall tail to single-digit-to-low-double-digit
-    ms instead of the multi-flush-length hard stops it replaced. Mirrors
-    rocksdb's WriteController + level0 slowdown/stop triggers.
+    samples — and keep the stall tail bounded instead of the
+    multi-flush-length hard stops it replaced. Mirrors rocksdb's
+    WriteController + level0 slowdown/stop triggers.
 
-    Best-of-3: on a cpu-share-throttled CI host the 4 writer threads +
-    flusher + compactor share ~1.5 cores, and whenever the FLUSHER is
-    the thread starved for 50ms+ the hard tier's poll interval lands
-    whole-host scheduling noise in the p99 (measured interleaved with a
-    tracing kill switch: same flake rate with instrumentation fully
-    disabled, so it is host noise, not engine pacing). A real controller
-    regression fails all three storms."""
+    DETERMINISTIC via failpoint: every flush pays a fixed ``delay_ms``
+    on the ``sst.fsync`` site instead of relying on real host-disk
+    storms, which made this test flake whenever whole-host scheduling
+    noise (proven by an interleaved tracing-kill-switch A/B in round 6)
+    landed in the p99. The injected 20 ms flush floor guarantees the
+    controller engages on any host; the sleeping flusher doesn't compete
+    for CPU, so the measured stalls reflect ENGINE pacing, not the
+    host's mood — no best-of-N retry loop needed. A controller
+    regression (soft tier gone → writers ride hard stops for the whole
+    backlog) blows the bound on every run."""
     import rocksplicator_tpu.utils.stats as stats_mod
+    from rocksplicator_tpu.testing import failpoints as fp
 
-    best_p99 = None
-    for attempt in range(3):
-        stats_mod.Stats.reset_for_test()
-        opts = DBOptions(
-            memtable_bytes=64 << 10,
-            level0_compaction_trigger=2,
-            background_compaction=True,
-        )
-        db = DB(str(tmp_path / f"db{attempt}"), opts)
-        try:
+    stats_mod.Stats.reset_for_test()
+    opts = DBOptions(
+        memtable_bytes=64 << 10,
+        level0_compaction_trigger=2,
+        background_compaction=True,
+    )
+    db = DB(str(tmp_path / "db"), opts)
+    try:
+        with fp.failpoint("sst.fsync", "delay_ms:20"):
             val = b"v" * 512
 
             def writer(tid: int) -> None:
@@ -881,25 +884,20 @@ def test_delayed_write_controller_bounds_stall_p99(tmp_path):
                 t.start()
             for t in threads:
                 t.join()
-        finally:
-            db.close()
-        stats = stats_mod.Stats.get()
-        n = stats.metric_count("storage.write_stall_ms")
-        if n == 0:
-            # a momentarily idle host can let the flusher keep pace and
-            # record no stalls — that consumes a retry, it isn't a hard
-            # failure (only all-3-storms-silent means the controller
-            # never engages)
-            continue
-        p99 = stats.metric_percentile("storage.write_stall_ms", 99)
-        best_p99 = p99 if best_p99 is None else min(best_p99, p99)
-        # generous CI bound; interactively this measures ~4ms
-        if best_p99 < 50.0:
-            return
-    assert best_p99 is not None, "storm never engaged the write controller"
-    raise AssertionError(
-        f"write-stall p99 {best_p99:.1f}ms across 3 storms — controller "
-        f"not pacing")
+    finally:
+        db.close()
+    stats = stats_mod.Stats.get()
+    n = stats.metric_count("storage.write_stall_ms")
+    assert n > 0, "storm never engaged the write controller"
+    p99 = stats.metric_percentile("storage.write_stall_ms", 99)
+    # One flush is pinned at >=20ms; a hard stop waits out roughly one
+    # flush (plus a 50ms poll tick), while a controller regression
+    # queues multiple flush-lengths per admission. Interactively this
+    # measures ~8-30ms; the bound leaves scheduling headroom without
+    # admitting a multi-flush stall.
+    assert p99 < 100.0, (
+        f"write-stall p99 {p99:.1f}ms under a deterministic 20ms flush "
+        f"floor — controller not pacing")
 
 
 def test_stop_trigger_blocks_until_compaction_drains(tmp_path):

@@ -1,0 +1,478 @@
+"""Seeded chaos harness: randomized failpoint schedules vs. the standing
+invariants.
+
+Each schedule arms 1-3 deterministic failpoints (testing/failpoints.py)
+from a seeded menu — torn frames, stuck connects, failing fsyncs,
+mid-ingest faults — runs a semi-sync write workload against a 3-node
+replication cluster (leader + 2 followers over real TCP loopback, the
+test_replication Host shape) plus periodic SST bulk-ingests through the
+real AdminHandler path, clears the faults, waits for recovery, and
+checks the three standing invariants:
+
+1. **hole-free WAL prefix** on every node — seq ranges tile with no gap;
+2. **zero acked-write loss** — every write whose ack future resolved
+   ``acked`` is readable on the leader AND both followers once the
+   cluster reconverges;
+3. **ingest atomicity / no partial meta** — a fault anywhere in the
+   ingest pipeline leaves either no meta claim, or a meta claim with
+   every ingested key readable; a clean retry then always completes.
+
+Everything is derived from ``--seed``: the fault menu draws, the torn
+offsets and probability rolls (per-site seeded RNGs), the jittered
+retry backoffs (RSTPU_RETRY_SEED / RSTPU_PULL_RETRY_SEED). A violation
+prints the reproducing command line and exits 1.
+
+``--break-guard`` deliberately breaks a guard to prove the harness has
+teeth (the acceptance demo):
+
+- ``wal_hole``    — WalWriter.append claims a durability token for every
+  5th record without writing it (an ack-without-WAL bug): invariant 1
+  must catch the hole;
+- ``meta_first``  — the ingest handler writes DBMetaData BEFORE the
+  engine ingest (the crash-ordering bug the r8 seam exists to prevent):
+  invariant 3 must catch meta-without-data.
+
+With ``--expect-violation`` the run exits 0 iff a violation WAS caught.
+
+Usage::
+
+    python -m tools.chaos_soak --schedules 20 --seed 1          # soak
+    python -m tools.chaos_soak --break-guard wal_hole \
+        --expect-violation                                      # teeth
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+from rocksplicator_tpu.replication import (  # noqa: E402
+    ReplicaRole,
+    ReplicationFlags,
+    Replicator,
+    StorageDbWrapper,
+)
+from rocksplicator_tpu.storage import DB, DBOptions, WriteBatch
+from rocksplicator_tpu.storage import wal as wal_mod
+from rocksplicator_tpu.storage.records import OpType, scan_batch_meta
+from rocksplicator_tpu.storage.sst import SSTWriter
+from rocksplicator_tpu.testing import failpoints as fp
+from rocksplicator_tpu.utils.objectstore import LocalObjectStore
+
+DB_NAME = "seg00001"
+
+# quick-recovery flags: chaos wants many fault→heal cycles per minute,
+# not the reference's production 5-10s backoffs
+FLAGS = ReplicationFlags(
+    server_long_poll_ms=300,
+    pull_error_delay_min_ms=30,
+    pull_error_delay_max_ms=250,
+    ack_timeout_ms=800,
+    consecutive_timeouts_to_degrade=1000,
+    empty_pulls_before_reset=1 << 30,
+    write_window=32,
+)
+
+DB_OPTS = dict(
+    memtable_bytes=32 * 1024,  # continuous flush/compaction churn
+    background_compaction=True,
+    level0_compaction_trigger=3,
+)
+
+
+def _fault_menu(rng: random.Random) -> List[Tuple[str, str]]:
+    """The schedule's candidate faults — every parameter drawn from the
+    schedule RNG, every probabilistic policy pinned to a drawn seed."""
+    s = rng.randrange(1 << 16)
+    return [
+        ("wal.fsync", f"delay_ms:{rng.randint(5, 40)}"),
+        ("wal.append", f"torn:{rng.uniform(0.02, 0.15):.3f}@seed{s}"),
+        ("sst.fsync", f"delay_ms:{rng.randint(5, 40)}"),
+        ("manifest.persist", f"fail_nth:{rng.randint(1, 4)}"),
+        ("manifest.persist", f"delay_ms:{rng.randint(5, 30)}"),
+        ("rpc.frame.send", f"torn:{rng.uniform(0.01, 0.08):.3f}@seed{s}"),
+        ("rpc.frame.send",
+         f"fail_prob:{rng.uniform(0.01, 0.08):.3f}@seed{s}"),
+        ("rpc.frame.recv",
+         f"fail_prob:{rng.uniform(0.005, 0.04):.3f}@seed{s}"),
+        ("rpc.connect", f"fail_first:{rng.randint(1, 3)}"),
+        ("rpc.connect",
+         f"delay_ms:{rng.randint(20, 120)}:{rng.uniform(0.1, 0.4):.2f}"
+         f"@seed{s}"),
+        ("repl.pull", f"fail_prob:{rng.uniform(0.02, 0.10):.3f}@seed{s}"),
+        ("repl.apply", f"fail_nth:{rng.randint(1, 3)}"),
+        ("ack.expire", f"delay_ms:{rng.randint(5, 50)}"),
+    ]
+
+
+_INGEST_FAULTS = [
+    None,
+    ("admin.ingest.engine", "fail_nth:1"),
+    ("admin.ingest.meta", "fail_nth:1"),
+    ("engine.ingest", "fail_nth:1"),
+    ("sst.ingest_footer", "fail_nth:1"),
+    ("objectstore.get", "fail_first:1"),  # absorbed by the batch retry
+    ("objectstore.get", "fail_first:6"),  # outlasts it — RPC must fail
+]
+
+
+class ChaosCluster:
+    """Leader + 2 followers over TCP loopback, semi-sync (mode 1)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.hosts: List[Replicator] = [
+            Replicator(port=0, flags=FLAGS) for _ in range(3)]
+        self.dbs: List[DB] = []
+        self.rdbs = []
+        leader_addr = ("127.0.0.1", self.hosts[0].port)
+        for i, rep in enumerate(self.hosts):
+            db = DB(os.path.join(root, f"n{i}", DB_NAME),
+                    DBOptions(**DB_OPTS))
+            self.dbs.append(db)
+            role = ReplicaRole.LEADER if i == 0 else ReplicaRole.FOLLOWER
+            self.rdbs.append(rep.add_db(
+                DB_NAME, StorageDbWrapper(db), role,
+                upstream_addr=None if i == 0 else leader_addr,
+                replication_mode=1,
+            ))
+
+    @property
+    def leader(self):
+        return self.rdbs[0]
+
+    def converged(self) -> bool:
+        lat = self.dbs[0].latest_sequence_number_relaxed()
+        return all(db.latest_sequence_number_relaxed() == lat
+                   for db in self.dbs[1:])
+
+    def wait_converged(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.converged():
+                return True
+            time.sleep(0.05)
+        return self.converged()
+
+    def stop(self) -> None:
+        for rep in self.hosts:
+            rep.stop()
+        for db in self.dbs:
+            db.close()
+
+
+def check_wal_contiguous(db: DB) -> Optional[str]:
+    """Invariant 1: the WAL's surviving records tile seq space with no
+    hole (purge only ever trims a fully-persisted prefix)."""
+    prev_end = None
+    for start_seq, raw in wal_mod.iter_updates(
+            os.path.join(db.path, "wal"), 0):
+        count, _ts = scan_batch_meta(raw)
+        if prev_end is not None and start_seq != prev_end + 1:
+            return (f"WAL hole: record at seq {start_seq} follows "
+                    f"seq {prev_end} (gap of {start_seq - prev_end - 1})")
+        prev_end = start_seq + count - 1
+    return None
+
+
+class IngestFixture:
+    """SST bulk-ingest through the real AdminHandler path, one fresh db
+    per step, with one ingest-class fault armed per step."""
+
+    def __init__(self, root: str, replicator: Replicator):
+        from rocksplicator_tpu.admin.handler import AdminHandler
+
+        self.bucket = os.path.join(root, "bucket")
+        self.store = LocalObjectStore(self.bucket)
+        self.handler = AdminHandler(
+            os.path.join(root, "admin"), replicator)
+        self.counter = 0
+
+    def step(self, rng: random.Random, violations: List[str],
+             tag: str) -> None:
+        self.counter += 1
+        db_name = f"ing{self.counter:05d}"
+        prefix = f"set{self.counter:05d}"
+        items = [
+            (b"k%05d" % j, b"v%05d" % (j % 997))
+            for j in range(rng.randint(40, 120))
+        ]
+        tmp_sst = os.path.join(self.bucket, "_mk.tsst")
+        w = SSTWriter(tmp_sst)
+        for k, v in items:
+            w.add(k, 0, OpType.PUT, v)
+        w.finish()
+        self.store.put_object(tmp_sst, f"{prefix}/bulk.tsst")
+        os.remove(tmp_sst)
+        asyncio.run(self.handler.handle_add_db(
+            db_name=db_name, role="NOOP"))
+        fault = rng.choice(_INGEST_FAULTS)
+        if fault is not None:
+            fp.activate(*fault)
+        ok, err = True, None
+        try:
+            asyncio.run(self.handler.handle_add_s3_sst_files_to_db(
+                db_name=db_name, s3_bucket=self.bucket, s3_path=prefix,
+                compact_db_after_load=rng.random() < 0.5))
+        except Exception as e:
+            ok, err = False, e
+        finally:
+            if fault is not None:
+                fp.deactivate(fault[0])
+        msg = self._check(db_name, prefix, items, must_claim=ok)
+        if msg:
+            violations.append(f"{tag}: ingest fault={fault}: {msg}")
+            return
+        if not ok:
+            # faults cleared: one clean retry must complete the load
+            try:
+                asyncio.run(self.handler.handle_add_s3_sst_files_to_db(
+                    db_name=db_name, s3_bucket=self.bucket,
+                    s3_path=prefix))
+            except Exception as e:
+                violations.append(
+                    f"{tag}: ingest retry after fault={fault} "
+                    f"(first error {err!r}) failed: {e!r}")
+                return
+            msg = self._check(db_name, prefix, items, must_claim=True)
+            if msg:
+                violations.append(
+                    f"{tag}: ingest fault={fault} post-retry: {msg}")
+
+    def _check(self, db_name: str, prefix: str, items,
+               must_claim: bool) -> Optional[str]:
+        """Invariant 3: meta claims the set ⇒ every key is readable
+        (never partial meta); a successful RPC ⇒ meta claims it."""
+        meta = self.handler.get_meta_data(db_name)
+        claims = (meta.s3_bucket == self.bucket
+                  and meta.s3_path == prefix)
+        if must_claim and not claims:
+            return "ingest RPC succeeded but meta does not claim the set"
+        if not claims:
+            return None  # fully pre-ingest (data may exist un-claimed)
+        app_db = self.handler.db_manager.get_db(db_name)
+        for k, v in items:
+            got = app_db.db.get(k)
+            if got != v:
+                return (f"meta claims {prefix} but key {k!r} reads "
+                        f"{got!r} (want {v!r}) — partial meta")
+        return None
+
+    def close(self) -> None:
+        self.handler.close()
+
+
+# ---------------------------------------------------------------------------
+# deliberately-broken guards (harness-teeth demonstration)
+# ---------------------------------------------------------------------------
+
+
+def _break_guard(kind: str):
+    """Returns an undo callable."""
+    if kind == "wal_hole":
+        from rocksplicator_tpu.storage.wal import WalWriter
+
+        orig = WalWriter.append
+        state = {"n": 0}
+
+        def broken_append(self, start_seq, batch_bytes):
+            state["n"] += 1
+            if state["n"] % 5 == 0:
+                # claim a durability token without writing the record —
+                # the ack-before-durability bug class
+                self._append_token += 1
+                return self._append_token
+            return orig(self, start_seq, batch_bytes)
+
+        WalWriter.append = broken_append
+        return lambda: setattr(WalWriter, "append", orig)
+    if kind == "meta_first":
+        from rocksplicator_tpu.admin.handler import AdminHandler
+
+        orig_do = AdminHandler._do_ingest
+
+        def broken_do(self, sp, db_name, store, s3_bucket, s3_path,
+                      *args):
+            self.write_meta_data(db_name, s3_bucket, s3_path)
+            return orig_do(self, sp, db_name, store, s3_bucket, s3_path,
+                           *args)
+
+        AdminHandler._do_ingest = broken_do
+        return lambda: setattr(AdminHandler, "_do_ingest", orig_do)
+    raise ValueError(f"unknown break-guard: {kind}")
+
+
+# ---------------------------------------------------------------------------
+# the run loop
+# ---------------------------------------------------------------------------
+
+
+def run_chaos(
+    root: str,
+    schedules: int = 20,
+    seed: int = 1,
+    writes: int = 80,
+    ingest_every: int = 4,
+    break_guard: Optional[str] = None,
+    conv_timeout: float = 30.0,
+    log=print,
+) -> Dict:
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("RSTPU_RETRY_SEED", "RSTPU_PULL_RETRY_SEED")
+    }
+    os.environ["RSTPU_RETRY_SEED"] = str(seed)
+    os.environ["RSTPU_PULL_RETRY_SEED"] = str(seed)
+    undo = _break_guard(break_guard) if break_guard else None
+    violations: List[str] = []
+    acked_total = 0
+    write_total = 0
+    fp.clear()
+    cluster = ChaosCluster(root)
+    ingest = IngestFixture(root, cluster.hosts[0])
+    try:
+        if not cluster.wait_converged(20.0):
+            raise RuntimeError("cluster never converged at start")
+        for si in range(schedules):
+            rng = random.Random(seed * 1_000_003 + si)
+            faults = rng.sample(_fault_menu(rng), k=rng.randint(1, 3))
+            tag = f"schedule {si}/seed {seed}"
+            for site, spec in faults:
+                fp.activate(site, spec)
+            # -- workload under fault -------------------------------------
+            waiters = []
+            n_writes = rng.randint(writes // 2, writes)
+            write_errors = 0
+            for i in range(n_writes):
+                key = b"s%03dk%04d" % (si, i)
+                val = b"s%03dv%04d" % (si, i)
+                try:
+                    waiters.append(
+                        (key, val,
+                         cluster.leader.write_async(
+                             WriteBatch().put(key, val))))
+                except Exception:
+                    write_errors += 1  # injected fault; write not acked
+            write_total += n_writes
+            acked: List[Tuple[bytes, bytes]] = []
+            for key, val, w in waiters:
+                try:
+                    w.future.result(5.0)
+                except Exception:
+                    continue
+                if w.acked:
+                    acked.append((key, val))
+            acked_total += len(acked)
+            # -- heal + verify --------------------------------------------
+            for site, _spec in faults:
+                fp.deactivate(site)
+            if not cluster.wait_converged(conv_timeout):
+                lat = [db.latest_sequence_number_relaxed()
+                       for db in cluster.dbs]
+                violations.append(
+                    f"{tag}: no reconvergence {conv_timeout}s after "
+                    f"faults cleared (seqs {lat}, faults {faults})")
+            for i, db in enumerate(cluster.dbs):
+                msg = check_wal_contiguous(db)
+                if msg:
+                    violations.append(
+                        f"{tag}: node {i}: {msg} (faults {faults})")
+            lost = []
+            for key, val in acked:
+                for i, db in enumerate(cluster.dbs):
+                    if db.get(key) != val:
+                        lost.append((i, key))
+            if lost:
+                violations.append(
+                    f"{tag}: {len(lost)} acked writes missing after "
+                    f"reconvergence, first {lost[0]} (faults {faults})")
+            if ingest_every and si % ingest_every == ingest_every - 1:
+                ingest.step(rng, violations, tag)
+            log(f"  [{si + 1}/{schedules}] faults={faults} "
+                f"writes={n_writes} acked={len(acked)} "
+                f"errors={write_errors} "
+                f"violations={len(violations)}")
+            if violations and break_guard:
+                break  # teeth demonstrated; no need to keep going
+    finally:
+        fp.clear()
+        if undo:
+            undo()
+        ingest.close()
+        cluster.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "schedules": schedules,
+        "seed": seed,
+        "writes": write_total,
+        "acked": acked_total,
+        "violations": violations,
+        "failpoint_trips": fp.trip_counts(),
+        "break_guard": break_guard,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--schedules", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--writes", type=int, default=80,
+                    help="max writes per schedule")
+    ap.add_argument("--ingest-every", type=int, default=4)
+    ap.add_argument("--break-guard", choices=["wal_hole", "meta_first"])
+    ap.add_argument("--expect-violation", action="store_true",
+                    help="exit 0 iff a violation WAS caught")
+    ap.add_argument("--conv-timeout", type=float, default=30.0)
+    ap.add_argument("--out", help="write the result JSON here")
+    args = ap.parse_args(argv)
+
+    root = tempfile.mkdtemp(prefix="rstpu-chaos-")
+    t0 = time.monotonic()
+    try:
+        result = run_chaos(
+            root, schedules=args.schedules, seed=args.seed,
+            writes=args.writes, ingest_every=args.ingest_every,
+            break_guard=args.break_guard, conv_timeout=args.conv_timeout,
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    result["elapsed_sec"] = round(time.monotonic() - t0, 1)
+    print(f"chaos: {result['schedules']} schedules, "
+          f"{result['writes']} writes ({result['acked']} acked), "
+          f"{result['elapsed_sec']}s")
+    print(f"chaos: failpoint trips: {result['failpoint_trips']}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+    if result["violations"]:
+        for v in result["violations"]:
+            print(f"VIOLATION: {v}")
+        print(f"REPRO: python -m tools.chaos_soak "
+              f"--schedules {args.schedules} --seed {args.seed}"
+              + (f" --break-guard {args.break_guard}"
+                 if args.break_guard else ""))
+        return 0 if args.expect_violation else 1
+    print("chaos: all invariants held"
+          + (" (hole-free WAL prefix, zero acked loss, ingest atomicity)"
+             if not args.break_guard else ""))
+    if args.expect_violation:
+        print("ERROR: --expect-violation but the broken guard was "
+              "NOT caught — the harness has lost its teeth")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
