@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test test-fast native bench loadsst-bench load-sst-smoke soak-bench repl-bench-smoke chaos-smoke clean
+.PHONY: test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke chaos-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -15,6 +15,20 @@ native:
 
 bench:
 	$(PY) bench.py
+
+# round-9 engine microbench: flush / host-compaction / block-cache A/B
+# at the PERF.md 200k-entry methodology
+flush-bench:
+	$(PY) bench.py --flush_bench \
+		--out benchmarks/results/flush_bench.json
+
+# fast regression smoke of the same: small memtable, parity asserted on
+# every side (drain vs seed flush, array vs tuple compaction), fails
+# loudly if the block cache stops hitting
+flush-bench-smoke:
+	$(PY) bench.py --flush_bench --keys 20000 --reps 2 \
+		--cache_gets 4000 \
+		--out benchmarks/results/flush_bench_smoke.json
 
 loadsst-bench:
 	$(PY) -m benchmarks.load_sst_bench --shards 16

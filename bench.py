@@ -1058,4 +1058,12 @@ def _salvage_late_accelerator(record, budget_left):
 
 
 if __name__ == "__main__":
+    if "--flush_bench" in sys.argv:
+        # engine microbench mode (round 9): flush / host-compaction /
+        # block-cache A/B — no accelerator worker, no kernel compiles.
+        # All other args pass through to benchmarks/flush_bench.py.
+        from benchmarks.flush_bench import main as flush_bench_main
+
+        argv = [a for a in sys.argv[1:] if a != "--flush_bench"]
+        sys.exit(flush_bench_main(argv))
     main()

@@ -1,0 +1,322 @@
+"""Flush / host-compaction / block-cache microbench (round 9).
+
+Same-host interleaved A/B for the three engine paths ISSUE 5 vectorized:
+
+- **flush**: the array drain→lexsort→planar pipeline
+  (MemTable.drain_lanes + engine._try_array_flush) vs the SEED flush
+  algorithm (sorted(mem) entry tuples + per-entry pack_entries repack +
+  planar sink without bulk bloom) reproduced here verbatim as the
+  "before" side. Interleaved best-of-N on the identical memtable;
+  read-back parity is asserted, not assumed.
+- **compact**: CPU full compaction over all-planar inputs through the
+  direct array sink (CpuCompactionBackend.merge_runs_to_files) vs the
+  same backend with the sink disabled (the seed's heap-merge +
+  per-entry _write_entry_stream path). Output parity asserted via full
+  iteration.
+- **block cache**: repeated point gets over a flushed+compacted DB with
+  the decoded-block cache disabled vs enabled; hit/miss come from the
+  /stats counters, not inference.
+
+Emits ONE JSON file (no fake-zero fields — every number is measured in
+this run): flush_mb_per_sec, compact_mb_per_sec, block_cache_hit_rate
+plus the before-sides and speedups.
+
+Run directly or via ``python bench.py --flush_bench`` /
+``make flush-bench-smoke``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from rocksplicator_tpu.storage import DB, DBOptions  # noqa: E402
+from rocksplicator_tpu.storage.compaction import CpuCompactionBackend  # noqa: E402
+from rocksplicator_tpu.storage.memtable import MemTable  # noqa: E402
+from rocksplicator_tpu.storage.merge import UInt64AddOperator  # noqa: E402
+from rocksplicator_tpu.storage.records import OpType  # noqa: E402
+from rocksplicator_tpu.storage.sst import BlockCache, SSTReader  # noqa: E402
+from rocksplicator_tpu.utils.stats import Stats  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _fill_memtable(mem: MemTable, keys: int, val_bytes: int) -> int:
+    """Mixed PUT/MERGE/DELETE uniform-width workload; returns payload
+    bytes (keys + values of live entries — the PERF.md convention)."""
+    payload = 0
+    for i in range(keys):
+        k = f"key{i:013d}".encode()
+        m = i % 10
+        if m == 0:
+            mem.apply(k, i + 1, OpType.DELETE, b"")
+            payload += len(k)
+        elif m == 1:
+            v = (i).to_bytes(8, "little").ljust(val_bytes, b"\x00")
+            mem.apply(k, i + 1, OpType.MERGE, v)
+            payload += len(k) + len(v)
+        else:
+            v = (i).to_bytes(8, "little").ljust(val_bytes, b"\x00")
+            mem.apply(k, i + 1, OpType.PUT, v)
+            payload += len(k) + len(v)
+    return payload
+
+
+def _seed_flush(path: str, mem: MemTable, block_bytes: int = 32 * 1024,
+                bits_per_key: int = 10) -> bool:
+    """The SEED's flush algorithm (pre-round-9 engine._write_mem_sst +
+    _try_planar_flush), reproduced as the A/B "before" side: pure-Python
+    sorted entry stream, per-entry width scan, per-entry pack_entries
+    repack, planar sink building its bloom from a per-key Python loop."""
+    from rocksplicator_tpu.ops.kv_format import UnsupportedBatch, pack_entries
+    from rocksplicator_tpu.tpu.format import (planar_stride, planar_widths,
+                                              write_sst_from_arrays)
+
+    entries = list(mem.entries())
+    if not entries:
+        return False
+    klen0 = len(entries[0][0])
+    vlen0 = None
+    for key, _seq, vtype, value in entries:
+        if len(key) != klen0 or len(key) > 24:
+            return False
+        if int(vtype) == 2:
+            if value:
+                return False
+        elif vlen0 is None:
+            vlen0 = len(value)
+        elif len(value) != vlen0:
+            return False
+    try:
+        batch = pack_entries(
+            entries, val_bytes=max(4, ((vlen0 or 0) + 3) // 4 * 4))
+    except UnsupportedBatch:
+        return False
+    n = len(entries)
+    arrays = {
+        f: getattr(batch, f)[:n]
+        for f in ("key_words_be", "key_words_le", "key_len", "seq_hi",
+                  "seq_lo", "vtype", "val_words", "val_len")
+    }
+    widths = planar_widths(arrays, n)
+    if widths is None:
+        return False
+    stride = planar_stride(*widths)
+    props = write_sst_from_arrays(
+        arrays, n, path, block_entries=max(64, block_bytes // stride),
+        planar=True, bits_per_key=bits_per_key,
+    )
+    return props is not None
+
+
+def bench_flush(workdir: str, keys: int, val_bytes: int, reps: int) -> dict:
+    mem = MemTable()
+    payload = _fill_memtable(mem, keys, val_bytes)
+    db = DB(os.path.join(workdir, "flushdb"),
+            DBOptions(memtable_bytes=1 << 30,
+                      disable_auto_compaction=True))
+    after: List[float] = []
+    before: List[float] = []
+    for r in range(reps):
+        path_a = os.path.join(workdir, f"new{r}.tsst")
+        t0 = time.perf_counter()
+        db._write_mem_sst(path_a, mem)
+        after.append(time.perf_counter() - t0)
+        path_b = os.path.join(workdir, f"old{r}.tsst")
+        t0 = time.perf_counter()
+        ok = _seed_flush(path_b, mem)
+        before.append(time.perf_counter() - t0)
+        assert ok, "seed flush path declined a uniform workload"
+    # read-back parity — the A/B is void if the sinks disagree
+    got_a = list(SSTReader(os.path.join(workdir, "new0.tsst")).iterate())
+    got_b = list(SSTReader(os.path.join(workdir, "old0.tsst")).iterate())
+    assert got_a == got_b and len(got_a) == keys, (
+        f"flush parity broken: {len(got_a)} vs {len(got_b)} entries")
+    db.close()
+    mb = payload / 1e6
+    res = {
+        "flush_entries": keys,
+        "flush_payload_mb": round(mb, 3),
+        "flush_sec_all": [round(x, 4) for x in after],
+        "flush_before_sec_all": [round(x, 4) for x in before],
+        "flush_mb_per_sec": round(mb / min(after), 2),
+        "flush_before_mb_per_sec": round(mb / min(before), 2),
+        "flush_speedup": round(min(before) / min(after), 2),
+    }
+    log(f"flush: {res['flush_mb_per_sec']} MB/s vs seed "
+        f"{res['flush_before_mb_per_sec']} MB/s "
+        f"({res['flush_speedup']}x)")
+    return res
+
+
+def _build_compact_db(path: str, backend, keys: int, runs: int,
+                      val_bytes: int) -> tuple:
+    opts = DBOptions(memtable_bytes=1 << 30, compaction_backend=backend,
+                     merge_operator=UInt64AddOperator(),
+                     disable_auto_compaction=True)
+    db = DB(path, opts)
+    one = (1).to_bytes(8, "little").ljust(val_bytes, b"\x00")
+    payload = 0
+    for r in range(runs):
+        for i in range(keys):
+            k = f"key{(i * 13 + r) % (keys * 2):013d}".encode()
+            m = (i + r) % 5
+            if m == 0:
+                db.merge(k, one)
+                payload += len(k) + len(one)
+            elif m == 1:
+                db.delete(k)
+                payload += len(k)
+            else:
+                v = (i).to_bytes(8, "little").ljust(val_bytes, b"\x00")
+                db.put(k, v)
+                payload += len(k) + len(v)
+        db.flush()
+    return db, payload
+
+
+def bench_compact(workdir: str, keys: int, runs: int,
+                  val_bytes: int) -> dict:
+    # AFTER: the cpu backend's direct array sink (all inputs planar —
+    # flush now writes planar files)
+    db_a, payload = _build_compact_db(
+        os.path.join(workdir, "compact_after"), CpuCompactionBackend(),
+        keys, runs, val_bytes)
+    t0 = time.perf_counter()
+    db_a.compact_range()
+    t_after = time.perf_counter() - t0
+    out_a = list(db_a.new_iterator())
+    db_a.close()
+    # BEFORE: same backend, direct sink disabled → the seed's tuple path
+    # (heap merge + per-entry SSTWriter.add loop)
+    be = CpuCompactionBackend()
+    be.merge_runs_to_files = None
+    db_b, _ = _build_compact_db(
+        os.path.join(workdir, "compact_before"), be, keys, runs, val_bytes)
+    t0 = time.perf_counter()
+    db_b.compact_range()
+    t_before = time.perf_counter() - t0
+    out_b = list(db_b.new_iterator())
+    db_b.close()
+    assert out_a == out_b and out_a, (
+        f"compaction parity broken: {len(out_a)} vs {len(out_b)} rows")
+    mb = payload / 1e6
+    res = {
+        "compact_input_entries": keys * runs,
+        "compact_payload_mb": round(mb, 3),
+        "compact_sec": round(t_after, 4),
+        "compact_before_sec": round(t_before, 4),
+        "compact_mb_per_sec": round(mb / t_after, 2),
+        "compact_before_mb_per_sec": round(mb / t_before, 2),
+        "compact_speedup": round(t_before / t_after, 2),
+    }
+    log(f"compact: {res['compact_mb_per_sec']} MB/s vs tuple path "
+        f"{res['compact_before_mb_per_sec']} MB/s "
+        f"({res['compact_speedup']}x)")
+    return res
+
+
+def bench_block_cache(workdir: str, keys: int, gets: int) -> dict:
+    path = os.path.join(workdir, "cachedb")
+    opts = DBOptions(memtable_bytes=1 << 30,
+                     disable_auto_compaction=True)
+    db = DB(path, opts)
+    for i in range(keys):
+        db.put(f"key{i:013d}".encode(),
+               (i).to_bytes(8, "little"))
+    db.flush()
+    probe = [f"key{(i * 7919) % keys:013d}".encode() for i in range(gets)]
+
+    def run_gets() -> float:
+        t0 = time.perf_counter()
+        for k in probe:
+            db.get(k)
+        return time.perf_counter() - t0
+
+    # cold pass (disabled cache) — the "before" side
+    BlockCache.reset_for_test(capacity=0)
+    t_off = run_gets()
+    # enabled cache: first pass fills, second pass measures the hit path
+    BlockCache.reset_for_test(capacity=64 << 20)
+    Stats.reset_for_test()
+    run_gets()
+    t_on = run_gets()
+    stats = Stats.get()
+    hits = stats.get_counter("storage.block_cache.hit")
+    misses = stats.get_counter("storage.block_cache.miss")
+    db.close()
+    BlockCache.reset_for_test()  # back to env-configured default
+    assert hits > 0, "block cache never hit — counters dead?"
+    res = {
+        "block_cache_gets": gets,
+        "block_cache_get_per_sec": round(gets / t_on, 1),
+        "block_cache_get_per_sec_disabled": round(gets / t_off, 1),
+        "block_cache_hits": int(hits),
+        "block_cache_misses": int(misses),
+        "block_cache_hit_rate": round(hits / max(1, hits + misses), 4),
+        "block_cache_get_speedup": round(t_off / t_on, 2),
+    }
+    log(f"block cache: {res['block_cache_get_per_sec']}/s hot vs "
+        f"{res['block_cache_get_per_sec_disabled']}/s disabled, "
+        f"hit rate {res['block_cache_hit_rate']}")
+    return res
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--keys", type=int, default=200_000,
+                    help="entries per flush memtable (PERF methodology: "
+                         "200k uniform-width)")
+    ap.add_argument("--val_bytes", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--compact_keys", type=int, default=None,
+                    help="keys per compaction input run "
+                         "(default: --keys/4)")
+    ap.add_argument("--compact_runs", type=int, default=4)
+    ap.add_argument("--cache_gets", type=int, default=20_000)
+    ap.add_argument("--out", default=None,
+                    help="JSON output path (default: "
+                         "benchmarks/results/flush_bench.json)")
+    args = ap.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = args.out or os.path.join(repo, "benchmarks", "results",
+                                   "flush_bench.json")
+    workdir = tempfile.mkdtemp(prefix="flush_bench_")
+    result = {
+        "bench": "flush_compact_blockcache",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host_cores": len(os.sched_getaffinity(0)),
+    }
+    try:
+        result.update(bench_flush(
+            workdir, args.keys, args.val_bytes, args.reps))
+        result.update(bench_compact(
+            workdir, args.compact_keys or max(1000, args.keys // 4),
+            args.compact_runs, args.val_bytes))
+        result.update(bench_block_cache(
+            workdir, max(1000, args.keys // 4), args.cache_gets))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,7 @@ memory traffic per probe on CPU, one lane op on TPU.
 from __future__ import annotations
 
 import struct
-from typing import Iterable, List
+from typing import Iterable, List, Tuple
 
 import numpy as np
 
@@ -47,6 +47,46 @@ def _avalanche(h: int) -> int:
     h = (h * 0xC2B2AE35) & _MASK32
     h ^= h >> 16
     return h
+
+
+def _avalanche_np(h: np.ndarray) -> np.ndarray:
+    """Vectorized _avalanche over a u32 lane (wrapping multiplies)."""
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def hash_many(keys: List[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+    """Filter-independent halves of the batch bloom probe: (h1, mask)
+    u32 lanes for ``keys`` (vectorized 24-byte-prefix + length FNV fold,
+    avalanche, K_BITS mask). Bit-exact with :func:`hash_pair` +
+    :func:`word_mask` modulo the per-filter ``h1 % num_words`` index,
+    which :meth:`BloomFilter.may_contain_hashed` applies."""
+    n = len(keys)
+    if n == 0:
+        z = np.zeros(0, dtype=np.uint32)
+        return z, z
+    mat = np.frombuffer(
+        b"".join(k[:PREFIX_BYTES].ljust(PREFIX_BYTES, b"\x00")
+                 for k in keys),
+        dtype=np.uint8).reshape(n, PREFIX_BYTES)
+    lens = np.fromiter((len(k) for k in keys), dtype=np.uint32, count=n)
+    words_le = mat.view("<u4").astype(np.uint32)
+    with np.errstate(over="ignore"):
+        h = np.full(n, _FNV_OFFSET, dtype=np.uint32)
+        for w in range(_PREFIX_WORDS):
+            h = (h ^ words_le[:, w]) * np.uint32(_FNV_PRIME)
+        h = (h ^ lens) * np.uint32(_FNV_PRIME)
+        h1 = _avalanche_np(h)
+        h2 = _avalanche_np(h * np.uint32(_H2_MUL) + np.uint32(1))
+        mask = np.zeros(n, dtype=np.uint32)
+        for j in range(K_BITS):
+            mask |= np.uint32(1) << ((h2 >> np.uint32(5 * j))
+                                     & np.uint32(31))
+    return h1, mask
 
 
 def hash_pair(key: bytes) -> tuple:
@@ -141,6 +181,22 @@ class BloomFilter:
         # for bulk build.
         idx, mask = word_mask(key, self.num_words)
         return (int(self.words[idx]) & mask) == mask
+
+    def may_contain_many(self, keys: List[bytes]) -> np.ndarray:
+        """(n,) bool — the batch probe (multi_get checks a whole key set
+        against each SST in one vectorized pass). Bit-exact with
+        may_contain: same 24-byte-prefix + full-length hash."""
+        h1, mask = hash_many(keys)
+        return self.may_contain_hashed(h1, mask)
+
+    def may_contain_hashed(self, h1: np.ndarray,
+                           mask: np.ndarray) -> np.ndarray:
+        """Probe with hashes precomputed by :func:`hash_many` — h1/mask
+        depend only on the keys, so a multi-SST read (multi_get) hashes
+        the key set ONCE and pays a modulo + gather per filter."""
+        with np.errstate(over="ignore"):
+            idx = h1 % np.uint32(self.num_words)
+        return (self.words[idx] & mask) == mask
 
     # -- serialization ----------------------------------------------------
 

@@ -20,8 +20,7 @@ from __future__ import annotations
 import heapq
 from typing import Iterable, Iterator, List, Optional, Tuple
 
-from .merge import MergeOperator
-from .records import OpType
+from .merge import MergeOperator, resolve_entry_group
 
 Entry = Tuple[bytes, int, int, bytes]  # key, seq, vtype, value
 
@@ -40,7 +39,12 @@ class CompactionBackend:
 
 class CpuCompactionBackend(CompactionBackend):
     """Heap-based k-way merge — the 32-core-CPU baseline the TPU backend is
-    benchmarked against."""
+    benchmarked against. Also carries the DIRECT array sink
+    (``merge_runs_to_files``): when every input run reads as lanes and
+    widths are uniform, the whole compaction runs array-to-array (lexsort
+    merge + segment resolve + planar writer) with no per-entry Python —
+    the engine's ``_write_entry_stream`` loop becomes the fallback, not
+    the common case."""
 
     name = "cpu"
 
@@ -53,6 +57,29 @@ class CpuCompactionBackend(CompactionBackend):
         # (key asc, seq desc) merge order.
         merged = heapq.merge(*runs, key=lambda e: (e[0], -e[1]))
         return resolve_stream(merged, merge_op, drop_tombstones)
+
+    def merge_runs_to_files(
+        self,
+        runs: List,
+        merge_op: Optional[MergeOperator],
+        drop_tombstones: bool,
+        path_factory,
+        block_bytes: int,
+        compression: int,
+        bits_per_key: int,
+        target_file_bytes: int,
+    ):
+        """[(path, props)], [] for an all-tombstoned result, or None →
+        the engine's tuple path. Shared implementation with the native
+        backend (storage/native_compaction.direct_merge_runs_to_files);
+        the native C resolve is used when the library is loaded, the
+        numpy lexsort+reduceat resolve otherwise."""
+        from .native_compaction import direct_merge_runs_to_files
+
+        return direct_merge_runs_to_files(
+            runs, merge_op, drop_tombstones, path_factory, block_bytes,
+            compression, bits_per_key, target_file_bytes,
+        )
 
 
 def resolve_stream(
@@ -80,36 +107,7 @@ def _resolve_group(
     merge_op: Optional[MergeOperator],
     drop_tombstones: bool,
 ) -> List[Entry]:
-    """group: all entries for one key, newest (highest seq) first. Returns
-    the surviving entries (usually one; an unresolved MERGE chain without a
-    partial-merge-capable operator survives as multiple entries, like
-    RocksDB keeps stacked merge operands)."""
-    key = group[0][0]
-    top_seq = group[0][1]
-    operands: List[bytes] = []
-    for _key, seq, vtype, value in group:
-        if vtype == OpType.PUT:
-            if operands and merge_op:
-                return [(key, top_seq, OpType.PUT,
-                         merge_op.merge(key, value, list(reversed(operands))))]
-            return [(key, top_seq, OpType.PUT, value)]
-        if vtype == OpType.DELETE:
-            if operands and merge_op:
-                return [(key, top_seq, OpType.PUT,
-                         merge_op.merge(key, None, list(reversed(operands))))]
-            if drop_tombstones:
-                return []
-            return [(key, top_seq, OpType.DELETE, b"")]
-        if vtype == OpType.MERGE:
-            operands.append(value)
-    # Only MERGE ops seen for this key.
-    if drop_tombstones and merge_op:
-        # Bottom level: no older data can exist — fold to a final value.
-        return [(key, top_seq, OpType.PUT,
-                 merge_op.merge(key, None, list(reversed(operands))))]
-    if merge_op:
-        partial = merge_op.partial_merge(key, list(reversed(operands)))
-        if partial is not None:
-            return [(key, top_seq, OpType.MERGE, partial)]
-    # No (partial-merge-capable) operator: keep the chain intact.
-    return [e for e in group if e[2] == OpType.MERGE]
+    """group: all entries for one key, newest (highest seq) first. The
+    fold semantics live in storage/merge.resolve_entry_group — the single
+    source of truth the array resolves are cross-checked against."""
+    return resolve_entry_group(group, merge_op, drop_tombstones)

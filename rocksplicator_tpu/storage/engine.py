@@ -41,6 +41,7 @@ from .merge import MERGE_OPERATORS, MergeOperator
 from .records import OpType, WriteBatch, decode_batch
 from .sst import COMPRESSION_NONE, COMPRESSION_ZLIB, SSTReader, SSTWriter
 
+import bisect
 import heapq
 import itertools
 import logging
@@ -131,6 +132,43 @@ class _MergedMemView:
             key=lambda e: (e[0], -e[1]),
         )
 
+    def drain_lanes(self):
+        """Concatenated unsorted lanes across every memtable (see
+        MemTable.drain_lanes) — the caller's single lexsort restores the
+        global (key asc, seq desc) order. None when any memtable can't
+        express its entries as lanes; cross-memtable width mismatches
+        are caught by the caller's planar_widths check."""
+        import numpy as np
+
+        parts = [m.drain_lanes() for m in self._imms]
+        if any(p is None for p in parts):
+            return None
+        # Cross-memtable width checks BEFORE any pad/concat — scalar
+        # reads off each part's lanes, so a mismatched burst bails in
+        # O(parts) instead of after a giant transient concatenation
+        # (the same round-2 lesson MemTable.drain_lanes applies within
+        # one memtable).
+        if len({km.shape[1] for _l, km in parts}) != 1:
+            return None  # mixed key widths across memtables
+        part_vlens = set()
+        for lanes, _km in parts:
+            live = lanes["val_len"][lanes["vtype"] != 2]
+            if len(live):  # all-DELETE parts constrain nothing
+                part_vlens.add(int(live[0]))
+        if len(part_vlens) > 1:
+            return None  # mixed value widths across memtables
+        vw = max(p[0]["val_words"].shape[1] for p in parts)
+        for lanes, _km in parts:
+            w = lanes["val_words"].shape[1]
+            if w < vw:
+                lanes["val_words"] = np.pad(
+                    lanes["val_words"], [(0, 0), (0, vw - w)])
+        lanes = {
+            f: np.concatenate([l[f] for l, _km in parts])
+            for f in parts[0][0]
+        }
+        return lanes, np.concatenate([km for _l, km in parts])
+
 
 class DB:
     """One LSM database (one shard in the sharded deployment)."""
@@ -147,6 +185,10 @@ class DB:
         # levels[0] may overlap; levels[1:] sorted non-overlapping by range
         self._levels: List[List[str]] = []
         self._readers: Dict[str, SSTReader] = {}
+        # per-level key-fence arrays (sorted min_keys, parallel max_keys +
+        # names) for bisect file lookup on levels >= 1; built lazily and
+        # dropped whenever a compaction/ingest rewrites a level's file set
+        self._fences: Dict[int, Tuple[List[bytes], List[bytes], List[str]]] = {}
         self._wal: Optional[wal_mod.WalWriter] = None
         self._closed = False
         if self.options.compaction_backend is not None:
@@ -596,8 +638,8 @@ class DB:
                     done, value = self._fold(key, result, operands, merge_op)
                     if done:
                         return value
-            for level_files in self._levels[1:]:
-                reader = self._find_file_for_key(level_files, key)
+            for level in range(1, len(self._levels)):
+                reader = self._find_file_for_key(level, key)
                 if reader is None:
                     continue
                 for result in reader.get_entries(key):
@@ -627,16 +669,138 @@ class DB:
         operands.append(value)  # MERGE operand, keep descending
         return False, None
 
-    def _find_file_for_key(self, level_files: List[str], key: bytes) -> Optional[SSTReader]:
-        for name in level_files:
-            reader = self._readers[name]
-            mn, mx = reader.min_key(), reader.max_key()
-            if mn is not None and mx is not None and mn <= key <= mx:
-                return reader
+    def _level_fences_locked(
+        self, level: int
+    ) -> Tuple[List[bytes], List[bytes], List[str]]:
+        """(sorted min_keys, parallel max_keys, names) for a level —
+        built once per file-set generation (install/GC/ingest clear the
+        cache), replacing the per-get linear min_key()/max_key() scan."""
+        fences = self._fences.get(level)
+        if fences is None:
+            recs = []
+            for name in self._levels[level]:
+                reader = self._readers[name]
+                mn, mx = reader.min_key(), reader.max_key()
+                if mn is not None and mx is not None:
+                    recs.append((mn, mx, name))
+            recs.sort()
+            fences = ([r[0] for r in recs], [r[1] for r in recs],
+                      [r[2] for r in recs])
+            self._fences[level] = fences
+        return fences
+
+    def _find_file_for_key(self, level: int, key: bytes) -> Optional[SSTReader]:
+        """Bisect the level's fence arrays (levels >= 1 are sorted and
+        non-overlapping): the candidate file is the one with the greatest
+        min_key <= key, live iff key <= its max_key."""
+        mins, maxs, names = self._level_fences_locked(level)
+        i = bisect.bisect_right(mins, key) - 1
+        if i >= 0 and key <= maxs[i]:
+            return self._readers[names[i]]
         return None
 
     def multi_get(self, keys: List[bytes]) -> List[Optional[bytes]]:
-        return [self.get(k) for k in keys]
+        """Point lookups for many keys with ONE lock pass over the
+        memtable/file-set snapshot (``[self.get(k) for k in keys]``
+        re-took the DB lock per key), blooms checked in batch, and keys
+        grouped per SST so each touched block decodes (or cache-hits)
+        once. Result order matches ``keys``; semantics are entry-exact
+        with per-key ``get`` (the parity test pins it)."""
+        from .bloom import hash_many
+
+        keys_b = [bytes(k) for k in keys]
+        with self._lock:
+            self._check_open()
+            merge_op = self.options.merge_operator
+            results: Dict[bytes, Optional[bytes]] = {}
+            operands: Dict[bytes, List[bytes]] = {}
+            pending: List[bytes] = []
+            for k in keys_b:
+                if k not in operands:
+                    operands[k] = []
+                    pending.append(k)
+            # bloom hashes are filter-independent: compute ONCE for the
+            # unique key set, probe per SST with a modulo + gather
+            h1_all, mask_all = hash_many(pending)
+            hashes = ({k: i for i, k in enumerate(pending)},
+                      h1_all, mask_all)
+            # newest first: active memtable, then immutables newest->oldest
+            for mem in (self._mem, *reversed(self._imms)):
+                if not pending:
+                    break
+                still: List[bytes] = []
+                for k in pending:
+                    resolved, value, pend = mem.get(k, merge_op)
+                    ops = operands[k]
+                    if resolved:
+                        results[k] = (
+                            merge_op.merge(k, value, ops[::-1])
+                            if ops and merge_op else value
+                        )
+                    else:
+                        ops.extend(pend[::-1])  # newest-first accumulation
+                        still.append(k)
+                pending = still
+            # L0 newest-first: every file may contain any key
+            for name in reversed(self._levels[0]):
+                if not pending:
+                    break
+                pending = self._fold_reader_many(
+                    self._readers[name], pending, operands, results,
+                    merge_op, hashes)
+            # deeper levels: group pending keys per fenced file
+            for level in range(1, len(self._levels)):
+                if not pending:
+                    break
+                groups: Dict[str, List[bytes]] = {}
+                skipped: List[bytes] = []
+                mins, maxs, names = self._level_fences_locked(level)
+                for k in pending:
+                    i = bisect.bisect_right(mins, k) - 1
+                    if i >= 0 and k <= maxs[i]:
+                        groups.setdefault(names[i], []).append(k)
+                    else:
+                        skipped.append(k)
+                still = skipped
+                for name, group in groups.items():
+                    still.extend(self._fold_reader_many(
+                        self._readers[name], group, operands, results,
+                        merge_op, hashes))
+                pending = still
+            for k in pending:
+                ops = operands[k]
+                results[k] = (
+                    merge_op.merge(k, None, ops[::-1])
+                    if ops and merge_op else None
+                )
+            return [results[k] for k in keys_b]
+
+    def _fold_reader_many(
+        self,
+        reader: SSTReader,
+        pending: List[bytes],
+        operands: Dict[bytes, List[bytes]],
+        results: Dict[bytes, Optional[bytes]],
+        merge_op: Optional[MergeOperator],
+        hashes=None,
+    ) -> List[bytes]:
+        """Fold one SST's entry stacks into the per-key resolution state;
+        returns the keys still unresolved after this file."""
+        found = reader.get_entries_many(pending, hashes=hashes)
+        still: List[bytes] = []
+        for k in pending:
+            entries = found.get(k)
+            done = False
+            if entries:
+                for result in entries:
+                    done, value = self._fold(k, result, operands[k],
+                                             merge_op)
+                    if done:
+                        results[k] = value
+                        break
+            if not done:
+                still.append(k)
+        return still
 
     def new_iterator(
         self, start: Optional[bytes] = None, end: Optional[bytes] = None
@@ -814,12 +978,12 @@ class DB:
 
     def _write_mem_sst(self, path: str, mem: MemTable) -> None:
         """Write a memtable's entries as one SST. Fixed-width workloads
-        take the PLANAR sink (array-decodable — first-level compactions
-        of flush output then run array-to-array even with tombstones,
-        which planar expresses; entry-stream cannot mix widths); anything
-        else takes the per-entry writer."""
-        entries = list(mem.entries())
-        if self._try_planar_flush(path, entries):
+        take the ARRAY drain path (lanes collected as byte joins, one
+        lexsort over key words with seq-desc tiebreak, planar sink with
+        bulk bloom — no per-entry Python and array-decodable for the
+        first-level compaction); anything else falls back cleanly to the
+        per-entry SSTWriter sink."""
+        if self._try_array_flush(path, mem):
             return
         writer = SSTWriter(
             path,
@@ -828,70 +992,64 @@ class DB:
             self.options.bits_per_key,
         )
         try:
-            for key, seq, vtype, value in entries:
+            for key, seq, vtype, value in mem.entries():
                 writer.add(key, seq, vtype, value)
             writer.finish()
         except BaseException:
             writer.abandon()
             raise
 
-    def _try_planar_flush(self, path: str, entries) -> bool:
-        """True when the planar sink handled the flush."""
-        if not entries:
-            return False
-        # Width pre-check on the TUPLES, before any packing: pack_entries
-        # allocates n x max_vlen — one oversized value among a million
-        # small ones must bail here, not after a giant transient buffer.
-        # vlen is bounded by the planar header's u16 field (the round-2
-        # crash: uniform values >= 256 B overflowed the then-u8 field);
-        # wider values take the entry-stream writer below.
-        from ..storage.planar import PLANAR_MAX_KLEN, PLANAR_MAX_VLEN
+    def _try_array_flush(self, path: str, mem) -> bool:
+        """True when the vectorized drain→lexsort→planar pipeline handled
+        the flush. ``mem`` is a MemTable or _MergedMemView; both expose
+        drain_lanes() (width checks bail inline, before any large buffer
+        — the round-2 lesson: one oversized value among a million small
+        ones must not cost a giant transient allocation)."""
+        import numpy as np
 
-        klen0 = len(entries[0][0])
-        vlen0 = None
-        for key, _seq, vtype, value in entries:
-            if len(key) != klen0 or len(key) > PLANAR_MAX_KLEN:
-                return False
-            if int(vtype) == 2:  # DELETE: no value in the planar layout
-                if value:
-                    return False
-            elif vlen0 is None:
-                vlen0 = len(value)
-                if vlen0 > PLANAR_MAX_VLEN:
-                    return False
-            elif len(value) != vlen0:
-                return False
-        from ..ops.kv_format import UnsupportedBatch, pack_entries
-        from ..tpu.format import (planar_stride, planar_widths,
-                                  write_sst_from_arrays)
+        from ..tpu.format import planar_stride, planar_widths, \
+            write_sst_from_arrays
+        from .bloom import BloomFilter
 
-        try:
-            batch = pack_entries(
-                entries, val_bytes=max(4, ((vlen0 or 0) + 3) // 4 * 4))
-        except UnsupportedBatch:
+        with start_span("flush.drain"):
+            drained = mem.drain_lanes()
+        if drained is None:
             return False
-        n = len(entries)
-        arrays = {
-            "key_words_be": batch.key_words_be[:n],
-            "key_words_le": batch.key_words_le[:n],
-            "key_len": batch.key_len[:n],
-            "seq_hi": batch.seq_hi[:n],
-            "seq_lo": batch.seq_lo[:n],
-            "vtype": batch.vtype[:n],
-            "val_words": batch.val_words[:n],
-            "val_len": batch.val_len[:n],
-        }
-        widths = planar_widths(arrays, n)
+        lanes, key_mat = drained
+        n = key_mat.shape[0]
+        widths = planar_widths(lanes, n)
         if widths is None:
-            return False
-        stride = planar_stride(*widths)
-        props = write_sst_from_arrays(
-            arrays, n, path,
-            block_entries=max(64, self.options.block_bytes // stride),
-            compression=self.options.compression,
-            bits_per_key=self.options.bits_per_key,
-            planar=True,
-        )
+            return False  # cross-memtable width mismatch
+        klen, vlen = widths
+        with start_span("flush.sort", entries=n):
+            # np.lexsort: last column has highest priority → key words
+            # ascending (uniform klen ⇒ BE word order == byte order),
+            # inverted seq as the descending tiebreak
+            seq = (
+                lanes["seq_hi"].astype(np.uint64) << np.uint64(32)
+            ) | lanes["seq_lo"].astype(np.uint64)
+            kw = lanes["key_words_be"]
+            kwc = (klen + 3) // 4
+            order = np.lexsort(
+                (~seq,) + tuple(kw[:, w] for w in range(kwc - 1, -1, -1)))
+            if not np.array_equal(order, np.arange(n)):
+                lanes = {f: a[order] for f, a in lanes.items()}
+        with start_span("flush.encode", entries=n):
+            # bulk bloom (order-independent — built from the pre-sort key
+            # matrix) instead of a per-key Python loop
+            bloom = BloomFilter.build_from_arrays(
+                key_mat, np.full(n, klen, dtype=np.uint64),
+                self.options.bits_per_key,
+            )
+            stride = planar_stride(klen, vlen)
+            props = write_sst_from_arrays(
+                lanes, n, path,
+                bloom_words=bloom.words,
+                block_entries=max(64, self.options.block_bytes // stride),
+                compression=self.options.compression,
+                bits_per_key=self.options.bits_per_key,
+                planar=True,
+            )
         return props is not None
 
     def _flush_imms(self, imms: List[MemTable]) -> None:
@@ -988,6 +1146,7 @@ class DB:
                         n for n in self._levels[0] if n not in inputs_l0
                     ]
                     self._levels[1] = out_names
+                    self._fences.clear()
                     snapshot = self._manifest_snapshot_locked()
                     dead = [(n, self._readers.pop(n, None)) for n in inputs]
                     # L0 just shrank: wake writers parked on the stop
@@ -1104,6 +1263,7 @@ class DB:
                     for files in self._levels:
                         files[:] = [n for n in files if n not in input_set]
                     self._levels[bottom] = out_names + self._levels[bottom]
+                    self._fences.clear()
                     # Manifest first, THEN delete inputs — a crash in
                     # between leaves orphan files (harmless), never a
                     # manifest pointing at deleted ones (unopenable DB).
@@ -1123,6 +1283,7 @@ class DB:
         out_names = self._write_merged(runs, drop_tombstones=drop)
         self._levels[0] = []
         self._levels[1] = out_names
+        self._fences.clear()
         self._persist_manifest()  # before GC — see compact_range
         self._gc_files(inputs)
 
@@ -1247,13 +1408,19 @@ class DB:
         return name, os.path.join(self.path, name)
 
     def install_full_compaction(self, plan: dict, entries=None,
-                                files: Optional[List[str]] = None) -> None:
+                                files: Optional[List[str]] = None,
+                                arrays: Optional[Tuple[dict, int]] = None,
+                                ) -> None:
         """Swap in a plan's externally-merged outputs (manifest first,
         then input GC — the compact_range crash-safety order). Outputs
-        come either as merged ``entries`` tuples written here, or as
-        ``files``: names from :meth:`allocate_sst` whose SSTs the caller
-        already wrote durably (the array-native batched sink). Consumes
-        the plan's mutex."""
+        come as merged ``entries`` tuples written here, as ``files``:
+        names from :meth:`allocate_sst` whose SSTs the caller already
+        wrote durably (the array-native batched sink), or as ``arrays``:
+        a resolved ``(lanes, count)`` pair written here through the
+        vectorized PLANAR sink with bulk blooms — no per-entry Python.
+        An ``arrays`` install the planar layout can't express raises
+        InvalidArgument (callers with mixed-width results unpack to
+        ``entries`` instead). Consumes the plan's mutex."""
         try:
             fp.hit("compact.install")
             if files is not None:
@@ -1261,6 +1428,13 @@ class DB:
                 for name in out_names:
                     self._readers[name] = SSTReader(
                         os.path.join(self.path, name))
+            elif arrays is not None:
+                out_names = self._write_resolved_arrays(*arrays)
+                if out_names is None:
+                    raise InvalidArgument(
+                        "install_full_compaction: arrays not planar-"
+                        "expressible (non-uniform widths) — unpack to "
+                        "entries for the tuple sink")
             else:
                 out_names = self._write_entry_stream(iter(entries))
             with self._lock:
@@ -1272,10 +1446,40 @@ class DB:
                         n for n in level_files if n not in input_set]
                 bottom = plan["bottom"]
                 self._levels[bottom] = out_names + self._levels[bottom]
+                self._fences.clear()
                 self._persist_manifest()
                 self._gc_files(plan["inputs"])
         finally:
             self._compaction_mutex.release()
+
+    def _write_resolved_arrays(self, lanes: dict,
+                               count: int) -> Optional[List[str]]:
+        """Write already-resolved lane arrays as PLANAR SSTs (split at
+        target_file_bytes, bulk blooms) and register readers — the
+        array-native install sink shared with the compaction backends.
+        None when the planar layout can't express the rows."""
+        from .native_compaction import write_resolved_lanes
+
+        if count == 0:
+            return []
+        outputs = write_resolved_lanes(
+            lanes, count, self.allocate_sst_path,
+            self.options.block_bytes, self.options.compression,
+            self.options.bits_per_key, self.options.target_file_bytes,
+        )
+        if outputs is None:
+            return None
+        names: List[str] = []
+        for path, _props in outputs:
+            name = os.path.basename(path)
+            self._readers[name] = SSTReader(path)
+            names.append(name)
+        return names
+
+    def allocate_sst_path(self) -> str:
+        """path_factory form of :meth:`allocate_sst` (the array sinks
+        take a zero-arg callable returning an absolute path)."""
+        return self.allocate_sst()[1]
 
     def abort_full_compaction(self, plan: dict) -> None:
         """Release a plan without installing (external merge declined or
@@ -1473,6 +1677,7 @@ class DB:
                         self._gc_files(new_names)
                         raise InvalidArgument("ingest_behind files overlap")
                 self._levels[-1] = ordered
+                self._fences.clear()
             else:
                 # The ingested file is newer than everything current, so the
                 # memtable — and any in-flight background flush, which would

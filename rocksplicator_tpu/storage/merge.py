@@ -1,16 +1,48 @@
-"""Merge operators.
+"""Merge operators — the ONE home for MERGE-operand folding semantics.
 
 Reference: rocksdb::AssociativeMergeOperator;
 examples/counter_service/merge_operator.h:20-40 implements the counter bump
 as a uint64-add associative merge.
+
+Two faces of the same semantics live here so they cannot drift:
+
+- ``resolve_entry_group``: the scalar per-key fold the tuple compaction
+  path (storage/compaction.resolve_stream) applies to one key's entry
+  stack — newest PUT/DELETE wins, MERGE operands above it fold in,
+  tombstones drop at the bottom level.
+- ``uint64_wrap`` / ``uint64add_segment_sums``: the wraparound arithmetic
+  the vectorized array resolve (tpu/backend.numpy_merge_resolve, the
+  native C resolve, and the TPU kernel) applies per sorted key segment.
+  ``tests/test_flush_drain.py`` cross-checks the two faces entry-exactly.
 """
 
 from __future__ import annotations
 
 import struct
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 _U64 = struct.Struct("<q")
+
+
+def uint64_wrap(total: int) -> int:
+    """Canonical uint64-add overflow semantics → signed int64 range.
+    Single source of truth shared by the scalar operator and (as plain
+    int64 wraparound) the vectorized segment fold."""
+    total &= (1 << 64) - 1
+    if total >= 1 << 63:
+        total -= 1 << 64
+    return total
+
+
+def uint64add_segment_sums(vals, contrib, bounds):
+    """Vectorized uint64-add fold: per-segment sums of ``vals`` (int64)
+    where ``contrib`` is True, segments starting at ``bounds`` — numpy
+    int64 wraparound is element-exact with :func:`uint64_wrap` (the
+    cross-check test pins it). Used by the array merge-resolve paths."""
+    import numpy as np
+
+    with np.errstate(over="ignore"):
+        return np.add.reduceat(np.where(contrib, vals, 0), bounds)
 
 
 class MergeOperator:
@@ -42,10 +74,7 @@ class UInt64AddOperator(MergeOperator):
         total = self._parse(existing)
         for op in operands:
             total += self._parse(op)
-        total &= (1 << 64) - 1
-        if total >= 1 << 63:
-            total -= 1 << 64
-        return _U64.pack(total)
+        return _U64.pack(uint64_wrap(total))
 
     def partial_merge(self, key: bytes, operands: List[bytes]) -> Optional[bytes]:
         return self.merge(key, None, operands)
@@ -54,3 +83,53 @@ class UInt64AddOperator(MergeOperator):
 MERGE_OPERATORS = {
     UInt64AddOperator.name: UInt64AddOperator,
 }
+
+# entry: (key, seq, vtype, value) — mirrors storage/compaction.Entry
+from .records import OpType as _OpType
+
+_PUT, _DELETE, _MERGE = _OpType.PUT, _OpType.DELETE, _OpType.MERGE
+
+
+def resolve_entry_group(
+    group: List[Tuple[bytes, int, int, bytes]],
+    merge_op: Optional[MergeOperator],
+    drop_tombstones: bool,
+) -> List[Tuple[bytes, int, int, bytes]]:
+    """Fold one key's entry stack — newest (highest seq) first — to its
+    surviving entries. THE scalar definition of LSM merge resolution;
+    storage/compaction.resolve_stream delegates here, and the array
+    resolves implement the identical semantics over lanes (cross-checked
+    in tests).
+
+    Usually returns one entry; an unresolved MERGE chain without a
+    partial-merge-capable operator survives as multiple entries, like
+    RocksDB keeps stacked merge operands."""
+    key = group[0][0]
+    top_seq = group[0][1]
+    operands: List[bytes] = []
+    for _key, seq, vtype, value in group:
+        if vtype == _PUT:
+            if operands and merge_op:
+                return [(key, top_seq, _PUT,
+                         merge_op.merge(key, value, list(reversed(operands))))]
+            return [(key, top_seq, _PUT, value)]
+        if vtype == _DELETE:
+            if operands and merge_op:
+                return [(key, top_seq, _PUT,
+                         merge_op.merge(key, None, list(reversed(operands))))]
+            if drop_tombstones:
+                return []
+            return [(key, top_seq, _DELETE, b"")]
+        if vtype == _MERGE:
+            operands.append(value)
+    # Only MERGE ops seen for this key.
+    if drop_tombstones and merge_op:
+        # Bottom level: no older data can exist — fold to a final value.
+        return [(key, top_seq, _PUT,
+                 merge_op.merge(key, None, list(reversed(operands))))]
+    if merge_op:
+        partial = merge_op.partial_merge(key, list(reversed(operands)))
+        if partial is not None:
+            return [(key, top_seq, _MERGE, partial)]
+    # No (partial-merge-capable) operator: keep the chain intact.
+    return [e for e in group if e[2] == _MERGE]

@@ -56,122 +56,12 @@ class NativeCompactionBackend(CpuCompactionBackend):
         target_file_bytes: int,
     ) -> Optional[List[Tuple[str, dict]]]:
         """[(path, props)], [] for an all-tombstoned result, or None →
-        the engine's tuple path."""
-        from ..ops.kv_format import UnsupportedBatch, pack_entries
-        from ..tpu.format import (planar_stride, planar_widths,
-                                  read_sst_arrays, write_sst_from_arrays)
-
-        if merge_op is not None and not isinstance(merge_op,
-                                                   UInt64AddOperator):
-            return None
-        parts: List[dict] = []
-        total = 0
-        try:
-            for run in runs:
-                if hasattr(run, "iterate"):  # an SSTReader
-                    arr = read_sst_arrays(run)
-                    if arr is None:
-                        arr = self._arrays_from_entries(
-                            list(run.iterate()), pack_entries)
-                else:
-                    arr = self._arrays_from_entries(list(run), pack_entries)
-                if arr is not None:
-                    if merge_op is not None:
-                        # uint64-add fold semantics require 8-byte
-                        # values (see the precondition comment below);
-                        # checked PER RUN so a disqualifying workload
-                        # bails after one run, not a full assembly
-                        nd = arr["val_len"][arr["vtype"] != _DELETE]
-                        if len(nd) and not (nd == 8).all():
-                            return None
-                    parts.append(arr)
-                    total += arr["key_len"].shape[0]
-                    if total > MAX_DIRECT_ENTRIES:
-                        # bail BEFORE materializing the rest — the cap
-                        # exists to bound host memory, not to be checked
-                        # after the allocation it should have prevented
-                        return None
-        except UnsupportedBatch:
-            return None
-        if total == 0:
-            return None
-        vw = max(p["val_words"].shape[1] for p in parts)
-        for p in parts:
-            w = p["val_words"].shape[1]
-            if w < vw:
-                p["val_words"] = np.pad(p["val_words"],
-                                        [(0, 0), (0, vw - w)])
-        fields = ("key_words_be", "key_len", "seq_hi", "seq_lo", "vtype",
-                  "val_words", "val_len")
-        lanes = {f: np.concatenate([p[f] for p in parts]) for f in fields}
-        if merge_op is None and bool((lanes["vtype"] == _MERGE).any()):
-            return None
-        # PLANAR sink preconditions (same as the TPU sink): uniform keys,
-        # uniform non-delete value widths
-        kl = lanes["key_len"]
-        if not (kl == kl[0]).all():
-            return None
-        is_del = lanes["vtype"] == _DELETE
-        non_del_vlens = lanes["val_len"][~is_del]
-        if len(non_del_vlens) and not (
-                non_del_vlens == non_del_vlens[0]).all():
-            return None
-        # uint64-add RESOLUTION assumes 8-byte values: the fold rewrites
-        # every PUT segment to the operand sum, and a non-8-byte PUT
-        # parses as 0 (stream semantics only invoke the operator when
-        # operands exist, so a lone non-8-byte PUT must stay verbatim —
-        # which the array fold cannot express). Route such shapes to the
-        # tuple path.
-        if (merge_op is not None and len(non_del_vlens)
-                and not (non_del_vlens == 8).all()):
-            return None
-
-        arrays, count = self._resolve(parts, lanes, total, vw, merge_op,
-                                      drop_tombstones)
-        if count == 0:
-            return []  # fully compacted away — nothing to write
-        widths = planar_widths(arrays, count)
-        if widths is None:
-            return None
-        klen0, vlen0 = widths
-        stride = planar_stride(klen0, vlen0)
-        entries_per_file = max(1024, target_file_bytes // max(1, stride))
-        block_entries = max(64, block_bytes // max(1, stride))
-        outputs: List[Tuple[str, dict]] = []
-
-        def cleanup():
-            for p, _ in outputs:
-                try:
-                    os.remove(p)
-                except OSError:
-                    pass
-
-        try:
-            for start in range(0, count, entries_per_file):
-                end = min(start + entries_per_file, count)
-                sub = {f: arrays[f][start:end] for f in arrays}
-                bloom = self._bulk_bloom(sub, end - start, klen0,
-                                         bits_per_key)
-                path = path_factory()
-                props = write_sst_from_arrays(
-                    sub, end - start, path,
-                    bloom_words=bloom.words,
-                    block_entries=block_entries,
-                    compression=compression,
-                    bits_per_key=bits_per_key,
-                    planar=True,
-                )
-                if props is None:  # should not happen after width checks
-                    cleanup()
-                    return None
-                outputs.append((path, props))
-        except BaseException:
-            # a mid-loop failure (disk full on file 2 of 3) must not
-            # leak file 1: the engine falls back to the tuple path and
-            # nothing would ever reference or GC the orphan
-            cleanup()
-            raise
-        return outputs
+        the engine's tuple path. (Shared with CpuCompactionBackend —
+        see direct_merge_runs_to_files below.)"""
+        return direct_merge_runs_to_files(
+            runs, merge_op, drop_tombstones, path_factory, block_bytes,
+            compression, bits_per_key, target_file_bytes,
+        )
 
     # -- internals ---------------------------------------------------------
 
@@ -294,3 +184,179 @@ class NativeCompactionBackend(CpuCompactionBackend):
             np.asarray(sub["key_len"][:n], dtype=np.uint64),
             np.uint64(kb.shape[1]))
         return BloomFilter.build_from_arrays(kb, lens, bits_per_key)
+
+
+def read_runs_as_lanes(
+    runs: List, merge_op: Optional[MergeOperator],
+    max_entries: int = MAX_DIRECT_ENTRIES,
+) -> Optional[Tuple[List[dict], dict, int, int]]:
+    """Decode input runs (SSTReaders or entry iterables) straight into
+    concatenated lane arrays. Returns (parts, lanes, total, vw) or None
+    when the lane representation can't express the inputs (per-run
+    checks bail early, before materializing the rest). Shared by the
+    direct compaction sink and the batched cross-shard service."""
+    from ..ops.kv_format import UnsupportedBatch, pack_entries
+    from ..tpu.format import read_sst_arrays
+
+    parts: List[dict] = []
+    total = 0
+    try:
+        for run in runs:
+            if hasattr(run, "iterate"):  # an SSTReader
+                arr = read_sst_arrays(run)
+                if arr is None:
+                    arr = NativeCompactionBackend._arrays_from_entries(
+                        list(run.iterate()), pack_entries)
+            else:
+                arr = NativeCompactionBackend._arrays_from_entries(
+                    list(run), pack_entries)
+            if arr is not None:
+                if merge_op is not None:
+                    # uint64-add fold semantics require 8-byte values
+                    # (see the precondition comment in
+                    # direct_merge_runs_to_files); checked PER RUN so a
+                    # disqualifying workload bails after one run, not a
+                    # full assembly
+                    nd = arr["val_len"][arr["vtype"] != _DELETE]
+                    if len(nd) and not (nd == 8).all():
+                        return None
+                parts.append(arr)
+                total += arr["key_len"].shape[0]
+                if total > max_entries:
+                    # bail BEFORE materializing the rest — the cap
+                    # exists to bound host memory, not to be checked
+                    # after the allocation it should have prevented
+                    return None
+    except UnsupportedBatch:
+        return None
+    if total == 0:
+        return None
+    vw = max(p["val_words"].shape[1] for p in parts)
+    for p in parts:
+        w = p["val_words"].shape[1]
+        if w < vw:
+            p["val_words"] = np.pad(p["val_words"], [(0, 0), (0, vw - w)])
+    fields = ("key_words_be", "key_len", "seq_hi", "seq_lo", "vtype",
+              "val_words", "val_len")
+    lanes = {f: np.concatenate([p[f] for p in parts]) for f in fields}
+    return parts, lanes, total, vw
+
+
+def lanes_resolvable(lanes: dict, merge_op: Optional[MergeOperator]) -> bool:
+    """True when the array merge-resolve can express these lanes' MERGE
+    semantics (the PLANAR-sink preconditions shared by every array
+    compaction path)."""
+    if merge_op is None and bool((lanes["vtype"] == _MERGE).any()):
+        return False
+    # PLANAR sink preconditions (same as the TPU sink): uniform keys,
+    # uniform non-delete value widths
+    kl = lanes["key_len"]
+    if len(kl) and not (kl == kl[0]).all():
+        return False
+    is_del = lanes["vtype"] == _DELETE
+    non_del_vlens = lanes["val_len"][~is_del]
+    if len(non_del_vlens) and not (
+            non_del_vlens == non_del_vlens[0]).all():
+        return False
+    # uint64-add RESOLUTION assumes 8-byte values: the fold rewrites
+    # every PUT segment to the operand sum, and a non-8-byte PUT
+    # parses as 0 (stream semantics only invoke the operator when
+    # operands exist, so a lone non-8-byte PUT must stay verbatim —
+    # which the array fold cannot express). Route such shapes to the
+    # tuple path.
+    if (merge_op is not None and len(non_del_vlens)
+            and not (non_del_vlens == 8).all()):
+        return False
+    return True
+
+
+def write_resolved_lanes(
+    arrays: dict, count: int, path_factory, block_bytes: int,
+    compression: int, bits_per_key: int, target_file_bytes: int,
+) -> Optional[List[Tuple[str, dict]]]:
+    """Write resolved lanes as PLANAR SSTs split at target_file_bytes
+    with bulk-built blooms — the shared array file sink. None when the
+    planar layout can't express the rows; a mid-loop failure cleans up
+    every file already written (nothing would ever GC the orphans)."""
+    from ..tpu.format import planar_stride, planar_widths, \
+        write_sst_from_arrays
+
+    widths = planar_widths(arrays, count)
+    if widths is None:
+        return None
+    klen0, vlen0 = widths
+    stride = planar_stride(klen0, vlen0)
+    entries_per_file = max(1024, target_file_bytes // max(1, stride))
+    block_entries = max(64, block_bytes // max(1, stride))
+    outputs: List[Tuple[str, dict]] = []
+
+    def cleanup():
+        for p, _ in outputs:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    try:
+        for start in range(0, count, entries_per_file):
+            end = min(start + entries_per_file, count)
+            sub = {f: arrays[f][start:end] for f in arrays}
+            bloom = NativeCompactionBackend._bulk_bloom(
+                sub, end - start, klen0, bits_per_key)
+            path = path_factory()
+            props = write_sst_from_arrays(
+                sub, end - start, path,
+                bloom_words=bloom.words,
+                block_entries=block_entries,
+                compression=compression,
+                bits_per_key=bits_per_key,
+                planar=True,
+            )
+            if props is None:  # should not happen after width checks
+                cleanup()
+                return None
+            outputs.append((path, props))
+    except BaseException:
+        # a mid-loop failure (disk full on file 2 of 3) must not
+        # leak file 1: the engine falls back to the tuple path and
+        # nothing would ever reference or GC the orphan
+        cleanup()
+        raise
+    return outputs
+
+
+def direct_merge_runs_to_files(
+    runs: List,
+    merge_op: Optional[MergeOperator],
+    drop_tombstones: bool,
+    path_factory,
+    block_bytes: int,
+    compression: int,
+    bits_per_key: int,
+    target_file_bytes: int,
+) -> Optional[List[Tuple[str, dict]]]:
+    """The CPU array compaction pipeline: runs → lanes → merge-resolve
+    (native C when loaded, numpy lexsort+reduceat otherwise) → PLANAR
+    files. [(path, props)], [] for an all-tombstoned result, or None →
+    the engine's tuple path. Shared by CpuCompactionBackend and
+    NativeCompactionBackend so every CPU-configured engine compacts
+    array-to-array when the inputs allow it."""
+    from ..observability.span import start_span
+
+    if merge_op is not None and not isinstance(merge_op, UInt64AddOperator):
+        return None
+    read = read_runs_as_lanes(runs, merge_op)
+    if read is None:
+        return None
+    parts, lanes, total, vw = read
+    if not lanes_resolvable(lanes, merge_op):
+        return None
+    with start_span("compact.resolve", entries=total):
+        arrays, count = NativeCompactionBackend._resolve(
+            parts, lanes, total, vw, merge_op, drop_tombstones)
+    if count == 0:
+        return []  # fully compacted away — nothing to write
+    return write_resolved_lanes(
+        arrays, count, path_factory, block_bytes, compression,
+        bits_per_key, target_file_bytes,
+    )

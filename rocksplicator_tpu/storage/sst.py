@@ -24,13 +24,18 @@ exactly how ingestion assigns sequence numbers without rewriting the file.
 
 from __future__ import annotations
 
+import bisect
+import itertools
 import json
 import os
 import struct
+import threading
 import zlib
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..testing import failpoints as fp
+from ..utils.stats import Stats
 from . import rlz
 from .bloom import BloomFilter
 from .errors import Corruption, InvalidArgument
@@ -60,6 +65,123 @@ BLOCK_PLANAR_RLZ = 5
 ENTRY_FIXED_OVERHEAD = _ENTRY_HEAD.size + _ENTRY_META.size
 
 FLAG_HAS_GLOBAL_SEQNO = 1
+
+# ---------------------------------------------------------------------------
+# Decoded-block cache
+# ---------------------------------------------------------------------------
+
+# Default budget for the process-global decoded-block LRU. Every `get`
+# that touches an SST used to re-read AND re-decompress its block from
+# disk; the cache holds decompressed (checksum-verified) block payloads.
+# Env-tunable: RSTPU_BLOCK_CACHE_BYTES=0 disables, any other value is the
+# byte budget. (rocksdb analog: block_cache / LRUCache.)
+BLOCK_CACHE_DEFAULT_BYTES = 32 << 20
+_BLOCK_CACHE_ENV = "RSTPU_BLOCK_CACHE_BYTES"
+
+_cache_tokens = itertools.count(1)
+
+
+class BlockCache:
+    """Byte-budgeted process-global LRU of decompressed data blocks,
+    keyed by (reader token, block index). Per-reader tokens — not paths —
+    key the entries, so a file GC'd and a new file reusing its name can
+    never alias; SSTReader.close() drops its token's entries (file GC
+    closes readers, which is the invalidation hook).
+
+    Counters on /stats: ``storage.block_cache.hit`` for every cache-served
+    block, ``storage.block_cache.miss`` for point-read fills. Bulk scans
+    (compaction sources, iterators) probe the cache but do not fill or
+    count misses — they would evict the working set and skew the rate
+    (rocksdb's fill_cache=false convention)."""
+
+    _instance: Optional["BlockCache"] = None
+    _disabled = False
+    _instance_lock = threading.Lock()
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity = int(capacity_bytes)
+        self._lock = threading.Lock()
+        self._blocks: "OrderedDict[Tuple[int, int], bytes]" = OrderedDict()
+        self._bytes = 0
+        self._by_token: Dict[int, set] = {}
+
+    # -- singleton --------------------------------------------------------
+
+    @classmethod
+    def get_instance(cls) -> Optional["BlockCache"]:
+        if cls._instance is None and not cls._disabled:
+            with cls._instance_lock:
+                if cls._instance is None and not cls._disabled:
+                    try:
+                        cap = int(os.environ.get(
+                            _BLOCK_CACHE_ENV, BLOCK_CACHE_DEFAULT_BYTES))
+                    except ValueError:
+                        cap = BLOCK_CACHE_DEFAULT_BYTES
+                    if cap > 0:
+                        cls._instance = cls(cap)
+                    else:
+                        cls._disabled = True
+        return cls._instance
+
+    @classmethod
+    def reset_for_test(cls, capacity: Optional[int] = None) -> None:
+        """Drop the singleton; next use re-reads the env (or uses the
+        explicit ``capacity``)."""
+        with cls._instance_lock:
+            cls._disabled = False
+            if capacity is None:
+                cls._instance = None
+            elif capacity > 0:
+                cls._instance = cls(capacity)
+            else:
+                cls._instance = None
+                cls._disabled = True
+
+    # -- cache ops --------------------------------------------------------
+
+    def get(self, token: int, idx: int) -> Optional[bytes]:
+        with self._lock:
+            raw = self._blocks.get((token, idx))
+            if raw is not None:
+                self._blocks.move_to_end((token, idx))
+            return raw
+
+    def put(self, token: int, idx: int, raw: bytes) -> None:
+        size = len(raw)
+        if size > self.capacity:
+            return
+        with self._lock:
+            key = (token, idx)
+            if key in self._blocks:
+                self._blocks.move_to_end(key)
+                return
+            self._blocks[key] = raw
+            self._bytes += size
+            self._by_token.setdefault(token, set()).add(idx)
+            while self._bytes > self.capacity and self._blocks:
+                (t, i), v = self._blocks.popitem(last=False)
+                self._bytes -= len(v)
+                idxs = self._by_token.get(t)
+                if idxs is not None:
+                    idxs.discard(i)
+                    if not idxs:
+                        del self._by_token[t]
+
+    def drop(self, token: int) -> None:
+        """Invalidate every block of one reader (close/file-GC hook)."""
+        with self._lock:
+            idxs = self._by_token.pop(token, None)
+            if not idxs:
+                return
+            for i in idxs:
+                raw = self._blocks.pop((token, i), None)
+                if raw is not None:
+                    self._bytes -= len(raw)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"bytes": self._bytes, "blocks": len(self._blocks),
+                    "capacity": self.capacity}
 
 
 def _encode_entry(key: bytes, seq: int, vtype: int, value: bytes) -> bytes:
@@ -299,10 +421,19 @@ class SSTReader:
         )
         self.props: Dict = json.loads(props_raw.decode("utf-8")) if props_raw else {}
         self._verified_blocks: set = set()
+        self._cache_token = next(_cache_tokens)
+        # block last_keys for bisect (get_entries_many groups keys/block)
+        self._last_keys: List[bytes] = [e[0] for e in self._index]
 
     # -- reads ------------------------------------------------------------
 
-    def _read_block(self, block_idx: int) -> bytes:
+    def _read_block(self, block_idx: int, fill_cache: bool = True) -> bytes:
+        cache = BlockCache.get_instance()
+        if cache is not None:
+            raw = cache.get(self._cache_token, block_idx)
+            if raw is not None:
+                Stats.get().incr("storage.block_cache.hit")
+                return raw
         _last_key, off, size, codec = self._index[block_idx]
         payload = os.pread(self._fd, size, off)
         if codec in (COMPRESSION_ZLIB, BLOCK_PLANAR_ZLIB):
@@ -320,6 +451,11 @@ class SSTReader:
             raise Corruption(
                 f"unsupported block codec {codec} (newer writer?)")
         self._verify_block_chk(block_idx, raw)
+        if cache is not None and fill_cache:
+            # only verified payloads enter the cache (a cached block skips
+            # re-verification, like the _verified_blocks memo)
+            Stats.get().incr("storage.block_cache.miss")
+            cache.put(self._cache_token, block_idx, raw)
         return raw
 
     def _block_is_planar(self, block_idx: int) -> bool:
@@ -473,6 +609,66 @@ class SSTReader:
         entries = self.get_entries(key)
         return entries[0] if entries else None
 
+    def get_entries_many(
+        self, keys: List[bytes], hashes=None
+    ) -> Dict[bytes, List[Tuple[int, int, bytes]]]:
+        """Entry stacks (newest first, as get_entries) for MANY keys:
+        blooms checked in one batch, keys sorted and grouped per block so
+        each touched block is read (or cache-hit) and decoded ONCE —
+        the multi_get path. Keys with no entries are absent from the
+        result. ``hashes`` is an optional ``(row_of_key, h1, mask)``
+        triple from ``bloom.hash_many`` so a multi-SST read hashes each
+        key once, not once per file."""
+        import numpy as np
+
+        out: Dict[bytes, List[Tuple[int, int, bytes]]] = {}
+        if not self._index or not keys:
+            return out
+        cand = sorted(set(keys))
+        if hashes is not None:
+            rows_of, h1_all, mask_all = hashes
+            rows = np.fromiter((rows_of[k] for k in cand),
+                               dtype=np.intp, count=len(cand))
+            mask = self._bloom.may_contain_hashed(
+                h1_all[rows], mask_all[rows])
+        else:
+            mask = self._bloom.may_contain_many(cand)
+        per_block: Dict[int, List[bytes]] = {}
+        for k, ok in zip(cand, mask):
+            if not ok:
+                continue
+            b = bisect.bisect_left(self._last_keys, k)
+            if b < len(self._index):
+                per_block.setdefault(b, []).append(k)
+        heap = sorted(per_block)
+        pos = 0
+        while pos < len(heap):
+            b = heap[pos]
+            pos += 1
+            want = per_block[b]
+            raw = self._read_block(b)
+            entries = list(self._block_iter(b, raw))
+            ekeys = [e[0] for e in entries]
+            for k in want:
+                j = bisect.bisect_left(ekeys, k)
+                while j < len(entries) and ekeys[j] == k:
+                    _k, seq, vtype, value = entries[j]
+                    out.setdefault(k, []).append(
+                        (self._effective_seq(seq), vtype, value))
+                    j += 1
+                if j == len(entries) and b + 1 < len(self._index):
+                    # the key's stack may continue into the next block
+                    # (same continuation rule as get_entries)
+                    nxt = per_block.get(b + 1)
+                    if nxt is None:
+                        per_block[b + 1] = [k]
+                        # keep the worklist ordered: b+1 precedes any
+                        # later scheduled block or is processed next
+                        heap.insert(pos, b + 1)
+                    elif k not in nxt:
+                        nxt.append(k)
+        return out
+
     def iterate(
         self, start: Optional[bytes] = None, end: Optional[bytes] = None
     ) -> Iterator[Tuple[bytes, int, int, bytes]]:
@@ -480,8 +676,10 @@ class SSTReader:
         for i, (last_key, _off, _size, _codec) in enumerate(self._index):
             if start is not None and last_key < start:
                 continue
+            # bulk scan: probe the cache but don't fill it (a compaction
+            # or full iteration would evict the point-read working set)
             for key, seq, vtype, value in self._block_iter(
-                    i, self._read_block(i)):
+                    i, self._read_block(i, fill_cache=False)):
                 if start is not None and key < start:
                     continue
                 if end is not None and key >= end:
@@ -505,3 +703,7 @@ class SSTReader:
         if self._fd >= 0:
             os.close(self._fd)
             self._fd = -1
+            cache = BlockCache.get_instance()
+            if cache is not None:
+                # file GC closes readers — cached blocks die with them
+                cache.drop(self._cache_token)

@@ -448,7 +448,12 @@ def numpy_merge_resolve(
             vals = vw[:, 0].astype(np.int64)
         # parity with UInt64AddOperator._parse: non-8-byte values parse as 0
         contrib = (operand_mask | (is_base & (pos == fb) & is_put)) & (vlen == 8)
-        sums = np.add.reduceat(np.where(contrib, vals, 0), bounds)
+        # the fold itself (wraparound semantics) is the shared
+        # storage/merge implementation — single source of truth with the
+        # scalar operator
+        from ..storage.merge import uint64add_segment_sums
+
+        sums = uint64add_segment_sums(vals, contrib, bounds)
 
     # representative = first row of each segment
     rep_idx = bounds

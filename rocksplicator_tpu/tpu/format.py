@@ -90,7 +90,8 @@ def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
         klen, vlen = int(widths[0]), int(widths[1])
         if not (0 < klen <= 24) or vlen < 0:
             return None  # foreign/crafted prop — tuple path validates
-        blocks = [reader._read_block(i) for i in range(len(reader._index))]
+        blocks = [reader._read_block(i, fill_cache=False)
+                  for i in range(len(reader._index))]
     else:
         # No sink prop (flush-written / foreign file): INFER the uniform
         # stride from block 0 so first-level compactions of flush output
@@ -100,7 +101,7 @@ def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
         # the tuple path).
         if not reader.num_entries or not reader._index:
             return None
-        b0 = reader._read_block(0)
+        b0 = reader._read_block(0, fill_cache=False)
         if len(b0) < _ENTRY_FIXED_OVERHEAD:
             return None
         klen = int.from_bytes(b0[:4], "little")
@@ -111,7 +112,8 @@ def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
         if len(b0) % (_ENTRY_FIXED_OVERHEAD + klen + vlen):
             return None
         blocks = [b0] + [
-            reader._read_block(i) for i in range(1, len(reader._index))
+            reader._read_block(i, fill_cache=False)
+            for i in range(1, len(reader._index))
         ]
     raw = b"".join(blocks)
     stride = _ENTRY_FIXED_OVERHEAD + klen + vlen
@@ -287,7 +289,7 @@ def _read_planar_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
     lanes concatenated across blocks."""
     try:
         parts = [
-            decode_planar_block(reader._read_block(i))
+            decode_planar_block(reader._read_block(i, fill_cache=False))
             for i in range(len(reader._index))
         ]
     except Exception:
