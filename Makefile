@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke chaos-smoke clean
+.PHONY: test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke chaos-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -50,15 +50,33 @@ repl-bench-smoke:
 		--write_window 64 \
 		--out benchmarks/results/replication_3replica_smoke.json
 
+# fast-path transport regression smoke: the same 3-replica bench
+# briefly on the uds (vectored sendmsg, 3 processes) and loopback
+# (in-process zero-copy, colocated) byte layers — fails loudly on any
+# acked-write loss or missed convergence on either fast path
+transport-bench-smoke:
+	$(PY) -m benchmarks.replication_3replica_bench --shards 8 --keys 50 \
+		--write_window 64 --transport uds \
+		--out benchmarks/results/transport_smoke_uds.json
+	$(PY) -m benchmarks.replication_3replica_bench --shards 8 --keys 50 \
+		--write_window 64 --transport loopback \
+		--out benchmarks/results/transport_smoke_loopback.json
+
 # seeded chaos smoke (<60s): 20 randomized failpoint schedules against a
 # 3-node cluster + the admin ingest path, every schedule checked for the
 # three standing invariants (hole-free WAL prefix, zero acked-write
-# loss, ingest atomicity/no-partial-meta); then a deliberately-broken
+# loss, ingest atomicity/no-partial-meta); then the SAME seeded
+# schedules re-run on the uds and loopback byte layers (failpoints arm
+# identically on all three transports), and a deliberately-broken
 # durability guard run that must be CAUGHT (--expect-violation). A
 # violation prints the reproducing --seed.
 chaos-smoke:
 	$(PY) -m tools.chaos_soak --schedules 20 --seed 1 \
 		--out benchmarks/results/chaos_smoke.json
+	$(PY) -m tools.chaos_soak --schedules 3 --seed 1 --transport uds \
+		--out benchmarks/results/chaos_smoke_uds.json
+	$(PY) -m tools.chaos_soak --schedules 3 --seed 1 --transport loopback \
+		--out benchmarks/results/chaos_smoke_loopback.json
 	$(PY) -m tools.chaos_soak --schedules 1 --seed 7 \
 		--break-guard wal_hole --expect-violation --conv-timeout 3
 	$(PY) -m tools.chaos_soak --schedules 1 --seed 7 --ingest-every 1 \

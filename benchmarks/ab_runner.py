@@ -1,0 +1,162 @@
+"""Shared interleaved A/B harness for same-host benchmarks.
+
+Rounds 7 and 9 learned the same lesson twice: this class of host (2-4
+core CI box, shared disk) drifts by 2-5× hour to hour, so "before" and
+"after" numbers measured in separate runs mostly measure the host, not
+the change. The cure both benches hand-rolled is INTERLEAVING — run the
+variants back to back inside each rep (A B C, A B C, ...) so drift and
+fsync storms land on every variant equally, then compare medians across
+reps. This module is that pattern as a library, plus the host
+calibration block that makes an absolute number from one of these hosts
+interpretable at all.
+
+Usage::
+
+    from benchmarks.ab_runner import host_calibration, run_interleaved
+
+    out = run_interleaved(
+        [("tcp", lambda: run_bench("tcp")),     # thunk -> float | dict
+         ("uds", lambda: run_bench("uds"))],
+        reps=3, key="acked_writes_per_sec")
+    out["host_calibration"] = host_calibration(tmpdir)
+
+``run_interleaved`` returns a JSON-ready dict: raw per-rep samples per
+variant, per-variant median/best summaries, and ``ratio_vs_<baseline>``
+computed median-to-median (the first variant is the baseline unless
+``baseline=`` names another). No fake-zero fields: a variant whose thunk
+raises is recorded as an error string, never as a 0.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from statistics import median
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+Sample = Union[float, Dict[str, float]]
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def host_calibration(workdir: str, fsyncs: int = 100,
+                     spin_ms: float = 80.0) -> Dict:
+    """A small, fast probe of what THIS host can do right now — recorded
+    next to every A/B so a reader (or a later round) can tell "the code
+    got faster" from "the host had a good hour":
+
+    - ``fsync_per_sec`` — the floor under any durable ack;
+    - ``cpu_spin_score`` — single-thread Python ops/ms (GIL-bound
+      orchestration scales with this);
+    - ``loadavg_1m`` / ``cpu_count`` — ambient contention context.
+    """
+    fd = os.open(os.path.join(workdir, "ab_fsync_probe"),
+                 os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+    try:
+        buf = b"x" * 4096
+        t0 = time.perf_counter()
+        for _ in range(fsyncs):
+            os.write(fd, buf)
+            os.fsync(fd)
+        fsync_per_sec = fsyncs / (time.perf_counter() - t0)
+    finally:
+        os.close(fd)
+        try:
+            os.unlink(os.path.join(workdir, "ab_fsync_probe"))
+        except OSError:
+            pass
+    n = 0
+    deadline = time.perf_counter() + spin_ms / 1e3
+    while time.perf_counter() < deadline:
+        n += sum(range(100))  # fixed per-iteration work
+    try:
+        load1 = round(os.getloadavg()[0], 2)
+    except OSError:
+        load1 = None
+    return {
+        "fsync_per_sec": round(fsync_per_sec, 1),
+        "cpu_spin_score": round(n / spin_ms / 1e3, 1),
+        "loadavg_1m": load1,
+        "cpu_count": os.cpu_count(),
+    }
+
+
+def _metric(sample: Sample, key: Optional[str]) -> Optional[float]:
+    if isinstance(sample, dict):
+        if key is None:
+            raise ValueError(
+                "dict samples need key= to pick the ratio metric")
+        v = sample.get(key)
+        return float(v) if v is not None else None
+    return float(sample)
+
+
+def run_interleaved(
+    variants: Sequence[Tuple[str, Callable[[], Sample]]],
+    reps: int = 3,
+    key: Optional[str] = None,
+    baseline: Optional[str] = None,
+    higher_is_better: bool = True,
+    log: Callable[[str], None] = _log,
+) -> Dict:
+    """Run every variant once per rep, in order, reps times; summarize.
+
+    ``variants`` is an ordered sequence of (name, thunk); a thunk
+    returns either a float or a dict of floats (then ``key`` names the
+    metric ratios are computed over). The baseline for ratios is the
+    first variant unless ``baseline`` names another.
+    """
+    names = [n for n, _ in variants]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate variant names: {names}")
+    base = baseline if baseline is not None else names[0]
+    if base not in names:
+        raise ValueError(f"baseline {base!r} not in variants {names}")
+    samples: Dict[str, List[Sample]] = {n: [] for n in names}
+    errors: Dict[str, List[str]] = {n: [] for n in names}
+    for rep in range(reps):
+        for name, thunk in variants:
+            t0 = time.perf_counter()
+            try:
+                sample = thunk()
+            except Exception as e:  # recorded, never a fake zero
+                errors[name].append(f"rep {rep}: {type(e).__name__}: {e}")
+                log(f"ab[{rep + 1}/{reps}] {name}: ERROR {e}")
+                continue
+            samples[name].append(sample)
+            m = _metric(sample, key)
+            log(f"ab[{rep + 1}/{reps}] {name}: "
+                + (f"{key}={m}" if key else f"{m}")
+                + f" ({time.perf_counter() - t0:.1f}s)")
+    summary: Dict[str, Dict] = {}
+    for name in names:
+        vals = [m for m in (_metric(s, key) for s in samples[name])
+                if m is not None]
+        if not vals:
+            continue
+        summary[name] = {
+            "median": round(median(vals), 2),
+            "best": round(max(vals) if higher_is_better else min(vals), 2),
+            "all": [round(v, 2) for v in vals],
+        }
+    ratios: Dict[str, Optional[float]] = {}
+    if base in summary and summary[base]["median"]:
+        for name in names:
+            if name == base or name not in summary:
+                continue
+            ratios[name] = round(
+                summary[name]["median"] / summary[base]["median"], 2)
+    return {
+        "interleaved": True,
+        "reps": reps,
+        "order": names,
+        "metric": key,
+        "baseline": base,
+        "samples": samples,
+        "summary": summary,
+        f"ratio_vs_{base}": ratios,
+        "errors": {n: e for n, e in errors.items() if e},
+    }

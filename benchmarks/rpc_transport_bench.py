@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""RPC byte-layer microbench: echo throughput per transport.
+
+The 3-replica bench measures the whole serving path, where (after the
+round-6 pipelining) follower apply + WAL fsync dominate and the byte
+layer is a minority cost. THIS bench isolates the layer this round made
+pluggable: one in-process echo server, K concurrent callers issuing
+small calls as fast as they resolve, interleaved A/B across
+tcp/uds/loopback (benchmarks/ab_runner.py). It also reports the uds
+transport's coalescing counters — frames per sendmsg/recv syscall — the
+mechanism behind the win, not just its effect.
+
+    python -m benchmarks.rpc_transport_bench --calls 3000 --concurrency 64
+
+Emits JSON with calls_per_sec per transport, ratios vs tcp, and
+frames_per_sendmsg / frames_per_recv for the vectored path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.ab_runner import host_calibration, run_interleaved  # noqa: E402
+
+TRANSPORTS = ("tcp", "uds", "loopback")
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+class _EchoHandler:
+    async def handle_echo(self, payload: str = "", blob: bytes = b""):
+        return {"payload": payload, "n": len(blob)}
+
+
+async def _drive(port: int, calls: int, concurrency: int,
+                 value_bytes: int) -> dict:
+    from rocksplicator_tpu.rpc.client import RpcClient
+
+    client = RpcClient("127.0.0.1", port)
+    await client.connect()
+    blob = b"x" * value_bytes
+    sem = asyncio.Semaphore(concurrency)
+    done = 0
+
+    async def one(i: int):
+        nonlocal done
+        async with sem:
+            r = await client.call("echo", {"payload": f"c{i}", "blob": blob})
+            assert r["n"] == value_bytes
+            done += 1
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one(i) for i in range(calls)))
+    elapsed = time.perf_counter() - t0
+    conn = client._conn
+    coalesce = {}
+    if hasattr(conn, "sendmsg_calls") and conn.sendmsg_calls:
+        coalesce = {
+            "frames_sent": conn.frames_sent,
+            "sendmsg_calls": conn.sendmsg_calls,
+            "frames_per_sendmsg": round(
+                conn.frames_sent / conn.sendmsg_calls, 1),
+            "frames_received": conn.frames_received,
+            "recv_calls": conn.recv_calls,
+            "frames_per_recv": round(
+                conn.frames_received / max(1, conn.recv_calls), 1),
+        }
+    scheme = client.transport_scheme
+    await client.close()
+    return {
+        "transport": scheme,
+        "calls": done,
+        "calls_per_sec": round(done / elapsed, 1),
+        **coalesce,
+    }
+
+
+def run_one(transport: str, calls: int, concurrency: int,
+            value_bytes: int) -> dict:
+    """One echo run: server + client in this process under the policy.
+    A fresh event loop per run keeps loopback registry/loop pairing
+    clean across interleaved reps."""
+    os.environ["RSTPU_TRANSPORT"] = transport
+
+    async def serve_and_drive():
+        from rocksplicator_tpu.rpc.ioloop import IoLoop
+        from rocksplicator_tpu.rpc.server import RpcServer
+
+        # the server's IoLoop is THIS loop: run its async start directly
+        srv = RpcServer(port=0, host="127.0.0.1")
+        srv.add_handler(_EchoHandler())
+        await srv._start_async()
+        try:
+            res = await _drive(srv.port, calls, concurrency, value_bytes)
+        finally:
+            await srv._stop_async()
+        return res
+
+    return asyncio.run(serve_and_drive())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--calls", type=int, default=3000)
+    ap.add_argument("--concurrency", type=int, default=64)
+    ap.add_argument("--value_bytes", type=int, default=1024)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--transports", default="tcp,uds,loopback")
+    ap.add_argument("--out",
+                    default="benchmarks/results/rpc_transport_bench.json")
+    args = ap.parse_args()
+
+    names = [t.strip() for t in args.transports.split(",") if t.strip()]
+    for t in names:
+        if t not in TRANSPORTS:
+            ap.error(f"unknown transport {t!r}")
+    saved = os.environ.get("RSTPU_TRANSPORT")
+    try:
+        ab = run_interleaved(
+            [(t, (lambda t=t: run_one(
+                t, args.calls, args.concurrency, args.value_bytes)))
+             for t in names],
+            reps=args.reps, key="calls_per_sec", log=log)
+    finally:
+        if saved is None:
+            os.environ.pop("RSTPU_TRANSPORT", None)
+        else:
+            os.environ["RSTPU_TRANSPORT"] = saved
+    with tempfile.TemporaryDirectory() as td:
+        calib = host_calibration(td)
+    result = {
+        "bench": "rpc_transport_echo",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "config": {
+            "calls": args.calls, "concurrency": args.concurrency,
+            "value_bytes": args.value_bytes, "transports": names,
+            "topology": "echo server + client, one process, one loop",
+        },
+        "ab": ab,
+        "host_calibration": calib,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({
+        "calls_per_sec_median": {
+            n: s.get("median") for n, s in ab.get("summary", {}).items()},
+        **{k: v for k, v in ab.items() if k.startswith("ratio_vs_")},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

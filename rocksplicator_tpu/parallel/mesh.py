@@ -90,7 +90,14 @@ def sharded_compaction_step(mesh, model=None):
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    try:
+        from jax import shard_map
+
+        replication_check = {"check_vma": False}
+    except ImportError:  # pre-0.5 jax: experimental namespace + old kwarg
+        from jax.experimental.shard_map import shard_map
+
+        replication_check = {"check_rep": False}
     from jax.sharding import PartitionSpec as P
 
     from ..models.compaction_model import CompactionModel
@@ -213,7 +220,7 @@ def sharded_compaction_step(mesh, model=None):
             P(None, None),
             P(None, None),
         ),
-        check_vma=False,
+        **replication_check,
     )
     return jax.jit(step)
 

@@ -93,7 +93,12 @@ def _print_ack_window_depth(snap) -> None:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--role", choices=["leader", "follower"], required=True)
+    p.add_argument("--role", choices=["leader", "follower", "cluster"],
+                   required=True,
+                   help="cluster = leader + 2 followers COLOCATED in this "
+                        "process (one IoLoop): the in-process loopback "
+                        "transport's deployment shape, also a syscall-"
+                        "free ceiling for uds/tcp on noisy hosts")
     p.add_argument("--port", type=int, required=True)
     p.add_argument("--upstream_ip", default="127.0.0.1")
     p.add_argument("--upstream_port", type=int, default=0)
@@ -154,19 +159,22 @@ def main(argv=None) -> int:
             sample_rate=args.trace_rate, capacity=1 << 15,
             process=f"{args.role}:{args.port}")
 
+    is_cluster = args.role == "cluster"
     replicator = Replicator(
         port=args.port,
         flags=ReplicationFlags(write_window=max(1, args.write_window)),
         executor_threads=max(1, args.executor_threads),
     )
     dbs = {}
-    role = ReplicaRole.LEADER if args.role == "leader" else ReplicaRole.FOLLOWER
+    role = (ReplicaRole.FOLLOWER if args.role == "follower"
+            else ReplicaRole.LEADER)
     upstream = (
         (args.upstream_ip, args.upstream_port) if args.upstream_port else None
     )
+    leader_dir = os.path.join(args.db_dir, "l") if is_cluster else args.db_dir
     for shard in range(args.num_shards):
         name = f"perf{shard:05d}"
-        db = DB(os.path.join(args.db_dir, name),
+        db = DB(os.path.join(leader_dir, name),
                 DBOptions(wal_ttl_seconds=3600.0))
         dbs[name] = db
         replicator.add_db(
@@ -175,6 +183,36 @@ def main(argv=None) -> int:
         )
     print(f"{args.role}: {args.num_shards} shards on :{replicator.port}",
           flush=True)
+
+    # colocated followers AFTER the leader is serving: their pullers
+    # connect immediately instead of sitting in connect backoff (all
+    # three replicators share IoLoop.default(), which is what makes the
+    # in-process loopback transport resolvable between them)
+    follower_reps = []
+    follower_dbs = []
+    if is_cluster:
+        for fi in (1, 2):
+            rep = Replicator(
+                port=args.port + fi,
+                flags=ReplicationFlags(
+                    write_window=max(1, args.write_window)),
+                executor_threads=max(1, args.executor_threads),
+            )
+            fdbs = {}
+            for shard in range(args.num_shards):
+                name = f"perf{shard:05d}"
+                db = DB(os.path.join(args.db_dir, f"f{fi}", name),
+                        DBOptions(wal_ttl_seconds=3600.0))
+                fdbs[name] = db
+                rep.add_db(
+                    name, StorageDbWrapper(db), ReplicaRole.FOLLOWER,
+                    upstream_addr=("127.0.0.1", args.port),
+                    replication_mode=args.replication_mode,
+                )
+            follower_reps.append(rep)
+            follower_dbs.append(fdbs)
+        print(f"cluster: 2 colocated followers on "
+              f":{args.port + 1} :{args.port + 2}", flush=True)
 
     if args.role == "follower":
         try:
@@ -320,12 +358,34 @@ def main(argv=None) -> int:
         flush=True,
     )
     print(
-        f"leader wrote ~{total_bytes / 1e6:.1f} MB in {elapsed:.1f}s = "
+        f"leader wrote ~{total_bytes / 1e6:.1f} MB in {elapsed:.3f}s = "
         f"{total_bytes / elapsed / 1e6:.2f} MB/s",
         flush=True,
     )
     print(Stats.get().dump_text(), flush=True)
-    if args.linger_sec:
+    if is_cluster:
+        # colocated followers: poll convergence in-process instead of
+        # lingering blind; the printed lines match what the 3-process
+        # bench parses from separate follower stdouts
+        want = total_writes
+        deadline = time.monotonic() + max(1, args.linger_sec)
+        while time.monotonic() < deadline:
+            totals = [
+                sum(db.latest_sequence_number() for db in fdbs.values())
+                for fdbs in follower_dbs
+            ]
+            for i, tot in enumerate(totals):
+                print(f"follower{i} total seq: {tot}", flush=True)
+            if all(tot >= want for tot in totals):
+                print("cluster converged", flush=True)
+                break
+            time.sleep(0.2)
+        for rep in follower_reps:
+            rep.stop()
+        for fdbs in follower_dbs:
+            for db in fdbs.values():
+                db.close()
+    elif args.linger_sec:
         print(f"leader lingering {args.linger_sec}s for follower catch-up",
               flush=True)
         time.sleep(args.linger_sec)

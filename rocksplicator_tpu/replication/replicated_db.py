@@ -34,7 +34,8 @@ from typing import Callable, Iterator, List, Optional, Tuple
 from ..observability.context import wire_context
 from ..observability.span import Span, start_span
 from ..rpc.client_pool import RpcClientPool
-from ..rpc.errors import RpcApplicationError, RpcConnectionError, RpcError
+from ..rpc.errors import (RpcApplicationError, RpcConnectionError, RpcError,
+                          RpcTransportConfigError)
 from ..storage.records import WriteBatch, decode_batch, scan_batch_meta
 from ..testing import failpoints as fp
 from ..utils.misc import now_ms
@@ -659,6 +660,19 @@ class ReplicatedDB:
                 self._conn_errors = 0
                 if e.code == ReplicateErrorCode.SOURCE_NOT_FOUND.value:
                     await self._maybe_reset_upstream(force_sample=False)
+                await self._pull_error_delay()
+            except RpcTransportConfigError as e:
+                # a MISCONFIG, not a connection error: loud (ERROR, not
+                # the routine pull warning), never escalated to the
+                # leader resolver, and retried only on the growing
+                # backoff — faster retries cannot heal a bad transport
+                # config, but the loop stays alive so reset_upstream /
+                # changeDBRoleAndUpStream can repoint past it
+                await self._drain_pending_apply()
+                self._stats.incr(M["pull_errors"])
+                self._conn_errors = 0
+                log.error("%s: transport misconfig pulling from %s: %s",
+                          self.name, self.upstream_addr, e)
                 await self._pull_error_delay()
             except (RpcError, Exception) as e:
                 await self._drain_pending_apply()

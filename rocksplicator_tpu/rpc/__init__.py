@@ -11,18 +11,27 @@ wire format is a length-prefixed frame with a JSON header and a raw binary
 payload region so WAL update bytes travel without copies or base64.
 """
 
-from .framing import FrameReader, write_frame
+from .framing import FrameBuffer, FrameReader, write_frame
 from .serde import encode_message, decode_message
-from .errors import RpcError, RpcTimeout, RpcConnectionError, RpcApplicationError
+from .errors import (RpcError, RpcTimeout, RpcConnectionError,
+                     RpcApplicationError, RpcTransportConfigError)
 from .ioloop import IoLoop
+from .transport import (Endpoint, Connection, Transport, get_transport,
+                        parse_endpoint, resolve_endpoint, transport_policy,
+                        uds_path_for_port)
 from .client import RpcClient
 from .client_pool import RpcClientPool
 from .server import RpcServer
 from .router import RpcRouter, ClusterLayout, Role, Quantity
 
 __all__ = [
-    "FrameReader", "write_frame", "encode_message", "decode_message",
+    "FrameBuffer", "FrameReader", "write_frame",
+    "encode_message", "decode_message",
     "RpcError", "RpcTimeout", "RpcConnectionError", "RpcApplicationError",
+    "RpcTransportConfigError",
+    "Endpoint", "Connection", "Transport", "get_transport",
+    "parse_endpoint", "resolve_endpoint", "transport_policy",
+    "uds_path_for_port",
     "IoLoop", "RpcClient", "RpcClientPool", "RpcServer",
     "RpcRouter", "ClusterLayout", "Role", "Quantity",
 ]

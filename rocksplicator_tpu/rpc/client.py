@@ -3,8 +3,9 @@
 Reference: common/thrift_client_pool.h:107-142 — ``ClientStatusCallback``
 tracks ``is_good`` via close/connectError callbacks; requests are
 multiplexed on a header channel. Here: request ids multiplex concurrent
-calls on one TCP stream; ``is_good`` flips false on connection errors and
-the pool handles reconnect throttling.
+calls on one transport connection (tcp/uds/loopback — transport.py);
+``is_good`` flips false on connection errors and the pool handles
+reconnect throttling.
 """
 
 from __future__ import annotations
@@ -16,9 +17,10 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from ..testing import failpoints as fp
-from .errors import RpcApplicationError, RpcConnectionError, RpcTimeout
-from .framing import FrameReader, write_frame
+from .errors import (RpcApplicationError, RpcConnectionError, RpcTimeout,
+                     RpcTransportConfigError)
 from .serde import decode_message, encode_message
+from .transport import Connection, get_transport, resolve_endpoint
 from ..observability.context import TRACE_KEY
 from ..observability.span import start_span
 
@@ -34,38 +36,53 @@ class RpcClient:
         self.port = port
         self._connect_timeout = connect_timeout
         self._ssl_manager = ssl_manager
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
+        self._conn: Optional[Connection] = None
         self._pending: Dict[int, asyncio.Future] = {}
         self._ids = itertools.count(1)
         self._recv_task: Optional[asyncio.Task] = None
-        self._write_lock = asyncio.Lock()
         self.is_good = False
         self.last_connect_attempt = 0.0
+        # a remembered RpcTransportConfigError from the last connect: the
+        # pool's reconnect throttle re-raises it as itself, so a misconfig
+        # is never laundered into a throttled RpcConnectionError
+        self.last_connect_config_error: Optional[Exception] = None
 
     @property
     def addr(self) -> Tuple[str, int]:
         return (self.host, self.port)
 
+    @property
+    def transport_scheme(self) -> Optional[str]:
+        """The connected transport's scheme (None before connect)."""
+        return self._conn.scheme if self._conn is not None else None
+
     async def connect(self) -> None:
         self.last_connect_attempt = time.monotonic()
+        self.last_connect_config_error = None
+        # endpoint resolution is per-connect: an explicit URL in ``host``
+        # wins, else the RSTPU_TRANSPORT policy applies. A transport
+        # MISCONFIG (RpcTransportConfigError) propagates as itself —
+        # reconnect machinery must not retry it into oblivion.
+        try:
+            ep = resolve_endpoint(self.host, self.port,
+                                  ssl=self._ssl_manager is not None)
+            transport = get_transport(ep.scheme)
+        except RpcTransportConfigError as e:
+            self.last_connect_config_error = e
+            raise
         try:
             # inside the except net: a tripped fail policy surfaces as
             # RpcConnectionError, a delay policy is a stuck connect
             await fp.async_hit("rpc.connect")
-            self._reader, self._writer = await asyncio.wait_for(
-                asyncio.open_connection(
-                    self.host, self.port,
-                    ssl=(self._ssl_manager.get()
-                         if self._ssl_manager else None),
-                ),
+            self._conn = await asyncio.wait_for(
+                transport.connect(ep, ssl_manager=self._ssl_manager),
                 self._connect_timeout,
             )
         except (OSError, asyncio.TimeoutError) as e:
             # (ssl.SSLError is an OSError subclass: handshake failures
             # funnel into RpcConnectionError too)
             self.is_good = False
-            raise RpcConnectionError(f"connect {self.host}:{self.port}: {e}") from e
+            raise RpcConnectionError(f"connect {ep}: {e}") from e
         if self._ssl_manager is not None:
             # role binding: the peer must hold a SERVER cert — CA
             # membership alone would let any cluster client cert
@@ -75,36 +92,35 @@ class RpcClient:
 
             try:
                 check_peer_role(
-                    self._writer.get_extra_info("ssl_object"), "server")
+                    self._conn.get_extra_info("ssl_object"), "server")
             except PeerRoleError as e:
-                self._writer.close()
+                self._conn.close()
                 self.is_good = False
-                raise RpcConnectionError(
-                    f"connect {self.host}:{self.port}: {e}") from e
+                raise RpcConnectionError(f"connect {ep}: {e}") from e
         self.is_good = True
         self._recv_task = asyncio.ensure_future(self._recv_loop())
 
     async def _recv_loop(self) -> None:
-        assert self._reader is not None
-        reader = FrameReader(self._reader)
+        assert self._conn is not None
+        conn = self._conn
         try:
             while True:
-                header, payload = await reader.read_frame()
-                msg = decode_message(header, payload)
-                fut = self._pending.pop(msg.get("id"), None)
-                if fut is None or fut.done():
-                    continue
-                if msg.get("ok"):
-                    fut.set_result(msg.get("result"))
-                else:
-                    err = msg.get("error") or {}
-                    fut.set_exception(
-                        RpcApplicationError(
-                            err.get("code", "UNKNOWN"),
-                            err.get("message", ""),
-                            err.get("data"),
+                for header, payload in await conn.recv_frames():
+                    msg = decode_message(header, payload)
+                    fut = self._pending.pop(msg.get("id"), None)
+                    if fut is None or fut.done():
+                        continue
+                    if msg.get("ok"):
+                        fut.set_result(msg.get("result"))
+                    else:
+                        err = msg.get("error") or {}
+                        fut.set_exception(
+                            RpcApplicationError(
+                                err.get("code", "UNKNOWN"),
+                                err.get("message", ""),
+                                err.get("data"),
+                            )
                         )
-                    )
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
             self._fail_pending(RpcConnectionError(f"connection lost: {e}"))
         except asyncio.CancelledError:
@@ -143,9 +159,12 @@ class RpcClient:
                 msg[TRACE_KEY] = sp.to_wire()
             header, chunks = encode_message(msg)
             try:
-                async with self._write_lock:
-                    assert self._writer is not None
-                    await write_frame(self._writer, header, chunks)
+                conn = self._conn
+                assert conn is not None
+                # no caller-side write lock: connections guarantee frame
+                # atomicity + FIFO under concurrent senders, which lets
+                # the vectored transports coalesce concurrent calls
+                await conn.send_frames([(header, chunks)])
             except (ConnectionError, OSError) as e:
                 self.is_good = False
                 self._pending.pop(req_id, None)
@@ -169,10 +188,10 @@ class RpcClient:
             except (asyncio.CancelledError, Exception):
                 pass
             self._recv_task = None
-        if self._writer is not None:
-            self._writer.close()
+        if self._conn is not None:
+            self._conn.close()
             try:
-                await self._writer.wait_closed()
+                await self._conn.wait_closed()
             except (ConnectionError, OSError):
                 pass
-            self._writer = None
+            self._conn = None

@@ -14,7 +14,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from .client import RpcClient
-from .errors import RpcConnectionError
+from .errors import RpcConnectionError, RpcTransportConfigError
 from ..observability.span import start_span
 
 RECONNECT_THROTTLE_SEC = 1.0
@@ -62,6 +62,14 @@ class RpcClientPool:
                     and time.monotonic() - client.last_connect_attempt
                     < RECONNECT_THROTTLE_SEC
                 ):
+                    # the throttle must not re-classify the failure: a
+                    # remembered misconfig stays RpcTransportConfigError
+                    # (callers like the pull loop route it away from the
+                    # leader-resolver escalation path)
+                    if client.last_connect_config_error is not None:
+                        raise RpcTransportConfigError(
+                            f"{host}:{port} throttled after transport "
+                            f"misconfig: {client.last_connect_config_error}")
                     raise RpcConnectionError(
                         f"{host}:{port} recently failed; throttled"
                     )

@@ -17,6 +17,13 @@ class RpcConnectionError(RpcError):
     pass
 
 
+class RpcTransportConfigError(RpcError):
+    """A transport misconfiguration — unknown ``RSTPU_TRANSPORT`` value,
+    an endpoint URL with an unregistered scheme, or a transport that
+    cannot apply (e.g. TLS over a non-TCP byte layer). Deliberately NOT
+    a connection error: retry/reconnect machinery must not mask it."""
+
+
 class RpcApplicationError(RpcError):
     """A typed error raised by the remote handler (thrift exception
     equivalent). ``code`` is an application-defined error code; ``data``

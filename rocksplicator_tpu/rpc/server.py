@@ -5,6 +5,15 @@ Reference: the fbthrift ThriftServer hosting e.g. the ``Replicator`` service
 Handlers are objects exposing ``async def handle_<method>(self, **args)``;
 raising RpcApplicationError maps to a typed error frame (thrift exception
 equivalent). CPU-bound work should be pushed to an executor by the handler.
+
+The byte layer is pluggable (transport.py): the server always binds its
+TCP port (the port is the cluster-wide identity — shard maps and
+upstream addresses carry it), and under the ``RSTPU_TRANSPORT`` policy
+ALSO serves the derived fast-path endpoints for that port — the
+per-port unix socket (``uds``) and/or the in-process loopback key
+(``loopback``) — so clients resolving the same (host, port) address
+under the same policy land on the fast path while stray tcp clients
+still work. Explicit extra endpoints may be passed as URL strings.
 """
 
 from __future__ import annotations
@@ -12,12 +21,20 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
-from .errors import RpcApplicationError
-from .framing import FrameReader, write_frame
+from .errors import RpcApplicationError, RpcTransportConfigError
 from .ioloop import IoLoop
 from .serde import decode_message, encode_message
+from .transport import (
+    Connection,
+    Endpoint,
+    TcpConnection,
+    get_transport,
+    parse_endpoint,
+    transport_policy,
+    uds_path_for_port,
+)
 from ..observability.context import TRACE_KEY
 from ..observability.span import start_span
 from ..utils.stats import Stats
@@ -26,7 +43,8 @@ log = logging.getLogger(__name__)
 
 
 class RpcServer:
-    """Serves one or more handler objects on a TCP port.
+    """Serves one or more handler objects on a TCP port (plus any
+    policy-derived or explicit fast-path endpoints).
 
     Multiple handlers may be stacked (e.g. an application handler extending
     the Admin service — counter.thrift's ``service Counter extends Admin``);
@@ -34,12 +52,15 @@ class RpcServer:
     """
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
-                 ioloop: Optional[IoLoop] = None, ssl_manager=None):
+                 ioloop: Optional[IoLoop] = None, ssl_manager=None,
+                 endpoints: Optional[List[str]] = None):
         self._host = host
         self._port = port
         self._ioloop = ioloop or IoLoop.default()
         self._handlers: list = []
         self._server: Optional[asyncio.AbstractServer] = None
+        self._extra_endpoints = list(endpoints or [])
+        self._extra_listeners: list = []
         self._ready = threading.Event()
         # connection task -> its in-flight dispatch-task set (one structure
         # serves both teardown cancellation and graceful drain)
@@ -61,11 +82,30 @@ class RpcServer:
     def port(self) -> int:
         return self._port
 
+    def serving_endpoints(self) -> List[str]:
+        """Every endpoint this server currently accepts on (tcp first)."""
+        eps = [f"tcp://{self._host}:{self._port}"]
+        for lst in self._extra_listeners:
+            if getattr(lst, "path", None):
+                eps.append(f"uds://{lst.path}")
+            elif getattr(lst, "key", None):
+                eps.append(f"loopback://{lst.key}")
+        return eps
+
     # -- lifecycle --------------------------------------------------------
 
     def start(self) -> None:
         """Start serving (callable from any thread); blocks until bound."""
-        self._ioloop.run_sync(self._start_async())
+        try:
+            self._ioloop.run_sync(self._start_async())
+        except Exception:
+            # a failed start has no stop() to pair with: drop this
+            # server's refresh-thread claim here (outside the loop,
+            # mirroring stop())
+            if self._ssl_manager is not None and self._ssl_claimed:
+                self._ssl_claimed = False
+                self._ssl_manager.release_auto_refresh()
+            raise
 
     async def _start_async(self) -> None:
         self._draining = False  # a restarted server serves again
@@ -73,7 +113,7 @@ class RpcServer:
         if self._ssl_manager is not None:
             ssl_ctx = self._ssl_manager.get()
         self._server = await asyncio.start_server(
-            self._on_connection, self._host, self._port, ssl=ssl_ctx,
+            self._on_tcp_connection, self._host, self._port, ssl=ssl_ctx,
         )
         if self._ssl_manager is not None and not self._ssl_claimed:
             # claim the refresh thread only for a server that actually
@@ -83,7 +123,48 @@ class RpcServer:
             self._ssl_manager.ensure_auto_refresh()
             self._ssl_claimed = True
         self._port = self._server.sockets[0].getsockname()[1]
+        try:
+            await self._start_extra_listeners()
+        except Exception:
+            # a half-started server must not keep accepting: the tcp
+            # listener is already bound (and some extras may be up) when
+            # an extra listener fails — close them before propagating so
+            # start() raising leaves nothing serving
+            self._server.close()
+            self._server = None
+            for listener in self._extra_listeners:
+                listener.close()
+            self._extra_listeners.clear()
+            raise
         self._ready.set()
+
+    async def _start_extra_listeners(self) -> None:
+        """Fast-path listeners: the policy-derived endpoints for this
+        port plus any explicit endpoint URLs. TLS pins tcp — a TLS
+        server never exposes a plaintext side channel."""
+        eps: List[Endpoint] = []
+        if self._ssl_manager is not None:
+            if self._extra_endpoints:
+                # refuse loudly rather than silently dropping a listener
+                # the operator asked for: a TLS server must not expose a
+                # plaintext side channel, and a config accepted-but-
+                # ignored would read as the fast path being up
+                raise RpcTransportConfigError(
+                    "TLS requires the tcp transport: explicit extra "
+                    f"endpoints {self._extra_endpoints!r} cannot be "
+                    "served by a TLS server")
+        else:
+            policy = transport_policy()
+            if policy == "uds":
+                eps.append(Endpoint(
+                    "uds", path=uds_path_for_port(self._port)))
+            elif policy == "loopback":
+                eps.append(Endpoint("loopback", key=str(self._port)))
+            eps.extend(parse_endpoint(u) for u in self._extra_endpoints)
+        for ep in eps:
+            listener = await get_transport(ep.scheme).accept(
+                ep, self._serve_connection)
+            self._extra_listeners.append(listener)
 
     def stop(self, drain_timeout: float = 0.0) -> None:
         """Stop serving. ``drain_timeout`` > 0 gives in-flight requests
@@ -113,6 +194,8 @@ class RpcServer:
         self._draining = True
         if self._server is not None:
             self._server.close()
+        for listener in self._extra_listeners:
+            listener.close()
         if drain_timeout > 0:
             deadline = asyncio.get_running_loop().time() + drain_timeout
             while (
@@ -126,16 +209,18 @@ class RpcServer:
         for task in list(self._connections):
             if task is not None:
                 task.cancel()
+        for listener in self._extra_listeners:
+            await listener.wait_closed()
+        self._extra_listeners = []
         if self._server is not None:
             await self._server.wait_closed()
             self._server = None
 
     # -- connection handling ---------------------------------------------
 
-    async def _on_connection(
+    async def _on_tcp_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        task = asyncio.current_task()
         if self._ssl_manager is not None:
             # role binding: a connecting peer presenting a cert must hold
             # a CLIENT cert (utils/ssl_context_manager.check_peer_role)
@@ -149,21 +234,25 @@ class RpcServer:
                 log.warning("rejecting connection: %s", e)
                 writer.close()
                 return
-        frame_reader = FrameReader(reader)
-        write_lock = asyncio.Lock()
+        await self._serve_connection(TcpConnection(reader, writer))
+
+    async def _serve_connection(self, conn: Connection) -> None:
+        """Transport-agnostic per-connection serve loop (every transport's
+        accept path funnels here)."""
+        task = asyncio.current_task()
         inflight: set = set()
         self._connections[task] = inflight
         try:
             while True:
-                header, payload = await frame_reader.read_frame()
-                msg = decode_message(header, payload)
-                # Each request runs as its own task so slow handlers (e.g.
-                # long-poll replicate) don't block the connection.
-                t = asyncio.ensure_future(
-                    self._dispatch(msg, writer, write_lock)
-                )
-                inflight.add(t)
-                t.add_done_callback(inflight.discard)
+                frames = await conn.recv_frames()
+                for header, payload in frames:
+                    msg = decode_message(header, payload)
+                    # Each request runs as its own task so slow handlers
+                    # (e.g. long-poll replicate) don't block the
+                    # connection.
+                    t = asyncio.ensure_future(self._dispatch(msg, conn))
+                    inflight.add(t)
+                    t.add_done_callback(inflight.discard)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         except asyncio.CancelledError:
@@ -174,18 +263,14 @@ class RpcServer:
             for t in inflight:
                 t.cancel()
             self._connections.pop(task, None)
-            writer.close()
+            conn.close()
             try:
-                await writer.wait_closed()
+                await conn.wait_closed()
             except (ConnectionError, OSError):
                 pass
 
-    async def _dispatch(
-        self,
-        msg: Dict[str, Any],
-        writer: asyncio.StreamWriter,
-        write_lock: asyncio.Lock,
-    ) -> None:
+    async def _dispatch(self, msg: Dict[str, Any],
+                        conn: Connection) -> None:
         req_id = msg.get("id")
         method = msg.get("method", "")
         args = msg.get("args") or {}
@@ -226,8 +311,9 @@ class RpcServer:
                 stats.incr(f"rpc.{method}.internal_error")
             header, chunks = encode_message(reply)
             try:
-                async with write_lock:
-                    await write_frame(writer, header, chunks)
+                # replies from concurrent dispatches coalesce in the
+                # transport (no per-connection write lock needed)
+                await conn.send_frames([(header, chunks)])
             except (ConnectionError, OSError):
                 pass
 

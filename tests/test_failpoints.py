@@ -303,6 +303,167 @@ def test_torn_replication_frame_reconnects_no_half_apply(hosts):
         "storm never actually tore a frame"
 
 
+@pytest.fixture(params=["tcp", "uds", "loopback"])
+def transport_hosts(request, tmp_path, monkeypatch):
+    """Host factory with the RSTPU_TRANSPORT policy pinned BEFORE any
+    Replicator exists — the whole replication plane (server listeners,
+    pull clients, ack pushes) then runs on the parameterized transport."""
+    monkeypatch.setenv("RSTPU_TRANSPORT", request.param)
+    created = []
+
+    def make(name):
+        h = Host(tmp_path, name, FAST)
+        created.append(h)
+        return h
+
+    yield make, request.param
+    for h in created:
+        h.stop()
+
+
+def test_torn_frame_matrix_reconnects_no_half_apply(transport_hosts):
+    """The ISSUE-6 transport matrix: tear frames on the replication wire
+    over EACH byte transport (tcp stream, vectored uds, in-process
+    loopback) and verify identical failure semantics — the puller
+    reconnects and reconverges byte-exact, never a hang, never a
+    half-applied batch (the seq-continuity guard would wedge the puller
+    forever if a partial batch applied)."""
+    from rocksplicator_tpu.replication.wire import ReplicaRole
+
+    make, transport = transport_hosts
+    leader, follower = make("leader"), make("follower")
+    ldb, _ = leader.add_db("seg00001", ReplicaRole.LEADER)
+    fdb, _ = follower.add_db(
+        "seg00001", ReplicaRole.FOLLOWER, upstream=leader.addr)
+    for i in range(10):
+        ldb.put(b"w%04d" % i, b"v%04d" % i)
+    assert wait_until(
+        lambda: fdb.latest_sequence_number() == 10, timeout=15)
+    # the fast path actually engaged (policy reached the byte layer)
+    pool = follower.replicator._pool
+    pulls = [c for c in pool._clients.values() if c._conn is not None]
+    assert pulls and all(
+        c.transport_scheme == transport for c in pulls), (
+        transport, [c.transport_scheme for c in pulls])
+    # now tear ~every other frame for a while (requests AND responses)
+    fp.activate("rpc.frame.send", "torn:0.5@seed11")
+    for i in range(10, 40):
+        ldb.put(b"w%04d" % i, b"v%04d" % i)
+    time.sleep(0.5)
+    fp.deactivate("rpc.frame.send")
+    assert wait_until(
+        lambda: fdb.latest_sequence_number()
+        == ldb.latest_sequence_number(), timeout=30), \
+        f"[{transport}] follower never converged after torn-frame storm"
+    for i in range(40):
+        assert fdb.get(b"w%04d" % i) == b"v%04d" % i, \
+            f"[{transport}] divergent value after reconvergence"
+    assert fp.trip_counts().get("rpc.frame.send", 0) > 0, \
+        f"[{transport}] storm never actually tore a frame"
+
+
+def test_recv_fault_matrix_reconnects(transport_hosts):
+    """rpc.frame.recv fail_prob on each transport: receive-side faults
+    kill the connection cleanly and replication recovers."""
+    from rocksplicator_tpu.replication.wire import ReplicaRole
+
+    make, transport = transport_hosts
+    leader, follower = make("leader"), make("follower")
+    ldb, _ = leader.add_db("seg00001", ReplicaRole.LEADER)
+    fdb, _ = follower.add_db(
+        "seg00001", ReplicaRole.FOLLOWER, upstream=leader.addr)
+    for i in range(5):
+        ldb.put(b"a%04d" % i, b"v%04d" % i)
+    assert wait_until(lambda: fdb.latest_sequence_number() == 5, timeout=15)
+    # deterministic: the puller's 2nd recv dies (a probabilistic policy
+    # may legitimately draw no trip in a short storm window)
+    fp.activate("rpc.frame.recv", "fail_nth:2")
+    for i in range(5, 25):
+        ldb.put(b"a%04d" % i, b"v%04d" % i)
+    assert wait_until(
+        lambda: fp.trip_counts().get("rpc.frame.recv", 0) > 0, timeout=10), \
+        f"[{transport}] recv failpoint never tripped"
+    fp.deactivate("rpc.frame.recv")
+    assert wait_until(
+        lambda: fdb.latest_sequence_number()
+        == ldb.latest_sequence_number(), timeout=30), \
+        f"[{transport}] no reconvergence after recv-fault storm"
+    assert fp.trip_counts().get("rpc.frame.recv", 0) > 0
+
+
+def test_torn_frame_unit_semantics_uds():
+    """Transport-level torn contract on the vectored uds connection: the
+    sender sees a failed send (FailpointError/OSError), the receiver a
+    clean decode error or EOF — never a partial frame handed up."""
+    import socket as socket_mod
+
+    from rocksplicator_tpu.rpc import transport as tr
+
+    async def go():
+        a, b = socket_mod.socketpair(socket_mod.AF_UNIX,
+                                     socket_mod.SOCK_STREAM)
+        loop = asyncio.get_event_loop()
+        left, right = tr.UdsConnection(a, loop), tr.UdsConnection(b, loop)
+        # a full frame, then a torn one: the good frame must decode, the
+        # tear must surface as a dead stream
+        await left.send_frames([(b'{"id":1}', [b"ok"])])
+        fp.activate("rpc.frame.send", "torn:1.0@seed2,one_shot")
+        with pytest.raises(fp.FailpointError):
+            await left.send_frames([(b'{"id":2}', [b"p" * 64])])
+        got = await right.recv_frames()
+        assert [(bytes(h), bytes(p)) for h, p in got] == [(b'{"id":1}',
+                                                           b"ok")]
+        with pytest.raises((asyncio.IncompleteReadError, ValueError,
+                            ConnectionError)):
+            while True:
+                await right.recv_frames()
+        left.close()
+        right.close()
+
+    asyncio.run(go())
+
+
+def test_torn_frame_unit_semantics_loopback():
+    from rocksplicator_tpu.rpc import transport as tr
+
+    async def go():
+        loop = asyncio.get_event_loop()
+        a, b = tr.LoopbackConnection(loop), tr.LoopbackConnection(loop)
+        a.peer, b.peer = b, a
+        await a.send_frames([(b'{"id":1}', [b"ok"])])
+        fp.activate("rpc.frame.send", "torn:1.0@seed2,one_shot")
+        with pytest.raises(fp.FailpointError):
+            await a.send_frames([(b'{"id":2}', [b"p" * 64])])
+        got = await b.recv_frames()
+        assert [(bytes(h), bytes(p)) for h, p in got] == [(b'{"id":1}',
+                                                           b"ok")]
+        with pytest.raises(ConnectionError):
+            await b.recv_frames()
+
+    asyncio.run(go())
+
+
+def test_short_frame_mid_prefix_uds_buffer():
+    """EOF mid-length-prefix on the vectored receive path: clean
+    IncompleteReadError, exactly like the stream FrameReader."""
+    import socket as socket_mod
+
+    from rocksplicator_tpu.rpc import transport as tr
+
+    async def go():
+        a, b = socket_mod.socketpair(socket_mod.AF_UNIX,
+                                     socket_mod.SOCK_STREAM)
+        loop = asyncio.get_event_loop()
+        right = tr.UdsConnection(b, loop)
+        a.sendall(b"\x54\x52\x00")  # 3 of the 12 prefix bytes
+        a.close()
+        with pytest.raises(asyncio.IncompleteReadError):
+            await right.recv_frames()
+        right.close()
+
+    asyncio.run(go())
+
+
 def test_stuck_connect_fails_over_to_retry(hosts):
     """fail_first on rpc.connect: the follower's first connect attempts
     die, the retry-policy backoff reconnects, replication proceeds."""

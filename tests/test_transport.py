@@ -1,0 +1,460 @@
+"""Pluggable RPC transport layer (ISSUE 6).
+
+Endpoint parsing / RSTPU_TRANSPORT selection / misconfig error paths,
+the vectored-uds frame coalescing (one sendmsg iovec per queue drain,
+multiple frames per recv_into), the in-process loopback transport, and
+cross-transport echo/binary/concurrency parity.
+"""
+
+import asyncio
+import os
+import socket
+import time
+
+import pytest
+
+from rocksplicator_tpu.rpc import (
+    IoLoop,
+    RpcClientPool,
+    RpcConnectionError,
+    RpcServer,
+    RpcTransportConfigError,
+)
+from rocksplicator_tpu.rpc.framing import FrameBuffer, encode_wire_parts
+from rocksplicator_tpu.rpc import transport as tr
+
+
+@pytest.fixture(autouse=True)
+def _clean_transport_env(monkeypatch):
+    monkeypatch.delenv("RSTPU_TRANSPORT", raising=False)
+    monkeypatch.delenv("RSTPU_UDS_DIR", raising=False)
+    yield
+
+
+class EchoHandler:
+    async def handle_echo(self, n=0, data=None):
+        return {"n": n, "data": bytes(data) if data is not None else None}
+
+    async def handle_sleep_ms(self, ms=0):
+        await asyncio.sleep(ms / 1000.0)
+        return {"slept": ms}
+
+
+def _run(coro, timeout=30):
+    return IoLoop.default().run_sync(coro, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# endpoint parsing + policy selection + misconfig
+# ---------------------------------------------------------------------------
+
+
+def test_parse_endpoint_urls():
+    ep = tr.parse_endpoint("tcp://10.1.2.3:9091")
+    assert (ep.scheme, ep.host, ep.port) == ("tcp", "10.1.2.3", 9091)
+    ep = tr.parse_endpoint("uds:///tmp/x.sock")
+    assert (ep.scheme, ep.path) == ("uds", "/tmp/x.sock")
+    ep = tr.parse_endpoint("loopback://9091")
+    assert (ep.scheme, ep.key) == ("loopback", "9091")
+    ep = tr.parse_endpoint("loop://svc-a")
+    assert (ep.scheme, ep.key) == ("loopback", "svc-a")
+
+
+@pytest.mark.parametrize("bad", [
+    "tcp://nohost", "tcp://h:notaport", "uds://", "loopback://",
+    "carrierpigeon://x:1",
+])
+def test_parse_endpoint_rejects_bad_urls(bad):
+    with pytest.raises(RpcTransportConfigError):
+        tr.parse_endpoint(bad)
+
+
+def test_policy_resolution(monkeypatch):
+    # default: tcp
+    assert tr.resolve_endpoint("127.0.0.1", 9091).scheme == "tcp"
+    # uds policy rewrites LOCAL addrs to the per-port socket path
+    monkeypatch.setenv("RSTPU_TRANSPORT", "uds")
+    ep = tr.resolve_endpoint("127.0.0.1", 9091)
+    assert ep.scheme == "uds" and ep.path == tr.uds_path_for_port(9091)
+    # ...but never a remote host (uds is same-host only)
+    assert tr.resolve_endpoint("10.9.9.9", 9091).scheme == "tcp"
+    monkeypatch.setenv("RSTPU_TRANSPORT", "loopback")
+    ep = tr.resolve_endpoint("127.0.0.1", 9091)
+    assert ep.scheme == "loopback" and ep.key == "9091"
+    # ...and like uds, never a remote host: the port-keyed loopback
+    # registry discards the host, so a remote addr must stay tcp
+    assert tr.resolve_endpoint("10.9.9.9", 9091).scheme == "tcp"
+    # explicit URL beats the policy
+    assert tr.resolve_endpoint("tcp://127.0.0.1:1", 1).scheme == "tcp"
+    # TLS pins tcp regardless of policy
+    assert tr.resolve_endpoint("127.0.0.1", 9091, ssl=True).scheme == "tcp"
+
+
+def test_unknown_policy_value_is_config_error(monkeypatch):
+    monkeypatch.setenv("RSTPU_TRANSPORT", "smoke-signals")
+    with pytest.raises(RpcTransportConfigError):
+        tr.transport_policy()
+    with pytest.raises(RpcTransportConfigError):
+        tr.resolve_endpoint("127.0.0.1", 9091)
+
+
+def test_uds_dir_override(monkeypatch, tmp_path):
+    monkeypatch.setenv("RSTPU_UDS_DIR", str(tmp_path / "socks"))
+    assert tr.uds_path_for_port(7) == str(tmp_path / "socks" / "7.sock")
+
+
+def test_loopback_connect_unregistered_is_connection_error():
+    pool = RpcClientPool()
+    with pytest.raises(RpcConnectionError) as ei:
+        _run(pool.call("loopback://99999", 0, "echo", {}))
+    assert "not served by this process" in str(ei.value)
+    _run(pool.close())
+
+
+def test_misconfigured_policy_surfaces_unwrapped(monkeypatch):
+    """A bogus RSTPU_TRANSPORT must raise the CONFIG error through the
+    client (not be retried/masked as a connection error)."""
+    pool = RpcClientPool()
+    monkeypatch.setenv("RSTPU_TRANSPORT", "bogus")
+    with pytest.raises(RpcTransportConfigError):
+        _run(pool.call("127.0.0.1", 1, "echo", {}))
+    monkeypatch.delenv("RSTPU_TRANSPORT")
+    _run(pool.close())
+
+
+def test_throttled_reconnect_preserves_config_error(monkeypatch):
+    """The pool's reconnect throttle must not re-classify a remembered
+    misconfig as RpcConnectionError — the pull loop routes the two
+    classes differently (only connection errors escalate to the leader
+    resolver)."""
+    pool = RpcClientPool()
+    monkeypatch.setenv("RSTPU_TRANSPORT", "bogus")
+    with pytest.raises(RpcTransportConfigError):
+        _run(pool.call("127.0.0.1", 1, "echo", {}))
+    # immediately inside the RECONNECT_THROTTLE_SEC window: still the
+    # config class, with the original cause in the message
+    with pytest.raises(RpcTransportConfigError) as ei:
+        _run(pool.call("127.0.0.1", 1, "echo", {}))
+    assert "bogus" in str(ei.value)
+    monkeypatch.delenv("RSTPU_TRANSPORT")
+    _run(pool.close())
+
+
+def test_server_start_failure_leaves_nothing_bound(tmp_path):
+    """If an extra fast-path listener fails to start after the tcp
+    listener bound, start() must raise AND tear the tcp listener down —
+    a half-started server must not keep accepting."""
+    # an AF_UNIX path over the 107-byte sockaddr_un limit: makedirs
+    # succeeds but bind() raises OSError after tcp already bound
+    bad = str(tmp_path / ("x" * 200 + ".sock"))
+    server = RpcServer(port=0, endpoints=[f"uds://{bad}"])
+    server.add_handler(EchoHandler())
+    with pytest.raises(OSError):
+        server.start()
+    port = server.port
+    assert port  # tcp had bound (port was assigned) before the failure
+    with pytest.raises(OSError):
+        s = socket.create_connection(("127.0.0.1", port), timeout=2)
+        s.close()
+    server.stop()  # idempotent no-op on the torn-down server
+
+
+# ---------------------------------------------------------------------------
+# echo parity across transports (policy-selected and URL-selected)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server_and_pool(monkeypatch):
+    made = []
+
+    def make(policy):
+        if policy:
+            monkeypatch.setenv("RSTPU_TRANSPORT", policy)
+        server = RpcServer(port=0)
+        server.add_handler(EchoHandler())
+        server.start()
+        pool = RpcClientPool()
+        made.append((server, pool))
+        return server, pool
+
+    yield make
+    for server, pool in made:
+        try:
+            _run(pool.close())
+        finally:
+            server.stop()
+
+
+@pytest.mark.parametrize("policy", ["tcp", "uds", "loopback"])
+def test_echo_binary_roundtrip_all_transports(server_and_pool, policy):
+    server, pool = server_and_pool(policy)
+    blob = bytes(range(256)) * 64
+
+    async def go():
+        r = await pool.call("127.0.0.1", server.port, "echo",
+                            {"n": 7, "data": blob})
+        assert r["n"] == 7 and bytes(r["data"]) == blob
+        client = pool.peek("127.0.0.1", server.port)
+        assert client.transport_scheme == policy
+        # concurrency: many in-flight calls multiplex on one connection
+        rs = await asyncio.gather(*(
+            pool.call("127.0.0.1", server.port, "echo", {"n": i})
+            for i in range(50)))
+        assert sorted(r["n"] for r in rs) == list(range(50))
+
+    _run(go())
+
+
+def test_explicit_uds_url_endpoint(tmp_path):
+    """URL-scheme selection end to end: server passes an explicit uds
+    endpoint, the client dials the URL directly."""
+    path = str(tmp_path / "explicit.sock")
+    server = RpcServer(port=0, endpoints=[f"uds://{path}"])
+    server.add_handler(EchoHandler())
+    server.start()
+    pool = RpcClientPool()
+    try:
+        r = _run(pool.call(f"uds://{path}", 0, "echo", {"n": 3}))
+        assert r["n"] == 3
+        assert pool.peek(f"uds://{path}", 0).transport_scheme == "uds"
+        assert f"uds://{path}" in server.serving_endpoints()
+    finally:
+        _run(pool.close())
+        server.stop()
+
+
+def test_uds_socket_file_cleaned_up_on_stop(monkeypatch):
+    monkeypatch.setenv("RSTPU_TRANSPORT", "uds")
+    server = RpcServer(port=0)
+    server.add_handler(EchoHandler())
+    server.start()
+    path = tr.uds_path_for_port(server.port)
+    assert os.path.exists(path)
+    server.stop()
+    assert not os.path.exists(path)
+
+
+def test_loopback_registry_cleared_on_stop(monkeypatch):
+    monkeypatch.setenv("RSTPU_TRANSPORT", "loopback")
+    server = RpcServer(port=0)
+    server.add_handler(EchoHandler())
+    server.start()
+    key = str(server.port)
+    assert key in tr._LOOPBACK_REGISTRY
+    pool = RpcClientPool()
+    try:
+        assert _run(pool.call("127.0.0.1", server.port,
+                              "echo", {"n": 1}))["n"] == 1
+    finally:
+        _run(pool.close())
+        server.stop()
+    assert key not in tr._LOOPBACK_REGISTRY
+    # restart re-registers the same key (server restart contract)
+    server.start()
+    try:
+        assert key in tr._LOOPBACK_REGISTRY
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# vectored uds: frame coalescing on both halves
+# ---------------------------------------------------------------------------
+
+
+def _uds_pair():
+    a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    loop = asyncio.get_running_loop()
+    return tr.UdsConnection(a, loop), tr.UdsConnection(b, loop)
+
+
+def test_uds_multi_frame_single_sendmsg_and_recv():
+    """N frames handed to send_frames drain as ONE iovec (one sendmsg)
+    and decode as one recv batch on the peer."""
+
+    async def go():
+        left, right = _uds_pair()
+        frames = [(b'{"id":%d}' % i, [b"p%03d" % i, b"-tail"])
+                  for i in range(20)]
+        await left.send_frames(frames)
+        assert left.frames_sent == 20
+        assert left.sendmsg_calls == 1, \
+            "queue drain must batch all frames into one sendmsg"
+        got = []
+        while len(got) < 20:
+            got.extend(await right.recv_frames())
+        assert right.recv_calls <= 2
+        for i, (h, p) in enumerate(got):
+            assert bytes(h) == b'{"id":%d}' % i
+            assert bytes(p) == b"p%03d-tail" % i
+        left.close()
+        right.close()
+
+    asyncio.run(go())
+
+
+def test_uds_close_fails_parked_senders():
+    """close() while the drainer is parked on a full socket buffer must
+    FAIL the in-flight batch's waiters (ConnectionResetError), never
+    leave a sender awaiting a forgotten future forever."""
+
+    async def go():
+        left, right = _uds_pair()
+        # small send buffer so one big frame parks the drainer mid-batch
+        left._sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+        big = [(b'{"id":1}', [b"x" * (4 << 20)])]
+        sender = asyncio.ensure_future(left.send_frames(big))
+        await asyncio.sleep(0.05)
+        assert not sender.done(), "frame should be stuck in the drainer"
+        left.close()
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(sender, timeout=5)
+        right.close()
+
+    asyncio.run(go())
+
+
+def test_uds_concurrent_senders_coalesce():
+    """Concurrent send_frames callers enqueue and ONE drainer flushes
+    them: far fewer syscalls than frames, every frame delivered intact,
+    FIFO per sender."""
+
+    async def go():
+        left, right = _uds_pair()
+
+        async def sender(k):
+            for i in range(25):
+                await left.send_frames(
+                    [(b'{"s":%d,"i":%d}' % (k, i), [b"x" * 64])])
+
+        recv_done = asyncio.Event()
+        got = []
+
+        async def receiver():
+            while len(got) < 100:
+                got.extend(await right.recv_frames())
+            recv_done.set()
+
+        rt = asyncio.ensure_future(receiver())
+        await asyncio.gather(*(sender(k) for k in range(4)))
+        await asyncio.wait_for(recv_done.wait(), 10)
+        rt.cancel()
+        assert len(got) == 100
+        assert left.sendmsg_calls < left.frames_sent, (
+            f"no coalescing: {left.sendmsg_calls} sendmsg for "
+            f"{left.frames_sent} frames")
+        # per-sender FIFO survived the coalescing
+        import json
+        seen = {k: -1 for k in range(4)}
+        for h, _p in got:
+            m = json.loads(bytes(h))
+            assert m["i"] == seen[m["s"]] + 1
+            seen[m["s"]] = m["i"]
+        left.close()
+        right.close()
+
+    asyncio.run(go())
+
+
+def test_uds_large_frame_crosses_iov_cap():
+    """A frame burst larger than one iovec budget still arrives whole
+    (partial-send resume + IOV_CAP chunking)."""
+
+    async def go():
+        left, right = _uds_pair()
+        big = os.urandom(900 * 1024)  # > any single sendmsg on a socketpair
+
+        async def pump():
+            await left.send_frames([(b'{"id":1}', [big])])
+
+        st = asyncio.ensure_future(pump())
+        got = []
+        while not got:
+            got.extend(await right.recv_frames())
+        await st
+        (h, p), = got
+        assert bytes(p) == big
+        left.close()
+        right.close()
+
+    asyncio.run(go())
+
+
+def test_frame_buffer_decodes_multiple_and_partials():
+    fb = FrameBuffer(capacity=64)
+    parts1, _ = encode_wire_parts(b'{"id":1}', [b"abc"])
+    parts2, _ = encode_wire_parts(b'{"id":2}', [b"defg"])
+    wire = b"".join(bytes(p) for p in parts1 + parts2)
+    # feed in awkward split points: mid-prefix, mid-header, mid-payload
+    fb.feed(wire[:7])
+    assert fb.pop_frames() == []
+    fb.feed(wire[7:15])
+    fb.feed(wire[15:])
+    frames = fb.pop_frames()
+    assert [(bytes(h), bytes(p)) for h, p in frames] == [
+        (b'{"id":1}', b"abc"), (b'{"id":2}', b"defg")]
+    # buffer fully reusable after drain
+    fb.feed(wire)
+    assert len(fb.pop_frames()) == 2
+
+
+def test_frame_buffer_rejects_bad_magic():
+    fb = FrameBuffer()
+    fb.feed(b"\xde\xad\xbe\xef" + b"\x00" * 20)
+    with pytest.raises(ValueError):
+        fb.pop_frames()
+
+
+def test_loopback_payload_is_zero_copy_view():
+    """The loopback frame payload must be a memoryview onto the sender's
+    chunk — no wire pack, no copy."""
+
+    async def go():
+        a = tr.LoopbackConnection(asyncio.get_running_loop())
+        b = tr.LoopbackConnection(asyncio.get_running_loop())
+        a.peer, b.peer = b, a
+        blob = b"Z" * 4096
+        await a.send_frames([(b'{"id":9}', [blob])])
+        (h, p), = await b.recv_frames()
+        assert bytes(h) == b'{"id":9}'
+        assert isinstance(p, memoryview)
+        assert p.obj is blob, "loopback must hand a view, not a copy"
+        a.close()
+        b.close()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# reconnect behavior parity (client pool heals a dead fast-path conn)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("policy", ["uds", "loopback"])
+def test_pool_reconnects_after_server_restart(server_and_pool, policy,
+                                              monkeypatch):
+    server, pool = server_and_pool(policy)
+    port = server.port
+
+    async def call():
+        return await pool.call("127.0.0.1", port, "echo", {"n": 1},
+                               timeout=5)
+
+    assert _run(call())["n"] == 1
+    server.stop()
+    with pytest.raises(RpcConnectionError):
+        _run(call())
+    server._port = port
+    server.start()
+    deadline = time.monotonic() + 10
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            assert _run(call())["n"] == 1
+            break
+        except RpcConnectionError as e:  # reconnect throttle window
+            last = e
+            time.sleep(0.3)
+    else:
+        raise AssertionError(f"never reconnected: {last}")

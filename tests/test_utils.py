@@ -590,12 +590,18 @@ def test_speculate_backup_after_primary_failure():
 # ---------------------------------------------------------------------------
 
 
-def test_rate_limiter_oversized_cost_terminates():
-    # cost > burst must incur token debt, not hang (AWS ApplyCost semantics)
+def test_rate_limiter_oversized_cost_terminates(monkeypatch):
+    # cost > burst must incur token debt, not hang (AWS ApplyCost semantics).
+    # Stub the debt sleep: a real sleep(0.04) oversleeping by >=1ms refills
+    # the 40-token debt at rate 1000/s and races the try_get below.
+    from rocksplicator_tpu.utils import rate_limiter as rl_mod
+
+    monkeypatch.setattr(rl_mod.time, "sleep", lambda s: None)
     rl = ConcurrentRateLimiter(rate=1000.0, burst=10.0)
-    slept = rl.apply_cost(50.0)
-    assert slept >= 0.0
-    # bucket is now in debt: an immediate try_get must fail
+    slept = rl.apply_cost(10_010.0)
+    # slept off exactly the 10k-token debt (returned, not actually slept)
+    assert slept == pytest.approx(10.0, rel=0.01)
+    # bucket is ~10s of refill in debt: an immediate try_get must fail
     assert not rl.try_get(1.0)
 
 

@@ -323,14 +323,22 @@ def run_chaos(
     ingest_every: int = 4,
     break_guard: Optional[str] = None,
     conv_timeout: float = 30.0,
+    transport: Optional[str] = None,
     log=print,
 ) -> Dict:
     saved_env = {
         k: os.environ.get(k)
-        for k in ("RSTPU_RETRY_SEED", "RSTPU_PULL_RETRY_SEED")
+        for k in ("RSTPU_RETRY_SEED", "RSTPU_PULL_RETRY_SEED",
+                  "RSTPU_TRANSPORT")
     }
     os.environ["RSTPU_RETRY_SEED"] = str(seed)
     os.environ["RSTPU_PULL_RETRY_SEED"] = str(seed)
+    if transport:
+        # the same seeded schedules must hold the same invariants on
+        # every byte layer: the policy reroutes the cluster's RPC plane
+        # (leader/followers are colocated in-process, so even loopback
+        # applies) while the fault sites arm identically
+        os.environ["RSTPU_TRANSPORT"] = transport
     undo = _break_guard(break_guard) if break_guard else None
     violations: List[str] = []
     acked_total = 0
@@ -416,6 +424,8 @@ def run_chaos(
     return {
         "schedules": schedules,
         "seed": seed,
+        "transport": transport or os.environ.get("RSTPU_TRANSPORT", "tcp")
+        or "tcp",
         "writes": write_total,
         "acked": acked_total,
         "violations": violations,
@@ -431,6 +441,10 @@ def main(argv=None) -> int:
     ap.add_argument("--writes", type=int, default=80,
                     help="max writes per schedule")
     ap.add_argument("--ingest-every", type=int, default=4)
+    ap.add_argument("--transport", choices=["tcp", "uds", "loopback"],
+                    help="run the cluster's RPC plane on this byte layer "
+                         "(RSTPU_TRANSPORT for the run; default: ambient "
+                         "policy, i.e. tcp)")
     ap.add_argument("--break-guard", choices=["wal_hole", "meta_first"])
     ap.add_argument("--expect-violation", action="store_true",
                     help="exit 0 iff a violation WAS caught")
@@ -445,11 +459,13 @@ def main(argv=None) -> int:
             root, schedules=args.schedules, seed=args.seed,
             writes=args.writes, ingest_every=args.ingest_every,
             break_guard=args.break_guard, conv_timeout=args.conv_timeout,
+            transport=args.transport,
         )
     finally:
         shutil.rmtree(root, ignore_errors=True)
     result["elapsed_sec"] = round(time.monotonic() - t0, 1)
-    print(f"chaos: {result['schedules']} schedules, "
+    print(f"chaos: {result['schedules']} schedules "
+          f"[{result['transport']}], "
           f"{result['writes']} writes ({result['acked']} acked), "
           f"{result['elapsed_sec']}s")
     print(f"chaos: failpoint trips: {result['failpoint_trips']}")
@@ -461,6 +477,8 @@ def main(argv=None) -> int:
             print(f"VIOLATION: {v}")
         print(f"REPRO: python -m tools.chaos_soak "
               f"--schedules {args.schedules} --seed {args.seed}"
+              + (f" --transport {args.transport}"
+                 if args.transport else "")
               + (f" --break-guard {args.break_guard}"
                  if args.break_guard else ""))
         return 0 if args.expect_violation else 1
