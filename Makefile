@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke chaos-smoke clean
+.PHONY: test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke chaos-smoke chaos-failover-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -81,6 +81,22 @@ chaos-smoke:
 		--break-guard wal_hole --expect-violation --conv-timeout 3
 	$(PY) -m tools.chaos_soak --schedules 1 --seed 7 --ingest-every 1 \
 		--break-guard meta_first --expect-violation --conv-timeout 10
+
+# coordinator-backed failover chaos (~25s + ~20s tooth): >= 15 seeded
+# control-plane schedules against Controller + Spectator + 3
+# participants — leader crash holding a full AckWindow, participant
+# session expiry via coordinator.heartbeat, coordinator primary kill,
+# coordinator WAL torn-write — each followed by the FOURTH standing
+# invariant (exactly one LEADER per shard, zero acked-write loss across
+# the handoff, shard-map convergence within a bounded number of
+# controller passes); then the fencing tooth: a leader patched to
+# IGNORE epochs must be CAUGHT acking writes after deposition
+# (--expect-violation). A violation prints the reproducing --seed.
+chaos-failover-smoke:
+	$(PY) -m tools.chaos_soak --failover --schedules 15 --seed 1 \
+		--out benchmarks/results/chaos_failover_smoke.json
+	$(PY) -m tools.chaos_soak --failover --schedules 1 --seed 7 \
+		--break-guard fencing --expect-violation
 
 clean:
 	$(MAKE) -C rocksplicator_tpu/storage/native clean

@@ -38,6 +38,7 @@ class ApplicationDB:
         leader_resolver: Optional[LeaderResolver] = None,
         wrapper: Optional[DbWrapper] = None,
         enable_read_stats: bool = True,  # optional: ~10M Get/s design point
+        epoch: int = 0,
     ):
         self.name = name
         self.db = db
@@ -54,6 +55,7 @@ class ApplicationDB:
                 upstream_addr=upstream_addr,
                 replication_mode=replication_mode,
                 leader_resolver=leader_resolver,
+                epoch=epoch,
             )
 
     # -- writes ------------------------------------------------------------

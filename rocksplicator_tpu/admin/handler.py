@@ -108,6 +108,15 @@ def _current_mode(app_db: ApplicationDB) -> Optional[int]:
     return None
 
 
+def _current_epoch(app_db: ApplicationDB) -> int:
+    """The db's live fencing epoch, preserved (max-merged) across
+    reopen/role change so a legacy caller passing no epoch can never
+    regress a shard below an epoch it already served under."""
+    if app_db.replicated_db is not None:
+        return app_db.replicated_db.epoch
+    return 0
+
+
 def _parse_role(role: str) -> ReplicaRole:
     r = _ROLE_ALIASES.get(role.upper())
     if r is None:
@@ -222,6 +231,7 @@ class AdminHandler:
         upstream: Optional[Tuple[str, int]],
         overwrite: bool = False,
         replication_mode: Optional[int] = None,
+        epoch: int = 0,
     ) -> ApplicationDB:
         path = self._db_path(db_name)
         if overwrite:
@@ -233,6 +243,7 @@ class AdminHandler:
             replicator=self.replicator,
             upstream_addr=upstream,
             replication_mode=replication_mode,
+            epoch=epoch,
             # late-bound: set_leader_resolver (called once the participant
             # exists — it is constructed after the handler) must reach DBs
             # that are already open, so the wrapper defers the lookup
@@ -302,6 +313,7 @@ class AdminHandler:
         role: str = "FOLLOWER",
         overwrite: bool = False,
         replication_mode: Optional[int] = None,
+        epoch: int = 0,
     ) -> dict:
         """addDB (admin_handler.cpp:597-694): open the db and register it
         with the replicator in the given role."""
@@ -315,7 +327,8 @@ class AdminHandler:
                 if self.db_manager.get_db(db_name) is not None:
                     raise RpcApplicationError(DB_ALREADY_EXISTS, db_name)
                 self._open_app_db(db_name, parsed, upstream, overwrite,
-                                  replication_mode=replication_mode)
+                                  replication_mode=replication_mode,
+                                  epoch=int(epoch))
 
         await self._run(do)
         return {}
@@ -338,10 +351,11 @@ class AdminHandler:
         def do():
             with self._db_admin_lock.locked(db_name):
                 app_db = self.db_manager.get_db(db_name)
-                role, upstream, mode = ReplicaRole.NOOP, None, None
+                role, upstream, mode, epoch = ReplicaRole.NOOP, None, None, 0
                 if app_db is not None:
                     role = app_db.role
                     mode = _current_mode(app_db)
+                    epoch = _current_epoch(app_db)
                     if app_db.replicated_db is not None:
                         upstream = app_db.replicated_db.upstream_addr
                     self.db_manager.remove_db(db_name)
@@ -349,7 +363,7 @@ class AdminHandler:
                 self.clear_meta_data(db_name)
                 if reopen_db:
                     self._open_app_db(db_name, role, upstream,
-                                      replication_mode=mode)
+                                      replication_mode=mode, epoch=epoch)
 
         await self._run(do)
         return {}
@@ -360,9 +374,13 @@ class AdminHandler:
         new_role: str = "FOLLOWER",
         upstream_ip: str = "",
         upstream_port: int = 0,
+        epoch: int = 0,
     ) -> dict:
         """changeDBRoleAndUpStream (admin_handler.cpp:1438): implemented as
-        removeDB + addDB with the new role, keeping the storage."""
+        removeDB + addDB with the new role, keeping the storage.
+        ``epoch`` is the controller's assignment epoch for the shard;
+        max-merged with the live epoch so legacy callers (epoch 0) can
+        never regress the fencing token."""
         parsed = _parse_role(new_role)
         upstream = (upstream_ip, upstream_port) if upstream_ip else None
         if parsed in (ReplicaRole.FOLLOWER, ReplicaRole.OBSERVER) and not upstream:
@@ -376,12 +394,35 @@ class AdminHandler:
                 # the ack mode survives role changes (an explicit addDB mode
                 # must not silently revert to the dbconfig default)
                 mode = _current_mode(app_db)
+                new_epoch = max(int(epoch), _current_epoch(app_db))
                 self.db_manager.remove_db(db_name)  # closes storage + repl
                 self._open_app_db(db_name, parsed, upstream,
-                                  replication_mode=mode)
+                                  replication_mode=mode, epoch=new_epoch)
 
         await self._run(do)
         return {}
+
+    async def handle_set_db_epoch(
+        self, db_name: str = "", epoch: int = 0
+    ) -> dict:
+        """Raise a hosted db's fencing epoch WITHOUT a role transition —
+        the sticky-leader path: the controller re-stamped the assignment
+        epoch (e.g. after a ledger rebuild) while the leader stays put,
+        and the leader must adopt it before its followers (which learned
+        the new epoch from their repoints) fence it as deposed. Epochs
+        only move forward; a lower value is a no-op."""
+
+        def do():
+            # under the per-db admin lock like every other db mutation:
+            # an adopt racing a concurrent reopen must not land on a
+            # discarded ReplicatedDB and silently vanish
+            with self._db_admin_lock.locked(db_name):
+                rdb = self._get_app_db(db_name).replicated_db
+                if rdb is not None:
+                    rdb.adopt_epoch(int(epoch))
+                return rdb.epoch if rdb is not None else 0
+
+        return {"epoch": await self._run(do)}
 
     # ------------------------------------------------------------------
     # RPC: backup / restore
@@ -612,6 +653,7 @@ class AdminHandler:
                     # (:1774-1817)
                     role = app_db.role
                     mode = _current_mode(app_db)
+                    epoch = _current_epoch(app_db)
                     upstream = (
                         app_db.replicated_db.upstream_addr
                         if app_db.replicated_db else None
@@ -619,7 +661,8 @@ class AdminHandler:
                     self.db_manager.remove_db(db_name)
                     destroy_db(self._db_path(db_name))
                     target_db = self._open_app_db(db_name, role, upstream,
-                                                  replication_mode=mode)
+                                                  replication_mode=mode,
+                                                  epoch=epoch)
                 fp.hit("admin.ingest.engine")
                 with Timer("admin.sst_ingest_ms"), \
                         start_span("admin.ingest.ingest", files=len(sst_files)):

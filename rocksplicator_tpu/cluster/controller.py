@@ -14,11 +14,13 @@ brain of its control plane). Responsibilities reproduced:
 from __future__ import annotations
 
 import hashlib
+import json
 import logging
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
+from ..testing import failpoints as fp
 from ..utils.segment_utils import segment_to_db_name, db_name_to_partition_name
 from .coordinator import CoordinatorClient
 from .model import (
@@ -45,6 +47,110 @@ def _rendezvous(partition: str, instance_id: str) -> int:
     return int.from_bytes(h, "big")
 
 
+def _state_names(state_model: str) -> Tuple[str, str]:
+    if state_model == "MasterSlave":
+        return "MASTER", "SLAVE"
+    if state_model in ("OnlineOffline", "Cache", "Bootstrap"):
+        return "ONLINE", "ONLINE"
+    if state_model == "CdcLeaderStandby":
+        return "LEADER", "STANDBY"
+    return LEADER, FOLLOWER
+
+
+def assign_resource(
+    resource: ResourceDef,
+    instances: Dict[str, InstanceInfo],
+    current: Dict[str, Dict[str, str]],
+    per_instance: Dict[str, Dict[str, PartitionAssignment]],
+    epochs: Dict[str, Dict],
+) -> Set[str]:
+    """Compute one resource's target assignments (pure — no coordinator
+    I/O, so the two-phase handoff edges are directly unit-testable).
+
+    ``epochs`` is the fencing-epoch ledger: partition -> {"epoch": int,
+    "leader": iid}. An epoch bumps EXACTLY when a promotion is issued to
+    a different leader than the ledger records — i.e. at the moment a
+    new leader may start acking — never during the demote phase of a
+    two-phase handoff (the old leader is still the only legitimate
+    acker until it reports non-leader). Mutated in place; returns the
+    set of partitions whose ledger record changed (the caller persists
+    those BEFORE publishing the stamped assignments)."""
+    leader_state, follower_state = _state_names(resource.state_model)
+    changed: Set[str] = set()
+    iids = sorted(instances)
+    if not iids:
+        return changed
+    for shard in range(resource.num_shards):
+        partition = db_name_to_partition_name(
+            segment_to_db_name(resource.segment, shard)
+        )
+        ranked = sorted(
+            iids, key=lambda iid: _rendezvous(partition, iid),
+            reverse=True,
+        )
+        replicas = ranked[: resource.replicas]
+        if not replicas:
+            continue
+        # who currently leads? A node that rejoins after being deposed
+        # still CLAIMS leaderlike in its (persistent) current state until
+        # it demotes — with two live claimers the epoch ledger's recorded
+        # leader is the truth, and trusting the stale claim instead would
+        # flap leadership straight back to the deposed node (observed in
+        # the failover chaos harness before this guard existed).
+        claimers = [
+            iid for iid in iids
+            if current.get(iid, {}).get(partition) in _LEADERLIKE
+        ]
+        recorded_leader = (epochs.get(partition) or {}).get("leader")
+        if not claimers:
+            live_leader = None
+        elif recorded_leader in claimers:
+            live_leader = recorded_leader
+        else:
+            live_leader = claimers[0]
+        # target leader: sticky to the live leader if still placed;
+        # else the best-ranked replica that's already serving; else rank-0
+        if live_leader in replicas:
+            target_leader = live_leader
+        else:
+            serving = [
+                iid for iid in replicas
+                if current.get(iid, {}).get(partition) in
+                (_FOLLOWERLIKE | _LEADERLIKE)
+            ]
+            target_leader = serving[0] if serving else replicas[0]
+        # two-phase handoff: demote first, promote when no live leader
+        promote_ok = live_leader is None or live_leader == target_leader
+        rec = epochs.setdefault(partition, {"epoch": 0, "leader": None})
+        if promote_ok and rec.get("leader") != target_leader:
+            # leadership is moving NOW: mint the new leader's epoch so
+            # every assignment written this pass already carries it
+            rec["epoch"] = int(rec.get("epoch", 0)) + 1
+            rec["leader"] = target_leader
+            changed.add(partition)
+        epoch = int(rec.get("epoch", 0))
+        # followers need the upstream (the acting leader while handoff
+        # is in flight, else the target leader)
+        upstream_iid = live_leader or target_leader
+        upstream_info = instances.get(upstream_iid)
+        upstream = (
+            f"{upstream_info.host}:{upstream_info.repl_port}"
+            if upstream_info else None
+        )
+        for iid in replicas:
+            if iid == target_leader and promote_ok:
+                state: str = leader_state
+                up = None
+            else:
+                # includes a demote-in-flight target leader: it stays a
+                # follower of the acting leader until promote_ok
+                state = follower_state
+                up = upstream if upstream_iid != iid else None
+            per_instance[iid][partition] = PartitionAssignment(
+                state, up, epoch)
+    return changed
+
+
 class Controller:
     def __init__(
         self,
@@ -64,6 +170,10 @@ class Controller:
         self._stop = threading.Event()
         self._kick = threading.Event()
         self._is_leader = False
+        # reconcile passes completed while leader — the chaos harness's
+        # "shard-map convergence within a bounded number of controller
+        # passes" invariant reads this
+        self.passes = 0
         self._thread = threading.Thread(
             target=self._run, name=f"controller-{controller_id}", daemon=True
         )
@@ -109,94 +219,122 @@ class Controller:
     # ------------------------------------------------------------------
 
     def reconcile(self) -> None:
-        """One pass: recompute and publish assignments for every resource."""
+        """One pass: recompute and publish assignments for every resource.
+
+        Ordering matters for fencing: epoch-ledger records changed by
+        this pass are persisted BEFORE the stamped assignments are
+        published — a controller crash between the two steps leaves a
+        minted-but-unpublished epoch, which the next pass (any
+        controller) re-reads and re-publishes without a double bump."""
         instances = self._live_instances()
         current = self._current_states()
+        epochs = self._load_epochs()
         per_instance: Dict[str, Dict[str, PartitionAssignment]] = {
             iid: {} for iid in instances
         }
+        changed: Set[str] = set()
         for seg in self.coord.list(self._path("resources")):
             raw = self.coord.get_or_none(self._path("resources", seg))
             if raw is None:
                 continue
             resource = ResourceDef.decode(raw)
-            self._assign_resource(resource, instances, current, per_instance)
+            changed |= assign_resource(
+                resource, instances, current, per_instance, epochs)
+        for partition in sorted(changed):
+            mine = epochs[partition]
+            merged = self._persist_epoch(partition, mine)
+            if merged is None:
+                continue  # our record landed
+            # A peer controller outran us on the ledger. If it minted the
+            # SAME record we did, the race was harmless — publish. If it
+            # recorded a DIFFERENT leader (or a further epoch), publishing
+            # our assignments would promote a second leader under (or
+            # hand the winning fencing token to) the wrong node — the
+            # split brain the ledger exists to prevent. Abort the pass;
+            # the next one recomputes from the merged record, and the
+            # recorded-leader preference converges both controllers.
+            if (int(merged.get("epoch", 0)) == int(mine["epoch"])
+                    and merged.get("leader") == mine.get("leader")):
+                continue
+            log.warning(
+                "epoch ledger conflict on %s: ours %s vs persisted %s — "
+                "deferring this reconcile pass", partition, mine, merged)
+            return
         for iid, assignments in per_instance.items():
             path = self._path("assignments", iid)
             encoded = encode_assignments(assignments)
             existing = self.coord.get_or_none(path)
             if existing != encoded:
+                # the control plane touching durable state: a tripped
+                # fault aborts this pass mid-publish — the next pass
+                # must converge from the partial write
+                fp.hit("controller.assign")
                 self.coord.put(path, encoded)
+        self.passes += 1
 
-    def _assign_resource(
-        self,
-        resource: ResourceDef,
-        instances: Dict[str, InstanceInfo],
-        current: Dict[str, Dict[str, str]],
-        per_instance: Dict[str, Dict[str, PartitionAssignment]],
-    ) -> None:
-        leader_state, follower_state = self._state_names(resource.state_model)
-        iids = sorted(instances)
-        if not iids:
-            return
-        for shard in range(resource.num_shards):
-            partition = db_name_to_partition_name(
-                segment_to_db_name(resource.segment, shard)
-            )
-            ranked = sorted(
-                iids, key=lambda iid: _rendezvous(partition, iid),
-                reverse=True,
-            )
-            replicas = ranked[: resource.replicas]
-            if not replicas:
+    # -- fencing-epoch ledger ---------------------------------------------
+
+    def _load_epochs(self) -> Dict[str, Dict]:
+        out: Dict[str, Dict] = {}
+        for p in self.coord.list(self._path("epochs")):
+            raw = self.coord.get_or_none(self._path("epochs", p))
+            if not raw:
                 continue
-            # who currently leads?
-            live_leader = None
-            for iid in iids:
-                if current.get(iid, {}).get(partition) in _LEADERLIKE:
-                    live_leader = iid
-                    break
-            # target leader: sticky to the live leader if still placed;
-            # else the best-ranked replica that's already serving; else rank-0
-            if live_leader in replicas:
-                target_leader = live_leader
-            else:
-                serving = [
-                    iid for iid in replicas
-                    if current.get(iid, {}).get(partition) in
-                    (_FOLLOWERLIKE | _LEADERLIKE)
-                ]
-                target_leader = serving[0] if serving else replicas[0]
-            # two-phase handoff: demote first, promote when no live leader
-            promote_ok = live_leader is None or live_leader == target_leader
-            # followers need the upstream (the acting leader while handoff
-            # is in flight, else the target leader)
-            upstream_iid = live_leader or target_leader
-            upstream_info = instances.get(upstream_iid)
-            upstream = (
-                f"{upstream_info.host}:{upstream_info.repl_port}"
-                if upstream_info else None
-            )
-            for iid in replicas:
-                if iid == target_leader and promote_ok:
-                    state: str = leader_state
-                    up = None
-                else:
-                    # includes a demote-in-flight target leader: it stays a
-                    # follower of the acting leader until promote_ok
-                    state = follower_state
-                    up = upstream if upstream_iid != iid else None
-                per_instance[iid][partition] = PartitionAssignment(state, up)
+            try:
+                rec = json.loads(bytes(raw).decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            out[p] = {"epoch": int(rec.get("epoch", 0)),
+                      "leader": rec.get("leader")}
+        return out
 
-    @staticmethod
-    def _state_names(state_model: str) -> Tuple[str, str]:
-        if state_model == "MasterSlave":
-            return "MASTER", "SLAVE"
-        if state_model in ("OnlineOffline", "Cache", "Bootstrap"):
-            return "ONLINE", "ONLINE"
-        if state_model == "CdcLeaderStandby":
-            return "LEADER", "STANDBY"
-        return LEADER, FOLLOWER
+    def _persist_epoch(self, partition: str,
+                       rec: Dict) -> Optional[Dict]:
+        """Version-CAS the ledger record in, max-merging against
+        concurrent writers (a deposed-but-racing peer controller must
+        never regress an epoch — last-write-wins here would undo the
+        very fencing the ledger exists for). Returns the winning record
+        when a peer's beats ours, None when OUR record landed; RAISES
+        when the write could not land at all, so the caller never
+        publishes assignments stamped with a minted-but-unpersisted
+        epoch."""
+        from ..rpc.errors import RpcApplicationError
+
+        path = self._path("epochs", partition)
+        payload = json.dumps(rec).encode()
+        last_exc: Optional[Exception] = None
+        for _ in range(4):
+            try:
+                try:
+                    existing_raw, version = self.coord.get(path)
+                except RpcApplicationError as e:
+                    if e.code != "NO_NODE":
+                        raise
+                    existing_raw, version = None, None
+                if existing_raw is not None:
+                    try:
+                        existing = json.loads(bytes(existing_raw).decode())
+                    except (ValueError, UnicodeDecodeError):
+                        existing = {"epoch": 0, "leader": None}
+                    if int(existing.get("epoch", 0)) >= int(rec["epoch"]):
+                        return {"epoch": int(existing.get("epoch", 0)),
+                                "leader": existing.get("leader")}
+                    self.coord.set(path, payload,
+                                   expected_version=version)
+                else:
+                    self.coord.create(path, payload)
+                return None
+            except RpcApplicationError as e:
+                if e.code in ("BAD_VERSION", "NODE_EXISTS", "NO_NODE"):
+                    last_exc = e
+                    continue  # lost the CAS race: re-read and max-merge
+                last_exc = e
+                time.sleep(0.05)
+            except Exception as e:
+                last_exc = e
+                time.sleep(0.05)
+        raise RuntimeError(
+            f"epoch ledger write for {partition} failed: {last_exc!r}")
 
     # ------------------------------------------------------------------
 

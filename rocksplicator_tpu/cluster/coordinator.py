@@ -45,7 +45,7 @@ import itertools
 import logging
 import threading
 import time
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..rpc.client_pool import RpcClientPool
 from ..rpc.errors import RpcApplicationError, RpcError
@@ -162,6 +162,18 @@ class _Wal:
                 batch.append(nxt)
             try:
                 for line, _fut in batch:
+                    # the control plane touching durable state: a tripped
+                    # fail policy fences the log exactly like a real
+                    # ENOSPC; a torn policy leaves a truncated record on
+                    # disk (healed by _valid_prefix_len on reopen) and
+                    # then fences
+                    cut = fp.torn_point("coordinator.wal.append", len(line))
+                    if cut is not None:
+                        self._f.write(line[:cut])
+                        self._f.flush()
+                        raise fp.FailpointError(
+                            f"coordinator.wal.append torn at {cut}")
+                    fp.hit("coordinator.wal.append")
                     self._f.write(line)
                 self._f.flush()
                 os.fsync(self._f.fileno())
@@ -1624,9 +1636,15 @@ class CoordinatorClient:
         self._ioloop = ioloop or IoLoop.default()
         self._pool = RpcClientPool()
         self._stop = threading.Event()
+        self._hb_suspended = threading.Event()
+        self._requested_ttl = session_ttl
         # highest fencing token seen from any primary; acks carrying a
         # LOWER token come from a deposed primary and are rejected
         self._max_ftoken = 0
+        # fired (from the heartbeat thread) after an expired session was
+        # re-established: ephemerals owned by the old session are gone —
+        # owners (participants) re-register here
+        self.on_session_reestablished: Optional[Callable[[], None]] = None
         r = self._call("create_session", ttl=session_ttl)
         self.session_id = r["session_id"]
         self._ttl = r["ttl"]
@@ -1729,12 +1747,31 @@ class CoordinatorClient:
         self._host, self._port = self._endpoints[
             (idx + 1) % len(self._endpoints)]
 
+    def suspend_heartbeats(self) -> None:
+        """Stop heartbeating WITHOUT closing: the server expires the
+        session after its TTL — the faithful 'process wedged / GC pause /
+        partitioned' simulation (chaos harness + tests). resume() lets
+        the next beat discover the expiry and re-establish."""
+        self._hb_suspended.set()
+
+    def resume_heartbeats(self) -> None:
+        self._hb_suspended.clear()
+
     def _heartbeat_loop(self) -> None:
         interval = self._ttl / 3
         beats = 0
         while not self._stop.wait(interval):
+            if self._hb_suspended.is_set():
+                continue
             try:
                 self._call("heartbeat", session_id=self.session_id)
+            except RpcApplicationError as e:
+                if e.code == NO_SESSION:
+                    # the session expired server-side (TTL lapse while we
+                    # were wedged/partitioned): its ephemerals are gone.
+                    # Re-establish rather than beating a dead session
+                    # forever — the ZK session-re-establishment analog.
+                    self._reestablish_session()
             except RpcError:
                 pass  # reconnects on next beat; session may expire meanwhile
             except Exception:
@@ -1745,6 +1782,25 @@ class CoordinatorClient:
                 # any standby registered would otherwise never learn
                 # its failover endpoints
                 self._discover_endpoints()
+
+    def _reestablish_session(self) -> None:
+        try:
+            r = self._call("create_session", ttl=self._requested_ttl)
+        except Exception:
+            log.exception("coordinator session re-establishment failed "
+                          "(retrying on the next beat)")
+            return
+        old = self.session_id
+        self.session_id = r["session_id"]
+        self._ttl = r["ttl"]
+        log.warning("coordinator session %d expired — re-established as %d",
+                    old, self.session_id)
+        cb = self.on_session_reestablished
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                log.exception("on_session_reestablished callback failed")
 
     def close(self) -> None:
         self._stop.set()
@@ -1842,11 +1898,24 @@ class CoordinatorClient:
 
     def watch(self, path: str, callback, poll_ms: int = 5_000) -> threading.Event:
         """Fire ``callback(snapshot_dict)`` on every observed change (and
-        once initially). Returns an Event; set it to stop the watch."""
+        once initially). Returns an Event; set it to stop the watch.
+
+        Error backoff goes through the unified RetryPolicy (growing,
+        jittered, deterministic under RSTPU_RETRY_SEED like the follower
+        pull loop; ``retry.attempts op=coord.watch`` on /stats) instead
+        of the old flat 0.5 s sleep — a control-plane outage must not be
+        hammered at a fixed cadence by every watcher at once."""
+        from ..utils.retry_policy import (RetryPolicy, backoff_step,
+                                          seeded_rng)
+
         stop = threading.Event()
+        policy = RetryPolicy(max_attempts=1 << 30, base_delay=0.2,
+                             max_delay=2.0, floor=0.1)
+        rng = seeded_rng()
 
         def loop():
             known = -2
+            attempt = 0
             while not stop.is_set() and not self._stop.is_set():
                 try:
                     snap = self._call(
@@ -1854,12 +1923,15 @@ class CoordinatorClient:
                         max_wait_ms=poll_ms, timeout=poll_ms / 1000 + 10,
                     )
                 except (RpcError, RpcApplicationError):
-                    time.sleep(0.5)
+                    backoff_step(policy, attempt, op="coord.watch", rng=rng)
+                    attempt += 1
                     continue
                 except Exception:
                     log.exception("watch loop error for %s", path)
-                    time.sleep(0.5)
+                    backoff_step(policy, attempt, op="coord.watch", rng=rng)
+                    attempt += 1
                     continue
+                attempt = 0
                 if snap["cversion"] != known:
                     known = snap["cversion"]
                     try:

@@ -47,9 +47,10 @@ class AdminClient:
 
     def add_db(self, addr, db_name: str, role: str = "FOLLOWER",
                upstream: Optional[Tuple[str, int]] = None,
-               overwrite: bool = False) -> None:
+               overwrite: bool = False, epoch: int = 0) -> None:
         args: Dict[str, Any] = {
             "db_name": db_name, "role": role, "overwrite": overwrite,
+            "epoch": int(epoch),
         }
         if upstream:
             args["upstream_ip"], args["upstream_port"] = upstream
@@ -64,11 +65,19 @@ class AdminClient:
     def change_db_role_and_upstream(
         self, addr, db_name: str, new_role: str,
         upstream: Optional[Tuple[str, int]] = None,
+        epoch: int = 0,
     ) -> None:
-        args: Dict[str, Any] = {"db_name": db_name, "new_role": new_role}
+        args: Dict[str, Any] = {"db_name": db_name, "new_role": new_role,
+                                "epoch": int(epoch)}
         if upstream:
             args["upstream_ip"], args["upstream_port"] = upstream
         self.call(addr, "change_db_role_and_upstream", **args)
+
+    def set_db_epoch(self, addr, db_name: str, epoch: int) -> None:
+        """Raise the db's fencing epoch without a role transition (the
+        sticky-leader adoption path)."""
+        self.call(addr, "set_db_epoch", db_name=db_name, epoch=int(epoch),
+                  timeout=10.0)
 
     def get_sequence_number(self, addr, db_name: str) -> Optional[int]:
         try:

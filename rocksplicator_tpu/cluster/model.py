@@ -7,6 +7,7 @@ Path conventions (the ZK tree equivalent):
     /clusters/<cluster>/assignments/<instance_id>      controller → participant
     /clusters/<cluster>/currentstates/<instance_id>    participant → world
     /clusters/<cluster>/partitionstate/<partition>     leader seq checkpoints
+    /clusters/<cluster>/epochs/<partition>             fencing epoch ledger
     /clusters/<cluster>/locks/partitions/<partition>   per-partition mutex
     /clusters/<cluster>/controller                     leader election
     /clusters/<cluster>/events/<partition>             leader-handoff history
@@ -67,13 +68,25 @@ class ResourceDef:
 
 @dataclass
 class PartitionAssignment:
-    """One partition's target on one instance."""
+    """One partition's target on one instance.
+
+    ``epoch`` is the partition's monotonic fencing epoch: the controller
+    bumps it exactly when leadership moves (see controller.py's epoch
+    ledger at ``/clusters/<cluster>/epochs/<partition>``) and stamps it
+    on every assignment. Participants thread it into the data plane
+    (``change_db_role_and_upstream``/``add_db``), where the ReplicatedDB
+    attaches it to every replicate/ack frame — followers and the ack
+    path reject stale-epoch traffic, so a deposed leader can never ack a
+    write after the new leader's epoch is visible (the no-split-brain
+    invariant the chaos harness holds)."""
 
     state: str
     upstream: Optional[str] = None  # "host:repl_port" of the leader
+    epoch: int = 0
 
     def to_json(self) -> dict:
-        return {"state": self.state, "upstream": self.upstream}
+        return {"state": self.state, "upstream": self.upstream,
+                "epoch": self.epoch}
 
 
 def encode_assignments(assignments: Dict[str, PartitionAssignment]) -> bytes:

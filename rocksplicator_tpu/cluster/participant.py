@@ -15,6 +15,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from ..testing import failpoints as fp
+from ..utils.stats import Stats
 from .coordinator import CoordinatorClient
 from .helix_utils import AdminClient
 from .model import (
@@ -63,6 +65,11 @@ class Participant:
         self.factory = factory_cls(self.ctx)
         self._current: Dict[str, str] = {}
         self._applied_upstream: Dict[str, str] = {}
+        self._applied_epoch: Dict[str, int] = {}
+        # set when a rejoin attempt failed mid-way: the periodic seq
+        # loop retries it (heartbeats succeed on the fresh session, so
+        # NO_SESSION never fires again to re-trigger the callback)
+        self._rejoin_pending = False
         self._state_lock = threading.Lock()
         self._publish_lock = threading.Lock()
         self._executor = ThreadPoolExecutor(
@@ -81,6 +88,11 @@ class Participant:
             self._path("currentstates", instance.instance_id),
             encode_states({}),
         )
+        # session-expiry recovery (the ZK session-re-establishment
+        # analog): a reaped participant re-registers its ephemeral
+        # instance node, republishes current state, and re-evaluates
+        # assignments — serving resumes WITHOUT a process restart
+        self.coord.on_session_reestablished = self._rejoin
         self._watch_stop = self.coord.watch(
             self._path("assignments", instance.instance_id),
             self._on_assignments,
@@ -99,6 +111,8 @@ class Participant:
 
         while not self._stopped:
             time.sleep(interval)
+            if self._rejoin_pending and not self._stopped:
+                self._rejoin()
             try:
                 for partition, state in self.current_states.items():
                     if state not in ("LEADER", "MASTER"):
@@ -119,6 +133,10 @@ class Participant:
         if self._stopped:
             return
         targets = decode_assignments(bytes(snap.get("value") or b""))
+        for partition, target in targets.items():
+            # epochs flow to the state models through the context; noted
+            # BEFORE any transition below reads them
+            self.ctx.note_partition_epoch(partition, target.epoch)
         with self._state_lock:
             partitions = set(targets) | set(self._current)
             for partition in partitions:
@@ -144,7 +162,23 @@ class Participant:
                         self._inflight[partition] = True
                         self._executor.submit(
                             self._run_repoint, partition, target_state,
-                            target.upstream,
+                            target.upstream, target.epoch,
+                        )
+                    elif (
+                        target is not None
+                        and target_state in ("LEADER", "MASTER",
+                                             "FOLLOWER", "SLAVE")
+                        and target.epoch
+                        > self._applied_epoch.get(partition, 0)
+                    ):
+                        # state AND upstream already right but the epoch
+                        # moved (sticky leader across a ledger re-mint, or
+                        # a follower whose upstream survived a chained
+                        # handoff): adopt in place — followers carrying
+                        # the new epoch would otherwise fence this node
+                        self._inflight[partition] = True
+                        self._executor.submit(
+                            self._run_adopt_epoch, partition, target.epoch
                         )
                     continue
                 self._inflight[partition] = True
@@ -154,7 +188,12 @@ class Participant:
 
     def _run_transition(self, partition: str, from_state: str,
                         to_state: str) -> None:
+        epoch = self.ctx.partition_epoch(partition)
         try:
+            # the control-plane seam where a transition touches durable
+            # state: a trip lands in the ERROR + paced-retry path below,
+            # exactly like a real failed transition
+            fp.hit("participant.transition")
             model = self.factory.get(partition)
             # ERROR recovers via OFFLINE (Helix resets ERROR->OFFLINE)
             plan_from = OFFLINE if from_state == ERROR else from_state
@@ -173,6 +212,12 @@ class Participant:
                 model.transition(a, b)
                 state = b
                 self._set_current(partition, state)
+            with self._state_lock:
+                # the epoch captured BEFORE the transition ran: a bump
+                # landing mid-flight stays > applied, so the re-evaluation
+                # below schedules the adoption
+                if epoch > self._applied_epoch.get(partition, 0):
+                    self._applied_epoch[partition] = epoch
         except Exception:
             log.exception("%s: transition %s->%s failed", partition,
                           from_state, to_state)
@@ -197,18 +242,23 @@ class Participant:
                     log.exception(
                         "%s: post-transition re-evaluation failed", partition)
 
-    def _run_repoint(self, partition: str, state: str, upstream: str) -> None:
+    def _run_repoint(self, partition: str, state: str, upstream: str,
+                     epoch: int = 0) -> None:
         from ..utils.segment_utils import partition_name_to_db_name
 
         try:
             host, _, port = upstream.partition(":")
             db_name = partition_name_to_db_name(partition)
-            log.info("%s: repointing upstream -> %s", partition, upstream)
+            log.info("%s: repointing upstream -> %s (epoch %d)",
+                     partition, upstream, epoch)
             self.ctx.admin.change_db_role_and_upstream(
-                self.ctx.local_admin_addr, db_name, state, (host, int(port))
+                self.ctx.local_admin_addr, db_name, state, (host, int(port)),
+                epoch=epoch,
             )
             with self._state_lock:
                 self._applied_upstream[partition] = upstream
+                if epoch > self._applied_epoch.get(partition, 0):
+                    self._applied_epoch[partition] = epoch
         except Exception:
             log.exception("%s: repoint failed", partition)
             # paced like _run_transition: the finally-block re-evaluation
@@ -235,6 +285,82 @@ class Participant:
                 except Exception:
                     log.exception(
                         "%s: post-repoint re-evaluation failed", partition)
+
+    def _run_adopt_epoch(self, partition: str, epoch: int) -> None:
+        """In-place fencing-epoch adoption: state and upstream already
+        match the assignment, only the epoch moved. No reopen — the
+        ReplicatedDB just raises its epoch (monotonic)."""
+        from ..utils.segment_utils import partition_name_to_db_name
+
+        try:
+            self.ctx.admin.set_db_epoch(
+                self.ctx.local_admin_addr,
+                partition_name_to_db_name(partition), epoch,
+            )
+            with self._state_lock:
+                if epoch > self._applied_epoch.get(partition, 0):
+                    self._applied_epoch[partition] = epoch
+        except Exception:
+            log.exception("%s: epoch adoption failed", partition)
+            time.sleep(self.error_retry_backoff)
+        finally:
+            with self._state_lock:
+                self._inflight.pop(partition, None)
+            if not self._stopped:
+                try:
+                    raw = self.coord.get_or_none(
+                        self._path("assignments", self.instance.instance_id)
+                    )
+                    if raw is not None:
+                        self._on_assignments({"value": raw})
+                except Exception:
+                    log.exception(
+                        "%s: post-adopt re-evaluation failed", partition)
+
+    def _rejoin(self) -> None:
+        """Called by the coordinator client after it re-established an
+        expired session: the old session's ephemerals (our instance
+        registration) were reaped — re-register, republish current
+        state, and re-evaluate assignments so serving resumes without a
+        restart (reference: ZK session re-establishment → Helix
+        re-registers the live-instance znode)."""
+        if self._stopped:
+            return
+        self._rejoin_pending = False
+        try:
+            self.coord.ensure(self._path("instances"))
+            path = self._path("instances", self.instance.instance_id)
+            try:
+                self.coord.create(path, self.instance.encode(),
+                                  ephemeral=True)
+            except Exception:
+                # a stale node from the dead session the reaper hasn't
+                # collected yet — replace it under OUR session
+                self.coord.delete_if_exists(path)
+                self.coord.create(path, self.instance.encode(),
+                                  ephemeral=True)
+            with self._publish_lock:
+                with self._state_lock:
+                    snapshot = dict(self._current)
+                self.coord.put(
+                    self._path("currentstates", self.instance.instance_id),
+                    encode_states(snapshot),
+                )
+            Stats.get().incr("participant.rejoins")
+            log.warning(
+                "%s: session expired — re-registered and resumed",
+                self.instance.instance_id)
+            raw = self.coord.get_or_none(
+                self._path("assignments", self.instance.instance_id))
+            if raw is not None:
+                self._on_assignments({"value": raw})
+        except Exception:
+            # a transient failure here (e.g. the coordinator itself
+            # failing over) must not strand the node unregistered
+            # forever: the periodic seq loop retries
+            self._rejoin_pending = True
+            log.exception("%s: rejoin after session expiry failed "
+                          "(will retry)", self.instance.instance_id)
 
     def _set_current(self, partition: str, state: str) -> None:
         # _publish_lock serializes snapshot+put as one unit so concurrent

@@ -13,10 +13,17 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.misc import write_file_atomic
+from ..utils.retry_policy import RetryPolicy, retry_call, seeded_rng
 from .coordinator import CoordinatorClient
 from .model import cluster_path
 
 log = logging.getLogger(__name__)
+
+# the materialize-to-disk write retried like any other transient I/O:
+# bounded, growing, jittered, deterministic under RSTPU_RETRY_SEED, and
+# visible as retry.attempts op=shardmap.write on /stats (the refresh loop
+# itself — the coordinator watch — retries via the client's own policy)
+_WRITE_RETRY = RetryPolicy(max_attempts=4, base_delay=0.05, max_delay=0.5)
 
 
 class ShardMapAgent:
@@ -27,6 +34,7 @@ class ShardMapAgent:
                  coord_fallbacks: Optional[List[Tuple[str, int]]] = None):
         self.cluster = cluster
         self.target_path = target_path
+        self._rng = seeded_rng()
         self.coord = CoordinatorClient(coord_host, coord_port,
                                        fallbacks=coord_fallbacks)
         self._watch_stop = self.coord.watch(
@@ -36,8 +44,15 @@ class ShardMapAgent:
     def _on_map(self, snap: dict) -> None:
         if not snap.get("exists"):
             return
+        value = bytes(snap["value"])
         try:
-            write_file_atomic(self.target_path, bytes(snap["value"]))
+            retry_call(
+                lambda: write_file_atomic(self.target_path, value),
+                policy=_WRITE_RETRY,
+                classify=lambda e: isinstance(e, OSError),
+                op="shardmap.write",
+                rng=self._rng,
+            )
         except Exception:
             log.exception("shard map agent write failed")
 

@@ -14,12 +14,19 @@ import logging
 import threading
 from typing import List, Optional, Tuple
 
+from ..testing import failpoints as fp
+from ..utils.retry_policy import RetryPolicy, backoff_step, seeded_rng
 from .config_generator import generate_shard_map
 from .coordinator import CoordinatorClient
 from .model import cluster_path
 from .publishers import DedupPublisher, ParallelPublisher, ShardMapPublisher
 
 log = logging.getLogger(__name__)
+
+# control-plane refresh retry: growing jittered backoff, deterministic
+# under RSTPU_RETRY_SEED (same contract as the follower pull loop)
+_REFRESH_RETRY = RetryPolicy(max_attempts=1 << 30, base_delay=0.2,
+                             max_delay=2.0, floor=0.1)
 
 
 class Spectator:
@@ -55,6 +62,8 @@ class Spectator:
         self._kick.set()
 
     def _run(self) -> None:
+        rng = seeded_rng()
+        attempt = 0
         while not self._stop.is_set():
             try:
                 if self._standalone:
@@ -71,12 +80,23 @@ class Spectator:
                         self._kick.clear()
                         continue
                 self.publish_once()
+                attempt = 0
             except Exception:
                 log.exception("spectator loop error")
+                # growing jittered backoff instead of the flat 1 s wait:
+                # a wedged publisher/coordinator is retried politely and
+                # visibly (retry.attempts op=spectator.publish on /stats)
+                backoff_step(_REFRESH_RETRY, attempt,
+                             op="spectator.publish", rng=rng)
+                attempt += 1
             self._kick.wait(1.0)
             self._kick.clear()
 
     def publish_once(self) -> dict:
+        # control plane touching durable state (the shard-map file /
+        # coordinator node every router reads): a tripped fault here is
+        # absorbed by the loop's retry backoff
+        fp.hit("shardmap.publish")
         shard_map = generate_shard_map(self.coord, self.cluster)
         self._publisher.publish(shard_map)
         return shard_map

@@ -105,6 +105,21 @@ class ClusterContext:
         self.catch_up_timeout = catch_up_timeout
         self._path = lambda *p: cluster_path(cluster, *p)
         self._view_path = lambda *p: cluster_path(self.view_cluster, *p)
+        # controller-stamped fencing epochs, noted by the participant on
+        # every assignment update; state models thread them into the
+        # data plane (add_db / change_db_role_and_upstream)
+        self._partition_epochs: Dict[str, int] = {}
+
+    # -- fencing epochs ----------------------------------------------------
+
+    def note_partition_epoch(self, partition: str, epoch: int) -> None:
+        """Epochs are monotonic: never regress a noted value."""
+        epoch = int(epoch or 0)
+        if epoch > self._partition_epochs.get(partition, 0):
+            self._partition_epochs[partition] = epoch
+
+    def partition_epoch(self, partition: str) -> int:
+        return self._partition_epochs.get(partition, 0)
 
     # -- identity ----------------------------------------------------------
 

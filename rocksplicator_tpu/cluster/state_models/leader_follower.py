@@ -138,9 +138,11 @@ class LeaderFollowerStateModel(StateModel):
                 else (best_addr.host, best_addr.repl_port) if best_addr
                 else ctx.local_repl_addr  # bootstrap: self-upstream, no-op
             )
+            epoch = ctx.partition_epoch(self.partition)
             try:
                 ctx.admin.add_db(
-                    ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream
+                    ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream,
+                    epoch=epoch,
                 )
             except RpcApplicationError as e:
                 if e.code != "DB_ALREADY_EXISTS":
@@ -149,7 +151,8 @@ class LeaderFollowerStateModel(StateModel):
                 # (e.g. a failed promotion retries via OFFLINE): converge
                 # role/upstream instead of failing the whole transition
                 ctx.admin.change_db_role_and_upstream(
-                    ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream
+                    ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream,
+                    epoch=epoch,
                 )
             # needRebuildDB: far behind the best replica -> snapshot rebuild
             local = ctx.admin.get_sequence_number(
@@ -214,6 +217,7 @@ class LeaderFollowerStateModel(StateModel):
                 ctx.admin.change_db_role_and_upstream(
                     ctx.local_admin_addr, self.db_name, "FOLLOWER",
                     (best_info.host, best_info.repl_port),
+                    epoch=ctx.partition_epoch(self.partition),
                 )
                 # margin=0: the peer has no leader feeding it, so its seq
                 # is static and exact catch-up terminates. Promoting even
@@ -242,8 +246,12 @@ class LeaderFollowerStateModel(StateModel):
                     f"{self.partition}: local seq {local} too far behind "
                     f"last leader seq {persisted}; refusing promotion"
                 )
+            # the promotion carries the controller-minted epoch: every
+            # ack this leader hands out is stamped with it, and any
+            # deposed predecessor seeing it on a follower frame fences
             ctx.admin.change_db_role_and_upstream(
-                ctx.local_admin_addr, self.db_name, "LEADER"
+                ctx.local_admin_addr, self.db_name, "LEADER",
+                epoch=ctx.partition_epoch(self.partition),
             )
             ctx.set_partition_seq(self.partition, local)
             ctx.log_event(self.partition, "follower_to_leader_success")
@@ -260,9 +268,32 @@ class LeaderFollowerStateModel(StateModel):
         seq = ctx.admin.get_sequence_number(ctx.local_admin_addr, self.db_name)
         if seq is not None:
             ctx.set_partition_seq(self.partition, seq)
-        upstream = self._current_leader_addr() or ctx.local_repl_addr
+        other_leader = self._current_leader_addr()
+        if other_leader is not None:
+            # DEPOSED demote: another leader is already serving, so this
+            # is not the demote phase of a two-phase handoff (which runs
+            # with no live leader) — we were deposed while unreachable.
+            # Any locally-committed un-acked suffix may diverge from the
+            # new lineage, and sequence arithmetic cannot prove it safe
+            # (the new leader's seq can overtake ours while histories
+            # differ). Resync from scratch: clear the storage and rejoin
+            # through the Offline→Follower path, which rebuilds from a
+            # peer snapshot or WAL catch-up.
+            ctx.log_event(self.partition, "deposed_resync_init",
+                          f"local_seq={seq}")
+            try:
+                ctx.admin.clear_db(ctx.local_admin_addr, self.db_name,
+                                   reopen=False)
+            except RpcApplicationError as e:
+                if e.code != "DB_NOT_FOUND":
+                    raise
+            self.on_become_follower_from_offline()
+            ctx.log_event(self.partition, "deposed_resync_success")
+            return
+        upstream = ctx.local_repl_addr
         ctx.admin.change_db_role_and_upstream(
-            ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream
+            ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream,
+            epoch=ctx.partition_epoch(self.partition),
         )
         ctx.log_event(self.partition, "leader_to_follower_success")
 

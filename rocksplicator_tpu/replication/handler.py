@@ -27,6 +27,7 @@ class ReplicatorHandler:
         max_updates: Optional[int] = None,
         role: str = ReplicaRole.FOLLOWER.value,
         applied_seq: Optional[int] = None,
+        epoch: Optional[int] = None,
     ) -> dict:
         span = current_span()
         if span is not None and span.sampled:
@@ -44,6 +45,7 @@ class ReplicatorHandler:
         return await db.handle_replicate_request(
             seq_no=seq_no, max_wait_ms=max_wait_ms,
             max_updates=max_updates, role=role, applied_seq=applied_seq,
+            epoch=epoch,
         )
 
     async def handle_replicate_ack(
@@ -51,6 +53,7 @@ class ReplicatorHandler:
         db_name: str = "",
         applied_seq: int = 0,
         role: str = ReplicaRole.FOLLOWER.value,
+        epoch: Optional[int] = None,
     ) -> dict:
         """Lightweight applied-position push from a pipelined puller whose
         next pull is a parked long-poll: lets mode-2 ack waiters resolve
@@ -60,5 +63,5 @@ class ReplicatorHandler:
             raise RpcApplicationError(
                 ReplicateErrorCode.SOURCE_NOT_FOUND.value, db_name
             )
-        db.post_applied(applied_seq, role)
-        return {"acked_seq": db._acked.value}
+        db.post_applied(applied_seq, role, epoch=epoch)
+        return {"acked_seq": db._acked.value, "epoch": db.epoch}

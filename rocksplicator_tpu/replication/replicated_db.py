@@ -100,12 +100,21 @@ class ReplicatedDB:
         replication_mode: int = 0,
         flags: Optional[ReplicationFlags] = None,
         leader_resolver: Optional[LeaderResolver] = None,
+        epoch: int = 0,
     ):
         self.name = name
         self.wrapper = wrapper
         self.role = role
         self.replication_mode = replication_mode
         self.upstream_addr = upstream_addr
+        # Fencing epoch (the controller-stamped assignment epoch; the
+        # ZK-zxid-epoch analog). Every replicate request/response and
+        # replicate_ack frame carries one; see _reject_stale_epoch for
+        # the rules. 0 = unfenced legacy plumbing (epoch checks only
+        # engage when a frame carries a strictly newer epoch).
+        self.epoch = int(epoch or 0)
+        self._epoch_lock = threading.Lock()
+        self._fenced_by: Optional[int] = None
         self.flags = flags or ReplicationFlags()
         self._loop = loop
         self._executor = executor
@@ -202,6 +211,72 @@ class ReplicatedDB:
         return self._removed
 
     # ------------------------------------------------------------------
+    # fencing (monotonic epoch, end to end)
+    # ------------------------------------------------------------------
+
+    @property
+    def fenced(self) -> bool:
+        return self._fenced_by is not None
+
+    def adopt_epoch(self, epoch: int) -> None:
+        """Raise this db's epoch (never lowers, never fences). Used by
+        followers adopting a newer epoch from upstream responses and by
+        the admin set_db_epoch path (a sticky leader whose assignment
+        epoch moved without a role transition)."""
+        epoch = int(epoch)
+        with self._epoch_lock:
+            if epoch > self.epoch:
+                self.epoch = epoch
+
+    def _reject_stale_epoch(self, remote_epoch) -> bool:
+        """Process the epoch carried on an inbound replicate/ack frame.
+
+        Followers/observers ADOPT a newer epoch (assignments flow
+        controller → participant, but a chained or raced promotion can
+        reach the data plane first) and never reject. A LEADER (or NOOP)
+        seeing a newer epoch has been deposed — a new leader was
+        promoted under that epoch — so it fences itself: every pending
+        ack waiter resolves un-acked, and this and every future
+        replicate/ack/write is refused. Returns True when the caller
+        must raise STALE_EPOCH and post no acks.
+
+        This method is the no-split-brain guard the chaos harness's
+        ``--break-guard fencing`` tooth disables to prove the harness
+        catches a leader that ignores epochs."""
+        if remote_epoch is not None:
+            remote = int(remote_epoch)
+            if remote > self.epoch:
+                if self.role in (ReplicaRole.FOLLOWER, ReplicaRole.OBSERVER):
+                    self.adopt_epoch(remote)
+                    return False
+                self._fence(remote)
+        return self._fenced_by is not None
+
+    def _fence(self, remote_epoch: int) -> None:
+        with self._epoch_lock:
+            first = self._fenced_by is None
+            self._fenced_by = max(self._fenced_by or 0, int(remote_epoch))
+        if first:
+            self._stats.incr(M["fenced"])
+            log.warning(
+                "%s: FENCED — epoch %d deposed by %d; failing %d pending "
+                "acks, refusing further writes", self.name, self.epoch,
+                self._fenced_by, self._acked.depth)
+            # every in-flight waiter resolves un-acked NOW: a deposed
+            # leader must not sit out ack timeouts pretending its window
+            # might still land
+            self._acked.close()
+
+    def _check_fenced(self) -> None:
+        fenced_by = self._fenced_by
+        if fenced_by is not None:
+            raise RpcApplicationError(
+                ReplicateErrorCode.STALE_EPOCH.value,
+                f"{self.name}: leader epoch {self.epoch} deposed by "
+                f"epoch {fenced_by}",
+            )
+
+    # ------------------------------------------------------------------
     # leader write path (any thread)
     # ------------------------------------------------------------------
 
@@ -242,6 +317,7 @@ class ReplicatedDB:
             raise RpcApplicationError(
                 "NOT_LEADER", f"{self.name} role is {self.role.value}"
             )
+        self._check_fenced()
         # The per-write trace: root span with wal_write through fsync;
         # the ack_wait phase becomes a DEFERRED child span finished at
         # ack resolution, so sampled traces show the real (overlapping)
@@ -280,6 +356,7 @@ class ReplicatedDB:
             raise RpcApplicationError(
                 "NOT_LEADER", f"{self.name} role is {self.role.value}"
             )
+        self._check_fenced()
         with start_span("repl.write_group", db=self.name,
                         n=len(batches)) as sp:
             total_bytes = 0
@@ -354,7 +431,9 @@ class ReplicatedDB:
                 if self._degraded:
                     self._degraded = False
                     log.info("%s: ACK degradation recovered", self.name)
-        elif not self._removed:
+        elif not self._removed and self._fenced_by is None:
+            # fence-failed waiters are not timeouts: the leader is
+            # deposed, not degraded — keep the degradation machine clean
             f = self.flags
             self._stats.incr(M["ack_timeouts"])
             with self._ack_state_lock:
@@ -449,6 +528,7 @@ class ReplicatedDB:
         max_updates: Optional[int] = None,
         role: str = ReplicaRole.FOLLOWER.value,
         applied_seq: Optional[int] = None,
+        epoch: Optional[int] = None,
     ) -> dict:
         """Serve updates after ``seq_no`` (the puller's WAL cursor).
         Returns {updates, latest_seq, source_role}; updates is empty on a
@@ -460,7 +540,22 @@ class ReplicatedDB:
         apply executor (the next pull is issued while the previous
         response is still applying), so acking off ``seq_no`` would
         over-claim in mode 2. Absent (legacy pullers), the cursor IS the
-        applied position."""
+        applied position.
+
+        ``epoch`` is the puller's fencing epoch. A pull carrying a newer
+        epoch than ours proves a newer leader was promoted: we are
+        deposed — reject the frame (STALE_EPOCH), post NO acks, fail the
+        pending ack window, refuse further writes. This is what stops a
+        demoted-but-still-running leader from acking a write after the
+        new leader's epoch is visible to its followers."""
+        if self._reject_stale_epoch(epoch):
+            self._stats.incr(M["stale_epoch_rejects"])
+            raise RpcApplicationError(
+                ReplicateErrorCode.STALE_EPOCH.value,
+                f"{self.name}: serving epoch {self.epoch} < puller epoch "
+                f"{epoch}" if epoch is not None else
+                f"{self.name}: fenced by epoch {self._fenced_by}",
+            )
         f = self.flags
         max_wait_ms = f.server_long_poll_ms if max_wait_ms is None else max_wait_ms
         max_updates = (
@@ -508,7 +603,8 @@ class ReplicatedDB:
             if latest <= seq_no:
                 return {"updates": [], "latest_seq": latest,
                         "source_role": self.role.value,
-                        "replication_mode": self.replication_mode}
+                        "replication_mode": self.replication_mode,
+                        "epoch": self.epoch}
             try:
                 with start_span("repl.wal_read") as sp_read:
                     # Cached-cursor fast path: serve INLINE on the loop.
@@ -563,7 +659,8 @@ class ReplicatedDB:
             sp.annotate(latest_seq=latest)
             return {"updates": updates, "latest_seq": latest,
                     "source_role": self.role.value,
-                    "replication_mode": self.replication_mode}
+                    "replication_mode": self.replication_mode,
+                    "epoch": self.epoch}
 
     def _read_updates(self, from_seq: int, max_updates: int,
                       it=None) -> List[dict]:
@@ -660,6 +757,11 @@ class ReplicatedDB:
                 self._conn_errors = 0
                 if e.code == ReplicateErrorCode.SOURCE_NOT_FOUND.value:
                     await self._maybe_reset_upstream(force_sample=False)
+                elif e.code == ReplicateErrorCode.STALE_EPOCH.value:
+                    # a KNOWN-deposed upstream (or one that outran us):
+                    # consult the resolver unsampled — faster pulls at
+                    # the stale leader cannot help
+                    await self._maybe_reset_upstream(force_sample=True)
                 await self._pull_error_delay()
             except RpcTransportConfigError as e:
                 # a MISCONFIG, not a connection error: loud (ERROR, not
@@ -736,6 +838,9 @@ class ReplicatedDB:
                     "max_wait_ms": f.server_long_poll_ms,
                     "max_updates": self._cur_max_updates,
                     "role": self.role.value,
+                    # fencing: our epoch rides the request frame header —
+                    # a deposed upstream seeing a newer one fences itself
+                    "epoch": self.epoch,
                 },
                 timeout=(f.server_long_poll_ms + f.pull_rpc_margin_ms) / 1000.0,
             )
@@ -745,6 +850,21 @@ class ReplicatedDB:
                 result = await self._call_racing_apply(client, call_coro)
             updates = result.get("updates", []) if result else []
             source_role = result.get("source_role") if result else None
+            resp_epoch = result.get("epoch") if result else None
+            if resp_epoch is not None:
+                if int(resp_epoch) > self.epoch:
+                    # a promotion reached the data plane before our
+                    # assignment did — adopt; epochs only move forward
+                    self.adopt_epoch(int(resp_epoch))
+                elif int(resp_epoch) < self.epoch:
+                    # deposed upstream: its updates may carry a divergent
+                    # un-acked suffix — apply NOTHING, repoint instead
+                    self._stats.incr(M["stale_epoch_rejects"])
+                    raise RpcApplicationError(
+                        ReplicateErrorCode.STALE_EPOCH.value,
+                        f"{self.name}: upstream {host}:{port} epoch "
+                        f"{resp_epoch} < ours {self.epoch}",
+                    )
             if result and result.get("replication_mode") is not None:
                 self._upstream_mode = int(result["replication_mode"])
             self._adapt_max_updates(result, updates)
@@ -806,6 +926,7 @@ class ReplicatedDB:
                     "db_name": self.name,
                     "applied_seq": self._applied_through,
                     "role": self.role.value,
+                    "epoch": self.epoch,
                 },
                 timeout=2.0,
             )
@@ -813,10 +934,20 @@ class ReplicatedDB:
             log.debug("%s: replicate_ack push failed", self.name,
                       exc_info=True)
 
-    def post_applied(self, applied_seq: int, role: str) -> None:
+    def post_applied(self, applied_seq: int, role: str,
+                     epoch: Optional[int] = None) -> None:
         """Server side of the replicate_ack push: count the follower's
         durably-applied position toward mode-2 acks (OBSERVERs never
-        count, same as the pull path)."""
+        count, same as the pull path). Same epoch fencing as the pull
+        path: an ack carrying a newer epoch deposes this leader and must
+        never resolve a waiter."""
+        if self._reject_stale_epoch(epoch):
+            self._stats.incr(M["stale_epoch_rejects"])
+            raise RpcApplicationError(
+                ReplicateErrorCode.STALE_EPOCH.value,
+                f"{self.name}: ack epoch {epoch} fences serving epoch "
+                f"{self.epoch}",
+            )
         if role != ReplicaRole.OBSERVER.value and self.replication_mode == 2:
             self._acked.post(int(applied_seq))
 
@@ -979,5 +1110,6 @@ class ReplicatedDB:
             f"acked_seq={self._acked.value} "
             f"ack_window={self._acked.depth}/{self._acked.capacity} "
             f"upstream={self.upstream_addr} "
+            f"epoch={self.epoch} fenced_by={self._fenced_by} "
             f"degraded={self._degraded} removed={self._removed}"
         )

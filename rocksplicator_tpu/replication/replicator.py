@@ -103,6 +103,7 @@ class Replicator:
         upstream_addr: Optional[Tuple[str, int]] = None,
         replication_mode: Optional[int] = None,
         leader_resolver: Optional[LeaderResolver] = None,
+        epoch: int = 0,
     ) -> ReplicatedDB:
         """Register a db for replication. Duplicate names are an error
         (reference returns DB_ALREADY_EXISTS)."""
@@ -124,6 +125,7 @@ class Replicator:
             replication_mode=replication_mode,
             flags=self._flags,
             leader_resolver=leader_resolver,
+            epoch=epoch,
         )
         if not self._dbs.add(name, rdb):
             raise ValueError(f"db already exists: {name}")

@@ -26,6 +26,14 @@ class ReplicateErrorCode(str, enum.Enum):
     SOURCE_NOT_FOUND = "SOURCE_NOT_FOUND"
     SOURCE_READ_ERROR = "SOURCE_READ_ERROR"
     SOURCE_REMOVED = "SOURCE_REMOVED"
+    # Fencing (the ZK-zxid-epoch analog, threaded end to end from the
+    # controller's assignment epoch): a replicate/ack frame carrying a
+    # NEWER epoch than the serving db proves a newer leader was promoted
+    # — the server is deposed and must reject the frame, fail its
+    # pending ack window, and refuse further writes. A frame carrying an
+    # OLDER epoch than the puller's known epoch marks a stale (deposed)
+    # upstream whose updates must not be applied.
+    STALE_EPOCH = "STALE_EPOCH"
 
 
 # Counter/metric names (reference rocksdb_replicator/replicator_stats.{h,cpp})
@@ -44,6 +52,8 @@ REPLICATOR_METRICS = dict(
     pull_bytes_applied="replicator.pull_bytes_applied",
     pull_errors="replicator.pull_errors",
     upstream_resets="replicator.upstream_resets",
+    stale_epoch_rejects="replicator.stale_epoch_rejects",
+    fenced="replicator.fenced",
     replication_lag_ms="replicator.replication_lag_ms",
     iter_cache_hits="replicator.iter_cache_hits",
     iter_cache_misses="replicator.iter_cache_misses",

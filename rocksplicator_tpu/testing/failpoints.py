@@ -48,7 +48,8 @@ Registered sites (grep for the literal name):
     objectstore.get  objectstore.put  s3.request  hdfs.request
     rpc.connect  rpc.frame.send  rpc.frame.recv
     repl.pull  repl.apply  ack.expire
-    coordinator.heartbeat  coordinator.reap
+    coordinator.heartbeat  coordinator.reap  coordinator.wal.append
+    participant.transition  shardmap.publish  controller.assign
     admin.ingest.engine  admin.ingest.meta
 """
 
@@ -80,7 +81,8 @@ SITES = frozenset({
     "objectstore.get", "objectstore.put", "s3.request", "hdfs.request",
     "rpc.connect", "rpc.frame.send", "rpc.frame.recv",
     "repl.pull", "repl.apply", "ack.expire",
-    "coordinator.heartbeat", "coordinator.reap",
+    "coordinator.heartbeat", "coordinator.reap", "coordinator.wal.append",
+    "participant.transition", "shardmap.publish", "controller.assign",
     "admin.ingest.engine", "admin.ingest.meta",
 })
 

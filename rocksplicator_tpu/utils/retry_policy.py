@@ -29,7 +29,19 @@ import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
-__all__ = ["RetryPolicy", "RetryBudget", "retry_call", "backoff_step"]
+__all__ = ["RetryPolicy", "RetryBudget", "retry_call", "backoff_step",
+           "seeded_rng"]
+
+
+def seeded_rng(env_var: str = "RSTPU_RETRY_SEED") -> random.Random:
+    """The one place the seed-pinning contract lives: a private RNG
+    seeded from ``env_var`` when set (reproducible chaos runs), random
+    otherwise. Every retry loop that jitters should draw from one of
+    these, not the global ``random``."""
+    import os
+
+    seed = os.environ.get(env_var)
+    return random.Random(int(seed) if seed else None)
 
 
 class RetryBudget:

@@ -34,11 +34,41 @@ teeth (the acceptance demo):
 
 With ``--expect-violation`` the run exits 0 iff a violation WAS caught.
 
+``--failover`` switches to the COORDINATOR-BACKED schedule menu
+(round 11): a durable coordinator primary + replicated standby, a
+Controller, a Spectator publishing the shard map, and 3 participant
+hosts running one replicas=3 semi-sync shard. Seeded schedules kill the
+acting leader while it holds a full AckWindow (heartbeats wedged, data
+plane alive — the classic deposed-but-running leader), expire
+participant sessions mid-write via the ``coordinator.heartbeat`` seam,
+kill the coordinator primary, torn-write the coordinator WAL
+(``coordinator.wal.append``), and blip the
+``participant.transition`` / ``controller.assign`` /
+``shardmap.publish`` / ``coordinator.reap`` seams. After EVERY schedule
+the harness holds the **fourth standing invariant**:
+
+4. **failover under fault** — exactly one LEADER per shard (current
+   states AND the published shard map), zero acked-write loss across
+   the handoff (strict ledger: pre-fault + post-promotion acks; acks
+   landing inside the visibility window are counted separately), zero
+   stale acks (a deposed leader must not ack a single write after the
+   new leader's epoch is visible — enforced by the end-to-end fencing
+   epochs), and shard-map convergence within a bounded number of
+   controller passes.
+
+- ``fencing`` (``--failover`` only) — the leader IGNORES epochs
+  (``ReplicatedDB._reject_stale_epoch`` patched to a no-op): the
+  stale-frame probes in the leader-crash schedule must catch it acking
+  writes after deposition (SPLIT BRAIN).
+
 Usage::
 
     python -m tools.chaos_soak --schedules 20 --seed 1          # soak
     python -m tools.chaos_soak --break-guard wal_hole \
         --expect-violation                                      # teeth
+    python -m tools.chaos_soak --failover --schedules 15 --seed 1
+    python -m tools.chaos_soak --failover --break-guard fencing \
+        --expect-violation                                      # tooth
 """
 
 from __future__ import annotations
@@ -271,6 +301,264 @@ class IngestFixture:
 
 
 # ---------------------------------------------------------------------------
+# coordinator-backed failover chaos (the control-plane schedule menu)
+# ---------------------------------------------------------------------------
+
+# faults the failover schedules arm (registration asserted by tests the
+# same way the data-plane menu is)
+_FAILOVER_FAULT_SITES = [
+    "coordinator.heartbeat", "coordinator.reap", "coordinator.wal.append",
+    "participant.transition", "shardmap.publish", "controller.assign",
+    "repl.pull",
+]
+
+FAILOVER_SESSION_TTL = 1.0
+FAILOVER_FLAGS = ReplicationFlags(
+    server_long_poll_ms=300,
+    pull_error_delay_min_ms=30,
+    pull_error_delay_max_ms=200,
+    ack_timeout_ms=800,
+    consecutive_timeouts_to_degrade=1000,
+    write_window=16,
+)
+# "shard-map convergence within a bounded number of controller passes":
+# the reconcile loop runs every 0.25 s, so this bound also caps heal time
+FAILOVER_PASS_BOUND = 80
+_LEADERLIKE = {"LEADER", "MASTER"}
+
+
+class FailoverNode:
+    """One 'host': replicator + admin service + participant."""
+
+    def __init__(self, root: str, name: str, coord_port: int, cluster: str,
+                 fallbacks, store_uri: str):
+        from rocksplicator_tpu.admin.handler import AdminHandler
+        from rocksplicator_tpu.cluster.model import InstanceInfo
+        from rocksplicator_tpu.cluster.participant import Participant
+        from rocksplicator_tpu.rpc.server import RpcServer
+
+        self.name = name
+        self.replicator = Replicator(port=0, flags=FAILOVER_FLAGS)
+        self.handler = AdminHandler(
+            os.path.join(root, "admin", name), self.replicator)
+        self.server = RpcServer(port=0, ioloop=self.replicator.ioloop)
+        self.server.add_handler(self.handler)
+        self.server.start()
+        self.instance = InstanceInfo(
+            instance_id=f"127.0.0.1_{self.server.port}",
+            host="127.0.0.1",
+            admin_port=self.server.port,
+            repl_port=self.replicator.port,
+            az=f"az-{name}",
+        )
+        self.participant = Participant(
+            "127.0.0.1", coord_port, cluster, self.instance,
+            backup_store_uri=store_uri, catch_up_timeout=10.0,
+            error_retry_backoff=0.2, coord_fallbacks=fallbacks,
+        )
+        # data-plane self-healing: followers can repoint from the pull
+        # loop's forced-reset path without waiting on a controller write
+        self.handler.set_leader_resolver(
+            self.participant.make_leader_resolver())
+
+    def state_of(self, partition: str):
+        return self.participant.current_states.get(partition)
+
+    def rdb(self, db_name: str):
+        return self.replicator.get_db(db_name)
+
+    def stop(self) -> None:
+        try:
+            self.participant.stop()
+        except Exception:
+            pass
+        self.server.stop()
+        self.handler.close()
+        self.replicator.stop()
+
+
+class FailoverCluster:
+    """Coordinator primary + standby (durable, replicated), a Controller,
+    a Spectator publishing the shard map, and 3 participant hosts running
+    one replicas=3 LeaderFollower resource in semi-sync mode — the
+    reference Helix topology in one process, chaos-sized."""
+
+    def __init__(self, root: str):
+        import itertools as _it
+
+        from rocksplicator_tpu.cluster.controller import Controller
+        from rocksplicator_tpu.cluster.coordinator import CoordinatorServer
+        from rocksplicator_tpu.cluster.coordinator import CoordinatorClient
+        from rocksplicator_tpu.cluster.model import ResourceDef
+        from rocksplicator_tpu.cluster.publishers import CallbackPublisher
+        from rocksplicator_tpu.cluster.spectator import Spectator
+        from rocksplicator_tpu.rpc.client_pool import RpcClientPool
+        from rocksplicator_tpu.rpc.ioloop import IoLoop
+        from rocksplicator_tpu.utils.segment_utils import segment_to_db_name
+
+        self.root = root
+        self.cluster = "chaos"
+        self.segment = "seg"
+        self.num_shards = 1
+        self.partitions = [f"{self.segment}_{s}"
+                           for s in range(self.num_shards)]
+        self.db_names = [segment_to_db_name(self.segment, s)
+                         for s in range(self.num_shards)]
+        self._coord_seq = _it.count()
+        # the failover invariants are about SEMI-SYNC acks (mode 1): an
+        # ack means a follower received the write. Participant-created
+        # dbs take their mode from the per-segment config.
+        from rocksplicator_tpu.utils.dbconfig import DBConfigManager
+
+        mgr = DBConfigManager.get()
+        self._saved_dbconfig = dict(mgr.config.raw)
+        mgr.load_from_dict({self.segment: {"replication_mode": 1}})
+        self.primary = CoordinatorServer(
+            port=0, session_ttl=FAILOVER_SESSION_TTL,
+            data_dir=self._coord_dir())
+        self.standby = CoordinatorServer(
+            port=0, session_ttl=FAILOVER_SESSION_TTL,
+            data_dir=self._coord_dir(),
+            replica_of=("127.0.0.1", self.primary.port))
+        fallbacks = [("127.0.0.1", self.standby.port)]
+        store_uri = os.path.join(root, "bucket")
+        LocalObjectStore(store_uri)
+        self.nodes = [
+            FailoverNode(root, f"n{i}", self.primary.port, self.cluster,
+                         fallbacks, store_uri)
+            for i in range(3)
+        ]
+        self.controller = Controller(
+            "127.0.0.1", self.primary.port, self.cluster, "ctrl-1",
+            reconcile_interval=0.25, coord_fallbacks=fallbacks)
+        self.maps: List[Dict] = []
+        self.spectator = Spectator(
+            "127.0.0.1", self.primary.port, self.cluster,
+            [CallbackPublisher(self.maps.append)],
+            coord_fallbacks=fallbacks)
+        self.client = CoordinatorClient("127.0.0.1", self.primary.port,
+                                        fallbacks=fallbacks)
+        self.controller.add_resource(
+            ResourceDef(self.segment, num_shards=self.num_shards,
+                        replicas=3))
+        self._ioloop = IoLoop.default()
+        self._pool = RpcClientPool()
+
+    def _coord_dir(self) -> str:
+        return os.path.join(self.root, f"coord{next(self._coord_seq)}")
+
+    # -- RPC straight at a node's replication plane (the follower frame
+    # -- a harness probe fakes rides the REAL wire path)
+    def rpc(self, port: int, method: str, args: dict, timeout: float = 5.0):
+        async def go():
+            return await self._pool.call("127.0.0.1", port, method, args,
+                                         timeout=timeout)
+
+        return self._ioloop.run_sync(go(), timeout=timeout + 5)
+
+    # -- views ------------------------------------------------------------
+
+    def leader_node(self, partition: str,
+                    exclude=()) -> Optional[FailoverNode]:
+        for n in self.nodes:
+            if n in exclude:
+                continue
+            if n.state_of(partition) in _LEADERLIKE:
+                return n
+        return None
+
+    def states(self, partition: str) -> Dict[str, str]:
+        return {n.name: n.state_of(partition) for n in self.nodes}
+
+    def seqs(self, db_name: str) -> List[Optional[int]]:
+        out = []
+        for n in self.nodes:
+            app = n.handler.db_manager.get_db(db_name)
+            out.append(
+                app.db.latest_sequence_number_relaxed()
+                if app is not None else None)
+        return out
+
+    def wait(self, pred, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.05)
+        return pred()
+
+    def wait_initial_convergence(self, timeout: float = 30.0) -> None:
+        def ready():
+            for partition in self.partitions:
+                st = sorted(s for s in self.states(partition).values() if s)
+                if st != ["FOLLOWER", "FOLLOWER", "LEADER"]:
+                    return False
+            return True
+
+        if not self.wait(ready, timeout):
+            raise RuntimeError(
+                f"failover cluster never converged: "
+                f"{[self.states(p) for p in self.partitions]}")
+
+    # -- workload ---------------------------------------------------------
+
+    def write_some(self, rng: random.Random, tag: str, n: int,
+                   acked: List[Tuple[bytes, bytes]],
+                   deadline_per_write: float = 3.0, exclude=()) -> int:
+        """n writes through the current leader; waits the ack futures and
+        appends acked (key, value) pairs. Returns how many writes errored
+        (fenced / no leader / mid-handoff)."""
+        errors = 0
+        waiters = []
+        for i in range(n):
+            key = f"{tag}-k{i:04d}".encode()
+            val = f"{tag}-v{i:04d}".encode()
+            node = self.leader_node(self.partitions[0], exclude=exclude)
+            if node is None:
+                errors += 1
+                continue
+            app = node.handler.db_manager.get_db(self.db_names[0])
+            if app is None:
+                errors += 1
+                continue
+            try:
+                waiters.append((key, val, app.write_async(
+                    WriteBatch().put(key, val))))
+            except Exception:
+                errors += 1
+        for key, val, w in waiters:
+            try:
+                w.future.result(deadline_per_write)
+            except Exception:
+                continue
+            if w.acked:
+                acked.append((key, val))
+        return errors
+
+    def stop(self) -> None:
+        for closer in (self.spectator.stop, self.controller.stop,
+                       self.client.close):
+            try:
+                closer()
+            except Exception:
+                pass
+        for n in self.nodes:
+            n.stop()
+        try:
+            self._ioloop.run_sync(self._pool.close(), timeout=5)
+        except Exception:
+            pass
+        for srv in (self.primary, self.standby):
+            try:
+                srv.stop()
+            except Exception:
+                pass
+        from rocksplicator_tpu.utils.dbconfig import DBConfigManager
+
+        DBConfigManager.get().load_from_dict(self._saved_dbconfig)
+
+
+# ---------------------------------------------------------------------------
 # deliberately-broken guards (harness-teeth demonstration)
 # ---------------------------------------------------------------------------
 
@@ -307,7 +595,507 @@ def _break_guard(kind: str):
 
         AdminHandler._do_ingest = broken_do
         return lambda: setattr(AdminHandler, "_do_ingest", orig_do)
+    if kind == "fencing":
+        # a leader that IGNORES epochs: stale-epoch frames are served and
+        # acked, a deposed leader never fences — the no-split-brain
+        # invariant must catch the acked-on-deposed-leader writes
+        from rocksplicator_tpu.replication.replicated_db import ReplicatedDB
+
+        orig_reject = ReplicatedDB._reject_stale_epoch
+        ReplicatedDB._reject_stale_epoch = (
+            lambda self, remote_epoch: False)
+        return lambda: setattr(
+            ReplicatedDB, "_reject_stale_epoch", orig_reject)
     raise ValueError(f"unknown break-guard: {kind}")
+
+
+# ---------------------------------------------------------------------------
+# failover schedules (every parameter drawn from the schedule RNG)
+# ---------------------------------------------------------------------------
+
+
+def _wait_replicas_equal(cluster: FailoverCluster, timeout: float = 10.0
+                         ) -> bool:
+    """Baseline writes are only held to the zero-loss invariant once they
+    are on EVERY replica — then any single survivor carries them through
+    arbitrary later flaps."""
+    def equal():
+        for db in cluster.db_names:
+            seqs = cluster.seqs(db)
+            if None in seqs or len(set(seqs)) != 1:
+                return False
+        return True
+
+    return cluster.wait(equal, timeout)
+
+
+def _schedule_leader_crash(cluster, rng, acked, violations, tag, timings):
+    """Crash the acting leader while it holds a full AckWindow, then
+    prove the no-split-brain invariant: after the new leader's epoch is
+    visible, the deposed leader cannot ack a single write. Follower
+    pulls are blocked for the whole window so NO ack can legitimately
+    land between fill and promotion."""
+    partition, db = cluster.partitions[0], cluster.db_names[0]
+    leader = cluster.leader_node(partition)
+    if leader is None:
+        violations.append(f"{tag}: no leader before the fault")
+        return
+    cluster.write_some(rng, tag + "-pre", rng.randint(6, 12), acked)
+    if not _wait_replicas_equal(cluster):
+        violations.append(f"{tag}: baseline never converged")
+        return
+    rdb = leader.rdb(db)
+    app = leader.handler.db_manager.get_db(db)
+    fp.activate("repl.pull",
+                f"fail_prob:1.0@seed{rng.randrange(1 << 16)}")
+    # drain pulls already PARKED in the leader's long-poll (they predate
+    # the failpoint and would legitimately serve+ack the fill writes)
+    time.sleep(FAILOVER_FLAGS.server_long_poll_ms / 1000.0 + 0.2)
+    base_seq = app.db.latest_sequence_number_relaxed()
+    # fill the window: none of these can ack while pulls are blocked
+    # (they expire un-acked on the 800 ms timeout — either way, zero acks)
+    pending = []
+    for i in range(min(rdb.ack_window_free, rng.randint(6, 16))):
+        key = f"{tag}-pend{i:03d}".encode()
+        try:
+            pending.append(
+                (key, key, app.write_async(WriteBatch().put(key, key))))
+        except Exception:
+            break
+    t_fault = time.monotonic()
+    leader.participant.coord.suspend_heartbeats()  # the wedge: data plane
+    # stays alive and thinks it leads — the classic deposed-but-running
+    # belt-and-braces: a fill write that somehow acked BEFORE the wedge
+    # (a straggler pull) is a legitimate pre-crash ack, not a stale one
+    pre_wedge: List = []
+    still_pending: List = []
+    for item in pending:
+        w = item[2]
+        if w.future.done() and w.acked:
+            pre_wedge.append(item)
+        else:
+            still_pending.append(item)
+    acked.extend((k, v) for k, v, _w in pre_wedge)
+    pending = still_pending
+    if not cluster.wait(
+            lambda: cluster.leader_node(partition, exclude=(leader,))
+            is not None, 12.0):
+        violations.append(
+            f"{tag}: no new leader within 12s of the wedge "
+            f"({cluster.states(partition)})")
+        fp.deactivate("repl.pull")
+        leader.participant.coord.resume_heartbeats()
+        return
+    t_one_leader = time.monotonic()
+    new_leader = cluster.leader_node(partition, exclude=(leader,))
+    fp.deactivate("repl.pull")
+    nrdb = new_leader.rdb(db)
+    new_epoch = nrdb.epoch if nrdb is not None else 0
+    # THE stale frame: a late follower pull carrying the new epoch hits
+    # the deposed leader over the real wire. Fencing: STALE_EPOCH, the
+    # pending window fails un-acked, writes refused. --break-guard
+    # fencing: the pull is served and mode-1 acks it.
+    try:
+        cluster.rpc(leader.replicator.port, "replicate",
+                    dict(db_name=db, seq_no=base_seq, max_wait_ms=0,
+                         max_updates=1024, role="FOLLOWER",
+                         epoch=new_epoch))
+    except Exception:
+        pass  # STALE_EPOCH is the expected outcome with the guard intact
+    # post-visibility write probes at the DEPOSED leader: with fencing
+    # they are refused outright; without it they commit locally and the
+    # second stale pull acks them — the split brain the harness must see
+    probe_waiters = []
+    for i in range(3):
+        key = f"{tag}-stale{i}".encode()
+        try:
+            probe_waiters.append(
+                (key, key, rdb.write_async(WriteBatch().put(key, key))))
+        except Exception:
+            pass
+    try:
+        cluster.rpc(leader.replicator.port, "replicate",
+                    dict(db_name=db, seq_no=base_seq + len(pending),
+                         max_wait_ms=0, max_updates=1024, role="FOLLOWER",
+                         epoch=new_epoch))
+    except Exception:
+        pass
+    # failover-time metric: fault → first acked write on the new leader
+    ack2: List[Tuple[bytes, bytes]] = []
+    deadline = time.monotonic() + 10.0
+    seq = 0
+    while time.monotonic() < deadline and not ack2:
+        cluster.write_some(rng, f"{tag}-post{seq}", 2, ack2,
+                           exclude=(leader,))
+        seq += 1
+    if ack2:
+        t_first_ack = time.monotonic()
+        timings["first_ack_ms"].append((t_first_ack - t_fault) * 1000.0)
+        acked.extend(ack2)
+    else:
+        violations.append(
+            f"{tag}: no acked write on the new leader within 10s")
+    timings["failover_ms"].append((t_one_leader - t_fault) * 1000.0)
+    # zero stale acks: nothing written at/after the wedge may ack on the
+    # deposed leader once the new epoch was visible
+    stale = []
+    for key, val, w in pending + probe_waiters:
+        try:
+            w.future.result(3.0)
+        except Exception:
+            continue
+        if w.acked:
+            stale.append(key)
+            acked.append((key, val))  # it claimed durability: hold it to it
+    if stale:
+        violations.append(
+            f"{tag}: SPLIT BRAIN — deposed leader acked {len(stale)} "
+            f"write(s) after epoch {new_epoch} was visible "
+            f"(first {stale[0]!r})")
+    # heal: resume heartbeats → session re-establishes → participant
+    # rejoins → controller demotes it → deposed resync from the new
+    # lineage (the _check_failover_invariants wait covers all of it)
+    leader.participant.coord.resume_heartbeats()
+
+
+def _schedule_session_expiry(cluster, rng, acked, violations, tag,
+                             timings):
+    """Expire participant sessions mid-write by dropping heartbeats at
+    the coordinator.heartbeat seam (real server-side TTL lapses, mass
+    ephemeral teardown, rejoin storm). Writes issued DURING the outage
+    ride the semi-sync window (availability over durability — the
+    reference contract) and are counted but not held to the strict
+    ledger; pre-fault and post-heal acks are."""
+    cluster.write_some(rng, tag + "-pre", rng.randint(6, 12), acked)
+    if not _wait_replicas_equal(cluster):
+        violations.append(f"{tag}: baseline never converged")
+        return
+    n = rng.randint(25, 45)  # ~1.5-2.5 TTLs of failed beats, all clients
+    fp.activate("coordinator.heartbeat", f"fail_first:{n}")
+    window: List[Tuple[bytes, bytes]] = []
+    cluster.write_some(rng, tag + "-mid", rng.randint(3, 6), window)
+    timings["window_acked"] += len(window)
+    time.sleep(FAILOVER_SESSION_TTL * 1.7)
+    fp.deactivate("coordinator.heartbeat")
+    cluster.write_some(rng, tag + "-post", rng.randint(3, 6), acked)
+
+
+def _schedule_follower_expiry(cluster, rng, acked, violations, tag,
+                              timings):
+    """Wedge a FOLLOWER past its session TTL (leadership must NOT move),
+    optionally with a transition/assignment fault armed, then prove the
+    reaped participant re-registers and resumes FOLLOWER without a
+    restart."""
+    from rocksplicator_tpu.cluster.model import cluster_path
+
+    partition = cluster.partitions[0]
+    followers = [n for n in cluster.nodes
+                 if n.state_of(partition) in ("FOLLOWER", "SLAVE")]
+    if not followers:
+        violations.append(f"{tag}: no follower to expire")
+        return
+    cluster.write_some(rng, tag + "-pre", rng.randint(4, 8), acked)
+    if not _wait_replicas_equal(cluster):
+        violations.append(f"{tag}: baseline never converged")
+        return
+    victim = rng.choice(followers)
+    extra = rng.choice([None,
+                        ("participant.transition", "fail_nth:1"),
+                        ("controller.assign", "fail_nth:1")])
+    if extra is not None:
+        fp.activate(*extra)
+    victim.participant.coord.suspend_heartbeats()
+    node_path = cluster_path(cluster.cluster, "instances",
+                             victim.instance.instance_id)
+    if not cluster.wait(lambda: not cluster.client.exists(node_path), 8.0):
+        violations.append(f"{tag}: {victim.name} session never expired")
+    # leader untouched: these acks ride the surviving follower — safe
+    cluster.write_some(rng, tag + "-mid", rng.randint(3, 6), acked)
+    victim.participant.coord.resume_heartbeats()
+    if not cluster.wait(lambda: cluster.client.exists(node_path), 8.0):
+        violations.append(
+            f"{tag}: {victim.name} never re-registered after expiry "
+            f"(rejoin gap)")
+    if extra is not None:
+        fp.deactivate(extra[0])
+
+
+def _coordinator_failover(cluster, tag, violations):
+    """Kill the primary, promote the standby, spin up a fresh standby,
+    and teach every client the new standby's endpoint (stands in for
+    ensemble discovery, which needs routable IPs — loopback standbys
+    advertise nothing)."""
+    from rocksplicator_tpu.cluster.coordinator import CoordinatorServer
+
+    old_primary = cluster.primary
+    old_primary.stop()
+    cluster.standby.promote()
+    cluster.primary = cluster.standby
+    cluster.standby = CoordinatorServer(
+        port=0, session_ttl=FAILOVER_SESSION_TTL,
+        data_dir=cluster._coord_dir(),
+        replica_of=("127.0.0.1", cluster.primary.port))
+    ep = ("127.0.0.1", cluster.standby.port)
+    for coord_client in [n.participant.coord for n in cluster.nodes] + [
+            cluster.controller.coord, cluster.spectator.coord,
+            cluster.client]:
+        if ep not in coord_client._endpoints:
+            coord_client._endpoints.append(ep)
+
+
+def _schedule_coordinator_failover(cluster, rng, acked, violations, tag,
+                                   timings):
+    """Kill the coordinator primary mid-write. Sessions survive on the
+    promoted standby (replicated, TTL grace), clients rotate, and the
+    data plane never blinks — leadership must NOT move."""
+    cluster.write_some(rng, tag + "-pre", rng.randint(4, 8), acked)
+    if not _wait_replicas_equal(cluster):
+        violations.append(f"{tag}: baseline never converged")
+        return
+    _coordinator_failover(cluster, tag, violations)
+    # a coordinator failover is invisible to the data plane: these acks
+    # are strict-ledger safe
+    cluster.write_some(rng, tag + "-mid", rng.randint(4, 8), acked)
+
+
+def _schedule_coordinator_wal_torn(cluster, rng, acked, violations, tag,
+                                   timings):
+    """Torn-write the coordinator WAL: the primary fail-stops for
+    mutations (every pending and future mutation fenced — the
+    coordinator.py _Wal contract), and the cluster heals by failing over
+    to the standby."""
+    cluster.write_some(rng, tag + "-pre", rng.randint(4, 8), acked)
+    if not _wait_replicas_equal(cluster):
+        violations.append(f"{tag}: baseline never converged")
+        return
+    fp.activate("coordinator.wal.append",
+                f"torn:1.0@seed{rng.randrange(1 << 16)},one_shot")
+    # poke durable mutations until the one-shot policy is consumed — a
+    # single put can die on a stale endpoint (mutations never blind-
+    # retry after a connection error) without ever reaching a WAL
+    for attempt in range(6):
+        try:
+            cluster.client.put(f"/chaos/poke/{tag}/{attempt}", b"x")
+        except Exception:
+            pass  # the poke itself may be the torn mutation
+        if not fp.is_active("coordinator.wal.append"):
+            break  # one_shot consumed: the tear landed
+    fp.deactivate("coordinator.wal.append")
+    primary_fenced = (cluster.primary._wal is not None
+                     and cluster.primary._wal.failed is not None)
+    standby_fenced = (cluster.standby._wal is not None
+                      and cluster.standby._wal.failed is not None)
+    if primary_fenced:
+        # fail-stop contract: NOTHING mutates after the fence
+        try:
+            cluster.client.put(f"/chaos/poke2/{tag}", b"y")
+            violations.append(
+                f"{tag}: mutation SUCCEEDED on a fenced coordinator WAL")
+        except Exception:
+            pass
+        _coordinator_failover(cluster, tag, violations)
+    elif standby_fenced:
+        # the replicated append tripped on the standby first: its durable
+        # persistence stopped (promote would refuse) — replace it
+        from rocksplicator_tpu.cluster.coordinator import CoordinatorServer
+
+        cluster.standby.stop()
+        cluster.standby = CoordinatorServer(
+            port=0, session_ttl=FAILOVER_SESSION_TTL,
+            data_dir=cluster._coord_dir(),
+            replica_of=("127.0.0.1", cluster.primary.port))
+    else:
+        violations.append(f"{tag}: torn WAL append fenced neither "
+                          f"coordinator")
+    cluster.write_some(rng, tag + "-post", rng.randint(3, 6), acked)
+
+
+def _schedule_blip(kind):
+    def run(cluster, rng, acked, violations, tag, timings):
+        s = rng.randrange(1 << 16)
+        if kind == "hb_delay":
+            fp.activate("coordinator.heartbeat",
+                        f"delay_ms:{rng.randint(40, 120)}:"
+                        f"{rng.uniform(0.2, 0.5):.2f}@seed{s}")
+        elif kind == "reap_blip":
+            fp.activate("coordinator.reap",
+                        f"fail_first:{rng.randint(1, 3)}")
+        elif kind == "shardmap_blip":
+            fp.activate("shardmap.publish",
+                        f"fail_first:{rng.randint(1, 2)}")
+        cluster.write_some(rng, tag, rng.randint(6, 12), acked)
+        time.sleep(rng.uniform(0.1, 0.4))
+        fp.clear()
+
+    return run
+
+
+_FAILOVER_SCHEDULES = {
+    "leader_crash": _schedule_leader_crash,
+    "session_expiry": _schedule_session_expiry,
+    "follower_expiry": _schedule_follower_expiry,
+    "coordinator_failover": _schedule_coordinator_failover,
+    "coordinator_wal_torn": _schedule_coordinator_wal_torn,
+    "hb_delay": _schedule_blip("hb_delay"),
+    "reap_blip": _schedule_blip("reap_blip"),
+    "shardmap_blip": _schedule_blip("shardmap_blip"),
+}
+_HEAVY_KINDS = ["leader_crash", "session_expiry", "coordinator_failover",
+                "coordinator_wal_torn", "follower_expiry"]
+_LIGHT_KINDS = ["hb_delay", "reap_blip", "shardmap_blip"]
+
+
+def _failover_deck(rng: random.Random, schedules: int,
+                   break_guard: Optional[str]) -> List[str]:
+    """Seeded schedule deck: every heavy kind appears at least once when
+    the run is long enough; the rest is a light-weighted draw. The
+    fencing tooth leads with the schedule that carries the stale-frame
+    probes."""
+    deck: List[str] = []
+    if break_guard == "fencing":
+        deck.append("leader_crash")
+    core = list(_HEAVY_KINDS)
+    rng.shuffle(core)
+    deck.extend(core[:max(0, schedules - len(deck))])
+    while len(deck) < schedules:
+        deck.append(rng.choice(_HEAVY_KINDS + _LIGHT_KINDS * 4))
+    return deck[:schedules]
+
+
+def _check_failover_invariants(cluster: FailoverCluster, acked, tag,
+                               violations, timeout: float = 15.0) -> int:
+    """The fourth standing invariant, checked after EVERY schedule:
+    exactly one LEADER per shard (current states AND the published shard
+    map), zero acked-write loss (every strict-ledger ack readable on
+    every replica), and convergence within a bounded number of
+    controller passes."""
+    passes0 = cluster.controller.passes
+    detail = {}
+
+    def healthy():
+        for partition in cluster.partitions:
+            states = [s for s in cluster.states(partition).values() if s]
+            if sorted(states) != ["FOLLOWER", "FOLLOWER", "LEADER"]:
+                detail["states"] = cluster.states(partition)
+                return False
+        for db in cluster.db_names:
+            seqs = cluster.seqs(db)
+            if None in seqs or len(set(seqs)) != 1:
+                detail["seqs"] = seqs
+                return False
+        for db in cluster.db_names:
+            for n in cluster.nodes:
+                app = n.handler.db_manager.get_db(db)
+                if app is None:  # mid-repoint reopen
+                    detail["lost"] = (n.name, "db closed")
+                    return False
+                for key, val in acked:
+                    if app.db.get(key) != val:
+                        detail["lost"] = (n.name, key)
+                        return False
+        if not cluster.maps:
+            detail["map"] = "never published"
+            return False
+        seg = cluster.maps[-1].get(cluster.segment) or {}
+        for s in range(cluster.num_shards):
+            mark = f"{s:05d}:M"
+            leaders = sum(
+                1 for host, entries in seg.items()
+                if host != "num_shards" for e in entries if e == mark)
+            if leaders != 1:
+                detail["map"] = f"shard {s}: {leaders} leaders in map"
+                return False
+        return True
+
+    ok = cluster.wait(healthy, timeout)
+    passes = cluster.controller.passes - passes0
+    if not ok:
+        violations.append(
+            f"{tag}: NO HEAL within {timeout}s / {passes} controller "
+            f"passes — {detail}")
+    elif passes > FAILOVER_PASS_BOUND:
+        violations.append(
+            f"{tag}: healed but took {passes} controller passes "
+            f"(bound {FAILOVER_PASS_BOUND})")
+    return passes
+
+
+def run_failover_chaos(
+    root: str,
+    schedules: int = 15,
+    seed: int = 1,
+    break_guard: Optional[str] = None,
+    heal_timeout: float = 15.0,
+    log=print,
+) -> Dict:
+    """Coordinator-backed chaos: seeded control-plane fault schedules
+    against a full Controller + Spectator + 3-participant cluster,
+    holding the fourth standing invariant after every schedule."""
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("RSTPU_RETRY_SEED", "RSTPU_PULL_RETRY_SEED")
+    }
+    os.environ["RSTPU_RETRY_SEED"] = str(seed)
+    os.environ["RSTPU_PULL_RETRY_SEED"] = str(seed)
+    undo = _break_guard(break_guard) if break_guard else None
+    violations: List[str] = []
+    acked: List[Tuple[bytes, bytes]] = []
+    timings: Dict = {"failover_ms": [], "first_ack_ms": [],
+                     "passes_used": [], "window_acked": 0}
+    fp.clear()
+    t_setup = time.monotonic()
+    cluster = FailoverCluster(root)
+    deck: List[str] = []
+    try:
+        cluster.wait_initial_convergence()
+        setup_sec = round(time.monotonic() - t_setup, 1)
+        deck = _failover_deck(random.Random(seed), schedules, break_guard)
+        log(f"  cluster up in {setup_sec}s; deck: {deck}")
+        for si, kind in enumerate(deck):
+            rng = random.Random(seed * 1_000_003 + si)
+            tag = f"s{si:02d}-{kind}/seed {seed}"
+            try:
+                _FAILOVER_SCHEDULES[kind](
+                    cluster, rng, acked, violations, tag, timings)
+            finally:
+                fp.clear()  # no fault outlives its schedule
+            timings["passes_used"].append(
+                _check_failover_invariants(cluster, acked, tag, violations,
+                                           timeout=heal_timeout))
+            log(f"  [{si + 1}/{len(deck)}] {kind}: acked={len(acked)} "
+                f"violations={len(violations)}")
+            if violations and break_guard:
+                break  # teeth demonstrated
+    finally:
+        fp.clear()
+        if undo:
+            undo()
+        cluster.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def _med(xs):
+        return round(sorted(xs)[len(xs) // 2], 1) if xs else None
+
+    return {
+        "mode": "failover",
+        "schedules": len(deck),
+        "deck": deck,
+        "seed": seed,
+        "acked": len(acked),
+        "window_acked": timings["window_acked"],
+        "violations": violations,
+        "failover_ms": [round(x, 1) for x in timings["failover_ms"]],
+        "failover_ms_median": _med(timings["failover_ms"]),
+        "first_ack_ms": [round(x, 1) for x in timings["first_ack_ms"]],
+        "first_ack_ms_median": _med(timings["first_ack_ms"]),
+        "passes_used": timings["passes_used"],
+        "failpoint_trips": fp.trip_counts(),
+        "break_guard": break_guard,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -441,33 +1229,59 @@ def main(argv=None) -> int:
     ap.add_argument("--writes", type=int, default=80,
                     help="max writes per schedule")
     ap.add_argument("--ingest-every", type=int, default=4)
+    ap.add_argument("--failover", action="store_true",
+                    help="coordinator-backed control-plane schedules "
+                         "(Controller + Spectator + 3 participants): "
+                         "leader crash with a full AckWindow, session "
+                         "expiry, coordinator kill/WAL torn — holding "
+                         "the fourth standing invariant")
     ap.add_argument("--transport", choices=["tcp", "uds", "loopback"],
                     help="run the cluster's RPC plane on this byte layer "
                          "(RSTPU_TRANSPORT for the run; default: ambient "
-                         "policy, i.e. tcp)")
-    ap.add_argument("--break-guard", choices=["wal_hole", "meta_first"])
+                         "policy, i.e. tcp; data-plane mode only)")
+    ap.add_argument("--break-guard",
+                    choices=["wal_hole", "meta_first", "fencing"])
     ap.add_argument("--expect-violation", action="store_true",
                     help="exit 0 iff a violation WAS caught")
     ap.add_argument("--conv-timeout", type=float, default=30.0)
     ap.add_argument("--out", help="write the result JSON here")
     args = ap.parse_args(argv)
+    if args.break_guard == "fencing" and not args.failover:
+        ap.error("--break-guard fencing requires --failover")
 
     root = tempfile.mkdtemp(prefix="rstpu-chaos-")
     t0 = time.monotonic()
     try:
-        result = run_chaos(
-            root, schedules=args.schedules, seed=args.seed,
-            writes=args.writes, ingest_every=args.ingest_every,
-            break_guard=args.break_guard, conv_timeout=args.conv_timeout,
-            transport=args.transport,
-        )
+        if args.failover:
+            result = run_failover_chaos(
+                root, schedules=args.schedules, seed=args.seed,
+                break_guard=args.break_guard,
+            )
+        else:
+            result = run_chaos(
+                root, schedules=args.schedules, seed=args.seed,
+                writes=args.writes, ingest_every=args.ingest_every,
+                break_guard=args.break_guard,
+                conv_timeout=args.conv_timeout,
+                transport=args.transport,
+            )
     finally:
         shutil.rmtree(root, ignore_errors=True)
     result["elapsed_sec"] = round(time.monotonic() - t0, 1)
-    print(f"chaos: {result['schedules']} schedules "
-          f"[{result['transport']}], "
-          f"{result['writes']} writes ({result['acked']} acked), "
-          f"{result['elapsed_sec']}s")
+    if args.failover:
+        print(f"chaos[failover]: {result['schedules']} schedules, "
+              f"{result['acked']} strict-ledger acks "
+              f"(+{result['window_acked']} window), "
+              f"{result['elapsed_sec']}s")
+        print(f"chaos[failover]: fault→one-leader median "
+              f"{result['failover_ms_median']} ms, fault→first-ack "
+              f"median {result['first_ack_ms_median']} ms, "
+              f"controller passes {result['passes_used']}")
+    else:
+        print(f"chaos: {result['schedules']} schedules "
+              f"[{result['transport']}], "
+              f"{result['writes']} writes ({result['acked']} acked), "
+              f"{result['elapsed_sec']}s")
     print(f"chaos: failpoint trips: {result['failpoint_trips']}")
     if args.out:
         with open(args.out, "w") as f:
@@ -477,13 +1291,17 @@ def main(argv=None) -> int:
             print(f"VIOLATION: {v}")
         print(f"REPRO: python -m tools.chaos_soak "
               f"--schedules {args.schedules} --seed {args.seed}"
+              + (" --failover" if args.failover else "")
               + (f" --transport {args.transport}"
                  if args.transport else "")
               + (f" --break-guard {args.break_guard}"
                  if args.break_guard else ""))
         return 0 if args.expect_violation else 1
     print("chaos: all invariants held"
-          + (" (hole-free WAL prefix, zero acked loss, ingest atomicity)"
+          + ((" (exactly-one-leader, zero acked loss across handoff, "
+              "bounded shard-map convergence)" if args.failover else
+              " (hole-free WAL prefix, zero acked loss, ingest "
+              "atomicity)")
              if not args.break_guard else ""))
     if args.expect_violation:
         print("ERROR: --expect-violation but the broken guard was "
