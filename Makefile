@@ -1,6 +1,15 @@
 PY ?= python
 
-.PHONY: test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke chaos-smoke chaos-failover-smoke clean
+.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke chaos-smoke chaos-failover-smoke clean
+
+# rstpu-check: the three-pass static suite (lock-order/blocking-under-
+# lock, event-loop blocking, failpoint/span/stats registries) over
+# rocksplicator_tpu/ — exits nonzero on any unbaselined finding — plus
+# a freshness check of the generated canonical lock order that the
+# lockwatch runtime asserts (testing/lock_order.py). Also gated in
+# tier-1 via tests/test_rstpu_check.py, with broken-fixture teeth.
+check:
+	$(PY) -m tools.rstpu_check --check-lock-order
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -70,16 +79,24 @@ transport-bench-smoke:
 # identically on all three transports), and a deliberately-broken
 # durability guard run that must be CAUGHT (--expect-violation). A
 # violation prints the reproducing --seed.
+# RSTPU_LOCKWATCH=1 arms the runtime lock-order watchdog in every
+# process (parent + spawned replicas inherit the env): each schedule
+# also asserts the canonical acquisition order from testing/
+# lock_order.py and per-thread held-set discipline, corroborating the
+# static rstpu-check result on the exercised paths.
 chaos-smoke:
-	$(PY) -m tools.chaos_soak --schedules 20 --seed 1 \
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --schedules 20 --seed 1 \
 		--out benchmarks/results/chaos_smoke.json
-	$(PY) -m tools.chaos_soak --schedules 3 --seed 1 --transport uds \
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --schedules 3 --seed 1 \
+		--transport uds \
 		--out benchmarks/results/chaos_smoke_uds.json
-	$(PY) -m tools.chaos_soak --schedules 3 --seed 1 --transport loopback \
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --schedules 3 --seed 1 \
+		--transport loopback \
 		--out benchmarks/results/chaos_smoke_loopback.json
-	$(PY) -m tools.chaos_soak --schedules 1 --seed 7 \
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --schedules 1 --seed 7 \
 		--break-guard wal_hole --expect-violation --conv-timeout 3
-	$(PY) -m tools.chaos_soak --schedules 1 --seed 7 --ingest-every 1 \
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --schedules 1 --seed 7 \
+		--ingest-every 1 \
 		--break-guard meta_first --expect-violation --conv-timeout 10
 
 # coordinator-backed failover chaos (~25s + ~20s tooth): >= 15 seeded

@@ -27,3 +27,16 @@ system:
 """
 
 __version__ = "0.1.0"
+
+# Arm the lock-order watchdog from the environment BEFORE any package
+# module constructs a lock (module-level locks are created at their
+# module's import, which necessarily follows this one). Zero-cost when
+# RSTPU_LOCKWATCH is unset: nothing is imported beyond the tiny module
+# and nothing is patched. Chaos-harness child processes inherit the env
+# and arm themselves through this same line.
+import os as _os
+
+if _os.environ.get("RSTPU_LOCKWATCH"):
+    from .testing import lockwatch as _lockwatch
+
+    _lockwatch.maybe_install()

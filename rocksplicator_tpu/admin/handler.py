@@ -140,6 +140,13 @@ class AdminHandler:
     ):
         self.rocksdb_dir = os.path.abspath(rocksdb_dir)
         os.makedirs(self.rocksdb_dir, exist_ok=True)
+        # sweep staging dirs orphaned by a crash mid-backup/restore:
+        # they live on the data volume (same-fs for hardlinks/rename)
+        # and are only meaningful to the in-flight op that created them
+        for entry in os.listdir(self.rocksdb_dir):
+            if entry.startswith((".restore-", ".backup-")):
+                shutil.rmtree(os.path.join(self.rocksdb_dir, entry),
+                              ignore_errors=True)
         self.replicator = replicator
         self.db_manager = db_manager or ApplicationDBManager()
         self._options_gen = options_generator or (lambda segment: DBOptions())
@@ -471,15 +478,40 @@ class AdminHandler:
         tctx = wire_context()
 
         def do():
-            with self._db_admin_lock.locked(db_name), \
-                    Timer("admin.backup_ms"), \
+            # The per-db admin lock covers ONLY the checkpoint (fast,
+            # hardlink-based): the upload — the 45 s part — runs outside
+            # it, off the checkpoint's immutable hardlinked file set, so
+            # a backup no longer blocks addDB/closeDB/ingest on the same
+            # db for its whole duration (rstpu-check blocking-under-lock;
+            # same narrowing as the round-7 ingest pipeline).
+            with Timer("admin.backup_ms"), \
                     start_span("admin.backup_db", always=True, remote=tctx,
                                db=db_name):
                 meta = self.get_meta_data(db_name)
-                return backup_mod.backup_db(
-                    app_db.db, store, prefix,
-                    meta={"last_kafka_msg_timestamp_ms": meta.last_kafka_msg_timestamp_ms},
-                )
+                # stage INSIDE rocksdb_dir: same filesystem as the db,
+                # so the checkpoint's os.link fast path works — on /tmp
+                # an EXDEV fallback would copy every SST under the DB
+                # lock, inverting the narrowing this path exists for
+                tmp = tempfile.mkdtemp(
+                    dir=self.rocksdb_dir, prefix=f".backup-{db_name}-")
+                ckpt_dir = os.path.join(tmp, "ckpt")
+                try:
+                    with self._db_admin_lock.locked(db_name), \
+                            start_span("admin.backup.checkpoint"):
+                        # re-fetch under the lock: a closeDB+addDB that
+                        # raced the pre-lock resolution must checkpoint
+                        # the LIVE instance, not a closed stale handle
+                        live = self.db_manager.get_db(db_name)
+                        if live is None:
+                            raise RpcApplicationError(DB_NOT_FOUND, db_name)
+                        ckpt_seq = live.db.checkpoint(ckpt_dir)
+                    return backup_mod.upload_checkpoint(
+                        live.db.path, store, prefix, ckpt_dir, ckpt_seq,
+                        meta={"last_kafka_msg_timestamp_ms":
+                              meta.last_kafka_msg_timestamp_ms},
+                    )
+                finally:
+                    shutil.rmtree(tmp, ignore_errors=True)
 
         dbmeta = await self._run(do)
         return {"seq": dbmeta["seq"], "timestamp_ms": dbmeta["timestamp_ms"]}
@@ -495,32 +527,60 @@ class AdminHandler:
         tctx = wire_context()
 
         def do():
-            with self._db_admin_lock.locked(db_name), \
-                    Timer("admin.restore_ms"), \
+            with Timer("admin.restore_ms"), \
                     start_span("admin.restore_db", always=True, remote=tctx,
                                db=db_name, to_seq=to_seq):
-                if self.db_manager.get_db(db_name) is not None:
-                    self.db_manager.remove_db(db_name)
-                destroy_db(self._db_path(db_name))
                 if to_seq > 0:
+                    # PITR: checkpoint download + WAL-archive replay must
+                    # materialize into the final path in one step; rare
+                    # enough to stay fully serialized
                     from ..storage.archive import restore_db_to_seq
 
-                    dbmeta = restore_db_to_seq(
-                        store, prefix, f"{prefix}/wal",
-                        self._db_path(db_name), to_seq=to_seq)
-                else:
-                    dbmeta = backup_mod.restore_db(
-                        store, prefix, self._db_path(db_name))
-                self._open_app_db(db_name, role, upstream)
-                ts = dbmeta.get("last_kafka_msg_timestamp_ms")
-                if ts:
-                    self.write_meta_data(db_name, last_kafka_msg_timestamp_ms=ts)
+                    with self._db_admin_lock.locked(db_name):
+                        if self.db_manager.get_db(db_name) is not None:
+                            self.db_manager.remove_db(db_name)
+                        destroy_db(self._db_path(db_name))
+                        dbmeta = restore_db_to_seq(
+                            store, prefix, f"{prefix}/wal",
+                            self._db_path(db_name), to_seq=to_seq)
+                        self._finish_restore(db_name, role, upstream, dbmeta)
+                    return dbmeta
+                # Plain restore: the download — the long part — runs into
+                # a staging dir OUTSIDE the per-db admin lock, so a
+                # restore no longer blocks same-db admin ops for its
+                # whole transfer (rstpu-check blocking-under-lock); the
+                # lock is taken only for the destroy→rename→reopen flip.
+                # staging parent is unique per attempt (concurrent
+                # restores of one db each download privately; last one
+                # to take the lock wins the flip, as before) and lives
+                # in rocksdb_dir so the rename is same-filesystem
+                tmp_parent = tempfile.mkdtemp(
+                    dir=self.rocksdb_dir, prefix=f".restore-{db_name}-")
+                staging = os.path.join(tmp_parent, "db")
+                try:
+                    dbmeta = backup_mod.restore_db(store, prefix, staging)
+                    with self._db_admin_lock.locked(db_name):
+                        if self.db_manager.get_db(db_name) is not None:
+                            self.db_manager.remove_db(db_name)
+                        destroy_db(self._db_path(db_name))
+                        os.rename(staging, self._db_path(db_name))
+                        self._finish_restore(db_name, role, upstream, dbmeta)
+                finally:
+                    shutil.rmtree(tmp_parent, ignore_errors=True)
                 return dbmeta
 
         dbmeta = await self._run(do)
         # PITR restores report the seq actually reached after WAL replay,
         # not the checkpoint's
         return {"seq": dbmeta.get("restored_seq", dbmeta["seq"])}
+
+    def _finish_restore(self, db_name, role, upstream, dbmeta) -> None:
+        """Post-materialization half of a restore, under the per-db
+        admin lock: register the reopened db + persist its kafka meta."""
+        self._open_app_db(db_name, role, upstream)
+        ts = dbmeta.get("last_kafka_msg_timestamp_ms")
+        if ts:
+            self.write_meta_data(db_name, last_kafka_msg_timestamp_ms=ts)
 
     # ------------------------------------------------------------------
     # RPC: SST bulk ingest — the north-star workload (§3.3)

@@ -293,6 +293,13 @@ class CoordinatorServer:
         self._session_ids = itertools.count(1)
         self._max_sid_seen = 0
         self._lock = threading.Lock()
+        # Serializes the read-state → atomic-write → WAL-truncate
+        # snapshot sequence: the periodic snapshot job, a promote's
+        # post-promote snapshot, and stop() now run on DIFFERENT threads
+        # (executor offload, rstpu-check loop-blocking), and a stale
+        # interleaved writer could otherwise overwrite a newer fencing
+        # token and then truncate the WAL under it.
+        self._snapshot_mutex = threading.Lock()  # rstpu-check: io-mutex snapshot writer — fsync + truncate-wait under it is the mechanism
         self._ttl = session_ttl
         self._change_event: Dict[str, asyncio.Event] = {}
         self._global_version = 0
@@ -513,6 +520,13 @@ class CoordinatorServer:
                     "WAL_ERROR", f"mutation not durable: {e!r}")
 
     def _write_snapshot(self) -> None:
+        # one writer end to end: a second snapshotter parks here until
+        # the first finishes its write+truncate, then re-reads fresh
+        # state (or sees _dirty clear and no-ops)
+        with self._snapshot_mutex:
+            self._write_snapshot_locked()
+
+    def _write_snapshot_locked(self) -> None:
         import json
 
         from ..utils.misc import write_file_atomic
@@ -560,7 +574,12 @@ class CoordinatorServer:
                 # mutations — do NOT persist it
                 continue
             try:
-                self._write_snapshot()
+                # off-loop: the snapshot's atomic write fsyncs (file +
+                # dir) and its WAL-truncate future wait would otherwise
+                # stall every session/heartbeat sharing this loop for
+                # tens of ms per cycle (rstpu-check loop-blocking)
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._write_snapshot)
             except Exception:
                 log.exception("coordinator snapshot failed")
 
@@ -1464,7 +1483,7 @@ class CoordinatorServer:
                             "coordinator standby: upstream %s:%d "
                             "unreachable for %.1fs — self-promoting",
                             host, port, outage)
-                        self.promote()
+                        await self.promote_async()
                         return
                     log.debug("coordinator standby pull error: %r", e)
                     await asyncio.sleep(0.5)
@@ -1490,7 +1509,27 @@ class CoordinatorServer:
         fencing token is bumped STRICTLY ABOVE the old primary's, so any
         client that has talked to this primary refuses acks from the
         deposed one. Refuses while the local WAL is fenced (state since
-        the last snapshot would not be durable) unless ``force``."""
+        the last snapshot would not be durable) unless ``force``.
+
+        Loop-side callers (the standby loop's self-promotion, the
+        promote RPC) use :meth:`promote_async`, which runs the durable
+        snapshot in an executor — fsyncing on the loop at the promote
+        moment is exactly when heartbeats/session grants must keep
+        flowing (rstpu-check loop-blocking)."""
+        if self._promote_state(force):
+            self._post_promote_snapshot()
+
+    async def promote_async(self, force: bool = False) -> None:
+        if self._promote_state(force):
+            # shield: once promotion flipped state, the durable snapshot
+            # of the bumped fencing token must complete even if THIS
+            # task is cancelled (the standby loop's self-promotion is
+            # cancelled by _promote_state scheduling its own teardown)
+            await asyncio.shield(asyncio.get_running_loop().run_in_executor(
+                None, self._post_promote_snapshot))
+
+    def _promote_state(self, force: bool) -> bool:
+        """Flip standby→primary state; True iff a transition happened."""
         if (
             not force and self._wal is not None
             and self._wal.failed is not None
@@ -1500,7 +1539,7 @@ class CoordinatorServer:
                 f"({self._wal.failed!r}); pass force=True to override")
         with self._lock:
             if not self._standby:
-                return
+                return False
             self._standby = False
             grace = time.monotonic() + self._ttl
             self._sessions = {sid: grace for sid in self._sessions}
@@ -1511,9 +1550,21 @@ class CoordinatorServer:
             self._standby_addrs.clear()
             self._fencing_token += 1
             self._dirty = True
-        if self._standby_task is not None:
-            self._standby_task.cancel()
-            self._standby_task = None
+        task, self._standby_task = self._standby_task, None
+        if task is not None:
+            try:
+                current = asyncio.current_task()
+            except RuntimeError:  # sync promote() off the loop thread
+                current = None
+            if task is not current:
+                # never cancel the task running THIS promotion (standby
+                # self-promotion): the scheduled cancel would land on
+                # promote_async's snapshot await; the loop returns right
+                # after promoting anyway
+                task.cancel()
+        return True
+
+    def _post_promote_snapshot(self) -> None:
         try:
             if self._data_dir:
                 self._write_snapshot()  # make the token bump durable now
@@ -1542,7 +1593,7 @@ class CoordinatorServer:
         """Operator/controller-driven failover for standalone standby
         processes (the in-process path calls promote() directly)."""
         try:
-            self.promote(force=bool(force))
+            await self.promote_async(force=bool(force))
         except RuntimeError as e:
             raise RpcApplicationError("WAL_ERROR", str(e))
         return {"standby": self._standby}

@@ -719,7 +719,7 @@ class KafkaWireConsumer(Consumer):
         self.group_id = group_id
         self._client_id = client_id
         self._corr = 0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # rstpu-check: io-mutex serializes round-trips on the one blocking kafka socket
         self._sock = socket.create_connection((host, port), connect_timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._topic: Optional[str] = None
@@ -948,7 +948,7 @@ class KafkaWireProducer:
                  connect_timeout: float = 10.0):
         self._client_id = client_id
         self._corr = 0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # rstpu-check: io-mutex serializes round-trips on the one blocking kafka socket
         self._sock = socket.create_connection((host, port), connect_timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 

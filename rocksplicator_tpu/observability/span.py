@@ -168,6 +168,23 @@ class start_span:
         return False
 
 
+def detached_span(name: str, parent, **annotations: Any):
+    """A child span that outlives the creating stack frame — for
+    operations whose completion lands on another thread (an ack-window
+    waiter resolved by the loop's expiry timer or a follower ack), where
+    ``with start_span(...)`` cannot scope the lifetime.
+
+    Returns ``None`` when the parent is unsampled (callers keep the
+    usual near-free unsampled path). The CALLER OWNS COMPLETION: every
+    resolution path must call ``.finish()`` and hand the span to
+    ``SpanCollector.get().record(...)`` — keep exactly one resolution
+    funnel, as AckWindow does. This is the only sanctioned way to build
+    a Span outside observability/ (rstpu-check span-manual)."""
+    if parent is None or not parent.sampled:
+        return None
+    return Span(name, parent.trace_id, parent.span_id, dict(annotations))
+
+
 def _sample() -> bool:
     from .collector import SpanCollector
 

@@ -32,7 +32,7 @@ from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional, Tuple
 
 from ..observability.context import wire_context
-from ..observability.span import Span, start_span
+from ..observability.span import detached_span, start_span
 from ..rpc.client_pool import RpcClientPool
 from ..rpc.errors import (RpcApplicationError, RpcConnectionError, RpcError,
                           RpcTransportConfigError)
@@ -407,13 +407,12 @@ class ReplicatedDB:
             f.degraded_ack_timeout_ms if self._degraded else f.ack_timeout_ms
         )
         self._stats.incr(M["ack_waits"])
-        ack_span = None
-        if write_span.sampled:
-            ack_span = Span(
-                "repl.ack_wait", write_span.trace_id, write_span.span_id,
-                {"target_seq": target_seq, "timeout_ms": timeout_ms,
-                 "window_depth": self._acked.depth + 1},
-            )
+        # detached: the waiter resolves on another thread (loop expiry /
+        # follower ack); AckWindow's resolution funnel finishes+records
+        ack_span = detached_span(
+            "repl.ack_wait", write_span,
+            target_seq=target_seq, timeout_ms=timeout_ms,
+            window_depth=self._acked.depth + 1)
         waiter = self._acked.register(
             target_seq, seq, timeout_ms / 1000.0, span=ack_span
         )

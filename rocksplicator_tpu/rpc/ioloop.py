@@ -11,10 +11,25 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import os
 import threading
+import time
 from typing import Any, Awaitable, Coroutine, Optional, TypeVar
 
 T = TypeVar("T")
+
+# Loop-stall watchdog (the runtime half of rstpu-check pass 2): armed
+# with the lockwatch (RSTPU_LOCKWATCH) or on its own (RSTPU_LOOPWATCH=1),
+# a monitor task measures dispatch lag every tick and publishes stalls
+# longer than RSTPU_LOOPWATCH_MS (default 100) as `ioloop.stalls` +
+# `ioloop.stall_ms` on /stats — one blocking call on the loop stalls
+# every colocated replica, and this is how a chaos run notices.
+_WATCH_TICK_S = 0.25
+
+
+def _loopwatch_armed() -> bool:
+    return bool(os.environ.get("RSTPU_LOCKWATCH")
+                or os.environ.get("RSTPU_LOOPWATCH"))
 
 
 class IoLoop:
@@ -29,6 +44,25 @@ class IoLoop:
         self._started = threading.Event()
         self._thread.start()
         self._started.wait()
+        if _loopwatch_armed():
+            self._stall_threshold_s = float(
+                os.environ.get("RSTPU_LOOPWATCH_MS", "100")) / 1000.0
+            self._loop.call_soon_threadsafe(
+                self._stall_tick, time.monotonic())
+
+    def _stall_tick(self, last: float) -> None:
+        # self-rescheduling call_later chain (no long-lived task to
+        # destroy at loop stop): dispatch lag beyond the tick interval
+        # is time some callback/coroutine spent hogging the loop
+        now = time.monotonic()
+        lag = now - last - _WATCH_TICK_S
+        if lag > self._stall_threshold_s:
+            from ..utils.stats import Stats
+
+            stats = Stats.get()
+            stats.incr("ioloop.stalls")
+            stats.add_metric("ioloop.stall_ms", lag * 1000.0)
+        self._loop.call_later(_WATCH_TICK_S, self._stall_tick, now)
 
     @classmethod
     def default(cls) -> "IoLoop":

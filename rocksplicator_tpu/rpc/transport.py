@@ -464,6 +464,7 @@ class UdsConnection(Connection):
 
     def _try_sendmsg(self, bufs: List[memoryview]) -> Optional[int]:
         try:
+            # rstpu-check: allow(loop-blocking) non-blocking socket — EAGAIN returns None and the drainer awaits loop writability; the vectored send never parks the loop
             return self._sock.sendmsg(bufs)
         except (BlockingIOError, InterruptedError):
             return None

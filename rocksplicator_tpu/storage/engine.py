@@ -212,7 +212,7 @@ class DB:
         # the DB lock across file+dir fsync). Snapshots are taken under
         # self._lock (monotonic version); the writer mutex drops any
         # snapshot older than what is already durable.
-        self._manifest_mutex = threading.Lock()
+        self._manifest_mutex = threading.Lock()  # rstpu-check: io-mutex versioned manifest writer — exists precisely to take the fsyncs OFF self._lock
         self._manifest_version = 0
         self._manifest_written_version = 0
         self._bg_stop = False
@@ -1600,6 +1600,7 @@ class DB:
                         try:
                             os.link(src, dst)
                         except OSError:
+                            # rstpu-check: allow(blocking-under-lock) cross-device fallback only; the checkpoint's file set + manifest must be one consistent cut under the lock
                             shutil.copyfile(src, dst)
                         nfiles += 1
                 self._persist_manifest(target_dir=checkpoint_dir)
@@ -1652,6 +1653,7 @@ class DB:
                             # copy-or-fail: a rename fallback would keep
                             # the shared inode and re-open the bucket-
                             # corruption hole this branch exists to close
+                            # rstpu-check: allow(blocking-under-lock) rare nlink>1 fallback; admin pre-breaks links outside every lock (handler.validate), so this copy under the db lock is the last-resort safety net
                             shutil.copyfile(src, dst)
                             os.remove(src)
                         else:
@@ -1661,12 +1663,14 @@ class DB:
                             except OSError:
                                 shutil.move(src, dst)
                     else:
+                        # rstpu-check: allow(blocking-under-lock) ingest file materialization must be atomic vs readers/seq allocation; per-shard only — the round-7 narrowing keeps other dbs unaffected
                         shutil.copyfile(src, dst)
                     new_names.append(name)
             except (OSError, Corruption) as e:
                 self._gc_files(new_names)
                 raise StorageError(f"ingest failed: {e}") from e
             if ingest_behind:
+                # rstpu-check: allow(blocking-under-lock) footer rewrite+fsync must complete before the file set becomes visible; crash matrix (test_failpoints) pins the pre/post-ingest atomicity this ordering provides
                 self._set_global_seqnos(new_names, 0)
                 # Bottom level must stay sorted & non-overlapping.
                 readers = [self._readers_open(n) for n in new_names]
@@ -1692,6 +1696,7 @@ class DB:
                     self._flush_locked(defer_manifest=True)
                 if allow_global_seqno:
                     self._last_seq += 1
+                    # rstpu-check: allow(blocking-under-lock) the global seqno is allocated from _last_seq under the lock and must be durable in the footer before install — releasing mid-rewrite would let a racing write reuse the seq
                     self._set_global_seqnos(new_names, self._last_seq)
                     self._persisted_seq = max(self._persisted_seq, self._last_seq)
                 else:
@@ -1700,6 +1705,7 @@ class DB:
                         # copied pages before the manifest names the file
                         # (ingested data has no WAL to replay)
                         with open(os.path.join(self.path, name), "rb") as f:
+                            # rstpu-check: allow(blocking-under-lock) ingested pages must be durable before the manifest names the file (no WAL covers them); ingest is rare and per-shard
                             os.fsync(f.fileno())
                         self._readers_open(name)
                 self._levels[0].extend(new_names)

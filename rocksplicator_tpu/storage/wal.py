@@ -65,7 +65,7 @@ class WalWriter:
         self._file_size = 0
         # group-commit state: tokens are published under the appender's
         # lock; _sync_lock serializes fsync leaders and file swaps
-        self._sync_lock = threading.Lock()
+        self._sync_lock = threading.Lock()  # rstpu-check: io-mutex group-commit fsync leader lock — fsync under it IS the mechanism
         self._append_token = 0
         self._synced_token = 0
         # non-sync workloads pay no roll-time fsync; the first sync

@@ -1,0 +1,49 @@
+"""The checked-in failpoint site registry — single source of truth.
+
+Every ``failpoints.hit/async_hit/pending_delay/torn_point`` call site in
+the package must name an entry here, every entry must have at least one
+call site, and every entry must be referenced by at least one test or
+chaos schedule; ``tools/rstpu_check.py`` (pass 3) enforces all three, so
+a seam can neither arm silently under a typo'd name nor rot uncovered.
+``failpoints.SITES`` derives from this dict, so activate()'s
+unknown-site rejection can never drift from the registry.
+
+Adding a seam = add its fp.hit()/torn_point() call, add the entry here,
+and reference it from a test or a chaos schedule (make check fails on
+any of the three missing).
+
+Value = one line saying what fault the site injects, for humans reading
+`rstpu-check --json` output or a chaos schedule.
+"""
+
+from __future__ import annotations
+
+REGISTRY = {
+    "wal.append": "WAL record append failure / torn tail",
+    "wal.fsync": "WAL group-commit fsync failure or stall",
+    "wal.roll": "WAL segment roll failure",
+    "manifest.persist": "manifest atomic-write failure",
+    "sst.fsync": "SST data/footer fsync failure or stall",
+    "sst.ingest_footer": "global-seqno footer rewrite failure mid-ingest",
+    "engine.ingest": "engine external-file ingest failure",
+    "compact.install": "compaction result install failure",
+    "compact.dispatch": "batch-compactor dispatch failure",
+    "objectstore.get": "object-store download failure",
+    "objectstore.put": "object-store upload failure",
+    "s3.request": "S3 request transient failure",
+    "hdfs.request": "WebHDFS request transient failure",
+    "rpc.connect": "RPC connect failure or stall",
+    "rpc.frame.send": "RPC frame send failure / torn frame",
+    "rpc.frame.recv": "RPC frame receive failure",
+    "repl.pull": "replication pull RPC failure",
+    "repl.apply": "follower apply failure",
+    "ack.expire": "ack-window expiry timer blip",
+    "coordinator.heartbeat": "coordinator session heartbeat failure",
+    "coordinator.reap": "coordinator ephemeral-node reap blip",
+    "coordinator.wal.append": "coordinator WAL append failure / torn tail",
+    "participant.transition": "participant state-transition failure",
+    "shardmap.publish": "spectator shard-map publish failure",
+    "controller.assign": "controller assignment-pass failure",
+    "admin.ingest.engine": "admin ingest fault before engine ingest",
+    "admin.ingest.meta": "admin ingest fault between engine and meta",
+}

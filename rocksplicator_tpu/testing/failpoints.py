@@ -41,16 +41,10 @@ Trips are rare by construction, so the trip path can afford stats
 the active sampled trace, which is how a chaos run's trace tree shows
 *which* injected fault each recovery path absorbed.
 
-Registered sites (grep for the literal name):
-
-    wal.append  wal.fsync  wal.roll  manifest.persist  sst.fsync
-    sst.ingest_footer  engine.ingest  compact.install  compact.dispatch
-    objectstore.get  objectstore.put  s3.request  hdfs.request
-    rpc.connect  rpc.frame.send  rpc.frame.recv
-    repl.pull  repl.apply  ack.expire
-    coordinator.heartbeat  coordinator.reap  coordinator.wal.append
-    participant.transition  shardmap.publish  controller.assign
-    admin.ingest.engine  admin.ingest.meta
+Registered sites live in ``testing/failpoint_registry.py`` (one entry
+per seam with a one-line fault description); ``SITES`` below derives
+from it and ``tools/rstpu_check.py`` lint-gates the registry against
+the actual call sites and test coverage.
 """
 
 from __future__ import annotations
@@ -69,22 +63,17 @@ __all__ = [
     "is_active", "active_sites", "trip_counts", "load_env",
 ]
 
-# The canonical registered-site list. activate() REJECTS names not on
-# it (a typo'd site would arm silently, inject nothing, and let a chaos
-# run or regression test pass vacuously); names starting with "t." or
-# "test." are exempt for unit tests of the registry itself. Adding a
-# seam = add its fp.hit()/torn_point() call AND list it here.
-SITES = frozenset({
-    "wal.append", "wal.fsync", "wal.roll",
-    "manifest.persist", "sst.fsync", "sst.ingest_footer",
-    "engine.ingest", "compact.install", "compact.dispatch",
-    "objectstore.get", "objectstore.put", "s3.request", "hdfs.request",
-    "rpc.connect", "rpc.frame.send", "rpc.frame.recv",
-    "repl.pull", "repl.apply", "ack.expire",
-    "coordinator.heartbeat", "coordinator.reap", "coordinator.wal.append",
-    "participant.transition", "shardmap.publish", "controller.assign",
-    "admin.ingest.engine", "admin.ingest.meta",
-})
+# The canonical registered-site list, derived from the checked-in
+# registry (testing/failpoint_registry.py) so the two can never drift.
+# activate() REJECTS names not on it (a typo'd site would arm silently,
+# inject nothing, and let a chaos run or regression test pass
+# vacuously); names starting with "t." or "test." are exempt for unit
+# tests of the registry itself. Adding a seam = add its
+# fp.hit()/torn_point() call AND a registry entry AND a test/chaos
+# reference — tools/rstpu_check.py pass 3 enforces all three.
+from .failpoint_registry import REGISTRY as _REGISTRY
+
+SITES = frozenset(_REGISTRY)
 
 
 class FailpointError(OSError):

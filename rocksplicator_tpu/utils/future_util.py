@@ -48,6 +48,7 @@ async def speculate(
             if exc is None:
                 for t in tasks:
                     t.cancel()
+                # rstpu-check: allow(loop-blocking) asyncio.Task.result() on a task from asyncio.wait's done set — already completed, returns immediately
                 return task.result()
             last_exc = exc
     assert last_exc is not None

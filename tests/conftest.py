@@ -40,6 +40,37 @@ except Exception:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
+from _pytest.runner import runtestprotocol  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "flaky_host: known host-noise flake under full-suite load "
+        "(passes standalone); retried once so tier-1 signal stays clean",
+    )
+
+
+def pytest_runtest_protocol(item, nextitem):
+    """Retry-once guard for @pytest.mark.flaky_host tests: the marked
+    tests are timing-sensitive cluster scenarios proven host-noise-flaky
+    under full-suite load (they pass standalone — CHANGES.md PR 4); one
+    retry reruns setup/call/teardown from scratch, and a real regression
+    still fails both attempts."""
+    if item.get_closest_marker("flaky_host") is None:
+        return None
+    hook = item.ihook
+    hook.pytest_runtest_logstart(nodeid=item.nodeid, location=item.location)
+    reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    if any(r.failed for r in reports):
+        sys.stderr.write(
+            f"\nflaky_host: retrying {item.nodeid} once "
+            f"(host-noise guard)\n")
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    for report in reports:
+        hook.pytest_runtest_logreport(report=report)
+    hook.pytest_runtest_logfinish(nodeid=item.nodeid, location=item.location)
+    return True
 
 
 @pytest.fixture(autouse=True)

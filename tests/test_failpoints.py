@@ -658,6 +658,31 @@ def test_batch_download_retry_absorbs_transient_fault(tmp_path):
         "retry.attempts op=objectstore.get") >= 1.0
 
 
+def test_upload_fault_retried_then_clean_failure(tmp_path):
+    """objectstore.put coverage (rstpu-check failpoint-uncovered): a
+    transient upload fault is absorbed by the batch retry; an outlasting
+    one surfaces the OSError without leaving a torn object (puts stage
+    to a tmp name and os.replace, so a tripped put publishes
+    nothing)."""
+    store = LocalObjectStore(str(tmp_path / "bucket"))
+    src = tmp_path / "f0.bin"
+    src.write_bytes(b"y" * 64)
+    fp.activate("objectstore.put", "fail_first:1")
+    try:
+        store.put_objects([str(src)], "up")
+    finally:
+        fp.deactivate("objectstore.put")
+    assert store.get_object_bytes("up/f0.bin") == b"y" * 64
+    assert fp.trip_counts()["objectstore.put"] == 1
+    fp.activate("objectstore.put", "fail_first:99")
+    try:
+        with pytest.raises(OSError):
+            store.put_objects([str(src)], "up2")
+        assert store.list_objects("up2/") == []  # nothing half-published
+    finally:
+        fp.deactivate("objectstore.put")
+
+
 def test_batch_download_fault_outlasting_retry_fails_clean(tmp_path):
     store = LocalObjectStore(str(tmp_path / "bucket"))
     for i in range(3):

@@ -295,7 +295,12 @@ def test_backup_restore_roundtrip_trace_via_endpoint(tmp_path):
         assert {"admin.backup_db", "storage.checkpoint",
                 "backup.upload"} <= names
         by_name = {s["name"]: s for s in backup_traces[0]["spans"]}
+        # the checkpoint now nests under the lock-held phase span so the
+        # waterfall shows exactly how long the per-db admin lock is held
+        # (the upload phase runs outside it)
         assert by_name["storage.checkpoint"]["parent_id"] == \
+            by_name["admin.backup.checkpoint"]["span_id"]
+        assert by_name["admin.backup.checkpoint"]["parent_id"] == \
             by_name["admin.backup_db"]["span_id"]
         assert by_name["backup.upload"]["annotations"]["files"] > 0
         restore_traces = [

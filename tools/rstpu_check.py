@@ -1,0 +1,1271 @@
+#!/usr/bin/env python
+"""rstpu-check: project-native static analysis for rocksplicator-tpu.
+
+The reference runs its C++ hot paths under TSAN/ASAN and Helix code-review
+conventions; this is our equivalent, specialized to the three invariant
+families the reproduction actually depends on (PARITY.md "Static analysis
+& sanitizers"):
+
+Pass 1 — lock-order (``lock-order-cycle``, ``blocking-under-lock``)
+    Identifies lock objects (attribute-rooted ``threading.Lock/RLock/
+    Condition`` and ``ObjectLock``, plus module- and class-level locks),
+    builds the acquired-while-holding graph over ``with`` blocks and bare
+    ``acquire()/release()`` pairs — including interprocedural ONE-HOP
+    calls resolved through self-methods, module functions, and
+    ``self.attr = ClassName(...)`` typed attributes — then reports
+    cycles (potential deadlock) and blocking calls made while holding a
+    lock (fsync, sleep, ``Future.result()``, socket verbs, object-store
+    transfers, WAL group-sync).
+
+Pass 2 — event-loop blocking (``loop-blocking``)
+    Every function reachable (call-graph BFS, depth <= 3) from a
+    coroutine or an ioloop-scheduled callback (``call_soon*/call_later/
+    call_at/add_done_callback``) that performs a blocking operation —
+    ``time.sleep``, ``Future.result()``, an untimed ``acquire()``, sync
+    socket IO, fsync — is a finding. Functions only *referenced* (passed
+    to ``run_in_executor``/``submit``/``Thread``) are not call edges:
+    they run off-loop by construction. ``with lock:`` critical sections
+    are assumed short and are pass 1's business, not pass 2's.
+
+Pass 3 — instrumentation registries
+    ``failpoint-*``: every ``failpoints.hit/async_hit/pending_delay/
+    torn_point`` site name is a string literal, registered in
+    ``rocksplicator_tpu/testing/failpoint_registry.py`` (the single
+    source of truth ``failpoints.SITES`` now derives from), with no dead
+    registry entries and every site covered by at least one test or
+    chaos schedule. ``span-manual``: spans are opened only via
+    ``with start_span(...)`` (no leakable manual begin/end, no raw
+    ``Span()`` outside observability/). ``stats-name-grammar``: every
+    literal counter/metric/gauge name matches the documented
+    ``dotted.name key=value`` grammar (lowercase ``[a-z0-9_]`` segments
+    joined by dots; lowercase tag keys via ``tagged()``).
+
+Baseline mechanism: deliberate exceptions carry an inline pragma with a
+reason, on the finding line or the line above::
+
+    time.sleep(d)  # rstpu-check: allow(blocking-under-lock) inline-flush mode
+
+A pragma without a reason, or one that suppresses nothing, is itself a
+finding — the baseline cannot silently rot. A lock whose entire purpose
+is serializing an I/O device (the WAL group-commit fsync leader lock,
+the versioned-manifest writer mutex) is declared ONCE at its
+construction site::
+
+    self._sync_lock = threading.Lock()  # rstpu-check: io-mutex group-commit fsync leader
+
+Blocking while holding ONLY io-mutexes is by design and suppressed;
+blocking while also holding any data lock still reports, and io-mutexes
+participate in the lock-order graph like any other lock.
+
+Exit status: 0 iff zero unsuppressed findings. ``--emit-lock-order``
+prints ``testing/lock_order.py`` (construction-site → rank from a
+topological sort of the static graph) for the lockwatch runtime;
+``--check-lock-order`` verifies the checked-in copy is fresh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*rstpu-check:\s*allow\(([a-z0-9_,\- ]+)\)\s*(.*)$")
+IO_MUTEX_RE = re.compile(r"#\s*rstpu-check:\s*io-mutex\b\s*(.*)$")
+
+RULES = {
+    "lock-order-cycle": "cycle in the acquired-while-holding lock graph",
+    "blocking-under-lock": "blocking call while holding a lock",
+    "loop-blocking": "blocking call reachable from the event loop",
+    "failpoint-unregistered": "failpoint site not in failpoint_registry",
+    "failpoint-dead-entry": "registry entry with no hit() site",
+    "failpoint-dynamic-name": "failpoint site name is not a string literal",
+    "failpoint-uncovered": "failpoint site not referenced by any test/chaos",
+    "span-manual": "span not opened via `with start_span(...)`",
+    "stats-name-grammar": "stats name violates dotted.name key=value grammar",
+    "pragma-missing-reason": "allow() pragma without a reason",
+    "pragma-unused": "allow() pragma that suppresses nothing",
+}
+
+STATS_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)*$")
+TAG_KEY_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# Blocking-operation predicate, shared by passes 1 and 2. Two shapes:
+# module-function calls matched by dotted name, attribute calls matched
+# by the attribute name alone (receiver types are not tracked; these
+# names are specific enough in this codebase that false hits are rare
+# and a pragma documents the exception).
+_BLOCKING_FUNCS = {
+    "os.fsync": "fsync", "os.fdatasync": "fsync",
+    "time.sleep": "sleep",
+    "socket.create_connection": "socket",
+    "shutil.copyfile": "bulk-copy", "shutil.copytree": "bulk-copy",
+}
+_BLOCKING_ATTRS = {
+    "result": "Future.result",
+    "sendall": "socket", "recv": "socket", "recv_into": "socket",
+    "sendmsg": "socket", "connect_ex": "socket",
+    "sync_to": "wal-group-fsync",
+    "get_object": "object-store", "get_objects": "object-store",
+    "put_object": "object-store", "put_objects": "object-store",
+}
+# pass 2 only: a bare lock acquire with no timeout parks the whole loop
+_LOOP_ONLY_ATTRS = {"acquire": "untimed-acquire"}
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+# ---------------------------------------------------------------------------
+# findings + pragmas
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Pragmas:
+    """Per-file `# rstpu-check: allow(rule) reason` map with usage
+    tracking so unused pragmas can be reported."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.by_line: Dict[int, Set[str]] = {}
+        self.reasons: Dict[int, str] = {}
+        self.used: Set[int] = set()
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = PRAGMA_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            self.by_line[i] = rules
+            self.reasons[i] = m.group(2).strip()
+
+    def suppresses(self, rule: str, line: int) -> bool:
+        for cand in (line, line - 1):
+            if rule in self.by_line.get(cand, ()):
+                self.used.add(cand)
+                return True
+        return False
+
+    def lint(self) -> List[Finding]:
+        out = []
+        for line, rules in sorted(self.by_line.items()):
+            unknown = rules - set(RULES)
+            if unknown:
+                out.append(Finding(
+                    "pragma-unused", self.path, line,
+                    f"pragma names unknown rule(s) {sorted(unknown)}"))
+            if not self.reasons.get(line):
+                out.append(Finding(
+                    "pragma-missing-reason", self.path, line,
+                    "allow() pragma must carry a reason"))
+            elif line not in self.used:
+                out.append(Finding(
+                    "pragma-unused", self.path, line,
+                    f"pragma allow({','.join(sorted(rules))}) suppresses "
+                    f"no finding — remove it or it will mask a future one"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# module model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FuncInfo:
+    qualname: str          # module.Class.func or module.func
+    module: str
+    cls: Optional[str]
+    name: str
+    node: ast.AST
+    is_async: bool
+    # phase-A summary
+    acquires: List[Tuple[str, int]] = field(default_factory=list)
+    blocking: List[Tuple[str, str, int]] = field(default_factory=list)
+    loop_blocking: List[Tuple[str, str, int]] = field(default_factory=list)
+    calls: List[Tuple[str, int]] = field(default_factory=list)  # resolved qualnames
+
+
+@dataclass
+class ModuleInfo:
+    relpath: str
+    modname: str           # dotted, package-relative (e.g. storage.engine)
+    tree: ast.Module
+    source: str
+    pragmas: Pragmas
+    imports: Dict[str, str] = field(default_factory=dict)  # alias -> dotted target
+
+
+class Project:
+    """Parsed package + lock table + function table + type hints."""
+
+    def __init__(self, root: str, package_dir: str):
+        self.root = root
+        self.package_dir = package_dir
+        self.modules: Dict[str, ModuleInfo] = {}
+        # lock identity: "Class.attr" / "module:name" -> construction site
+        self.locks: Dict[str, Tuple[str, int]] = {}
+        self.io_mutexes: Set[str] = set()     # declared-by-design IO locks
+        self.io_findings: List[Finding] = []  # io-mutex markers sans reason
+        self.lock_alias: Dict[str, str] = {}   # Condition(self._lock) chains
+        self.lock_kind: Dict[str, str] = {}    # Lock/RLock/Condition/ObjectLock
+        self.attr_types: Dict[Tuple[str, str], str] = {}  # (Class, attr) -> Class
+        self.classes: Dict[str, Dict[str, FuncInfo]] = {}  # Class -> {meth: fi}
+        self.class_bases: Dict[str, List[str]] = {}
+        self.module_funcs: Dict[str, Dict[str, FuncInfo]] = {}
+        self.funcs: Dict[str, FuncInfo] = {}
+        self._load()
+        self._collect()
+
+    # -- loading ----------------------------------------------------------
+
+    def _load(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.package_dir):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.root)
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+                try:
+                    tree = ast.parse(src, filename=rel)
+                except SyntaxError as e:
+                    raise SystemExit(f"rstpu-check: cannot parse {rel}: {e}")
+                modrel = os.path.relpath(path, self.package_dir)
+                modname = modrel[:-3].replace(os.sep, ".")
+                if modname.endswith("__init__"):
+                    modname = modname[: -len(".__init__")] or "__init__"
+                mi = ModuleInfo(rel, modname, tree, src, Pragmas(rel, src))
+                self._collect_imports(mi)
+                self.modules[modname] = mi
+
+    @staticmethod
+    def _collect_imports(mi: ModuleInfo) -> None:
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mi.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    mi.imports[a.asname or a.name] = f"{mod}.{a.name}"
+
+    # -- collection -------------------------------------------------------
+
+    def _is_lock_ctor(self, mi: ModuleInfo, call: ast.Call) -> Optional[str]:
+        """'Lock'/'RLock'/'Condition'/'ObjectLock' when `call` builds a
+        lock, else None."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            base = mi.imports.get(f.value.id, f.value.id)
+            if base == "threading" and f.attr in _LOCK_CTORS:
+                return f.attr
+        elif isinstance(f, ast.Name):
+            tgt = mi.imports.get(f.id, "")
+            tail = tgt.rsplit(".", 1)[-1]
+            if tail in _LOCK_CTORS and "threading" in tgt:
+                return tail
+            if f.id == "ObjectLock" or tail == "ObjectLock":
+                return "ObjectLock"
+        return None
+
+    def _collect(self) -> None:
+        # two phases: register every class/function first, THEN read
+        # self.attr assignments — attribute typing must not depend on
+        # module walk order
+        for mi in self.modules.values():
+            for node in mi.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._collect_class(mi, node)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add_func(mi, None, node)
+                elif isinstance(node, ast.Assign):
+                    self._module_lock(mi, node)
+        for mi in self.modules.values():
+            for node in mi.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            self._collect_self_assigns(mi, node.name, sub)
+        # resolve Condition(self._x)-style aliases transitively
+        for k in list(self.lock_alias):
+            seen = {k}
+            tgt = self.lock_alias[k]
+            while tgt in self.lock_alias and tgt not in seen:
+                seen.add(tgt)
+                tgt = self.lock_alias[tgt]
+            self.lock_alias[k] = tgt
+
+    def _register_lock(self, mi: ModuleInfo, lid: str, kind: str,
+                       lineno: int) -> None:
+        self.locks[lid] = (mi.relpath, lineno)
+        self.lock_kind[lid] = kind
+        try:
+            text = mi.source.splitlines()[lineno - 1]
+        except IndexError:  # pragma: no cover
+            return
+        m = IO_MUTEX_RE.search(text)
+        if m:
+            self.io_mutexes.add(lid)
+            if not m.group(1).strip():
+                self.io_findings.append(Finding(
+                    "pragma-missing-reason", mi.relpath, lineno,
+                    "io-mutex marker must carry a reason"))
+
+    def _module_lock(self, mi: ModuleInfo, node: ast.Assign) -> None:
+        if not isinstance(node.value, ast.Call):
+            return
+        kind = self._is_lock_ctor(mi, node.value)
+        if kind is None:
+            return
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self._register_lock(mi, f"{mi.modname}:{t.id}", kind,
+                                    node.lineno)
+
+    def _collect_class(self, mi: ModuleInfo, cnode: ast.ClassDef) -> None:
+        cname = cnode.name
+        self.classes.setdefault(cname, {})
+        self.class_bases[cname] = [
+            b.id for b in cnode.bases if isinstance(b, ast.Name)]
+        for node in cnode.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(mi, cname, node)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                # class-level lock (e.g. _instance_lock = threading.Lock())
+                value = node.value
+                if isinstance(value, ast.Call):
+                    kind = self._is_lock_ctor(mi, value)
+                    if kind:
+                        targets = (node.targets if isinstance(node, ast.Assign)
+                                   else [node.target])
+                        for t in targets:
+                            if isinstance(t, ast.Name):
+                                self._register_lock(
+                                    mi, f"{cname}.{t.id}", kind,
+                                    node.lineno)
+
+    def _collect_self_assigns(self, mi, cname, fnode) -> None:
+        for node in ast.walk(fnode):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                value = node.value
+                # `self.x = a or ClassName(...)`: take the Call operand
+                if isinstance(value, ast.BoolOp):
+                    calls = [v for v in value.values
+                             if isinstance(v, ast.Call)]
+                    value = calls[0] if calls else value
+                if not isinstance(value, ast.Call):
+                    continue
+                kind = self._is_lock_ctor(mi, value)
+                lid = f"{cname}.{t.attr}"
+                if kind == "Condition" and value.args:
+                    arg = value.args[0]
+                    if (isinstance(arg, ast.Attribute)
+                            and isinstance(arg.value, ast.Name)
+                            and arg.value.id == "self"):
+                        # Condition wrapping an existing lock: acquiring
+                        # the condition IS acquiring that lock
+                        self.lock_alias[lid] = f"{cname}.{arg.attr}"
+                        self.lock_kind[lid] = kind
+                        continue
+                if kind:
+                    self._register_lock(mi, lid, kind, node.lineno)
+                    continue
+                # plain typed attribute: self.x = ClassName(...)
+                f = value.func
+                tname = None
+                if isinstance(f, ast.Name):
+                    tname = mi.imports.get(f.id, f.id).rsplit(".", 1)[-1]
+                elif isinstance(f, ast.Attribute):
+                    tname = f.attr
+                if tname and tname in self.classes:
+                    self.attr_types[(cname, t.attr)] = tname
+
+    def _add_func(self, mi, cname, node) -> None:
+        qual = (f"{mi.modname}.{cname}.{node.name}" if cname
+                else f"{mi.modname}.{node.name}")
+        fi = FuncInfo(qual, mi.modname, cname, node.name, node,
+                      isinstance(node, ast.AsyncFunctionDef))
+        self.funcs[qual] = fi
+        if cname:
+            self.classes.setdefault(cname, {})[node.name] = fi
+        else:
+            self.module_funcs.setdefault(mi.modname, {})[node.name] = fi
+        self._add_nested(mi, cname, node, qual)
+
+    def _add_nested(self, mi, cname, node, outer_qual) -> None:
+        """Closures (the admin handler's `def do():` bodies run in the
+        executor but hold the same locks) are analyzed as functions of
+        the enclosing class — `self` still resolves — but stay OUT of
+        the name-resolution tables: a nested `do` is not callable by
+        name from elsewhere."""
+        for sub in ast.walk(node):
+            if sub is node or not isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # direct children only; deeper nesting recurses below
+            qual = f"{outer_qual}.<locals>.{sub.name}"
+            if qual in self.funcs:
+                continue
+            self.funcs[qual] = FuncInfo(
+                qual, mi.modname, cname, sub.name, sub,
+                isinstance(sub, ast.AsyncFunctionDef))
+
+    # -- lock expression classification ----------------------------------
+
+    def canon(self, lid: str) -> str:
+        return self.lock_alias.get(lid, lid)
+
+    def lock_of(self, mi: ModuleInfo, cls: Optional[str],
+                expr: ast.AST) -> Optional[str]:
+        """LockId acquired by `with expr:` / `expr.acquire()`, else None.
+        Handles self.X, cls.X / ClassName.X, module globals, and
+        ObjectLock `.locked(key)` context-manager calls."""
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Attribute) and f.attr == "locked":
+                inner = self.lock_of(mi, cls, f.value)
+                if inner and self.lock_kind.get(inner) == "ObjectLock":
+                    return inner
+            return None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            base = expr.value.id
+            if base == "self" and cls:
+                lid = f"{cls}.{expr.attr}"
+                for c in [cls] + self.class_bases.get(cls, []):
+                    cand = f"{c}.{expr.attr}"
+                    if cand in self.locks or cand in self.lock_alias:
+                        return self.canon(cand)
+                return None
+            if base == "cls" and cls:
+                lid = f"{cls}.{expr.attr}"
+                return self.canon(lid) if lid in self.locks else None
+            lid = f"{base}.{expr.attr}"  # ClassName._class_lock
+            if lid in self.locks:
+                return self.canon(lid)
+            return None
+        if isinstance(expr, ast.Name):
+            lid = f"{mi.modname}:{expr.id}"
+            return self.canon(lid) if lid in self.locks else None
+        return None
+
+    # -- call resolution (one hop) ---------------------------------------
+
+    def resolve_call(self, mi: ModuleInfo, cls: Optional[str],
+                     call: ast.Call) -> Optional[FuncInfo]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            fi = self.module_funcs.get(mi.modname, {}).get(f.id)
+            if fi:
+                return fi
+            tgt = mi.imports.get(f.id)
+            if tgt:  # from .mod import func
+                mod, _, name = tgt.rpartition(".")
+                mod = mod.lstrip(".")
+                for modname, funcs in self.module_funcs.items():
+                    if (modname == mod or modname.endswith("." + mod)) \
+                            and name in funcs:
+                        return funcs[name]
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        recv = f.value
+        if isinstance(recv, ast.Name):
+            if recv.id in ("self", "cls") and cls:
+                for c in [cls] + self.class_bases.get(cls, []):
+                    fi = self.classes.get(c, {}).get(f.attr)
+                    if fi:
+                        return fi
+                return None
+            # ClassName.method or module_alias.func
+            if recv.id in self.classes:
+                return self.classes[recv.id].get(f.attr)
+            tgt = mi.imports.get(recv.id)
+            if tgt:
+                mod = tgt.lstrip(".")
+                for modname, funcs in self.module_funcs.items():
+                    if (modname == mod or modname.endswith("." + mod)) \
+                            and f.attr in funcs:
+                        return funcs[f.attr]
+            return None
+        # self.attr.method() through a typed attribute
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self" and cls):
+            tname = self.attr_types.get((cls, recv.attr))
+            if tname:
+                return self.classes.get(tname, {}).get(f.attr)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# blocking predicate
+# ---------------------------------------------------------------------------
+
+
+def _dotted_name(mi: ModuleInfo, f: ast.AST) -> Optional[str]:
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        base = mi.imports.get(f.value.id, f.value.id)
+        return f"{base}.{f.attr}"
+    if isinstance(f, ast.Name):
+        return mi.imports.get(f.id, f.id)
+    return None
+
+
+def classify_blocking(mi: ModuleInfo, call: ast.Call,
+                      loop_pass: bool) -> Optional[str]:
+    """Human label when `call` is a blocking operation, else None."""
+    f = call.func
+    dn = _dotted_name(mi, f)
+    if dn in _BLOCKING_FUNCS:
+        return _BLOCKING_FUNCS[dn]
+    if isinstance(f, ast.Attribute):
+        label = _BLOCKING_ATTRS.get(f.attr)
+        if label:
+            return label
+        if loop_pass and f.attr in _LOOP_ONLY_ATTRS:
+            # acquire() with a timeout kw/2nd positional is bounded
+            if len(call.args) >= 2 or any(
+                    kw.arg == "timeout" for kw in call.keywords):
+                return None
+            return _LOOP_ONLY_ATTRS[f.attr]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# phase A: per-function summaries
+# ---------------------------------------------------------------------------
+
+
+class _Summarizer(ast.NodeVisitor):
+    """Collects a function's own acquisitions, blocking calls, and
+    resolvable outgoing calls — without descending into nested defs."""
+
+    def __init__(self, proj: Project, mi: ModuleInfo, fi: FuncInfo):
+        self.proj, self.mi, self.fi = proj, mi, fi
+        self._await_depth = 0
+
+    def run(self) -> None:
+        for stmt in self.fi.node.body:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node):  # do not descend
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Await(self, node):
+        self._await_depth += 1
+        self.generic_visit(node)
+        self._await_depth -= 1
+
+    def visit_With(self, node):
+        for item in node.items:
+            lid = self.proj.lock_of(self.mi, self.fi.cls, item.context_expr)
+            if lid:
+                self.fi.acquires.append((lid, item.context_expr.lineno))
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "acquire":
+            lid = self.proj.lock_of(self.mi, self.fi.cls, f.value)
+            if lid:
+                self.fi.acquires.append((lid, node.lineno))
+        label = classify_blocking(self.mi, node, loop_pass=False)
+        if label and not self._await_depth:
+            self.fi.blocking.append((label, _call_repr(node), node.lineno))
+        loop_label = classify_blocking(self.mi, node, loop_pass=True)
+        if loop_label and not self._await_depth:
+            self.fi.loop_blocking.append(
+                (loop_label, _call_repr(node), node.lineno))
+        callee = self.proj.resolve_call(self.mi, self.fi.cls, node)
+        if callee is not None:
+            self.fi.calls.append((callee.qualname, node.lineno))
+        self.generic_visit(node)
+
+
+def _call_repr(call: ast.Call) -> str:
+    try:
+        return ast.unparse(call.func)
+    except Exception:  # pragma: no cover
+        return "<call>"
+
+
+# ---------------------------------------------------------------------------
+# pass 1: lock-order + blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Phase B: walks one function with a live held-set, adding
+    acquired-while-holding edges and blocking-under-lock findings."""
+
+    def __init__(self, pass1: "LockPass", mi: ModuleInfo, fi: FuncInfo):
+        self.p, self.mi, self.fi = pass1, mi, fi
+        self.held: List[str] = []
+
+    def run(self) -> None:
+        self._walk_block(self.fi.node.body)
+
+    def _walk_block(self, stmts) -> None:
+        base_depth = len(self.held)
+        for stmt in stmts:
+            self.visit(stmt)
+        # bare acquire() without release in this block: conservatively
+        # held to end of block, then dropped
+        del self.held[base_depth:]
+
+    def visit_FunctionDef(self, node):  # nested defs run later, not here
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_With(self, node):
+        acquired = 0
+        for item in node.items:
+            lid = self.p.proj.lock_of(self.mi, self.fi.cls, item.context_expr)
+            if lid:
+                self._acquire(lid, item.context_expr.lineno)
+                acquired += 1
+            else:
+                self.visit(item.context_expr)
+        self._walk_block(node.body)
+        for _ in range(acquired):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            lid = self.p.proj.lock_of(self.mi, self.fi.cls, f.value)
+            if lid is not None:
+                if f.attr == "acquire":
+                    self._acquire(lid, node.lineno)
+                    # stays held until release()/end of block
+                    for arg in node.args:
+                        self.visit(arg)
+                    return
+                if f.attr == "release" and lid in self.held:
+                    self.held.remove(lid)
+                    return
+                if f.attr == "wait":
+                    # Condition.wait releases the underlying lock for the
+                    # duration: not a blocking-under-lock event for it
+                    self.generic_visit(node)
+                    return
+        if self.held:
+            label = classify_blocking(self.mi, node, loop_pass=False)
+            if label:
+                self.p.report_blocking(
+                    self.mi, self.fi, node.lineno, label,
+                    _call_repr(node), self.held)
+            callee = self.p.proj.resolve_call(self.mi, self.fi.cls, node)
+            if callee is not None:
+                # interprocedural one hop: the callee's own acquisitions
+                # and blocking calls happen under our held set
+                for lid, _ln in callee.acquires:
+                    self._edge_only(lid, node.lineno,
+                                    via=callee.qualname)
+                # ...except the failpoint seams: the sleep inside a
+                # delay-policy hit() IS the injected fault, placed at
+                # the seam on purpose (loop seams must still use
+                # async_hit/pending_delay — pass 2 checks that)
+                if callee.module != "testing.failpoints":
+                    for label, crepr, _ln in callee.blocking:
+                        self.p.report_blocking(
+                            self.mi, self.fi, node.lineno, label,
+                            f"{crepr} via {callee.qualname}()", self.held)
+        self.generic_visit(node)
+
+    def _acquire(self, lid: str, line: int) -> None:
+        self._edge_only(lid, line)
+        self.held.append(lid)
+
+    def _edge_only(self, lid: str, line: int, via: str = "") -> None:
+        for holder in self.held:
+            if holder != lid:
+                self.p.add_edge(holder, lid, self.mi.relpath, line,
+                                self.fi.qualname, via)
+
+
+class LockPass:
+    def __init__(self, proj: Project):
+        self.proj = proj
+        # edges[a][b] = (path, line, func, via) — first site seen
+        self.edges: Dict[str, Dict[str, Tuple[str, int, str, str]]] = {}
+        self.findings: List[Finding] = []
+        self.io_suppressed: List[Finding] = []
+
+    def add_edge(self, a, b, path, line, func, via) -> None:
+        self.edges.setdefault(a, {}).setdefault(b, (path, line, func, via))
+
+    def report_blocking(self, mi, fi, line, label, crepr, held) -> None:
+        f = Finding(
+            "blocking-under-lock", mi.relpath, line,
+            f"{crepr} ({label}) while holding "
+            f"{' -> '.join(held)} in {fi.qualname}")
+        if all(lid in self.proj.io_mutexes for lid in held):
+            # serializing this IO is the held locks' declared purpose
+            self.io_suppressed.append(f)
+        else:
+            self.findings.append(f)
+
+    def run(self) -> List[Finding]:
+        for fi in self.proj.funcs.values():
+            mi = self.proj.modules[fi.module]
+            _LockWalker(self, mi, fi).run()
+        self._find_cycles()
+        return self.findings
+
+    def _find_cycles(self) -> None:
+        # DFS cycle detection with path recovery; report each cycle once
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+        reported: Set[frozenset] = set()
+
+        def dfs(n: str):
+            color[n] = 1
+            stack.append(n)
+            for m in self.edges.get(n, {}):
+                if color.get(m, 0) == 1:
+                    cyc = stack[stack.index(m):] + [m]
+                    key = frozenset(cyc)
+                    if key not in reported:
+                        reported.add(key)
+                        sites = []
+                        for a, b in zip(cyc, cyc[1:]):
+                            path, line, func, via = self.edges[a][b]
+                            hop = f" via {via}" if via else ""
+                            sites.append(
+                                f"{a} -> {b} at {path}:{line} "
+                                f"({func}{hop})")
+                        first = self.edges[cyc[0]][cyc[1]]
+                        self.findings.append(Finding(
+                            "lock-order-cycle", first[0], first[1],
+                            "potential deadlock: " + "; ".join(sites)))
+                elif color.get(m, 0) == 0:
+                    dfs(m)
+            stack.pop()
+            color[n] = 2
+
+        for n in list(self.edges):
+            if color.get(n, 0) == 0:
+                dfs(n)
+
+    def canonical_order(self) -> List[str]:
+        """Topological order of the lock graph (requires acyclic) for
+        the lockwatch runtime ranks; locks with no edges sort last by
+        name for determinism."""
+        indeg: Dict[str, int] = {n: 0 for n in self.proj.locks}
+        for lid in list(indeg):
+            if self.proj.canon(lid) != lid:
+                del indeg[lid]
+        for a, outs in self.edges.items():
+            for b in outs:
+                if b in indeg:
+                    indeg[b] = indeg.get(b, 0) + 1
+        order: List[str] = []
+        remaining = dict(indeg)
+        while remaining:
+            ready = sorted(n for n, d in remaining.items() if d == 0)
+            if not ready:  # cycle: reported separately; bail stable
+                order.extend(sorted(remaining))
+                break
+            for n in ready:
+                order.append(n)
+                del remaining[n]
+                for b in self.edges.get(n, {}):
+                    if b in remaining:
+                        remaining[b] -= 1
+        return order
+
+
+# ---------------------------------------------------------------------------
+# pass 2: event-loop blocking
+# ---------------------------------------------------------------------------
+
+_SCHEDULE_ATTRS = {"call_soon", "call_soon_threadsafe", "call_later",
+                   "call_at", "add_done_callback"}
+
+
+class LoopPass:
+    MAX_DEPTH = 3
+
+    def __init__(self, proj: Project):
+        self.proj = proj
+
+    def _roots(self) -> Dict[str, str]:
+        """qualname -> why it runs on the loop."""
+        roots: Dict[str, str] = {}
+        for fi in self.proj.funcs.values():
+            if fi.is_async:
+                roots[fi.qualname] = "coroutine"
+        # sync callbacks handed to the loop scheduler
+        for fi in self.proj.funcs.values():
+            mi = self.proj.modules[fi.module]
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SCHEDULE_ATTRS):
+                    continue
+                for arg in node.args:
+                    target = None
+                    if isinstance(arg, ast.Attribute) and \
+                            isinstance(arg.value, ast.Name) and \
+                            arg.value.id == "self" and fi.cls:
+                        target = self.proj.classes.get(
+                            fi.cls, {}).get(arg.attr)
+                    elif isinstance(arg, ast.Name):
+                        target = self.proj.module_funcs.get(
+                            fi.module, {}).get(arg.id)
+                    if target is not None and not target.is_async:
+                        roots.setdefault(
+                            target.qualname,
+                            f"scheduled via {node.func.attr} in "
+                            f"{fi.qualname}")
+        return roots
+
+    def run(self) -> List[Finding]:
+        findings: List[Finding] = []
+        roots = self._roots()
+        # BFS from each root over resolved call edges; report each
+        # (function, line) once with one sample chain
+        seen_sites: Set[Tuple[str, int]] = set()
+        for root, why in sorted(roots.items()):
+            frontier: List[Tuple[str, List[str]]] = [(root, [root])]
+            visited = {root}
+            depth = 0
+            while frontier and depth <= self.MAX_DEPTH:
+                nxt: List[Tuple[str, List[str]]] = []
+                for qual, chain in frontier:
+                    fi = self.proj.funcs.get(qual)
+                    if fi is None:
+                        continue
+                    mi = self.proj.modules[fi.module]
+                    for label, crepr, line in fi.loop_blocking:
+                        site = (qual, line)
+                        if site in seen_sites:
+                            continue
+                        seen_sites.add(site)
+                        via = (" -> ".join(chain) if len(chain) > 1
+                               else chain[0])
+                        findings.append(Finding(
+                            "loop-blocking", mi.relpath, line,
+                            f"{crepr} ({label}) on the event loop: "
+                            f"{via} [{why}]"))
+                    for callee, _line in fi.calls:
+                        cfi = self.proj.funcs.get(callee)
+                        if cfi is None or callee in visited:
+                            continue
+                        if cfi.is_async:
+                            continue  # awaited coroutine: its own root
+                        visited.add(callee)
+                        nxt.append((callee, chain + [callee]))
+                frontier = nxt
+                depth += 1
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 3: registries (failpoints, spans, stats)
+# ---------------------------------------------------------------------------
+
+_FP_ENTRY_FUNCS = {"hit", "async_hit", "pending_delay", "torn_point"}
+
+
+class RegistryPass:
+    def __init__(self, proj: Project, registry_path: Optional[str],
+                 coverage_dirs: Optional[List[str]]):
+        self.proj = proj
+        self.registry_path = registry_path
+        self.coverage_dirs = coverage_dirs
+
+    def _registry_names(self) -> Tuple[List[str], List[Finding]]:
+        findings: List[Finding] = []
+        if not self.registry_path or not os.path.isfile(self.registry_path):
+            return [], findings
+        with open(self.registry_path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=self.registry_path)
+        rel = os.path.relpath(self.registry_path, self.proj.root)
+        names: List[str] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "REGISTRY"
+                    for t in node.targets):
+                if isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            if k.value in names:
+                                findings.append(Finding(
+                                    "failpoint-unregistered", rel,
+                                    k.lineno,
+                                    f"duplicate registry entry "
+                                    f"{k.value!r}"))
+                            names.append(k.value)
+        return names, findings
+
+    def _fp_sites(self) -> Tuple[Dict[str, List[Tuple[str, int]]],
+                                 List[Finding]]:
+        """site name -> [(relpath, line)] over the package (the registry
+        module and the failpoints module themselves excluded)."""
+        findings: List[Finding] = []
+        sites: Dict[str, List[Tuple[str, int]]] = {}
+        for mi in self.proj.modules.values():
+            if mi.modname.startswith("testing.failpoint") or \
+                    mi.modname == "testing.failpoints":
+                continue
+            for node in ast.walk(mi.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _FP_ENTRY_FUNCS
+                        and isinstance(node.func.value, ast.Name)):
+                    continue
+                base = mi.imports.get(node.func.value.id, "")
+                if "failpoints" not in base and \
+                        node.func.value.id not in ("fp", "failpoints"):
+                    continue
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    findings.append(Finding(
+                        "failpoint-dynamic-name", mi.relpath, node.lineno,
+                        f"failpoints.{node.func.attr}() site name must be "
+                        f"a string literal"))
+                    continue
+                sites.setdefault(arg.value, []).append(
+                    (mi.relpath, node.lineno))
+        return sites, findings
+
+    def _coverage_text(self) -> str:
+        chunks = []
+        for d in self.coverage_dirs or []:
+            for dirpath, dirnames, filenames in os.walk(d):
+                dirnames[:] = [x for x in dirnames if x != "__pycache__"]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        with open(os.path.join(dirpath, fn), "r",
+                                  encoding="utf-8", errors="replace") as f:
+                            chunks.append(f.read())
+        return "\n".join(chunks)
+
+    def run(self) -> List[Finding]:
+        findings: List[Finding] = []
+        reg_names, reg_findings = self._registry_names()
+        findings.extend(reg_findings)
+        sites, site_findings = self._fp_sites()
+        findings.extend(site_findings)
+        if self.registry_path and os.path.isfile(self.registry_path):
+            rel = os.path.relpath(self.registry_path, self.proj.root)
+            regset = set(reg_names)
+            for name, locs in sorted(sites.items()):
+                if name not in regset:
+                    path, line = locs[0]
+                    findings.append(Finding(
+                        "failpoint-unregistered", path, line,
+                        f"failpoint site {name!r} is not in "
+                        f"testing/failpoint_registry.py"))
+            hit_names = set(sites)
+            for name in reg_names:
+                if name not in hit_names:
+                    findings.append(Finding(
+                        "failpoint-dead-entry", rel, 1,
+                        f"registry entry {name!r} has no "
+                        f"fp.hit/async_hit/pending_delay/torn_point site"))
+            if self.coverage_dirs:
+                text = self._coverage_text()
+                for name in reg_names:
+                    if f'"{name}"' not in text and \
+                            f"'{name}'" not in text:
+                        findings.append(Finding(
+                            "failpoint-uncovered", rel, 1,
+                            f"failpoint {name!r} is not referenced by any "
+                            f"test or chaos schedule"))
+        findings.extend(self._span_lint())
+        findings.extend(self._stats_lint())
+        return findings
+
+    def _span_lint(self) -> List[Finding]:
+        findings: List[Finding] = []
+        for mi in self.proj.modules.values():
+            in_obs = mi.modname.startswith("observability")
+            with_ctx: Set[int] = set()
+            for node in ast.walk(mi.tree):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        with_ctx.add(id(item.context_expr))
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = None
+                if isinstance(node.func, ast.Name):
+                    fname = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    fname = node.func.attr
+                if fname == "start_span" and not in_obs:
+                    if id(node) not in with_ctx:
+                        findings.append(Finding(
+                            "span-manual", mi.relpath, node.lineno,
+                            "start_span() must be used as `with "
+                            "start_span(...)` — a bare call leaks the "
+                            "span on any exception path"))
+                elif fname == "Span" and not in_obs:
+                    tgt = mi.imports.get("Span", "")
+                    if "observability" in tgt or isinstance(
+                            node.func, ast.Attribute):
+                        findings.append(Finding(
+                            "span-manual", mi.relpath, node.lineno,
+                            "raw Span() construction outside "
+                            "observability/ — use `with start_span(...)`"))
+        return findings
+
+    def _stats_lint(self) -> List[Finding]:
+        findings: List[Finding] = []
+        for mi in self.proj.modules.values():
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                fname = (f.attr if isinstance(f, ast.Attribute)
+                         else f.id if isinstance(f, ast.Name) else None)
+                if fname in ("incr", "add_metric", "add_gauge", "tagged",
+                             "Timer") and node.args:
+                    self._check_name(mi, node, fname, findings)
+        return findings
+
+    def _check_name(self, mi, node, fname, findings) -> None:
+        arg = node.args[0]
+        if isinstance(arg, ast.Call):
+            inner = arg.func
+            iname = (inner.attr if isinstance(inner, ast.Attribute)
+                     else inner.id if isinstance(inner, ast.Name) else None)
+            if iname == "tagged":
+                return  # the tagged() call is checked at its own node
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            return
+        name = arg.value
+        if not STATS_NAME_RE.match(name):
+            findings.append(Finding(
+                "stats-name-grammar", mi.relpath, node.lineno,
+                f"{fname}() name {name!r} violates the dotted.name "
+                f"grammar [a-z0-9_ segments joined by '.']"))
+        if fname == "tagged":
+            for kw in node.keywords:
+                if kw.arg and not TAG_KEY_RE.match(kw.arg):
+                    findings.append(Finding(
+                        "stats-name-grammar", mi.relpath, node.lineno,
+                        f"tag key {kw.arg!r} violates the key=value "
+                        f"grammar [a-z0-9_]"))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+ALL_PASSES = ("lock", "loop", "registry")
+
+
+def run_checks(
+    package_dir: str,
+    root: Optional[str] = None,
+    passes: Iterable[str] = ALL_PASSES,
+    registry_path: Optional[str] = "<default>",
+    coverage_dirs: Optional[List[str]] = "<default>",  # type: ignore
+) -> Tuple[List[Finding], List[Finding], "LockPass"]:
+    """Run the selected passes; returns (unsuppressed, suppressed,
+    lock_pass). Library entry point used by the tests' fixture teeth."""
+    package_dir = os.path.abspath(package_dir)
+    root = os.path.abspath(root or os.path.dirname(package_dir))
+    if registry_path == "<default>":
+        registry_path = os.path.join(
+            package_dir, "testing", "failpoint_registry.py")
+    if coverage_dirs == "<default>":
+        coverage_dirs = [p for p in (os.path.join(root, "tests"),
+                                     os.path.join(root, "tools"))
+                         if os.path.isdir(p)]
+    proj = Project(root, package_dir)
+    for fi in proj.funcs.values():
+        _Summarizer(proj, proj.modules[fi.module], fi).run()
+    lock_pass = LockPass(proj)
+    findings: List[Finding] = []
+    if "lock" in passes:
+        findings.extend(lock_pass.run())
+    else:
+        lock_pass.run()  # edges still needed for --emit-lock-order
+    if "loop" in passes:
+        findings.extend(LoopPass(proj).run())
+    if "registry" in passes:
+        findings.extend(RegistryPass(
+            proj, registry_path, coverage_dirs).run())
+    # dedupe: one-hop propagation can report the same (rule, site)
+    # once per blocking call inside the callee
+    uniq: Dict[Tuple[str, str, int, str], Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.path, f.line, f.message), f)
+    findings = list(uniq.values())
+    findings.extend(proj.io_findings)
+    kept: List[Finding] = []
+    suppressed: List[Finding] = list(lock_pass.io_suppressed)
+    by_path = {mi.relpath: mi.pragmas for mi in proj.modules.values()}
+    for f in findings:
+        pragmas = by_path.get(f.path)
+        if pragmas and pragmas.suppresses(f.rule, f.line):
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    for pragmas in by_path.values():
+        kept.extend(pragmas.lint())
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept, suppressed, lock_pass
+
+
+def emit_lock_order(lock_pass: LockPass) -> str:
+    proj = lock_pass.proj
+    order = lock_pass.canonical_order()
+    site_of = {lid: f"{s[0]}:{s[1]}" for lid, s in proj.locks.items()}
+    # transitive closure of the static acquired-while-holding graph,
+    # over construction sites: (A, B) means A is canonically acquired
+    # BEFORE B. This is a PARTIAL order — locks the static graph never
+    # relates have no entry and the runtime watchdog constrains them
+    # only via its dynamic cycle detection.
+    closure: Set[Tuple[str, str]] = set()
+    for a in proj.locks:
+        seen, stack = {a}, [a]
+        while stack:
+            n = stack.pop()
+            for m in lock_pass.edges.get(n, ()):
+                if m not in seen:
+                    seen.add(m)
+                    stack.append(m)
+                    if a in site_of and m in site_of:
+                        closure.add((site_of[a], site_of[m]))
+    lines = [
+        '"""Canonical lock-acquisition order — GENERATED, do not edit.',
+        "",
+        "Regenerate with:",
+        "  python -m tools.rstpu_check --emit-lock-order \\",
+        "      > rocksplicator_tpu/testing/lock_order.py",
+        "Verified fresh by `make check` (--check-lock-order).",
+        "",
+        "ORDER is the transitive closure of the static",
+        "acquired-while-holding graph (tools/rstpu_check.py pass 1),",
+        "keyed by lock construction site: (A, B) present means A is",
+        "canonically acquired before B, so a live acquisition of A while",
+        "holding B is a violation. RANKS names each known lock and gives",
+        "a topological rank for humans reading reports; pairs the static",
+        "graph never relates are constrained only by the lockwatch",
+        "runtime's dynamic cycle detection.",
+        '"""',
+        "",
+        "# construction site (repo-relative file:line) -> (name, rank)",
+        "RANKS = {",
+    ]
+    for rank, lid in enumerate(order):
+        site = proj.locks.get(lid)
+        if site is None:
+            continue
+        lines.append(f'    "{site[0]}:{site[1]}": ({lid!r}, {rank}),')
+    lines.append("}")
+    lines.append("")
+    lines.append("# static partial order: (acquired-first, acquired-second)")
+    lines.append("ORDER = {")
+    for a, b in sorted(closure):
+        lines.append(f'    ("{a}", "{b}"),')
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rstpu-check", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: package dir's parent)")
+    ap.add_argument("--package", default="rocksplicator_tpu",
+                    help="package directory to analyze")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=ALL_PASSES, default=None,
+                    help="run only this pass (repeatable; default: all)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    ap.add_argument("--emit-lock-order", action="store_true",
+                    help="print the generated testing/lock_order.py")
+    ap.add_argument("--check-lock-order", action="store_true",
+                    help="fail if the checked-in lock_order.py is stale")
+    args = ap.parse_args(argv)
+
+    passes = tuple(args.passes) if args.passes else ALL_PASSES
+    kept, suppressed, lock_pass = run_checks(
+        args.package, root=args.root, passes=passes)
+
+    if args.emit_lock_order:
+        sys.stdout.write(emit_lock_order(lock_pass))
+        return 0
+    rc = 0
+    if args.check_lock_order:
+        path = os.path.join(os.path.abspath(args.package),
+                            "testing", "lock_order.py")
+        want = emit_lock_order(lock_pass)
+        have = ""
+        if os.path.isfile(path):
+            with open(path, "r", encoding="utf-8") as f:
+                have = f.read()
+        if have != want:
+            print("rstpu-check: testing/lock_order.py is STALE — "
+                  "regenerate with: python -m tools.rstpu_check "
+                  "--emit-lock-order > "
+                  "rocksplicator_tpu/testing/lock_order.py",
+                  file=sys.stderr)
+            rc = 1
+
+    if args.json:
+        print(json.dumps({
+            "findings": [vars(f) for f in kept],
+            "suppressed": [vars(f) for f in suppressed],
+        }, indent=2))
+    else:
+        for f in kept:
+            print(f.format())
+        print(f"rstpu-check: {len(kept)} finding(s), "
+              f"{len(suppressed)} baselined via allow() pragmas "
+              f"[passes: {', '.join(passes)}]")
+    return 1 if kept else rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
