@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke chaos-smoke chaos-failover-smoke clean
+.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke chaos-smoke chaos-failover-smoke clean
 
 # rstpu-check: the three-pass static suite (lock-order/blocking-under-
 # lock, event-loop blocking, failpoint/span/stats registries) over
@@ -71,6 +71,26 @@ transport-bench-smoke:
 		--write_window 64 --transport loopback \
 		--out benchmarks/results/transport_smoke_loopback.json
 
+# round-13 serving-scale macro-bench: YCSB-style mixed workload (zipfian
+# keys, tunable get/put/multi_get/scan mix, open-loop Poisson arrival)
+# against a 3-process 3-replica cluster via the router's read policies,
+# sweeping offered throughput and reporting p50/p99 per op class, plus
+# the interleaved leader_only vs follower_ok(max_lag) read-scaling A/B
+macro-bench:
+	$(PY) bench.py --macro_bench --shards 4 --preload_keys 2000 \
+		--rates 300,600,1200,2400 --duration 5 --ab --ab_duration 6 \
+		--ab_reps 3 --ab_readers 8 \
+		--out benchmarks/results/macro_bench_r13.json
+
+# sub-minute macro-bench smoke: tiny keyspace, 3-point sweep, 1-rep A/B;
+# fails loudly on value mismatches, zero follower-served reads, or an
+# empty sweep (the artifact shape is also asserted by tier-1 tests)
+macro-bench-smoke:
+	$(PY) bench.py --macro_bench --shards 2 --preload_keys 400 \
+		--rates 150,300,600 --duration 2 --ab --ab_duration 2 \
+		--ab_reps 1 --ab_readers 4 \
+		--out benchmarks/results/macro_bench_smoke.json
+
 # seeded chaos smoke (<60s): 20 randomized failpoint schedules against a
 # 3-node cluster + the admin ingest path, every schedule checked for the
 # three standing invariants (hole-free WAL prefix, zero acked-write
@@ -99,16 +119,20 @@ chaos-smoke:
 		--ingest-every 1 \
 		--break-guard meta_first --expect-violation --conv-timeout 10
 
-# coordinator-backed failover chaos (~25s + ~20s tooth): >= 15 seeded
+# coordinator-backed failover chaos (~30s + ~20s tooth): >= 15 seeded
 # control-plane schedules against Controller + Spectator + 3
 # participants — leader crash holding a full AckWindow, participant
 # session expiry via coordinator.heartbeat, coordinator primary kill,
 # coordinator WAL torn-write — each followed by the FOURTH standing
 # invariant (exactly one LEADER per shard, zero acked-write loss across
 # the handoff, shard-map convergence within a bounded number of
-# controller passes); then the fencing tooth: a leader patched to
-# IGNORE epochs must be CAUGHT acking writes after deposition
-# (--expect-violation). A violation prints the reproducing --seed.
+# controller passes) AND the FIFTH (round 13): bounded-staleness reads
+# issued at every replica post-heal — zero served reads may violate the
+# client's lag bound, zero reads may come from a deposed lineage (the
+# fenced ex-leader is probed directly); then the fencing tooth: a
+# leader patched to IGNORE epochs must be CAUGHT acking writes after
+# deposition (--expect-violation). A violation prints the reproducing
+# --seed.
 chaos-failover-smoke:
 	$(PY) -m tools.chaos_soak --failover --schedules 15 --seed 1 \
 		--out benchmarks/results/chaos_failover_smoke.json

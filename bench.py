@@ -1058,6 +1058,16 @@ def _salvage_late_accelerator(record, budget_left):
 
 
 if __name__ == "__main__":
+    if "--macro_bench" in sys.argv:
+        # serving-scale macro-bench mode (round 13): YCSB-style mixed
+        # workload (zipfian keys, Poisson open-loop arrival) over a
+        # 3-replica cluster via router read policies — no accelerator
+        # worker, no kernel compiles. Other args pass through to
+        # benchmarks/macro_bench.py.
+        from benchmarks.macro_bench import main as macro_bench_main
+
+        argv = [a for a in sys.argv[1:] if a != "--macro_bench"]
+        sys.exit(macro_bench_main(argv))
     if "--flush_bench" in sys.argv:
         # engine microbench mode (round 9): flush / host-compaction /
         # block-cache A/B — no accelerator worker, no kernel compiles.

@@ -15,7 +15,8 @@ from __future__ import annotations
 import logging
 from typing import Iterator, List, Optional, Tuple
 
-from ..replication.db_wrapper import DbWrapper, StorageDbWrapper
+from ..replication.db_wrapper import (DbWrapper, StorageDbWrapper,
+                                      execute_read_op)
 from ..replication.replicated_db import LeaderResolver, ReplicatedDB
 from ..replication.replicator import Replicator
 from ..replication.wire import ReplicaRole
@@ -46,6 +47,10 @@ class ApplicationDB:
         self._replicator = replicator
         self._stats = Stats.get()
         self._enable_read_stats = enable_read_stats
+        # local engine reader for the bounded-staleness read path: always
+        # reads THIS replica's engine, independent of whatever wrapper
+        # (possibly a non-persisting proxy) is registered for replication
+        self._reader = StorageDbWrapper(db)
         self.replicated_db: Optional[ReplicatedDB] = None
         if replicator is not None and role is not ReplicaRole.NOOP:
             self.replicated_db = replicator.add_db(
@@ -97,6 +102,37 @@ class ApplicationDB:
                 tagged("applicationdb.multigets", db=self.name), len(keys)
             )
         return self.db.multi_get(keys)
+
+    def read(
+        self,
+        op: str = "get",
+        keys=None,
+        start: Optional[bytes] = None,
+        count: Optional[int] = None,
+        max_lag: Optional[int] = None,
+        epoch: Optional[int] = None,
+    ) -> dict:
+        """Bounded-staleness local read (round 13): the in-process analog
+        of the replication plane's ``read`` RPC, for embedding services
+        (reference: ApplicationDB delegating reads to rocksdb,
+        application_db.cpp:138-181) that want the same guarantees a
+        routed client gets. Replicated dbs gate through
+        ``ReplicatedDB.read_gate`` — a FOLLOWER serves only within
+        ``max_lag`` of the leader's committed sequence and rejects a
+        newer-epoch (deposed-lineage) read exactly as it rejects
+        stale-epoch pulls; the sync gate never probes, so a follower
+        whose commit-point estimate aged out bounces rather than
+        blocking. Unreplicated/NOOP dbs serve directly."""
+        gate: dict = {"applied_seq": None, "leader_seq": None, "lag": None}
+        if self.replicated_db is not None:
+            gate = self.replicated_db.read_gate(max_lag=max_lag, epoch=epoch)
+        if self._enable_read_stats:
+            self._stats.incr(tagged("applicationdb.reads", db=self.name))
+        # one shared dispatch with the RPC path (execute_read_op) over a
+        # local engine reader, so the two surfaces cannot diverge
+        values = execute_read_op(self._reader, op, keys=keys, start=start,
+                                 count=count)
+        return {**gate, "values": values, "source_role": self.role.value}
 
     def new_iterator(self, start=None, end=None) -> Iterator[Tuple[bytes, bytes]]:
         return self.db.new_iterator(start, end)

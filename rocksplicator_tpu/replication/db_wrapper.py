@@ -16,6 +16,27 @@ from ..storage.engine import DB
 from ..storage.records import WriteBatch, decode_batch
 
 
+def execute_read_op(reader, op: str, keys=None, start=None,
+                    count=None) -> list:
+    """The ONE home of get/multi_get/scan dispatch semantics, shared by
+    every read surface (`ReplicatedDB._do_read`, `ApplicationDB.read`)
+    so the RPC and in-process paths cannot diverge. ``reader`` exposes
+    ``get`` / ``multi_get`` / ``scan(start, limit)``."""
+    if op == "get":
+        key = (keys[0] if keys else None) \
+            if isinstance(keys, (list, tuple)) else keys
+        if key is None:
+            raise ValueError("get requires a key")
+        return [reader.get(bytes(key))]
+    if op == "multi_get":
+        return reader.multi_get([bytes(k) for k in (keys or [])])
+    if op == "scan":
+        limit = 10 if count is None else max(1, int(count))
+        s = bytes(start) if start is not None else None
+        return [[k, v] for k, v in reader.scan(s, limit)]
+    raise ValueError(f"unknown read op {op!r}")
+
+
 class DbWrapper:
     """Abstract seam (db_wrapper.h)."""
 
@@ -69,6 +90,22 @@ class DbWrapper:
             self.handle_replicate_response(
                 bytes(u["raw_data"]), u.get("timestamp"))
 
+    # -- serving reads (round 13: bounded-staleness follower reads) ------
+    # Wrappers that persist locally expose the engine's read surface so
+    # any replica — not just the leader — can serve reads; CDC observers
+    # and other non-persisting wrappers keep the default and the read
+    # handler turns it into a clean RPC error.
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError("wrapper does not serve reads")
+
+    def multi_get(self, keys: List[bytes]) -> List[Optional[bytes]]:
+        return [self.get(k) for k in keys]
+
+    def scan(self, start: Optional[bytes], limit: int
+             ) -> List[Tuple[bytes, bytes]]:
+        raise NotImplementedError("wrapper does not serve scans")
+
 
 class StorageDbWrapper(DbWrapper):
     """Default wrapper over the LSM engine (rocksdb_wrapper.{h,cpp}):
@@ -120,3 +157,18 @@ class StorageDbWrapper(DbWrapper):
             raw = bytes(u["raw_data"])
             items.append((decode_batch(raw), raw))
         self.db.write_many(items)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self.db.get(key)
+
+    def multi_get(self, keys: List[bytes]) -> List[Optional[bytes]]:
+        return self.db.multi_get(keys)
+
+    def scan(self, start: Optional[bytes], limit: int
+             ) -> List[Tuple[bytes, bytes]]:
+        out: List[Tuple[bytes, bytes]] = []
+        for k, v in self.db.new_iterator(start=start):
+            out.append((k, v))
+            if len(out) >= limit:
+                break
+        return out

@@ -65,3 +65,49 @@ class ReplicatorHandler:
             )
         db.post_applied(applied_seq, role, epoch=epoch)
         return {"acked_seq": db._acked.value, "epoch": db.epoch}
+
+    async def handle_read(
+        self,
+        db_name: str = "",
+        op: str = "get",
+        keys=None,
+        start=None,
+        count: Optional[int] = None,
+        max_lag: Optional[int] = None,
+        epoch: Optional[int] = None,
+    ) -> dict:
+        """Bounded-staleness read (round 13): any replica — LEADER or
+        FOLLOWER within ``max_lag`` of the leader's committed sequence —
+        serves get/multi_get/scan; a follower on a deposed lineage
+        rejects exactly as it rejects stale-epoch pulls."""
+        span = current_span()
+        if span is not None and span.sampled:
+            span.annotate(db=db_name, op=op)
+        db = self._dbs.get(db_name)
+        if db is None or db.removed:
+            raise RpcApplicationError(
+                ReplicateErrorCode.SOURCE_NOT_FOUND.value, db_name
+            )
+        return await db.handle_read_request(
+            op=op, keys=keys, start=start, count=count, max_lag=max_lag,
+            epoch=epoch,
+        )
+
+    async def handle_write(
+        self,
+        db_name: str = "",
+        raw_batch=None,
+        epoch: Optional[int] = None,
+    ) -> dict:
+        """Remote leader write (the macro-bench's full-stack put path):
+        one encoded WriteBatch in, {seq, acked} out once the replication
+        ack condition resolves. Non-leaders raise NOT_LEADER; a deposed
+        leader raises STALE_EPOCH."""
+        db = self._dbs.get(db_name)
+        if db is None or db.removed:
+            raise RpcApplicationError(
+                ReplicateErrorCode.SOURCE_NOT_FOUND.value, db_name
+            )
+        if raw_batch is None:
+            raise RpcApplicationError("BAD_WRITE", "raw_batch required")
+        return await db.handle_write_request(raw_batch, epoch=epoch)

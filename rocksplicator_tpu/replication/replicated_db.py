@@ -45,6 +45,8 @@ from .ack_window import AckWaiter, AckWindow, resolved_waiter
 from .cond_var import AsyncNotifier
 from .db_wrapper import DbWrapper
 from .iter_cache import IterCache
+from ..utils.timer import Timer
+from .wire import READ_METRICS as R
 from .wire import REPLICATOR_METRICS as M
 from .wire import ReplicaRole, ReplicateErrorCode
 
@@ -85,6 +87,20 @@ class ReplicationFlags:
     # max_updates_per_response) so one response acks a whole write
     # window; also the server-side clamp on any requested max_updates
     adaptive_max_updates_cap: int = 1024
+    # bounded-staleness follower reads (round 13): how old the cached
+    # upstream commit-point estimate may be before a bounded read must
+    # refresh it with a seq probe (serving on a stale estimate is how a
+    # partitioned follower silently blows the client's lag bound); and
+    # the probe RPC's timeout — a probe that can't reach the upstream
+    # means the bound is unverifiable and the read bounces. The client's
+    # total staleness window is max_lag seqs + this TTL of time. The
+    # default sits ABOVE server_long_poll_ms: an idle follower's
+    # estimate refreshes on every long-poll expiry (~10 s), so the
+    # sync (probe-free) ApplicationDB.read gate stays serveable on an
+    # idle caught-up cluster; deployments wanting a tighter time window
+    # lower BOTH knobs together (the bench and chaos flags do).
+    read_info_ttl_ms: int = 12_000
+    read_probe_timeout_ms: int = 1000
 
 
 class ReplicatedDB:
@@ -145,6 +161,18 @@ class ReplicatedDB:
         self._applied_through: Optional[int] = None
         self._cur_max_updates = self.flags.max_updates_per_response
         self._upstream_mode: Optional[int] = None  # learned from responses
+        # commit-point estimate for bounded-staleness reads: the
+        # upstream's latest_seq as carried on the most recent pull/probe
+        # response, plus when we heard it. ONE tuple swapped atomically
+        # (GIL attribute store): a torn (old seq, fresh mono) pair would
+        # let the sync read gate serve past the bound — pairing an old
+        # lower-bound estimate with a fresh age is a wrong SERVE, not a
+        # spurious bounce.
+        self._upstream_latest: Optional[Tuple[int, float]] = None
+        # single-flight probe: concurrent bounded reads hitting a stale
+        # estimate share ONE refresh RPC instead of stampeding the
+        # upstream (loop thread only)
+        self._probe_task: Optional[asyncio.Task] = None
         self._empty_pulls = 0
         self._conn_errors = 0
         # pull-error backoff: exp backoff + jitter via the unified
@@ -603,7 +631,8 @@ class ReplicatedDB:
                 return {"updates": [], "latest_seq": latest,
                         "source_role": self.role.value,
                         "replication_mode": self.replication_mode,
-                        "epoch": self.epoch}
+                        "epoch": self.epoch,
+                        **self._commit_point_fields()}
             try:
                 with start_span("repl.wal_read") as sp_read:
                     # Cached-cursor fast path: serve INLINE on the loop.
@@ -659,7 +688,8 @@ class ReplicatedDB:
             return {"updates": updates, "latest_seq": latest,
                     "source_role": self.role.value,
                     "replication_mode": self.replication_mode,
-                    "epoch": self.epoch}
+                    "epoch": self.epoch,
+                    **self._commit_point_fields()}
 
     def _read_updates(self, from_seq: int, max_updates: int,
                       it=None) -> List[dict]:
@@ -717,6 +747,313 @@ class ReplicatedDB:
         if not exhausted or getattr(it, "resumable", False):
             self._iter_cache.put(next_seq, it)
         return updates
+
+    # ------------------------------------------------------------------
+    # serving reads (round 13: bounded-staleness follower reads)
+    # ------------------------------------------------------------------
+
+    _READ_OPS = ("get", "multi_get", "scan")
+    # a cursor pinned past any real sequence: the upstream answers the
+    # probe inline from a relaxed seq read (max_wait_ms=0 skips the
+    # long-poll park, nothing to serve skips the WAL read)
+    _SEQ_PROBE_CURSOR = 1 << 60
+
+    def _note_upstream_latest(self, seq: int, age_ms: float = 0.0) -> None:
+        """Record a LEADER-ORIGIN commit-point attestation: "the leader
+        had committed ≥ seq as of (now − age_ms)". ``age_ms`` is the
+        attestation's age already accumulated upstream (a chained
+        follower forwards its own estimate plus ITS age, so staleness
+        COMPOUNDS down the chain instead of resetting per hop).
+        Because leader commit is monotonic, "leader ≥ S as of t" stays
+        true for every t' > t — so max-merging seq and timestamp
+        independently is sound. The (seq, heard_at) pair is swapped as
+        ONE tuple so concurrent sync-gate readers can never observe an
+        old estimate wearing a fresh timestamp."""
+        heard_at = time.monotonic() - max(0.0, age_ms) / 1000.0
+        cur = self._upstream_latest
+        if cur is not None:
+            seq = max(seq, cur[0])
+            heard_at = max(heard_at, cur[1])
+        self._upstream_latest = (seq, heard_at)
+
+    def _commit_point_fields(self) -> dict:
+        """What THIS node can honestly attest about the leader's commit
+        point, for downstream pullers' bounded reads: a LEADER attests
+        its own committed seq (age 0); a chained FOLLOWER forwards its
+        upstream estimate WITH its accumulated age (never its own
+        applied seq — that would let a downstream caught up to a lagging
+        middle hop serve reads violating the leader-relative bound).
+        ``leader_seq`` is explicitly None when a follower has no
+        estimate yet, so new downstreams never fall back to the legacy
+        latest_seq (= this hop's applied position)."""
+        applied, est, age = self._read_lag_state()
+        return {
+            "leader_seq": None if est is None else int(est),
+            "leader_seq_age_ms": 0.0 if not age else round(age * 1e3, 1),
+        }
+
+    def _adopt_commit_point(self, result) -> None:
+        """Shared pull/probe response handling for the commit-point
+        estimate. New upstreams attest a leader-origin (seq, age) pair;
+        legacy responses (no ``leader_seq`` key) fall back to
+        latest_seq — correct for a direct-from-leader pull, the only
+        shape legacy servers produced bounded reads for."""
+        if not result:
+            return
+        if "leader_seq" in result:
+            if result["leader_seq"] is not None:
+                self._note_upstream_latest(
+                    int(result["leader_seq"]),
+                    float(result.get("leader_seq_age_ms") or 0.0))
+        elif result.get("latest_seq") is not None:
+            self._note_upstream_latest(int(result["latest_seq"]))
+
+    def _read_lag_state(self) -> Tuple[int, Optional[int], Optional[float]]:
+        """(applied, leader_est, age_sec): this replica's durably-visible
+        engine position (relaxed read — same contract as the serve
+        path), the last commit point heard from upstream, and how long
+        ago it was heard. Leaders ARE the commit point (lag 0 by
+        definition)."""
+        applied = self.wrapper.latest_sequence_number_relaxed()
+        if self.role in (ReplicaRole.LEADER, ReplicaRole.NOOP):
+            return applied, applied, 0.0
+        cur = self._upstream_latest
+        if cur is None:
+            return applied, None, None
+        est, heard_at = cur
+        return applied, est, time.monotonic() - heard_at
+
+    def _read_epoch_gate(self, epoch) -> None:
+        """Lineage check for reads — the read-path analog of
+        ``_reject_stale_epoch``, with one asymmetry: a FOLLOWER must
+        never ADOPT an epoch from a read request. A client's epoch claim
+        is not authoritative (assignments flow controller→participant
+        and pull responses come from the upstream we replicate from); a
+        bogus inflated epoch here would make the real leader's frames
+        look stale and wedge a healthy replica. It still REJECTS: a read
+        carrying a newer epoch proves a newer leader was promoted, and
+        this replica's applied prefix may end in the deposed lineage's
+        divergent un-acked suffix — exactly the stale-epoch-pull rule."""
+        if epoch is not None and int(epoch) > self.epoch:
+            if self.role in (ReplicaRole.FOLLOWER, ReplicaRole.OBSERVER):
+                self._stats.incr(R["stale_epoch_rejected"])
+                raise RpcApplicationError(
+                    ReplicateErrorCode.STALE_EPOCH.value,
+                    f"{self.name}: replica epoch {self.epoch} < read "
+                    f"epoch {epoch} — possibly deposed lineage",
+                )
+            # leader/NOOP: a newer epoch deposes it, same as pulls/acks
+            self._reject_stale_epoch(epoch)
+        if self._fenced_by is not None:
+            self._stats.incr(R["stale_epoch_rejected"])
+        self._check_fenced()
+
+    def read_gate(self, max_lag: Optional[int] = None,
+                  epoch=None) -> dict:
+        """Admission control for serving a read from THIS replica:
+        lineage (fencing epoch) first, then the client's staleness
+        bound. Raises STALE_EPOCH (deposed lineage — reject exactly as a
+        stale-epoch pull is rejected) or STALE_READ (lag bound exceeded,
+        or unverifiable because the commit-point estimate is older than
+        ``read_info_ttl_ms``); returns the lag bookkeeping the response
+        reports. Sync and probe-free so in-process callers
+        (ApplicationDB.read) can gate without an event-loop hop; the
+        async RPC handler refreshes a stale estimate with an upstream
+        seq probe before gating.
+
+        Boundary contract (tested): lag == max_lag SERVES,
+        lag == max_lag + 1 bounces."""
+        self._read_epoch_gate(epoch)
+        applied, est, age = self._read_lag_state()
+        lag = max(0, est - applied) if est is not None else None
+        if (max_lag is not None
+                and self.role not in (ReplicaRole.LEADER, ReplicaRole.NOOP)):
+            ttl = self.flags.read_info_ttl_ms / 1000.0
+            if est is None or age is None or age > ttl:
+                self._stats.incr(R["stale_rejected"])
+                raise RpcApplicationError(
+                    ReplicateErrorCode.STALE_READ.value,
+                    f"{self.name}: lag bound {max_lag} unverifiable "
+                    f"(commit-point estimate "
+                    f"{'missing' if est is None else f'{age * 1e3:.0f}ms old'})",
+                )
+            if lag > int(max_lag):
+                self._stats.incr(R["stale_rejected"])
+                raise RpcApplicationError(
+                    ReplicateErrorCode.STALE_READ.value,
+                    f"{self.name}: lag {lag} exceeds bound {max_lag} "
+                    f"(applied {applied}, leader {est})",
+                )
+        return {"applied_seq": applied, "leader_seq": est, "lag": lag}
+
+    async def _probe_upstream_seq(self) -> None:
+        """Refresh the commit-point estimate (single-flight: concurrent
+        stale reads share one probe)."""
+        task = self._probe_task
+        if task is None or task.done():
+            task = self._probe_task = asyncio.ensure_future(
+                self._probe_upstream_seq_once())
+        await task
+
+    async def _probe_upstream_seq_once(self) -> None:
+        """Refresh the commit-point estimate with one lightweight
+        replicate RPC. Failure leaves the estimate stale and the gate
+        bounces the read: a partitioned follower must not serve bounded
+        reads on memories. Probes ride role=OBSERVER so a mode-1/2
+        upstream never counts them toward acks."""
+        if self.upstream_addr is None:
+            return
+        self._stats.incr(R["probes"])
+        host, port = self.upstream_addr
+        try:
+            client = await self._pool.get_client(host, port)
+            result = await client.call(
+                "replicate",
+                {
+                    "db_name": self.name,
+                    "seq_no": self._SEQ_PROBE_CURSOR,
+                    "max_wait_ms": 0,
+                    "max_updates": 1,
+                    "role": ReplicaRole.OBSERVER.value,
+                    "epoch": self.epoch,
+                },
+                timeout=self.flags.read_probe_timeout_ms / 1000.0,
+            )
+        except Exception as e:
+            log.debug("%s: upstream seq probe failed: %r", self.name, e)
+            return
+        resp_epoch = result.get("epoch") if result else None
+        if resp_epoch is not None and int(resp_epoch) > self.epoch:
+            self.adopt_epoch(int(resp_epoch))
+        if resp_epoch is not None and int(resp_epoch) < self.epoch:
+            # deposed-lineage attestation: the pull path raises
+            # STALE_EPOCH before adopting anything from an older-epoch
+            # upstream — the probe must be exactly as deaf, or a fresh
+            # wrong-lineage estimate lets bounded reads serve past the
+            # REAL leader's commit point (a wrong serve, not a bounce)
+            log.debug("%s: ignoring seq probe from deposed upstream "
+                      "epoch %s < ours %d", self.name, resp_epoch,
+                      self.epoch)
+            return
+        self._adopt_commit_point(result)
+
+    async def handle_read_request(
+        self,
+        op: str = "get",
+        keys=None,
+        start=None,
+        count: Optional[int] = None,
+        max_lag: Optional[int] = None,
+        epoch=None,
+    ) -> dict:
+        """Serve a get/multi_get/scan from THIS replica under the
+        client's staleness bound (``max_lag``, in sequence numbers;
+        None = unbounded — any live replica serves) and fencing epoch.
+        The read-scaling half of round 13: any FOLLOWER within the bound
+        serves, so read throughput scales with replica count instead of
+        saturating the leader."""
+        await fp.async_hit("repl.read")
+        if self._removed:
+            raise RpcApplicationError(
+                ReplicateErrorCode.SOURCE_REMOVED.value, self.name)
+        if op not in self._READ_OPS:
+            raise RpcApplicationError(
+                "BAD_READ_OP",
+                f"{self.name}: unknown read op {op!r} "
+                f"(want one of {self._READ_OPS})",
+            )
+        with Timer(tagged("reads.latency_ms", op=op)), \
+                start_span("repl.read", db=self.name, op=op) as sp:
+            if (max_lag is not None
+                    and self.role in (ReplicaRole.FOLLOWER,
+                                      ReplicaRole.OBSERVER)):
+                _applied, est, age = self._read_lag_state()
+                if (est is None or age is None
+                        or age > self.flags.read_info_ttl_ms / 1000.0):
+                    # stale estimate: verify against the upstream BEFORE
+                    # gating, so the serve decision is exact as of the
+                    # probe's answer — the chaos invariant's foundation
+                    await self._probe_upstream_seq()
+            gate = self.read_gate(max_lag=max_lag, epoch=epoch)
+            values = await self._loop.run_in_executor(
+                self._executor, self._do_read, op, keys, start, count)
+            if self.role in (ReplicaRole.LEADER, ReplicaRole.NOOP):
+                self._stats.incr(R["leader_served"])
+            else:
+                self._stats.incr(R["follower_served"])
+            if sp.sampled:
+                sp.annotate(lag=gate["lag"], applied_seq=gate["applied_seq"])
+            return {
+                **gate,
+                "values": values,
+                "source_role": self.role.value,
+                "epoch": self.epoch,
+            }
+
+    def _do_read(self, op: str, keys, start, count):
+        """Executor-side read execution (engine reads may touch disk —
+        never on the loop). Wrapper/argument problems surface as typed
+        RPC errors, never as INTERNAL stack traces: a non-persisting
+        wrapper (CDC observer) bounces cleanly down the router's chain."""
+        from .db_wrapper import execute_read_op
+
+        try:
+            return execute_read_op(self.wrapper, op, keys=keys,
+                                   start=start, count=count)
+        except NotImplementedError as e:
+            raise RpcApplicationError(
+                "READS_UNSUPPORTED",
+                f"{self.name}: wrapper does not serve reads ({e})",
+            ) from e
+        except (ValueError, TypeError) as e:
+            raise RpcApplicationError(
+                "BAD_READ_OP", f"{self.name}: {e}") from e
+
+    async def handle_write_request(self, raw_batch, epoch=None) -> dict:
+        """Remote entry to the leader write path (the macro-bench's
+        full-stack put op class): fence-check the carried epoch, commit
+        via write_async OFF the loop (it may block on window flow
+        control), and await the ack condition. Returns the batch's start
+        seq and whether the replication ack condition was met."""
+        if self.role not in (ReplicaRole.LEADER, ReplicaRole.NOOP):
+            # role check BEFORE any epoch processing: a FOLLOWER must
+            # never adopt a client-claimed epoch (_reject_stale_epoch
+            # would — and the bogus epoch would then ride this
+            # follower's pulls upstream and fence the HEALTHY leader).
+            # Same no-adopt rule as _read_epoch_gate: client claims are
+            # not authoritative.
+            raise RpcApplicationError(
+                ReplicateErrorCode.NOT_LEADER.value,
+                f"{self.name} role is {self.role.value}",
+            )
+        if self._reject_stale_epoch(epoch):
+            self._stats.incr(M["stale_epoch_rejects"])
+            raise RpcApplicationError(
+                ReplicateErrorCode.STALE_EPOCH.value,
+                f"{self.name}: write epoch {epoch} fences serving epoch "
+                f"{self.epoch}",
+            )
+        # Fail fast on a full write window instead of parking an
+        # executor thread inside write_async's flow-control block: with
+        # followers partitioned, enough concurrent write RPCs would
+        # otherwise exhaust the SHARED executor and starve every read
+        # and cold-cursor WAL serve behind stalled writes. The depth
+        # check is advisory (a racing writer can still fill the window
+        # and briefly park the executor task — bounded by the race, not
+        # systematic); the client sees a typed, retryable error.
+        if self.ack_window_free <= 0:
+            self._stats.incr(M["write_window_full"])
+            raise RpcApplicationError(
+                "WRITE_WINDOW_FULL",
+                f"{self.name}: {self._acked.depth}/{self._acked.capacity} "
+                f"writes in flight — retry with backoff",
+            )
+        batch = decode_batch(bytes(raw_batch))
+        waiter = await self._loop.run_in_executor(
+            self._executor, self.write_async, batch)
+        await asyncio.wrap_future(waiter.future)
+        return {"seq": waiter.seq, "acked": waiter.acked,
+                "epoch": self.epoch}
 
     # ------------------------------------------------------------------
     # follower pull path (loop thread)
@@ -866,6 +1203,9 @@ class ReplicatedDB:
                     )
             if result and result.get("replication_mode") is not None:
                 self._upstream_mode = int(result["replication_mode"])
+            # every pull response refreshes the commit-point estimate
+            # bounded follower reads check their lag against
+            self._adopt_commit_point(result)
             self._adapt_max_updates(result, updates)
             if not updates:
                 # idle upstream: let the pipeline drain so apply errors

@@ -34,6 +34,34 @@ class ReplicateErrorCode(str, enum.Enum):
     # OLDER epoch than the puller's known epoch marks a stale (deposed)
     # upstream whose updates must not be applied.
     STALE_EPOCH = "STALE_EPOCH"
+    # Bounded-staleness follower reads (round 13): the serving replica's
+    # applied position is (or cannot be proven to be) within the
+    # client's lag bound of the leader's committed sequence. NOT a
+    # lineage error — the client should bounce the read to the leader
+    # (or another replica); the router's follower-ok policy does exactly
+    # that.
+    STALE_READ = "STALE_READ"
+    # The write/read entry was asked of a non-leader (reads with a
+    # leader-only requirement, writes anywhere but the leader).
+    NOT_LEADER = "NOT_LEADER"
+
+
+# Read-path counters (round 13 — bounded-staleness follower reads).
+# Names follow the tools/rstpu_check.py dotted.name grammar.
+READ_METRICS = dict(
+    leader_served="reads.leader_served",
+    follower_served="reads.follower_served",
+    # lag-bound bounce (the follower is too far behind the client's
+    # max_lag, or its view of the leader's commit point is too old to
+    # verify the bound) — distinct from the fencing rejection below
+    stale_rejected="reads.stale_rejected",
+    # lineage (fencing) rejection: the read carried a newer epoch than
+    # the serving replica knows — the replica is on a deposed lineage
+    stale_epoch_rejected="reads.stale_epoch_rejected",
+    # upstream commit-point probes issued by bounded follower reads
+    # whose cached estimate was older than read_info_ttl_ms
+    probes="reads.upstream_probes",
+)
 
 
 # Counter/metric names (reference rocksdb_replicator/replicator_stats.{h,cpp})
@@ -54,6 +82,7 @@ REPLICATOR_METRICS = dict(
     upstream_resets="replicator.upstream_resets",
     stale_epoch_rejects="replicator.stale_epoch_rejects",
     fenced="replicator.fenced",
+    write_window_full="replicator.write_window_full_rejects",
     replication_lag_ms="replicator.replication_lag_ms",
     iter_cache_hits="replicator.iter_cache_hits",
     iter_cache_misses="replicator.iter_cache_misses",

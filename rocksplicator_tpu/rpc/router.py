@@ -12,17 +12,36 @@ replicas share load. The map file is hot-reloaded via the file watcher.
 from __future__ import annotations
 
 import enum
+import itertools
 import json
 import logging
 import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..testing import failpoints as fp
 from ..utils.file_watcher import FileWatcher
+from ..utils.stats import Stats, tagged
+from ..utils.timer import Timer
 from .client_pool import RpcClientPool
-from .errors import RpcConnectionError
+from .errors import RpcApplicationError, RpcConnectionError, RpcTimeout
 
 log = logging.getLogger(__name__)
+
+# read-RPC application errors that mean "try the next candidate" rather
+# than "the request is bad": a follower too stale for the bound, a
+# replica on a deposed lineage, a non-leader asked a leader-only
+# question, a replica that hasn't registered the db yet (mid-repoint),
+# or one whose wrapper doesn't persist locally (CDC observer)
+_READ_BOUNCE_CODES = frozenset(
+    ("STALE_READ", "STALE_EPOCH", "NOT_LEADER", "SOURCE_NOT_FOUND",
+     "READS_UNSUPPORTED"))
+# write-RPC errors that mean "try the next mapped leader": the stale
+# shard-map cases during a handoff — the old leader either demoted
+# already (NOT_LEADER), is fenced by the new epoch (STALE_EPOCH), or
+# dropped the db mid-repoint (SOURCE_NOT_FOUND)
+_WRITE_BOUNCE_CODES = frozenset(
+    ("NOT_LEADER", "STALE_EPOCH", "SOURCE_NOT_FOUND"))
 
 
 class Role(enum.Enum):
@@ -35,6 +54,44 @@ class Quantity(enum.Enum):
     ONE = 1
     TWO = 2
     ALL = -1
+
+
+@dataclass(frozen=True)
+class ReadPolicy:
+    """Read preference for routed reads (round 13).
+
+    Reference mapping: ThriftRouter's role filter + AZ-locality sort
+    (thrift_router.h:384-455) — the reference serves reads from SLAVE
+    replicas in the local AZ by asking ``getClientsFor(..., SLAVE)``;
+    here the policy also carries the staleness bound the serving replica
+    must prove (``max_lag``, in sequence numbers).
+
+    - ``leader_only()``      — only the LEADER serves (the implicit
+      pre-round-13 behavior: read throughput caps at leader capacity);
+    - ``follower_ok(lag)``   — followers are ACCEPTABLE: every replica
+      (followers AND the leader) joins one per-request-rotated group,
+      so read throughput scales with replica count; a FOLLOWER serves
+      only when its applied position is within ``lag`` seqs of the
+      leader's committed sequence, and a stale/deposed replica bounces
+      the read down the chain — which always contains the leader;
+    - ``nearest(lag)``       — AZ-locality first regardless of role
+      (the reference's local-replica preference), same bound semantics.
+    """
+
+    kind: str = "leader_only"
+    max_lag: Optional[int] = None
+
+    @classmethod
+    def leader_only(cls) -> "ReadPolicy":
+        return cls("leader_only", None)
+
+    @classmethod
+    def follower_ok(cls, max_lag: int) -> "ReadPolicy":
+        return cls("follower_ok", int(max_lag))
+
+    @classmethod
+    def nearest(cls, max_lag: Optional[int] = None) -> "ReadPolicy":
+        return cls("nearest", None if max_lag is None else int(max_lag))
 
 
 @dataclass(frozen=True)
@@ -120,6 +177,10 @@ class RpcRouter:
         # 9) — the reference's local-group-prefix sort.
         self._local_group_prefix_len = local_group_prefix_len
         self._shard_map_path = shard_map_path
+        # per-request rotation for read spreading across equally-good
+        # followers (itertools.count is GIL-atomic enough for a counter)
+        self._read_seq = itertools.count()
+        self._stats = Stats.get()
         if shard_map_path is not None:
             FileWatcher.instance().add_file(shard_map_path, self._on_map_content)
 
@@ -226,6 +287,155 @@ class RpcRouter:
             if want is not None and len(clients) >= want:
                 break
         return clients
+
+    # -- bounded-staleness reads (round 13) -------------------------------
+
+    def read_pick(self, segment: str, shard: int,
+                  policy: ReadPolicy) -> List[Host]:
+        """Ordered read candidates for a shard under a read policy. The
+        last entries are the bounce targets a stale/deposed replica
+        falls back to (always ending at the leader when one is mapped)."""
+        if policy.kind == "leader_only":
+            return self.get_hosts_for(segment, shard, Role.LEADER,
+                                      Quantity.ALL)
+        if policy.kind == "nearest":
+            # locality-ordered ANY (leader-first within each tier —
+            # the reference's local-replica preference)
+            return self.get_hosts_for(segment, shard, Role.ANY,
+                                      Quantity.ALL)
+        if policy.kind != "follower_ok":
+            raise ValueError(f"unknown read policy: {policy.kind!r}")
+        # follower_ok: followers are ACCEPTABLE, not exclusive — every
+        # replica (followers AND leader) joins one rotated group so read
+        # throughput scales with replica count, not follower count. The
+        # rotation is per-REQUEST: get_hosts_for's shard-hash rotation
+        # is deterministic per shard, which would pin every read for a
+        # shard to one replica — the opposite of read scaling. A
+        # replica that bounces (stale lag / deposed lineage) falls
+        # through to the rest of the chain, which always contains the
+        # leader (lag 0 by definition).
+        followers = self.get_hosts_for(segment, shard, Role.FOLLOWER,
+                                       Quantity.ALL)
+        leaders = self.get_hosts_for(segment, shard, Role.LEADER,
+                                     Quantity.ALL)
+        group = followers + [h for h in leaders if h not in followers]
+        if group:
+            r = next(self._read_seq) % len(group)
+            group = group[r:] + group[:r]
+        return group
+
+    async def read(
+        self,
+        segment: str,
+        shard: int,
+        op: str = "get",
+        keys=None,
+        start=None,
+        count: Optional[int] = None,
+        policy: Optional[ReadPolicy] = None,
+        epoch: Optional[int] = None,
+        timeout: float = 10.0,
+    ):
+        """Routed bounded-staleness read: try the policy's candidates in
+        order over the replication plane's ``read`` RPC, bouncing on
+        STALE_READ (lag bound exceeded/unverifiable) and STALE_EPOCH
+        (deposed lineage) toward the leader. Connection errors AND
+        timeouts skip to the next candidate (reference: filterBadHosts;
+        reads are idempotent, so retrying a wedged replica's read on
+        another host is safe — unlike the write path, which must not
+        re-commit on a timeout)."""
+        policy = policy or ReadPolicy.leader_only()
+        await fp.async_hit("router.read_pick")
+        hosts = self.read_pick(segment, shard, policy)
+        if not hosts:
+            raise RpcConnectionError(
+                f"no read candidates for {segment}:{shard} "
+                f"under {policy.kind}")
+        args = {
+            "db_name": self._db_name(segment, shard),
+            "op": op,
+            "keys": keys,
+            "start": start,
+            "count": count,
+            "max_lag": policy.max_lag,
+            "epoch": epoch,
+        }
+        with Timer(tagged("router.read_ms", op=op, policy=policy.kind)):
+            return await self._failover_call(
+                hosts, "read", args, _READ_BOUNCE_CODES, timeout,
+                retry_timeouts=True, count_bounces=True,
+                what=f"read {segment}:{shard}")
+
+    async def write(
+        self,
+        segment: str,
+        shard: int,
+        raw_batch: bytes,
+        epoch: Optional[int] = None,
+        timeout: float = 30.0,
+    ):
+        """Routed leader write over the replication plane's ``write``
+        RPC (one encoded WriteBatch). NOT_LEADER / STALE_EPOCH (a
+        fenced ex-leader still in the map) / SOURCE_NOT_FOUND /
+        connection errors fall through to the next mapped leader
+        candidate — the stale shard-map cases during a handoff."""
+        hosts = self.get_hosts_for(segment, shard, Role.LEADER,
+                                   Quantity.ALL)
+        if not hosts:
+            raise RpcConnectionError(f"no leader for {segment}:{shard}")
+        args = {
+            "db_name": self._db_name(segment, shard),
+            "raw_batch": raw_batch,
+            "epoch": epoch,
+        }
+        return await self._failover_call(
+            hosts, "write", args, _WRITE_BOUNCE_CODES, timeout,
+            retry_timeouts=False, count_bounces=False,
+            what=f"write {segment}:{shard}")
+
+    async def _failover_call(
+        self,
+        hosts: List[Host],
+        method: str,
+        args: dict,
+        bounce_codes,
+        timeout: float,
+        retry_timeouts: bool,
+        count_bounces: bool,
+        what: str,
+    ):
+        """The one sequential try-candidates loop behind routed
+        reads/writes: bounce on the caller's application-error codes,
+        skip dead hosts, remember the last error. Timeouts retry only
+        when the caller says the call is idempotent (reads yes, writes
+        no — a timed-out write may have committed)."""
+        last_err: Optional[Exception] = None
+        for host in hosts:
+            ip, port = host.repl_addr
+            try:
+                return await self._pool.call(
+                    ip, port, method, args, timeout=timeout)
+            except RpcApplicationError as e:
+                if e.code not in bounce_codes:
+                    raise
+                if count_bounces:
+                    self._stats.incr(
+                        tagged("router.read_bounces", code=e.code.lower()))
+                last_err = e
+            except RpcTimeout as e:
+                if not retry_timeouts:
+                    raise
+                last_err = e
+            except RpcConnectionError as e:
+                last_err = e
+        raise last_err if last_err is not None else RpcConnectionError(
+            f"{what}: no candidate answered")
+
+    @staticmethod
+    def _db_name(segment: str, shard: int) -> str:
+        from ..utils.segment_utils import segment_to_db_name
+
+        return segment_to_db_name(segment, shard)
 
     async def hedged_call(
         self,

@@ -37,6 +37,8 @@ REGISTRY = {
     "rpc.frame.recv": "RPC frame receive failure",
     "repl.pull": "replication pull RPC failure",
     "repl.apply": "follower apply failure",
+    "repl.read": "bounded-staleness read-path failure at the replica",
+    "router.read_pick": "router read host-pick failure",
     "ack.expire": "ack-window expiry timer blip",
     "coordinator.heartbeat": "coordinator session heartbeat failure",
     "coordinator.reap": "coordinator ephemeral-node reap blip",

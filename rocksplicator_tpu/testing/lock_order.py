@@ -49,10 +49,10 @@ RANKS = {
     "rocksplicator_tpu/utils/file_watcher.py:173": ('MultiFilePoller._lock', 29),
     "rocksplicator_tpu/utils/object_lock.py:18": ('ObjectLock._guard', 30),
     "rocksplicator_tpu/cluster/participant.py:74": ('Participant._publish_lock', 31),
-    "rocksplicator_tpu/replication/replicated_db.py:133": ('ReplicatedDB._ack_state_lock', 32),
-    "rocksplicator_tpu/replication/replicated_db.py:116": ('ReplicatedDB._epoch_lock', 33),
-    "rocksplicator_tpu/replication/replicated_db.py:139": ('ReplicatedDB._expiry_lock', 34),
-    "rocksplicator_tpu/replication/replicated_db.py:180": ('ReplicatedDB._write_traces_lock', 35),
+    "rocksplicator_tpu/replication/replicated_db.py:149": ('ReplicatedDB._ack_state_lock', 32),
+    "rocksplicator_tpu/replication/replicated_db.py:132": ('ReplicatedDB._epoch_lock', 33),
+    "rocksplicator_tpu/replication/replicated_db.py:155": ('ReplicatedDB._expiry_lock', 34),
+    "rocksplicator_tpu/replication/replicated_db.py:208": ('ReplicatedDB._write_traces_lock', 35),
     "rocksplicator_tpu/replication/replicator.py:41": ('Replicator._instance_lock', 36),
     "rocksplicator_tpu/utils/retry_policy.py:57": ('RetryBudget._lock', 37),
     "rocksplicator_tpu/utils/s3_stub.py:48": ('S3StubServer.lock', 38),

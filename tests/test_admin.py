@@ -541,6 +541,10 @@ def test_tpu_compaction_flag_installs_backend(nodes, call, tmp_path):
 def test_admin_plane_over_mutual_tls(tmp_path):
     """Admin RPCs (add_db / put / get / checkpoint paths) work over a
     mutual-TLS RpcServer + client pool (VERDICT item 8)."""
+    pytest.importorskip(
+        "cryptography",
+        reason="TLS tests need the 'cryptography' package to mint the "
+               "test CA (not installed in this image)")
     from rocksplicator_tpu.utils.ssl_context_manager import (
         SslContextManager, make_test_ca,
     )

@@ -1,0 +1,647 @@
+"""Bounded-staleness follower reads + router read policies (round 13).
+
+Covers the ISSUE-11 test matrix:
+- staleness-bound boundary semantics: lag == bound SERVES, lag ==
+  bound + 1 bounces to the leader (STALE_READ);
+- lineage: a follower read carrying a newer epoch is rejected exactly
+  as a stale-epoch pull (STALE_EPOCH, no adoption from client claims),
+  and serves again once the follower learns the epoch from its
+  upstream; a leader seeing a newer epoch on a read fences;
+- router read-preference policies (leader_only / follower_ok(max_lag) /
+  nearest) including the bounce-to-leader path and per-request rotation;
+- failpoint seams ``repl.read`` and ``router.read_pick``;
+- zipfian / Poisson workload generators deterministic under a fixed
+  seed;
+- the macro-bench smoke artifact shape (3-point sweep, per-op-class
+  p50/p99, host_calibration block).
+"""
+
+import json
+import time
+
+import pytest
+
+from rocksplicator_tpu.replication import (
+    ReplicaRole,
+    ReplicationFlags,
+    Replicator,
+    StorageDbWrapper,
+)
+from rocksplicator_tpu.rpc import IoLoop
+from rocksplicator_tpu.rpc.client_pool import RpcClientPool
+from rocksplicator_tpu.rpc.errors import RpcApplicationError, RpcError
+from rocksplicator_tpu.rpc.router import ClusterLayout, ReadPolicy, Role, RpcRouter
+from rocksplicator_tpu.storage import DB, DBOptions, WriteBatch
+from rocksplicator_tpu.testing import failpoints as fp
+from rocksplicator_tpu.utils.stats import Stats
+
+DB_NAME = "seg00000"
+
+FLAGS = ReplicationFlags(
+    server_long_poll_ms=200,
+    pull_error_delay_min_ms=30,
+    pull_error_delay_max_ms=80,
+    ack_timeout_ms=2000,
+    consecutive_timeouts_to_degrade=1000,
+    empty_pulls_before_reset=1 << 30,
+    # tiny TTL: bounded reads in these tests exercise the PROBE path
+    # (the estimate is nearly always "stale"), which is also the path
+    # whose answer is exact at serve time
+    read_info_ttl_ms=100,
+    read_probe_timeout_ms=1000,
+)
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class Pair:
+    """Leader + follower over real TCP loopback, semi-sync (mode 1)."""
+
+    def __init__(self, tmp_path):
+        self.leader = Replicator(port=0, flags=FLAGS)
+        self.follower = Replicator(port=0, flags=FLAGS)
+        self.ldb = DB(str(tmp_path / "l"), DBOptions(wal_ttl_seconds=3600.0))
+        self.fdb = DB(str(tmp_path / "f"), DBOptions(wal_ttl_seconds=3600.0))
+        self.lrdb = self.leader.add_db(
+            DB_NAME, StorageDbWrapper(self.ldb), ReplicaRole.LEADER,
+            replication_mode=1)
+        self.frdb = self.follower.add_db(
+            DB_NAME, StorageDbWrapper(self.fdb), ReplicaRole.FOLLOWER,
+            upstream_addr=("127.0.0.1", self.leader.port),
+            replication_mode=1)
+        self.ioloop = IoLoop.default()
+        self.pool = RpcClientPool()
+
+    def write(self, n, tag=b"k"):
+        for i in range(n):
+            self.lrdb.write(WriteBatch().put(
+                b"%s%04d" % (tag, i), b"v%04d" % i))
+
+    def converged(self):
+        return (self.fdb.latest_sequence_number_relaxed()
+                == self.ldb.latest_sequence_number_relaxed())
+
+    def read(self, port, **kw):
+        args = {"db_name": DB_NAME}
+        args.update(kw)
+
+        async def go():
+            return await self.pool.call("127.0.0.1", port, "read", args)
+
+        return self.ioloop.run_sync(go(), timeout=10)
+
+    def block_pulls(self):
+        """Arm repl.pull AND wait out the in-flight pull (which predates
+        the failpoint) so follower state is frozen deterministically."""
+        fp.activate("repl.pull", "fail_prob:1.0@seed1")
+        time.sleep(FLAGS.server_long_poll_ms / 1000.0 + 0.3)
+
+    def stop(self):
+        try:
+            self.ioloop.run_sync(self.pool.close(), timeout=5)
+        except Exception:
+            pass
+        self.leader.stop()
+        self.follower.stop()
+        self.ldb.close()
+        self.fdb.close()
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    p = Pair(tmp_path)
+    yield p
+    fp.clear()
+    p.stop()
+
+
+# ---------------------------------------------------------------------------
+# staleness-bound boundary semantics
+# ---------------------------------------------------------------------------
+
+
+def test_lag_boundary_serves_at_bound_bounces_past_it(pair):
+    """lag == bound serves; lag == bound + 1 raises STALE_READ. The
+    follower's estimate is stale (pulls blocked), so the serve decision
+    rides the upstream seq probe — exact at serve time."""
+    pair.write(10)
+    assert wait_until(pair.converged)
+    pair.block_pulls()
+    pair.write(3, tag=b"x")  # leader 3 ahead; follower frozen
+    # lag == bound: SERVES from the follower
+    r = pair.read(pair.follower.port, op="get", keys=[b"k0005"], max_lag=3)
+    assert bytes(r["values"][0]) == b"v0005"
+    assert r["source_role"] == "FOLLOWER"
+    assert r["lag"] == 3 and r["leader_seq"] - r["applied_seq"] == 3
+    # lag == bound + 1: bounces
+    with pytest.raises(RpcApplicationError) as ei:
+        pair.read(pair.follower.port, op="get", keys=[b"k0005"], max_lag=2)
+    assert ei.value.code == "STALE_READ"
+    # unbounded (max_lag None): a follower serves regardless of lag
+    r = pair.read(pair.follower.port, op="get", keys=[b"k0005"])
+    assert bytes(r["values"][0]) == b"v0005"
+    # heal: pulls resume, lag drains, bound-0 reads serve again
+    fp.clear()
+    assert wait_until(pair.converged)
+    r = pair.read(pair.follower.port, op="get", keys=[b"x0001"], max_lag=0)
+    assert bytes(r["values"][0]) == b"v0001"
+
+
+def test_unreachable_upstream_bounces_bounded_reads(pair):
+    """A partitioned follower (probe cannot reach the upstream) must
+    bounce bounded reads — never serve on a stale estimate."""
+    pair.write(5)
+    assert wait_until(pair.converged)
+    pair.block_pulls()
+    pair.leader.stop()  # upstream gone: probe fails
+    time.sleep(0.15)  # age the estimate past read_info_ttl_ms
+    with pytest.raises(RpcApplicationError) as ei:
+        pair.read(pair.follower.port, op="get", keys=[b"k0001"], max_lag=5)
+    assert ei.value.code == "STALE_READ"
+    # unbounded reads still serve (the client opted out of the bound)
+    r = pair.read(pair.follower.port, op="get", keys=[b"k0001"])
+    assert bytes(r["values"][0]) == b"v0001"
+
+
+def test_multi_get_and_scan_op_classes(pair):
+    pair.write(20)
+    assert wait_until(pair.converged)
+    r = pair.read(pair.follower.port, op="multi_get",
+                  keys=[b"k0001", b"nope", b"k0003"], max_lag=0)
+    got = [bytes(v) if v is not None else None for v in r["values"]]
+    assert got == [b"v0001", None, b"v0003"]
+    r = pair.read(pair.follower.port, op="scan", start=b"k0010", count=3,
+                  max_lag=0)
+    assert [(bytes(k), bytes(v)) for k, v in r["values"]] == [
+        (b"k0010", b"v0010"), (b"k0011", b"v0011"), (b"k0012", b"v0012")]
+    with pytest.raises(RpcApplicationError) as ei:
+        pair.read(pair.follower.port, op="frobnicate", keys=[b"k"])
+    assert ei.value.code == "BAD_READ_OP"
+
+
+def test_non_persisting_wrapper_reads_are_typed_errors(pair):
+    """A replica whose wrapper doesn't persist locally (CDC observer
+    shape) answers reads with READS_UNSUPPORTED — a typed, router-
+    bounceable error, not an INTERNAL stack trace."""
+    from rocksplicator_tpu.replication.db_wrapper import DbWrapper
+    from rocksplicator_tpu.rpc.router import _READ_BOUNCE_CODES
+
+    class NoReadWrapper(DbWrapper):
+        def latest_sequence_number(self):
+            return 0
+
+    pair.leader.add_db("seg00009", NoReadWrapper(), ReplicaRole.NOOP)
+    with pytest.raises(RpcApplicationError) as ei:
+        pair.read(pair.leader.port, db_name="seg00009", op="get",
+                  keys=[b"k"])
+    assert ei.value.code == "READS_UNSUPPORTED"
+    assert "READS_UNSUPPORTED" in _READ_BOUNCE_CODES
+    # malformed args are the client's fault, also typed
+    for bad_keys in (None, []):
+        with pytest.raises(RpcApplicationError) as ei:
+            pair.read(pair.leader.port, op="get", keys=bad_keys)
+        assert ei.value.code == "BAD_READ_OP"
+
+
+# ---------------------------------------------------------------------------
+# lineage (fencing epoch) semantics
+# ---------------------------------------------------------------------------
+
+
+def test_follower_read_rejected_across_epoch_bump_then_recovers(pair):
+    """A read carrying a newer epoch is rejected (deposed lineage) and
+    the follower does NOT adopt the client's claim; once the follower
+    learns the epoch from its UPSTREAM, the same read serves."""
+    stats = Stats.get()
+    pair.write(5)
+    assert wait_until(pair.converged)
+    base = stats.get_counter("reads.stale_epoch_rejected")
+    with pytest.raises(RpcApplicationError) as ei:
+        pair.read(pair.follower.port, op="get", keys=[b"k0001"],
+                  max_lag=0, epoch=7)
+    assert ei.value.code == "STALE_EPOCH"
+    assert pair.frdb.epoch == 0  # client claims are not authoritative
+    assert stats.get_counter("reads.stale_epoch_rejected") == base + 1
+    # the UPSTREAM is authoritative: epoch rides the next pull response
+    pair.lrdb.adopt_epoch(7)
+    pair.write(1, tag=b"bump")  # wake the long-poll
+    assert wait_until(lambda: pair.frdb.epoch == 7)
+    r = pair.read(pair.follower.port, op="get", keys=[b"k0001"],
+                  max_lag=2, epoch=7)
+    assert bytes(r["values"][0]) == b"v0001"
+    assert r["epoch"] == 7
+
+
+def test_probe_ignores_deposed_upstream_attestation(pair):
+    """A seq probe answered by an OLDER-epoch (deposed-lineage) upstream
+    must not refresh the commit-point estimate — the pull path rejects
+    such responses before adopting, and the probe must be exactly as
+    deaf, or a fresh wrong-lineage estimate lets bounded reads serve
+    past the REAL leader's commit point."""
+    pair.write(4)
+    assert wait_until(pair.converged)
+    # the follower learns of a newer lineage; its upstream (epoch 0) is
+    # now deposed from the follower's point of view
+    pair.frdb.adopt_epoch(3)
+    time.sleep(0.15)  # age the estimate past read_info_ttl_ms
+    with pytest.raises(RpcApplicationError) as ei:
+        pair.read(pair.follower.port, op="get", keys=[b"k0001"], max_lag=9)
+    # the probe reached the epoch-0 upstream, refused its attestation,
+    # and the bound stayed unverifiable
+    assert ei.value.code == "STALE_READ"
+    # unbounded reads are unaffected
+    r = pair.read(pair.follower.port, op="get", keys=[b"k0001"])
+    assert bytes(r["values"][0]) == b"v0001"
+
+
+def test_scan_count_zero_is_clamped_not_defaulted(pair):
+    pair.write(8)
+    assert wait_until(pair.converged)
+    r = pair.read(pair.follower.port, op="scan", start=b"k0000", count=0)
+    assert len(r["values"]) == 1  # clamped to 1, not silently 10
+
+
+def test_leader_read_with_newer_epoch_fences(pair):
+    """A LEADER seeing a newer epoch on a read is deposed — exactly the
+    stale-epoch pull/ack rule — and refuses writes afterwards."""
+    pair.write(3)
+    with pytest.raises(RpcApplicationError) as ei:
+        pair.read(pair.leader.port, op="get", keys=[b"k0001"], epoch=9)
+    assert ei.value.code == "STALE_EPOCH"
+    assert pair.lrdb.fenced
+    with pytest.raises(RpcApplicationError) as ei:
+        pair.lrdb.write_async(WriteBatch().put(b"nope", b"nope"))
+    assert ei.value.code == "STALE_EPOCH"
+    # reads at the fenced (deposed-lineage) leader stay refused, with
+    # and without an epoch on the request
+    for kw in ({"epoch": 9}, {}):
+        with pytest.raises(RpcApplicationError) as ei:
+            pair.read(pair.leader.port, op="get", keys=[b"k0001"], **kw)
+        assert ei.value.code == "STALE_EPOCH"
+
+
+def test_chained_follower_bound_is_leader_relative(tmp_path):
+    """L → F1 → F2: a chained follower's staleness bound is relative to
+    the LEADER's commit point, not its direct upstream's applied
+    position. With F1 cut off from the leader but still serving F2,
+    F2's estimate (forwarded by F1 with COMPOUNDED age) goes stale —
+    bounded reads at F2 must bounce even though F2 is perfectly caught
+    up to F1 and in fresh contact with it."""
+    reps = [Replicator(port=0, flags=FLAGS) for _ in range(3)]
+    dbs = [DB(str(tmp_path / f"n{i}"), DBOptions(wal_ttl_seconds=3600.0))
+           for i in range(3)]
+    lrdb = reps[0].add_db(DB_NAME, StorageDbWrapper(dbs[0]),
+                          ReplicaRole.LEADER, replication_mode=0)
+    f1rdb = reps[1].add_db(DB_NAME, StorageDbWrapper(dbs[1]),
+                           ReplicaRole.FOLLOWER, replication_mode=0,
+                           upstream_addr=("127.0.0.1", reps[0].port))
+    reps[2].add_db(DB_NAME, StorageDbWrapper(dbs[2]),
+                   ReplicaRole.FOLLOWER, replication_mode=0,
+                   upstream_addr=("127.0.0.1", reps[1].port))
+    ioloop = IoLoop.default()
+    pool = RpcClientPool()
+
+    def read_f2(**kw):
+        args = {"db_name": DB_NAME}
+        args.update(kw)
+
+        async def go():
+            return await pool.call("127.0.0.1", reps[2].port, "read", args)
+
+        return ioloop.run_sync(go(), timeout=10)
+
+    try:
+        for i in range(5):
+            lrdb.write(WriteBatch().put(b"c%03d" % i, b"v%03d" % i))
+        assert wait_until(lambda: dbs[2].latest_sequence_number_relaxed()
+                          == 5)
+        # cut F1 off from the leader (unroutable upstream: its pulls
+        # fail, its leader-origin estimate ages); F1 still serves F2
+        f1rdb.reset_upstream(("127.0.0.1", 1))
+        time.sleep(0.3)  # > read_info_ttl_ms: F1's attestation is stale
+        for _ in range(3):
+            lrdb.write(WriteBatch().put(b"late", b"late"))
+        # F2 is caught up to F1 and in FRESH contact with it — but the
+        # leader-relative bound cannot be verified through a cut-off
+        # middle hop, so the bounded read bounces (the pre-fix code
+        # compared against F1's APPLIED seq and wrongly served here)
+        with pytest.raises(RpcApplicationError) as ei:
+            read_f2(op="get", keys=[b"c001"], max_lag=0)
+        assert ei.value.code == "STALE_READ"
+        # unbounded reads still serve from the chained follower
+        r = read_f2(op="get", keys=[b"c001"])
+        assert bytes(r["values"][0]) == b"v001"
+        # heal the chain: F1 repoints at the leader, attestations flow
+        # again, and the bounded read serves once F2 catches up
+        f1rdb.reset_upstream(("127.0.0.1", reps[0].port))
+        assert wait_until(lambda: dbs[2].latest_sequence_number_relaxed()
+                          == 8, timeout=15)
+
+        def served():
+            try:
+                return bytes(read_f2(op="get", keys=[b"late"],
+                                     max_lag=1)["values"][0]) == b"late"
+            except RpcApplicationError:
+                return False
+
+        assert wait_until(served, timeout=10)
+    finally:
+        ioloop.run_sync(pool.close(), timeout=5)
+        for rep in reps:
+            rep.stop()
+        for db in dbs:
+            db.close()
+
+
+# ---------------------------------------------------------------------------
+# ApplicationDB local read path (admin plane)
+# ---------------------------------------------------------------------------
+
+
+def test_application_db_read_gates_follower(pair, tmp_path):
+    from rocksplicator_tpu.admin.application_db import ApplicationDB
+
+    pair.write(6)
+    assert wait_until(pair.converged)
+    lapp = ApplicationDB("app", pair.ldb, ReplicaRole.LEADER,
+                         wrapper=StorageDbWrapper(pair.ldb))
+    # unreplicated/local leader view serves with trivial gate
+    r = lapp.read(op="get", keys=[b"k0002"])
+    assert r["values"][0] == b"v0002"
+    # follower ApplicationDB shares the registered ReplicatedDB's gate
+    fapp = ApplicationDB.__new__(ApplicationDB)
+    fapp.name = DB_NAME
+    fapp.db = pair.fdb
+    fapp.role = ReplicaRole.FOLLOWER
+    fapp._replicator = pair.follower
+    fapp._stats = Stats.get()
+    fapp._enable_read_stats = False
+    fapp._reader = StorageDbWrapper(pair.fdb)
+    fapp.replicated_db = pair.frdb
+    assert wait_until(  # estimate fresh enough for the sync (no-probe) gate
+        lambda: fapp.read(op="get", keys=[b"k0002"], max_lag=1)[
+            "values"][0] == b"v0002", timeout=5.0)
+    pair.block_pulls()
+    time.sleep(0.15)  # age the estimate: sync gate cannot verify
+    with pytest.raises(RpcApplicationError) as ei:
+        fapp.read(op="get", keys=[b"k0002"], max_lag=1)
+    assert ei.value.code == "STALE_READ"
+
+
+# ---------------------------------------------------------------------------
+# router read policies
+# ---------------------------------------------------------------------------
+
+
+def _layout_for(pair, num_shards=1):
+    lp, fpn = pair.leader.port, pair.follower.port
+    layout = {
+        "seg": {
+            "num_shards": num_shards,
+            f"127.0.0.1:{lp}:az-a:{lp}": ["00000:M"],
+            f"127.0.0.1:{fpn}:az-b:{fpn}": ["00000:S"],
+        }
+    }
+    return ClusterLayout.parse(json.dumps(layout).encode())
+
+
+def test_router_policies_and_bounce(pair):
+    pair.write(8)
+    assert wait_until(pair.converged)
+    router = RpcRouter(local_az="az-b", pool=pair.pool)
+    router.update_layout(_layout_for(pair))
+
+    def read(policy, **kw):
+        async def go():
+            return await router.read("seg", 0, op="get", keys=[b"k0003"],
+                                     policy=policy, **kw)
+
+        return pair.ioloop.run_sync(go(), timeout=10)
+
+    # leader_only: always the leader
+    r = read(ReadPolicy.leader_only())
+    assert r["source_role"] == "LEADER"
+    # follower_ok rotates over ALL replicas (read scaling = every
+    # replica serves); over a few calls both roles must appear
+    roles = {read(ReadPolicy.follower_ok(64))["source_role"]
+             for _ in range(6)}
+    assert roles == {"LEADER", "FOLLOWER"}
+    # nearest: az-b is local ⇒ the follower is preferred
+    r = read(ReadPolicy.nearest(64))
+    assert r["source_role"] == "FOLLOWER"
+    # bounce: freeze the follower behind the bound — follower_ok must
+    # fall through to the leader, counting a bounce
+    stats = Stats.get()
+    base = stats.get_counter("router.read_bounces code=stale_read")
+    pair.block_pulls()
+    pair.write(4, tag=b"y")
+    for _ in range(4):  # every rotation must land on the leader
+        r = read(ReadPolicy.follower_ok(0))
+        assert r["source_role"] == "LEADER"
+    assert stats.get_counter("router.read_bounces code=stale_read") >= base + 1
+
+
+def test_router_read_pick_ordering(pair):
+    router = RpcRouter(local_az="az-a", pool=pair.pool)
+    router.update_layout(_layout_for(pair))
+    picks = router.read_pick("seg", 0, ReadPolicy.leader_only())
+    assert [h.port for h in picks] == [pair.leader.port]
+    # follower_ok: one rotated group over all replicas; every replica
+    # leads the chain at some rotation
+    firsts = {router.read_pick("seg", 0, ReadPolicy.follower_ok(8))[0].port
+              for _ in range(8)}
+    assert firsts == {pair.leader.port, pair.follower.port}
+    # chains always contain the leader (the bounce terminus)
+    for _ in range(4):
+        chain = router.read_pick("seg", 0, ReadPolicy.follower_ok(8))
+        assert pair.leader.port in [h.port for h in chain]
+    with pytest.raises(ValueError):
+        router.read_pick("seg", 0, ReadPolicy("bogus"))
+
+
+def test_routed_write_rpc(pair):
+    router = RpcRouter(local_az="az-a", pool=pair.pool)
+    router.update_layout(_layout_for(pair))
+
+    async def go():
+        return await router.write(
+            "seg", 0, WriteBatch().put(b"routed", b"w").encode())
+
+    r = pair.ioloop.run_sync(go(), timeout=10)
+    assert r["acked"] is True
+    assert pair.ldb.get(b"routed") == b"w"
+    # a follower asked to write says NOT_LEADER
+    async def direct():
+        return await pair.pool.call(
+            "127.0.0.1", pair.follower.port, "write",
+            {"db_name": DB_NAME,
+             "raw_batch": WriteBatch().put(b"n", b"n").encode()})
+
+    with pytest.raises(RpcApplicationError) as ei:
+        pair.ioloop.run_sync(direct(), timeout=10)
+    assert ei.value.code == "NOT_LEADER"
+
+    # a bogus inflated epoch on a FOLLOWER write must neither adopt nor
+    # fence: NOT_LEADER fires BEFORE epoch processing (an adopted claim
+    # would ride this follower's pulls and fence the HEALTHY leader)
+    async def direct_epoch():
+        return await pair.pool.call(
+            "127.0.0.1", pair.follower.port, "write",
+            {"db_name": DB_NAME, "epoch": 99,
+             "raw_batch": WriteBatch().put(b"n", b"n").encode()})
+
+    with pytest.raises(RpcApplicationError) as ei:
+        pair.ioloop.run_sync(direct_epoch(), timeout=10)
+    assert ei.value.code == "NOT_LEADER"
+    assert pair.frdb.epoch == 0
+    # the leader is still healthy and writable afterwards
+    r = pair.ioloop.run_sync(go(), timeout=10)
+    assert r["acked"] is True and not pair.lrdb.fenced
+
+
+# ---------------------------------------------------------------------------
+# failpoint seams (registry coverage: "repl.read", "router.read_pick")
+# ---------------------------------------------------------------------------
+
+
+def test_write_rpc_fails_fast_on_full_window(pair):
+    """A full write window answers the write RPC with a typed
+    WRITE_WINDOW_FULL instead of parking an executor thread in
+    write_async's flow-control block (which would starve reads and WAL
+    serves behind stalled writes under partition)."""
+    pair.write(2)
+    assert wait_until(pair.converged)
+    pair.block_pulls()  # no acks: the window can only fill
+    free = pair.lrdb.ack_window_free
+    waiters = [pair.lrdb.write_async(WriteBatch().put(b"w%03d" % i, b"x"))
+               for i in range(free)]
+    assert pair.lrdb.ack_window_free == 0
+
+    async def wr():
+        return await pair.pool.call(
+            "127.0.0.1", pair.leader.port, "write",
+            {"db_name": DB_NAME,
+             "raw_batch": WriteBatch().put(b"z", b"z").encode()})
+
+    with pytest.raises(RpcApplicationError) as ei:
+        pair.ioloop.run_sync(wr(), timeout=10)
+    assert ei.value.code == "WRITE_WINDOW_FULL"
+    # reads at the leader still serve while its write window is wedged
+    r = pair.read(pair.leader.port, op="get", keys=[b"k0001"])
+    assert bytes(r["values"][0]) == b"v0001"
+    for w in waiters:  # drain: they expire un-acked on the ack timeout
+        try:
+            w.future.result(10)
+        except Exception:
+            pass
+
+
+def test_read_failpoint_seams(pair):
+    pair.write(3)
+    assert wait_until(pair.converged)
+    fp.activate("repl.read", "fail_nth:1")
+    try:
+        with pytest.raises(RpcError):
+            pair.read(pair.follower.port, op="get", keys=[b"k0001"])
+    finally:
+        fp.deactivate("repl.read")
+    router = RpcRouter(local_az="az-a", pool=pair.pool)
+    router.update_layout(_layout_for(pair))
+    fp.activate("router.read_pick", "fail_nth:1")
+    try:
+        async def go():
+            return await router.read("seg", 0, op="get", keys=[b"k0001"])
+
+        with pytest.raises(Exception):
+            pair.ioloop.run_sync(go(), timeout=10)
+    finally:
+        fp.deactivate("router.read_pick")
+    # seams disarmed: the same read serves
+    r = pair.read(pair.follower.port, op="get", keys=[b"k0001"])
+    assert bytes(r["values"][0]) == b"v0001"
+
+
+# ---------------------------------------------------------------------------
+# workload generators: deterministic under a fixed seed
+# ---------------------------------------------------------------------------
+
+
+def test_zipfian_deterministic_and_skewed():
+    from benchmarks.macro_bench import ZipfianGenerator
+
+    a = ZipfianGenerator(1000, seed=42)
+    b = ZipfianGenerator(1000, seed=42)
+    sa = [a.next() for _ in range(500)]
+    sb = [b.next() for _ in range(500)]
+    assert sa == sb  # same seed ⇒ same stream
+    c = ZipfianGenerator(1000, seed=43)
+    assert [c.next() for _ in range(500)] != sa  # different seed differs
+    # zipfian skew: the most popular key dominates a uniform draw's
+    # expected 0.5/1000 share by an order of magnitude
+    from collections import Counter
+
+    top = Counter(sa).most_common(1)[0][1]
+    assert top >= 25  # ~1/H(1000) ≈ 13% of 500 draws; allow slack
+    # hot ids are SPREAD over the id space, not clustered at 0
+    hot = [k for k, _n in Counter(sa).most_common(5)]
+    assert max(hot) > 100
+
+
+def test_poisson_arrivals_deterministic():
+    from benchmarks.macro_bench import op_stream, parse_mix, poisson_arrivals
+
+    a = poisson_arrivals(500.0, 2.0, seed=7)
+    b = poisson_arrivals(500.0, 2.0, seed=7)
+    assert a == b
+    assert a != poisson_arrivals(500.0, 2.0, seed=8)
+    assert all(0 <= t < 2.0 for t in a)
+    assert all(t2 > t1 for t1, t2 in zip(a, a[1:]))
+    # rate sanity: ~1000 arrivals ± 20%
+    assert 700 < len(a) < 1300
+    mix = parse_mix("get=0.5,put=0.5")
+    assert op_stream(mix, 100, seed=3) == op_stream(mix, 100, seed=3)
+
+
+# ---------------------------------------------------------------------------
+# macro-bench smoke artifact shape
+# ---------------------------------------------------------------------------
+
+
+def test_macro_bench_smoke_artifact_shape(tmp_path):
+    """End-to-end macro-bench micro run: 3-point sweep, per-op-class
+    latency percentiles, host_calibration block, zero value mismatches —
+    the artifact contract `bench.py --macro_bench` / the make target
+    rely on."""
+    from benchmarks.macro_bench import main as macro_main
+
+    out = tmp_path / "macro.json"
+    rc = macro_main([
+        "--shards", "1", "--preload_keys", "150", "--value_bytes", "48",
+        "--rates", "60,120,240", "--duration", "1.2",
+        "--seed", "5", "--out", str(out),
+    ])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["bench"] == "macro_bench"
+    assert art["failures"] == []
+    assert "fsync_per_sec" in art["host_calibration"]
+    assert len(art["sweep"]) >= 3  # the ≥3-point offered-throughput sweep
+    for point in art["sweep"]:
+        assert point["offered_per_sec"] > 0
+        assert point["achieved_per_sec"] > 0
+        assert point["value_mismatches"] == 0
+        for op, st in point["ops"].items():
+            assert op in ("get", "put", "multi_get", "scan")
+            if st["count"]:
+                assert st["p99_ms"] >= st["p50_ms"] > 0
+    # the default policy is follower_ok: followers must actually serve
+    assert any(p["reads_by_role"].get("FOLLOWER")
+               for p in art["sweep"])
+    assert art["config"]["read_policy"] == "follower_ok"

@@ -514,6 +514,10 @@ def test_wrapper_based_add_db_via_test_proxy(hosts):
 def test_replication_over_mutual_tls(tmp_path):
     """Leader/follower WAL shipping end-to-end over mutual TLS — every
     node verifies its peer's CA-signed cert in both directions."""
+    pytest.importorskip(
+        "cryptography",
+        reason="TLS tests need the 'cryptography' package to mint the "
+               "test CA (not installed in this image)")
     from rocksplicator_tpu.utils.ssl_context_manager import (
         SslContextManager, make_test_ca,
     )

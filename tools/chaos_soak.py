@@ -56,6 +56,17 @@ the harness holds the **fourth standing invariant**:
    epochs), and shard-map convergence within a bounded number of
    controller passes.
 
+5. **bounded-staleness + lineage reads** (round 13) — after every
+   healed schedule, reads with a ``max_lag`` bound are issued at every
+   replica: ZERO served reads may violate the bound (checked exactly:
+   the workload is quiesced, the read-info TTL slept out, and the
+   leader's committed seq sampled BEFORE the reads — any served read
+   must have ``applied_seq >= L0 - bound``) and ZERO reads may be
+   served from a deposed lineage (the leader-crash schedule probes the
+   fenced ex-leader directly: reads there must raise STALE_EPOCH, with
+   and without the new epoch on the request). Bounces are always
+   legal; wrong serves never are.
+
 - ``fencing`` (``--failover`` only) — the leader IGNORES epochs
   (``ReplicatedDB._reject_stale_epoch`` patched to a no-op): the
   stale-frame probes in the leader-crash schedule must catch it acking
@@ -320,6 +331,12 @@ FAILOVER_FLAGS = ReplicationFlags(
     ack_timeout_ms=800,
     consecutive_timeouts_to_degrade=1000,
     write_window=16,
+    # bounded-staleness reads (round 13): small TTL so the read
+    # invariant's quiesce-then-check window stays fast; a follower
+    # whose estimate aged past this must prove its lag with an
+    # upstream probe before serving — or bounce
+    read_info_ttl_ms=300,
+    read_probe_timeout_ms=500,
 )
 # "shard-map convergence within a bounded number of controller passes":
 # the reconcile loop runs every 0.25 s, so this bound also caps heal time
@@ -720,6 +737,23 @@ def _schedule_leader_crash(cluster, rng, acked, violations, tag, timings):
                          epoch=new_epoch))
     except Exception:
         pass
+    # DEPOSED-LINEAGE READ PROBES (round 13): once the new epoch is
+    # visible, the deposed leader must refuse reads exactly as it
+    # refuses stale-epoch pulls — with the new epoch on the request
+    # (the fencing trigger) AND without one (it is already fenced).
+    for probe_epoch in (new_epoch, None):
+        try:
+            resp = cluster.rpc(
+                leader.replicator.port, "read",
+                dict(db_name=db, op="get", keys=[b"probe"],
+                     max_lag=0, epoch=probe_epoch))
+        except Exception:
+            timings["read_bounces"] += 1
+            continue  # STALE_EPOCH is the required outcome
+        violations.append(
+            f"{tag}: READ SERVED FROM DEPOSED LINEAGE — fenced leader "
+            f"answered a read (epoch on request: {probe_epoch}, "
+            f"response epoch {resp.get('epoch')})")
     # failover-time metric: fault → first acked write on the new leader
     ack2: List[Tuple[bytes, bytes]] = []
     deadline = time.monotonic() + 10.0
@@ -923,7 +957,24 @@ def _schedule_blip(kind):
         elif kind == "shardmap_blip":
             fp.activate("shardmap.publish",
                         f"fail_first:{rng.randint(1, 2)}")
+        elif kind == "read_blip":
+            # round-13 seam: failing reads mid-schedule must only ever
+            # surface as errors/bounces at the client, never as a
+            # served-but-wrong read (the post-schedule invariant check)
+            fp.activate("repl.read",
+                        f"fail_prob:{rng.uniform(0.3, 0.7):.2f}@seed{s}")
         cluster.write_some(rng, tag, rng.randint(6, 12), acked)
+        if kind == "read_blip":
+            # drive reads THROUGH the armed seam at every replica
+            db = cluster.db_names[0]
+            for node in cluster.nodes:
+                for _ in range(rng.randint(2, 4)):
+                    try:
+                        cluster.rpc(node.replicator.port, "read",
+                                    dict(db_name=db, op="get",
+                                         keys=[b"probe"], max_lag=5))
+                    except Exception:
+                        timings["read_bounces"] += 1
         time.sleep(rng.uniform(0.1, 0.4))
         fp.clear()
 
@@ -939,10 +990,11 @@ _FAILOVER_SCHEDULES = {
     "hb_delay": _schedule_blip("hb_delay"),
     "reap_blip": _schedule_blip("reap_blip"),
     "shardmap_blip": _schedule_blip("shardmap_blip"),
+    "read_blip": _schedule_blip("read_blip"),
 }
 _HEAVY_KINDS = ["leader_crash", "session_expiry", "coordinator_failover",
                 "coordinator_wal_torn", "follower_expiry"]
-_LIGHT_KINDS = ["hb_delay", "reap_blip", "shardmap_blip"]
+_LIGHT_KINDS = ["hb_delay", "reap_blip", "shardmap_blip", "read_blip"]
 
 
 def _failover_deck(rng: random.Random, schedules: int,
@@ -1020,6 +1072,66 @@ def _check_failover_invariants(cluster: FailoverCluster, acked, tag,
     return passes
 
 
+def _check_read_invariants(cluster: FailoverCluster, acked, tag,
+                           violations, timings) -> None:
+    """Round-13 standing invariant, checked after every healed schedule:
+    ZERO reads violate the client's staleness bound and ZERO reads are
+    served from a deposed lineage.
+
+    Method (race-free by construction): the workload is quiesced here,
+    so after sleeping out ``read_info_ttl_ms`` every estimate a serving
+    follower may rely on was heard AFTER the last commit — sampling the
+    leader's committed seq L0 then makes ``applied_seq >= L0 - bound``
+    an EXACT requirement for any served bounded read, not a heuristic.
+    Bounces (STALE_READ / STALE_EPOCH) are always legal; serving outside
+    the bound or from a stale lineage never is."""
+    partition, db = cluster.partitions[0], cluster.db_names[0]
+    leader = cluster.leader_node(partition)
+    if leader is None:
+        return  # heal already failed; invariant 4 reported it
+    lrdb = leader.rdb(db)
+    if lrdb is None:
+        return
+    epoch = lrdb.epoch
+    time.sleep(FAILOVER_FLAGS.read_info_ttl_ms / 1000.0 + 0.05)
+    lapp = leader.handler.db_manager.get_db(db)
+    if lapp is None:
+        return
+    l0 = lapp.db.latest_sequence_number_relaxed()
+    key, val = acked[-1] if acked else (b"probe", None)
+    for node in cluster.nodes:
+        for bound in (0, 5):
+            timings["reads_checked"] += 1
+            try:
+                resp = cluster.rpc(
+                    node.replicator.port, "read",
+                    dict(db_name=db, op="get", keys=[key],
+                         max_lag=bound, epoch=epoch))
+            except Exception:
+                timings["read_bounces"] += 1
+                continue  # bouncing is always legal
+            timings["reads_served"] += 1
+            applied = int(resp.get("applied_seq") or 0)
+            resp_epoch = int(resp.get("epoch") or 0)
+            if applied < l0 - bound:
+                violations.append(
+                    f"{tag}: STALENESS BOUND VIOLATED — {node.name} "
+                    f"served a max_lag={bound} read at applied_seq "
+                    f"{applied} with leader committed {l0}")
+            if resp_epoch < epoch:
+                violations.append(
+                    f"{tag}: READ SERVED FROM DEPOSED LINEAGE — "
+                    f"{node.name} served at epoch {resp_epoch} < "
+                    f"current {epoch}")
+            if val is not None:
+                got = resp["values"][0]
+                got = bytes(got) if got is not None else None
+                if got != val:
+                    violations.append(
+                        f"{tag}: read of acked key {key!r} on "
+                        f"{node.name} returned {got!r} (want {val!r})")
+
+
 def run_failover_chaos(
     root: str,
     schedules: int = 15,
@@ -1041,7 +1153,9 @@ def run_failover_chaos(
     violations: List[str] = []
     acked: List[Tuple[bytes, bytes]] = []
     timings: Dict = {"failover_ms": [], "first_ack_ms": [],
-                     "passes_used": [], "window_acked": 0}
+                     "passes_used": [], "window_acked": 0,
+                     "reads_checked": 0, "reads_served": 0,
+                     "read_bounces": 0}
     fp.clear()
     t_setup = time.monotonic()
     cluster = FailoverCluster(root)
@@ -1062,7 +1176,13 @@ def run_failover_chaos(
             timings["passes_used"].append(
                 _check_failover_invariants(cluster, acked, tag, violations,
                                            timeout=heal_timeout))
+            # round-13 standing invariant: bounded-staleness + lineage
+            # rules hold on every replica once the schedule healed
+            _check_read_invariants(cluster, acked, tag, violations,
+                                   timings)
             log(f"  [{si + 1}/{len(deck)}] {kind}: acked={len(acked)} "
+                f"reads={timings['reads_served']}"
+                f"/{timings['reads_checked']} "
                 f"violations={len(violations)}")
             if violations and break_guard:
                 break  # teeth demonstrated
@@ -1093,6 +1213,9 @@ def run_failover_chaos(
         "first_ack_ms": [round(x, 1) for x in timings["first_ack_ms"]],
         "first_ack_ms_median": _med(timings["first_ack_ms"]),
         "passes_used": timings["passes_used"],
+        "reads_checked": timings["reads_checked"],
+        "reads_served": timings["reads_served"],
+        "read_bounces": timings["read_bounces"],
         "failpoint_trips": fp.trip_counts(),
         "break_guard": break_guard,
     }
@@ -1277,6 +1400,10 @@ def main(argv=None) -> int:
               f"{result['failover_ms_median']} ms, fault→first-ack "
               f"median {result['first_ack_ms_median']} ms, "
               f"controller passes {result['passes_used']}")
+        print(f"chaos[failover]: reads {result['reads_served']} served / "
+              f"{result['reads_checked']} checked "
+              f"({result['read_bounces']} bounces) — zero staleness-"
+              f"bound or deposed-lineage violations required")
     else:
         print(f"chaos: {result['schedules']} schedules "
               f"[{result['transport']}], "
@@ -1299,7 +1426,8 @@ def main(argv=None) -> int:
         return 0 if args.expect_violation else 1
     print("chaos: all invariants held"
           + ((" (exactly-one-leader, zero acked loss across handoff, "
-              "bounded shard-map convergence)" if args.failover else
+              "bounded shard-map convergence, bounded-staleness + "
+              "lineage reads)" if args.failover else
               " (hole-free WAL prefix, zero acked loss, ingest "
               "atomicity)")
              if not args.break_guard else ""))
