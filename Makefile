@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke chaos-smoke chaos-failover-smoke clean
+.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke metrics-smoke chaos-smoke chaos-failover-smoke clean
 
 # rstpu-check: the three-pass static suite (lock-order/blocking-under-
 # lock, event-loop blocking, failpoint/span/stats registries) over
@@ -90,6 +90,15 @@ macro-bench-smoke:
 		--rates 150,300,600 --duration 2 --ab --ab_duration 2 \
 		--ab_reps 1 --ab_readers 4 \
 		--out benchmarks/results/macro_bench_smoke.json
+
+# round-14 metrics-plane smoke (<10s): boots one replica in-process,
+# scrapes /metrics + /cluster_stats, validates Prometheus text-format
+# parseability, the presence of every registered gauge family (engine
+# level/amp/debt, replication lag/ack-window, block-cache hit rate),
+# and the spectator-path exact histogram merge; also run by tier-1
+# (tests/test_metrics_plane.py)
+metrics-smoke:
+	$(PY) -m tools.metrics_smoke
 
 # seeded chaos smoke (<60s): 20 randomized failpoint schedules against a
 # 3-node cluster + the admin ingest path, every schedule checked for the

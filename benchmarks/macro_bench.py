@@ -444,7 +444,9 @@ class PhaseResult:
 async def _run_open_loop(cluster: Cluster, policy, rate: float,
                          duration: float, total_keys: int,
                          value_bytes: int, mix: Dict[str, float],
-                         seed: int, max_inflight: int) -> PhaseResult:
+                         seed: int, max_inflight: int,
+                         server_get_sink: Optional[List[float]] = None
+                         ) -> PhaseResult:
     from rocksplicator_tpu.rpc.errors import RpcError
     from rocksplicator_tpu.storage import WriteBatch
 
@@ -492,6 +494,12 @@ async def _run_open_loop(cluster: Cluster, policy, rate: float,
                         policy=policy, timeout=15.0, **args)
                     role = r.get("source_role") or "?"
                     res.by_role[role] = res.by_role.get(role, 0) + 1
+                    if op == "get" and server_get_sink is not None \
+                            and r.get("serve_ms") is not None:
+                        # server-reported serve time: the exact samples
+                        # the fleet histogram buckets — the p99
+                        # agreement check's bench side
+                        server_get_sink.append(float(r["serve_ms"]))
                     if op == "get":
                         got = r["values"][0]
                         got = bytes(got) if got is not None else None
@@ -533,10 +541,12 @@ def _router_bounces(cluster) -> float:
 
 def run_phase(cluster: Cluster, policy, rate: float, duration: float,
               total_keys: int, value_bytes: int, mix: Dict[str, float],
-              seed: int, max_inflight: int) -> Dict:
+              seed: int, max_inflight: int,
+              server_get_sink: Optional[List[float]] = None) -> Dict:
     res = cluster.ioloop.run_sync(
         _run_open_loop(cluster, policy, rate, duration, total_keys,
-                       value_bytes, mix, seed, max_inflight),
+                       value_bytes, mix, seed, max_inflight,
+                       server_get_sink=server_get_sink),
         timeout=duration + 120)
     return res.summarize(rate, duration)
 
@@ -679,6 +689,69 @@ def run_read_ab(cluster: Cluster, max_lag: int, duration: float,
 
 
 # ---------------------------------------------------------------------------
+# cluster-wide stats scrape (round 14: the spectator-aggregation path)
+# ---------------------------------------------------------------------------
+
+
+def collect_cluster_stats(cluster: Cluster) -> Dict:
+    """One spectator-style scrape+merge over the 3 replica processes:
+    per-shard read/write rates + max lag, fleet per-op-class p50/p99
+    from the exact log-bucket histogram merge."""
+    from rocksplicator_tpu.cluster.stats_aggregator import \
+        ClusterStatsAggregator
+
+    agg = ClusterStatsAggregator(pool=cluster.pool, ioloop=cluster.ioloop)
+    endpoints = [("127.0.0.1", p) for p in cluster.ports]
+    return agg.scrape_and_aggregate(endpoints)
+
+
+def _fleet_p99(cluster_stats: Dict, op: str) -> Optional[float]:
+    fam = (cluster_stats.get("fleet_latency_ms") or {}).get(
+        "reads.latency_ms") or {}
+    rec = fam.get(op)
+    return rec.get("p99_ms") if rec else None
+
+
+def p99_agreement(result: Dict, server_get_ms: List[float]) -> Dict:
+    """The acceptance check: the fleet-merged get p99 must AGREE with a
+    bench-measured p99 within histogram bucket resolution.
+
+    The apples-to-apples comparison is against the bench's pooled
+    SERVER-REPORTED serve times (each read response carries
+    ``serve_ms`` — the exact quantity the per-replica
+    ``reads.latency_ms`` histograms bucket). The merged value is a
+    bucket UPPER edge, so exact agreement means
+    fleet_p99 ∈ [bench_p99, bench_p99 * 2^(1/8)]; the gate allows one
+    extra bucket step each way for the catch-up probe reads that are in
+    the fleet histogram but predate the sweep. The client-side p99
+    (intended-arrival → completion) is recorded alongside for the
+    queueing-delta picture but only bounds from above."""
+    sweep = result.get("sweep") or []
+    fleet = _fleet_p99(result.get("cluster_stats") or {}, "get")
+    if not sweep or fleet is None or not server_get_ms:
+        return {"checked": False}
+    bench_server = percentile(sorted(server_get_ms), 99)
+    lowest = min(sweep, key=lambda p: p["offered_per_sec"])
+    bench_client = (lowest["ops"].get("get") or {}).get("p99_ms")
+    bucket_step = 2 ** 0.125  # 8 sub-buckets per octave (~9%)
+    tol = bucket_step * bucket_step * 1.01  # two bucket steps + epsilon
+    within = (bench_server / tol - 0.05 <= fleet
+              <= bench_server * tol + 0.05)
+    return {
+        "checked": True,
+        "bench_server_get_p99_ms": round(bench_server, 3),
+        "bench_server_samples": len(server_get_ms),
+        "bench_client_get_p99_ms": bench_client,
+        "fleet_get_p99_ms": fleet,
+        "bucket_step": round(bucket_step, 4),
+        "within": within,
+        "note": ("fleet p99 is an exact log-bucket merge of the same "
+                 "server-side samples (upper-edge convention); client "
+                 "p99 adds RTT + open-loop queueing on top"),
+    }
+
+
+# ---------------------------------------------------------------------------
 # main
 # ---------------------------------------------------------------------------
 
@@ -783,19 +856,37 @@ def main(argv=None) -> int:
         cluster.wait_catchup(total_keys)
         result["host_calibration"] = host_calibration(root)
         sweep = []
+        server_get_ms: List[float] = []
         for i, rate in enumerate(rates):
             log(f"macro_bench: sweep {i + 1}/{len(rates)} "
                 f"offered={rate}/s x {args.duration}s "
                 f"policy={args.read_policy}")
             point = run_phase(cluster, policy, rate, args.duration,
                               total_keys, args.value_bytes, mix,
-                              args.seed + i * 101, args.max_inflight)
+                              args.seed + i * 101, args.max_inflight,
+                              server_get_sink=server_get_ms)
             sweep.append(point)
             g = point["ops"].get("get") or {}
             log(f"  achieved={point['achieved_per_sec']}/s "
                 f"get p50={g.get('p50_ms')}ms p99={g.get('p99_ms')}ms "
                 f"roles={point['reads_by_role']}")
         result["sweep"] = sweep
+        # round 14: the cluster-wide metrics plane's view of the same
+        # run — scrape every replica's `stats` RPC through the SAME
+        # aggregator the spectator's scrape loop uses and merge exactly
+        # (log-bucket histograms add losslessly). Taken right after the
+        # sweep so the A/B's saturation reads don't swamp the op-class
+        # histograms the agreement check compares.
+        result["cluster_stats"] = collect_cluster_stats(cluster)
+        result["p99_agreement"] = p99_agreement(result, server_get_ms)
+        log(f"  cluster_stats: {result['cluster_stats']['replicas_scraped']}"
+            f" replicas, max_lag="
+            f"{result['cluster_stats']['max_replication_lag']}, "
+            f"fleet get p99="
+            f"{_fleet_p99(result['cluster_stats'], 'get')}ms vs bench "
+            f"server-side "
+            f"{result['p99_agreement'].get('bench_server_get_p99_ms')}ms "
+            f"(within={result['p99_agreement'].get('within')})")
         if args.ab:
             log(f"macro_bench: read A/B leader_only vs follower_ok"
                 f"(max_lag={args.max_lag}) x {args.ab_reps} reps, "
@@ -832,6 +923,20 @@ def main(argv=None) -> int:
             and not any(p["reads_by_role"].get("FOLLOWER")
                         for p in result.get("sweep", []))):
         failures.append("follower_ok policy but zero follower-served reads")
+    cs = result.get("cluster_stats") or {}
+    if not cs.get("per_shard"):
+        failures.append("cluster_stats scrape returned no per-shard series")
+    elif cs.get("replicas_scraped", 0) < 3:
+        failures.append(
+            f"cluster_stats scraped only {cs.get('replicas_scraped')}/3 "
+            f"replicas")
+    agr = result.get("p99_agreement") or {}
+    if agr.get("checked") and not agr.get("within"):
+        failures.append(
+            f"fleet-merged get p99 {agr['fleet_get_p99_ms']}ms disagrees "
+            f"with bench-measured server-side "
+            f"{agr['bench_server_get_p99_ms']}ms beyond histogram bucket "
+            f"resolution")
     result["failures"] = failures
 
     out_json = json.dumps(result, indent=2, sort_keys=True)

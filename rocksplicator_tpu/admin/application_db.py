@@ -12,6 +12,7 @@ replicator (application_db.cpp:52-70); ``close`` unregisters.
 
 from __future__ import annotations
 
+import itertools
 import logging
 from typing import Iterator, List, Optional, Tuple
 
@@ -25,6 +26,9 @@ from ..storage.records import WriteBatch
 from ..utils.stats import Stats, tagged
 
 log = logging.getLogger(__name__)
+
+# process-unique suffixes for the fallback gauge registrations below
+_APPDB_GAUGE_REFS = itertools.count(1)
 
 
 class ApplicationDB:
@@ -52,16 +56,31 @@ class ApplicationDB:
         # (possibly a non-persisting proxy) is registered for replication
         self._reader = StorageDbWrapper(db)
         self.replicated_db: Optional[ReplicatedDB] = None
+        repl_wrapper = wrapper or StorageDbWrapper(db)
         if replicator is not None and role is not ReplicaRole.NOOP:
             self.replicated_db = replicator.add_db(
                 name,
-                wrapper or StorageDbWrapper(db),
+                repl_wrapper,
                 role,
                 upstream_addr=upstream_addr,
                 replication_mode=replication_mode,
                 leader_resolver=leader_resolver,
                 epoch=epoch,
             )
+        # engine introspection gauges (round 14): the replicator's
+        # add_db registers them when the replication wrapper exposes the
+        # engine; otherwise (unreplicated/NOOP dbs, CDC observers whose
+        # wrapper has no local engine) this ApplicationDB owns them. The
+        # ref tag disambiguates colocated same-name shards (in-process
+        # test topologies) the way the replicator path's port tag does —
+        # without it, two registrations would silently overwrite each
+        # other and either close() would strip the survivor's gauges.
+        from ..storage.engine import register_db_gauges
+
+        self._gauge_names: list = []
+        if self.replicated_db is None or repl_wrapper.gauge_target() is None:
+            self._gauge_names = register_db_gauges(
+                name, db, ref=f"a{next(_APPDB_GAUGE_REFS)}")
 
     # -- writes ------------------------------------------------------------
 
@@ -158,6 +177,10 @@ class ApplicationDB:
         return self.db.latest_sequence_number()
 
     def close(self) -> None:
+        from ..storage.engine import unregister_db_gauges
+
+        unregister_db_gauges(self._gauge_names)
+        self._gauge_names = []
         if self.replicated_db is not None and self._replicator is not None:
             try:
                 self._replicator.remove_db(self.name)

@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from ..observability.context import current_span
 from ..rpc.client_pool import RpcClientPool
 from ..rpc.errors import RpcApplicationError, RpcError
 from ..rpc.ioloop import IoLoop
@@ -1100,6 +1101,11 @@ class CoordinatorServer:
 
         snap = snapshot()
         if known_version != -2 and snap["cversion"] == known_version:
+            # parked long-poll by design: the enclosing rpc.server root
+            # must not be tail-kept as a slow outlier
+            root = current_span()
+            if root is not None:
+                root.annotate(tail_exempt="watch_longpoll")
             await self._wait_change(path, max_wait_ms / 1000.0)
             snap = snapshot()
         return snap
@@ -1224,6 +1230,11 @@ class CoordinatorServer:
         pull an ACK: requesting from_index implies everything before it
         was received — the semi-sync wait watches these (the same
         implicit-ACK design as the replication plane's seq pulls)."""
+        if max_wait_ms > 0:
+            # long-poll serve by design — never tail-keep its root
+            root = current_span()
+            if root is not None:
+                root.annotate(tail_exempt="repl_updates_longpoll")
         if standby_id:
             with self._lock:
                 # lease contact counts even before the epoch handshake
@@ -1456,6 +1467,7 @@ class CoordinatorServer:
                          # discover the ensemble from any one member
                          "standby_addr": my_addr},
                         timeout=35,
+                        tail_exempt=True,  # 5s long-poll by design
                     )
                     down_since = None
                     self._adopt_ftoken(r.get("ftoken", 0))
@@ -1717,9 +1729,15 @@ class CoordinatorClient:
     _UNSAFE_RETRY = frozenset({"create", "set", "delete", "multi"})
 
     def _call(self, method: str, timeout: float = 30.0, **args):
+        # any coordinator RPC that long-polls by protocol (watch, lock
+        # recipes) has a BY-DESIGN slow RTT: never tail-keep it as an
+        # outlier trace
+        exempt = int(args.get("max_wait_ms") or 0) > 0
+
         async def go(host: str, port: int):
             return await self._pool.call(
-                host, port, method, args, timeout=timeout
+                host, port, method, args, timeout=timeout,
+                tail_exempt=exempt,
             )
 
         last: Optional[Exception] = None

@@ -39,6 +39,7 @@ class Spectator:
         spectator_id: str = "spectator",
         standalone: bool = True,
         coord_fallbacks: Optional[List[Tuple[str, int]]] = None,
+        scrape_interval: float = 0.0,
     ):
         self.cluster = cluster
         self.spectator_id = spectator_id
@@ -49,10 +50,24 @@ class Spectator:
         self._path = lambda *p: cluster_path(cluster, *p)
         self._kick = threading.Event()
         self._stop = threading.Event()
+        # cluster-wide stats plane (round 14): the latest published
+        # shard map names every replica's replication endpoint, so the
+        # spectator — already the fleet's external-view watcher — owns
+        # the scrape loop. 0 = off (existing callers unchanged).
+        self._last_shard_map: Optional[dict] = None
+        self.cluster_stats: dict = {}
+        self._scrape_interval = float(scrape_interval)
+        self._aggregator = None
+        self._scrape_thread: Optional[threading.Thread] = None
         self._thread = threading.Thread(
             target=self._run, name=f"spectator-{spectator_id}", daemon=True
         )
         self._thread.start()
+        if self._scrape_interval > 0:
+            self._scrape_thread = threading.Thread(
+                target=self._scrape_loop,
+                name=f"spectator-scrape-{spectator_id}", daemon=True)
+            self._scrape_thread.start()
         self._watches = [
             self.coord.watch(self._path("currentstates"), self._on_change),
             self.coord.watch(self._path("instances"), self._on_change),
@@ -99,7 +114,51 @@ class Spectator:
         fp.hit("shardmap.publish")
         shard_map = generate_shard_map(self.coord, self.cluster)
         self._publisher.publish(shard_map)
+        self._last_shard_map = shard_map
         return shard_map
+
+    # -- cluster-wide stats scrape (round 14) ---------------------------
+
+    def _scrape_loop(self) -> None:
+        from ..utils.status_server import StatusServer
+        from .stats_aggregator import (ClusterStatsAggregator,
+                                       endpoints_from_shard_map)
+
+        rng = seeded_rng()
+        attempt = 0
+        endpoint_registered = False
+        while not self._stop.wait(self._scrape_interval):
+            shard_map = self._last_shard_map
+            if not shard_map:
+                continue
+            try:
+                if self._aggregator is None:
+                    self._aggregator = ClusterStatsAggregator()
+                endpoints, per_db = endpoints_from_shard_map(shard_map)
+                if endpoints:
+                    self.cluster_stats = \
+                        self._aggregator.scrape_and_aggregate(
+                            endpoints, per_db)
+                if not endpoint_registered:
+                    # serve /cluster_stats off this process's status
+                    # server when one is running (never start one here —
+                    # the embedding service owns that decision)
+                    server = StatusServer._instance
+                    if server is not None:
+                        server.register_endpoint(
+                            "/cluster_stats", self.cluster_stats_json)
+                        endpoint_registered = True
+                attempt = 0
+            except Exception:
+                log.exception("spectator stats scrape error")
+                backoff_step(_REFRESH_RETRY, attempt,
+                             op="spectator.scrape", rng=rng)
+                attempt += 1
+
+    def cluster_stats_json(self) -> str:
+        import json
+
+        return json.dumps(self.cluster_stats, indent=1, default=str)
 
     def stop(self) -> None:
         self._stop.set()
@@ -107,4 +166,9 @@ class Spectator:
         for w in self._watches:
             w.set()
         self._thread.join(timeout=5.0)
+        if self._scrape_thread is not None:
+            self._scrape_thread.join(timeout=5.0)
+        if self._aggregator is not None:
+            self._aggregator.close()  # drop the per-replica scrape sockets
+            self._aggregator = None
         self.coord.close()

@@ -1,0 +1,275 @@
+"""Cluster-wide stats aggregation: scrape every replica, merge exactly.
+
+Reference: the rocksplicator deployment fans per-host common/stats into
+statsd and aggregates fleet-wide in the Helix spectator's dashboards
+(PAPER.md L1/L4). Here the spectator itself owns the loop: it already
+watches the external view, so it knows every replica's replication
+endpoint from the shard map it publishes — the scrape pulls each node's
+``stats`` RPC (``Stats.export_state``), and the merge is EXACT:
+
+- counters merge by summation (totals and 1-minute rates);
+- histograms merge losslessly — every process buckets with the same
+  log-spaced edges (utils/stats._Histogram), so a cross-replica merge
+  is a per-bucket vector add (``merge_histogram_states``), and fleet
+  percentiles carry exactly the per-replica bucket resolution (~9%),
+  never resampling error on top;
+- gauges keep per-replica identity and aggregate per shard (max lag is
+  a max, not a mean — the rebalancer cares about the worst replica).
+
+The aggregate feeds ``/cluster_stats`` and the macro-bench artifact:
+per-shard hot-spot ranking by read/write rate, per-shard max
+replication lag / ack-window occupancy / compaction debt, and fleet
+p50/p99 per op class — the input shape the per-shard-load rebalancer
+and the workload-adaptive compaction scheduler (ROADMAP) consume.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..rpc.errors import RpcError
+from ..utils.retry_policy import RetryPolicy, retry_call, seeded_rng
+from ..utils.segment_utils import segment_to_db_name
+from ..utils.stats import (Stats, histogram_state_percentile,
+                           merge_histogram_states, split_tagged)
+
+log = logging.getLogger(__name__)
+
+Endpoint = Tuple[str, int]
+
+# one quick retry per endpoint per scrape pass: a node mid-restart is
+# skipped (and counted) rather than stalling the whole pass
+_SCRAPE_RETRY = RetryPolicy(max_attempts=2, base_delay=0.1, max_delay=0.5)
+
+# histogram families reported per op class in the fleet summary
+_LATENCY_FAMILIES = ("reads.latency_ms", "writes.latency_ms")
+
+
+def endpoints_from_shard_map(shard_map: Dict) -> Tuple[
+        List[Endpoint], Dict[str, List[Endpoint]]]:
+    """(all replica replication endpoints, db_name -> its replicas).
+    Shard-map host keys are ``host:admin_port:az:repl_port`` (the 4th
+    field is the replication RPC port — config_generator.py)."""
+    endpoints: List[Endpoint] = []
+    seen = set()
+    per_db: Dict[str, List[Endpoint]] = {}
+    for segment, seg_map in (shard_map or {}).items():
+        for host_key, shards in seg_map.items():
+            if host_key == "num_shards":
+                continue
+            parts = host_key.split(":")
+            if len(parts) < 4:
+                continue
+            ep = (parts[0], int(parts[3]))
+            if ep not in seen:
+                seen.add(ep)
+                endpoints.append(ep)
+            for entry in shards:
+                shard_id = int(entry.split(":", 1)[0])
+                db = segment_to_db_name(segment, shard_id)
+                per_db.setdefault(db, [])
+                if ep not in per_db[db]:
+                    per_db[db].append(ep)
+    return endpoints, per_db
+
+
+class ClusterStatsAggregator:
+    """Scrapes replica ``stats`` RPCs and merges them into one
+    cluster-wide view. Owns no thread — the Spectator's scrape loop (or
+    a bench doing a one-shot pull) drives it."""
+
+    def __init__(self, pool=None, ioloop=None,
+                 rpc_timeout: float = 3.0):
+        from ..rpc.client_pool import RpcClientPool
+        from ..rpc.ioloop import IoLoop
+
+        self._ioloop = ioloop or IoLoop.default()
+        self._owns_pool = pool is None
+        self._pool = pool or RpcClientPool()
+        self._rpc_timeout = rpc_timeout
+        self._rng = seeded_rng()
+        self._stats = Stats.get()
+
+    def close(self) -> None:
+        """Release the scrape connections — only when this aggregator
+        created its own pool (callers sharing a pool keep theirs)."""
+        if not self._owns_pool:
+            return
+        try:
+            self._ioloop.run_sync(self._pool.close(), timeout=5)
+        except Exception:  # pragma: no cover - teardown best-effort
+            log.debug("aggregator pool close failed", exc_info=True)
+
+    # -- scrape -----------------------------------------------------------
+
+    def scrape(self, endpoints: Iterable[Endpoint]
+               ) -> Dict[str, Dict]:
+        """Pull ``stats`` from every endpoint; unreachable nodes are
+        skipped and counted (``spectator.scrape_errors``). Returns
+        ``{"host:port": export_state}`` for the nodes that answered."""
+        out: Dict[str, Dict] = {}
+        for host, port in endpoints:
+            key = f"{host}:{port}"
+            try:
+                out[key] = retry_call(
+                    lambda h=host, p=port: self._scrape_one(h, p),
+                    policy=_SCRAPE_RETRY,
+                    classify=lambda e: isinstance(e, (RpcError, OSError,
+                                                      TimeoutError)),
+                    op="spectator.scrape",
+                    rng=self._rng,
+                )
+                self._stats.incr("spectator.scrapes")
+            except Exception as e:
+                self._stats.incr("spectator.scrape_errors")
+                log.warning("stats scrape of %s failed: %r", key, e)
+        return out
+
+    def _scrape_one(self, host: str, port: int) -> Dict:
+        async def go():
+            return await self._pool.call(host, port, "stats", {},
+                                         timeout=self._rpc_timeout)
+
+        return self._ioloop.run_sync(go(), timeout=self._rpc_timeout + 2)
+
+    # -- merge ------------------------------------------------------------
+
+    @staticmethod
+    def aggregate(per_endpoint: Dict[str, Dict],
+                  per_db_endpoints: Optional[Dict[str, List[Endpoint]]]
+                  = None,
+                  hot_limit: int = 16) -> Dict:
+        """Merge scraped states into the `/cluster_stats` document."""
+        shard: Dict[str, Dict] = {}
+
+        def shard_rec(db: str) -> Dict:
+            return shard.setdefault(db, {
+                "read_rate_1m": 0.0, "write_rate_1m": 0.0,
+                "reads_total": 0.0, "writes_total": 0.0,
+                "max_applied_seq_lag": 0.0, "ack_window_depth": 0.0,
+                "compaction_debt_bytes": 0.0, "replicas_reporting": 0,
+                "roles": {},
+            })
+
+        hist_by_family_op: Dict[Tuple[str, str], List[Dict]] = {}
+        counters_total: Dict[str, float] = {}
+        debt_by_ep_db: Dict[Tuple[str, str], float] = {}
+
+        # In-process topologies (chaos/cluster tests) colocate several
+        # replicators in ONE process sharing ONE Stats registry: two
+        # endpoints of the same pid export identical registries, so the
+        # registry-wide parts (counters/gauges/metrics) are consumed
+        # once per process; the per-endpoint shard_roles — each
+        # replicator's OWN db map — are consumed per endpoint. Cross-
+        # process deployments have one endpoint per pid and are
+        # unaffected.
+        seen_processes = set()
+        for ep in sorted(per_endpoint):
+            state = per_endpoint[ep]
+            proc = state.get("process") or ep
+            dup_registry = proc in seen_processes
+            seen_processes.add(proc)
+            for db, role in (state.get("shard_roles") or {}).items():
+                shard_rec(db)["roles"][role] = (
+                    shard_rec(db)["roles"].get(role, 0) + 1)
+            if dup_registry:
+                continue
+            for name, c in (state.get("counters") or {}).items():
+                base, tags = split_tagged(name)
+                counters_total[base] = (counters_total.get(base, 0.0)
+                                        + float(c.get("total", 0.0)))
+                db = tags.get("db")
+                if db and base == "replicator.shard_reads":
+                    rec = shard_rec(db)
+                    rec["read_rate_1m"] += float(c.get("rate_1m", 0.0))
+                    rec["reads_total"] += float(c.get("total", 0.0))
+                elif db and base == "replicator.shard_writes":
+                    rec = shard_rec(db)
+                    rec["write_rate_1m"] += float(c.get("rate_1m", 0.0))
+                    rec["writes_total"] += float(c.get("total", 0.0))
+            for name, value in (state.get("gauges") or {}).items():
+                base, tags = split_tagged(name)
+                db = tags.get("db")
+                if not db:
+                    continue
+                if base == "replicator.applied_seq_lag":
+                    rec = shard_rec(db)
+                    rec["max_applied_seq_lag"] = max(
+                        rec["max_applied_seq_lag"], float(value))
+                    rec["replicas_reporting"] += 1
+                elif base == "replicator.ack_window_depth":
+                    shard_rec(db)["ack_window_depth"] = max(
+                        shard_rec(db)["ack_window_depth"], float(value))
+                elif base == "storage.compaction_debt_bytes":
+                    k = (ep, db)
+                    debt_by_ep_db[k] = (debt_by_ep_db.get(k, 0.0)
+                                        + float(value))
+            for name, st in (state.get("metrics") or {}).items():
+                base, tags = split_tagged(name)
+                if base in _LATENCY_FAMILIES:
+                    op = tags.get("op", "?")
+                    hist_by_family_op.setdefault((base, op), []).append(st)
+
+        # worst-replica compaction debt per shard (summed over levels
+        # within one replica, max across replicas)
+        for (ep, db), debt in debt_by_ep_db.items():
+            shard_rec(db)["compaction_debt_bytes"] = max(
+                shard_rec(db)["compaction_debt_bytes"], debt)
+
+        # shard-map view of how many replicas SHOULD be reporting — a
+        # shard whose reporting count falls short names its gap here
+        if per_db_endpoints:
+            for db, eps in per_db_endpoints.items():
+                shard_rec(db)["replicas_expected"] = len(eps)
+
+        fleet_latency: Dict[str, Dict] = {}
+        for (family, op), states in sorted(hist_by_family_op.items()):
+            merged = merge_histogram_states(states)
+            if not merged["count"]:
+                continue
+            fleet_latency.setdefault(family, {})[op] = {
+                "count": merged["count"],
+                "mean_ms": round(merged["sum"] / merged["count"], 3),
+                "p50_ms": round(
+                    histogram_state_percentile(merged, 50), 3),
+                "p99_ms": round(
+                    histogram_state_percentile(merged, 99), 3),
+            }
+
+        hot = sorted(
+            shard.items(),
+            key=lambda kv: kv[1]["read_rate_1m"] + kv[1]["write_rate_1m"],
+            reverse=True,
+        )
+        return {
+            "time": time.time(),
+            "replicas_scraped": len(per_endpoint),
+            "replicas": sorted(per_endpoint),
+            "per_shard": shard,
+            "hot_shards": [
+                {"db": db,
+                 "read_rate_1m": round(rec["read_rate_1m"], 1),
+                 "write_rate_1m": round(rec["write_rate_1m"], 1)}
+                for db, rec in hot[:hot_limit]
+            ],
+            "max_replication_lag": max(
+                (rec["max_applied_seq_lag"] for rec in shard.values()),
+                default=0.0),
+            "fleet_latency_ms": fleet_latency,
+            "counters_total": {
+                k: v for k, v in sorted(counters_total.items())
+                if k.startswith(("replicator.", "reads.", "storage."))
+            },
+            "histogram_merge": "exact-log-bucket",
+        }
+
+    def scrape_and_aggregate(self, endpoints: Iterable[Endpoint],
+                             per_db_endpoints: Optional[
+                                 Dict[str, List[Endpoint]]] = None) -> Dict:
+        states = self.scrape(endpoints)
+        agg = self.aggregate(states, per_db_endpoints)
+        agg["scrape_errors_total"] = self._stats.get_counter(
+            "spectator.scrape_errors")
+        return agg

@@ -34,6 +34,12 @@ from typing import Any, Dict, List, Optional
 
 DEFAULT_CAPACITY = 4096
 DEFAULT_SAMPLE_RATE = 1.0 / 1024.0
+# Tail-keep (round 14): a head-UNSAMPLED root whose duration exceeds
+# this is retained anyway — the deferred-decision buffer that makes the
+# macro-bench's knee-point p99 outliers inspectable instead of
+# 1023/1024 invisible. 0 disables; RSTPU_TRACING=0 still kills all.
+DEFAULT_TAIL_MS = 100.0
+DEFAULT_TAIL_CAPACITY = 256
 
 
 class SpanCollector:
@@ -56,6 +62,22 @@ class SpanCollector:
             except ValueError:
                 pass
         self.sample_rate = float(sample_rate)
+        # tail-keep threshold: env-tunable, malformed values degrade to
+        # the default (same stance as the sample-rate env above)
+        tail_ms = DEFAULT_TAIL_MS
+        env_tail = os.environ.get("RSTPU_TRACE_TAIL_MS")
+        if env_tail is not None:
+            try:
+                tail_ms = float(env_tail)
+            except ValueError:
+                pass
+        self.tail_ms = tail_ms
+        # separate small ring for tail-kept roots so head-sampled
+        # traffic can never evict the rare slow outlier — the whole
+        # point of keeping it
+        self._tail_ring: List[Optional[dict]] = [None] * DEFAULT_TAIL_CAPACITY
+        self._tail_seq = itertools.count()
+        self._tail_recorded = 0
         # global kill switch: RSTPU_TRACING=0 disables EVERYTHING,
         # including always=True control-plane spans — the ops escape
         # hatch when any tracing overhead at all is unwanted
@@ -86,9 +108,12 @@ class SpanCollector:
 
     def configure(self, sample_rate: Optional[float] = None,
                   capacity: Optional[int] = None,
-                  process: Optional[str] = None) -> None:
+                  process: Optional[str] = None,
+                  tail_ms: Optional[float] = None) -> None:
         if sample_rate is not None:
             self.sample_rate = float(sample_rate)
+        if tail_ms is not None:
+            self.tail_ms = float(tail_ms)
         if process is not None:
             self.process = process
         if capacity is not None and int(capacity) != self._capacity:
@@ -111,6 +136,41 @@ class SpanCollector:
         ring[i % len(ring)] = d
         self._recorded = i + 1
 
+    def record_tail(self, root, duration_ms: float,
+                    error: Optional[str] = None) -> None:
+        """Keep a head-unsampled root that crossed the tail threshold
+        (span.py ``_TailRoot`` exit). Ids are minted HERE — only kept
+        tails pay for id generation. The span dict carries a
+        ``tail_kept`` annotation so /traces readers can tell a deferred
+        keep (root-only by construction) from a head-sampled trace."""
+        import time
+
+        from .context import new_id
+
+        d = {
+            "trace_id": new_id(),
+            "span_id": new_id(),
+            "parent_id": None,
+            "name": root.name,
+            "process": self.process,
+            # wall-clock start reconstructed at keep time — the fast
+            # (discarded) path never pays the time.time() syscall
+            "start_ms": round(time.time() * 1000.0 - duration_ms, 3),
+            "duration_ms": round(duration_ms, 3),
+            "annotations": {**root.annotations, "tail_kept": True},
+            "error": error,
+        }
+        i = next(self._tail_seq)
+        ring = self._tail_ring
+        ring[i % len(ring)] = d
+        self._tail_recorded = i + 1
+        try:
+            from ..utils.stats import Stats
+
+            Stats.get().incr("trace.tail_kept")
+        except Exception:  # pragma: no cover - defensive
+            pass
+
     # -- cold read path ---------------------------------------------------
 
     @property
@@ -122,9 +182,20 @@ class SpanCollector:
         """Spans overwritten before they could be read (ring evictions)."""
         return max(0, self._recorded - self._capacity)
 
+    @property
+    def tail_kept(self) -> int:
+        """Head-unsampled roots retained by the tail path."""
+        return self._tail_recorded
+
+    @property
+    def tail_dropped(self) -> int:
+        return max(0, self._tail_recorded - len(self._tail_ring))
+
     def snapshot(self) -> List[dict]:
-        """All retained spans, oldest first (by wall-clock start)."""
+        """All retained spans — head-sampled AND tail-kept — oldest
+        first (by wall-clock start)."""
         spans = [d for d in list(self._ring) if d is not None]
+        spans.extend(d for d in list(self._tail_ring) if d is not None)
         spans.sort(key=lambda d: d["start_ms"])
         return spans
 
@@ -190,6 +261,9 @@ class SpanCollector:
             "capacity": self._capacity,
             "recorded": self.recorded,
             "dropped": self.dropped,
+            "tail_ms": self.tail_ms,
+            "tail_kept": self.tail_kept,
+            "tail_dropped": self.tail_dropped,
             "traces": self.traces(limit=limit),
         }, indent=1, default=str)
 
@@ -198,7 +272,9 @@ class SpanCollector:
         """Human-readable per-trace waterfall (``/traces.txt``)."""
         lines: List[str] = [
             f"# spans recorded={self.recorded} dropped={self.dropped} "
-            f"sample_rate={self.sample_rate:g} process={self.process}",
+            f"sample_rate={self.sample_rate:g} "
+            f"tail_kept={self.tail_kept} tail_ms={self.tail_ms:g} "
+            f"process={self.process}",
         ]
         for tr in self.traces(trace_id=trace_id, limit=limit):
             lines.append("")

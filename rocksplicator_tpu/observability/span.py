@@ -106,6 +106,37 @@ class _NoopSpan:
 NOOP_SPAN = _NoopSpan()
 
 
+class _TailRoot:
+    """A head-UNSAMPLED root under tail-keep (round 14): the deferred
+    sampling decision. Cheap enough for every root op — one small
+    object, one wall-clock read, one perf_counter read; ``sampled`` is
+    False so descendants still take the NOOP fast path (a kept tail
+    trace is root-only by design — the decision can't be made until the
+    duration is known, by which time the children are gone). On exit,
+    a root slower than the collector's ``tail_ms`` is retained in the
+    tail ring: the 1023/1024 head-unsampled p99 outlier becomes
+    inspectable on /traces instead of invisible."""
+
+    __slots__ = ("name", "t0", "tail_ms", "annotations")
+    sampled = False
+    trace_id = ""
+    span_id = ""
+
+    def __init__(self, name: str, tail_ms: float,
+                 annotations: Optional[Dict[str, Any]]):
+        self.name = name
+        self.t0 = time.perf_counter()
+        # threshold cached here so the (common) fast exit never touches
+        # the collector singleton; wall-clock start is reconstructed at
+        # keep time (start = now - duration) — one fewer syscall per
+        # unsampled root
+        self.tail_ms = tail_ms
+        self.annotations = annotations or {}
+
+    def annotate(self, **kv: Any) -> None:
+        self.annotations.update(kv)
+
+
 class start_span:
     """Context manager creating a span under the active one (or a new
     sampled/unsampled root). See module docstring for the fast-path
@@ -143,13 +174,26 @@ class start_span:
                     return NOOP_SPAN
                 span = Span(self._name, parent.trace_id, parent.span_id,
                             self._ann)
-            elif (self._always and _enabled()) or _sample():
-                span = Span(self._name, new_id(), None, self._ann)
             else:
-                # unsampled ROOT: park the sentinel so descendants take
-                # the cheap branch above instead of re-rolling sampling
-                self._token = _current.set(NOOP_SPAN)
-                return NOOP_SPAN
+                from .collector import SpanCollector
+
+                col = SpanCollector.get()
+                if (self._always and col.enabled) or col.sample():
+                    span = Span(self._name, new_id(), None, self._ann)
+                elif col.enabled and col.tail_ms > 0.0:
+                    # head-unsampled ROOT under tail-keep: defer the
+                    # decision to __exit__ (duration known). sampled is
+                    # False, so descendants still take the NOOP branch.
+                    root = _TailRoot(self._name, col.tail_ms, self._ann)
+                    self._span = root
+                    self._token = _current.set(root)
+                    return root
+                else:
+                    # unsampled ROOT: park the sentinel so descendants
+                    # take the cheap branch above instead of re-rolling
+                    # sampling
+                    self._token = _current.set(NOOP_SPAN)
+                    return NOOP_SPAN
         self._span = span
         self._token = _current.set(span)
         return span
@@ -158,13 +202,30 @@ class start_span:
         if self._token is not None:
             _current.reset(self._token)
         span = self._span
-        if span is not NOOP_SPAN:
-            if exc_type is not None and span.error is None:
-                span.error = repr(exc)
-            span.finish()
-            from .collector import SpanCollector
+        if span is NOOP_SPAN:
+            return False
+        if type(span) is _TailRoot:
+            duration_ms = (time.perf_counter() - span.t0) * 1000.0
+            # tail_exempt: the operation declared its slowness is BY
+            # DESIGN (a parked long-poll serve, a long-poll pull RTT) —
+            # keeping those would fill the tail ring with waits and
+            # evict the genuine outliers the ring exists for
+            if duration_ms >= span.tail_ms \
+                    and "tail_exempt" not in span.annotations:
+                from .collector import SpanCollector
 
-            SpanCollector.get().record(span)
+                col = SpanCollector.get()
+                if col.enabled:
+                    col.record_tail(
+                        span, duration_ms,
+                        error=repr(exc) if exc_type is not None else None)
+            return False
+        if exc_type is not None and span.error is None:
+            span.error = repr(exc)
+        span.finish()
+        from .collector import SpanCollector
+
+        SpanCollector.get().record(span)
         return False
 
 
@@ -183,12 +244,6 @@ def detached_span(name: str, parent, **annotations: Any):
     if parent is None or not parent.sampled:
         return None
     return Span(name, parent.trace_id, parent.span_id, dict(annotations))
-
-
-def _sample() -> bool:
-    from .collector import SpanCollector
-
-    return SpanCollector.get().sample()
 
 
 def _enabled() -> bool:

@@ -106,6 +106,14 @@ class DbWrapper:
              ) -> List[Tuple[bytes, bytes]]:
         raise NotImplementedError("wrapper does not serve scans")
 
+    # -- observability (round 14: engine introspection gauges) -----------
+
+    def gauge_target(self) -> Optional[DB]:
+        """The engine whose pull-model gauges should be registered for
+        this shard (``engine.register_db_gauges``), or None for wrappers
+        with no local engine (CDC observers, test proxies)."""
+        return None
+
 
 class StorageDbWrapper(DbWrapper):
     """Default wrapper over the LSM engine (rocksdb_wrapper.{h,cpp}):
@@ -172,3 +180,6 @@ class StorageDbWrapper(DbWrapper):
             if len(out) >= limit:
                 break
         return out
+
+    def gauge_target(self) -> Optional[DB]:
+        return self.db

@@ -7,11 +7,13 @@ SOURCE_NOT_FOUND otherwise.
 
 from __future__ import annotations
 
+import asyncio
 from typing import Optional
 
 from ..observability.context import current_span
 from ..rpc.errors import RpcApplicationError
 from ..utils.concurrent_map import FastReadMap
+from ..utils.stats import Stats
 from .wire import ReplicaRole, ReplicateErrorCode
 
 
@@ -92,6 +94,21 @@ class ReplicatorHandler:
             op=op, keys=keys, start=start, count=count, max_lag=max_lag,
             epoch=epoch,
         )
+
+    async def handle_stats(self) -> dict:
+        """Process stats export for the spectator's scrape loop (round
+        14): every counter/gauge plus the exact all-time histogram
+        states (``Stats.export_state``), tagged with this node's shard
+        roles so the aggregator can attribute per-shard series without
+        a second control-plane lookup. Runs in the executor — the
+        export drains thread buffers under locks and evaluates engine
+        gauges, none of which belongs on the event loop."""
+        roles = {name: rdb.role.value for name, rdb in self._dbs.items()
+                 if not rdb.removed}
+        loop = asyncio.get_running_loop()
+        state = await loop.run_in_executor(None, Stats.get().export_state)
+        state["shard_roles"] = roles
+        return state
 
     async def handle_write(
         self,

@@ -27,6 +27,7 @@ from ..storage.records import WriteBatch
 from ..utils.concurrent_map import FastReadMap
 from ..utils.dbconfig import DBConfigManager
 from ..utils.segment_utils import db_name_to_segment
+from ..utils.stats import Stats, tagged
 from .db_wrapper import DbWrapper
 from .handler import ReplicatorHandler
 from .replicated_db import LeaderResolver, ReplicatedDB, ReplicationFlags
@@ -126,6 +127,7 @@ class Replicator:
             flags=self._flags,
             leader_resolver=leader_resolver,
             epoch=epoch,
+            stat_tags={"port": str(self.port)},
         )
         if not self._dbs.add(name, rdb):
             raise ValueError(f"db already exists: {name}")
@@ -136,13 +138,46 @@ class Replicator:
             self._dbs.remove(name)
             rdb.stop()
             raise
+        self._register_shard_gauges(name, rdb, wrapper)
         return rdb
+
+    def _register_shard_gauges(self, name: str, rdb: ReplicatedDB,
+                               wrapper: DbWrapper) -> None:
+        """Pull-model gauges for this shard (round 14): replication lag
+        + ack-window occupancy here, the engine's level/amp/debt gauges
+        when the wrapper exposes a local engine. Tagged with this
+        replicator's port so multi-replicator (in-process cluster) test
+        topologies keep one gauge series per replica."""
+        from ..storage.engine import register_db_gauges
+
+        stats = Stats.get()
+        port = str(self.port)
+        names = []
+        lag_name = tagged("replicator.applied_seq_lag", db=name, port=port)
+        stats.add_gauge(lag_name, rdb.applied_seq_lag)
+        names.append(lag_name)
+        depth_name = tagged("replicator.ack_window_depth", db=name,
+                            port=port)
+        stats.add_gauge(depth_name, lambda: float(rdb.ack_window_depth))
+        names.append(depth_name)
+        engine = wrapper.gauge_target()
+        if engine is not None:
+            names.extend(register_db_gauges(name, engine, stats=stats,
+                                            port=port))
+        rdb._gauge_names = names
+
+    def _unregister_shard_gauges(self, rdb: ReplicatedDB) -> None:
+        stats = Stats.get()
+        for gname in getattr(rdb, "_gauge_names", ()):
+            stats.remove_gauge(gname)
+        rdb._gauge_names = []
 
     def remove_db(self, name: str) -> None:
         rdb = self._dbs.get(name)
         if rdb is None:
             raise KeyError(f"no such db: {name}")
         rdb.stop()
+        self._unregister_shard_gauges(rdb)
         self._dbs.remove(name)
 
     def get_db(self, name: str) -> Optional[ReplicatedDB]:
@@ -189,6 +224,7 @@ class Replicator:
         self._maintenance_stop.set()
         for _name, rdb in list(self._dbs.items()):
             rdb.stop()
+            self._unregister_shard_gauges(rdb)
         self._dbs.clear()
         self._server.stop()
         self._ioloop.run_sync(self._pool.close())

@@ -141,7 +141,11 @@ class RpcClient:
     async def call(
         self, method: str, args: Optional[Dict[str, Any]] = None,
         timeout: Optional[float] = 30.0,
+        tail_exempt: bool = False,
     ) -> Any:
+        """``tail_exempt=True`` marks a call whose long RTT is BY DESIGN
+        (a long-poll pull parks server-side up to max_wait_ms): the
+        tracing tail-keep path must not retain it as a slow outlier."""
         if not self.is_good:
             raise RpcConnectionError(f"client {self.host}:{self.port} not connected")
         req_id = next(self._ids)
@@ -152,6 +156,8 @@ class RpcClient:
         # under the reserved "trace" key; the server reattaches it before
         # dispatch, stitching the caller's trace across the process hop.
         with start_span("rpc.rtt", method=method, peer=self.host) as sp:
+            if tail_exempt:
+                sp.annotate(tail_exempt="long_poll")
             msg: Dict[str, Any] = {
                 "id": req_id, "method": method, "args": args or {}
             }

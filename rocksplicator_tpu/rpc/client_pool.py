@@ -85,9 +85,11 @@ class RpcClientPool:
                 return client
 
     async def call(self, host: str, port: int, method: str, args=None,
-                   timeout: Optional[float] = 30.0):
+                   timeout: Optional[float] = 30.0,
+                   tail_exempt: bool = False):
         client = await self.get_client(host, port)
-        return await client.call(method, args, timeout)
+        return await client.call(method, args, timeout,
+                                 tail_exempt=tail_exempt)
 
     def peek(self, host: str, port: int) -> Optional[RpcClient]:
         return self._clients.get((host, port))

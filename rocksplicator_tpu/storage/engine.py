@@ -99,6 +99,14 @@ class DBOptions:
     delayed_write_rate: int = 16 * 1024 * 1024  # bytes/s, rocksdb default
     level0_slowdown_writes_trigger: int = 12
     level0_stop_writes_trigger: int = 24
+    # Per-level byte targets for the compaction-debt gauges (rocksdb's
+    # max_bytes_for_level_base/_multiplier): level L>=1 target is
+    # base * multiplier^(L-1); bytes above target are "debt" — the
+    # foreground-pressure signal a workload-adaptive compaction
+    # scheduler prioritizes by (RESYSTANCE, arxiv 2603.05162). L0 debt
+    # is files beyond the compaction trigger, expressed in bytes.
+    max_bytes_for_level_base: int = 256 * 1024 * 1024
+    max_bytes_for_level_multiplier: int = 10
     # WAL archival (storage.archive.WalArchiver.sink, or any
     # callable(path)): sealed WAL segments are shipped here before TTL
     # deletion, enabling point-in-time restore (restore_db(..., to_seq))
@@ -228,6 +236,18 @@ class DB:
         self._bg_compaction_failures = 0
         self._bg_thread: Optional[threading.Thread] = None
         self._compaction_thread: Optional[threading.Thread] = None
+        # Introspection counters (all mutated under self._lock): the
+        # cumulative inputs of the pull-model gauges. read-amp = files
+        # consulted per get (fence/bloom path); write-amp = bytes
+        # written by compaction / bytes flushed (rocksdb's definition,
+        # measured at the flush/compaction install sinks).
+        self._gets_total = 0
+        self._files_consulted_total = 0
+        self._bytes_flushed_total = 0
+        self._bytes_compacted_total = 0
+        # short-lived cache so one /stats or /metrics dump evaluating a
+        # dozen per-db gauges pays ONE lock pass, not one per gauge
+        self._metrics_cache: Tuple[float, Optional[Dict]] = (0.0, None)
         self._open()
         if self.options.background_compaction:
             # Separate flush and compaction threads (as RocksDB separates
@@ -619,36 +639,46 @@ class DB:
         key = bytes(key)
         with self._lock:
             self._check_open()
-            merge_op = self.options.merge_operator
-            operands: List[bytes] = []
-            # newest first: active memtable, then immutables newest->oldest
-            for mem in (self._mem, *reversed(self._imms)):
-                resolved, value, pending = mem.get(key, merge_op)
-                if resolved and not operands:
-                    return value
-                if resolved:
-                    base = value
-                    return merge_op.merge(key, base, operands[::-1]) if merge_op else base
-                operands.extend(pending[::-1])  # newest-first accumulation
-            # L0 newest-first, then deeper levels. Fold through every entry
-            # of each file's per-key stack (MERGE operands stack within one
-            # SST after a flush).
-            for name in reversed(self._levels[0]):
-                for result in self._readers[name].get_entries(key):
-                    done, value = self._fold(key, result, operands, merge_op)
-                    if done:
+            # read-amp accounting: every SST actually consulted (bloom/
+            # fence survivors) counts; the gauge reports the cumulative
+            # files-consulted-per-get ratio
+            self._gets_total += 1
+            consulted = 0
+            try:
+                merge_op = self.options.merge_operator
+                operands: List[bytes] = []
+                # newest first: active memtable, then immutables newest->oldest
+                for mem in (self._mem, *reversed(self._imms)):
+                    resolved, value, pending = mem.get(key, merge_op)
+                    if resolved and not operands:
                         return value
-            for level in range(1, len(self._levels)):
-                reader = self._find_file_for_key(level, key)
-                if reader is None:
-                    continue
-                for result in reader.get_entries(key):
-                    done, value = self._fold(key, result, operands, merge_op)
-                    if done:
-                        return value
-            if operands and merge_op:
-                return merge_op.merge(key, None, operands[::-1])
-            return None
+                    if resolved:
+                        base = value
+                        return merge_op.merge(key, base, operands[::-1]) if merge_op else base
+                    operands.extend(pending[::-1])  # newest-first accumulation
+                # L0 newest-first, then deeper levels. Fold through every entry
+                # of each file's per-key stack (MERGE operands stack within one
+                # SST after a flush).
+                for name in reversed(self._levels[0]):
+                    consulted += 1
+                    for result in self._readers[name].get_entries(key):
+                        done, value = self._fold(key, result, operands, merge_op)
+                        if done:
+                            return value
+                for level in range(1, len(self._levels)):
+                    reader = self._find_file_for_key(level, key)
+                    if reader is None:
+                        continue
+                    consulted += 1
+                    for result in reader.get_entries(key):
+                        done, value = self._fold(key, result, operands, merge_op)
+                        if done:
+                            return value
+                if operands and merge_op:
+                    return merge_op.merge(key, None, operands[::-1])
+                return None
+            finally:
+                self._files_consulted_total += consulted
 
     def _fold(
         self,
@@ -711,6 +741,7 @@ class DB:
         keys_b = [bytes(k) for k in keys]
         with self._lock:
             self._check_open()
+            self._gets_total += len(keys_b)
             merge_op = self.options.merge_operator
             results: Dict[bytes, Optional[bytes]] = {}
             operands: Dict[bytes, List[bytes]] = {}
@@ -786,6 +817,7 @@ class DB:
     ) -> List[bytes]:
         """Fold one SST's entry stacks into the per-key resolution state;
         returns the keys still unresolved after this file."""
+        self._files_consulted_total += len(pending)  # read-amp accounting
         found = reader.get_entries_many(pending, hashes=hashes)
         still: List[bytes] = []
         for k in pending:
@@ -1086,6 +1118,7 @@ class DB:
                 )
                 self._readers[name] = reader
                 self._levels[0].append(name)
+                self._bytes_flushed_total += reader.file_size
                 self._persisted_seq = max(self._persisted_seq, max_seq)
                 snapshot = self._manifest_snapshot_locked()
                 for m in imms:
@@ -1107,6 +1140,14 @@ class DB:
                     install_ms=round((t2 - t1) * 1e3, 3),
                     wal_purge_ms=round((t3 - t2) * 1e3, 3),
                 )
+
+    def _note_compacted_locked(self, out_names: List[str]) -> None:
+        """Write-amp accounting at a compaction install sink: bytes
+        WRITTEN by the compaction (its outputs). Caller holds self._lock
+        and has already registered readers for ``out_names``."""
+        self._bytes_compacted_total += sum(
+            self._readers[n].file_size for n in out_names
+            if n in self._readers)
 
     def _compact_level0_bg(self) -> None:
         """L0→L1 compaction with the merge OUTSIDE the DB lock. Safe
@@ -1146,6 +1187,7 @@ class DB:
                         n for n in self._levels[0] if n not in inputs_l0
                     ]
                     self._levels[1] = out_names
+                    self._note_compacted_locked(out_names)
                     self._fences.clear()
                     snapshot = self._manifest_snapshot_locked()
                     dead = [(n, self._readers.pop(n, None)) for n in inputs]
@@ -1181,6 +1223,7 @@ class DB:
             self._write_mem_sst(os.path.join(self.path, name), mem)
             self._readers[name] = SSTReader(os.path.join(self.path, name))
             self._levels[0].append(name)
+            self._bytes_flushed_total += self._readers[name].file_size
             self._persisted_seq = max(self._persisted_seq, mem.max_seq)
             if not defer_manifest:
                 self._persist_manifest()
@@ -1263,6 +1306,7 @@ class DB:
                     for files in self._levels:
                         files[:] = [n for n in files if n not in input_set]
                     self._levels[bottom] = out_names + self._levels[bottom]
+                    self._note_compacted_locked(out_names)
                     self._fences.clear()
                     # Manifest first, THEN delete inputs — a crash in
                     # between leaves orphan files (harmless), never a
@@ -1283,6 +1327,7 @@ class DB:
         out_names = self._write_merged(runs, drop_tombstones=drop)
         self._levels[0] = []
         self._levels[1] = out_names
+        self._note_compacted_locked(out_names)
         self._fences.clear()
         self._persist_manifest()  # before GC — see compact_range
         self._gc_files(inputs)
@@ -1446,6 +1491,7 @@ class DB:
                         n for n in level_files if n not in input_set]
                 bottom = plan["bottom"]
                 self._levels[bottom] = out_names + self._levels[bottom]
+                self._note_compacted_locked(out_names)
                 self._fences.clear()
                 self._persist_manifest()
                 self._gc_files(plan["inputs"])
@@ -1549,6 +1595,82 @@ class DB:
 
     def approximate_disk_size(self) -> int:
         return int(self.get_property("total-sst-bytes") or 0)
+
+    # ------------------------------------------------------------------
+    # introspection gauges (round 14: the observability plane's inputs)
+    # ------------------------------------------------------------------
+
+    def metrics_snapshot(self, max_age: float = 0.5) -> Dict:
+        """One consistent cut of the engine's pull-model gauge inputs,
+        computed in ONE pass under the DB lock (file sizes are cached on
+        the readers — no filesystem IO under the lock) and cached for
+        ``max_age`` seconds so a /metrics dump evaluating a dozen per-db
+        gauges pays one lock pass, not one per gauge. These are the
+        foreground-pressure signals the workload-adaptive compaction
+        scheduler and the per-shard rebalancer consume (ROADMAP)."""
+        now = time.monotonic()
+        cached_at, cached = self._metrics_cache
+        if cached is not None and now - cached_at < max_age:
+            return cached
+        opts = self.options
+        with self._lock:
+            if self._closed:
+                return cached or {}
+            level_files = [len(files) for files in self._levels]
+            level_bytes = [
+                sum(self._readers[n].file_size for n in files
+                    if n in self._readers)
+                for files in self._levels
+            ]
+            # compaction debt: bytes above each level's target. L0's
+            # target is the compaction trigger expressed in bytes (files
+            # beyond the trigger, at the level's mean file size); deeper
+            # levels use the rocksdb-style base * multiplier^(L-1).
+            debt = [0] * len(self._levels)
+            if level_files[0] > opts.level0_compaction_trigger:
+                mean = level_bytes[0] / max(1, level_files[0])
+                debt[0] = int(
+                    (level_files[0] - opts.level0_compaction_trigger) * mean)
+            target = opts.max_bytes_for_level_base
+            for lvl in range(1, len(self._levels)):
+                debt[lvl] = max(0, level_bytes[lvl] - target)
+                target *= opts.max_bytes_for_level_multiplier
+            mem_bytes = self._mem.approximate_bytes() + sum(
+                m.approximate_bytes() for m in self._imms)
+            unflushed_seqs = max(0, self._last_seq - self._persisted_seq)
+            gets = self._gets_total
+            consulted = self._files_consulted_total
+            flushed = self._bytes_flushed_total
+            compacted = self._bytes_compacted_total
+        # WAL backlog sized OUTSIDE the lock (directory listing is IO);
+        # the segment set is append/purge-only so a racing purge at
+        # worst under-counts one segment
+        wal_bytes = 0
+        try:
+            with os.scandir(self._wal_dir) as it:
+                for entry in it:
+                    try:
+                        wal_bytes += entry.stat().st_size
+                    except OSError:
+                        continue
+        except OSError:
+            pass
+        snap = {
+            "level_files": level_files,
+            "level_bytes": level_bytes,
+            "compaction_debt_bytes": debt,
+            "memtable_bytes": mem_bytes,
+            "wal_backlog_bytes": wal_bytes,
+            "unflushed_seqs": unflushed_seqs,
+            "read_amp": (consulted / gets) if gets else 0.0,
+            "write_amp": (compacted / flushed) if flushed else 0.0,
+            "gets_total": gets,
+            "files_consulted_total": consulted,
+            "bytes_flushed_total": flushed,
+            "bytes_compacted_total": compacted,
+        }
+        self._metrics_cache = (now, snap)
+        return snap
 
     def set_options(self, updates: Dict[str, object]) -> None:
         """Runtime-mutable options (reference setDBOptions,
@@ -1798,3 +1920,77 @@ def destroy_db(path: str) -> None:
     """DestroyDB parity (clearDB path, admin_handler.cpp:1774-1817)."""
     if os.path.isdir(path):
         shutil.rmtree(path)
+
+
+# ---------------------------------------------------------------------------
+# pull-model gauge registration (reference stats.h pull gauges)
+# ---------------------------------------------------------------------------
+
+# per-level families (tagged db=<name> level=<L>)
+DB_LEVEL_GAUGES = (
+    "storage.level_files",
+    "storage.level_bytes",
+    "storage.compaction_debt_bytes",
+)
+# scalar families (tagged db=<name>)
+DB_SCALAR_GAUGES = {
+    "storage.memtable_bytes": "memtable_bytes",
+    "storage.wal_backlog_bytes": "wal_backlog_bytes",
+    "storage.unflushed_seqs": "unflushed_seqs",
+    "storage.read_amp": "read_amp",
+    "storage.write_amp": "write_amp",
+}
+_LEVEL_GAUGE_KEYS = {
+    "storage.level_files": "level_files",
+    "storage.level_bytes": "level_bytes",
+    "storage.compaction_debt_bytes": "compaction_debt_bytes",
+}
+
+
+def register_db_gauges(name: str, db: DB,
+                       stats: Optional[Stats] = None,
+                       **extra_tags: str) -> List[str]:
+    """Register this shard's engine gauges on the process Stats registry
+    (pull-model: each callback reads the db's cached metrics_snapshot).
+    ``extra_tags`` (e.g. port=...) disambiguate multi-replicator test
+    processes where several engines carry the same shard name. Returns
+    the registered gauge names for :func:`unregister_db_gauges`."""
+    from ..utils.stats import tagged
+
+    stats = stats or Stats.get()
+    names: List[str] = []
+
+    def add(gname: str, cb) -> None:
+        stats.add_gauge(gname, cb)
+        names.append(gname)
+
+    for family in DB_LEVEL_GAUGES:
+        key = _LEVEL_GAUGE_KEYS[family]
+        for lvl in range(db.options.num_levels):
+            def cb(key=key, lvl=lvl) -> float:
+                vals = db.metrics_snapshot().get(key) or []
+                return float(vals[lvl]) if lvl < len(vals) else 0.0
+            add(tagged(family, db=name, level=str(lvl), **extra_tags), cb)
+    for family, key in DB_SCALAR_GAUGES.items():
+        def cb(key=key) -> float:
+            return float(db.metrics_snapshot().get(key) or 0.0)
+        add(tagged(family, db=name, **extra_tags), cb)
+    # process-global: registered idempotently alongside any db (the
+    # decoded-block cache is process-wide)
+    stats.add_gauge("storage.block_cache.hit_rate", _block_cache_hit_rate)
+    return names
+
+
+def unregister_db_gauges(names: List[str],
+                         stats: Optional[Stats] = None) -> None:
+    stats = stats or Stats.get()
+    for gname in names:
+        stats.remove_gauge(gname)
+
+
+def _block_cache_hit_rate() -> float:
+    s = Stats.get()
+    hits = s.get_counter("storage.block_cache.hit")
+    misses = s.get_counter("storage.block_cache.miss")
+    total = hits + misses
+    return hits / total if total else 0.0

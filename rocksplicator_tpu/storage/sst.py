@@ -402,6 +402,9 @@ class SSTReader:
             global_seqno if flags & FLAG_HAS_GLOBAL_SEQNO else None
         )
         self.num_entries = num_entries
+        # cached once at open: the engine's level-bytes / write-amp
+        # gauges sum these under the DB lock without touching the fs
+        self.file_size = file_size
         self._bloom = BloomFilter.from_bytes(
             os.pread(self._fd, index_off - bloom_off, bloom_off)
         )

@@ -26,8 +26,8 @@ RANKS = {
     "rocksplicator_tpu/admin/cdc.py:103": ('CdcAdminHandler._lock', 6),
     "rocksplicator_tpu/admin/cdc.py:42": ('CdcDbWrapper._lock', 7),
     "rocksplicator_tpu/utils/rate_limiter.py:25": ('ConcurrentRateLimiter._lock', 8),
-    "rocksplicator_tpu/cluster/coordinator.py:302": ('CoordinatorServer._snapshot_mutex', 9),
-    "rocksplicator_tpu/storage/engine.py:208": ('DB._compaction_mutex', 10),
+    "rocksplicator_tpu/cluster/coordinator.py:303": ('CoordinatorServer._snapshot_mutex', 9),
+    "rocksplicator_tpu/storage/engine.py:216": ('DB._compaction_mutex', 10),
     "rocksplicator_tpu/utils/dbconfig.py:48": ('DBConfigManager._instance_lock', 11),
     "rocksplicator_tpu/cluster/publishers.py:69": ('DedupPublisher._lock', 12),
     "rocksplicator_tpu/utils/concurrent_map.py:22": ('FastReadMap._write_lock', 13),
@@ -52,28 +52,28 @@ RANKS = {
     "rocksplicator_tpu/replication/replicated_db.py:149": ('ReplicatedDB._ack_state_lock', 32),
     "rocksplicator_tpu/replication/replicated_db.py:132": ('ReplicatedDB._epoch_lock', 33),
     "rocksplicator_tpu/replication/replicated_db.py:155": ('ReplicatedDB._expiry_lock', 34),
-    "rocksplicator_tpu/replication/replicated_db.py:208": ('ReplicatedDB._write_traces_lock', 35),
-    "rocksplicator_tpu/replication/replicator.py:41": ('Replicator._instance_lock', 36),
+    "rocksplicator_tpu/replication/replicated_db.py:219": ('ReplicatedDB._write_traces_lock', 35),
+    "rocksplicator_tpu/replication/replicator.py:42": ('Replicator._instance_lock', 36),
     "rocksplicator_tpu/utils/retry_policy.py:57": ('RetryBudget._lock', 37),
     "rocksplicator_tpu/utils/s3_stub.py:48": ('S3StubServer.lock', 38),
-    "rocksplicator_tpu/observability/collector.py:41": ('SpanCollector._instance_lock', 39),
+    "rocksplicator_tpu/observability/collector.py:47": ('SpanCollector._instance_lock', 39),
     "rocksplicator_tpu/utils/ssl_context_manager.py:57": ('SslContextManager._lock', 40),
-    "rocksplicator_tpu/utils/stats.py:162": ('Stats._buffers_lock', 41),
-    "rocksplicator_tpu/utils/stats.py:153": ('Stats._instance_lock', 42),
-    "rocksplicator_tpu/utils/stats.py:156": ('Stats._lock', 43),
+    "rocksplicator_tpu/utils/stats.py:231": ('Stats._buffers_lock', 41),
+    "rocksplicator_tpu/utils/stats.py:212": ('Stats._instance_lock', 42),
+    "rocksplicator_tpu/utils/stats.py:218": ('Stats._lock', 43),
     "rocksplicator_tpu/utils/status_server.py:31": ('StatusServer._instance_lock', 44),
     "rocksplicator_tpu/tpu/compaction_service.py:41": ('TpuCompactionService._instance_lock', 45),
     "rocksplicator_tpu/storage/archive.py:63": ('WalArchiver._mutex', 46),
     "rocksplicator_tpu/testing/failpoints.py:129": ('_Site.lock', 47),
-    "rocksplicator_tpu/utils/stats.py:141": ('_ThreadBuffer.lock', 48),
+    "rocksplicator_tpu/utils/stats.py:200": ('_ThreadBuffer.lock', 48),
     "rocksplicator_tpu/kafka/broker.py:204": ('kafka.broker:_clusters_lock', 49),
     "rocksplicator_tpu/storage/native/binding.py:472": ('storage.native.binding:_native_lock', 50),
     "rocksplicator_tpu/testing/failpoints.py:161": ('testing.failpoints:_lock', 51),
     "rocksplicator_tpu/utils/objectstore.py:379": ('utils.objectstore:_store_cache_lock', 52),
     "rocksplicator_tpu/admin/db_manager.py:20": ('ApplicationDBManager._lock', 53),
-    "rocksplicator_tpu/cluster/coordinator.py:295": ('CoordinatorServer._lock', 54),
-    "rocksplicator_tpu/storage/engine.py:179": ('DB._lock', 55),
-    "rocksplicator_tpu/storage/engine.py:215": ('DB._manifest_mutex', 56),
+    "rocksplicator_tpu/cluster/coordinator.py:296": ('CoordinatorServer._lock', 54),
+    "rocksplicator_tpu/storage/engine.py:187": ('DB._lock', 55),
+    "rocksplicator_tpu/storage/engine.py:223": ('DB._manifest_mutex', 56),
     "rocksplicator_tpu/utils/file_watcher.py:40": ('FileWatcher._instance_lock', 57),
     "rocksplicator_tpu/cluster/participant.py:73": ('Participant._state_lock', 58),
     "rocksplicator_tpu/storage/wal.py:68": ('WalWriter._sync_lock', 59),
@@ -82,11 +82,11 @@ RANKS = {
 # static partial order: (acquired-first, acquired-second)
 ORDER = {
     ("rocksplicator_tpu/admin/handler.py:157", "rocksplicator_tpu/admin/db_manager.py:20"),
-    ("rocksplicator_tpu/cluster/coordinator.py:302", "rocksplicator_tpu/cluster/coordinator.py:295"),
+    ("rocksplicator_tpu/cluster/coordinator.py:303", "rocksplicator_tpu/cluster/coordinator.py:296"),
     ("rocksplicator_tpu/cluster/participant.py:74", "rocksplicator_tpu/cluster/participant.py:73"),
-    ("rocksplicator_tpu/storage/engine.py:179", "rocksplicator_tpu/storage/wal.py:68"),
-    ("rocksplicator_tpu/storage/engine.py:208", "rocksplicator_tpu/storage/engine.py:179"),
-    ("rocksplicator_tpu/storage/engine.py:208", "rocksplicator_tpu/storage/engine.py:215"),
-    ("rocksplicator_tpu/storage/engine.py:208", "rocksplicator_tpu/storage/wal.py:68"),
+    ("rocksplicator_tpu/storage/engine.py:187", "rocksplicator_tpu/storage/wal.py:68"),
+    ("rocksplicator_tpu/storage/engine.py:216", "rocksplicator_tpu/storage/engine.py:187"),
+    ("rocksplicator_tpu/storage/engine.py:216", "rocksplicator_tpu/storage/engine.py:223"),
+    ("rocksplicator_tpu/storage/engine.py:216", "rocksplicator_tpu/storage/wal.py:68"),
     ("rocksplicator_tpu/utils/dbconfig.py:48", "rocksplicator_tpu/utils/file_watcher.py:40"),
 }

@@ -60,9 +60,16 @@ class _TimeSeries:
 
 
 class _Histogram:
-    """Windowed histogram with percentile queries (log-spaced buckets)."""
+    """Windowed histogram with percentile queries (log-spaced buckets).
 
-    __slots__ = ("windows", "count", "sum")
+    Besides the ~2-window view the percentile reads use, an ALL-TIME
+    sparse bucket map (``totals``) accumulates forever: it is what the
+    Prometheus ``/metrics`` export renders (native histograms must be
+    monotone counters) and what the spectator's cross-replica merge
+    sums — a log-bucket merge is lossless by construction (same bucket
+    edges everywhere, merge = vector add)."""
+
+    __slots__ = ("windows", "count", "sum", "totals")
 
     # log-spaced buckets, 8 per octave (~9% relative resolution), covering
     # 2^-4 (0.0625) .. 2^40 (~1e12) — enough for sub-ms latencies through
@@ -75,6 +82,7 @@ class _Histogram:
         self.windows: Dict[int, List[int]] = {}
         self.count = 0
         self.sum = 0.0
+        self.totals: Dict[int, int] = {}
 
     @classmethod
     def _bucket_of(cls, value: float) -> int:
@@ -98,7 +106,9 @@ class _Histogram:
                 cutoff = w - 2
                 for k in [k for k in self.windows if k < cutoff]:
                     del self.windows[k]
-        buckets[self._bucket_of(value)] += 1
+        b = self._bucket_of(value)
+        buckets[b] += 1
+        self.totals[b] = self.totals.get(b, 0) + 1
         self.count += 1
         self.sum += value
 
@@ -125,6 +135,55 @@ class _Histogram:
 
     def avg(self) -> float:
         return self.sum / self.count if self.count else 0.0
+
+    def state(self) -> Dict:
+        """Serializable all-time state: the scrape-RPC / merge shape.
+        Bucket keys are stringified indices (JSON object keys)."""
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "buckets": {str(i): c for i, c in sorted(self.totals.items())},
+        }
+
+
+def merge_histogram_states(states: List[Dict]) -> Dict:
+    """EXACT merge of histogram states (``_Histogram.state()`` shape):
+    every replica buckets with the same log-spaced edges, so the merge
+    is a plain per-bucket sum — no resampling, no approximation beyond
+    the original per-replica bucketing."""
+    buckets: Dict[int, int] = {}
+    count = 0
+    total = 0.0
+    for st in states:
+        if not st:
+            continue
+        count += int(st.get("count", 0))
+        total += float(st.get("sum", 0.0))
+        for k, c in (st.get("buckets") or {}).items():
+            i = int(k)
+            buckets[i] = buckets.get(i, 0) + int(c)
+    return {
+        "count": count,
+        "sum": total,
+        "buckets": {str(i): c for i, c in sorted(buckets.items())},
+    }
+
+
+def histogram_state_percentile(state: Dict, pct: float) -> float:
+    """Percentile over a (possibly merged) histogram state. Same
+    conservative upper-edge convention as ``_Histogram.percentile``."""
+    buckets = [(int(k), int(c)) for k, c in (state.get("buckets") or {}).items()]
+    buckets.sort()
+    total = sum(c for _i, c in buckets)
+    if total == 0:
+        return 0.0
+    target = total * pct / 100.0
+    acc = 0
+    for i, c in buckets:
+        acc += c
+        if acc >= target:
+            return _Histogram._bucket_value(i)
+    return _Histogram._bucket_value(buckets[-1][0])
 
 
 # ---------------------------------------------------------------------------
@@ -153,7 +212,17 @@ class Stats:
     _instance_lock = threading.Lock()
 
     def __init__(self) -> None:
+        import os
+        import uuid
+
         self._lock = threading.Lock()
+        # process-INSTANCE identity for scrape exports: pid alone is not
+        # unique across hosts/containers (every containerized replica is
+        # commonly pid 1), so a random token minted per registry makes
+        # the aggregator's shared-registry dedup safe fleet-wide —
+        # endpoints sharing one registry share the token; distinct
+        # processes never do
+        self._export_id = f"pid:{os.getpid()}:{uuid.uuid4().hex[:12]}"
         self._counters: Dict[str, _TimeSeries] = {}
         self._metrics: Dict[str, _Histogram] = {}
         self._gauges: Dict[str, Callable[[], float]] = {}
@@ -314,6 +383,109 @@ class Stats:
                 lines.append(f"gauge {name} error={e!r}")
         return "\n".join(lines) + "\n"
 
+    def gauge_values(
+        self, prefixes: Optional[Tuple[str, ...]] = None
+    ) -> Dict[str, float]:
+        """Evaluate registered gauges (optionally filtered by base-name
+        prefix). Callbacks run OUTSIDE the stats lock — a gauge is free
+        to take its own subsystem's locks (the engine snapshot does)."""
+        with self._lock:
+            gauges = list(self._gauges.items())
+        out: Dict[str, float] = {}
+        for name, cb in gauges:
+            if prefixes is not None and not name.startswith(prefixes):
+                continue
+            try:
+                out[name] = float(cb())
+            except Exception:  # pragma: no cover - defensive
+                continue
+        return out
+
+    def export_state(self) -> Dict:
+        """The scrape-RPC body: every counter (all-time total + 1-minute
+        rate), every histogram's exact all-time state, every gauge's
+        current value — JSON-serializable, mergeable across replicas by
+        the spectator (``merge_histogram_states`` et al.). Carries the
+        process identity: in-process multi-replicator topologies
+        (chaos/cluster tests) share ONE registry, so an aggregator
+        scraping two such endpoints must count the registry once, not
+        twice (stats_aggregator dedupes on this field)."""
+        self.flush()
+        now = time.time()
+        with self._lock:
+            counters = {
+                name: {"total": ts.total,
+                       "rate_1m": ts.rate_last_minute(now)}
+                for name, ts in self._counters.items()
+            }
+            metrics = {name: h.state() for name, h in self._metrics.items()}
+        return {
+            "time": now,
+            "process": self._export_id,
+            "counters": counters,
+            "metrics": metrics,
+            "gauges": self.gauge_values(),
+        }
+
+    def dump_prometheus(self) -> str:
+        """Prometheus text exposition of counters, gauges, and the
+        log-bucketed histograms (classic ``_bucket``/``_sum``/``_count``
+        lines over the ALL-TIME totals, so every series is the monotone
+        counter Prometheus requires). Tagged names (``name k=v``) become
+        labels; dotted names become underscore-joined metric names under
+        the ``rstpu_`` namespace."""
+        self.flush()
+        now = time.time()
+        with self._lock:
+            counters = [(n, ts.total, ts.rate_last_minute(now))
+                        for n, ts in self._counters.items()]
+            metrics = [(n, h.state()) for n, h in self._metrics.items()]
+        gauges = self.gauge_values()
+
+        # family name -> (type, sample lines); one TYPE header per family
+        families: Dict[str, Tuple[str, List[str]]] = {}
+
+        def fam_of(base: str, ftype: str) -> List[str]:
+            fam = _prom_name(base) + ("_total" if ftype == "counter" else "")
+            return families.setdefault(fam, (ftype, []))[1]
+
+        for name, total, _rate in sorted(counters):
+            base, tags = split_tagged(name)
+            fam_of(base, "counter").append(
+                f"{_prom_name(base)}_total{_prom_labels(tags)} "
+                f"{_prom_num(total)}")
+        for name, value in sorted(gauges.items()):
+            base, tags = split_tagged(name)
+            fam_of(base, "gauge").append(
+                f"{_prom_name(base)}{_prom_labels(tags)} "
+                f"{_prom_num(value)}")
+        for name, state in sorted(metrics):
+            base, tags = split_tagged(name)
+            fam = _prom_name(base)
+            lines = fam_of(base, "histogram")
+            acc = 0
+            for k, c in sorted(
+                    ((int(i), c) for i, c in state["buckets"].items())):
+                acc += c
+                le = _Histogram._bucket_value(k)
+                lines.append(
+                    f"{fam}_bucket"
+                    f"{_prom_labels(tags, le=_prom_num(le))} {acc}")
+            lines.append(
+                f"{fam}_bucket{_prom_labels(tags, le='+Inf')} "
+                f"{state['count']}")
+            lines.append(
+                f"{fam}_sum{_prom_labels(tags)} {_prom_num(state['sum'])}")
+            lines.append(
+                f"{fam}_count{_prom_labels(tags)} {state['count']}")
+
+        out: List[str] = []
+        for fam in sorted(families):
+            ftype, lines = families[fam]
+            out.append(f"# TYPE {fam} {ftype}")
+            out.extend(lines)
+        return "\n".join(out) + "\n"
+
 
 class _Snapshot:
     """Holds references to a thread's buffers so flush() can drain them."""
@@ -333,3 +505,78 @@ def tagged(name: str, **tags: str) -> str:
     if not tags:
         return name
     return name + " " + " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
+
+
+def split_tagged(name: str) -> Tuple[str, Dict[str, str]]:
+    """Inverse of :func:`tagged`: ``"db_size db=seg00001"`` →
+    ``("db_size", {"db": "seg00001"})``. Tokens without ``=`` after the
+    base name are kept verbatim in a ``_`` tag rather than dropped."""
+    parts = name.split(" ")
+    tags: Dict[str, str] = {}
+    for tok in parts[1:]:
+        k, sep, v = tok.partition("=")
+        if sep:
+            tags[k] = v
+        elif tok:
+            tags["_"] = tok
+    return parts[0], tags
+
+
+def _prom_name(base: str) -> str:
+    """Dotted stats name → Prometheus metric name (``rstpu_`` namespace,
+    ``[a-zA-Z0-9_:]`` alphabet)."""
+    safe = "".join(c if (c.isalnum() or c == "_") else "_" for c in base)
+    return "rstpu_" + safe
+
+
+def _prom_labels(tags: Dict[str, str], **extra: str) -> str:
+    items = dict(tags)
+    items.update(extra)
+    if not items:
+        return ""
+    def esc(v: str) -> str:
+        return str(v).replace("\\", "\\\\").replace('"', '\\"')
+    return ("{" + ",".join(
+        f'{k}="{esc(v)}"' for k, v in sorted(items.items())) + "}")
+
+
+def _prom_num(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+_PROM_LINE = None  # compiled lazily (keeps `re` off the hot import path)
+
+
+def parse_prometheus_text(text: str) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """Strict-enough parser for the Prometheus text format the export
+    produces: returns ``{metric_name: [(labels, value), ...]}``. Raises
+    ``ValueError`` on any line that is neither a comment nor a valid
+    sample — the metrics-smoke gate."""
+    import re
+
+    global _PROM_LINE
+    if _PROM_LINE is None:
+        _PROM_LINE = re.compile(
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*)\})?'
+            r' ([0-9eE+.\-]+|\+Inf|-Inf|NaN)$')
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable metrics line {lineno}: {line!r}")
+        name, rawlabels, rawval = m.groups()
+        labels: Dict[str, str] = {}
+        if rawlabels:
+            for part in re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)='
+                                   r'"((?:[^"\\]|\\.)*)"', rawlabels):
+                labels[part[0]] = part[1]
+        value = float("inf") if rawval == "+Inf" else (
+            float("-inf") if rawval == "-Inf" else float(rawval))
+        out.setdefault(name, []).append((labels, value))
+    return out

@@ -43,6 +43,12 @@ class StatusServer:
         self._port = port
         self._endpoints: Dict[str, EndpointHandler] = {
             "/stats.txt": lambda: Stats.get().dump_text(),
+            # machine-readable siblings of /stats.txt: the Prometheus
+            # text exposition (counters/gauges + log-bucket histograms
+            # as native histogram lines) and the raw mergeable state the
+            # spectator scrape consumes
+            "/metrics": lambda: Stats.get().dump_prometheus(),
+            "/stats.json": _dump_stats_json,
             "/flags.txt": FLAGS.dump_text,
             "/gflags.txt": FLAGS.dump_text,  # reference-compatible alias
             "/threads.txt": _dump_threads,
@@ -131,6 +137,12 @@ class StatusServer:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+
+
+def _dump_stats_json() -> str:
+    import json
+
+    return json.dumps(Stats.get().export_state(), indent=1, default=str)
 
 
 def _dump_traces_json() -> str:

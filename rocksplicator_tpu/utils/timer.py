@@ -11,9 +11,32 @@ import random
 import time
 from typing import Optional
 
+from .retry_policy import seeded_rng
 from .stats import Stats
 
 log = logging.getLogger(__name__)
+
+# Slow-log sampling draws ride the seeded_rng()/RSTPU_RETRY_SEED
+# contract (utils/retry_policy.py — the ONE home of the seed-pinning
+# rule) instead of the global `random`: with the seed pinned, a chaos
+# schedule or slow-log test sees a deterministic sample sequence.
+# Created lazily so an env seed set at process start (how chaos arms
+# children) is honored regardless of import order.
+_slow_log_rng: Optional[random.Random] = None
+
+
+def _slow_log_draw() -> float:
+    global _slow_log_rng
+    if _slow_log_rng is None:
+        _slow_log_rng = seeded_rng()
+    return _slow_log_rng.random()
+
+
+def reset_slow_log_rng_for_test() -> None:
+    """Re-derive the sampling RNG from the environment (tests pin
+    RSTPU_RETRY_SEED and need the stream to restart)."""
+    global _slow_log_rng
+    _slow_log_rng = None
 
 
 class Timer:
@@ -54,7 +77,8 @@ class SlowLogTimer(Timer):
 
     def __exit__(self, *exc) -> bool:
         super().__exit__(*exc)
-        if self.elapsed_ms > self._threshold_ms and random.random() < self._sample_rate:
+        if self.elapsed_ms > self._threshold_ms \
+                and _slow_log_draw() < self._sample_rate:
             log.warning(
                 "slow request: %s took %.1fms (threshold %.1fms) %s",
                 self._metric,

@@ -440,6 +440,49 @@ def test_spectator_generates_shard_map(control_plane, tmp_path):
     assert all(len(k.split(":")) == 4 for k in host_keys)
 
 
+def test_spectator_scrape_loop_builds_cluster_stats(control_plane):
+    """Round 14: the spectator's scrape loop pulls every replica's
+    `stats` RPC off the shard map it publishes and merges them into
+    cluster_stats — per-shard series with roles, fleet counters, and
+    the max-replication-lag headline."""
+    coord_server, cluster, add_node, add_controller, extras = control_plane
+    a = add_node("a")
+    b = add_node("b")
+    ctrl = add_controller()
+    ctrl.add_resource(ResourceDef("seg", num_shards=1, replicas=2))
+    spec = Spectator(
+        "127.0.0.1", coord_server.port, cluster, [],
+        scrape_interval=0.2,
+    )
+    extras.append(spec)
+    nodes = [a, b]
+    assert wait_until(lambda: any(
+        n.participant.current_states.get("seg_0") == "LEADER"
+        for n in nodes), timeout=30)
+    leader = next(n for n in nodes
+                  if n.participant.current_states.get("seg_0") == "LEADER")
+    for i in range(20):
+        leader.handler.db_manager.get_db("seg00000").write(
+            WriteBatch().put(b"k%03d" % i, b"v" * 16))
+
+    def scraped():
+        cs = spec.cluster_stats
+        shard = (cs.get("per_shard") or {}).get("seg00000")
+        return bool(shard and shard.get("writes_total", 0) >= 20
+                    and cs.get("replicas_scraped", 0) >= 2)
+
+    assert wait_until(scraped, timeout=30), spec.cluster_stats
+    shard = spec.cluster_stats["per_shard"]["seg00000"]
+    # both replicas report the shard; the external-view roles rode along
+    assert shard["replicas_reporting"] >= 2
+    assert shard["roles"].get("LEADER") == 1
+    assert shard["roles"].get("FOLLOWER", 0) >= 1
+    assert shard.get("replicas_expected") == 2
+    assert "max_replication_lag" in spec.cluster_stats
+    assert json.loads(spec.cluster_stats_json())["histogram_merge"] == \
+        "exact-log-bucket"
+
+
 def test_task_framework_backup_and_dedup(control_plane, tmp_path):
     coord_server, cluster, add_node, add_controller, extras = control_plane
     store_uri = str(tmp_path / "bucket")

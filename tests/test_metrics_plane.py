@@ -1,0 +1,549 @@
+"""Round 14: the cluster-wide metrics plane.
+
+Covers the four tentpole layers — engine introspection gauges,
+Prometheus ``/metrics`` export, spectator scrape/exact-merge
+aggregation, tail-kept traces — plus the satellite contracts:
+``_TimeSeries`` window expiry, ``_Histogram`` percentile accuracy at
+the documented ~9% bucket resolution, exact histogram merge, thread
+churn buffer hygiene, and seeded slow-log sampling.
+"""
+
+import json
+import logging
+import math
+import random
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from rocksplicator_tpu.observability.collector import SpanCollector
+from rocksplicator_tpu.observability.span import start_span
+from rocksplicator_tpu.storage.engine import (DB, DBOptions,
+                                              register_db_gauges,
+                                              unregister_db_gauges)
+from rocksplicator_tpu.storage.records import WriteBatch
+from rocksplicator_tpu.utils.stats import (Stats, _Histogram, _TimeSeries,
+                                           _WINDOW_SEC, _NUM_WINDOWS,
+                                           _prom_name,
+                                           histogram_state_percentile,
+                                           merge_histogram_states,
+                                           parse_prometheus_text,
+                                           split_tagged, tagged)
+
+
+# ---------------------------------------------------------------------------
+# _TimeSeries / _Histogram foundations (satellite: test coverage)
+# ---------------------------------------------------------------------------
+
+
+def test_timeseries_window_expiry():
+    ts = _TimeSeries()
+    t0 = 1_000_000.0
+    # fill far more windows than the retention bound
+    for w in range(_NUM_WINDOWS * 3):
+        ts.add(1.0, t0 + w * _WINDOW_SEC)
+    assert len(ts.buckets) <= _NUM_WINDOWS + 2
+    # expiry trims old windows, never the all-time total
+    assert ts.total == _NUM_WINDOWS * 3
+    now = t0 + (_NUM_WINDOWS * 3 - 1) * _WINDOW_SEC
+    # rate sees only the current window (previous fully elapsed at the
+    # window boundary contributes its unexpired fraction)
+    assert ts.rate_last_minute(now) <= 2.0
+    # a bucket older than the cutoff is gone
+    assert int(t0 // _WINDOW_SEC) not in ts.buckets
+
+
+def test_histogram_percentile_accuracy_within_bucket_resolution():
+    """Satellite acceptance: p50/p99 against a known distribution stay
+    within the documented ~9% relative bucket resolution (8 sub-buckets
+    per octave => upper-edge estimate in [true, true * 2^(1/8)])."""
+    rng = random.Random(42)
+    vals = [rng.lognormvariate(2.0, 1.5) for _ in range(20_000)]
+    h = _Histogram()
+    now = time.time()
+    for v in vals:
+        h.add(v, now)
+    svals = sorted(vals)
+    step = 2 ** (1 / 8)
+    for pct in (50.0, 90.0, 99.0):
+        k = math.ceil(len(svals) * pct / 100.0)
+        true = svals[k - 1]
+        est = h.percentile(pct, now)
+        assert true * 0.999 <= est <= true * step * 1.001, (
+            f"p{pct}: est {est} vs true {true}")
+
+
+def test_histogram_merge_is_exact():
+    """The spectator merge contract: merging two replicas' states is
+    bucket-for-bucket identical to one histogram that saw all samples,
+    so fleet percentiles are exactly as good as per-replica ones."""
+    rng = random.Random(7)
+    a_vals = [rng.expovariate(0.1) for _ in range(5_000)]
+    b_vals = [rng.expovariate(0.02) for _ in range(3_000)]
+    now = time.time()
+    ha, hb, hall = _Histogram(), _Histogram(), _Histogram()
+    for v in a_vals:
+        ha.add(v, now)
+        hall.add(v, now)
+    for v in b_vals:
+        hb.add(v, now)
+        hall.add(v, now)
+    merged = merge_histogram_states([ha.state(), hb.state()])
+    assert merged["buckets"] == hall.state()["buckets"]
+    assert merged["count"] == hall.count
+    assert merged["sum"] == pytest.approx(hall.sum)
+    for pct in (50.0, 99.0):
+        assert histogram_state_percentile(merged, pct) == \
+            histogram_state_percentile(hall.state(), pct) == \
+            hall.percentile(pct, now)
+
+
+def test_split_tagged_roundtrip():
+    name = tagged("storage.level_bytes", db="seg00001", level="3")
+    base, tags = split_tagged(name)
+    assert base == "storage.level_bytes"
+    assert tags == {"db": "seg00001", "level": "3"}
+    assert split_tagged("plain.name") == ("plain.name", {})
+
+
+# ---------------------------------------------------------------------------
+# Prometheus export
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_dump_parses_and_carries_values():
+    s = Stats.get()
+    s.incr("unit.prom_counter", 5)
+    s.incr(tagged("unit.prom_tagged", db="x"), 2)
+    for v in (1.0, 2.0, 4.0, 100.0):
+        s.add_metric("unit.prom_lat_ms", v)
+    s.add_gauge("unit.prom_gauge", lambda: 7.5)
+    text = s.dump_prometheus()
+    fams = parse_prometheus_text(text)
+    assert fams["rstpu_unit_prom_counter_total"][0][1] == 5.0
+    labels, val = fams["rstpu_unit_prom_tagged_total"][0]
+    assert labels == {"db": "x"} and val == 2.0
+    assert fams["rstpu_unit_prom_gauge"][0][1] == 7.5
+    # histogram: +Inf bucket == count, buckets cumulative & monotone
+    buckets = fams["rstpu_unit_prom_lat_ms_bucket"]
+    inf = [v for lbl, v in buckets if lbl.get("le") == "+Inf"]
+    assert inf == [4.0]
+    finite = [(float(lbl["le"]), v) for lbl, v in buckets
+              if lbl.get("le") != "+Inf"]
+    assert finite == sorted(finite)
+    assert all(b[1] <= a[1] for b, a in zip(finite, finite[1:]))
+    assert fams["rstpu_unit_prom_lat_ms_count"][0][1] == 4.0
+    assert fams["rstpu_unit_prom_lat_ms_sum"][0][1] == pytest.approx(107.0)
+    # TYPE headers present once per family
+    assert text.count("# TYPE rstpu_unit_prom_lat_ms histogram") == 1
+
+
+def test_prometheus_parser_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_prometheus_text("this is { not metrics\n")
+
+
+# ---------------------------------------------------------------------------
+# per-thread buffer hygiene (satellite: churn test)
+# ---------------------------------------------------------------------------
+
+
+def test_thread_churn_keeps_buffer_count_bounded():
+    """Short-lived threads (the run_in_executor pattern) must not grow
+    _all_buffers forever: dead threads' buffers are drained then reaped
+    on flush."""
+    s = Stats.get()
+
+    def worker(i):
+        s.incr("unit.churn")
+        s.add_metric("unit.churn_ms", float(i))
+
+    for batch in range(6):
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        s.flush()
+    s.flush()  # the flush after the drain prunes the last dead snapshots
+    with s._buffers_lock:
+        live = len(s._all_buffers)
+    assert live <= 3, f"dead-thread buffers accumulated: {live}"
+    # nothing was lost while reaping
+    assert s.get_counter("unit.churn") == 60
+    assert s.metric_count("unit.churn_ms") == 60
+
+
+# ---------------------------------------------------------------------------
+# SlowLogTimer seeded sampling (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_slow_log_timer_sampling_is_seeded(monkeypatch, caplog):
+    from rocksplicator_tpu.utils import timer as timer_mod
+
+    monkeypatch.setenv("RSTPU_RETRY_SEED", "123")
+
+    def run_once():
+        timer_mod.reset_slow_log_rng_for_test()
+        hits = []
+        with caplog.at_level(logging.WARNING,
+                             logger="rocksplicator_tpu.utils.timer"):
+            for i in range(40):
+                caplog.clear()
+                with timer_mod.SlowLogTimer("unit.slowlog_ms",
+                                            threshold_ms=0.0,
+                                            sample_rate=0.3):
+                    pass  # any elapsed > 0 crosses threshold 0
+                if caplog.records:
+                    hits.append(i)
+        return hits
+
+    first, second = run_once(), run_once()
+    assert first == second, "slow-log sampling not deterministic under seed"
+    assert first, "seed 123 never sampled in 40 draws at rate 0.3"
+    # a different seed produces a different schedule (not a constant)
+    monkeypatch.setenv("RSTPU_RETRY_SEED", "124")
+    assert run_once() != first
+
+
+# ---------------------------------------------------------------------------
+# engine introspection gauges
+# ---------------------------------------------------------------------------
+
+
+def test_engine_metrics_snapshot_and_gauges(tmp_path):
+    db = DB(str(tmp_path / "db"),
+            DBOptions(memtable_bytes=4 * 1024,
+                      level0_compaction_trigger=100,  # keep files in L0
+                      compression=0))
+    try:
+        for i in range(400):
+            db.write(WriteBatch().put(b"k%05d" % i, b"v" * 64))
+        db.flush()
+        for i in range(0, 400, 5):
+            db.get(b"k%05d" % i)
+        snap = db.metrics_snapshot(max_age=0.0)
+        assert sum(snap["level_files"]) >= 1
+        assert sum(snap["level_bytes"]) > 0
+        assert snap["gets_total"] == 80
+        assert snap["read_amp"] > 0  # flushed files were consulted
+        assert snap["bytes_flushed_total"] > 0
+        assert snap["memtable_bytes"] >= 0
+        # L0 over its (tiny) trigger => debt in bytes
+        db.set_options({"level0_compaction_trigger": 1})
+        snap2 = db.metrics_snapshot(max_age=0.0)
+        if sum(snap2["level_files"]) > 1:
+            assert snap2["compaction_debt_bytes"][0] > 0
+        # full compaction drives the write-amp numerator
+        db.compact_range()
+        snap3 = db.metrics_snapshot(max_age=0.0)
+        assert snap3["bytes_compacted_total"] > 0
+        assert snap3["write_amp"] > 0
+        # registration: every family lands on /stats and unregisters
+        names = register_db_gauges("unit00001", db)
+        s = Stats.get()
+        vals = s.gauge_values(prefixes=("storage.",))
+        assert tagged("storage.read_amp", db="unit00001") in vals
+        assert tagged("storage.level_files", db="unit00001",
+                      level="0") in vals
+        assert "storage.block_cache.hit_rate" in vals
+        unregister_db_gauges(names)
+        vals = s.gauge_values(prefixes=("storage.level_files",))
+        assert not vals
+    finally:
+        db.close()
+
+
+def test_metrics_snapshot_cache_coalesces_lock_passes(tmp_path):
+    db = DB(str(tmp_path / "db"), DBOptions())
+    try:
+        db.write(WriteBatch().put(b"a", b"1"))
+        s1 = db.metrics_snapshot()
+        db.write(WriteBatch().put(b"b", b"2"))
+        # within max_age the same snapshot object is returned
+        assert db.metrics_snapshot() is s1
+        assert db.metrics_snapshot(max_age=0.0) is not s1
+    finally:
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# replication plane: shard gauges + stats RPC + aggregation
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def leader_replicator(tmp_path):
+    from rocksplicator_tpu.replication import (ReplicaRole, Replicator,
+                                               StorageDbWrapper)
+
+    rep = Replicator(port=0)
+    dbs = []
+    for s in range(2):
+        name = f"mp{s:05d}"
+        db = DB(str(tmp_path / name), DBOptions())
+        dbs.append(db)
+        rep.add_db(name, StorageDbWrapper(db), ReplicaRole.LEADER,
+                   replication_mode=0)
+    yield rep, dbs
+    rep.stop()
+    for db in dbs:
+        db.close()
+
+
+def test_replicator_registers_and_removes_shard_gauges(leader_replicator):
+    rep, _dbs = leader_replicator
+    s = Stats.get()
+    port = str(rep.port)
+    lag = tagged("replicator.applied_seq_lag", db="mp00000", port=port)
+    depth = tagged("replicator.ack_window_depth", db="mp00000", port=port)
+    vals = s.gauge_values()
+    assert lag in vals and depth in vals
+    assert tagged("storage.read_amp", db="mp00000", port=port) in vals
+    rep.remove_db("mp00000")
+    vals = s.gauge_values()
+    assert lag not in vals and depth not in vals
+    # the other shard's gauges survive
+    assert tagged("replicator.applied_seq_lag", db="mp00001",
+                  port=port) in vals
+
+
+def test_stats_rpc_scrape_and_aggregate(leader_replicator):
+    from rocksplicator_tpu.cluster.stats_aggregator import \
+        ClusterStatsAggregator
+    from rocksplicator_tpu.rpc.ioloop import IoLoop
+
+    rep, _dbs = leader_replicator
+    for s in range(2):
+        for i in range(30):
+            rep.write(f"mp{s:05d}",
+                      WriteBatch().put(b"k%03d" % i, b"v" * 32))
+    ioloop = IoLoop.default()
+
+    async def read_some():
+        for i in range(20):
+            await rep._pool.call(
+                "127.0.0.1", rep.port, "read",
+                {"db_name": "mp00000", "op": "get",
+                 "keys": [b"k%03d" % i]}, timeout=5.0)
+
+    ioloop.run_sync(read_some(), timeout=30)
+    agg = ClusterStatsAggregator(pool=rep._pool, ioloop=ioloop)
+    cs = agg.scrape_and_aggregate([("127.0.0.1", rep.port)])
+    assert cs["replicas_scraped"] == 1
+    shard0 = cs["per_shard"]["mp00000"]
+    assert shard0["writes_total"] == 30
+    assert shard0["reads_total"] == 20
+    assert shard0["roles"] == {"LEADER": 1}
+    assert cs["per_shard"]["mp00001"]["writes_total"] == 30
+    # hot-spot ranking: the read+written shard outranks the write-only one
+    assert cs["hot_shards"][0]["db"] == "mp00000"
+    fleet = cs["fleet_latency_ms"]["reads.latency_ms"]["get"]
+    assert fleet["count"] == 20 and fleet["p99_ms"] > 0
+    assert cs["max_replication_lag"] == 0.0
+
+
+def test_aggregate_merges_endpoints_exactly():
+    """Synthetic two-replica merge: rates sum, lag is a max, debt is
+    worst-replica, histograms merge exactly."""
+    from rocksplicator_tpu.cluster.stats_aggregator import \
+        ClusterStatsAggregator
+
+    now = time.time()
+    ha, hb = _Histogram(), _Histogram()
+    for v in (1.0, 2.0, 3.0):
+        ha.add(v, now)
+    for v in (10.0, 20.0):
+        hb.add(v, now)
+    mk = lambda hist, lag, rate, debt: {
+        "counters": {
+            tagged("replicator.shard_reads", db="seg00000"):
+                {"total": 10.0, "rate_1m": rate},
+        },
+        "gauges": {
+            tagged("replicator.applied_seq_lag", db="seg00000",
+                   port="1"): lag,
+            tagged("storage.compaction_debt_bytes", db="seg00000",
+                   level="0", port="1"): debt,
+        },
+        "metrics": {
+            tagged("reads.latency_ms", op="get"): hist.state(),
+        },
+        "shard_roles": {"seg00000": "FOLLOWER"},
+    }
+    cs = ClusterStatsAggregator.aggregate(
+        {"h1:1": mk(ha, 5.0, 2.0, 100.0),
+         "h2:1": mk(hb, 9.0, 3.0, 40.0)})
+    rec = cs["per_shard"]["seg00000"]
+    assert rec["reads_total"] == 20.0
+    assert rec["read_rate_1m"] == 5.0
+    assert rec["max_applied_seq_lag"] == 9.0
+    assert rec["compaction_debt_bytes"] == 100.0  # worst replica, not sum
+    assert cs["max_replication_lag"] == 9.0
+    merged_all = merge_histogram_states([ha.state(), hb.state()])
+    assert cs["fleet_latency_ms"]["reads.latency_ms"]["get"]["count"] == 5
+    assert cs["fleet_latency_ms"]["reads.latency_ms"]["get"]["p99_ms"] == \
+        round(histogram_state_percentile(merged_all, 99), 3)
+
+
+# ---------------------------------------------------------------------------
+# tail-kept traces (tentpole layer 4)
+# ---------------------------------------------------------------------------
+
+
+def test_tail_keeps_slow_unsampled_root_and_drops_fast():
+    col = SpanCollector.get()
+    col.configure(sample_rate=0.0, tail_ms=30.0)
+    with start_span("unit.fast"):
+        pass
+    assert col.tail_kept == 0 and col.recorded == 0
+    with start_span("unit.slow", db="x") as sp:
+        assert not sp.sampled  # head-unsampled: children stay free
+        with start_span("unit.child") as child:
+            assert not child.sampled
+        time.sleep(0.05)
+    assert col.recorded == 0  # nothing entered the head ring
+    assert col.tail_kept == 1
+    snap = col.snapshot()
+    assert len(snap) == 1
+    d = snap[0]
+    assert d["name"] == "unit.slow"
+    assert d["annotations"]["tail_kept"] is True
+    assert d["annotations"]["db"] == "x"
+    assert d["duration_ms"] >= 30.0
+    # visible on the /traces surfaces
+    payload = json.loads(col.to_json_text())
+    assert payload["tail_kept"] == 1 and payload["tail_ms"] == 30.0
+    assert any(s["name"] == "unit.slow"
+               for t in payload["traces"] for s in t["spans"])
+    assert "tail_kept=1" in col.waterfall_text().splitlines()[0]
+
+
+def test_tail_keep_delay_failpoint_slow_write_appears_on_traces(tmp_path):
+    """Acceptance: head sampling at 0, an injected delay_ms failpoint
+    slow write is retained via the tail path and shows on /traces."""
+    from rocksplicator_tpu.replication import (ReplicaRole, Replicator,
+                                               StorageDbWrapper)
+    from rocksplicator_tpu.testing import failpoints as fp
+    from rocksplicator_tpu.utils.status_server import StatusServer
+
+    col = SpanCollector.get()
+    col.configure(sample_rate=0.0, tail_ms=40.0)
+    rep = Replicator(port=0)
+    db = DB(str(tmp_path / "db"), DBOptions())
+    status = StatusServer(port=0)
+    status.start()
+    try:
+        rdb = rep.add_db("tk00000", StorageDbWrapper(db),
+                         ReplicaRole.LEADER, replication_mode=0)
+        fp.activate("wal.append", "delay_ms:80")
+        try:
+            rdb.write(WriteBatch().put(b"slow", b"w"))
+        finally:
+            fp.deactivate("wal.append")
+        rdb.write(WriteBatch().put(b"fast", b"w"))  # under threshold
+        assert col.tail_kept == 1
+        kept = [d for d in col.snapshot()
+                if d["annotations"].get("tail_kept")]
+        assert len(kept) == 1
+        assert kept[0]["name"] == "repl.write"
+        assert kept[0]["duration_ms"] >= 40.0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/traces",
+                timeout=10) as resp:
+            traces = json.loads(resp.read().decode())
+        assert any(s["name"] == "repl.write"
+                   and s["annotations"].get("tail_kept")
+                   for t in traces["traces"] for s in t["spans"])
+    finally:
+        status.stop()
+        rep.stop()
+        db.close()
+
+
+def test_tail_exempts_longpoll_pulls_and_serves(tmp_path):
+    """A parked long-poll (server serve AND the pull's client RTT) is
+    slow BY DESIGN: without the tail_exempt contract an idle follower
+    would fill the tail ring with one fake outlier per poll cycle,
+    evicting the genuine slow writes the ring exists for."""
+    from rocksplicator_tpu.replication import (ReplicaRole,
+                                               ReplicationFlags,
+                                               Replicator,
+                                               StorageDbWrapper)
+
+    col = SpanCollector.get()
+    col.configure(sample_rate=0.0, tail_ms=50.0)
+    flags = ReplicationFlags(server_long_poll_ms=200,
+                             pull_error_delay_min_ms=50,
+                             pull_error_delay_max_ms=100)
+    leader = Replicator(port=0, flags=flags)
+    follower = Replicator(port=0, flags=flags)
+    ldb = DB(str(tmp_path / "L"), DBOptions())
+    fdb = DB(str(tmp_path / "F"), DBOptions())
+    try:
+        leader.add_db("lp00000", StorageDbWrapper(ldb),
+                      ReplicaRole.LEADER, replication_mode=1)
+        follower.add_db("lp00000", StorageDbWrapper(fdb),
+                        ReplicaRole.FOLLOWER,
+                        upstream_addr=("127.0.0.1", leader.port),
+                        replication_mode=1)
+        leader.write("lp00000", WriteBatch().put(b"k", b"v"))
+        # several 200ms poll cycles park and expire while idle
+        time.sleep(1.0)
+        kept = [d["name"] for d in col.snapshot()
+                if d["annotations"].get("tail_kept")]
+        assert kept == [], f"long-poll waits tail-kept: {kept}"
+    finally:
+        leader.stop()
+        follower.stop()
+        ldb.close()
+        fdb.close()
+
+
+def test_tail_disabled_and_kill_switch_take_noop_path():
+    col = SpanCollector.get()
+    col.configure(sample_rate=0.0, tail_ms=0.0)
+    with start_span("unit.slowish"):
+        time.sleep(0.02)
+    assert col.tail_kept == 0
+    col.configure(tail_ms=5.0)
+    col.enabled = False  # RSTPU_TRACING=0 equivalent
+    with start_span("unit.slowish"):
+        time.sleep(0.02)
+    assert col.tail_kept == 0
+    col.enabled = True
+
+
+def test_tail_unsampled_overhead_smoke():
+    """With tail-keep ARMED (the default) but nothing slow, the
+    per-root cost stays in the same near-free band as the NOOP path —
+    one small object + two clock reads."""
+    col = SpanCollector.get()
+    col.configure(sample_rate=0.0, tail_ms=100.0)
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with start_span("hot.op", db="x"):
+            pass
+    per_op_us = (time.perf_counter() - t0) / n * 1e6
+    assert col.recorded == 0 and col.tail_kept == 0
+    assert per_op_us < 50.0, f"armed tail-keep root cost {per_op_us:.1f}µs"
+
+
+# ---------------------------------------------------------------------------
+# the metrics-smoke CI gate, in tier-1 (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_smoke_end_to_end():
+    from tools.metrics_smoke import run_smoke
+
+    report = run_smoke(shards=2, keys=60, log=lambda *a, **k: None)
+    assert report["failures"] == []
+    served = report["cluster_stats"]
+    assert served["histogram_merge"] == "exact-log-bucket"
+    assert served["max_replication_lag"] == 0.0

@@ -1132,6 +1132,30 @@ def _check_read_invariants(cluster: FailoverCluster, acked, tag,
                         f"{node.name} returned {got!r} (want {val!r})")
 
 
+def _gauge_snapshot(tag: str) -> Dict:
+    """Round-14 state picture recorded in the artifact after each
+    schedule: per-shard replication lag, ack-window occupancy, and
+    compaction debt (the chaos clusters are in-process, so the gauges
+    live on this process's Stats registry). An invariant violation now
+    ships WITH the cluster's load/debt state at check time instead of
+    leaving the reproducer to re-derive it."""
+    from rocksplicator_tpu.utils.stats import Stats
+
+    gauges = Stats.get().gauge_values(prefixes=(
+        "replicator.applied_seq_lag",
+        "replicator.ack_window_depth",
+        "storage.compaction_debt_bytes",
+        "storage.memtable_bytes",
+    ))
+    # debt gauges are per level — drop the all-zero ones so the
+    # snapshot stays readable at 7 levels x N shards
+    return {
+        "schedule": tag,
+        "gauges": {k: round(v, 1) for k, v in sorted(gauges.items())
+                   if v or not k.startswith("storage.compaction_debt")},
+    }
+
+
 def run_failover_chaos(
     root: str,
     schedules: int = 15,
@@ -1156,6 +1180,7 @@ def run_failover_chaos(
                      "passes_used": [], "window_acked": 0,
                      "reads_checked": 0, "reads_served": 0,
                      "read_bounces": 0}
+    gauge_snapshots: List[Dict] = []
     fp.clear()
     t_setup = time.monotonic()
     cluster = FailoverCluster(root)
@@ -1180,6 +1205,7 @@ def run_failover_chaos(
             # rules hold on every replica once the schedule healed
             _check_read_invariants(cluster, acked, tag, violations,
                                    timings)
+            gauge_snapshots.append(_gauge_snapshot(tag))
             log(f"  [{si + 1}/{len(deck)}] {kind}: acked={len(acked)} "
                 f"reads={timings['reads_served']}"
                 f"/{timings['reads_checked']} "
@@ -1216,6 +1242,7 @@ def run_failover_chaos(
         "reads_checked": timings["reads_checked"],
         "reads_served": timings["reads_served"],
         "read_bounces": timings["read_bounces"],
+        "gauge_snapshots": gauge_snapshots,
         "failpoint_trips": fp.trip_counts(),
         "break_guard": break_guard,
     }
@@ -1252,6 +1279,7 @@ def run_chaos(
         os.environ["RSTPU_TRANSPORT"] = transport
     undo = _break_guard(break_guard) if break_guard else None
     violations: List[str] = []
+    gauge_snapshots: List[Dict] = []
     acked_total = 0
     write_total = 0
     fp.clear()
@@ -1315,6 +1343,7 @@ def run_chaos(
                     f"reconvergence, first {lost[0]} (faults {faults})")
             if ingest_every and si % ingest_every == ingest_every - 1:
                 ingest.step(rng, violations, tag)
+            gauge_snapshots.append(_gauge_snapshot(tag))
             log(f"  [{si + 1}/{schedules}] faults={faults} "
                 f"writes={n_writes} acked={len(acked)} "
                 f"errors={write_errors} "
@@ -1340,6 +1369,7 @@ def run_chaos(
         "writes": write_total,
         "acked": acked_total,
         "violations": violations,
+        "gauge_snapshots": gauge_snapshots,
         "failpoint_trips": fp.trip_counts(),
         "break_guard": break_guard,
     }

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Metrics-plane smoke (round 14, CI `make metrics-smoke`): boot one
+replica, exercise the engine + read/write RPC paths, then validate the
+whole observability plane end to end:
+
+- ``/metrics`` (StatusServer) parses as Prometheus text exposition and
+  contains EVERY registered gauge family (engine level/amp/debt gauges,
+  replication lag + ack-window occupancy, block-cache hit rate);
+- ``/stats.json`` parses and round-trips the exact histogram states;
+- the ``stats`` RPC + spectator aggregation path produces a
+  ``/cluster_stats`` document with per-shard rates, max lag, and fleet
+  per-op-class percentiles from the exact log-bucket histogram merge.
+
+Runs in-process in a few seconds; any missing family, unparseable line,
+or empty aggregate exits nonzero. Also exercised by tier-1
+(tests/test_metrics_plane.py) so a regression fails fast.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the gauge families every replica must export (base dotted names)
+REQUIRED_GAUGE_FAMILIES = [
+    "storage.level_files",
+    "storage.level_bytes",
+    "storage.compaction_debt_bytes",
+    "storage.memtable_bytes",
+    "storage.wal_backlog_bytes",
+    "storage.unflushed_seqs",
+    "storage.read_amp",
+    "storage.write_amp",
+    "storage.block_cache.hit_rate",
+    "replicator.applied_seq_lag",
+    "replicator.ack_window_depth",
+]
+
+
+def _http_get(port: int, path: str) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.read().decode("utf-8")
+
+
+def run_smoke(shards: int = 2, keys: int = 200, log=print) -> Dict:
+    from rocksplicator_tpu.cluster.stats_aggregator import \
+        ClusterStatsAggregator
+    from rocksplicator_tpu.replication import (ReplicaRole, Replicator,
+                                               StorageDbWrapper)
+    from rocksplicator_tpu.rpc.ioloop import IoLoop
+    from rocksplicator_tpu.storage.engine import DB, DBOptions
+    from rocksplicator_tpu.storage.records import WriteBatch
+    from rocksplicator_tpu.utils.segment_utils import segment_to_db_name
+    from rocksplicator_tpu.utils.stats import (Stats, _prom_name,
+                                               parse_prometheus_text)
+    from rocksplicator_tpu.utils.status_server import StatusServer
+
+    failures: List[str] = []
+    root = tempfile.mkdtemp(prefix="rstpu-metrics-smoke-")
+    replicator = Replicator(port=0)
+    status = StatusServer(port=0)
+    status.start()
+    dbs = []
+    ioloop = IoLoop.default()
+    try:
+        # one replica, `shards` dbs: writes drive flush, reads drive the
+        # read-amp accounting AND the reads.latency_ms histograms (via
+        # the real read RPC, so the fleet merge has op classes to show)
+        for s in range(shards):
+            name = segment_to_db_name("msk", s)
+            db = DB(os.path.join(root, name),
+                    DBOptions(memtable_bytes=8 * 1024))
+            dbs.append(db)
+            replicator.add_db(name, StorageDbWrapper(db),
+                              ReplicaRole.LEADER, replication_mode=0)
+        for s in range(shards):
+            name = segment_to_db_name("msk", s)
+            for i in range(keys):
+                replicator.write(
+                    name, WriteBatch().put(b"k%05d" % i, b"v" * 64))
+            dbs[s].flush()
+
+        async def read_some():
+            for s in range(shards):
+                for i in range(0, keys, 7):
+                    await replicator._pool.call(
+                        "127.0.0.1", replicator.port, "read",
+                        {"db_name": segment_to_db_name("msk", s),
+                         "op": "get", "keys": [b"k%05d" % i]},
+                        timeout=5.0)
+
+        ioloop.run_sync(read_some(), timeout=60)
+
+        # -- /metrics: parseable + every family present ----------------
+        metrics_text = _http_get(status.port, "/metrics")
+        families = parse_prometheus_text(metrics_text)
+        for base in REQUIRED_GAUGE_FAMILIES:
+            if _prom_name(base) not in families:
+                failures.append(f"/metrics missing gauge family {base!r} "
+                                f"({_prom_name(base)})")
+        for counter in ("replicator.shard_writes", "replicator.shard_reads"):
+            if _prom_name(counter) + "_total" not in families:
+                failures.append(f"/metrics missing counter family "
+                                f"{counter!r}")
+        hist = _prom_name("reads.latency_ms")
+        if f"{hist}_bucket" not in families or f"{hist}_count" not in families:
+            failures.append("/metrics missing reads.latency_ms histogram "
+                            "lines")
+        log(f"  /metrics: {len(metrics_text.splitlines())} lines, "
+            f"{len(families)} families, all required present="
+            f"{not failures}")
+
+        # -- /stats.json parses ----------------------------------------
+        state = json.loads(_http_get(status.port, "/stats.json"))
+        if not state.get("gauges"):
+            failures.append("/stats.json has no gauges")
+
+        # -- spectator aggregation -> /cluster_stats -------------------
+        agg = ClusterStatsAggregator(pool=replicator._pool, ioloop=ioloop)
+        cluster_stats = agg.scrape_and_aggregate(
+            [("127.0.0.1", replicator.port)])
+        status.register_endpoint(
+            "/cluster_stats", lambda: json.dumps(cluster_stats, indent=1))
+        served = json.loads(_http_get(status.port, "/cluster_stats"))
+        if served.get("replicas_scraped") != 1:
+            failures.append("cluster_stats scraped != 1 replica")
+        per_shard = served.get("per_shard") or {}
+        if len(per_shard) != shards:
+            failures.append(
+                f"cluster_stats per_shard has {len(per_shard)} shards, "
+                f"want {shards}")
+        for name, rec in per_shard.items():
+            if rec.get("writes_total", 0) <= 0:
+                failures.append(f"shard {name}: no writes recorded")
+        fleet = (served.get("fleet_latency_ms") or {}).get(
+            "reads.latency_ms") or {}
+        if "get" not in fleet:
+            failures.append("fleet_latency_ms missing the get op class")
+        log(f"  /cluster_stats: {len(per_shard)} shards, "
+            f"fleet get p99={fleet.get('get', {}).get('p99_ms')}ms")
+        return {
+            "failures": failures,
+            "metrics_families": len(families),
+            "cluster_stats": served,
+        }
+    finally:
+        status.stop()
+        replicator.stop()
+        for db in dbs:
+            db.close()
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    report = run_smoke()
+    if report["failures"]:
+        for msg in report["failures"]:
+            print(f"metrics-smoke: FAILURE: {msg}", file=sys.stderr)
+        return 1
+    print(f"metrics-smoke: OK ({report['metrics_families']} metric "
+          f"families, {len(report['cluster_stats']['per_shard'])} shards "
+          f"aggregated)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
