@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke metrics-smoke chaos-smoke chaos-failover-smoke clean
+.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke metrics-smoke chaos-smoke chaos-failover-smoke reshard-smoke clean
 
 # rstpu-check: the three-pass static suite (lock-order/blocking-under-
 # lock, event-loop blocking, failpoint/span/stats registries) over
@@ -91,6 +91,17 @@ macro-bench-smoke:
 		--ab_reps 1 --ab_readers 4 \
 		--out benchmarks/results/macro_bench_smoke.json
 
+# round-15 live-move macro-bench smoke (~1 min): the mixed-workload
+# bench with a 4th spare node and ONE live shard move (snapshot →
+# bulk-ingest → WAL-tail catch-up → paused epoch-stamped cutover) of
+# shard 0's leader launched mid-phase; the artifact records get p99
+# before/during/after the flip and fails loudly if the move fails,
+# reads stop serving during it, or reads/writes don't resume after
+macro-bench-move-smoke:
+	$(PY) bench.py --macro_bench --shards 2 --preload_keys 400 \
+		--rates 150 --duration 3 --move_mid_bench \
+		--out benchmarks/results/macro_bench_move_smoke.json
+
 # round-14 metrics-plane smoke (<10s): boots one replica in-process,
 # scrapes /metrics + /cluster_stats, validates Prometheus text-format
 # parseability, the presence of every registered gauge family (engine
@@ -147,6 +158,24 @@ chaos-failover-smoke:
 		--out benchmarks/results/chaos_failover_smoke.json
 	$(PY) -m tools.chaos_soak --failover --schedules 1 --seed 7 \
 		--break-guard fencing --expect-violation
+
+# live-shard-move chaos smoke (~45s): 3 seeded reshard schedules (4
+# nodes / 3 replicas; the move step machine killed at its seams,
+# participants killed mid-move, coordinator faults) each holding the
+# SIXTH standing invariant — exactly one serving lineage per shard,
+# zero acked-write loss across the move, bounded convergence, no
+# stranded replicas — then the move_flip tooth: a cutover patched to
+# force-promote without drain/demote must be CAUGHT by the lineage
+# probes (--expect-violation). Full deck: --reshard --schedules 15
+# (artifact: benchmarks/results/chaos_reshard.json). A violation
+# prints the reproducing --seed.
+reshard-smoke:
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --reshard \
+		--schedules 3 --seed 1 \
+		--out benchmarks/results/chaos_reshard_smoke.json
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --reshard \
+		--schedules 1 --seed 7 \
+		--break-guard move_flip --expect-violation
 
 clean:
 	$(MAKE) -C rocksplicator_tpu/storage/native clean

@@ -204,11 +204,29 @@ def serve(args) -> int:
                 if args.upstream_port else None)
     replicator = Replicator(port=args.port, flags=flags,
                             executor_threads=args.executor_threads)
+    handler = admin_server = None
+    db_options = lambda _seg: DBOptions(wal_ttl_seconds=3600.0)  # noqa: E731
+    if args.admin_port:
+        # the live-move variant: this replica also speaks the Admin RPC
+        # plane (backup/restore/pause/role-change) so a DirectShardMove
+        # can relocate a shard mid-bench; restored dbs must come up in
+        # the same semi-sync mode the bench registers explicitly
+        from rocksplicator_tpu.admin.handler import AdminHandler
+        from rocksplicator_tpu.rpc.server import RpcServer
+        from rocksplicator_tpu.utils.dbconfig import DBConfigManager
+
+        DBConfigManager.get().load_from_dict(
+            {SEGMENT: {"replication_mode": 1}})
+        handler = AdminHandler(args.db_dir, replicator,
+                               options_generator=db_options)
+        admin_server = RpcServer(port=args.admin_port,
+                                 ioloop=replicator.ioloop)
+        admin_server.add_handler(handler)
+        admin_server.start()
     dbs = []
     for s in range(args.shards):
         name = segment_to_db_name(SEGMENT, s)
-        db = DB(os.path.join(args.db_dir, name),
-                DBOptions(wal_ttl_seconds=3600.0))
+        db = DB(os.path.join(args.db_dir, name), db_options(SEGMENT))
         if role is ReplicaRole.LEADER and args.preload_keys:
             # preload BEFORE replication registration: engine writes go
             # straight to the WAL, followers replay them on first pull
@@ -224,8 +242,19 @@ def serve(args) -> int:
             if batch is not None:
                 db.write(batch)
         dbs.append(db)
-        replicator.add_db(name, StorageDbWrapper(db), role,
-                          upstream_addr=upstream, replication_mode=1)
+        if handler is not None:
+            # register through the admin plane (ApplicationDB) so move
+            # RPCs and the replication plane see the same instance
+            from rocksplicator_tpu.admin.application_db import \
+                ApplicationDB
+
+            app_db = ApplicationDB(name, db, role, replicator=replicator,
+                                   upstream_addr=upstream,
+                                   replication_mode=1)
+            handler.db_manager.add_db(name, app_db)
+        else:
+            replicator.add_db(name, StorageDbWrapper(db), role,
+                              upstream_addr=upstream, replication_mode=1)
     print(f"READY role={args.serve} port={replicator.port} "
           f"shards={args.shards}", flush=True)
     stop = threading.Event()
@@ -235,9 +264,14 @@ def serve(args) -> int:
             pass
     except KeyboardInterrupt:
         pass
+    if admin_server is not None:
+        admin_server.stop()
+    if handler is not None:
+        handler.close()
     replicator.stop()
     for db in dbs:
-        db.close()
+        if handler is None:
+            db.close()  # admin-managed dbs were closed by handler.close
     return 0
 
 
@@ -274,24 +308,33 @@ def build_router(ports: List[int], shards: int):
 
 class Cluster:
     """1 leader + 2 followers as OS processes, plus the router/pool the
-    driver issues RPCs through."""
+    driver issues RPCs through. With ``with_move_node`` the children
+    also serve the Admin RPC plane and a 4th (initially empty) node is
+    spawned — the destination a mid-bench DirectShardMove relocates a
+    shard onto."""
 
     def __init__(self, root: str, shards: int, preload_keys: int,
                  value_bytes: int, write_window: int,
                  read_info_ttl_ms: int, transport: str,
-                 executor_threads: int):
+                 executor_threads: int, with_move_node: bool = False):
         self.shards = shards
+        self.with_move_node = with_move_node
         self.procs: List[subprocess.Popen] = []
-        self.ports = [reserve_port() for _ in range(3)]
+        n = 4 if with_move_node else 3
+        self.ports = [reserve_port() for _ in range(n)]
+        self.admin_ports = ([reserve_port() for _ in range(n)]
+                            if with_move_node else [])
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    RSTPU_TRANSPORT=transport)
         env.pop("PALLAS_AXON_POOL_IPS", None)
 
-        def spawn(role: str, port: int, upstream: int) -> subprocess.Popen:
+        def spawn(role: str, idx: int, upstream: int,
+                  node_shards: int) -> subprocess.Popen:
+            port = self.ports[idx]
             cmd = [
                 sys.executable, "-m", "benchmarks.macro_bench",
                 "--serve", role, "--port", str(port),
-                "--shards", str(shards),
+                "--shards", str(node_shards),
                 "--db_dir", os.path.join(root, f"{role}{port}"),
                 "--preload_keys", str(preload_keys),
                 "--value_bytes", str(value_bytes),
@@ -299,6 +342,8 @@ class Cluster:
                 "--read_info_ttl_ms", str(read_info_ttl_ms),
                 "--executor_threads", str(executor_threads),
             ]
+            if self.admin_ports:
+                cmd += ["--admin_port", str(self.admin_ports[idx])]
             if upstream:
                 cmd += ["--upstream_port", str(upstream)]
             return subprocess.Popen(
@@ -307,18 +352,51 @@ class Cluster:
                 cwd=os.path.dirname(os.path.dirname(
                     os.path.abspath(__file__))))
 
-        self.procs.append(spawn("leader", self.ports[0], 0))
+        self.procs.append(spawn("leader", 0, 0, shards))
         self._wait_ready(self.procs[0], "leader")
         for i in (1, 2):
-            self.procs.append(spawn("follower", self.ports[i],
-                                    self.ports[0]))
+            self.procs.append(spawn("follower", i, self.ports[0],
+                                    shards))
+        if with_move_node:
+            # the move destination: admin plane up, zero shards hosted
+            self.procs.append(spawn("follower", 3, self.ports[0], 0))
         for p in self.procs[1:]:
             self._wait_ready(p, "follower")
 
         # per-process transport policy must match the children's
         os.environ["RSTPU_TRANSPORT"] = transport
         self.ioloop, self.pool, self.router = build_router(
-            self.ports, shards)
+            self.ports[:3], shards)
+
+    def apply_move_layout(self, shard: int, new_leader_idx: int) -> None:
+        """Re-teach the driver's router after a completed shard move:
+        ``shard``'s leader is now node ``new_leader_idx`` (what the
+        shardmap-agent file refresh does for real clients)."""
+        from rocksplicator_tpu.rpc.router import ClusterLayout
+
+        layout: Dict = {SEGMENT: {"num_shards": self.shards}}
+        marks = {0: "M", 1: "S", 2: "S", 3: None}
+        for i, port in enumerate(self.ports):
+            entries = []
+            for s in range(self.shards):
+                if s == shard:
+                    # moved shard: leader on the new node, the two
+                    # surviving followers unchanged, old leader retired
+                    if i == new_leader_idx:
+                        mark = "M"
+                    elif i in (1, 2):
+                        mark = "S"
+                    else:
+                        mark = None
+                else:
+                    mark = marks[i]
+                if mark:
+                    entries.append(f"{s:05d}:{mark}")
+            if entries:
+                layout[SEGMENT][
+                    f"127.0.0.1:{port}:az-n{i}:{port}"] = entries
+        self.router.update_layout(
+            ClusterLayout.parse(json.dumps(layout).encode()))
 
     @staticmethod
     def _wait_ready(proc: subprocess.Popen, what: str,
@@ -369,7 +447,9 @@ class Cluster:
                 timeout=5.0)
 
         deadline = time.monotonic() + timeout
-        for port in self.ports[1:]:
+        # replicas only — the move-phase spare node (ports[3]) hosts
+        # nothing until a move lands on it
+        for port in self.ports[1:3]:
             for shard, gid in sorted(last_gids.items()):
                 while True:
                     try:
@@ -445,7 +525,8 @@ async def _run_open_loop(cluster: Cluster, policy, rate: float,
                          duration: float, total_keys: int,
                          value_bytes: int, mix: Dict[str, float],
                          seed: int, max_inflight: int,
-                         server_get_sink: Optional[List[float]] = None
+                         server_get_sink: Optional[List[float]] = None,
+                         sample_log: Optional[List] = None
                          ) -> PhaseResult:
     from rocksplicator_tpu.rpc.errors import RpcError
     from rocksplicator_tpu.storage import WriteBatch
@@ -507,11 +588,18 @@ async def _run_open_loop(cluster: Cluster, policy, rate: float,
                             res.value_mismatches += 1
             except RpcError:
                 res.errors[op] += 1
+                if sample_log is not None:
+                    sample_log.append((loop.time(), op, None))
                 return
             # OPEN-LOOP latency: completion minus INTENDED arrival, so
             # dispatcher/queue delay counts against the server, not the
             # next request's budget
-            res.lat[op].append((loop.time() - intended) * 1000.0)
+            lat_ms = (loop.time() - intended) * 1000.0
+            res.lat[op].append(lat_ms)
+            if sample_log is not None:
+                # (completion time, op, latency) — the move phase
+                # windows samples into before/during/after the flip
+                sample_log.append((loop.time(), op, lat_ms))
 
     t0 = loop.time()
     tasks = []
@@ -549,6 +637,96 @@ def run_phase(cluster: Cluster, policy, rate: float, duration: float,
                        server_get_sink=server_get_sink),
         timeout=duration + 120)
     return res.summarize(rate, duration)
+
+
+def run_move_phase(cluster: Cluster, root: str, policy, rate: float,
+                   duration: float, total_keys: int, value_bytes: int,
+                   mix: Dict[str, float], seed: int,
+                   max_inflight: int) -> Dict:
+    """One long open-loop phase (3 windows of ``duration``) with a LIVE
+    leader move of shard 0 onto the spare node launched at the 1/3
+    mark: snapshot → bulk-ingest → WAL-tail catch-up → paused cutover →
+    epoch-stamped promote (DirectShardMove). Samples are windowed into
+    before/during/after the move so the artifact records what a live
+    move costs the serving p99 — the acceptance number for this
+    scenario. Reads keep serving throughout (bounded-staleness reads
+    bounce off the moving replica to its peers); writes see a brief
+    WRITE_PAUSED/repoint window, counted as errors, then resume on the
+    new leader."""
+    from rocksplicator_tpu.cluster.shard_move import (DirectMovePlan,
+                                                      DirectNode,
+                                                      DirectShardMove,
+                                                      MoveFlags)
+    from rocksplicator_tpu.utils.segment_utils import segment_to_db_name
+
+    sample_log: List = []
+    move_info: Dict = {}
+
+    def node(i: int) -> DirectNode:
+        return DirectNode("127.0.0.1", cluster.admin_ports[i],
+                          cluster.ports[i])
+
+    def mover():
+        time.sleep(duration)
+        move_info["t_start"] = time.monotonic()
+        try:
+            plan = DirectMovePlan(
+                db_name=segment_to_db_name(SEGMENT, 0),
+                source=node(0), target=node(3), leader=node(0),
+                followers=[node(1), node(2)],
+                store_uri=os.path.join(root, "move-bucket"))
+            timings = DirectShardMove(plan, flags=MoveFlags(
+                catchup_lag_threshold=32, catchup_timeout=60.0,
+                cutover_pause_ms=3000.0, poll_interval=0.05)).run()
+            move_info.update(ok=True, timings_ms=timings)
+        except Exception as e:
+            move_info.update(ok=False, error=repr(e))
+        move_info["t_end"] = time.monotonic()
+        if move_info.get("ok"):
+            # what the shardmap-agent file refresh does for real
+            # clients: shard 0's leader is the spare node now
+            cluster.apply_move_layout(0, 3)
+
+    th = threading.Thread(target=mover, name="bench-mover", daemon=True)
+    th.start()
+    res = cluster.ioloop.run_sync(
+        _run_open_loop(cluster, policy, rate, duration * 3, total_keys,
+                       value_bytes, mix, seed, max_inflight,
+                       sample_log=sample_log),
+        timeout=duration * 3 + 180)
+    th.join(timeout=120)
+    t_start = move_info.get("t_start")
+    t_end = move_info.get("t_end")
+    inf = float("inf")
+    windows: Dict[str, Dict] = {}
+    for name, lo, hi in (("before", -inf, t_start or inf),
+                         ("during", t_start or inf, t_end or inf),
+                         ("after", t_end or inf, inf)):
+        gets = sorted(lat for ts, op, lat in sample_log
+                      if op == "get" and lat is not None
+                      and lo <= ts < hi)
+        windows[name] = {
+            "get_count": len(gets),
+            "get_errors": sum(1 for ts, op, lat in sample_log
+                              if op == "get" and lat is None
+                              and lo <= ts < hi),
+            "get_p50_ms": round(percentile(gets, 50), 3) if gets else None,
+            "get_p99_ms": round(percentile(gets, 99), 3) if gets else None,
+            "put_count": sum(1 for ts, op, lat in sample_log
+                             if op == "put" and lat is not None
+                             and lo <= ts < hi),
+            "put_errors": sum(1 for ts, op, lat in sample_log
+                              if op == "put" and lat is None
+                              and lo <= ts < hi),
+        }
+    return {
+        "move": {k: move_info.get(k)
+                 for k in ("ok", "error", "timings_ms")},
+        "move_duration_ms": (round((t_end - t_start) * 1000.0, 1)
+                             if t_start and t_end else None),
+        "windows": windows,
+        "phase": res.summarize(rate, duration * 3),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -762,6 +940,9 @@ def main(argv=None) -> int:
     p.add_argument("--serve", choices=["leader", "follower"])
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--upstream_port", type=int, default=0)
+    p.add_argument("--admin_port", type=int, default=0,
+                   help="serve: also run the Admin RPC plane on this "
+                        "port (required for mid-bench shard moves)")
     p.add_argument("--db_dir")
     p.add_argument("--ab_worker", choices=["leader_only", "follower_ok"])
     p.add_argument("--ports", help="ab_worker: leader,f1,f2 ports")
@@ -795,6 +976,14 @@ def main(argv=None) -> int:
                    help="A/B client fleet size (worker PROCESSES per "
                         "variant; 0 = derive from cpu count)")
     p.add_argument("--ab_reps", type=int, default=3)
+    p.add_argument("--move_mid_bench", action="store_true",
+                   help="spawn a 4th (spare) node and run one LIVE "
+                        "shard move (shard 0's leader onto it) in the "
+                        "middle of a 3-window phase, recording get p99 "
+                        "before/during/after the flip")
+    p.add_argument("--move_rate", type=float, default=0.0,
+                   help="offered ops/s for the move phase (0 = first "
+                        "sweep rate)")
     p.add_argument("--out", help="write the artifact JSON here")
     args = p.parse_args(argv)
 
@@ -852,7 +1041,8 @@ def main(argv=None) -> int:
         cluster = Cluster(root, args.shards, args.preload_keys,
                           args.value_bytes, args.write_window,
                           args.read_info_ttl_ms, args.transport,
-                          args.executor_threads)
+                          args.executor_threads,
+                          with_move_node=args.move_mid_bench)
         cluster.wait_catchup(total_keys)
         result["host_calibration"] = host_calibration(root)
         sweep = []
@@ -887,6 +1077,22 @@ def main(argv=None) -> int:
             f"server-side "
             f"{result['p99_agreement'].get('bench_server_get_p99_ms')}ms "
             f"(within={result['p99_agreement'].get('within')})")
+        if args.move_mid_bench:
+            move_rate = args.move_rate or rates[0]
+            log(f"macro_bench: LIVE shard move mid-bench (shard 0 "
+                f"leader -> spare node) under {move_rate}/s mixed load")
+            result["shard_move"] = run_move_phase(
+                cluster, root, policy, move_rate, args.duration,
+                total_keys, args.value_bytes, mix, args.seed + 9001,
+                args.max_inflight)
+            result["config"]["move_mid_bench"] = True
+            mv = result["shard_move"]
+            w = mv["windows"]
+            log(f"  move ok={mv['move'].get('ok')} "
+                f"phases={mv['move'].get('timings_ms')} — get p99 "
+                f"before/during/after = {w['before']['get_p99_ms']}/"
+                f"{w['during']['get_p99_ms']}/{w['after']['get_p99_ms']}"
+                f" ms (put errors during: {w['during']['put_errors']})")
         if args.ab:
             log(f"macro_bench: read A/B leader_only vs follower_ok"
                 f"(max_lag={args.max_lag}) x {args.ab_reps} reps, "
@@ -930,6 +1136,19 @@ def main(argv=None) -> int:
         failures.append(
             f"cluster_stats scraped only {cs.get('replicas_scraped')}/3 "
             f"replicas")
+    if args.move_mid_bench:
+        mv = result.get("shard_move") or {}
+        if not (mv.get("move") or {}).get("ok"):
+            failures.append(
+                f"mid-bench shard move failed: "
+                f"{(mv.get('move') or {}).get('error')}")
+        else:
+            w = mv["windows"]
+            if not w["during"]["get_count"]:
+                failures.append("no reads served DURING the live move")
+            if not w["after"]["get_count"] or not w["after"]["put_count"]:
+                failures.append(
+                    "reads/writes did not resume after the move flip")
     agr = result.get("p99_agreement") or {}
     if agr.get("checked") and not agr.get("within"):
         failures.append(

@@ -298,12 +298,34 @@ class AdminHandler:
                     )
             except OSError:
                 pass
+            rdb = app_db.replicated_db
             return {
                 "seq_num": seq,
                 "last_update_timestamp_ms": last_ts,
                 "oldest_wal_timestamp_ms": oldest_wal_ts,
+                # needRebuildDB's WAL-availability input: a rebuilding
+                # peer below this seq cannot WAL-catch-up from us
+                "oldest_wal_seq": app_db.db.oldest_wal_seq(),
                 "db_size_bytes": app_db.db.approximate_disk_size(),
                 "role": app_db.role.value,
+                # live shard moves read these: the direct (coordinator-
+                # less) mover mints its cutover epoch from the shard's
+                # live one, and verifies the pause it armed
+                "epoch": rdb.epoch if rdb is not None else 0,
+                "write_paused": (rdb.write_paused
+                                 if rdb is not None else False),
+                # a puller whose position predates its upstream's WAL:
+                # the participant loop converts this into a snapshot
+                # rebuild (pulling can never catch it up)
+                "pull_stalled_wal_gap": bool(
+                    rdb is not None
+                    and getattr(rdb, "pull_stalled_wal_gap", False)),
+                # a follower persistently AHEAD of its leader's commit
+                # point: divergent suffix — the participant loop clears
+                # + rejoins it (the follower analog of deposed resync)
+                "pull_diverged": bool(
+                    rdb is not None
+                    and getattr(rdb, "pull_diverged", False)),
             }
 
         return await self._run(collect)
@@ -409,6 +431,42 @@ class AdminHandler:
         await self._run(do)
         return {}
 
+    async def handle_check_pull_stall(self, db_name: str = "") -> dict:
+        """Flags-only sibling of check_db for the participant's 5s
+        stall-heal probe: two booleans read straight off the
+        ReplicatedDB, no disk I/O (check_db walks the WAL dir and the
+        db directory — too heavy to run per follower shard per tick)."""
+        app_db = self._get_app_db(db_name)
+        rdb = app_db.replicated_db
+        return {
+            "role": app_db.role.value,
+            "pull_stalled_wal_gap": bool(
+                rdb is not None
+                and getattr(rdb, "pull_stalled_wal_gap", False)),
+            "pull_diverged": bool(
+                rdb is not None
+                and getattr(rdb, "pull_diverged", False)),
+        }
+
+    async def handle_pause_db_writes(
+        self, db_name: str = "", duration_ms: float = 0.0
+    ) -> dict:
+        """Arm (or clear, duration_ms<=0) the shard's cutover write
+        pause: NEW leader writes raise WRITE_PAUSED until the window
+        expires, bounding the WAL tail a live shard move must drain.
+        Auto-expiring by construction — a mover that dies after arming
+        this leaves the shard serving again within the window."""
+
+        def do():
+            rdb = self._get_app_db(db_name).replicated_db
+            if rdb is None:
+                raise RpcApplicationError(
+                    DB_ADMIN_ERROR, f"{db_name} is not replicated")
+            rdb.pause_writes(float(duration_ms))
+            return rdb.write_paused
+
+        return {"paused": await self._run(do)}
+
     async def handle_set_db_epoch(
         self, db_name: str = "", epoch: int = 0
     ) -> dict:
@@ -457,14 +515,19 @@ class AdminHandler:
     async def handle_restore_db_from_s3(
         self, db_name: str = "", s3_bucket: str = "", s3_backup_dir: str = "",
         upstream_ip: str = "", upstream_port: int = 0, limit_mbs: int = 0,
-        to_seq: int = 0,
+        to_seq: int = 0, role: str = "",
     ) -> dict:
         """restoreDBFromS3 + PITR extension: ``to_seq > 0`` replays the
         backup's WAL archive (<prefix>/wal, written by the backup
         manager's archive_wal rider) over the checkpoint up to that
-        sequence point."""
+        sequence point. ``role`` overrides the post-restore registration
+        role — a live shard move restores its target as an OBSERVER
+        (WAL-tail catch-up without joining the semi-sync ack set: a
+        write must never be acked solely by a half-built replica that an
+        aborted move will sweep)."""
         return await self._restore(db_name, s3_bucket, s3_backup_dir,
-                                   upstream_ip, upstream_port, to_seq)
+                                   upstream_ip, upstream_port, to_seq,
+                                   role=role)
 
     async def _backup(self, db_name: str, store_uri: str, sub_path: str) -> dict:
         app_db = self._get_app_db(db_name)
@@ -519,11 +582,19 @@ class AdminHandler:
     async def _restore(
         self, db_name: str, store_uri: str, sub_path: str,
         upstream_ip: str, upstream_port: int, to_seq: int = 0,
+        role: str = "",
     ) -> dict:
         store = self._store(store_uri)
         prefix = sub_path or db_name
         upstream = (upstream_ip, upstream_port) if upstream_ip else None
-        role = ReplicaRole.FOLLOWER if upstream else ReplicaRole.NOOP
+        if role:
+            role = _parse_role(role)
+            if role in (ReplicaRole.FOLLOWER, ReplicaRole.OBSERVER) \
+                    and not upstream:
+                raise RpcApplicationError(
+                    INVALID_UPSTREAM, f"{role.value} requires upstream")
+        else:
+            role = ReplicaRole.FOLLOWER if upstream else ReplicaRole.NOOP
         tctx = wire_context()
 
         def do():
@@ -558,7 +629,27 @@ class AdminHandler:
                     dir=self.rocksdb_dir, prefix=f".restore-{db_name}-")
                 staging = os.path.join(tmp_parent, "db")
                 try:
-                    dbmeta = backup_mod.restore_db(store, prefix, staging)
+                    # the bulk transfer rides the SAME admission gate as
+                    # SST loads (IngestGate): a drain-node restoring N
+                    # moved shards onto this host pipelines its
+                    # downloads boundedly instead of running N-wide.
+                    # Restores QUEUE (enter_wait) rather than bounce —
+                    # but the wait budget stays WELL below the caller's
+                    # 600s RPC deadline: a slot that frees at t=550s
+                    # would start a download with no client budget
+                    # left, orphaning a server-side restore the mover
+                    # already gave up on (and later re-registering a
+                    # replica no move record points at)
+                    if not self._ingest_gate.enter_wait(timeout=120.0):
+                        raise RpcApplicationError(
+                            TOO_MANY_REQUESTS,
+                            f"{self._ingest_gate.in_flight} bulk loads in "
+                            f"flight (max {self._ingest_gate.capacity})")
+                    try:
+                        dbmeta = backup_mod.restore_db(store, prefix,
+                                                       staging)
+                    finally:
+                        self._ingest_gate.exit()
                     with self._db_admin_lock.locked(db_name):
                         if self.db_manager.get_db(db_name) is not None:
                             self.db_manager.remove_db(db_name)

@@ -23,6 +23,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
@@ -48,7 +49,9 @@ class IngestGate:
     def __init__(self, capacity: int):
         self.capacity = int(capacity)
         self._lock = threading.Lock()
+        self._free = threading.Condition(self._lock)
         self._in_flight = 0
+        self._waiting = 0
 
     @property
     def in_flight(self) -> int:
@@ -62,9 +65,40 @@ class IngestGate:
             self._in_flight += 1
             return True
 
+    def enter_wait(self, timeout: float, max_waiting: int = 2) -> bool:
+        """Blocking admission for callers that should QUEUE rather than
+        bounce: snapshot-restore downloads in a live shard move (a
+        drain-node moving N shards pipelines its bulk transfers through
+        this gate, exactly like the SST-load path, instead of saturating
+        the NIC/disk N-wide). Returns False when no slot freed within
+        ``timeout`` — or IMMEDIATELY when ``max_waiting`` callers are
+        already parked: each waiter occupies a shared admin-executor
+        thread, and an unbounded queue of 10-minute waits would starve
+        every other admin RPC on the host (the PR-9 WRITE_WINDOW_FULL
+        fail-fast lesson). The SST-load RPC keeps try_enter's
+        reject-don't-queue contract."""
+        deadline = time.monotonic() + timeout
+        with self._free:
+            if self._in_flight >= self.capacity \
+                    and self._waiting >= max_waiting:
+                return False
+            self._waiting += 1
+            try:
+                while self._in_flight >= self.capacity:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._free.wait(remaining):
+                        if self._in_flight < self.capacity:
+                            break
+                        return False
+                self._in_flight += 1
+                return True
+            finally:
+                self._waiting -= 1
+
     def exit(self) -> None:
         with self._lock:
             self._in_flight -= 1
+            self._free.notify()
 
 
 class BatchCompactor:

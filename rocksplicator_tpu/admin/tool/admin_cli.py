@@ -164,6 +164,72 @@ def cmd_load_sst(admin: AdminClient, args) -> int:
     return 1 if failures else 0
 
 
+def _coord_client(spec: str):
+    from ...cluster.coordinator import CoordinatorClient
+
+    host, _, port = spec.partition(":")
+    return CoordinatorClient(host, int(port))
+
+
+def cmd_move_shard(admin: AdminClient, args) -> int:
+    """Live elastic shard move (snapshot → bulk-ingest → WAL-tail
+    catch-up → epoch-bumped flip) driven by the resumable step machine;
+    --resume continues a recorded in-flight move, --abort unwinds a
+    pre-cutover one."""
+    from ...cluster.shard_move import MoveError, ShardMove
+
+    partition = f"{args.segment}_{args.shard}"
+    if not (args.resume or args.abort) and not (
+            args.source and args.target and args.store_uri):
+        print("move-shard: --source, --target and --store_uri are "
+              "required for a new move", file=sys.stderr)
+        return 2
+    coord = _coord_client(args.coord)
+    try:
+        if args.abort:
+            ShardMove.resume(coord, args.cluster, partition,
+                             admin=admin).abort()
+            print(f"{partition}: move aborted")
+            return 0
+        if args.resume:
+            mv = ShardMove.resume(coord, args.cluster, partition,
+                                  admin=admin)
+        else:
+            mv = ShardMove.start(
+                coord, args.cluster, partition, args.source, args.target,
+                args.store_uri, admin=admin)
+        rec = mv.run()
+        print(json.dumps({
+            "move_id": rec.move_id, "partition": rec.partition,
+            "source": rec.source, "target": rec.target,
+            "bytes_ingested": rec.bytes_ingested,
+        }))
+        return 0
+    except MoveError as e:
+        print(f"move failed: {e}", file=sys.stderr)
+        return 1
+    finally:
+        coord.close()
+
+
+def cmd_drain_node(admin: AdminClient, args) -> int:
+    """Move every replica off --node (least-loaded targets, sequential
+    moves) — the minimal whole-node evacuation."""
+    from ...cluster.shard_move import MoveError, drain_node
+
+    coord = _coord_client(args.coord)
+    try:
+        moved = drain_node(coord, args.cluster, args.node,
+                           args.store_uri, admin=admin, log_fn=print)
+        print(f"drained {args.node}: {len(moved)} partition(s)")
+        return 0
+    except MoveError as e:
+        print(f"drain failed: {e}", file=sys.stderr)
+        return 1
+    finally:
+        coord.close()
+
+
 def cmd_backup(admin: AdminClient, args) -> int:
     r = admin.backup_db_to_store(
         (args.host, args.port), args.db, args.store_uri, args.backup_path
@@ -225,6 +291,31 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ingest_behind", action="store_true")
     sp.add_argument("--compact", action="store_true")
     sp.set_defaults(fn=cmd_load_sst)
+
+    sp = sub.add_parser("move-shard")
+    sp.add_argument("--coord", required=True, help="host:port")
+    sp.add_argument("--cluster", required=True)
+    sp.add_argument("--segment", required=True)
+    sp.add_argument("--shard", type=int, required=True)
+    sp.add_argument("--source", default="",
+                    help="instance_id donating the replica")
+    sp.add_argument("--target", default="",
+                    help="instance_id receiving it")
+    sp.add_argument("--store_uri", default="",
+                    help="object store for the move snapshot")
+    sp.add_argument("--resume", action="store_true",
+                    help="continue the recorded in-flight move")
+    sp.add_argument("--abort", action="store_true",
+                    help="unwind a pre-cutover move (sweeps the "
+                         "target's half-built replica)")
+    sp.set_defaults(fn=cmd_move_shard)
+
+    sp = sub.add_parser("drain-node")
+    sp.add_argument("--coord", required=True, help="host:port")
+    sp.add_argument("--cluster", required=True)
+    sp.add_argument("--node", required=True, help="instance_id to drain")
+    sp.add_argument("--store_uri", required=True)
+    sp.set_defaults(fn=cmd_drain_node)
 
     sp = sub.add_parser("backup")
     sp.add_argument("--host", default="127.0.0.1")

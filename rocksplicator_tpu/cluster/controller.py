@@ -28,6 +28,7 @@ from .model import (
     LEADER,
     InstanceInfo,
     PartitionAssignment,
+    PlacementPin,
     ResourceDef,
     cluster_path,
     decode_states,
@@ -63,6 +64,7 @@ def assign_resource(
     current: Dict[str, Dict[str, str]],
     per_instance: Dict[str, Dict[str, PartitionAssignment]],
     epochs: Dict[str, Dict],
+    pins: Optional[Dict[str, PlacementPin]] = None,
 ) -> Set[str]:
     """Compute one resource's target assignments (pure — no coordinator
     I/O, so the two-phase handoff edges are directly unit-testable).
@@ -74,7 +76,16 @@ def assign_resource(
     two-phase handoff (the old leader is still the only legitimate
     acker until it reports non-leader). Mutated in place; returns the
     set of partitions whose ledger record changed (the caller persists
-    those BEFORE publishing the stamped assignments)."""
+    those BEFORE publishing the stamped assignments).
+
+    ``pins`` (live shard moves, round 15) overrides rendezvous placement
+    per partition: a pinned partition's replica set is the pin's live
+    instances verbatim, and a live ``preferred_leader`` steers the
+    two-phase handoff toward it — the flip a shard move requests rides
+    the SAME demote → no-live-leader → epoch-mint → promote machinery as
+    a failover, so a pinned cutover is epoch-stamped end to end. A pin
+    whose instances are all dead is ignored (a pin can never un-serve a
+    partition)."""
     leader_state, follower_state = _state_names(resource.state_model)
     changed: Set[str] = set()
     iids = sorted(instances)
@@ -84,11 +95,31 @@ def assign_resource(
         partition = db_name_to_partition_name(
             segment_to_db_name(resource.segment, shard)
         )
+        pin = (pins or {}).get(partition)
+        pinned_live = (
+            [iid for iid in pin.replicas if iid in instances]
+            if pin is not None else []
+        )
         ranked = sorted(
             iids, key=lambda iid: _rendezvous(partition, iid),
             reverse=True,
         )
-        replicas = ranked[: resource.replicas]
+        if pinned_live:
+            # pinned placement, TOPPED UP from the rendezvous ranking
+            # when pinned replicas died: a moved partition must keep
+            # self-healing to full replication like an unpinned one (a
+            # frozen pin would serve under-replicated forever after one
+            # permanent failure)
+            replicas = pinned_live + [
+                iid for iid in ranked if iid not in pinned_live
+            ][: max(0, resource.replicas - len(pinned_live))]
+        else:
+            replicas = ranked[: resource.replicas]
+        preferred = (
+            pin.preferred_leader
+            if pinned_live and pin.preferred_leader in pinned_live
+            else None
+        )
         if not replicas:
             continue
         # who currently leads? A node that rejoins after being deposed
@@ -108,9 +139,13 @@ def assign_resource(
             live_leader = recorded_leader
         else:
             live_leader = claimers[0]
-        # target leader: sticky to the live leader if still placed;
+        # target leader: a pinned preferred leader wins (the move's
+        # flip request — two-phase rules below still gate the actual
+        # promotion); else sticky to the live leader if still placed;
         # else the best-ranked replica that's already serving; else rank-0
-        if live_leader in replicas:
+        if preferred is not None:
+            target_leader = preferred
+        elif live_leader in replicas:
             target_leader = live_leader
         else:
             serving = [
@@ -183,6 +218,10 @@ class Controller:
             self.coord.watch(self._path("instances"), self._on_change),
             self.coord.watch(self._path("currentstates"), self._on_change),
             self.coord.watch(self._path("resources"), self._on_change),
+            # a shard move's pin write must wake the reconcile loop
+            # immediately — the cutover window is the interval between
+            # the pin landing and the flip completing
+            self.coord.watch(self._path("placements"), self._on_change),
         ]
 
     def _on_change(self, _snap) -> None:
@@ -229,6 +268,7 @@ class Controller:
         instances = self._live_instances()
         current = self._current_states()
         epochs = self._load_epochs()
+        pins = self._load_pins()
         per_instance: Dict[str, Dict[str, PartitionAssignment]] = {
             iid: {} for iid in instances
         }
@@ -239,7 +279,8 @@ class Controller:
                 continue
             resource = ResourceDef.decode(raw)
             changed |= assign_resource(
-                resource, instances, current, per_instance, epochs)
+                resource, instances, current, per_instance, epochs,
+                pins=pins)
         for partition in sorted(changed):
             mine = epochs[partition]
             merged = self._persist_epoch(partition, mine)
@@ -273,6 +314,17 @@ class Controller:
         self.passes += 1
 
     # -- fencing-epoch ledger ---------------------------------------------
+
+    def _load_pins(self) -> Dict[str, PlacementPin]:
+        """Placement pins written by live shard moves — the rendezvous
+        override assign_resource honors."""
+        out: Dict[str, PlacementPin] = {}
+        for p in self.coord.list(self._path("placements")):
+            pin = PlacementPin.decode(
+                self.coord.get_or_none(self._path("placements", p)))
+            if pin is not None and pin.replicas:
+                out[p] = pin
+        return out
 
     def _load_epochs(self) -> Dict[str, Dict]:
         out: Dict[str, Dict] = {}

@@ -73,6 +73,23 @@ class AdminClient:
             args["upstream_ip"], args["upstream_port"] = upstream
         self.call(addr, "change_db_role_and_upstream", **args)
 
+    def check_pull_stall(self, addr, db_name: str) -> Optional[dict]:
+        """Flags-only stall probe (no disk I/O server-side) for the
+        participant's periodic heal loop."""
+        try:
+            return self.call(addr, "check_pull_stall", db_name=db_name,
+                             timeout=5.0)
+        except (RpcError, RpcApplicationError):
+            return None
+
+    def pause_db_writes(self, addr, db_name: str,
+                        duration_ms: float) -> bool:
+        """Arm (duration_ms>0) or clear (<=0) the shard's auto-expiring
+        cutover write pause (live shard moves)."""
+        return bool(self.call(addr, "pause_db_writes", db_name=db_name,
+                              duration_ms=float(duration_ms),
+                              timeout=10.0).get("paused"))
+
     def set_db_epoch(self, addr, db_name: str, epoch: int) -> None:
         """Raise the db's fencing epoch without a role transition (the
         sticky-leader adoption path)."""
@@ -101,10 +118,12 @@ class AdminClient:
     def restore_db_from_store(
         self, addr, db_name: str, store_uri: str, backup_path: str,
         upstream: Optional[Tuple[str, int]] = None,
-        to_seq: int = 0,
+        to_seq: int = 0, role: str = "",
     ) -> dict:
         """``to_seq > 0`` = point-in-time restore: replay the backup's
-        WAL archive over the newest checkpoint <= to_seq."""
+        WAL archive over the newest checkpoint <= to_seq. ``role``
+        overrides the post-restore registration role (shard moves
+        restore their target as an ack-invisible OBSERVER)."""
         args: Dict[str, Any] = {
             "db_name": db_name, "s3_bucket": store_uri,
             "s3_backup_dir": backup_path,
@@ -113,6 +132,8 @@ class AdminClient:
             args["upstream_ip"], args["upstream_port"] = upstream
         if to_seq:
             args["to_seq"] = int(to_seq)
+        if role:
+            args["role"] = role
         return self.call(addr, "restore_db_from_s3", timeout=600.0, **args)
 
     def ingest_from_store(self, addr, db_name: str, store_uri: str,

@@ -13,6 +13,9 @@ Path conventions (the ZK tree equivalent):
     /clusters/<cluster>/events/<partition>             leader-handoff history
     /clusters/<cluster>/config/<segment>               resource configs
     /clusters/<cluster>/tasks/queue, /tasks/results    task framework
+    /clusters/<cluster>/placements/<partition>         placement pins (moves)
+    /clusters/<cluster>/moves/<partition>              live shard-move ledger
+    /clusters/<cluster>/moves_summary                  move counters (spectator)
 """
 
 from __future__ import annotations
@@ -64,6 +67,42 @@ class ResourceDef:
     @classmethod
     def decode(cls, raw: bytes) -> "ResourceDef":
         return cls(**json.loads(bytes(raw).decode()))
+
+
+@dataclass
+class PlacementPin:
+    """One partition's pinned placement — the live-resharding override
+    over rendezvous hashing.
+
+    A shard move (cluster/shard_move.py) flips placement by writing a
+    pin: ``replicas`` is the exact instance list that should host the
+    partition, ``preferred_leader`` (optional) names which of them the
+    controller should drive leadership to — through the SAME two-phase
+    demote-then-promote + epoch-mint machinery a failover uses, so a
+    pinned flip is epoch-stamped and fencing-safe by construction.
+    Dead pinned instances are filtered at assignment time; an entirely
+    dead pin falls back to rendezvous placement so a pin can never
+    un-serve a partition. ``move_id`` records which move wrote it (audit
+    trail; stale-pin sweeps)."""
+
+    replicas: List[str]
+    preferred_leader: Optional[str] = None
+    move_id: str = ""
+
+    def encode(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def decode(cls, raw: Optional[bytes]) -> Optional["PlacementPin"]:
+        if not raw:
+            return None
+        try:
+            d = json.loads(bytes(raw).decode())
+        except (ValueError, UnicodeDecodeError):
+            return None
+        return cls(replicas=list(d.get("replicas") or []),
+                   preferred_leader=d.get("preferred_leader"),
+                   move_id=d.get("move_id", ""))
 
 
 @dataclass

@@ -48,6 +48,7 @@ class Participant:
         error_retry_backoff: float = 1.0,
         view_cluster: Optional[str] = None,
         coord_fallbacks: Optional[List[Tuple[str, int]]] = None,
+        promotion_seq_slack: Optional[int] = None,
     ):
         self.error_retry_backoff = error_retry_backoff
         self.cluster = cluster
@@ -60,6 +61,7 @@ class Participant:
             backup_store_uri=backup_store_uri,
             catch_up_timeout=catch_up_timeout,
             view_cluster=view_cluster,
+            promotion_seq_slack=promotion_seq_slack,
         )
         factory_cls = FACTORIES[state_model]
         self.factory = factory_cls(self.ctx)
@@ -115,17 +117,99 @@ class Participant:
                 self._rejoin()
             try:
                 for partition, state in self.current_states.items():
-                    if state not in ("LEADER", "MASTER"):
-                        continue
-                    seq = self.admin.get_sequence_number(
-                        self.ctx.local_admin_addr,
-                        partition_name_to_db_name(partition),
-                    )
-                    if seq is not None:
-                        self.ctx.set_partition_seq(partition, seq)
+                    db_name = partition_name_to_db_name(partition)
+                    if state in ("LEADER", "MASTER"):
+                        seq = self.admin.get_sequence_number(
+                            self.ctx.local_admin_addr, db_name)
+                        if seq is not None:
+                            self.ctx.set_partition_seq(partition, seq)
+                    elif state in ("FOLLOWER", "SLAVE"):
+                        self._heal_pull_stall(partition, db_name)
             except Exception:
                 if not self._stopped:
                     log.exception("partition seq updater failed")
+
+    def _heal_pull_stall(self, partition: str, db_name: str) -> None:
+        """Self-heal a steady follower whose pull loop can NEVER
+        converge (it gets no state transition on its own — both states
+        were found wedged by the reshard chaos harness):
+
+        - ``pull_stalled_wal_gap``: the upstream purged its WAL past
+          our position. Force the ERROR→replan path: the
+          Offline→Follower transition re-runs with the needRebuildDB
+          WAL-availability check and rebuilds from a peer snapshot
+          (local data kept until the rebuild lands).
+        - ``pull_diverged``: we are persistently AHEAD of the leader's
+          commit point — a deposed-leader window write poisoned our
+          suffix. Clear + rejoin through OFFLINE (the follower analog
+          of the r11 deposed-leader resync; the lineage's copies live
+          on the leader and its other followers).
+
+        Discipline: the COMMON path (no stall) probes WITHOUT touching
+        the partition's inflight slot — claiming it even briefly races
+        assignment delivery (an update arriving while claimed is
+        skipped by _on_assignments and, since the controller never
+        rewrites identical assignments, would be lost for good — the
+        exact lost-update class _run_transition's finally re-evaluation
+        exists for). Only a CONFIRMED stall claims the slot, re-probes
+        under it (the destructive clear must not race a transition that
+        just promoted this node), acts, releases, and then ALWAYS
+        re-evaluates assignments to recover any update that arrived
+        while claimed. The probe is the flags-only check_pull_stall
+        RPC (no disk I/O), cheap enough per follower shard per tick."""
+        info = self.admin.check_pull_stall(
+            self.ctx.local_admin_addr, db_name)
+        if not info or not (info.get("pull_diverged")
+                            or info.get("pull_stalled_wal_gap")):
+            return
+        with self._state_lock:
+            if self._inflight.get(partition):
+                return
+            if self._current.get(partition) not in ("FOLLOWER", "SLAVE"):
+                return
+            self._inflight[partition] = True
+        try:
+            # re-probe under the claim: the stall (and this node's
+            # follower role) must still hold with transitions excluded
+            info = self.admin.check_pull_stall(
+                self.ctx.local_admin_addr, db_name)
+            if not info or info.get("role") not in ("FOLLOWER",
+                                                    "OBSERVER"):
+                return
+            if info.get("pull_diverged"):
+                log.warning(
+                    "%s: follower DIVERGED from the lineage (applied "
+                    "ahead of the leader's commit point) — clearing "
+                    "and rejoining", partition)
+                Stats.get().incr("participant.diverged_resyncs")
+                try:
+                    self.admin.clear_db(self.ctx.local_admin_addr,
+                                        db_name, reopen=False)
+                except Exception:
+                    log.exception("%s: diverged-resync clear failed "
+                                  "(will retry)", partition)
+                    return
+                self._set_current(partition, OFFLINE)
+            elif info.get("pull_stalled_wal_gap"):
+                log.warning(
+                    "%s: follower stalled on a WAL gap (upstream "
+                    "purged past our position) — forcing snapshot "
+                    "rebuild via ERROR replan", partition)
+                Stats.get().incr("participant.wal_gap_rebuilds")
+                self._set_current(partition, ERROR)
+        finally:
+            with self._state_lock:
+                self._inflight.pop(partition, None)
+            # recover any assignment update delivered while claimed
+            try:
+                raw = self.coord.get_or_none(
+                    self._path("assignments",
+                               self.instance.instance_id))
+                if raw is not None:
+                    self._on_assignments({"value": raw})
+            except Exception:
+                log.exception("%s: post-heal re-evaluation failed",
+                              partition)
 
     # ------------------------------------------------------------------
 

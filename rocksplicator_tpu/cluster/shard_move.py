@@ -1,0 +1,958 @@
+"""Live elastic shard moves: snapshot → bulk-ingest → WAL-tail catch-up
+→ epoch-bumped flip, as a resumable step machine.
+
+Reference: the Helix Bootstrap / backup+restore task flows plus the
+ConfigGenerator shard-map publisher (PAPER.md L4) — the reference
+relocates partitions on LIVE clusters by snapshotting a donor,
+restoring the snapshot on the destination, catching the destination up,
+and flipping the published shard map. This module composes the pieces
+this repo already fault-proved into that operation:
+
+- **snapshot** — the round-12 narrowed ``backup_db`` path (checkpoint
+  under the per-db lock only; upload off the immutable hardlinked set);
+- **bulk-ingest** — ``restore_db_from_s3`` on the target, whose bulk
+  download rides the round-7 :class:`IngestGate` admission gate (a
+  drain-node moving N shards pipelines transfers boundedly) and whose
+  destroy→rename→reopen flip holds the per-db lock only briefly;
+- **WAL-tail catch-up** — the target reopens as a *hidden* FOLLOWER of
+  the live leader (registered on the data plane only — its participant
+  publishes nothing, so the shard map never shows a half-built
+  replica) and drains the tail through the leader's cached
+  :class:`~rocksplicator_tpu.storage.wal.WalTailCursor` serve path;
+- **cutover** — a brief auto-expiring write pause bounds the tail on a
+  hot shard (``ReplicatedDB.pause_writes``), then a
+  :class:`~rocksplicator_tpu.cluster.model.PlacementPin` steers the
+  controller's OWN two-phase handoff at the target: demote →
+  no-live-leader → epoch mint in the controller's durable ledger →
+  promote → spectator/config_generator republish. The flip is therefore
+  epoch-stamped end to end, and a source that was wedged through it
+  demotes via the round-11 deposed-resync path when it heals;
+- **retire** — a second pin drops the source replica; its participant
+  runs Follower→Offline→Dropped and the move's snapshot garbage is
+  swept from the store.
+
+Every phase entry is recorded in a durable coordinator ledger
+(``/clusters/<c>/moves/<partition>``) BEFORE the phase runs, so a mover
+killed at any seam leaves the move either cleanly abortable (target
+garbage swept, pin restored) or resumable (``ShardMove.resume``) —
+never a half-flipped map. Failpoint seams (``move.record``,
+``move.snapshot``, ``move.restore``, ``move.catchup``, ``move.flip``,
+``move.retire``) let the chaos harness (``tools/chaos_soak.py
+--reshard``) kill the mover at every phase and prove the sixth standing
+invariant: exactly one serving lineage per shard, zero acked-write loss
+across the move, bounded convergence.
+
+:class:`DirectShardMove` is the coordinator-less variant (pure admin
+RPCs against a static cluster) used by the macro-bench's mid-bench move
+and by script-driven deployments without a control plane: same
+snapshot/restore/catch-up phases, but the cutover mints the epoch from
+the shard's live one and performs the promote/repoint/demote RPCs
+itself.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..rpc.errors import RpcApplicationError, RpcError
+from ..testing import failpoints as fp
+from ..utils.objectstore import build_object_store
+from ..utils.segment_utils import partition_name_to_db_name
+from ..utils.stats import Stats, tagged
+from .coordinator import CoordinatorClient
+from .helix_utils import AdminClient
+from .model import (InstanceInfo, PlacementPin, cluster_path,
+                    decode_states)
+
+log = logging.getLogger(__name__)
+
+_LEADERLIKE = {"LEADER", "MASTER"}
+_SERVING = _LEADERLIKE | {"FOLLOWER", "SLAVE"}
+
+# phase order — the durable record's ``phase`` field always names the
+# phase being (re)executed, written BEFORE the phase body runs
+PHASES = ("planned", "snapshot", "restore", "catchup", "cutover",
+          "retire")
+
+
+class MoveError(RuntimeError):
+    """A phase failed in a way the mover cannot ride through. The move
+    record stays in the coordinator: the operator (or chaos harness)
+    resumes or aborts it explicitly."""
+
+
+class MoveInFlightError(MoveError):
+    """A move for this partition is already recorded. Resume or abort
+    the existing one; two movers on one partition are never allowed."""
+
+
+@dataclass
+class MoveRecord:
+    """The durable move ledger entry — one per in-flight move, at
+    ``/clusters/<cluster>/moves/<partition>``. Also what the Spectator
+    surfaces on ``/cluster_stats`` (phase / bytes / lag progress)."""
+
+    move_id: str
+    partition: str
+    db_name: str
+    source: str                      # instance_id donating the replica
+    target: str                      # instance_id receiving it
+    store_uri: str
+    snapshot_prefix: str
+    phase: str = "planned"
+    moving_leader: Optional[bool] = None  # decided at first cutover entry
+    pin_before: Optional[str] = None      # raw pin JSON to restore on abort
+    snapshot_seq: int = 0
+    bytes_ingested: int = 0
+    catchup_lag: int = -1
+    started_ms: int = 0
+    updated_ms: int = 0
+
+    def encode(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MoveRecord":
+        return cls(**json.loads(bytes(raw).decode()))
+
+
+@dataclass
+class MoveFlags:
+    """Knobs; defaults sized for production-ish pacing, overridden small
+    by the chaos harness and tests."""
+
+    catchup_lag_threshold: int = 64     # enter cutover at lag <= this
+    catchup_timeout: float = 120.0
+    cutover_pause_ms: float = 3000.0    # the write pause bounding the tail
+    cutover_attempts: int = 3           # pause windows tried before failing
+    flip_timeout: float = 30.0          # pin write -> map flipped
+    retire_timeout: float = 30.0
+    poll_interval: float = 0.1
+    record_update_interval: float = 0.5  # progress put pacing (catch-up)
+
+
+def _phase_index(phase: str) -> int:
+    return PHASES.index(phase) if phase in PHASES else -1
+
+
+class ShardMove:
+    """Coordinator-backed mover: drives one partition's replica from
+    ``source`` to ``target`` under live traffic. Construct via
+    :meth:`start` (new move) or :meth:`resume` (continue a recorded
+    one); then :meth:`run` executes to completion. :meth:`abort` cleans
+    up a pre-cutover move."""
+
+    def __init__(self, coord: CoordinatorClient, cluster: str,
+                 record: MoveRecord,
+                 admin: Optional[AdminClient] = None,
+                 flags: Optional[MoveFlags] = None):
+        self.coord = coord
+        self.cluster = cluster
+        self.rec = record
+        self.flags = flags or MoveFlags()
+        self.admin = admin or AdminClient()
+        self._owns_admin = admin is None
+        self._path = lambda *p: cluster_path(cluster, *p)
+        self._stats = Stats.get()
+        self._gauge_names: List[str] = []
+        self._last_record_put = 0.0
+        self._resumed = False
+        # (expiry, value) caches for the leader/target resolutions the
+        # catch-up poll loop re-reads 10-20x/s — without them every poll
+        # is an O(cluster) sweep of coordinator list+get round-trips
+        # during the most latency-sensitive window of the move
+        self._leader_cache: Tuple[float, Optional[Tuple[str,
+                                                        InstanceInfo]]] \
+            = (0.0, None)
+        self._target_cache: Tuple[float, Optional[InstanceInfo]] \
+            = (0.0, None)
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def start(cls, coord: CoordinatorClient, cluster: str, partition: str,
+              source: str, target: str, store_uri: str,
+              admin: Optional[AdminClient] = None,
+              flags: Optional[MoveFlags] = None) -> "ShardMove":
+        """Record and return a NEW move (phase ``planned``). Validates
+        the endpoints against the live cluster and claims the
+        partition's move slot — a second concurrent mover gets
+        :class:`MoveInFlightError` from the create, never a second
+        record."""
+        move_id = uuid.uuid4().hex[:12]
+        db_name = partition_name_to_db_name(partition)
+        rec = MoveRecord(
+            move_id=move_id, partition=partition, db_name=db_name,
+            source=source, target=target, store_uri=store_uri,
+            snapshot_prefix=f"moves/{db_name}/{move_id}",
+            started_ms=int(time.time() * 1000),
+        )
+        mv = cls(coord, cluster, rec, admin=admin, flags=flags)
+        try:
+            mv._validate_plan()
+            pin_raw = coord.get_or_none(
+                mv._path("placements", partition))
+            if pin_raw is not None:
+                rec.pin_before = bytes(pin_raw).decode()
+            fp.hit("move.record")
+            coord.create(mv._record_path(), rec.encode())
+        except RpcApplicationError as e:
+            mv.close()
+            if e.code == "NODE_EXISTS":
+                raise MoveInFlightError(
+                    f"{partition}: a move is already recorded — resume "
+                    f"or abort it first") from e
+            raise
+        except BaseException:
+            mv.close()
+            raise
+        mv._stats.incr("shard_moves.started")
+        mv._bump_summary("started")
+        return mv
+
+    @classmethod
+    def resume(cls, coord: CoordinatorClient, cluster: str,
+               partition: str, admin: Optional[AdminClient] = None,
+               flags: Optional[MoveFlags] = None) -> "ShardMove":
+        """Load the recorded move for ``partition`` and return a mover
+        that will continue from the recorded phase (the phase itself
+        restarts from its top — every phase body is idempotent)."""
+        raw = coord.get_or_none(
+            cluster_path(cluster, "moves", partition))
+        if raw is None:
+            raise MoveError(f"{partition}: no move recorded")
+        mv = cls(coord, cluster, MoveRecord.decode(raw), admin=admin,
+                 flags=flags)
+        # counted when run() actually continues the move — an operator
+        # loading the record just to abort() is not a resume
+        mv._resumed = True
+        return mv
+
+    # -- plumbing --------------------------------------------------------
+
+    def _record_path(self) -> str:
+        return self._path("moves", self.rec.partition)
+
+    def _save(self, phase: Optional[str] = None, force: bool = True) -> None:
+        """Write-ahead the move record. Phase transitions always write;
+        in-phase progress updates (catch-up lag) are paced by
+        ``record_update_interval``."""
+        now = time.monotonic()
+        if phase is not None:
+            self.rec.phase = phase
+        elif not force and (now - self._last_record_put
+                            < self.flags.record_update_interval):
+            return
+        self.rec.updated_ms = int(time.time() * 1000)
+        fp.hit("move.record")
+        self.coord.put(self._record_path(), self.rec.encode())
+        self._last_record_put = now
+
+    def _bump_summary(self, key: str) -> None:
+        """Cluster-wide move counters the Spectator surfaces. Best
+        effort (read-modify-write; one mover per partition, and a lost
+        increment is a cosmetic stat, never a correctness input)."""
+        path = self._path("moves_summary")
+        try:
+            raw = self.coord.get_or_none(path)
+            d = json.loads(bytes(raw).decode()) if raw else {}
+            d[key] = int(d.get(key, 0)) + 1
+            self.coord.put(path, json.dumps(d).encode())
+        except Exception:
+            log.debug("moves_summary bump failed", exc_info=True)
+
+    def _instances(self) -> Dict[str, InstanceInfo]:
+        out: Dict[str, InstanceInfo] = {}
+        for iid in self.coord.list(self._path("instances")):
+            raw = self.coord.get_or_none(self._path("instances", iid))
+            if raw:
+                out[iid] = InstanceInfo.decode(raw)
+        return out
+
+    def _states(self) -> Dict[str, str]:
+        """instance_id -> current state for THIS partition."""
+        out: Dict[str, str] = {}
+        for iid in self.coord.list(self._path("currentstates")):
+            st = decode_states(self.coord.get_or_none(
+                self._path("currentstates", iid))).get(self.rec.partition)
+            if st:
+                out[iid] = st
+        return out
+
+    def _leader(self, cached: bool = False
+                ) -> Optional[Tuple[str, InstanceInfo]]:
+        """(iid, info) of the partition's live leader. Leadership can
+        move mid-move (that is the point of the chaos schedules), so
+        every use re-resolves — but the catch-up POLL loops pass
+        ``cached`` to reuse a ~1s-old answer instead of sweeping every
+        coordinator node 10-20x/s for the whole drain window (a None
+        answer is never cached, so failover discovery stays prompt)."""
+        now = time.monotonic()
+        if cached and now < self._leader_cache[0]:
+            return self._leader_cache[1]
+        instances = self._instances()
+        result = None
+        for iid, st in self._states().items():
+            if st in _LEADERLIKE and iid in instances:
+                result = (iid, instances[iid])
+                break
+        if result is not None:
+            self._leader_cache = (now + 1.0, result)
+        return result
+
+    def _admin_addr(self, info: InstanceInfo) -> Tuple[str, int]:
+        return (info.host, info.admin_port)
+
+    def _seq(self, info: InstanceInfo) -> Optional[int]:
+        return self.admin.get_sequence_number(
+            self._admin_addr(info), self.rec.db_name)
+
+    def _target_info(self) -> InstanceInfo:
+        info = self._instances().get(self.rec.target)
+        if info is None:
+            raise MoveError(
+                f"{self.rec.partition}: target {self.rec.target} is not "
+                f"a live instance")
+        return info
+
+    def _validate_plan(self) -> None:
+        instances = self._instances()
+        states = self._states()
+        if self.rec.source not in instances:
+            raise MoveError(f"source {self.rec.source} is not live")
+        if self.rec.target not in instances:
+            raise MoveError(f"target {self.rec.target} is not live")
+        if states.get(self.rec.source) not in _SERVING:
+            raise MoveError(
+                f"source {self.rec.source} does not serve "
+                f"{self.rec.partition} (state {states.get(self.rec.source)})")
+        if self.rec.target in states:
+            raise MoveError(
+                f"target {self.rec.target} already serves "
+                f"{self.rec.partition}")
+        # also probe the target's ADMIN plane: a hidden (currentstate-
+        # invisible) replica left by an interrupted earlier move must
+        # never be silently adopted as this move's restore — its data
+        # could be a stale diverged lineage
+        if self._seq(instances[self.rec.target]) is not None:
+            raise MoveError(
+                f"target {self.rec.target} already holds a "
+                f"{self.rec.db_name} replica (leftover from an earlier "
+                f"move?) — sweep it first (clear_db)")
+
+    def _register_gauges(self) -> None:
+        stats = self._stats
+        db = self.rec.db_name
+        for name, fn in (
+            (tagged("shard_move.phase", db=db),
+             lambda: float(_phase_index(self.rec.phase))),
+            (tagged("shard_move.bytes_ingested", db=db),
+             lambda: float(self.rec.bytes_ingested)),
+            (tagged("shard_move.catchup_lag", db=db),
+             lambda: float(self.rec.catchup_lag)),
+        ):
+            stats.add_gauge(name, fn)
+            self._gauge_names.append(name)
+
+    def _unregister_gauges(self) -> None:
+        for name in self._gauge_names:
+            self._stats.remove_gauge(name)
+        self._gauge_names = []
+
+    # -- the step machine ------------------------------------------------
+
+    def run(self) -> MoveRecord:
+        """Execute (or continue) the move to DONE. Raises MoveError on
+        an unrecoverable phase failure — the record stays durable and a
+        later resume()/abort() picks it up."""
+        order = {p: i for i, p in enumerate(PHASES)}
+        start_at = order.get(self.rec.phase, 0)
+        if self._resumed:
+            self._resumed = False
+            self._stats.incr("shard_moves.resumed")
+            self._bump_summary("resumed")
+        self._register_gauges()
+        try:
+            if start_at <= order["snapshot"]:
+                self._save("snapshot")
+                self._phase_snapshot()
+            if start_at <= order["restore"]:
+                self._save("restore")
+                self._phase_restore()
+            if start_at <= order["catchup"]:
+                self._save("catchup")
+                self._phase_catchup()
+            if start_at <= order["cutover"]:
+                self._save("cutover")
+                self._phase_cutover()
+            self._save("retire")
+            self._phase_retire()
+            self._finish()
+            self.close()
+            return self.rec
+        finally:
+            # NOTE: an owned admin client is NOT closed on a failed run
+            # — the record is still live and abort()/retries on this
+            # instance must keep a working client; close() runs on
+            # the success path and at abort.
+            self._unregister_gauges()
+
+    def close(self) -> None:
+        if self._owns_admin:
+            self.admin.close()
+            self._owns_admin = False
+
+    # each phase is idempotent: resume() re-enters the recorded phase
+    # from its top, and every step either re-checks before acting or is
+    # naturally repeatable (incremental backup, pin put, state waits)
+
+    def _phase_snapshot(self) -> None:
+        fp.hit("move.snapshot")
+        rec = self.rec
+        source = self._instances().get(rec.source)
+        donor = source
+        if donor is None:
+            # the donor died mid-move: snapshot from the live leader
+            # instead (any replica is a valid checkpoint donor)
+            led = self._leader()
+            if led is None:
+                raise MoveError(f"{rec.partition}: no live donor for "
+                                f"snapshot (source dead, no leader)")
+            donor = led[1]
+        r = self.admin.backup_db_to_store(
+            self._admin_addr(donor), rec.db_name, rec.store_uri,
+            rec.snapshot_prefix)
+        rec.snapshot_seq = int(r.get("seq") or 0)
+        self._save()
+
+    def _phase_restore(self) -> None:
+        fp.hit("move.restore")
+        rec = self.rec
+        target = self._target_info()
+        existing = self._seq(target)
+        if existing is not None and existing >= rec.snapshot_seq > 0:
+            # resume: the restore already materialized (we crashed after
+            # the flip-and-register step) — don't destroy the catch-up
+            log.info("%s: target already at seq %d >= snapshot %d; "
+                     "restore skipped", rec.partition, existing,
+                     rec.snapshot_seq)
+            return
+        led = self._leader()
+        if led is None:
+            raise MoveError(f"{rec.partition}: no live leader to tail "
+                            f"from after restore")
+        _iid, leader = led
+        # upstream = the LIVE LEADER: the hidden replica's WAL-tail
+        # catch-up pulls straight from the lineage head (the leader's
+        # serve path streams from its cached WalTailCursor), and the
+        # round-13 leader resolver repoints it if leadership moves.
+        # Role OBSERVER: catch-up pulls must NOT count toward semi-sync
+        # acks — a write acked solely by a half-built replica that an
+        # aborted move then sweeps would be an acked-write loss.
+        self.admin.restore_db_from_store(
+            self._admin_addr(target), rec.db_name, rec.store_uri,
+            rec.snapshot_prefix,
+            upstream=(leader.host, leader.repl_port), role="OBSERVER")
+        info = self.admin.check_db(self._admin_addr(target), rec.db_name)
+        if info:
+            rec.bytes_ingested = int(info.get("db_size_bytes") or 0)
+        self._save()
+
+    def _catchup_lag(self) -> Optional[int]:
+        """leader_seq - target_seq, or None when either side is
+        unreadable this instant. Polled 10-20x/s: resolutions ride the
+        ~1s caches; a seq-read failure drops them so the next poll
+        re-resolves (leadership moved / target bounced)."""
+        led = self._leader(cached=True)
+        if led is None:
+            return None
+        now = time.monotonic()
+        if now < self._target_cache[0]:
+            target = self._target_cache[1]
+        else:
+            target = self._instances().get(self.rec.target)
+            if target is not None:
+                self._target_cache = (now + 1.0, target)
+        if target is None:
+            raise MoveError(f"{self.rec.partition}: target died during "
+                            f"catch-up")
+        lseq = self._seq(led[1])
+        tseq = self._seq(target)
+        if lseq is None or tseq is None:
+            self._leader_cache = (0.0, None)
+            self._target_cache = (0.0, None)
+            return None
+        return max(0, lseq - tseq)
+
+    def _phase_catchup(self) -> None:
+        fp.hit("move.catchup")
+        rec, flags = self.rec, self.flags
+        deadline = time.monotonic() + flags.catchup_timeout
+        while True:
+            lag = self._catchup_lag()
+            if lag is not None:
+                rec.catchup_lag = lag
+                self._save(force=False)
+                if lag <= flags.catchup_lag_threshold:
+                    self._save()
+                    return
+            if time.monotonic() > deadline:
+                raise MoveError(
+                    f"{rec.partition}: catch-up lag {rec.catchup_lag} "
+                    f"never reached threshold "
+                    f"{flags.catchup_lag_threshold} within "
+                    f"{flags.catchup_timeout}s")
+            time.sleep(flags.poll_interval)
+
+    def _current_pin(self) -> Optional[PlacementPin]:
+        return PlacementPin.decode(self.coord.get_or_none(
+            self._path("placements", self.rec.partition)))
+
+    def _put_pin(self, pin: PlacementPin) -> None:
+        self.coord.put(self._path("placements", self.rec.partition),
+                       pin.encode())
+
+    def _phase_cutover(self) -> None:
+        """The fenced flip. With the tail bounded by the write pause,
+        pin the placement at the target: the controller's own two-phase
+        handoff demotes the source, mints the epoch bump in its durable
+        ledger, promotes the target (whose Follower→Leader transition
+        re-verifies exact catch-up at margin=0), and the spectator's
+        config_generator republishes the map — every stamp and guard a
+        failover gets, because it IS the failover machinery."""
+        fp.hit("move.flip")
+        rec = self.rec
+        if rec.moving_leader is None:
+            states = self._states()
+            rec.moving_leader = states.get(rec.source) in _LEADERLIKE
+            self._save()
+        target = self._target_info()
+        if self._seq(target) is None:
+            raise MoveError(f"{rec.partition}: target no longer hosts "
+                            f"{rec.db_name} at cutover")
+        if rec.moving_leader:
+            self._cutover_drain()
+        hosting = [iid for iid, st in self._states().items()
+                   if st in _SERVING]
+        replicas = sorted(set(hosting) | {rec.target})
+        self._put_pin(PlacementPin(
+            replicas=replicas,
+            preferred_leader=rec.target if rec.moving_leader else None,
+            move_id=rec.move_id))
+        self._await_flip()
+
+    def _cutover_drain(self) -> None:
+        """Pause source-side ingress and drain the WAL tail to exact
+        equality — the guard that makes the flip lossless-by-
+        construction on a hot shard (and the one the chaos harness's
+        ``move_flip`` tooth breaks to prove it is load-bearing)."""
+        rec, flags = self.rec, self.flags
+        last_lag = None
+        for attempt in range(flags.cutover_attempts):
+            led = self._leader()
+            if led is None:
+                # mid-failover: no acking leader, nothing to drain — the
+                # promotion machinery will finish the catch-up exactly
+                return
+            _iid, leader = led
+            try:
+                self.admin.pause_db_writes(
+                    self._admin_addr(leader), rec.db_name,
+                    flags.cutover_pause_ms)
+            except (RpcError, RpcApplicationError):
+                continue  # leader moved/unreachable: re-resolve and retry
+            pause_deadline = (time.monotonic()
+                              + flags.cutover_pause_ms / 1000.0)
+            while time.monotonic() < pause_deadline:
+                lag = self._catchup_lag()
+                if lag is not None:
+                    last_lag = lag
+                    rec.catchup_lag = lag
+                    if lag == 0:
+                        return  # tail drained; pause expires on its own
+                time.sleep(flags.poll_interval)
+        raise MoveError(
+            f"{rec.partition}: WAL tail never drained to 0 across "
+            f"{flags.cutover_attempts} pause windows (last lag "
+            f"{last_lag})")
+
+    def _await_flip(self) -> None:
+        rec, flags = self.rec, self.flags
+        deadline = time.monotonic() + flags.flip_timeout
+        while time.monotonic() < deadline:
+            states = self._states()
+            st = states.get(rec.target)
+            if rec.moving_leader:
+                if st in _LEADERLIKE:
+                    return
+            elif st in _SERVING:
+                return
+            time.sleep(flags.poll_interval)
+        raise MoveError(
+            f"{rec.partition}: map never flipped to {rec.target} "
+            f"within {flags.flip_timeout}s (states {self._states()})")
+
+    def _phase_retire(self) -> None:
+        fp.hit("move.retire")
+        rec, flags = self.rec, self.flags
+        pin = self._current_pin()
+        replicas = (pin.replicas if pin is not None
+                    else []) or [rec.target]
+        if rec.source in replicas:
+            replicas = [iid for iid in replicas if iid != rec.source]
+            self._put_pin(PlacementPin(
+                replicas=replicas,
+                preferred_leader=(rec.target if rec.moving_leader
+                                  else None),
+                move_id=rec.move_id))
+        deadline = time.monotonic() + flags.retire_timeout
+        while time.monotonic() < deadline:
+            if rec.source not in self._instances():
+                return  # dead source: it will drop on rejoin (DROPPED
+                # assignment); the map already excludes it
+            if self._states().get(rec.source) is None:
+                return
+            time.sleep(flags.poll_interval)
+        raise MoveError(
+            f"{rec.partition}: source {rec.source} never dropped the "
+            f"partition within {flags.retire_timeout}s")
+
+    def _finish(self) -> None:
+        # release the leadership preference: it existed only to drive
+        # the flip. Leaving it standing would steer every LATER failover
+        # back toward this target — including one that has since lost
+        # its data (observed cascading in the reshard chaos). The
+        # replica-set pin itself stays: that IS the placement now.
+        pin = self._current_pin()
+        if pin is not None and pin.preferred_leader is not None:
+            self._put_pin(PlacementPin(replicas=pin.replicas,
+                                       preferred_leader=None,
+                                       move_id=self.rec.move_id))
+        self._sweep_snapshot()
+        fp.hit("move.record")
+        self.coord.delete_if_exists(self._record_path())
+        self._stats.incr("shard_moves.completed")
+        self._bump_summary("completed")
+        log.info("%s: move %s complete (%s -> %s)", self.rec.partition,
+                 self.rec.move_id, self.rec.source, self.rec.target)
+
+    def _sweep_snapshot(self) -> None:
+        """Delete the move's snapshot objects — the garbage sweep that
+        keeps repeated/aborted moves from filling the store (same
+        hygiene as the admin handler's staging-dir sweep)."""
+        try:
+            store = build_object_store(self.rec.store_uri)
+            for key in store.list_objects(
+                    self.rec.snapshot_prefix.rstrip("/") + "/"):
+                store.delete_object(key)
+        except Exception:
+            log.warning("%s: snapshot sweep failed (prefix %s)",
+                        self.rec.partition, self.rec.snapshot_prefix,
+                        exc_info=True)
+
+    # -- abort -----------------------------------------------------------
+
+    def abort(self) -> None:
+        """Cleanly unwind a PRE-cutover move: the target's half-built
+        replica is closed and destroyed, the snapshot prefix swept, the
+        pre-move pin restored, and the move record deleted. A move at
+        or past cutover has already asked the controller to flip — the
+        only safe direction is forward (resume)."""
+        rec = self.rec
+        if _phase_index(rec.phase) >= _phase_index("cutover"):
+            raise MoveError(
+                f"{rec.partition}: move already at {rec.phase} — past "
+                f"the point of no return; resume it instead")
+        # target garbage FIRST, and the record is only deleted once the
+        # sweep succeeded: deleting it past a failed sweep would destroy
+        # the only resume/abort handle to a still-registered hidden
+        # OBSERVER (the stranded-replica state the sixth invariant
+        # forbids). A LIVE-but-unreachable target keeps the record — the
+        # operator retries the abort; a DEAD target cannot be swept by
+        # anyone, so the abort proceeds (its half-built replica is disk
+        # state only: nothing re-registers it when the node returns).
+        target = self._instances().get(rec.target)
+        if target is not None:
+            try:
+                self.admin.clear_db(self._admin_addr(target),
+                                    rec.db_name, reopen=False)
+            except (RpcError, RpcApplicationError) as e:
+                if getattr(e, "code", None) != "DB_NOT_FOUND":
+                    raise MoveError(
+                        f"{rec.partition}: abort could not sweep the "
+                        f"target replica on {rec.target} ({e!r}) — "
+                        f"record kept, retry the abort") from e
+        else:
+            log.warning("%s: abort with target %s not live — its "
+                        "half-built replica is unreachable and will "
+                        "remain as disk state", rec.partition, rec.target)
+        try:
+            self._sweep_snapshot()
+            if rec.pin_before is not None:
+                self.coord.put(self._path("placements", rec.partition),
+                               rec.pin_before.encode())
+            else:
+                self.coord.delete_if_exists(
+                    self._path("placements", rec.partition))
+        finally:
+            fp.hit("move.record")
+            self.coord.delete_if_exists(self._record_path())
+            self._stats.incr("shard_moves.aborted")
+            self._bump_summary("aborted")
+            self.close()
+        log.info("%s: move %s aborted at phase %s", rec.partition,
+                 rec.move_id, rec.phase)
+
+
+# ---------------------------------------------------------------------------
+# drain-node: move every replica off one instance
+# ---------------------------------------------------------------------------
+
+
+def list_active_moves(coord: CoordinatorClient,
+                      cluster: str) -> List[MoveRecord]:
+    out: List[MoveRecord] = []
+    for p in coord.list(cluster_path(cluster, "moves")):
+        raw = coord.get_or_none(cluster_path(cluster, "moves", p))
+        if raw:
+            try:
+                out.append(MoveRecord.decode(raw))
+            except (ValueError, TypeError, UnicodeDecodeError):
+                continue
+    return out
+
+
+def drain_node(coord: CoordinatorClient, cluster: str, node: str,
+               store_uri: str, admin: Optional[AdminClient] = None,
+               flags: Optional[MoveFlags] = None,
+               log_fn=log.info) -> List[MoveRecord]:
+    """Move every partition ``node`` serves to other live instances —
+    the minimal whole-node evacuation built on move-shard. Targets are
+    chosen least-loaded-first among live instances not already hosting
+    the partition (the round-14 ``/cluster_stats`` hot-spot ranking is
+    the richer signal a future rebalancer consumes; shard COUNT is the
+    honest minimum for an evacuation). Sequential by design: an
+    evacuation should trickle, not trample serving traffic — the
+    per-move IngestGate and write-pause bounds apply to each step."""
+    path = lambda *p: cluster_path(cluster, *p)  # noqa: E731
+    states_of = {}
+    for iid in coord.list(path("currentstates")):
+        states_of[iid] = decode_states(
+            coord.get_or_none(path("currentstates", iid)))
+    instances = set()
+    for iid in coord.list(path("instances")):
+        if coord.get_or_none(path("instances", iid)) is not None:
+            instances.add(iid)
+    partitions = [p for p, st in states_of.get(node, {}).items()
+                  if st in _SERVING]
+    if not partitions:
+        log_fn(f"drain {node}: nothing to move")
+        return []
+    done: List[MoveRecord] = []
+    for partition in sorted(partitions):
+        hosting = {iid for iid, st in states_of.items()
+                   if st.get(partition) in _SERVING}
+        candidates = [iid for iid in instances
+                      if iid != node and iid not in hosting]
+        if not candidates:
+            raise MoveError(
+                f"drain {node}: no candidate instance for {partition} "
+                f"(every live node already hosts it)")
+        load = {iid: sum(1 for st in states_of.get(iid, {}).values()
+                         if st in _SERVING) for iid in candidates}
+        target = min(candidates, key=lambda iid: (load[iid], iid))
+        log_fn(f"drain {node}: moving {partition} -> {target}")
+        mv = ShardMove.start(coord, cluster, partition, node, target,
+                             store_uri, admin=admin, flags=flags)
+        done.append(mv.run())
+        # refresh state: the completed move changed hosting + load
+        for iid in (node, target):
+            states_of[iid] = decode_states(
+                coord.get_or_none(path("currentstates", iid)))
+    log_fn(f"drain {node}: {len(done)} partition(s) moved")
+    return done
+
+
+# ---------------------------------------------------------------------------
+# DirectShardMove: coordinator-less variant (macro-bench / static clusters)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DirectNode:
+    host: str
+    admin_port: int
+    repl_port: int
+
+    @property
+    def admin_addr(self) -> Tuple[str, int]:
+        return (self.host, self.admin_port)
+
+
+@dataclass
+class DirectMovePlan:
+    db_name: str
+    source: DirectNode            # node donating the replica
+    target: DirectNode            # node receiving it
+    leader: DirectNode            # current leader (== source for a
+    # leader move)
+    followers: List[DirectNode] = field(default_factory=list)  # other
+    # replicas to repoint on a leader flip (excluding source/target)
+    store_uri: str = ""
+    snapshot_prefix: str = ""
+
+
+class DirectShardMove:
+    """The same snapshot → restore → catch-up → flip sequence driven by
+    plain admin RPCs against a static (coordinator-less) cluster: the
+    macro-bench's mid-bench move and script-driven deployments. The
+    cutover here mints the epoch bump itself (live epoch + 1, stamped
+    on every promote/repoint/demote RPC) since there is no controller
+    ledger to do it; the write pause plays the same tail-bounding role.
+    """
+
+    def __init__(self, plan: DirectMovePlan,
+                 admin: Optional[AdminClient] = None,
+                 flags: Optional[MoveFlags] = None):
+        self.plan = plan
+        self.flags = flags or MoveFlags()
+        self.admin = admin or AdminClient()
+        self._owns_admin = admin is None
+        if not self.plan.snapshot_prefix:
+            self.plan.snapshot_prefix = (
+                f"moves/{plan.db_name}/{uuid.uuid4().hex[:12]}")
+        self.timings_ms: Dict[str, float] = {}
+
+    def _timed(self, name: str, fn) -> None:
+        t0 = time.monotonic()
+        fn()
+        self.timings_ms[name] = round((time.monotonic() - t0) * 1e3, 1)
+
+    def run(self) -> Dict[str, float]:
+        try:
+            self._timed("snapshot", self._snapshot)
+            self._timed("restore", self._restore)
+            self._timed("catchup", self._catchup)
+            self._timed("cutover", self._cutover)
+            self._timed("retire", self._retire)
+            return dict(self.timings_ms)
+        finally:
+            if self._owns_admin:
+                self.admin.close()
+                self._owns_admin = False
+
+    def _snapshot(self) -> None:
+        fp.hit("move.snapshot")
+        p = self.plan
+        self.admin.backup_db_to_store(
+            p.source.admin_addr, p.db_name, p.store_uri,
+            p.snapshot_prefix)
+
+    def _restore(self) -> None:
+        fp.hit("move.restore")
+        p = self.plan
+        self.admin.restore_db_from_store(
+            p.target.admin_addr, p.db_name, p.store_uri,
+            p.snapshot_prefix, upstream=(p.leader.host,
+                                         p.leader.repl_port),
+            role="OBSERVER")
+
+    def _lag(self) -> Optional[int]:
+        p = self.plan
+        lseq = self.admin.get_sequence_number(p.leader.admin_addr,
+                                              p.db_name)
+        tseq = self.admin.get_sequence_number(p.target.admin_addr,
+                                              p.db_name)
+        if lseq is None or tseq is None:
+            return None
+        return max(0, lseq - tseq)
+
+    def _catchup(self) -> None:
+        fp.hit("move.catchup")
+        flags = self.flags
+        deadline = time.monotonic() + flags.catchup_timeout
+        while True:
+            lag = self._lag()
+            if lag is not None and lag <= flags.catchup_lag_threshold:
+                return
+            if time.monotonic() > deadline:
+                raise MoveError(
+                    f"{self.plan.db_name}: direct catch-up stuck at lag "
+                    f"{lag} past {flags.catchup_timeout}s")
+            time.sleep(flags.poll_interval)
+
+    def _cutover(self) -> None:
+        fp.hit("move.flip")
+        p, flags = self.plan, self.flags
+        moving_leader = (p.source.admin_addr == p.leader.admin_addr)
+        if moving_leader:
+            # pause, drain to exact equality, then promote under a
+            # bumped epoch — the deposed source fences on the first
+            # stale frame it sees
+            drained = False
+            for _attempt in range(flags.cutover_attempts):
+                self.admin.pause_db_writes(
+                    p.leader.admin_addr, p.db_name,
+                    flags.cutover_pause_ms)
+                pause_deadline = (time.monotonic()
+                                  + flags.cutover_pause_ms / 1000.0)
+                while time.monotonic() < pause_deadline:
+                    if self._lag() == 0:
+                        drained = True
+                        break
+                    time.sleep(flags.poll_interval)
+                if drained:
+                    break
+            if not drained:
+                raise MoveError(f"{p.db_name}: direct cutover never "
+                                f"drained the tail")
+            info = self.admin.check_db(p.leader.admin_addr, p.db_name)
+            epoch = int((info or {}).get("epoch") or 0) + 1
+            # FAIL-STOP ordering: demote the source BEFORE promoting
+            # the target. A mover that dies (or an RPC that fails)
+            # anywhere in this sequence then leaves the shard
+            # LEADERLESS — writes refused until an operator re-promotes
+            # — never with two live leaders. (The old promote-first
+            # order claimed the source would end up fenced, but a
+            # demote-RPC failure left it an unfenced LEADER whose pause
+            # simply expired: nothing ever delivers the new epoch to a
+            # leader nobody pulls from.)
+            self.admin.change_db_role_and_upstream(
+                p.source.admin_addr, p.db_name, "FOLLOWER",
+                (p.target.host, p.target.repl_port), epoch=epoch)
+            self.admin.change_db_role_and_upstream(
+                p.target.admin_addr, p.db_name, "LEADER", epoch=epoch)
+            for fol in p.followers:
+                self.admin.change_db_role_and_upstream(
+                    fol.admin_addr, p.db_name, "FOLLOWER",
+                    (p.target.host, p.target.repl_port), epoch=epoch)
+        else:
+            # follower move: no leadership flip — the target just joins
+            # the ack set (OBSERVER -> FOLLOWER) before the source
+            # retires, so replication strength never dips
+            self.admin.change_db_role_and_upstream(
+                p.target.admin_addr, p.db_name, "FOLLOWER",
+                (p.leader.host, p.leader.repl_port))
+
+    def _retire(self) -> None:
+        fp.hit("move.retire")
+        p = self.plan
+        try:
+            self.admin.clear_db(p.source.admin_addr, p.db_name,
+                                reopen=False)
+        except (RpcError, RpcApplicationError) as e:
+            if getattr(e, "code", None) != "DB_NOT_FOUND":
+                raise
+        try:
+            store = build_object_store(p.store_uri)
+            for key in store.list_objects(
+                    p.snapshot_prefix.rstrip("/") + "/"):
+                store.delete_object(key)
+        except Exception:
+            log.warning("%s: direct move snapshot sweep failed",
+                        p.db_name, exc_info=True)

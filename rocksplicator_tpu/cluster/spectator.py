@@ -136,9 +136,14 @@ class Spectator:
                     self._aggregator = ClusterStatsAggregator()
                 endpoints, per_db = endpoints_from_shard_map(shard_map)
                 if endpoints:
-                    self.cluster_stats = \
-                        self._aggregator.scrape_and_aggregate(
-                            endpoints, per_db)
+                    stats = self._aggregator.scrape_and_aggregate(
+                        endpoints, per_db)
+                    # live shard moves (round 15): the movers write
+                    # phase/bytes/lag progress into the coordinator's
+                    # move ledger — surfacing it here is what lets an
+                    # operator watch a move from /cluster_stats
+                    stats["shard_moves"] = self._shard_moves()
+                    self.cluster_stats = stats
                 if not endpoint_registered:
                     # serve /cluster_stats off this process's status
                     # server when one is running (never start one here —
@@ -154,6 +159,34 @@ class Spectator:
                 backoff_step(_REFRESH_RETRY, attempt,
                              op="spectator.scrape", rng=rng)
                 attempt += 1
+
+    def _shard_moves(self) -> dict:
+        """Per-move progress (phase, bytes ingested, catch-up lag) from
+        the coordinator move ledger (one scan implementation:
+        shard_move.list_active_moves), plus the cluster-lifetime
+        started/completed/aborted/resumed counters."""
+        import json as _json
+
+        from .shard_move import list_active_moves
+
+        active = {
+            rec.partition: {
+                "move_id": rec.move_id, "phase": rec.phase,
+                "source": rec.source, "target": rec.target,
+                "bytes_ingested": rec.bytes_ingested,
+                "catchup_lag": rec.catchup_lag,
+                "updated_ms": rec.updated_ms,
+            }
+            for rec in list_active_moves(self.coord, self.cluster)
+        }
+        counters = {}
+        raw = self.coord.get_or_none(self._path("moves_summary"))
+        if raw:
+            try:
+                counters = _json.loads(bytes(raw).decode())
+            except (ValueError, UnicodeDecodeError):
+                counters = {}
+        return {"active": active, "counters": counters}
 
     def cluster_stats_json(self) -> str:
         import json

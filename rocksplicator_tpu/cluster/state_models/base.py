@@ -88,12 +88,25 @@ class ClusterContext:
     def __init__(self, coord, admin, cluster: str, instance,
                  backup_store_uri: Optional[str] = None,
                  catch_up_timeout: float = 60.0,
-                 view_cluster: Optional[str] = None):
+                 view_cluster: Optional[str] = None,
+                 promotion_seq_slack: Optional[int] = None):
         from ..model import cluster_path
 
         self.coord = coord            # CoordinatorClient
         self.admin = admin            # AdminClient
         self.cluster = cluster
+        # 3-node-failure promotion guard slack: refuse promotion when
+        # the candidate is more than this many seqs behind the last
+        # checkpointed leader seq. Defaults to the rebuild gap
+        # (reference behavior); chaos-sized clusters tighten it so an
+        # empty replica can never be promoted over a transiently-
+        # invisible data-rich peer (found by the reshard harness: an
+        # absolute 100k slack is scale-blind at small workloads).
+        from .leader_follower import REBUILD_SEQ_GAP as _GAP
+
+        self.promotion_seq_slack = (
+            int(promotion_seq_slack) if promotion_seq_slack is not None
+            else _GAP)
         # The cluster whose topology (instances / current states) the
         # state models observe. Differs from ``cluster`` for CDC
         # participants, which join their own cluster but watch the DATA

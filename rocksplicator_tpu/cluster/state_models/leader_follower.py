@@ -154,12 +154,27 @@ class LeaderFollowerStateModel(StateModel):
                     ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream,
                     epoch=epoch,
                 )
-            # needRebuildDB: far behind the best replica -> snapshot rebuild
+            # needRebuildDB: far behind the best replica -> snapshot
+            # rebuild; ALSO rebuild when the donor's WAL no longer
+            # reaches back to our seq — the serve path would raise
+            # "WAL gap … puller must rebuild" forever and plain
+            # catch-up can never terminate (the reference checks WAL
+            # availability, not just the seq gap; found by the reshard
+            # chaos harness: a deposed-resync'd replica rejoining from
+            # seq 0 wedged behind a donor whose WAL was purged)
             local = ctx.admin.get_sequence_number(
                 ctx.local_admin_addr, self.db_name
             ) or 0
+            need_rebuild = best_seq - local > REBUILD_SEQ_GAP
+            if (not need_rebuild and best_addr is not None
+                    and best_seq > local):
+                donor = ctx.admin.check_db(
+                    (best_addr.host, best_addr.admin_port), self.db_name)
+                oldest = (donor or {}).get("oldest_wal_seq")
+                if oldest is not None and local + 1 < int(oldest):
+                    need_rebuild = True
             if (
-                best_seq - local > REBUILD_SEQ_GAP
+                need_rebuild
                 and ctx.backup_store_uri
                 and best_addr is not None
             ):
@@ -237,11 +252,16 @@ class LeaderFollowerStateModel(StateModel):
                     )
             # 3-node-failure guard (reference :291-303): refuse promotion if
             # we're far behind the last known leader seq in the coordinator.
+            # Slack is ctx.promotion_seq_slack (default = REBUILD_SEQ_GAP):
+            # chaos-sized clusters tighten it so a data-poor candidate can
+            # never be promoted past a checkpointed lineage it hasn't
+            # caught up to.
             persisted = ctx.get_partition_seq(self.partition)
             local = ctx.admin.get_sequence_number(
                 ctx.local_admin_addr, self.db_name
             ) or 0
-            if persisted is not None and local + REBUILD_SEQ_GAP < persisted:
+            if persisted is not None and \
+                    local + ctx.promotion_seq_slack < persisted:
                 raise TransitionError(
                     f"{self.partition}: local seq {local} too far behind "
                     f"last leader seq {persisted}; refusing promotion"

@@ -140,8 +140,38 @@ class DedupTask(TaskRunner):
         return {}
 
 
+class MoveShardTask(TaskRunner):
+    """Live shard move through the resumable step machine
+    (cluster/shard_move.py) — the queued-job face of the reference's
+    Helix Bootstrap/backup+restore task flows. The job names the
+    partition, donor and destination instance ids, and the snapshot
+    store; ``resume: true`` continues a recorded in-flight move
+    instead of starting a new one."""
+
+    name = "MoveShard"
+
+    def run(self, worker, job):
+        from .shard_move import ShardMove
+
+        partition = job["partition"]
+        if job.get("resume"):
+            mv = ShardMove.resume(worker.coord, worker.cluster,
+                                  partition, admin=worker.admin)
+        else:
+            mv = ShardMove.start(
+                worker.coord, worker.cluster, partition,
+                job["source"], job["target"], job["store_uri"],
+                admin=worker.admin,
+            )
+        rec = mv.run()
+        return {"move_id": rec.move_id, "source": rec.source,
+                "target": rec.target,
+                "bytes_ingested": rec.bytes_ingested}
+
+
 TASK_RUNNERS: Dict[str, TaskRunner] = {
-    t.name: t() for t in (BackupTask, RestoreTask, IngestTask, DedupTask)
+    t.name: t() for t in (BackupTask, RestoreTask, IngestTask, DedupTask,
+                          MoveShardTask)
 }
 
 

@@ -132,6 +132,12 @@ class ReplicatedDB:
         self._epoch_lock = threading.Lock()
         self._fenced_by: Optional[int] = None
         self.flags = flags or ReplicationFlags()
+        # Live shard move (round 15): monotonic deadline until which NEW
+        # leader writes are refused (WRITE_PAUSED, retryable). The move
+        # cutover arms this so WAL-tail catch-up has a bounded tail on a
+        # hot shard; ALWAYS auto-expiring — a crashed move coordinator
+        # can never wedge the shard. 0.0 = not paused.
+        self._write_paused_until = 0.0
         self._loop = loop
         self._executor = executor
         self._pool = pool
@@ -175,6 +181,22 @@ class ReplicatedDB:
         self._probe_task: Optional[asyncio.Task] = None
         self._empty_pulls = 0
         self._conn_errors = 0
+        # set when the upstream answered WAL_GAP: our position predates
+        # its oldest surviving WAL record, so pulling can NEVER catch up
+        # — the participant's periodic loop reads this (via check_db)
+        # and forces a snapshot rebuild; cleared by any successful pull
+        # (an upstream repoint may land on a deeper-WAL donor)
+        self.pull_stalled_wal_gap = False
+        # set when this follower is PERSISTENTLY ahead of a direct
+        # LEADER upstream's own committed seq: it applied writes from a
+        # deposed leader inside the r11 visibility window (before the
+        # new epoch reached it), so its suffix is not in the lineage
+        # and pulling can never reconcile it. The participant loop
+        # clears + rejoins the replica (the follower analog of the
+        # deposed-leader resync). Never reset by success — the flag
+        # dies with the resync's reopen.
+        self.pull_diverged = False
+        self._ahead_pulls = 0
         # pull-error backoff: exp backoff + jitter via the unified
         # RetryPolicy (utils/retry_policy.py) — jittered within
         # [min, cap], cap growing from the reference's min delay toward
@@ -258,14 +280,32 @@ class ReplicatedDB:
         return self._fenced_by is not None
 
     def adopt_epoch(self, epoch: int) -> None:
-        """Raise this db's epoch (never lowers, never fences). Used by
-        followers adopting a newer epoch from upstream responses and by
-        the admin set_db_epoch path (a sticky leader whose assignment
-        epoch moved without a role transition)."""
+        """Raise this db's epoch (never lowers). Used by followers
+        adopting a newer epoch from upstream responses and by the admin
+        set_db_epoch path (a sticky leader whose assignment epoch moved
+        without a role transition).
+
+        RE-ANOINTMENT: adopting an epoch STRICTLY ABOVE the one that
+        fenced us clears the fence — the controller mints a fresh epoch
+        exactly when it issues leadership, so an assignment carrying
+        one means this node is the legitimate leader again under it
+        (and any peer still at the fencing epoch is now the stale one).
+        Without this, a fenced-then-sticky-re-elected leader satisfied
+        the control plane while its data plane refused every write and
+        serve forever (found wedged by the reshard chaos: lineages=[])."""
         epoch = int(epoch)
+        unfenced = False
         with self._epoch_lock:
             if epoch > self.epoch:
                 self.epoch = epoch
+            if (self._fenced_by is not None
+                    and self.epoch > self._fenced_by):
+                self._fenced_by = None
+                unfenced = True
+        if unfenced:
+            log.warning(
+                "%s: UNFENCED — re-anointed at epoch %d (above the "
+                "deposing epoch); serving resumes", self.name, self.epoch)
 
     def _reject_stale_epoch(self, remote_epoch) -> bool:
         """Process the epoch carried on an inbound replicate/ack frame.
@@ -316,6 +356,40 @@ class ReplicatedDB:
             )
 
     # ------------------------------------------------------------------
+    # cutover write pause (live shard moves, round 15)
+    # ------------------------------------------------------------------
+
+    @property
+    def write_paused(self) -> bool:
+        return time.monotonic() < self._write_paused_until
+
+    def pause_writes(self, duration_ms: float) -> None:
+        """Refuse NEW leader writes for ``duration_ms`` — the shard-move
+        cutover's tail bound: with the ingress paused, WAL-tail catch-up
+        converges to exact seq equality instead of chasing a hot shard
+        forever. Auto-expires (never latched), so a mover that dies
+        mid-cutover leaves the shard serving again within the window;
+        ``duration_ms <= 0`` resumes immediately. In-flight writes and
+        their acks are untouched — the pause only gates NEW admissions,
+        so it can never turn an acked write into a lost one."""
+        if duration_ms <= 0:
+            self._write_paused_until = 0.0
+            log.info("%s: write pause cleared", self.name)
+            return
+        self._write_paused_until = time.monotonic() + duration_ms / 1000.0
+        log.info("%s: writes paused for %.0f ms (move cutover)",
+                 self.name, duration_ms)
+
+    def _check_write_paused(self) -> None:
+        if time.monotonic() < self._write_paused_until:
+            self._stats.incr(M["write_paused"])
+            raise RpcApplicationError(
+                ReplicateErrorCode.WRITE_PAUSED.value,
+                f"{self.name}: writes paused for move cutover "
+                f"({max(0.0, self._write_paused_until - time.monotonic()) * 1e3:.0f} ms left)",
+            )
+
+    # ------------------------------------------------------------------
     # leader write path (any thread)
     # ------------------------------------------------------------------
 
@@ -357,6 +431,7 @@ class ReplicatedDB:
                 "NOT_LEADER", f"{self.name} role is {self.role.value}"
             )
         self._check_fenced()
+        self._check_write_paused()
         # The per-write trace: root span with wal_write through fsync;
         # the ack_wait phase becomes a DEFERRED child span finished at
         # ack resolution, so sampled traces show the real (overlapping)
@@ -397,6 +472,7 @@ class ReplicatedDB:
                 "NOT_LEADER", f"{self.name} role is {self.role.value}"
             )
         self._check_fenced()
+        self._check_write_paused()
         with start_span("repl.write_group", db=self.name,
                         n=len(batches)) as sp:
             total_bytes = 0
@@ -753,9 +829,11 @@ class ReplicatedDB:
             if first:
                 first = False
                 if start_seq > from_seq:
-                    raise ValueError(
-                        f"WAL gap: requested seq {from_seq}, oldest available "
-                        f"{start_seq} (purged — puller must rebuild)"
+                    raise RpcApplicationError(
+                        ReplicateErrorCode.WAL_GAP.value,
+                        f"WAL gap: requested seq {from_seq}, oldest "
+                        f"available {start_seq} (purged — puller must "
+                        f"rebuild)",
                     )
             # header skim, not decode_batch + extract_timestamp_ms: the
             # serve path needs only (count, stamp) per shipped update
@@ -1120,6 +1198,7 @@ class ReplicatedDB:
                 applied, source_role = await self._pull_once()
                 self._conn_errors = 0
                 self._pull_retry_attempt = 0
+                self.pull_stalled_wal_gap = False
                 if (
                     applied == 0
                     and self.role is ReplicaRole.FOLLOWER
@@ -1147,6 +1226,20 @@ class ReplicatedDB:
                 self._conn_errors = 0
                 if e.code == ReplicateErrorCode.SOURCE_NOT_FOUND.value:
                     await self._maybe_reset_upstream(force_sample=False)
+                elif e.code == ReplicateErrorCode.WAL_GAP.value:
+                    # the upstream's WAL was purged past our position:
+                    # no amount of pulling can ever catch us up. Flag
+                    # the stall (the participant loop turns it into a
+                    # snapshot rebuild) and still consult the resolver
+                    # — a repoint to a deeper-WAL donor may heal it
+                    # without a rebuild.
+                    if not self.pull_stalled_wal_gap:
+                        self.pull_stalled_wal_gap = True
+                        self._stats.incr(M["wal_gap_stalls"])
+                        log.warning(
+                            "%s: WAL-tail catch-up STALLED (%s) — "
+                            "snapshot rebuild required", self.name, e)
+                    await self._maybe_reset_upstream(force_sample=True)
                 elif e.code == ReplicateErrorCode.STALE_EPOCH.value:
                     # a KNOWN-deposed upstream (or one that outran us):
                     # consult the resolver unsampled — faster pulls at
@@ -1267,6 +1360,7 @@ class ReplicatedDB:
             # every pull response refreshes the commit-point estimate
             # bounded follower reads check their lag against
             self._adopt_commit_point(result)
+            self._note_divergence(result, source_role)
             self._adapt_max_updates(result, updates)
             if not updates:
                 # idle upstream: let the pipeline drain so apply errors
@@ -1350,6 +1444,37 @@ class ReplicatedDB:
             )
         if role != ReplicaRole.OBSERVER.value and self.replication_mode == 2:
             self._acked.post(int(applied_seq))
+
+    def _note_divergence(self, result, source_role) -> None:
+        """Detect a lineage-divergent suffix: a FOLLOWER persistently
+        AHEAD of a direct LEADER upstream's own committed seq holds
+        records that are not in the lineage — it applied them from a
+        deposed leader inside the visibility window, before the new
+        epoch reached it. Pulling can never reconcile this (the
+        upstream serves only seqs above ours, and our extra seqs shadow
+        the lineage's), so flag it for the participant's resync loop.
+        Requires several CONSECUTIVE ahead observations from a LEADER
+        source: a momentarily-lagging middle hop or a racing estimate
+        must never trigger a data-destroying resync."""
+        if (self.role is not ReplicaRole.FOLLOWER
+                or source_role != ReplicaRole.LEADER.value):
+            self._ahead_pulls = 0
+            return
+        latest = (result or {}).get("latest_seq")
+        applied = self._applied_through
+        if latest is None or applied is None \
+                or int(latest) >= int(applied):
+            self._ahead_pulls = 0
+            return
+        self._ahead_pulls += 1
+        if self._ahead_pulls >= 3 and not self.pull_diverged:
+            self.pull_diverged = True
+            self._stats.incr(M["diverged_stalls"])
+            log.warning(
+                "%s: applied %d is AHEAD of the leader's committed %d "
+                "for %d consecutive pulls — divergent suffix (deposed-"
+                "leader window write); resync required",
+                self.name, applied, int(latest), self._ahead_pulls)
 
     def _adapt_max_updates(self, result, updates) -> None:
         """Size the NEXT pull to the upstream's reported backlog: behind

@@ -44,6 +44,18 @@ class ReplicateErrorCode(str, enum.Enum):
     # The write/read entry was asked of a non-leader (reads with a
     # leader-only requirement, writes anywhere but the leader).
     NOT_LEADER = "NOT_LEADER"
+    # The puller's position predates the oldest WAL record this server
+    # can still serve (purge outran the puller): WAL catch-up can NEVER
+    # succeed — the puller must flag itself stalled so the control
+    # plane rebuilds it from a snapshot (rocksdb GetUpdatesSince
+    # NotFound parity; round 15, found by the reshard chaos).
+    WAL_GAP = "WAL_GAP"
+    # Live shard move (round 15): the leader briefly refuses NEW writes
+    # while a move's cutover drains the WAL tail to the target — the
+    # write-pause that BOUNDS catch-up on a hot shard. Always
+    # auto-expiring (a crashed move coordinator can never wedge the
+    # shard); clients retry after the pause window, reads are unaffected.
+    WRITE_PAUSED = "WRITE_PAUSED"
 
 
 # Read-path counters (round 13 — bounded-staleness follower reads).
@@ -83,6 +95,9 @@ REPLICATOR_METRICS = dict(
     stale_epoch_rejects="replicator.stale_epoch_rejects",
     fenced="replicator.fenced",
     write_window_full="replicator.write_window_full_rejects",
+    write_paused="replicator.write_paused_rejects",
+    wal_gap_stalls="replicator.wal_gap_stalls",
+    diverged_stalls="replicator.diverged_stalls",
     replication_lag_ms="replicator.replication_lag_ms",
     iter_cache_hits="replicator.iter_cache_hits",
     iter_cache_misses="replicator.iter_cache_misses",

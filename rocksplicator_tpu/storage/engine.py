@@ -901,6 +901,12 @@ class DB:
         ``seq``. Followers pass latest_local+1 (replicated_db.cpp:486-505)."""
         return wal_mod.iter_updates(self._wal_dir, seq)
 
+    def oldest_wal_seq(self) -> Optional[int]:
+        """First seq the WAL can still serve (None = empty WAL). A
+        peer below this cannot WAL-catch-up from us — it must rebuild
+        from a snapshot (needRebuildDB's WAL-availability check)."""
+        return wal_mod.oldest_seq(self._wal_dir)
+
     def get_updates_cursor(self, seq: int) -> "wal_mod.WalTailCursor":
         """Resumable tail cursor over the same records as
         ``get_updates_since`` — survives reaching the live tail, so the

@@ -400,6 +400,17 @@ def _iter_segment(
             f.truncate(good_end)
 
 
+def oldest_seq(wal_dir: str) -> Optional[int]:
+    """First sequence number the WAL can still serve (the oldest
+    surviving segment's name seq), or None for an empty/missing WAL.
+    The needRebuildDB check uses this: a replica whose local seq is
+    BELOW a donor's oldest WAL seq can never catch up over the
+    replication plane (the serve path raises "WAL gap … puller must
+    rebuild") and must rebuild from a snapshot instead."""
+    segs = _segments(wal_dir)
+    return segs[0][0] if segs else None
+
+
 def iter_updates(
     wal_dir: str, since_seq: int = 0, truncate_torn: bool = False
 ) -> Iterator[Tuple[int, bytes]]:

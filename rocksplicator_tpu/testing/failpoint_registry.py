@@ -48,4 +48,14 @@ REGISTRY = {
     "controller.assign": "controller assignment-pass failure",
     "admin.ingest.engine": "admin ingest fault before engine ingest",
     "admin.ingest.meta": "admin ingest fault between engine and meta",
+    # live shard moves (round 15): one seam per step-machine phase —
+    # arming fail_nth:1 on any of them IS the "kill the move
+    # coordinator at this phase" chaos schedule (the raise unwinds the
+    # mover, leaving the durable record for resume/abort)
+    "move.record": "shard-move ledger write failure (any phase entry)",
+    "move.snapshot": "shard-move snapshot (backup) phase failure",
+    "move.restore": "shard-move bulk-ingest (restore) phase failure",
+    "move.catchup": "shard-move WAL-tail catch-up phase failure",
+    "move.flip": "shard-move epoch-bumped cutover phase failure",
+    "move.retire": "shard-move source-retire phase failure",
 }

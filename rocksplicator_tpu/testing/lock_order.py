@@ -19,7 +19,7 @@ runtime's dynamic cycle detection.
 RANKS = {
     "rocksplicator_tpu/replication/ack_window.py:127": ('AckWindow._cond', 0),
     "rocksplicator_tpu/admin/handler.py:157": ('AdminHandler._db_admin_lock', 1),
-    "rocksplicator_tpu/admin/ingest_pipeline.py:89": ('BatchCompactor._lock', 2),
+    "rocksplicator_tpu/admin/ingest_pipeline.py:123": ('BatchCompactor._lock', 2),
     "rocksplicator_tpu/storage/sst.py:99": ('BlockCache._instance_lock', 3),
     "rocksplicator_tpu/storage/sst.py:103": ('BlockCache._lock', 4),
     "rocksplicator_tpu/kafka/network.py:91": ('BrokerHandler._log_lock', 5),
@@ -35,7 +35,7 @@ RANKS = {
     "rocksplicator_tpu/utils/flags.py:34": ('FlagRegistry._lock', 15),
     "rocksplicator_tpu/utils/graceful_shutdown.py:30": ('GracefulShutdownHandler._lock', 16),
     "rocksplicator_tpu/utils/hot_key_detector.py:27": ('HotKeyDetector._lock', 17),
-    "rocksplicator_tpu/admin/ingest_pipeline.py:50": ('IngestGate._lock', 18),
+    "rocksplicator_tpu/admin/ingest_pipeline.py:51": ('IngestGate._lock', 18),
     "rocksplicator_tpu/rpc/ioloop.py:37": ('IoLoop._default_lock', 19),
     "rocksplicator_tpu/replication/iter_cache.py:41": ('IterCache._lock', 20),
     "rocksplicator_tpu/kafka/watcher.py:165": ('KafkaBrokerFileWatcher._lock', 21),
@@ -48,11 +48,11 @@ RANKS = {
     "rocksplicator_tpu/kafka/broker.py:49": ('MockKafkaCluster._cond', 28),
     "rocksplicator_tpu/utils/file_watcher.py:173": ('MultiFilePoller._lock', 29),
     "rocksplicator_tpu/utils/object_lock.py:18": ('ObjectLock._guard', 30),
-    "rocksplicator_tpu/cluster/participant.py:74": ('Participant._publish_lock', 31),
-    "rocksplicator_tpu/replication/replicated_db.py:149": ('ReplicatedDB._ack_state_lock', 32),
+    "rocksplicator_tpu/cluster/participant.py:76": ('Participant._publish_lock', 31),
+    "rocksplicator_tpu/replication/replicated_db.py:155": ('ReplicatedDB._ack_state_lock', 32),
     "rocksplicator_tpu/replication/replicated_db.py:132": ('ReplicatedDB._epoch_lock', 33),
-    "rocksplicator_tpu/replication/replicated_db.py:155": ('ReplicatedDB._expiry_lock', 34),
-    "rocksplicator_tpu/replication/replicated_db.py:219": ('ReplicatedDB._write_traces_lock', 35),
+    "rocksplicator_tpu/replication/replicated_db.py:161": ('ReplicatedDB._expiry_lock', 34),
+    "rocksplicator_tpu/replication/replicated_db.py:241": ('ReplicatedDB._write_traces_lock', 35),
     "rocksplicator_tpu/replication/replicator.py:42": ('Replicator._instance_lock', 36),
     "rocksplicator_tpu/utils/retry_policy.py:57": ('RetryBudget._lock', 37),
     "rocksplicator_tpu/utils/s3_stub.py:48": ('S3StubServer.lock', 38),
@@ -75,7 +75,7 @@ RANKS = {
     "rocksplicator_tpu/storage/engine.py:187": ('DB._lock', 55),
     "rocksplicator_tpu/storage/engine.py:223": ('DB._manifest_mutex', 56),
     "rocksplicator_tpu/utils/file_watcher.py:40": ('FileWatcher._instance_lock', 57),
-    "rocksplicator_tpu/cluster/participant.py:73": ('Participant._state_lock', 58),
+    "rocksplicator_tpu/cluster/participant.py:75": ('Participant._state_lock', 58),
     "rocksplicator_tpu/storage/wal.py:68": ('WalWriter._sync_lock', 59),
 }
 
@@ -83,7 +83,7 @@ RANKS = {
 ORDER = {
     ("rocksplicator_tpu/admin/handler.py:157", "rocksplicator_tpu/admin/db_manager.py:20"),
     ("rocksplicator_tpu/cluster/coordinator.py:303", "rocksplicator_tpu/cluster/coordinator.py:296"),
-    ("rocksplicator_tpu/cluster/participant.py:74", "rocksplicator_tpu/cluster/participant.py:73"),
+    ("rocksplicator_tpu/cluster/participant.py:76", "rocksplicator_tpu/cluster/participant.py:75"),
     ("rocksplicator_tpu/storage/engine.py:187", "rocksplicator_tpu/storage/wal.py:68"),
     ("rocksplicator_tpu/storage/engine.py:216", "rocksplicator_tpu/storage/engine.py:187"),
     ("rocksplicator_tpu/storage/engine.py:216", "rocksplicator_tpu/storage/engine.py:223"),

@@ -1,0 +1,598 @@
+"""Live elastic shard moves (round 15): the resumable step machine,
+placement pins, the cutover write pause, WAL-tail catch-up, and the
+reshard chaos harness.
+
+Covers the ISSUE-13 matrix:
+- ``ReplicatedDB.pause_writes``: WRITE_PAUSED on new writes, auto-expiry
+  (a dead mover can never wedge the shard), explicit clear, counter;
+- ``assign_resource`` placement pins: replica-set override, preferred-
+  leader steering THROUGH the two-phase demote→mint→promote machinery,
+  dead-pin fallback to rendezvous, dead-preferred fallback to sticky;
+- WAL-tail catch-up convergence under sustained writes: an OBSERVER
+  target chases a writing leader, survives a target restart
+  mid-catch-up (cursor-served resume from its applied seq), and
+  reaches EXACT seq equality only because the cutover write pause
+  bounds the tail;
+- ``DirectShardMove`` end to end over real admin RPCs: snapshot →
+  gate-bounded restore (OBSERVER) → catch-up → paused epoch-bumped
+  cutover → retire, with every committed write readable on the new
+  leader and the source + snapshot garbage swept;
+- move/record codecs, IngestGate.enter_wait, spectator /cluster_stats
+  move section, failpoint-site registration;
+- the reshard chaos harness itself (2 schedules in tier-1; full run =
+  ``make reshard-smoke``) and its ``move_flip`` tooth.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from rocksplicator_tpu.cluster.model import (InstanceInfo,
+                                             PartitionAssignment,
+                                             PlacementPin)
+from rocksplicator_tpu.replication import (ReplicaRole, ReplicationFlags,
+                                           Replicator, StorageDbWrapper)
+from rocksplicator_tpu.rpc.errors import RpcApplicationError
+from rocksplicator_tpu.storage import DB, DBOptions, WriteBatch
+from rocksplicator_tpu.utils.stats import Stats
+
+DB_NAME = "seg00000"
+PARTITION = "seg_0"
+
+FLAGS = ReplicationFlags(
+    server_long_poll_ms=200,
+    pull_error_delay_min_ms=30,
+    pull_error_delay_max_ms=80,
+    ack_timeout_ms=2000,
+    consecutive_timeouts_to_degrade=1000,
+    empty_pulls_before_reset=1 << 30,
+)
+
+
+def wait_until(pred, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# cutover write pause
+# ---------------------------------------------------------------------------
+
+
+def test_pause_writes_refuses_then_auto_expires(tmp_path):
+    rep = Replicator(port=0, flags=FLAGS)
+    db = DB(str(tmp_path / "l"), DBOptions())
+    try:
+        rdb = rep.add_db(DB_NAME, StorageDbWrapper(db),
+                         ReplicaRole.LEADER, replication_mode=0)
+        rdb.write(WriteBatch().put(b"a", b"1"))
+        before = Stats.get().get_counter(
+            "replicator.write_paused_rejects")
+        rdb.pause_writes(250.0)
+        assert rdb.write_paused
+        with pytest.raises(RpcApplicationError) as ei:
+            rdb.write(WriteBatch().put(b"b", b"2"))
+        assert ei.value.code == "WRITE_PAUSED"
+        with pytest.raises(RpcApplicationError):
+            rdb.write_async_many([WriteBatch().put(b"c", b"3")])
+        assert Stats.get().get_counter(
+            "replicator.write_paused_rejects") >= before + 2
+        # AUTO-EXPIRY: the pause can never outlive its window — a mover
+        # that died after arming it leaves the shard serving again
+        assert wait_until(lambda: not rdb.write_paused, timeout=2.0)
+        rdb.write(WriteBatch().put(b"b", b"2"))
+        # explicit clear
+        rdb.pause_writes(60_000.0)
+        assert rdb.write_paused
+        rdb.pause_writes(0)
+        assert not rdb.write_paused
+        rdb.write(WriteBatch().put(b"d", b"4"))
+        assert db.get(b"d") == b"4"
+    finally:
+        rep.stop()
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# placement pins in the controller's assignment computation
+# ---------------------------------------------------------------------------
+
+
+def _instances(n):
+    return {
+        f"i{k}": InstanceInfo(instance_id=f"i{k}", host="127.0.0.1",
+                              admin_port=9000 + k, repl_port=9100 + k)
+        for k in range(n)
+    }
+
+
+def _assign(current, epochs, pins, instances):
+    from rocksplicator_tpu.cluster.controller import assign_resource
+    from rocksplicator_tpu.cluster.model import ResourceDef
+
+    per = {iid: {} for iid in instances}
+    changed = assign_resource(
+        ResourceDef("seg", num_shards=1, replicas=3), instances,
+        current, per, epochs, pins=pins)
+    return per, changed
+
+
+def test_pin_overrides_replica_set_and_steers_leader():
+    instances = _instances(4)
+    # i0 currently leads; pin moves the placement to i1,i2,i3 with i3
+    # preferred — phase 1: i0 still claims LEADER, so NO promotion and
+    # NO epoch mint (two-phase discipline holds under pins)
+    current = {"i0": {PARTITION: "LEADER"},
+               "i1": {PARTITION: "FOLLOWER"},
+               "i2": {PARTITION: "FOLLOWER"}}
+    epochs = {PARTITION: {"epoch": 3, "leader": "i0"}}
+    pin = {PARTITION: PlacementPin(replicas=["i1", "i2", "i3"],
+                                   preferred_leader="i3")}
+    per, changed = _assign(current, epochs, pin, instances)
+    assert changed == set()
+    assert "i0" not in {iid for iid, a in per.items()
+                        if PARTITION in a}  # dropped from placement
+    assert all(per[iid][PARTITION].state == "FOLLOWER"
+               for iid in ("i1", "i2", "i3"))
+    # phase 2: the old leader demoted/dropped — promote the preferred
+    # target and mint its epoch in the same pass
+    current = {"i1": {PARTITION: "FOLLOWER"},
+               "i2": {PARTITION: "FOLLOWER"},
+               "i3": {PARTITION: "FOLLOWER"}}
+    per, changed = _assign(current, epochs, pin, instances)
+    assert changed == {PARTITION}
+    assert per["i3"][PARTITION].state == "LEADER"
+    assert per["i3"][PARTITION].epoch == 4
+    assert epochs[PARTITION]["leader"] == "i3"
+
+
+def test_dead_pin_falls_back_to_rendezvous():
+    instances = _instances(3)
+    pin = {PARTITION: PlacementPin(replicas=["gone1", "gone2"],
+                                   preferred_leader="gone1")}
+    per, _ = _assign({}, {}, pin, instances)
+    placed = [iid for iid, a in per.items() if PARTITION in a]
+    assert len(placed) == 3  # rendezvous placement, pin ignored
+
+
+def test_dead_preferred_leader_falls_back_to_sticky():
+    instances = _instances(3)
+    current = {"i0": {PARTITION: "LEADER"},
+               "i1": {PARTITION: "FOLLOWER"},
+               "i2": {PARTITION: "FOLLOWER"}}
+    epochs = {PARTITION: {"epoch": 5, "leader": "i0"}}
+    pin = {PARTITION: PlacementPin(replicas=["i0", "i1", "i2", "dead"],
+                                   preferred_leader="dead")}
+    per, changed = _assign(current, epochs, pin, instances)
+    # the pinned preferred target is dead: leadership stays sticky on
+    # the live leader, no churn, no mint
+    assert per["i0"][PARTITION].state == "LEADER"
+    assert changed == set()
+
+
+# ---------------------------------------------------------------------------
+# codecs + gate
+# ---------------------------------------------------------------------------
+
+
+def test_placement_pin_codec_tolerates_garbage():
+    pin = PlacementPin(replicas=["a", "b"], preferred_leader="b",
+                       move_id="m1")
+    assert PlacementPin.decode(pin.encode()) == pin
+    assert PlacementPin.decode(None) is None
+    assert PlacementPin.decode(b"not json") is None
+
+
+def test_move_record_codec_roundtrip():
+    from rocksplicator_tpu.cluster.shard_move import MoveRecord
+
+    rec = MoveRecord(move_id="m", partition=PARTITION, db_name=DB_NAME,
+                     source="i0", target="i3", store_uri="/tmp/b",
+                     snapshot_prefix="moves/x", phase="catchup",
+                     moving_leader=True, catchup_lag=7)
+    assert MoveRecord.decode(rec.encode()) == rec
+
+
+def test_ingest_gate_enter_wait_queues_and_times_out():
+    from rocksplicator_tpu.admin.ingest_pipeline import IngestGate
+
+    gate = IngestGate(1)
+    assert gate.enter_wait(timeout=1.0)
+    # full: a second waiter times out...
+    assert not gate.enter_wait(timeout=0.2)
+    # ...but queues through when a slot frees mid-wait
+    released = []
+
+    def free_soon():
+        time.sleep(0.15)
+        gate.exit()
+        released.append(True)
+
+    t = threading.Thread(target=free_soon)
+    t.start()
+    assert gate.enter_wait(timeout=3.0)
+    t.join()
+    gate.exit()
+    assert gate.in_flight == 0
+
+
+def test_oldest_wal_seq_reports_serveable_floor(tmp_path):
+    """needRebuildDB's WAL-availability input (found by the reshard
+    chaos: a deposed-resync'd replica rejoining from seq 0 wedged
+    forever behind a donor whose WAL was purged below its seq — the
+    serve path raises 'WAL gap … puller must rebuild' but nothing
+    rebuilt on a < REBUILD_SEQ_GAP gap)."""
+    from rocksplicator_tpu.storage import wal as wal_mod
+
+    db = DB(str(tmp_path / "d"),
+            DBOptions(memtable_bytes=1024, wal_ttl_seconds=0.0,
+                      wal_segment_bytes=2048))
+    try:
+        assert db.oldest_wal_seq() is None or db.oldest_wal_seq() == 1
+        for i in range(400):
+            db.write(WriteBatch().put(b"k%04d" % i, b"v" * 64))
+        db.flush()  # purge of the fully-persisted prefix rides the flush
+        oldest = db.oldest_wal_seq()
+        assert oldest is not None and oldest > 1, oldest
+        assert oldest == wal_mod.oldest_seq(str(tmp_path / "d" / "wal"))
+        # and the admin surface carries it for the rebuild decision
+    finally:
+        db.close()
+
+
+def test_move_failpoint_sites_registered():
+    from rocksplicator_tpu.testing.failpoints import SITES
+    from tools.chaos_soak import _RESHARD_FAULT_SITES
+
+    for site in _RESHARD_FAULT_SITES:
+        assert site in SITES, f"unregistered fault site {site}"
+    for site in ("move.record", "move.snapshot", "move.restore",
+                 "move.catchup", "move.flip", "move.retire"):
+        assert site in SITES
+
+
+# ---------------------------------------------------------------------------
+# WAL-tail catch-up (the satellite): sustained writes, target restart,
+# pause-bounded termination
+# ---------------------------------------------------------------------------
+
+
+def test_wal_tail_catchup_survives_restart_and_pause_bounds_tail(tmp_path):
+    leader = Replicator(port=0, flags=FLAGS)
+    target = Replicator(port=0, flags=FLAGS)
+    ldb = DB(str(tmp_path / "l"), DBOptions(wal_ttl_seconds=3600.0))
+    tdb = DB(str(tmp_path / "t"), DBOptions(wal_ttl_seconds=3600.0))
+    stop = threading.Event()
+
+    try:
+        lrdb = leader.add_db(DB_NAME, StorageDbWrapper(ldb),
+                             ReplicaRole.LEADER, replication_mode=0)
+        for i in range(200):
+            lrdb.write(WriteBatch().put(b"pre%04d" % i, b"v"))
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    lrdb.write(WriteBatch().put(b"live%05d" % i, b"v"))
+                except RpcApplicationError as e:
+                    assert e.code == "WRITE_PAUSED"
+                time.sleep(0.002)
+
+        th = threading.Thread(target=writer, daemon=True)
+        th.start()
+        # hidden catch-up replica: OBSERVER (never acks) chasing the
+        # writing leader through the WalTailCursor serve path
+        target.add_db(DB_NAME, StorageDbWrapper(tdb),
+                      ReplicaRole.OBSERVER,
+                      upstream_addr=("127.0.0.1", leader.port),
+                      replication_mode=0)
+        assert wait_until(
+            lambda: tdb.latest_sequence_number_relaxed() > 100)
+        # TARGET RESTART mid-catch-up: close and reopen — the new
+        # cursor resumes from the reopened db's applied seq and the
+        # leader re-serves from mid-WAL, no restart-from-zero
+        target.remove_db(DB_NAME)
+        seq_at_restart = tdb.latest_sequence_number_relaxed()
+        tdb.close()
+        tdb = DB(str(tmp_path / "t"), DBOptions(wal_ttl_seconds=3600.0))
+        assert tdb.latest_sequence_number_relaxed() >= seq_at_restart - 64
+        target.add_db(DB_NAME, StorageDbWrapper(tdb),
+                      ReplicaRole.OBSERVER,
+                      upstream_addr=("127.0.0.1", leader.port),
+                      replication_mode=0)
+        assert wait_until(
+            lambda: tdb.latest_sequence_number_relaxed() > seq_at_restart)
+        # CUTOVER: with the leader still hot, exact equality is a
+        # moving target — the write pause bounds the tail and catch-up
+        # terminates at seq equality inside the pause window
+        lrdb.pause_writes(5000.0)
+        assert wait_until(
+            lambda: (tdb.latest_sequence_number_relaxed()
+                     == ldb.latest_sequence_number_relaxed()),
+            timeout=5.0), (
+            tdb.latest_sequence_number_relaxed(),
+            ldb.latest_sequence_number_relaxed())
+        assert lrdb.write_paused  # equality reached INSIDE the window
+        # the pause refuses new ingress for the rest of the window
+        # (asserted from THIS thread — the background writer may not
+        # get scheduled inside the window under full-suite load)
+        with pytest.raises(RpcApplicationError) as ei:
+            lrdb.write(WriteBatch().put(b"refused", b"x"))
+        assert ei.value.code == "WRITE_PAUSED"
+        stop.set()
+        th.join(timeout=5)
+    finally:
+        stop.set()
+        target.stop()
+        leader.stop()
+        ldb.close()
+        tdb.close()
+
+
+def test_reanointment_unfences_a_deposed_leader(tmp_path):
+    """A fenced leader that the controller re-elects (sticky) under a
+    NEWER minted epoch must resume serving: the fence cleared exactly
+    when set_db_epoch/adopt_epoch carries an epoch strictly above the
+    deposing one. Without this the control plane was satisfied (one
+    claimer) while the data plane refused everything forever (reshard
+    chaos wedge: lineages=[])."""
+    rep = Replicator(port=0, flags=FLAGS)
+    db = DB(str(tmp_path / "l"), DBOptions())
+    try:
+        rdb = rep.add_db(DB_NAME, StorageDbWrapper(db),
+                         ReplicaRole.LEADER, replication_mode=0,
+                         epoch=3)
+        rdb.write(WriteBatch().put(b"a", b"1"))
+        # an inbound frame carrying a newer epoch deposes this leader
+        assert rdb._reject_stale_epoch(5)
+        assert rdb.fenced
+        with pytest.raises(RpcApplicationError):
+            rdb.write(WriteBatch().put(b"b", b"2"))
+        # adopting the SAME epoch that fenced us must NOT unfence (the
+        # epoch-5 leader is someone else)
+        rdb.adopt_epoch(5)
+        assert rdb.fenced
+        # the controller re-anoints us at a strictly newer epoch
+        rdb.adopt_epoch(6)
+        assert not rdb.fenced
+        rdb.write(WriteBatch().put(b"c", b"3"))
+        assert db.get(b"c") == b"3"
+        assert rdb.epoch == 6
+    finally:
+        rep.stop()
+        db.close()
+
+
+def test_follower_ahead_of_leader_flags_divergence(tmp_path):
+    """A follower persistently AHEAD of a direct leader's committed seq
+    holds a suffix that is not in the lineage (a deposed-leader
+    visibility-window write) — pulling can never reconcile it, so the
+    pull loop must flag ``pull_diverged`` for the participant's resync
+    loop (found as a permanent seq-equality wedge by the reshard
+    chaos)."""
+    rep_a = Replicator(port=0, flags=FLAGS)
+    rep_b = Replicator(port=0, flags=FLAGS)
+    rep_f = Replicator(port=0, flags=FLAGS)
+    dba = DB(str(tmp_path / "a"), DBOptions(wal_ttl_seconds=3600.0))
+    dbb = DB(str(tmp_path / "b"), DBOptions(wal_ttl_seconds=3600.0))
+    dbf = DB(str(tmp_path / "f"), DBOptions(wal_ttl_seconds=3600.0))
+    try:
+        ra = rep_a.add_db(DB_NAME, StorageDbWrapper(dba),
+                          ReplicaRole.LEADER, replication_mode=0)
+        rb = rep_b.add_db(DB_NAME, StorageDbWrapper(dbb),
+                          ReplicaRole.LEADER, replication_mode=0)
+        for i in range(8):
+            ra.write(WriteBatch().put(b"a%03d" % i, b"v"))
+        for i in range(5):
+            rb.write(WriteBatch().put(b"b%03d" % i, b"v"))
+        before = Stats.get().get_counter("replicator.diverged_stalls")
+        frdb = rep_f.add_db(DB_NAME, StorageDbWrapper(dbf),
+                            ReplicaRole.FOLLOWER,
+                            upstream_addr=("127.0.0.1", rep_a.port),
+                            replication_mode=0)
+        assert wait_until(
+            lambda: dbf.latest_sequence_number_relaxed() == 8)
+        assert not frdb.pull_diverged
+        # the old lineage (A) is deposed elsewhere; the follower
+        # repoints to the NEW lineage head (B) whose committed seq is
+        # BELOW what we applied — the divergence the flag must catch
+        frdb.reset_upstream(("127.0.0.1", rep_b.port))
+        assert wait_until(lambda: frdb.pull_diverged, timeout=10.0)
+        assert Stats.get().get_counter(
+            "replicator.diverged_stalls") == before + 1
+    finally:
+        rep_f.stop()
+        rep_a.stop()
+        rep_b.stop()
+        for d in (dba, dbb, dbf):
+            d.close()
+
+
+# ---------------------------------------------------------------------------
+# DirectShardMove end to end (admin-RPC plane, no coordinator)
+# ---------------------------------------------------------------------------
+
+
+class _AdminNode:
+    def __init__(self, tmp_path, name):
+        from rocksplicator_tpu.admin.handler import AdminHandler
+        from rocksplicator_tpu.rpc.server import RpcServer
+
+        self.name = name
+        self.replicator = Replicator(port=0, flags=FLAGS)
+        self.handler = AdminHandler(
+            str(tmp_path / name), self.replicator,
+            options_generator=lambda seg: DBOptions(
+                wal_ttl_seconds=3600.0))
+        self.server = RpcServer(port=0, ioloop=self.replicator.ioloop)
+        self.server.add_handler(self.handler)
+        self.server.start()
+
+    @property
+    def admin_addr(self):
+        return ("127.0.0.1", self.server.port)
+
+    def stop(self):
+        self.server.stop()
+        self.handler.close()
+        self.replicator.stop()
+
+
+def test_direct_shard_move_end_to_end(tmp_path):
+    from rocksplicator_tpu.cluster.helix_utils import AdminClient
+    from rocksplicator_tpu.cluster.shard_move import (DirectMovePlan,
+                                                      DirectNode,
+                                                      DirectShardMove,
+                                                      MoveFlags)
+    from rocksplicator_tpu.utils.objectstore import LocalObjectStore
+
+    src = _AdminNode(tmp_path, "src")
+    fol = _AdminNode(tmp_path, "fol")
+    tgt = _AdminNode(tmp_path, "tgt")
+    store_uri = str(tmp_path / "bucket")
+    LocalObjectStore(store_uri)
+    admin = AdminClient()
+    stop = threading.Event()
+    committed = []
+
+    def node_of(n: _AdminNode) -> DirectNode:
+        return DirectNode("127.0.0.1", n.server.port, n.replicator.port)
+
+    try:
+        admin.add_db(src.admin_addr, DB_NAME, role="LEADER")
+        sapp = src.handler.db_manager.get_db(DB_NAME)
+        for i in range(300):
+            sapp.write(WriteBatch().put(b"k%05d" % i, b"v%05d" % i))
+        admin.add_db(fol.admin_addr, DB_NAME, role="FOLLOWER",
+                     upstream=("127.0.0.1", src.replicator.port))
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                key = b"live%05d" % i
+                try:
+                    sapp.write(WriteBatch().put(key, key))
+                    committed.append(key)
+                except Exception:
+                    pass  # WRITE_PAUSED / demoted: not committed
+                time.sleep(0.003)
+
+        th = threading.Thread(target=writer, daemon=True)
+        th.start()
+        plan = DirectMovePlan(
+            db_name=DB_NAME, source=node_of(src), target=node_of(tgt),
+            leader=node_of(src), followers=[node_of(fol)],
+            store_uri=store_uri)
+        timings = DirectShardMove(plan, admin=admin, flags=MoveFlags(
+            catchup_lag_threshold=32, catchup_timeout=30.0,
+            cutover_pause_ms=4000.0, poll_interval=0.02)).run()
+        stop.set()
+        th.join(timeout=5)
+        assert set(timings) == {"snapshot", "restore", "catchup",
+                                "cutover", "retire"}
+        # the target now LEADS at a bumped epoch
+        info = admin.check_db(tgt.admin_addr, DB_NAME)
+        assert info["role"] == "LEADER"
+        assert info["epoch"] >= 1
+        # the source's replica is retired (data plane swept)
+        assert admin.get_sequence_number(src.admin_addr, DB_NAME) is None
+        # zero committed-write loss across the move: every write the
+        # old leader accepted is on the new one (the paused drain ran
+        # to EXACT equality before the flip)
+        tapp = tgt.handler.db_manager.get_db(DB_NAME)
+        assert tapp.db.get(b"k00042") == b"v00042"
+        for key in committed:
+            assert tapp.db.get(key) == key, key
+        # the follower repointed to the new leader (same epoch)
+        finfo = admin.check_db(fol.admin_addr, DB_NAME)
+        assert finfo["role"] == "FOLLOWER"
+        assert finfo["epoch"] == info["epoch"]
+        # writes serve on the new leader
+        tapp.write(WriteBatch().put(b"post", b"move"))
+        assert tapp.db.get(b"post") == b"move"
+        # snapshot garbage swept from the store
+        store = LocalObjectStore(store_uri)
+        assert not store.list_objects(plan.snapshot_prefix + "/")
+    finally:
+        stop.set()
+        admin.close()
+        for n in (src, fol, tgt):
+            n.stop()
+
+
+# ---------------------------------------------------------------------------
+# spectator surfaces move progress
+# ---------------------------------------------------------------------------
+
+
+def test_spectator_shard_moves_section(tmp_path):
+    from rocksplicator_tpu.cluster.coordinator import (CoordinatorClient,
+                                                       CoordinatorServer)
+    from rocksplicator_tpu.cluster.publishers import CallbackPublisher
+    from rocksplicator_tpu.cluster.shard_move import MoveRecord
+    from rocksplicator_tpu.cluster.spectator import Spectator
+
+    server = CoordinatorServer(port=0, session_ttl=5.0)
+    client = CoordinatorClient("127.0.0.1", server.port)
+    spec = Spectator("127.0.0.1", server.port, "c",
+                     [CallbackPublisher(lambda m: None)])
+    try:
+        rec = MoveRecord(move_id="m1", partition=PARTITION,
+                         db_name=DB_NAME, source="i0", target="i3",
+                         store_uri="b", snapshot_prefix="moves/x",
+                         phase="catchup", bytes_ingested=12345,
+                         catchup_lag=9)
+        client.put(f"/clusters/c/moves/{PARTITION}", rec.encode())
+        client.put("/clusters/c/moves_summary",
+                   json.dumps({"started": 2, "completed": 1}).encode())
+        moves = spec._shard_moves()
+        assert moves["active"][PARTITION]["phase"] == "catchup"
+        assert moves["active"][PARTITION]["bytes_ingested"] == 12345
+        assert moves["active"][PARTITION]["catchup_lag"] == 9
+        assert moves["counters"] == {"started": 2, "completed": 1}
+    finally:
+        spec.stop()
+        client.close()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# the reshard chaos harness (fast tier-1 markers; full run =
+# make reshard-smoke)
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_chaos_schedules_hold_invariants(tmp_path):
+    from tools.chaos_soak import run_reshard_chaos
+
+    result = run_reshard_chaos(
+        str(tmp_path / "chaos"), schedules=2, seed=1234,
+        log=lambda *a: None)
+    assert result["violations"] == [], result["violations"]
+    assert result["acked"] > 0
+    # every schedule drove its move to a terminal state
+    assert sum(result["move_outcomes"].values()) >= 1
+    assert not set(result["move_outcomes"]) & {
+        "wedged", "abort_failed", "resume_failed"}
+
+
+def test_reshard_chaos_catches_naive_flip(tmp_path):
+    """The tooth: a cutover patched to force-promote the target without
+    drain/pause/two-phase-demote must be CAUGHT by the lineage probes."""
+    from tools.chaos_soak import run_reshard_chaos
+
+    result = run_reshard_chaos(
+        str(tmp_path / "chaos"), schedules=1, seed=7,
+        break_guard="move_flip", heal_timeout=5.0, log=lambda *a: None)
+    assert result["violations"], "move_flip tooth NOT caught"
+    assert any("SERVING LINEAGE" in v or "NEW LINEAGE" in v
+               for v in result["violations"]), result["violations"]

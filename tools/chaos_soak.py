@@ -67,10 +67,41 @@ the harness holds the **fourth standing invariant**:
    and without the new epoch on the request). Bounces are always
    legal; wrong serves never are.
 
+``--reshard`` (round 15) runs LIVE SHARD MOVES under fault: a 4-node /
+3-replica coordinator-backed cluster where seeded schedules drive the
+resumable move step machine (``cluster/shard_move.py``: snapshot →
+bulk-ingest → WAL-tail catch-up → epoch-bumped pinned flip → retire)
+with continuous write load riding through every phase, and kill every
+actor at every seam — the move coordinator at each of its failpoint
+phases (``move.record/snapshot/restore/catchup/flip/retire``), the
+source and target participants mid-move, the coordinator primary
+(kill + torn WAL during the flip), plus cluster-wide session expiry
+mid-catch-up and data-plane faults riding a whole move. After EVERY
+schedule the harness holds the **sixth standing invariant**:
+
+6. **live moves under fault** — exactly ONE serving lineage per shard
+   (current states, the published shard map, and the data plane agree
+   on one unfenced leader; two coexisting unfenced leaders at any
+   sampled instant is a violation), zero acked-write loss across the
+   move (every acked key readable on every CURRENT host — the hosting
+   set itself moved; plus the sharp probe: the instant a cutover
+   claims completion, every already-acked write must be readable on
+   the NEW leader), bounded convergence (controller-pass bound), and
+   no stranded replicas (a non-host still holding the db is un-swept
+   move garbage — aborts must sweep the target, retires the source).
+   A killed mover must leave the move either cleanly aborted or
+   resumable to completion — a move that can do neither is the
+   half-flipped-map state and a violation by itself.
+
 - ``fencing`` (``--failover`` only) — the leader IGNORES epochs
   (``ReplicatedDB._reject_stale_epoch`` patched to a no-op): the
   stale-frame probes in the leader-crash schedule must catch it acking
   writes after deposition (SPLIT BRAIN).
+- ``move_flip`` (``--reshard`` only) — the naive cutover: no write
+  pause, no tail drain, no two-phase demote — force-promote the
+  target's data plane the moment catch-up is "close": the lineage
+  probes must catch the two coexisting serving lineages / the acked
+  tail missing on the new leader.
 
 Usage::
 
@@ -79,6 +110,9 @@ Usage::
         --expect-violation                                      # teeth
     python -m tools.chaos_soak --failover --schedules 15 --seed 1
     python -m tools.chaos_soak --failover --break-guard fencing \
+        --expect-violation                                      # tooth
+    python -m tools.chaos_soak --reshard --schedules 15 --seed 1
+    python -m tools.chaos_soak --reshard --break-guard move_flip \
         --expect-violation                                      # tooth
 """
 
@@ -92,6 +126,7 @@ import random
 import shutil
 import sys
 import tempfile
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -341,6 +376,10 @@ FAILOVER_FLAGS = ReplicationFlags(
 # "shard-map convergence within a bounded number of controller passes":
 # the reconcile loop runs every 0.25 s, so this bound also caps heal time
 FAILOVER_PASS_BOUND = 80
+# reshard heals ride a longer window (deposed resync + drops + rejoin
+# storms settle through MORE passes, at the same 0.25 s cadence): the
+# bound scales with the 30 s heal timeout the reshard checks use
+RESHARD_PASS_BOUND = 160
 _LEADERLIKE = {"LEADER", "MASTER"}
 
 
@@ -372,6 +411,11 @@ class FailoverNode:
             "127.0.0.1", coord_port, cluster, self.instance,
             backup_store_uri=store_uri, catch_up_timeout=10.0,
             error_retry_backoff=0.2, coord_fallbacks=fallbacks,
+            # chaos-scale 3-node-failure guard: the default 100k slack
+            # is scale-blind at these workload sizes — a data-poor
+            # candidate must refuse promotion past a checkpointed
+            # lineage and rebuild first
+            promotion_seq_slack=64,
         )
         # data-plane self-healing: followers can repoint from the pull
         # loop's forced-reset path without waiting on a controller write
@@ -396,11 +440,14 @@ class FailoverNode:
 
 class FailoverCluster:
     """Coordinator primary + standby (durable, replicated), a Controller,
-    a Spectator publishing the shard map, and 3 participant hosts running
+    a Spectator publishing the shard map, and N participant hosts running
     one replicas=3 LeaderFollower resource in semi-sync mode — the
-    reference Helix topology in one process, chaos-sized."""
+    reference Helix topology in one process, chaos-sized. ``num_nodes``
+    above the replica count leaves spare hosts for the reshard
+    schedules' live shard moves (3 of 4 host the shard; moves relocate
+    replicas onto the free node)."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, num_nodes: int = 3):
         import itertools as _it
 
         from rocksplicator_tpu.cluster.controller import Controller
@@ -440,10 +487,11 @@ class FailoverCluster:
         fallbacks = [("127.0.0.1", self.standby.port)]
         store_uri = os.path.join(root, "bucket")
         LocalObjectStore(store_uri)
+        self.store_uri = store_uri
         self.nodes = [
             FailoverNode(root, f"n{i}", self.primary.port, self.cluster,
                          fallbacks, store_uri)
-            for i in range(3)
+            for i in range(num_nodes)
         ]
         self.controller = Controller(
             "127.0.0.1", self.primary.port, self.cluster, "ctrl-1",
@@ -460,6 +508,11 @@ class FailoverCluster:
                         replicas=3))
         self._ioloop = IoLoop.default()
         self._pool = RpcClientPool()
+        # the reshard schedules drive real AdminClient RPCs (the shard-
+        # move step machine's snapshot/restore/pause calls)
+        from rocksplicator_tpu.cluster.helix_utils import AdminClient
+
+        self.admin = AdminClient()
 
     def _coord_dir(self) -> str:
         return os.path.join(self.root, f"coord{next(self._coord_seq)}")
@@ -554,7 +607,7 @@ class FailoverCluster:
 
     def stop(self) -> None:
         for closer in (self.spectator.stop, self.controller.stop,
-                       self.client.close):
+                       self.client.close, self.admin.close):
             try:
                 closer()
             except Exception:
@@ -612,6 +665,43 @@ def _break_guard(kind: str):
 
         AdminHandler._do_ingest = broken_do
         return lambda: setattr(AdminHandler, "_do_ingest", orig_do)
+    if kind == "move_flip":
+        # the naive shard-move cutover a lazy implementation would ship:
+        # no write pause, no tail drain, no two-phase handoff — just
+        # bump the ledger and force-promote the target's data plane the
+        # moment catch-up is "close enough". This leaves TWO unfenced
+        # serving lineages (the source still leads its follower set;
+        # the target leads alone at a higher epoch, missing the acked
+        # tail) — the sixth invariant's lineage probes must catch it.
+        import json as _json
+
+        from rocksplicator_tpu.cluster.model import cluster_path
+        from rocksplicator_tpu.cluster.shard_move import ShardMove
+
+        orig_cutover = ShardMove._phase_cutover
+
+        def broken_cutover(self):
+            rec = self.rec
+            rec.moving_leader = True
+            target = self._target_info()
+            path = cluster_path(self.cluster, "epochs", rec.partition)
+            raw = self.coord.get_or_none(path)
+            cur = 0
+            if raw:
+                try:
+                    cur = int(_json.loads(bytes(raw).decode())
+                              .get("epoch", 0))
+                except (ValueError, UnicodeDecodeError):
+                    cur = 0
+            self.coord.put(path, _json.dumps(
+                {"epoch": cur + 1, "leader": rec.target}).encode())
+            self.admin.change_db_role_and_upstream(
+                self._admin_addr(target), rec.db_name, "LEADER",
+                epoch=cur + 1)
+
+        ShardMove._phase_cutover = broken_cutover
+        return lambda: setattr(
+            ShardMove, "_phase_cutover", orig_cutover)
     if kind == "fencing":
         # a leader that IGNORES epochs: stale-epoch frames are served and
         # acked, a deposed leader never fences — the no-split-brain
@@ -631,15 +721,17 @@ def _break_guard(kind: str):
 # ---------------------------------------------------------------------------
 
 
-def _wait_replicas_equal(cluster: FailoverCluster, timeout: float = 10.0
-                         ) -> bool:
+def _wait_replicas_equal(cluster: FailoverCluster, timeout: float = 10.0,
+                         replicas: int = 3) -> bool:
     """Baseline writes are only held to the zero-loss invariant once they
     are on EVERY replica — then any single survivor carries them through
-    arbitrary later flaps."""
+    arbitrary later flaps. Hosting-aware: with spare nodes (reshard
+    mode), exactly ``replicas`` nodes must host the db at equal seqs —
+    nodes without the db (the move's free node) are not required to."""
     def equal():
         for db in cluster.db_names:
-            seqs = cluster.seqs(db)
-            if None in seqs or len(set(seqs)) != 1:
+            seqs = [s for s in cluster.seqs(db) if s is not None]
+            if len(seqs) != replicas or len(set(seqs)) != 1:
                 return False
         return True
 
@@ -1249,6 +1341,685 @@ def run_failover_chaos(
 
 
 # ---------------------------------------------------------------------------
+# reshard chaos: live shard moves under fault (round 15)
+# ---------------------------------------------------------------------------
+
+# the move step machine's failpoint seams: arming fail_nth on one IS the
+# "kill the move coordinator at this phase" schedule (registration
+# asserted by tests like the other menus)
+_RESHARD_FAULT_SITES = [
+    "move.record", "move.snapshot", "move.restore", "move.catchup",
+    "move.flip", "move.retire",
+    "coordinator.heartbeat", "coordinator.wal.append", "repl.pull",
+    "rpc.frame.send",
+]
+
+# every actor × phase: the mover killed at each of its five seams (+ the
+# ledger-write seam), the source/target participants killed mid-move,
+# cluster-wide session expiry, the coordinator torn/killed, a data-plane
+# fault riding the whole move, plus clean leader/follower moves and a
+# whole-node drain
+_RESHARD_KINDS = [
+    "move_clean_leader", "move_clean_follower", "move_drain",
+    "move_crash_record", "move_crash_snapshot", "move_crash_restore",
+    "move_crash_catchup", "move_crash_flip", "move_crash_retire",
+    "move_kill_source", "move_kill_target", "move_session_expiry",
+    "move_coord_torn", "move_coord_failover", "move_fault_dataplane",
+]
+
+
+def _move_flags():
+    """Chaos-sized move pacing: many move→fault→heal cycles per minute."""
+    from rocksplicator_tpu.cluster.shard_move import MoveFlags
+
+    return MoveFlags(
+        catchup_lag_threshold=16, catchup_timeout=40.0,
+        cutover_pause_ms=4000.0, cutover_attempts=3,
+        flip_timeout=25.0, retire_timeout=25.0,
+        poll_interval=0.05, record_update_interval=0.25,
+    )
+
+
+class _BgWriter:
+    """Continuous write load riding THROUGH every move phase — the acked
+    ledger the zero-loss-across-the-move invariant is checked against.
+    Writes go to whichever node currently claims leadership; errors
+    (WRITE_PAUSED during cutover, NOT_LEADER mid-flip, no leader) are
+    expected and counted, never acked."""
+
+    def __init__(self, cluster: FailoverCluster, tag: str,
+                 interval: float = 0.02):
+        self.cluster = cluster
+        self.tag = tag
+        self.interval = interval
+        self.errors = 0
+        self.window_acked = 0
+        # participant-kill / session-expiry schedules flip this ON at
+        # the kill: from that instant leadership may churn with a
+        # deposed-but-uninformed leader still granting acks — the
+        # documented r11 semi-sync visibility-window residual. Writes
+        # SUBMITTED while the window is open are counted but not held
+        # to the strict ledger (exactly the r11 session-expiry
+        # accounting); pre-kill acks stay strict.
+        self.window_mode = False
+        self._waiters: List = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="chaos-move-writer", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        partition = self.cluster.partitions[0]
+        db = self.cluster.db_names[0]
+        i = 0
+        while not self._stop.wait(self.interval):
+            i += 1
+            key = f"{self.tag}-bg{i:05d}".encode()
+            node = self.cluster.leader_node(partition)
+            app = (node.handler.db_manager.get_db(db)
+                   if node is not None else None)
+            if app is None:
+                self.errors += 1
+                continue
+            strict = not self.window_mode
+            try:
+                w = app.write_async(WriteBatch().put(key, key))
+            except Exception:
+                self.errors += 1
+                continue
+            with self._lock:
+                self._waiters.append((key, key, w, strict))
+
+    def _collect_one(self, item, acked) -> None:
+        key, val, w, strict = item
+        if not w.acked:
+            return
+        if strict:
+            acked.append((key, val))
+        else:
+            self.window_acked += 1
+
+    def harvest(self, acked: List[Tuple[bytes, bytes]]) -> None:
+        """Move already-resolved acks into the ledger NOW — the sharp
+        post-flip probes check against writes acked before the flip."""
+        with self._lock:
+            pending = []
+            for item in self._waiters:
+                if item[2].future.done():
+                    self._collect_one(item, acked)
+                else:
+                    pending.append(item)
+            self._waiters = pending
+
+    def stop_collect(self, acked: List[Tuple[bytes, bytes]]) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        with self._lock:
+            waiters, self._waiters = self._waiters, []
+        for item in waiters:
+            try:
+                item[2].future.result(3.0)
+            except Exception:
+                continue
+            self._collect_one(item, acked)
+
+
+def _start_move_bg(cluster: FailoverCluster, source_iid: str,
+                   target_iid: str, flags) -> Dict:
+    """Run one coordinated move in a background thread (the 'move
+    coordinator' actor the schedules kill) against the harness's shared
+    coordinator/admin clients."""
+    from rocksplicator_tpu.cluster.shard_move import ShardMove
+
+    box: Dict = {"mover": None, "error": None, "record": None,
+                 "done": threading.Event()}
+    partition = cluster.partitions[0]
+
+    def go():
+        try:
+            mv = ShardMove.start(
+                cluster.client, cluster.cluster, partition, source_iid,
+                target_iid, cluster.store_uri, admin=cluster.admin,
+                flags=flags)
+            box["mover"] = mv
+            box["record"] = mv.run()
+        except BaseException as e:
+            box["error"] = e
+        finally:
+            box["done"].set()
+
+    t = threading.Thread(target=go, name="chaos-mover", daemon=True)
+    t.start()
+    box["thread"] = t
+    return box
+
+
+def _start_drain_bg(cluster: FailoverCluster, node, flags) -> Dict:
+    from rocksplicator_tpu.cluster.shard_move import drain_node
+
+    box: Dict = {"mover": None, "error": None, "record": None,
+                 "done": threading.Event()}
+
+    def go():
+        try:
+            box["record"] = drain_node(
+                cluster.client, cluster.cluster,
+                node.instance.instance_id, cluster.store_uri,
+                admin=cluster.admin, flags=flags,
+                log_fn=lambda *_a, **_k: None)
+        except BaseException as e:
+            box["error"] = e
+        finally:
+            box["done"].set()
+
+    t = threading.Thread(target=go, name="chaos-drainer", daemon=True)
+    t.start()
+    box["thread"] = t
+    return box
+
+
+def _wait_move_phase(box: Dict, phase: str, timeout: float = 30.0) -> bool:
+    """Wait until the mover has ENTERED ``phase`` (or finished/crashed —
+    both mean the seam was passed or will never be reached)."""
+    from rocksplicator_tpu.cluster.shard_move import PHASES
+
+    order = {p: i for i, p in enumerate(PHASES)}
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if box["done"].is_set():
+            return True
+        mv = box.get("mover")
+        if mv is not None and order.get(mv.rec.phase, -1) >= order[phase]:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _finish_move(cluster: FailoverCluster, box: Dict, rng: random.Random,
+                 tag: str, violations: List[str], flags,
+                 timeout: float = 90.0) -> str:
+    """Drive the move to a TERMINAL state: completed as launched, or —
+    after a crash — either resumed to completion or cleanly aborted
+    (seeded choice where both are legal). A move that can do neither is
+    the 'half-flipped map' state the step machine exists to prevent:
+    a violation."""
+    from rocksplicator_tpu.cluster.model import cluster_path
+    from rocksplicator_tpu.cluster.shard_move import MoveRecord, ShardMove
+
+    if not box["done"].wait(timeout):
+        violations.append(f"{tag}: move wedged (no exit in {timeout}s)")
+        return "wedged"
+    partition = cluster.partitions[0]
+    if box["error"] is None:
+        return "completed"
+    raw = cluster.client.get_or_none(
+        cluster_path(cluster.cluster, "moves", partition))
+    if raw is None:
+        # crashed before the ledger write landed (or after the final
+        # delete): nothing half-done exists to resume
+        return "no_record"
+    rec = MoveRecord.decode(raw)
+    abortable = rec.phase in ("planned", "snapshot", "restore", "catchup")
+    if abortable and rng.random() < 0.5:
+        try:
+            ShardMove.resume(cluster.client, cluster.cluster, partition,
+                             admin=cluster.admin, flags=flags).abort()
+            return "aborted"
+        except Exception as e:
+            violations.append(
+                f"{tag}: ABORT FAILED from phase {rec.phase}: {e!r}")
+            return "abort_failed"
+    last: Optional[BaseException] = None
+    for _attempt in range(2):
+        try:
+            ShardMove.resume(cluster.client, cluster.cluster, partition,
+                             admin=cluster.admin, flags=flags).run()
+            return "resumed"
+        except Exception as e:
+            last = e
+            time.sleep(0.5)
+    violations.append(
+        f"{tag}: RESUME FAILED from phase {rec.phase} (half-flipped "
+        f"state left behind): {last!r}")
+    return "resume_failed"
+
+
+def _probe_serving_lineages(cluster: FailoverCluster, tag: str,
+                            violations: List[str],
+                            duration: float = 1.5) -> None:
+    """Sharp lineage check sampled across the flip window: at NO instant
+    may two unfenced data-plane LEADERs coexist — the pinned two-phase
+    flip demotes the source before the target may promote, and the
+    ``move_flip`` tooth (force-promote without drain/demote) is exactly
+    what this catches."""
+    db = cluster.db_names[0]
+    deadline = time.monotonic() + duration
+    while time.monotonic() < deadline:
+        leaders = []
+        for n in cluster.nodes:
+            rdb = n.rdb(db)
+            if (rdb is not None and rdb.role is ReplicaRole.LEADER
+                    and not rdb.fenced and not rdb.removed):
+                leaders.append(n.name)
+        if len(leaders) > 1:
+            violations.append(
+                f"{tag}: TWO SERVING LINEAGES — unfenced leaders "
+                f"{leaders} coexist (flip before demote)")
+            return
+        time.sleep(0.03)
+
+
+def _probe_new_lineage(cluster: FailoverCluster, box: Dict,
+                       acked: List[Tuple[bytes, bytes]], tag: str,
+                       violations: List[str]) -> None:
+    """The moment the cutover claims completion (phase → retire), every
+    write acked so far must be readable on the NEW leader — a flip that
+    beat catch-up shows up here as a hole in the acked ledger."""
+    mv = box.get("mover")
+    if mv is None or not mv.rec.moving_leader:
+        return
+    node = next((n for n in cluster.nodes
+                 if n.instance.instance_id == mv.rec.target), None)
+    if node is None:
+        return
+    db = cluster.db_names[0]
+    deadline = time.monotonic() + 2.0
+    app = None
+    while time.monotonic() < deadline and app is None:
+        app = node.handler.db_manager.get_db(db)
+        if app is None:
+            time.sleep(0.05)
+    if app is None:
+        return  # mid-reopen; the post-schedule invariant check covers it
+    for key, val in list(acked)[-20:]:
+        try:
+            got = app.db.get(key)
+        except Exception:
+            return
+        if got != val:
+            violations.append(
+                f"{tag}: ACKED WRITE {key!r} MISSING ON NEW LINEAGE "
+                f"{mv.rec.target} (flip before catch-up completed)")
+            return
+
+
+def _reshard_schedule(kind: str):
+    def run(cluster: FailoverCluster, rng: random.Random, acked,
+            violations: List[str], tag: str, timings: Dict) -> None:
+        from rocksplicator_tpu.cluster.model import cluster_path
+
+        partition = cluster.partitions[0]
+        cluster.write_some(rng, tag + "-pre", rng.randint(4, 8), acked)
+        # generous window: the PREVIOUS schedule's healed participants
+        # (rejoins, deposed resyncs, late drops) may still be settling
+        if not _wait_replicas_equal(cluster, timeout=25.0):
+            violations.append(f"{tag}: baseline never converged")
+            return
+        move_leader = kind != "move_clean_follower"
+        leader = cluster.leader_node(partition)
+        followers = [n for n in cluster.nodes
+                     if n.state_of(partition) in ("FOLLOWER", "SLAVE")]
+        free = [n for n in cluster.nodes if not n.state_of(partition)]
+        if leader is None or not free or (
+                not move_leader and not followers):
+            violations.append(f"{tag}: no legal move endpoints "
+                              f"({cluster.states(partition)})")
+            return
+        source = leader if move_leader else rng.choice(followers)
+        target = rng.choice(free)
+        flags = _move_flags()
+        writer = _BgWriter(cluster, tag)
+        healers: List[FailoverNode] = []
+        t0 = time.monotonic()
+        outcome = "?"
+        try:
+            if kind == "move_coord_torn":
+                # the flip's durable writes (move ledger, pin, epoch)
+                # hit a torn coordinator WAL: the primary fail-stops and
+                # the mover's mutation dies mid-flight
+                fp.activate(
+                    "coordinator.wal.append",
+                    f"torn:1.0@seed{rng.randrange(1 << 16)},one_shot")
+            crash_site = {
+                "move_crash_record": ("move.record", "fail_nth:2"),
+                "move_crash_snapshot": ("move.snapshot", "fail_nth:1"),
+                "move_crash_restore": ("move.restore", "fail_nth:1"),
+                "move_crash_catchup": ("move.catchup", "fail_nth:1"),
+                "move_crash_flip": ("move.flip", "fail_nth:1"),
+                "move_crash_retire": ("move.retire", "fail_nth:1"),
+            }.get(kind)
+            if crash_site:
+                fp.activate(*crash_site)
+            if kind == "move_fault_dataplane":
+                s = rng.randrange(1 << 16)
+                fp.activate(
+                    rng.choice(["repl.pull", "rpc.frame.send"]),
+                    f"fail_prob:{rng.uniform(0.03, 0.10):.3f}@seed{s}")
+            if kind == "move_drain":
+                box = _start_drain_bg(cluster, source, flags)
+            else:
+                box = _start_move_bg(
+                    cluster, source.instance.instance_id,
+                    target.instance.instance_id, flags)
+            if kind == "move_kill_source":
+                if _wait_move_phase(box, "catchup"):
+                    # from here leadership may churn with deposed-but-
+                    # uninformed claimers: acks ride the r11-documented
+                    # visibility window, not the strict ledger
+                    writer.window_mode = True
+                    source.participant.coord.suspend_heartbeats()
+                    healers.append(source)
+            elif kind == "move_kill_target":
+                if _wait_move_phase(box,
+                                    rng.choice(["restore", "catchup"])):
+                    writer.window_mode = True
+                    target.participant.coord.suspend_heartbeats()
+                    healers.append(target)
+            elif kind == "move_session_expiry":
+                if _wait_move_phase(
+                        box, rng.choice(["snapshot", "restore",
+                                         "catchup"])):
+                    writer.window_mode = True
+                    fp.activate("coordinator.heartbeat",
+                                f"fail_first:{rng.randint(25, 45)}")
+                    time.sleep(FAILOVER_SESSION_TTL * 1.7)
+                    fp.deactivate("coordinator.heartbeat")
+            elif kind == "move_coord_failover":
+                if _wait_move_phase(box,
+                                    rng.choice(["restore", "catchup"])):
+                    _coordinator_failover(cluster, tag, violations)
+            # sharp flip-window probes — only where every participant
+            # stays responsive, so the two-phase demote-before-promote
+            # discipline is actually observable: under participant
+            # kills / session expiry / coordinator faults a wedged
+            # deposed leader legitimately lingers as an unfenced zombie
+            # (the documented r11 state — it cannot ACK and cannot
+            # serve lineage-valid reads, which invariants 4/5 check;
+            # it fences on first contact)
+            probing = kind in (
+                "move_clean_leader", "move_clean_follower", "move_drain",
+                "move_crash_record", "move_crash_snapshot",
+                "move_crash_restore", "move_crash_catchup",
+                "move_crash_flip", "move_crash_retire",
+                "move_fault_dataplane")
+            if probing and _wait_move_phase(box, "retire", timeout=60.0):
+                writer.harvest(acked)
+                _probe_serving_lineages(cluster, tag, violations)
+                _probe_new_lineage(cluster, box, acked, tag, violations)
+            if violations and timings.get("fast_fail"):
+                # teeth run: the broken guard is caught — don't spend a
+                # minute trying to recover a deliberately-broken flip
+                return
+            if crash_site:
+                fp.deactivate(crash_site[0])
+            if kind == "move_coord_torn":
+                # the tear fail-stopped a coordinator (the mover's
+                # ledger write died with it): heal the control plane
+                # BEFORE terminal recovery, exactly like the r11
+                # coordinator_wal_torn schedule
+                box["done"].wait(30.0)
+                fp.deactivate("coordinator.wal.append")
+                primary_fenced = (cluster.primary._wal is not None
+                                  and cluster.primary._wal.failed
+                                  is not None)
+                standby_fenced = (cluster.standby._wal is not None
+                                  and cluster.standby._wal.failed
+                                  is not None)
+                if primary_fenced:
+                    _coordinator_failover(cluster, tag, violations)
+                elif standby_fenced:
+                    from rocksplicator_tpu.cluster.coordinator import \
+                        CoordinatorServer
+
+                    cluster.standby.stop()
+                    cluster.standby = CoordinatorServer(
+                        port=0, session_ttl=FAILOVER_SESSION_TTL,
+                        data_dir=cluster._coord_dir(),
+                        replica_of=("127.0.0.1", cluster.primary.port))
+            # a killed participant must heal BEFORE terminal recovery:
+            # resume/abort legitimately need its admin plane back
+            if healers:
+                box["done"].wait(60.0)
+                for n in healers:
+                    n.participant.coord.resume_heartbeats()
+                for n in healers:
+                    node_path = cluster_path(
+                        cluster.cluster, "instances",
+                        n.instance.instance_id)
+                    cluster.wait(
+                        lambda: cluster.client.exists(node_path), 10.0)
+                healers.clear()
+            outcome = _finish_move(cluster, box, rng, tag, violations,
+                                   flags)
+            if probing and outcome in ("completed", "resumed"):
+                _probe_serving_lineages(cluster, tag, violations,
+                                        duration=0.5)
+        finally:
+            for n in healers:
+                n.participant.coord.resume_heartbeats()
+            fp.clear()
+            writer.stop_collect(acked)
+        timings["move_outcomes"][outcome] = \
+            timings["move_outcomes"].get(outcome, 0) + 1
+        timings["move_ms"].append(
+            round((time.monotonic() - t0) * 1000.0, 1))
+        timings["write_errors"] += writer.errors
+        timings["window_acked"] += writer.window_acked
+
+    return run
+
+
+def _reshard_deck(rng: random.Random, schedules: int,
+                  break_guard: Optional[str]) -> List[str]:
+    """Every kind at least once when the run is long enough; the
+    move_flip tooth leads with the clean leader move it breaks."""
+    deck: List[str] = []
+    if break_guard == "move_flip":
+        deck.append("move_clean_leader")
+    core = list(_RESHARD_KINDS)
+    rng.shuffle(core)
+    deck.extend(core[:max(0, schedules - len(deck))])
+    while len(deck) < schedules:
+        deck.append(rng.choice(_RESHARD_KINDS))
+    return deck[:schedules]
+
+
+def _check_reshard_invariants(cluster: FailoverCluster, acked, tag: str,
+                              violations: List[str],
+                              timeout: float = 30.0) -> int:
+    """The SIXTH standing invariant, after EVERY reshard schedule:
+    exactly one serving lineage per shard (current states, the
+    published map, AND the data plane agree on one unfenced leader),
+    zero acked-write loss across the move (every acked key readable on
+    every CURRENT host — the hosting set itself may have moved), no
+    stranded replicas (a non-host holding the db = un-swept move
+    garbage), and convergence within the controller-pass bound."""
+    partition, db = cluster.partitions[0], cluster.db_names[0]
+    passes0 = cluster.controller.passes
+    detail: Dict = {}
+
+    def healthy():
+        from rocksplicator_tpu.storage.errors import StorageError
+
+        hosts = [n for n in cluster.nodes if n.state_of(partition)]
+        states = sorted(n.state_of(partition) for n in hosts)
+        if states != ["FOLLOWER", "FOLLOWER", "LEADER"]:
+            detail["states"] = cluster.states(partition)
+            return False
+        seqs = []
+        apps = {}
+        try:
+            for n in hosts:
+                app = n.handler.db_manager.get_db(db)
+                if app is None:
+                    detail["lost"] = (n.name, "db closed")
+                    return False
+                apps[n.name] = app
+                seqs.append(app.db.latest_sequence_number_relaxed())
+            if len(set(seqs)) != 1:
+                detail["seqs"] = seqs
+                return False
+            host_names = {n.name for n in hosts}
+            for n in cluster.nodes:
+                if n.name not in host_names and \
+                        n.handler.db_manager.get_db(db) is not None:
+                    detail["garbage"] = n.name  # un-swept move replica
+                    return False
+            for n in hosts:
+                app = apps[n.name]
+                for key, val in acked:
+                    if app.db.get(key) != val:
+                        detail["lost"] = (n.name, key)
+                        return False
+        except StorageError as e:
+            # a handle we resolved raced a reopen (repoint/rejoin
+            # transition mid-sample): not healthy YET, re-sample
+            detail["transition"] = repr(e)
+            return False
+        if not cluster.maps:
+            detail["map"] = "never published"
+            return False
+        seg = cluster.maps[-1].get(cluster.segment) or {}
+        for s in range(cluster.num_shards):
+            mark = f"{s:05d}:M"
+            leaders = sum(
+                1 for host, entries in seg.items()
+                if host != "num_shards" for e in entries if e == mark)
+            if leaders != 1:
+                detail["map"] = f"shard {s}: {leaders} leaders in map"
+                return False
+        dp_leaders = []
+        for n in cluster.nodes:
+            rdb = n.rdb(db)
+            if (rdb is not None and rdb.role is ReplicaRole.LEADER
+                    and not rdb.fenced and not rdb.removed):
+                dp_leaders.append(n.name)
+        if len(dp_leaders) != 1:
+            detail["lineages"] = dp_leaders
+            return False
+        return True
+
+    def stable_healthy():
+        # a rejoining participant can look healthy for an instant while
+        # its re-applied assignment is about to reopen a db — require
+        # the state to hold across a short window
+        if not healthy():
+            return False
+        time.sleep(0.35)
+        return healthy()
+
+    ok = cluster.wait(stable_healthy, timeout)
+    passes = cluster.controller.passes - passes0
+    if not ok:
+        violations.append(
+            f"{tag}: NO HEAL within {timeout}s / {passes} controller "
+            f"passes — {detail}")
+    elif passes > RESHARD_PASS_BOUND:
+        violations.append(
+            f"{tag}: healed but took {passes} controller passes "
+            f"(bound {RESHARD_PASS_BOUND})")
+    return passes
+
+
+def run_reshard_chaos(
+    root: str,
+    schedules: int = 15,
+    seed: int = 1,
+    break_guard: Optional[str] = None,
+    heal_timeout: float = 30.0,
+    log=print,
+) -> Dict:
+    """Live shard moves under fault: seeded schedules kill the move
+    coordinator at every step-machine seam, kill the source/target
+    participants mid-move, tear the coordinator WAL during the flip,
+    and expire sessions mid-catch-up — holding the SIXTH standing
+    invariant after every schedule, with continuous write load riding
+    through every move."""
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("RSTPU_RETRY_SEED", "RSTPU_PULL_RETRY_SEED")
+    }
+    os.environ["RSTPU_RETRY_SEED"] = str(seed)
+    os.environ["RSTPU_PULL_RETRY_SEED"] = str(seed)
+    undo = _break_guard(break_guard) if break_guard else None
+    violations: List[str] = []
+    acked: List[Tuple[bytes, bytes]] = []
+    timings: Dict = {"move_ms": [], "move_outcomes": {},
+                     "passes_used": [], "write_errors": 0,
+                     "window_acked": 0,
+                     "reads_checked": 0, "reads_served": 0,
+                     "read_bounces": 0,
+                     "fast_fail": bool(break_guard)}
+    gauge_snapshots: List[Dict] = []
+    fp.clear()
+    t_setup = time.monotonic()
+    cluster = FailoverCluster(root, num_nodes=4)
+    deck: List[str] = []
+    try:
+        cluster.wait_initial_convergence()
+        setup_sec = round(time.monotonic() - t_setup, 1)
+        deck = _reshard_deck(random.Random(seed), schedules, break_guard)
+        log(f"  cluster up in {setup_sec}s (4 nodes / 3 replicas); "
+            f"deck: {deck}")
+        for si, kind in enumerate(deck):
+            rng = random.Random(seed * 1_000_003 + si)
+            tag = f"s{si:02d}-{kind}/seed {seed}"
+            try:
+                _reshard_schedule(kind)(
+                    cluster, rng, acked, violations, tag, timings)
+            finally:
+                fp.clear()
+            if violations and break_guard:
+                break  # teeth demonstrated — skip the 30 s heal wait
+            timings["passes_used"].append(
+                _check_reshard_invariants(cluster, acked, tag, violations,
+                                          timeout=heal_timeout))
+            _check_read_invariants(cluster, acked, tag, violations,
+                                   timings)
+            gauge_snapshots.append(_gauge_snapshot(tag))
+            log(f"  [{si + 1}/{len(deck)}] {kind}: acked={len(acked)} "
+                f"moves={timings['move_outcomes']} "
+                f"violations={len(violations)}")
+            if violations and break_guard:
+                break
+    finally:
+        fp.clear()
+        if undo:
+            undo()
+        cluster.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def _med(xs):
+        return round(sorted(xs)[len(xs) // 2], 1) if xs else None
+
+    return {
+        "mode": "reshard",
+        "schedules": len(deck),
+        "deck": deck,
+        "seed": seed,
+        "acked": len(acked),
+        "window_acked": timings["window_acked"],
+        "write_errors": timings["write_errors"],
+        "violations": violations,
+        "move_outcomes": timings["move_outcomes"],
+        "move_ms": timings["move_ms"],
+        "move_ms_median": _med(timings["move_ms"]),
+        "passes_used": timings["passes_used"],
+        "reads_checked": timings["reads_checked"],
+        "reads_served": timings["reads_served"],
+        "read_bounces": timings["read_bounces"],
+        "gauge_snapshots": gauge_snapshots,
+        "failpoint_trips": fp.trip_counts(),
+        "break_guard": break_guard,
+    }
+
+
+# ---------------------------------------------------------------------------
 # the run loop
 # ---------------------------------------------------------------------------
 
@@ -1388,12 +2159,22 @@ def main(argv=None) -> int:
                          "leader crash with a full AckWindow, session "
                          "expiry, coordinator kill/WAL torn — holding "
                          "the fourth standing invariant")
+    ap.add_argument("--reshard", action="store_true",
+                    help="live-shard-move schedules (4 nodes / 3 "
+                         "replicas): the move coordinator killed at "
+                         "every step-machine seam, source/target kills "
+                         "mid-move, torn coordinator WAL during the "
+                         "flip, session expiry mid-catch-up — holding "
+                         "the SIXTH standing invariant (exactly one "
+                         "serving lineage, zero acked-write loss across "
+                         "the move, bounded convergence)")
     ap.add_argument("--transport", choices=["tcp", "uds", "loopback"],
                     help="run the cluster's RPC plane on this byte layer "
                          "(RSTPU_TRANSPORT for the run; default: ambient "
                          "policy, i.e. tcp; data-plane mode only)")
     ap.add_argument("--break-guard",
-                    choices=["wal_hole", "meta_first", "fencing"])
+                    choices=["wal_hole", "meta_first", "fencing",
+                             "move_flip"])
     ap.add_argument("--expect-violation", action="store_true",
                     help="exit 0 iff a violation WAS caught")
     ap.add_argument("--conv-timeout", type=float, default=30.0)
@@ -1401,11 +2182,20 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.break_guard == "fencing" and not args.failover:
         ap.error("--break-guard fencing requires --failover")
+    if args.break_guard == "move_flip" and not args.reshard:
+        ap.error("--break-guard move_flip requires --reshard")
+    if args.failover and args.reshard:
+        ap.error("--failover and --reshard are mutually exclusive")
 
     root = tempfile.mkdtemp(prefix="rstpu-chaos-")
     t0 = time.monotonic()
     try:
-        if args.failover:
+        if args.reshard:
+            result = run_reshard_chaos(
+                root, schedules=args.schedules, seed=args.seed,
+                break_guard=args.break_guard,
+            )
+        elif args.failover:
             result = run_failover_chaos(
                 root, schedules=args.schedules, seed=args.seed,
                 break_guard=args.break_guard,
@@ -1421,7 +2211,19 @@ def main(argv=None) -> int:
     finally:
         shutil.rmtree(root, ignore_errors=True)
     result["elapsed_sec"] = round(time.monotonic() - t0, 1)
-    if args.failover:
+    if args.reshard:
+        print(f"chaos[reshard]: {result['schedules']} schedules, "
+              f"{result['acked']} acked writes through live moves "
+              f"({result['write_errors']} refused), "
+              f"{result['elapsed_sec']}s")
+        print(f"chaos[reshard]: move outcomes "
+              f"{result['move_outcomes']}, move median "
+              f"{result['move_ms_median']} ms, controller passes "
+              f"{result['passes_used']}")
+        print(f"chaos[reshard]: reads {result['reads_served']} served / "
+              f"{result['reads_checked']} checked "
+              f"({result['read_bounces']} bounces)")
+    elif args.failover:
         print(f"chaos[failover]: {result['schedules']} schedules, "
               f"{result['acked']} strict-ledger acks "
               f"(+{result['window_acked']} window), "
@@ -1449,13 +2251,17 @@ def main(argv=None) -> int:
         print(f"REPRO: python -m tools.chaos_soak "
               f"--schedules {args.schedules} --seed {args.seed}"
               + (" --failover" if args.failover else "")
+              + (" --reshard" if args.reshard else "")
               + (f" --transport {args.transport}"
                  if args.transport else "")
               + (f" --break-guard {args.break_guard}"
                  if args.break_guard else ""))
         return 0 if args.expect_violation else 1
     print("chaos: all invariants held"
-          + ((" (exactly-one-leader, zero acked loss across handoff, "
+          + ((" (exactly one serving lineage per shard, zero acked "
+              "loss across the move, bounded convergence, no stranded "
+              "replicas)" if args.reshard else
+              " (exactly-one-leader, zero acked loss across handoff, "
               "bounded shard-map convergence, bounded-staleness + "
               "lineage reads)" if args.failover else
               " (hole-free WAL prefix, zero acked loss, ingest "
