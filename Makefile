@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke metrics-smoke chaos-smoke chaos-failover-smoke reshard-smoke clean
+.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab metrics-smoke compaction-bench compaction-bench-smoke chaos-smoke chaos-failover-smoke reshard-smoke clean
 
 # rstpu-check: the three-pass static suite (lock-order/blocking-under-
 # lock, event-loop blocking, failpoint/span/stats registries) over
@@ -101,6 +101,38 @@ macro-bench-move-smoke:
 	$(PY) bench.py --macro_bench --shards 2 --preload_keys 400 \
 		--rates 150 --duration 3 --move_mid_bench \
 		--out benchmarks/results/macro_bench_move_smoke.json
+
+# round-16 compaction-scheduler A/B: a mixed-load engine slice of the
+# macro-bench (zipfian keys, Poisson open-loop arrivals, write-heavy
+# mix accumulating real L0 debt) with the workload-adaptive scheduler
+# interleaved ON vs OFF at the same offered throughput — get p99,
+# write-stall ms, debt drain, and the scheduler counters per arm
+compaction-bench:
+	$(PY) bench.py --compaction_bench --keys 30000 --rate 2100 \
+		--duration 10 --reps 3 --memtable_kb 32 --target_file_kb 64 \
+		--level_base_kb 128 --settle 2.5 --offline_keys 250000 \
+		--out benchmarks/results/compaction_bench_r16.json
+
+# sub-minute smoke of the same (tier-1 asserts the artifact shape):
+# fails loudly on value mismatches, a pick-less scheduler-on phase, or
+# a missing get-p99 pair
+compaction-bench-smoke:
+	$(PY) bench.py --compaction_bench --keys 6000 --rate 1200 \
+		--duration 4 --reps 1 --memtable_kb 32 --target_file_kb 64 \
+		--level_base_kb 128 --settle 1 --offline_keys 8000 \
+		--min_slice_entries 4096 \
+		--out benchmarks/results/compaction_bench_smoke.json
+
+# round-16 serving-SLO acceptance: the SAME 3-process macro-bench
+# cluster under a write-heavy mix, whole-cluster interleaved A/B of
+# RSTPU_COMPACTION_SCHED=1 vs 0 (children run churn engine options so
+# compaction pressure is real), reporting get p99 + fleet write-stall
+# totals per arm
+macro-bench-sched-ab:
+	$(PY) bench.py --macro_bench --sched_ab --shards 2 \
+		--preload_keys 4000 --sched_rate 1300 --sched_duration 8 \
+		--sched_reps 3 \
+		--out benchmarks/results/macro_bench_sched_ab.json
 
 # round-14 metrics-plane smoke (<10s): boots one replica in-process,
 # scrapes /metrics + /cluster_stats, validates Prometheus text-format

@@ -1076,4 +1076,13 @@ if __name__ == "__main__":
 
         argv = [a for a in sys.argv[1:] if a != "--flush_bench"]
         sys.exit(flush_bench_main(argv))
+    if "--compaction_bench" in sys.argv:
+        # compaction-scheduler A/B mode (round 16): mixed-load engine
+        # slice of the macro-bench with the workload-adaptive scheduler
+        # interleaved on/off. Args pass through to
+        # benchmarks/compaction_bench.py.
+        from benchmarks.compaction_bench import main as compaction_bench_main
+
+        argv = [a for a in sys.argv[1:] if a != "--compaction_bench"]
+        sys.exit(compaction_bench_main(argv))
     main()

@@ -160,3 +160,59 @@ def run_interleaved(
         f"ratio_vs_{base}": ratios,
         "errors": {n: e for n, e in errors.items() if e},
     }
+
+
+def sched_ab_failures(
+    samples: Dict[str, List[Dict]],
+    picks_of: Callable[[Dict], float],
+    mismatch_label: str = "value mismatches",
+) -> List[str]:
+    """Shared pass/fail gates for a scheduler on/off A/B (compaction
+    bench + macro-bench --sched_ab): every rep completed with a p99 and
+    zero value mismatches, the sched_on arm actually picked, and the
+    sched_off arm actually didn't. ``picks_of`` maps one rep sample to
+    its compaction.sched_picks count (the two benches nest counters
+    differently)."""
+    failures: List[str] = []
+    for mode in ("sched_on", "sched_off"):
+        if not samples.get(mode):
+            failures.append(f"no completed {mode} rep")
+    for mode, reps_data in samples.items():
+        for s in reps_data:
+            if s["value_mismatches"]:
+                failures.append(
+                    f"{mode}: {s['value_mismatches']} {mismatch_label}")
+            if s["get_p99_ms"] is None:
+                failures.append(f"{mode}: no get p99 recorded")
+    for s in samples.get("sched_on") or []:
+        if picks_of(s) <= 0:
+            failures.append("sched_on arm recorded zero sched picks")
+    for s in samples.get("sched_off") or []:
+        if picks_of(s) > 0:
+            failures.append("sched_off arm recorded sched picks")
+    return failures
+
+
+def emit_gated_artifact(
+    result: Dict,
+    out_path: Optional[str],
+    bench: str,
+    log: Callable[[str], None] = _log,
+) -> int:
+    """Dump ``result`` (sorted, indented), write the artifact when
+    ``out_path`` is set, print to stdout, and turn ``result['failures']``
+    into the process exit code."""
+    import json
+
+    out_json = json.dumps(result, indent=2, sort_keys=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(out_json + "\n")
+        log(f"{bench}: artifact -> {out_path}")
+    print(out_json)
+    failures = result.get("failures") or []
+    if failures:
+        for msg in failures:
+            log(f"{bench}: FAILURE: {msg}")
+        return 1
+    return 0

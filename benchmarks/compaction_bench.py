@@ -1,0 +1,438 @@
+#!/usr/bin/env python
+"""Compaction-scheduler A/B: a mixed-load engine slice of the macro-bench.
+
+Reuses the macro-bench's workload generators — seeded zipfian key
+popularity, open-loop Poisson arrivals with latency measured from the
+INTENDED arrival (coordinated-omission fix), a get/put mix — and drives
+them straight at ONE engine with background compaction under write-heavy
+pressure (small memtable + low L0 triggers: real L0 debt accumulates),
+interleaving the workload-adaptive compaction scheduler ON vs OFF
+(``DBOptions.compaction_scheduler`` — the same switch
+RSTPU_COMPACTION_SCHED=0 flips process-wide) at the same offered
+throughput. This is where the scheduler's effect lives: get p99 under
+compaction churn, write-stall ms, and the debt drain the round-14
+gauges measure.
+
+Per mode the artifact records get/put p50/p99, achieved throughput,
+write-stall totals, end-of-phase + settled compaction debt (drain
+rate), the scheduler counters (``compaction.sched_picks``,
+``compaction.yields``, ``compaction.subcompactions``), and the slowest
+tail-kept write traces attributing any remaining slow writes. Loud
+failure gates: a scheduler-on phase must carry picks, both arms must
+carry a get p99, and every sampled get must return a value from the
+deterministic preload/put set (zero acked-write loss).
+
+`make compaction-bench-smoke` runs the sub-minute configuration;
+tier-1 asserts the artifact shape (tests/test_compaction_scheduler.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from benchmarks.ab_runner import (emit_gated_artifact, host_calibration,
+                                  run_interleaved, sched_ab_failures)
+from benchmarks.macro_bench import (ZipfianGenerator, op_stream, parse_mix,
+                                    percentile, poisson_arrivals)
+
+DEFAULT_MIX = "get=0.55,put=0.45"
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def key_of(gid: int) -> bytes:
+    return b"k%08d" % gid
+
+
+def preload_value(gid: int, n: int) -> bytes:
+    v = b"l%08d." % gid
+    return (v * (n // len(v) + 1))[:n]
+
+
+def put_value(gid: int, n: int) -> bytes:
+    v = b"p%08d." % gid
+    return (v * (n // len(v) + 1))[:n]
+
+
+def _counters(prefix: str) -> float:
+    from rocksplicator_tpu.utils.stats import Stats
+
+    state = Stats.get().export_state()["counters"]
+    return sum(v["total"] for k, v in state.items() if k.startswith(prefix))
+
+
+def _stall_totals() -> Dict[str, float]:
+    from rocksplicator_tpu.utils.stats import Stats
+
+    state = Stats.get().export_state()["metrics"]
+    rec = state.get("storage.write_stall_ms") or {}
+    tot = rec.get("totals") or rec  # exact all-time state
+    return {
+        "sum_ms": float(tot.get("sum", 0.0)),
+        "count": float(tot.get("count", 0)),
+    }
+
+
+def _tail_traces(limit: int = 3) -> List[Dict]:
+    """Slowest tail-kept roots on the trace plane — the attribution for
+    any remaining slow writes the scheduler did not prevent."""
+    from rocksplicator_tpu.observability.collector import SpanCollector
+
+    roots = [
+        s for s in SpanCollector.get().snapshot()
+        if s.get("annotations", {}).get("tail_kept")
+        or s.get("name") in ("storage.flush", "storage.compaction")
+    ]
+    roots.sort(key=lambda s: -float(s.get("duration_ms") or 0.0))
+    return [
+        {"name": s["name"], "duration_ms": s.get("duration_ms"),
+         "annotations": {k: v for k, v in s.get("annotations", {}).items()
+                         if not isinstance(v, (bytes,))}}
+        for s in roots[:limit]
+    ]
+
+
+def run_phase(root: str, mode: str, args, seed: int) -> Dict:
+    """One mode's phase: fresh DB, preload, open-loop mixed load, then
+    a settle window measuring debt drain. Counters are process-global:
+    report DELTAS across the phase."""
+    from rocksplicator_tpu.storage.engine import DB, DBOptions
+    from rocksplicator_tpu.storage.records import WriteBatch
+
+    sched_on = mode == "sched_on"
+    # bench-scale subcompaction threshold: the production floor (32k
+    # entries per slice) is sized for 64MB files; the bench's small
+    # target files would never slice, leaving the parallel-merge half
+    # of the scheduler unmeasured (recorded in config)
+    import rocksplicator_tpu.storage.native_compaction as nc
+
+    nc.MIN_SLICE_ENTRIES = args.min_slice_entries
+    opts = DBOptions(
+        background_compaction=True,
+        compaction_scheduler=sched_on,
+        memtable_bytes=args.memtable_kb * 1024,
+        level0_compaction_trigger=4,
+        level0_slowdown_writes_trigger=8,
+        level0_stop_writes_trigger=16,
+        target_file_bytes=args.target_file_kb * 1024,
+        max_bytes_for_level_base=args.level_base_kb * 1024,
+        max_subcompactions=0 if sched_on else 1,
+        compaction_budget_bytes_per_sec=(
+            args.budget_bytes if sched_on else 0),
+    )
+    db_dir = os.path.join(root, f"db-{mode}-{seed}")
+    mix = parse_mix(args.mix)
+    total_keys = args.keys
+    base_picks = _counters("compaction.sched_picks")
+    base_yields = _counters("compaction.yields")
+    base_sub = _counters("compaction.subcompactions")
+    base_stall = _stall_totals()
+
+    db = DB(db_dir, opts)
+    try:
+        batch = None
+        for gid in range(total_keys):
+            if batch is None:
+                batch = WriteBatch()
+            batch.put(key_of(gid), preload_value(gid, args.value_bytes))
+            if batch.count() >= 64:
+                db.write(batch)
+                batch = None
+        if batch is not None:
+            db.write(batch)
+        db.flush()
+
+        arrivals = poisson_arrivals(args.rate, args.duration, seed)
+        ops = op_stream(mix, len(arrivals), seed + 1)
+        zipf = ZipfianGenerator(total_keys, seed=seed + 2)
+        gids = [zipf.next() for _ in arrivals]
+        lat: Dict[str, List[float]] = {"get": [], "put": []}
+        errors = {"get": 0, "put": 0}
+        mismatches = [0]
+        lat_lock = threading.Lock()
+        put_seq = [0]
+
+        def one_op(intended: float, op: str, gid: int) -> None:
+            try:
+                if op == "put":
+                    with lat_lock:
+                        put_seq[0] += 1
+                        sync = (put_seq[0] % args.sync_every) == 0
+                    db.write(WriteBatch().put(
+                        key_of(gid), put_value(gid, args.value_bytes)),
+                        sync=sync)
+                else:
+                    got = db.get(key_of(gid))
+                    if got not in (preload_value(gid, args.value_bytes),
+                                   put_value(gid, args.value_bytes)):
+                        with lat_lock:
+                            mismatches[0] += 1
+            except Exception:
+                with lat_lock:
+                    errors[op] += 1
+                return
+            done = time.monotonic()
+            with lat_lock:
+                lat[op].append((done - intended) * 1000.0)
+
+        pool = ThreadPoolExecutor(max_workers=args.workers,
+                                  thread_name_prefix=f"cb-{mode}")
+        t0 = time.monotonic()
+        futs = []
+        for off, op, gid in zip(arrivals, ops, gids):
+            delay = (t0 + off) - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            futs.append(pool.submit(one_op, t0 + off, op, gid))
+        for f in futs:
+            f.result()
+        phase_sec = time.monotonic() - t0
+        pool.shutdown()
+
+        snap = db.metrics_snapshot(max_age=0.0)
+        debt_end = sum(snap["compaction_debt_bytes"])
+        # settle window: how fast does the engine drain the remaining
+        # debt with the load gone?
+        settle_t0 = time.monotonic()
+        time.sleep(args.settle)
+        snap2 = db.metrics_snapshot(max_age=0.0)
+        debt_settled = sum(snap2["compaction_debt_bytes"])
+        settle_sec = max(1e-6, time.monotonic() - settle_t0)
+
+        # zero acked-write loss: every sampled key reads back a value
+        # from the deterministic set
+        for gid in range(0, total_keys, max(1, total_keys // 128)):
+            got = db.get(key_of(gid))
+            if got not in (preload_value(gid, args.value_bytes),
+                           put_value(gid, args.value_bytes)):
+                mismatches[0] += 1
+
+        gets = sorted(lat["get"])
+        puts = sorted(lat["put"])
+        stall = _stall_totals()
+        return {
+            "mode": mode,
+            "offered_per_sec": args.rate,
+            "duration_sec": round(phase_sec, 2),
+            "achieved_per_sec": round(
+                (len(gets) + len(puts)) / max(phase_sec, 1e-6), 1),
+            "get_count": len(gets),
+            "put_count": len(puts),
+            "errors": dict(errors),
+            "value_mismatches": mismatches[0],
+            "get_p50_ms": round(percentile(gets, 50), 3) if gets else None,
+            "get_p99_ms": round(percentile(gets, 99), 3) if gets else None,
+            "put_p50_ms": round(percentile(puts, 50), 3) if puts else None,
+            "put_p99_ms": round(percentile(puts, 99), 3) if puts else None,
+            "write_stall_ms_total": round(
+                stall["sum_ms"] - base_stall["sum_ms"], 2),
+            "write_stalls": int(stall["count"] - base_stall["count"]),
+            "debt_bytes_end_of_load": int(debt_end),
+            "debt_bytes_after_settle": int(debt_settled),
+            "debt_drain_bytes_per_sec": int(
+                max(0, debt_end - debt_settled) / settle_sec),
+            "counters": {
+                "compaction.sched_picks": int(
+                    _counters("compaction.sched_picks") - base_picks),
+                "compaction.yields": int(
+                    _counters("compaction.yields") - base_yields),
+                "compaction.subcompactions": int(
+                    _counters("compaction.subcompactions") - base_sub),
+            },
+            "slow_write_traces": _tail_traces(),
+        }
+    finally:
+        db.close()
+
+
+def run_offline_subcompaction(root: str, args) -> Dict:
+    """The compaction-throughput half of the A/B: ONE large compaction
+    (4 overlapping sorted runs over ``offline_keys`` keys) timed
+    unsliced vs key-range-sliced, no concurrent serving load — the
+    regime subcompactions are designed for (the serving phase above
+    deliberately stays below the slice floor: parallel fan-out on
+    small merges was measured to steal serving CPU for nothing).
+    Output equality is checksummed across both arms."""
+    import hashlib
+
+    from rocksplicator_tpu.storage.engine import DB, DBOptions
+
+    base_sub = _counters("compaction.subcompactions")
+    out: Dict = {"entries": 4 * args.offline_keys}
+    sums = {}
+    # the sliced arm forces >=2 slices: auto (0) resolves to
+    # min(4, cores) which on a single-core host is 1 — the arm would
+    # never slice and the "never sliced" gate would blame the floor
+    for mode, nsub in (("unsliced", 1),
+                       ("sliced", max(2, min(4, os.cpu_count() or 1)))):
+        from rocksplicator_tpu.storage.records import WriteBatch
+
+        db_dir = os.path.join(root, f"offline-{mode}")
+        db = DB(db_dir, DBOptions(
+            memtable_bytes=1 << 30, compaction_scheduler=False,
+            # keep the 4 overlapping L0 runs intact: inline auto
+            # compaction at the L0 trigger would pre-merge them and
+            # both arms would time a single-run no-op
+            disable_auto_compaction=True,
+            target_file_bytes=4 << 20, max_subcompactions=nsub))
+        try:
+            for rev in range(4):
+                batch = None
+                for gid in range(args.offline_keys):
+                    if batch is None:
+                        batch = WriteBatch()
+                    batch.put(key_of(gid),
+                              b"r%d." % rev + put_value(gid, 64))
+                    if batch.count() >= 512:
+                        db.write(batch)
+                        batch = None
+                if batch is not None:
+                    db.write(batch)
+                db.flush()
+            input_bytes = sum(
+                os.path.getsize(os.path.join(db.path, n))
+                for files in db._levels for n in files)
+            t0 = time.monotonic()
+            db.compact_range()
+            secs = time.monotonic() - t0
+            h = hashlib.sha256()
+            for k, v in db.new_iterator():
+                h.update(k)
+                h.update(v)
+            sums[mode] = h.hexdigest()
+            out[f"{mode}_sec"] = round(secs, 3)
+            out[f"{mode}_mb_per_sec"] = round(
+                input_bytes / 1e6 / max(secs, 1e-9), 2)
+        finally:
+            db.close()
+    out["subcompactions"] = int(
+        _counters("compaction.subcompactions") - base_sub)
+    out["output_checksums_equal"] = sums["unsliced"] == sums["sliced"]
+    out["speedup"] = round(out["unsliced_sec"] / max(out["sliced_sec"],
+                                                     1e-9), 2)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--keys", type=int, default=8000)
+    p.add_argument("--value_bytes", type=int, default=128)
+    p.add_argument("--rate", type=float, default=1200.0,
+                   help="offered ops/s (open-loop)")
+    p.add_argument("--duration", type=float, default=6.0)
+    p.add_argument("--mix", default=DEFAULT_MIX)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--workers", type=int, default=8)
+    p.add_argument("--settle", type=float, default=1.5,
+                   help="post-load window measuring debt drain")
+    p.add_argument("--memtable_kb", type=int, default=48)
+    p.add_argument("--target_file_kb", type=int, default=128)
+    p.add_argument("--level_base_kb", type=int, default=256)
+    p.add_argument("--budget_bytes", type=int, default=0,
+                   help="scheduler-on IO budget (0 = yield-only)")
+    p.add_argument("--sync_every", type=int, default=4,
+                   help="every Nth put is a sync write (foreground "
+                        "fsync pressure the budget yields to)")
+    p.add_argument("--min_slice_entries", type=int, default=32768,
+                   help="subcompaction floor (entries per slice; the "
+                        "production default): serving-phase merges "
+                        "below it never slice — fan-out on small "
+                        "merges steals serving CPU for nothing (PERF "
+                        "round 16 measured it); the offline section's "
+                        "large merge crosses it legitimately")
+    p.add_argument("--offline_keys", type=int, default=60000,
+                   help="keyspace for the offline sliced-vs-unsliced "
+                        "one-shot compaction (4 overlapping L0 runs = "
+                        "4x this many entries)")
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--out")
+    args = p.parse_args(argv)
+
+    import shutil
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="rstpu-compact-bench-")
+    t0 = time.monotonic()
+    result: Dict = {
+        "bench": "compaction_bench",
+        "config": {
+            "keys": args.keys, "value_bytes": args.value_bytes,
+            "rate": args.rate, "duration": args.duration,
+            "mix": args.mix, "reps": args.reps,
+            "workers": args.workers, "memtable_kb": args.memtable_kb,
+            "target_file_kb": args.target_file_kb,
+            "level_base_kb": args.level_base_kb,
+            "budget_bytes": args.budget_bytes,
+            "sync_every": args.sync_every, "seed": args.seed,
+            "min_slice_entries": args.min_slice_entries,
+            "note": ("engine slice of the macro-bench mixed load: "
+                     "zipfian keys, Poisson open-loop arrivals, "
+                     "latency from intended arrival"),
+        },
+        "host_calibration": host_calibration(root),
+    }
+    rep_counter = [0]
+
+    def variant(mode: str):
+        def run() -> Dict:
+            rep_counter[0] += 1
+            seed = args.seed + 101 * rep_counter[0]
+            return run_phase(root, mode, args, seed)
+        return run
+
+    try:
+        # baseline FIRST (ratio_vs_sched_off reads naturally); lower
+        # get p99 is better
+        result["ab"] = run_interleaved(
+            [("sched_off", variant("sched_off")),
+             ("sched_on", variant("sched_on"))],
+            reps=args.reps, key="get_p99_ms", higher_is_better=False,
+            log=log)
+        log("compaction_bench: offline sliced-vs-unsliced compaction "
+            f"({4 * args.offline_keys} entries)")
+        result["subcompaction_offline"] = run_offline_subcompaction(
+            root, args)
+        off = result["subcompaction_offline"]
+        log(f"  unsliced {off['unsliced_sec']}s vs sliced "
+            f"{off['sliced_sec']}s = {off['speedup']}x "
+            f"({off['subcompactions']} slices)")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    result["elapsed_sec"] = round(time.monotonic() - t0, 1)
+
+    failures = sched_ab_failures(
+        result["ab"]["samples"],
+        picks_of=lambda ph: ph["counters"]["compaction.sched_picks"],
+        mismatch_label=("reads outside the deterministic value set "
+                       "(acked-write loss)"))
+    off = result.get("subcompaction_offline") or {}
+    if not off.get("output_checksums_equal"):
+        failures.append(
+            "offline sliced compaction output differs from unsliced")
+    if off.get("subcompactions", 0) <= 0:
+        failures.append(
+            "offline sliced arm never sliced (floor too high for "
+            "--offline_keys)")
+    result["failures"] = failures
+
+    rc = emit_gated_artifact(result, args.out, "compaction_bench", log)
+    if rc:
+        return rc
+    summ = result["ab"]["summary"]
+    log(f"compaction_bench: get p99 sched_off="
+        f"{(summ.get('sched_off') or {}).get('median')}ms sched_on="
+        f"{(summ.get('sched_on') or {}).get('median')}ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,7 +52,9 @@ from typing import Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks.ab_runner import host_calibration, run_interleaved  # noqa: E402
+from benchmarks.ab_runner import (emit_gated_artifact,  # noqa: E402
+                                  host_calibration, run_interleaved,
+                                  sched_ab_failures)
 
 SEGMENT = "mac"
 OP_CLASSES = ("get", "put", "multi_get", "scan")
@@ -205,7 +207,24 @@ def serve(args) -> int:
     replicator = Replicator(port=args.port, flags=flags,
                             executor_threads=args.executor_threads)
     handler = admin_server = None
-    db_options = lambda _seg: DBOptions(wal_ttl_seconds=3600.0)  # noqa: E731
+    if args.db_profile == "churn":
+        # compaction-pressure profile (the --sched_ab arms): small
+        # memtables + low L0 triggers + small files so the write-heavy
+        # mix accumulates REAL L0 debt; whether the adaptive scheduler
+        # acts on it comes from the inherited RSTPU_COMPACTION_SCHED
+        db_options = lambda _seg: DBOptions(  # noqa: E731
+            wal_ttl_seconds=3600.0,
+            background_compaction=True,
+            memtable_bytes=24 * 1024,
+            level0_compaction_trigger=4,
+            level0_slowdown_writes_trigger=8,
+            level0_stop_writes_trigger=16,
+            target_file_bytes=48 * 1024,
+            max_bytes_for_level_base=96 * 1024,
+        )
+    else:
+        db_options = lambda _seg: DBOptions(  # noqa: E731
+            wal_ttl_seconds=3600.0)
     if args.admin_port:
         # the live-move variant: this replica also speaks the Admin RPC
         # plane (backup/restore/pause/role-change) so a DirectShardMove
@@ -316,7 +335,9 @@ class Cluster:
     def __init__(self, root: str, shards: int, preload_keys: int,
                  value_bytes: int, write_window: int,
                  read_info_ttl_ms: int, transport: str,
-                 executor_threads: int, with_move_node: bool = False):
+                 executor_threads: int, with_move_node: bool = False,
+                 db_profile: str = "default",
+                 extra_env: Optional[Dict[str, str]] = None):
         self.shards = shards
         self.with_move_node = with_move_node
         self.procs: List[subprocess.Popen] = []
@@ -326,6 +347,7 @@ class Cluster:
                             if with_move_node else [])
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    RSTPU_TRANSPORT=transport)
+        env.update(extra_env or {})
         env.pop("PALLAS_AXON_POOL_IPS", None)
 
         def spawn(role: str, idx: int, upstream: int,
@@ -341,6 +363,7 @@ class Cluster:
                 "--write_window", str(write_window),
                 "--read_info_ttl_ms", str(read_info_ttl_ms),
                 "--executor_threads", str(executor_threads),
+                "--db_profile", db_profile,
             ]
             if self.admin_ports:
                 cmd += ["--admin_port", str(self.admin_ports[idx])]
@@ -867,6 +890,104 @@ def run_read_ab(cluster: Cluster, max_lag: int, duration: float,
 
 
 # ---------------------------------------------------------------------------
+# compaction-scheduler A/B (round 16: whole-cluster, serving-SLO number)
+# ---------------------------------------------------------------------------
+
+
+def run_sched_ab(args) -> Dict:
+    """Interleaved A/B of the workload-adaptive compaction scheduler
+    UNDER the macro-bench: each rep boots a FRESH 3-process cluster per
+    arm — children inherit ``RSTPU_COMPACTION_SCHED`` (1 vs 0) and run
+    the ``churn`` engine profile (small memtables, low L0 triggers) so
+    the write-heavy mix accumulates real L0 debt — then runs one
+    open-loop mixed phase at the SAME offered throughput and scrapes
+    the leader's ``stats`` RPC for the scheduler counters and
+    write-stall totals. Lower get p99 is better."""
+    import shutil
+    import tempfile
+
+    from rocksplicator_tpu.rpc.router import ReadPolicy
+
+    mix = parse_mix(args.sched_mix)
+    total_keys = args.shards * args.preload_keys
+    policy = ReadPolicy.follower_ok(args.max_lag)
+    rep_no = [0]
+
+    def arm(sched: str):
+        name = "sched_on" if sched == "1" else "sched_off"
+
+        def run() -> Dict:
+            rep_no[0] += 1
+            root = tempfile.mkdtemp(prefix="rstpu-macro-sched-")
+            cluster = None
+            try:
+                log(f"sched_ab[{name}]: booting churn cluster "
+                    f"(RSTPU_COMPACTION_SCHED={sched})")
+                cluster = Cluster(
+                    root, args.shards, args.preload_keys,
+                    args.value_bytes, args.write_window,
+                    args.read_info_ttl_ms, args.transport,
+                    args.executor_threads, db_profile="churn",
+                    extra_env={"RSTPU_COMPACTION_SCHED": sched})
+                cluster.wait_catchup(total_keys)
+                phase = run_phase(
+                    cluster, policy, args.sched_rate,
+                    args.sched_duration, total_keys, args.value_bytes,
+                    mix, args.seed + 77 * rep_no[0], args.max_inflight)
+
+                async def scrape(port: int):
+                    return await cluster.pool.call(
+                        "127.0.0.1", port, "stats", {}, timeout=10.0)
+
+                # fleet totals: every replica compacts (followers apply
+                # the same write stream), so stalls/picks sum across
+                # all three processes
+                counters: Dict[str, float] = {}
+                stall_sum, stall_count = 0.0, 0
+                for port in cluster.ports[:3]:
+                    st = cluster.ioloop.run_sync(scrape(port), timeout=15)
+                    for k, v in (st.get("counters") or {}).items():
+                        counters[k] = counters.get(k, 0.0) + v["total"]
+                    rec = (st.get("metrics") or {}).get(
+                        "storage.write_stall_ms") or {}
+                    stall_sum += float(rec.get("sum", 0.0))
+                    stall_count += int(rec.get("count", 0))
+
+                def csum(prefix: str) -> int:
+                    return int(sum(v for k, v in counters.items()
+                                   if k.startswith(prefix)))
+
+                g = phase["ops"].get("get") or {}
+                pw = phase["ops"].get("put") or {}
+                return {
+                    "get_p99_ms": g.get("p99_ms"),
+                    "get_p50_ms": g.get("p50_ms"),
+                    "put_p99_ms": pw.get("p99_ms"),
+                    "achieved_per_sec": phase["achieved_per_sec"],
+                    "get_errors": g.get("errors", 0),
+                    "put_errors": pw.get("errors", 0),
+                    "value_mismatches": phase["value_mismatches"],
+                    "fleet_write_stall_ms": round(stall_sum, 1),
+                    "fleet_write_stalls": stall_count,
+                    "compaction.sched_picks": csum(
+                        "compaction.sched_picks"),
+                    "compaction.yields": csum("compaction.yields"),
+                    "compaction.subcompactions": csum(
+                        "compaction.subcompactions"),
+                }
+            finally:
+                if cluster is not None:
+                    cluster.stop()
+                shutil.rmtree(root, ignore_errors=True)
+        return run
+
+    return run_interleaved(
+        [("sched_off", arm("0")), ("sched_on", arm("1"))],
+        reps=args.sched_reps, key="get_p99_ms", higher_is_better=False,
+        log=log)
+
+
+# ---------------------------------------------------------------------------
 # cluster-wide stats scrape (round 14: the spectator-aggregation path)
 # ---------------------------------------------------------------------------
 
@@ -946,6 +1067,11 @@ def main(argv=None) -> int:
     p.add_argument("--db_dir")
     p.add_argument("--ab_worker", choices=["leader_only", "follower_ok"])
     p.add_argument("--ports", help="ab_worker: leader,f1,f2 ports")
+    p.add_argument("--db_profile", default="default",
+                   choices=["default", "churn"],
+                   help="serve: engine options profile (churn = small "
+                        "memtables + low L0 triggers for compaction-"
+                        "pressure benches)")
     # shared topology / workload knobs
     p.add_argument("--shards", type=int, default=4)
     p.add_argument("--preload_keys", type=int, default=2000,
@@ -984,6 +1110,15 @@ def main(argv=None) -> int:
     p.add_argument("--move_rate", type=float, default=0.0,
                    help="offered ops/s for the move phase (0 = first "
                         "sweep rate)")
+    p.add_argument("--sched_ab", action="store_true",
+                   help="standalone mode: interleaved A/B of the "
+                        "workload-adaptive compaction scheduler "
+                        "(RSTPU_COMPACTION_SCHED=1 vs 0) over fresh "
+                        "churn-profile clusters under a write-heavy mix")
+    p.add_argument("--sched_rate", type=float, default=900.0)
+    p.add_argument("--sched_duration", type=float, default=8.0)
+    p.add_argument("--sched_reps", type=int, default=2)
+    p.add_argument("--sched_mix", default="get=0.5,put=0.5")
     p.add_argument("--out", help="write the artifact JSON here")
     args = p.parse_args(argv)
 
@@ -1019,6 +1154,37 @@ def main(argv=None) -> int:
 
     root = tempfile.mkdtemp(prefix="rstpu-macro-")
     t0 = time.monotonic()
+    if args.sched_ab:
+        # standalone mode: each arm boots its own cluster (the
+        # scheduler switch is a process-env knob), so the normal
+        # shared-cluster flow below does not apply
+        result = {
+            "bench": "macro_bench_sched_ab",
+            "config": {
+                "shards": args.shards,
+                "preload_keys_per_shard": args.preload_keys,
+                "value_bytes": args.value_bytes,
+                "mix": parse_mix(args.sched_mix),
+                "rate": args.sched_rate,
+                "duration": args.sched_duration,
+                "reps": args.sched_reps,
+                "transport": args.transport,
+                "seed": args.seed,
+                "db_profile": "churn",
+                "topology": ("1 leader + 2 followers (mode 1), "
+                             "3 OS processes, fresh cluster per arm"),
+            },
+            "host_calibration": host_calibration(root),
+        }
+        try:
+            result["sched_ab"] = run_sched_ab(args)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        result["elapsed_sec"] = round(time.monotonic() - t0, 1)
+        result["failures"] = sched_ab_failures(
+            result["sched_ab"]["samples"],
+            picks_of=lambda s: s["compaction.sched_picks"])
+        return emit_gated_artifact(result, args.out, "macro_bench", log)
     result: Dict = {
         "bench": "macro_bench",
         "config": {

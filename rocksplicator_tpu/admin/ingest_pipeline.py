@@ -220,10 +220,23 @@ class BatchCompactor:
             for _name, db in by_db.values():
                 if id(db) not in rem_ids:
                     resolve(db, result=len(batch))
-        # per-db fan-out: CPU backends, declined shards, single shards
+        # per-db fan-out: CPU backends, declined shards, single shards.
+        # DBs running the adaptive compaction scheduler take its manual
+        # queue (DB.schedule_compaction) so the post-ingest compaction
+        # obeys the same PRIORITY order as background picks — an
+        # L0-storm drain outranks it; schedule_compaction returns None
+        # for engines without an adaptive compaction thread (inline
+        # mode, scheduler off), which keep the direct compact_range.
         def one(name: str, db) -> None:
             try:
-                db.compact_range()
+                fut = None
+                submit = getattr(db, "schedule_compaction", None)
+                if submit is not None:
+                    fut = submit()
+                if fut is not None:
+                    fut.result()
+                else:
+                    db.compact_range()
                 resolve(db, result=len(batch))
             except BaseException as e:
                 resolve(db, exc=e)

@@ -27,6 +27,12 @@ Entry = Tuple[bytes, int, int, bytes]  # key, seq, vtype, value
 
 class CompactionBackend:
     name = "base"
+    # True on backends whose ``merge_runs_to_files`` accepts the
+    # ``max_subcompactions``/``io_budget`` keywords (key-range
+    # subcompactions + foreground-yielding IO budget); the engine only
+    # passes them to backends that declare support, so third-party
+    # backend signatures stay valid.
+    supports_subcompactions = False
 
     def merge_runs(
         self,
@@ -47,6 +53,7 @@ class CpuCompactionBackend(CompactionBackend):
     the common case."""
 
     name = "cpu"
+    supports_subcompactions = True
 
     def merge_runs(
         self,
@@ -68,17 +75,22 @@ class CpuCompactionBackend(CompactionBackend):
         compression: int,
         bits_per_key: int,
         target_file_bytes: int,
+        max_subcompactions: int = 1,
+        io_budget=None,
     ):
         """[(path, props)], [] for an all-tombstoned result, or None →
         the engine's tuple path. Shared implementation with the native
         backend (storage/native_compaction.direct_merge_runs_to_files);
         the native C resolve is used when the library is loaded, the
-        numpy lexsort+reduceat resolve otherwise."""
+        numpy lexsort+reduceat resolve otherwise. With
+        ``max_subcompactions > 1`` the merge splits into parallel
+        key-range slices; ``io_budget`` paces output writes."""
         from .native_compaction import direct_merge_runs_to_files
 
         return direct_merge_runs_to_files(
             runs, merge_op, drop_tombstones, path_factory, block_bytes,
             compression, bits_per_key, target_file_bytes,
+            max_subcompactions=max_subcompactions, io_budget=io_budget,
         )
 
 

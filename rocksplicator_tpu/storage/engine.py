@@ -113,13 +113,39 @@ class DBOptions:
     # — the BackupEngine-incremental-chain analog. None = segments are
     # simply deleted at TTL, as before.
     wal_archive_sink: Optional[object] = None
+    # Workload-adaptive compaction scheduling (compaction_scheduler.py):
+    # the background compaction thread picks work by PRESSURE (L0 file
+    # count vs triggers, per-level debt vs targets, windowed read-amp,
+    # delayed-write stall boost) and re-ranks on every flush/install
+    # instead of waiting on the fixed L0 trigger. RSTPU_COMPACTION_SCHED=0
+    # reverts every DB in the process to the legacy trigger loop (the
+    # scheduler A/B's off arm).
+    compaction_scheduler: bool = field(
+        default_factory=lambda: os.environ.get(
+            "RSTPU_COMPACTION_SCHED", "1") not in ("0", "false"))
+    # Key-range subcompactions (rocksdb max_subcompactions): one large
+    # compaction splits into disjoint key-range slices executed in
+    # parallel across cores (one padded device batch on the TPU
+    # backend). 0 = auto (min(4, cores)), 1 = off.
+    max_subcompactions: int = field(
+        default_factory=lambda: int(os.environ.get(
+            "RSTPU_MAX_SUBCOMPACTIONS", "0")))
+    # Compaction output IO budget (bytes/s) shared with the delayed-
+    # write controller: compaction file writes consume tokens and yield
+    # to in-flight foreground WAL fsyncs; admission stalls OPEN the
+    # budget (debt drain is what un-delays writes), as does a
+    # read-heavy mix. 0 = unmetered (yield-to-foreground only).
+    compaction_budget_bytes_per_sec: int = field(
+        default_factory=lambda: int(os.environ.get(
+            "RSTPU_COMPACT_BUDGET_BYTES", "0")))
 
     # Mutable at runtime via DB.set_options (reference setDBOptions RPC).
     MUTABLE = {
         "memtable_bytes", "wal_ttl_seconds", "level0_compaction_trigger",
         "target_file_bytes", "disable_auto_compaction", "sync_writes",
         "delayed_write_rate", "level0_slowdown_writes_trigger",
-        "level0_stop_writes_trigger",
+        "level0_stop_writes_trigger", "max_subcompactions",
+        "compaction_budget_bytes_per_sec",
     }
 
 
@@ -245,9 +271,28 @@ class DB:
         self._files_consulted_total = 0
         self._bytes_flushed_total = 0
         self._bytes_compacted_total = 0
+        # last foreground write (monotonic): the scheduler defers batch
+        # level-debt work while the foreground is live and drains it in
+        # valleys (compaction_scheduler.IDLE_DRAIN_SEC). 0 = never
+        # written this process ⇒ idle, so a reopened db with standing
+        # debt drains immediately.
+        self._last_write_mono = 0.0
         # short-lived cache so one /stats or /metrics dump evaluating a
         # dozen per-db gauges pays ONE lock pass, not one per gauge
         self._metrics_cache: Tuple[float, Optional[Dict]] = (0.0, None)
+        # Workload-adaptive compaction scheduling (round 16): priority
+        # picks from the pressure gauges + the foreground-yielding IO
+        # budget. The budget exists whenever the scheduler does — even
+        # at rate 0 its yield-to-foreground tier is active.
+        self._sched = None
+        self._io_budget = None
+        if self.options.background_compaction and \
+                self.options.compaction_scheduler:
+            from .compaction_scheduler import CompactionScheduler, IoBudget
+
+            self._sched = CompactionScheduler(self)
+            self._io_budget = IoBudget(
+                self.options.compaction_budget_bytes_per_sec)
         self._open()
         if self.options.background_compaction:
             # Separate flush and compaction threads (as RocksDB separates
@@ -310,6 +355,10 @@ class DB:
         self._wal = wal_mod.WalWriter(
             self._wal_dir, self.options.wal_segment_bytes
         )
+        if self._io_budget is not None:
+            # foreground WAL fsyncs register in-flight so compaction
+            # output writes yield to them (compaction_scheduler.IoBudget)
+            self._wal.io_budget = self._io_budget
 
     @property
     def _wal_dir(self) -> str:
@@ -384,6 +433,7 @@ class DB:
             self._check_open()
             self._check_flush_health_locked()
             start_seq = self._last_seq + 1
+            self._last_write_mono = time.monotonic()
             if encoded is None:
                 encoded = batch.encode()
             assert self._wal is not None
@@ -431,6 +481,7 @@ class DB:
             self._check_flush_health_locked()
             assert self._wal is not None
             first_seq = self._last_seq + 1
+            self._last_write_mono = time.monotonic()
             records = []
             seq = first_seq
             for batch, encoded in items:
@@ -559,13 +610,16 @@ class DB:
         self._mem = MemTable()
         self._cond.notify_all()
 
-    @staticmethod
-    def _record_stall(stall_start: Optional[float]) -> None:
+    def _record_stall(self, stall_start: Optional[float]) -> None:
         if stall_start is not None:
-            Stats.get().add_metric(
-                "storage.write_stall_ms",
-                (time.monotonic() - stall_start) * 1000.0,
-            )
+            stall_ms = (time.monotonic() - stall_start) * 1000.0
+            Stats.get().add_metric("storage.write_stall_ms", stall_ms)
+            if self._io_budget is not None:
+                # the delayed-write controller's stall signal feeds the
+                # scheduler's priority boost AND opens the IO budget:
+                # debt drain accelerates precisely when writes are
+                # being delayed
+                self._io_budget.note_stall(stall_ms)
 
     def _flush_gate_tripped_locked(self) -> bool:
         """One source of truth for 'the background flusher is dead enough
@@ -984,25 +1038,93 @@ class DB:
                                   self._bg_flush_failures)
                     time.sleep(1.0)
 
+    def _pick_compaction_locked(self):
+        """The compaction thread's work selector. With the adaptive
+        scheduler: rank candidates by pressure (compaction_scheduler.py)
+        — re-ranked on every wake, and every flush install/compaction
+        install/ingest/set_options notifies the condition, so ranking
+        is event-driven rather than a timer scan. Without it: the
+        legacy fixed L0-trigger predicate."""
+        if self._sched is not None:
+            return self._sched.pick_locked()
+        from .compaction_scheduler import Pick
+
+        if (not self.options.disable_auto_compaction
+                and len(self._levels[0])
+                >= self.options.level0_compaction_trigger):
+            return Pick("l0", 0, 1.0, "legacy trigger")
+        return None
+
+    def schedule_compaction(self):
+        """Queue a manual FULL compaction on the scheduler's priority
+        queue and return a Future resolved when it completes — the
+        post-ingest path (admin BatchCompactor) submits through this so
+        its compactions obey the same priority order as background
+        picks. Returns None when no adaptive compaction thread is
+        running (caller falls back to a direct compact_range)."""
+        with self._lock:
+            self._check_open()
+            if (self._sched is None or self._compaction_thread is None
+                    or self._bg_stop):
+                return None
+            from concurrent.futures import Future
+
+            fut: Future = Future()
+            self._sched.submit_manual_locked(fut)
+            self._cond.notify_all()
+            return fut
+
     def _compaction_loop(self) -> None:
+        from ..utils.stats import tagged
+
         while True:
             with self._lock:
-                while not self._bg_stop and (
-                    self.options.disable_auto_compaction
-                    or len(self._levels[0])
-                    < self.options.level0_compaction_trigger
-                ):
-                    # wake sources all notify: flush install, close, and
-                    # set_options (the predicate reads MUTABLE options)
+                pick = None
+                while not self._bg_stop:
+                    pick = self._pick_compaction_locked()
+                    if pick is not None:
+                        break
+                    # wake sources all notify: flush install, compaction
+                    # install, ingest, manual submission, close, and
+                    # set_options (the ranking reads MUTABLE options)
                     self._cond.wait(10.0)
                 if self._bg_stop:
+                    if self._sched is not None:
+                        self._sched.fail_pending_locked(
+                            StorageError("db closing"))
                     return
+                if self._sched is not None:
+                    self._sched.note_picked_locked()
+            manual_futs = []
             try:
-                self._compact_level0_bg()
+                if self._sched is not None:
+                    # before dequeuing manual futures: a fault injected
+                    # at the pick seam is retried by this loop (registry
+                    # contract), so it must not permanently fail waiters
+                    # whose compaction was never attempted
+                    fp.hit("compact.pick")
+                    Stats.get().incr(
+                        tagged("compaction.sched_picks", kind=pick.kind))
+                if pick.kind == "manual":
+                    with self._lock:
+                        manual_futs = self._sched.take_manual_locked()
+                    # one full compaction satisfies every queued waiter
+                    # (the same coalescing as BatchCompactor's dedupe)
+                    self.compact_range()
+                    for f in manual_futs:
+                        if not f.done():
+                            f.set_result(None)
+                elif pick.kind == "level":
+                    self._compact_level_bg(pick.level)
+                else:
+                    self._compact_level0_bg()
                 with self._lock:
                     self._bg_compaction_error = None
                     self._bg_compaction_failures = 0
             except Exception as e:
+                for f in manual_futs:
+                    if not f.done():
+                        f.set_exception(e)
                 with self._lock:
                     self._bg_compaction_error = e
                     self._bg_compaction_failures += 1
@@ -1184,6 +1306,15 @@ class DB:
                 out_names = self._write_merged(runs, drop_tombstones=drop)
             csp.annotate(outputs=len(out_names))
             with start_span("compaction.install"):
+                # crash-at-install atomicity: a fault here (before any
+                # in-memory mutation or manifest write) leaves the DB
+                # exactly pre-compaction — outputs are swept, inputs
+                # stay live (tested by the subcompaction crash matrix)
+                try:
+                    fp.hit("compact.install")
+                except BaseException:
+                    self._discard_outputs(out_names)
+                    raise
                 with self._lock:
                     if self._closed:
                         return
@@ -1203,6 +1334,86 @@ class DB:
                 # Durable manifest first, THEN delete the files it stopped
                 # referencing — all outside self._lock (the fsyncs + a few
                 # hundred unlinks under the lock were a write-stall tail).
+                self._write_manifest_payload(*snapshot)
+            with start_span("compaction.gc", files=len(dead)):
+                self._remove_dead_files(dead)
+
+    def _compact_level_bg(self, level: int) -> None:
+        """Debt-driven level→level+1 compaction (scheduler "level"
+        pick): merge all of ``level`` with the OVERLAPPING files of
+        ``level+1``, install into ``level+1``. Same off-lock merge and
+        manifest-before-GC ordering as the L0 path; safe because
+        compactions are serialized by _compaction_mutex and nothing
+        else adds files to levels >= 1."""
+        with self._compaction_mutex, \
+                start_span("storage.compaction", always=True) as csp:
+            with start_span("compaction.plan"):
+                with self._lock:
+                    if self._closed:
+                        return
+                    top = len(self._levels) - 1
+                    if self.options.allow_ingest_behind:
+                        # the true bottom level is reserved for
+                        # ingested-behind files (compact_range makes the
+                        # same reservation) — never install into it
+                        top -= 1
+                    if not (1 <= level < top):
+                        return
+                    inputs_src = list(self._levels[level])
+                    if not inputs_src:
+                        return
+                    # overlap against the source files' overall range
+                    lo = hi = None
+                    for n in inputs_src:
+                        r = self._readers[n]
+                        mn, mx = r.min_key(), r.max_key()
+                        if mn is None:
+                            continue
+                        lo = mn if lo is None else min(lo, mn)
+                        hi = mx if hi is None else max(hi, mx)
+                    inputs_dst = []
+                    for n in self._levels[level + 1]:
+                        r = self._readers[n]
+                        mn, mx = r.min_key(), r.max_key()
+                        if mn is None or lo is None or (
+                                mx >= lo and mn <= hi):
+                            inputs_dst.append(n)
+                    inputs = inputs_src + inputs_dst
+                    # tombstones survive unless level+1 is the deepest
+                    # data-bearing level (same rule as the L0 path)
+                    drop = (
+                        all(not self._levels[i]
+                            for i in range(level + 2, len(self._levels)))
+                        and not self.options.allow_ingest_behind
+                    )
+                    runs = [self._readers[n] for n in inputs]
+            csp.annotate(inputs=len(inputs), backend=self._backend.name,
+                         level=level)
+            with start_span("compaction.merge"):
+                out_names = self._write_merged(runs, drop_tombstones=drop)
+            csp.annotate(outputs=len(out_names))
+            with start_span("compaction.install"):
+                try:
+                    fp.hit("compact.install")
+                except BaseException:
+                    self._discard_outputs(out_names)
+                    raise
+                with self._lock:
+                    if self._closed:
+                        return
+                    src_set = set(inputs_src)
+                    dst_set = set(inputs_dst)
+                    self._levels[level] = [
+                        n for n in self._levels[level] if n not in src_set]
+                    self._levels[level + 1] = [
+                        n for n in self._levels[level + 1]
+                        if n not in dst_set
+                    ] + out_names
+                    self._note_compacted_locked(out_names)
+                    self._fences.clear()
+                    snapshot = self._manifest_snapshot_locked()
+                    dead = [(n, self._readers.pop(n, None)) for n in inputs]
+                    self._cond.notify_all()
                 self._write_manifest_payload(*snapshot)
             with start_span("compaction.gc", files=len(dead)):
                 self._remove_dead_files(dead)
@@ -1304,6 +1515,11 @@ class DB:
                 )
             csp.annotate(outputs=len(out_names))
             with start_span("compaction.install"):
+                try:
+                    fp.hit("compact.install")
+                except BaseException:
+                    self._discard_outputs(out_names)
+                    raise
                 with self._lock:
                     self._check_open()
                     input_set = set(inputs)
@@ -1319,9 +1535,16 @@ class DB:
                     # manifest pointing at deleted ones (unopenable DB).
                     self._persist_manifest()
                     self._gc_files(inputs)
+                    # L0 drained: re-rank the scheduler / wake stalled
+                    # writers parked on the stop trigger
+                    self._cond.notify_all()
 
     def _compact_level0_locked(self) -> None:
-        """L0 → L1 compaction (tombstones kept; not bottom level)."""
+        """L0 → L1 compaction (tombstones kept; not bottom level).
+        Runs UNDER the DB lock (inline mode), so subcompactions are
+        forced off: a slice worker allocating an output name would
+        block on the lock this thread holds — and with writers parked
+        on the same lock there is no latency to win anyway."""
         inputs = list(self._levels[0]) + list(self._levels[1])
         if not inputs:
             return
@@ -1330,7 +1553,8 @@ class DB:
             all(not files for files in self._levels[2:])
             and not self.options.allow_ingest_behind
         )
-        out_names = self._write_merged(runs, drop_tombstones=drop)
+        out_names = self._write_merged(runs, drop_tombstones=drop,
+                                       subcompactions=1)
         self._levels[0] = []
         self._levels[1] = out_names
         self._note_compacted_locked(out_names)
@@ -1338,7 +1562,15 @@ class DB:
         self._persist_manifest()  # before GC — see compact_range
         self._gc_files(inputs)
 
-    def _write_merged(self, runs: List, drop_tombstones: bool) -> List[str]:
+    def _effective_subcompactions(self) -> int:
+        """max_subcompactions with 0 = auto (min(4, cores))."""
+        n = self.options.max_subcompactions
+        if n <= 0:
+            n = min(4, os.cpu_count() or 1)
+        return max(1, n)
+
+    def _write_merged(self, runs: List, drop_tombstones: bool,
+                      subcompactions: Optional[int] = None) -> List[str]:
         # Backends with a direct file sink (the TPU pipeline: kernel output
         # arrays → vectorized block assembly + kernel-built bloom) skip the
         # per-entry tuple path entirely, splitting at target_file_bytes.
@@ -1356,12 +1588,21 @@ class DB:
                 allocated.append(name)
                 return os.path.join(self.path, name)
 
+            # subcompaction + IO-budget plumbing only for backends that
+            # declare support (keeps third-party backend signatures
+            # unchanged)
+            kwargs = {}
+            if getattr(self._backend, "supports_subcompactions", False):
+                kwargs["max_subcompactions"] = (
+                    subcompactions if subcompactions is not None
+                    else self._effective_subcompactions())
+                kwargs["io_budget"] = self._io_budget
             try:
                 outputs = direct(
                     runs, self.options.merge_operator, drop_tombstones,
                     path_factory, self.options.block_bytes,
                     self.options.compression, self.options.bits_per_key,
-                    self.options.target_file_bytes,
+                    self.options.target_file_bytes, **kwargs,
                 )
             except Exception:
                 log.exception("direct merge sink failed; using tuple path")
@@ -1377,12 +1618,15 @@ class DB:
         stream = self._backend.merge_runs(
             streams, self.options.merge_operator, drop_tombstones
         )
-        return self._write_entry_stream(stream)
+        return self._write_entry_stream(stream, io_budget=self._io_budget)
 
-    def _write_entry_stream(self, stream) -> List[str]:
+    def _write_entry_stream(self, stream, io_budget=None) -> List[str]:
         """Write an already-merged (key asc, seq desc) entry stream into
         output SSTs, splitting at target_file_bytes. Shared by the tuple
-        merge path and the cross-db batched-compaction install."""
+        merge path and the cross-db batched-compaction install.
+        ``io_budget`` (compaction callers only) throttles after each
+        finished output file so background IO yields to foreground
+        fsyncs."""
         out_names: List[str] = []
         writer: Optional[SSTWriter] = None
         written = 0
@@ -1402,8 +1646,12 @@ class DB:
             if written >= self.options.target_file_bytes:
                 writer.finish()
                 writer = None
+                if io_budget is not None:
+                    io_budget.throttle(written)
         if writer is not None:
             writer.finish()
+            if io_budget is not None:
+                io_budget.throttle(written)
         for name in out_names:
             self._readers[name] = SSTReader(os.path.join(self.path, name))
         return out_names
@@ -1487,7 +1735,8 @@ class DB:
                         "expressible (non-uniform widths) — unpack to "
                         "entries for the tuple sink")
             else:
-                out_names = self._write_entry_stream(iter(entries))
+                out_names = self._write_entry_stream(
+                    iter(entries), io_budget=self._io_budget)
             with self._lock:
                 self._check_open()
                 input_set = set(plan["inputs"])
@@ -1518,6 +1767,7 @@ class DB:
             lanes, count, self.allocate_sst_path,
             self.options.block_bytes, self.options.compression,
             self.options.bits_per_key, self.options.target_file_bytes,
+            io_budget=self._io_budget,
         )
         if outputs is None:
             return None
@@ -1554,6 +1804,14 @@ class DB:
     def _gc_files(self, names: List[str]) -> None:
         self._remove_dead_files(
             [(name, self._readers.pop(name, None)) for name in names])
+
+    def _discard_outputs(self, out_names: List[str]) -> None:
+        """Sweep never-installed compaction outputs after an install-
+        phase fault: close + drop their readers and unlink the files
+        (nothing references them — the manifest was never written)."""
+        with self._lock:
+            dead = [(n, self._readers.pop(n, None)) for n in out_names]
+        self._remove_dead_files(dead)
 
     # ------------------------------------------------------------------
     # properties (application_db.cpp:183-225)
@@ -1695,6 +1953,10 @@ class DB:
                 # _coerce handles "false"→False etc. (same class of bug as
                 # flags string coercion).
                 setattr(self.options, k, _coerce(v, type(current)))
+            if ("compaction_budget_bytes_per_sec" in updates
+                    and self._io_budget is not None):
+                self._io_budget.set_rate(
+                    self.options.compaction_budget_bytes_per_sec)
             # wake the background loops: their wait predicates read
             # mutable options (e.g. disable_auto_compaction toggled off
             # must start the parked compactor now, not on the next write)

@@ -40,6 +40,12 @@ _PUT, _DELETE, _MERGE = 1, 2, 3
 # bound the in-memory lane concatenation (~48 B/entry of lanes)
 MAX_DIRECT_ENTRIES = 1 << 22
 
+# Key-range subcompactions engage only when every slice would carry at
+# least this many entries — below it the thread fan-out costs more than
+# the parallel resolve buys (tests lower it to force slicing on small
+# fixtures).
+MIN_SLICE_ENTRIES = 1 << 15
+
 
 class NativeCompactionBackend(CpuCompactionBackend):
     name = "native"
@@ -54,6 +60,8 @@ class NativeCompactionBackend(CpuCompactionBackend):
         compression: int,
         bits_per_key: int,
         target_file_bytes: int,
+        max_subcompactions: int = 1,
+        io_budget=None,
     ) -> Optional[List[Tuple[str, dict]]]:
         """[(path, props)], [] for an all-tombstoned result, or None →
         the engine's tuple path. (Shared with CpuCompactionBackend —
@@ -61,6 +69,7 @@ class NativeCompactionBackend(CpuCompactionBackend):
         return direct_merge_runs_to_files(
             runs, merge_op, drop_tombstones, path_factory, block_bytes,
             compression, bits_per_key, target_file_bytes,
+            max_subcompactions=max_subcompactions, io_budget=io_budget,
         )
 
     # -- internals ---------------------------------------------------------
@@ -194,22 +203,31 @@ def read_runs_as_lanes(
     concatenated lane arrays. Returns (parts, lanes, total, vw) or None
     when the lane representation can't express the inputs (per-run
     checks bail early, before materializing the rest). Shared by the
-    direct compaction sink and the batched cross-shard service."""
+    direct compaction sink and the batched cross-shard service.
+
+    Deliberately single-threaded: the per-block Python between the
+    GIL-releasing zlib/numpy stretches convoys badly under a thread
+    fan-out (measured 2.6x SLOWER with 4 decode threads) — the decode
+    phase parallelizes by CHUNK in the planned streaming merge, not by
+    thread here."""
     from ..ops.kv_format import UnsupportedBatch, pack_entries
     from ..tpu.format import read_sst_arrays
+
+    def decode_one(run) -> Optional[dict]:
+        if hasattr(run, "iterate"):  # an SSTReader
+            arr = read_sst_arrays(run)
+            if arr is None:
+                arr = NativeCompactionBackend._arrays_from_entries(
+                    list(run.iterate()), pack_entries)
+        else:
+            arr = NativeCompactionBackend._arrays_from_entries(
+                list(run), pack_entries)
+        return arr
 
     parts: List[dict] = []
     total = 0
     try:
-        for run in runs:
-            if hasattr(run, "iterate"):  # an SSTReader
-                arr = read_sst_arrays(run)
-                if arr is None:
-                    arr = NativeCompactionBackend._arrays_from_entries(
-                        list(run.iterate()), pack_entries)
-            else:
-                arr = NativeCompactionBackend._arrays_from_entries(
-                    list(run), pack_entries)
+        for arr in (decode_one(run) for run in runs):
             if arr is not None:
                 if merge_op is not None:
                     # uint64-add fold semantics require 8-byte values
@@ -273,11 +291,14 @@ def lanes_resolvable(lanes: dict, merge_op: Optional[MergeOperator]) -> bool:
 def write_resolved_lanes(
     arrays: dict, count: int, path_factory, block_bytes: int,
     compression: int, bits_per_key: int, target_file_bytes: int,
+    io_budget=None,
 ) -> Optional[List[Tuple[str, dict]]]:
     """Write resolved lanes as PLANAR SSTs split at target_file_bytes
     with bulk-built blooms — the shared array file sink. None when the
     planar layout can't express the rows; a mid-loop failure cleans up
-    every file already written (nothing would ever GC the orphans)."""
+    every file already written (nothing would ever GC the orphans).
+    ``io_budget`` (compaction callers only) throttles after each output
+    file so compaction IO yields to foreground fsyncs."""
     from ..tpu.format import planar_stride, planar_widths, \
         write_sst_from_arrays
 
@@ -316,6 +337,12 @@ def write_resolved_lanes(
                 cleanup()
                 return None
             outputs.append((path, props))
+            if io_budget is not None:
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    size = (end - start) * stride
+                io_budget.throttle(size)
     except BaseException:
         # a mid-loop failure (disk full on file 2 of 3) must not
         # leak file 1: the engine falls back to the tuple path and
@@ -323,6 +350,178 @@ def write_resolved_lanes(
         cleanup()
         raise
     return outputs
+
+
+# ---------------------------------------------------------------------------
+# key-range subcompactions (rocksdb max_subcompactions analog)
+# ---------------------------------------------------------------------------
+#
+# One large compaction splits into disjoint KEY-RANGE slices executed in
+# parallel across cores. Boundaries are chosen from the input runs' own
+# key distribution (evenly spaced rows of each decoded SST — the lane
+# image of the files' fence/block-index keys) and are plain KEYS, so a
+# key's whole entry group — MERGE operand chains, duplicate seqs,
+# tombstone stacks — lands in exactly one slice by construction and the
+# per-slice resolve is byte-equivalent to the unsliced single pass
+# (pinned by the slice-boundary matrix test). Slice outputs concatenate
+# in boundary order and install atomically as ONE generation.
+
+
+def _part_key(part: dict, i: int, klen: int) -> bytes:
+    """Key bytes of row ``i`` (uniform width ``klen`` — guaranteed by
+    lanes_resolvable before slicing is attempted)."""
+    return part["key_words_be"][i].astype(">u4").tobytes()[:klen]
+
+
+def _first_row_ge(part: dict, key: bytes, klen: int) -> int:
+    """First row index with key >= ``key`` in a (key asc)-sorted run."""
+    lo, hi = 0, part["key_len"].shape[0]
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if _part_key(part, mid, klen) < key:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
+
+
+def choose_slice_boundaries(parts: List[dict], nslices: int,
+                            klen: int) -> List[bytes]:
+    """Up to ``nslices - 1`` boundary KEYS approximating equal-weight
+    quantiles of the merged key distribution: each run contributes
+    evenly spaced sample rows proportional to its size (the decoded
+    form of its SST fence array), the pooled samples sort, and the
+    quantile points dedupe. May return fewer boundaries than asked
+    (skewed or tiny key sets)."""
+    total = sum(p["key_len"].shape[0] for p in parts)
+    if total == 0 or nslices <= 1:
+        return []
+    per_total = max(nslices * 8, 64)
+    samples: List[bytes] = []
+    for part in parts:
+        n = part["key_len"].shape[0]
+        if n == 0:
+            continue
+        take = max(1, min(n, (per_total * n + total - 1) // total))
+        idx = np.linspace(0, n - 1, take).astype(int)
+        samples.extend(_part_key(part, int(i), klen) for i in idx)
+    samples.sort()
+    bounds: List[bytes] = []
+    lo_key = samples[0]
+    for s in range(1, nslices):
+        b = samples[(s * len(samples)) // nslices]
+        if b > lo_key and (not bounds or b > bounds[-1]):
+            bounds.append(b)
+    return bounds
+
+
+def plan_subcompactions(parts: List[dict], total: int,
+                        max_subcompactions: int, klen: int) -> List[bytes]:
+    """Boundary keys for this compaction, or [] to run unsliced. Slices
+    only when the parallelism is asked for, every slice would clear
+    MIN_SLICE_ENTRIES, and every run is (key, seq)-sorted — the bisect
+    cut is only meaningful on sorted runs (unsorted inputs take the
+    full-lexsort resolve unsliced)."""
+    nslices = min(int(max_subcompactions), total // max(1, MIN_SLICE_ENTRIES))
+    if nslices <= 1:
+        return []
+    if not all(NativeCompactionBackend._run_is_sorted(p) for p in parts):
+        return []
+    return choose_slice_boundaries(parts, nslices, klen)
+
+
+def slice_parts(parts: List[dict], bounds: List[bytes], si: int,
+                klen: int, cuts: List[List[int]],
+                fields: Optional[Tuple[str, ...]] = None) -> List[dict]:
+    """Slice ``si``'s row ranges of every part (``cuts[p]`` = the
+    per-part boundary row indices from _first_row_ge)."""
+    if fields is None:
+        fields = ("key_words_be", "key_len", "seq_hi", "seq_lo", "vtype",
+                  "val_words", "val_len")
+    out: List[dict] = []
+    for p, c in zip(parts, cuts):
+        lo = c[si - 1] if si > 0 else 0
+        hi = c[si] if si < len(bounds) else p["key_len"].shape[0]
+        if hi > lo:
+            out.append({f: p[f][lo:hi] for f in fields})
+    return out
+
+
+def _subcompact_to_files(
+    parts: List[dict], bounds: List[bytes], klen: int, vw: int,
+    merge_op: Optional[MergeOperator], drop_tombstones: bool,
+    path_factory, block_bytes: int, compression: int, bits_per_key: int,
+    target_file_bytes: int, io_budget,
+) -> List[Tuple[str, dict]]:
+    """Resolve + write every key-range slice in parallel; outputs
+    concatenate in boundary order (still globally key-sorted and
+    non-overlapping). Any slice failure sweeps every file already
+    written by every slice and re-raises — the caller falls back to the
+    unsliced/tuple path, and nothing would ever GC the orphans."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..observability.span import start_span
+    from ..testing import failpoints as fp
+    from ..utils.stats import Stats
+
+    cuts = [[_first_row_ge(p, b, klen) for b in bounds] for p in parts]
+    nsl = len(bounds) + 1
+    results: List[Optional[List[Tuple[str, dict]]]] = [None] * nsl
+    written_lock = threading.Lock()
+    written_paths: List[str] = []
+
+    def tracking_factory() -> str:
+        path = path_factory()
+        with written_lock:
+            written_paths.append(path)
+        return path
+
+    def run_slice(si: int) -> None:
+        fp.hit("compact.subcompact")
+        Stats.get().incr("compaction.subcompactions")
+        sub_parts = slice_parts(parts, bounds, si, klen, cuts)
+        if not sub_parts:
+            results[si] = []
+            return
+        fields = sub_parts[0].keys()
+        sub_lanes = {f: np.concatenate([p[f] for p in sub_parts])
+                     for f in fields}
+        sub_total = sub_lanes["key_len"].shape[0]
+        arrays, count = NativeCompactionBackend._resolve(
+            sub_parts, sub_lanes, sub_total, vw, merge_op,
+            drop_tombstones)
+        if count == 0:
+            results[si] = []
+            return
+        outs = write_resolved_lanes(
+            arrays, count, tracking_factory, block_bytes, compression,
+            bits_per_key, target_file_bytes, io_budget=io_budget)
+        if outs is None:  # cannot happen after the global width checks
+            raise RuntimeError(f"slice {si}: planar sink declined")
+        results[si] = outs
+
+    with start_span("compact.subcompactions", slices=nsl):
+        with ThreadPoolExecutor(
+            max_workers=min(nsl, os.cpu_count() or 2),
+            thread_name_prefix="subcompact",
+        ) as pool:
+            futs = [pool.submit(run_slice, si) for si in range(nsl)]
+            errs = []
+            for f in futs:
+                try:
+                    f.result()
+                except BaseException as e:
+                    errs.append(e)
+        if errs:
+            with written_lock:
+                for p in written_paths:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+            raise errs[0]
+    return [o for outs in results for o in (outs or [])]
 
 
 def direct_merge_runs_to_files(
@@ -334,13 +533,20 @@ def direct_merge_runs_to_files(
     compression: int,
     bits_per_key: int,
     target_file_bytes: int,
+    max_subcompactions: int = 1,
+    io_budget=None,
 ) -> Optional[List[Tuple[str, dict]]]:
     """The CPU array compaction pipeline: runs → lanes → merge-resolve
     (native C when loaded, numpy lexsort+reduceat otherwise) → PLANAR
     files. [(path, props)], [] for an all-tombstoned result, or None →
     the engine's tuple path. Shared by CpuCompactionBackend and
     NativeCompactionBackend so every CPU-configured engine compacts
-    array-to-array when the inputs allow it."""
+    array-to-array when the inputs allow it.
+
+    ``max_subcompactions > 1``: the merge splits into disjoint
+    key-range slices resolved+written in parallel across cores (see the
+    subcompaction block above); ``io_budget`` paces the output writes
+    so compaction IO yields to foreground fsyncs."""
     from ..observability.span import start_span
 
     if merge_op is not None and not isinstance(merge_op, UInt64AddOperator):
@@ -351,6 +557,15 @@ def direct_merge_runs_to_files(
     parts, lanes, total, vw = read
     if not lanes_resolvable(lanes, merge_op):
         return None
+    if max_subcompactions > 1:
+        kl = lanes["key_len"]
+        klen = int(kl[0]) if len(kl) else 0
+        bounds = plan_subcompactions(parts, total, max_subcompactions, klen)
+        if bounds:
+            return _subcompact_to_files(
+                parts, bounds, klen, vw, merge_op, drop_tombstones,
+                path_factory, block_bytes, compression, bits_per_key,
+                target_file_bytes, io_budget)
     with start_span("compact.resolve", entries=total):
         arrays, count = NativeCompactionBackend._resolve(
             parts, lanes, total, vw, merge_op, drop_tombstones)
@@ -358,5 +573,5 @@ def direct_merge_runs_to_files(
         return []  # fully compacted away — nothing to write
     return write_resolved_lanes(
         arrays, count, path_factory, block_bytes, compression,
-        bits_per_key, target_file_bytes,
+        bits_per_key, target_file_bytes, io_budget=io_budget,
     )

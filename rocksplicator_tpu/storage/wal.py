@@ -77,6 +77,12 @@ class WalWriter:
         # a failed attempt is retried by the next sync instead of the
         # durability claim silently standing
         self._dir_synced = False
+        # Optional compaction_scheduler.IoBudget (set by the engine when
+        # adaptive compaction scheduling is on): foreground group-commit
+        # fsyncs register in-flight so compaction output writes yield to
+        # them instead of queueing the latency-critical fsync behind a
+        # large background write.
+        self.io_budget = None
         os.makedirs(wal_dir, exist_ok=True)
 
     def append(self, start_seq: int, batch_bytes: bytes) -> int:
@@ -227,7 +233,14 @@ class WalWriter:
             if not self._dir_synced:
                 # segment dirents created before sync was in use
                 self._fsync_dir_locked()
-            _fsync_file(f)
+            budget = self.io_budget
+            if budget is not None:
+                budget.fg_fsync_begin()
+            try:
+                _fsync_file(f)
+            finally:
+                if budget is not None:
+                    budget.fg_fsync_end()
             if cover > self._synced_token:
                 self._synced_token = cover
 

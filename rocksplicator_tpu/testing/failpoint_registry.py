@@ -28,6 +28,10 @@ REGISTRY = {
     "engine.ingest": "engine external-file ingest failure",
     "compact.install": "compaction result install failure",
     "compact.dispatch": "batch-compactor dispatch failure",
+    # workload-adaptive compaction scheduler (round 16)
+    "compact.pick": "scheduler pick failure (compaction loop retries)",
+    "compact.subcompact": "key-range subcompaction slice failure",
+    "compact.yield": "IO-budget yield delay / failure on a compaction write",
     "objectstore.get": "object-store download failure",
     "objectstore.put": "object-store upload failure",
     "s3.request": "S3 request transient failure",

@@ -54,6 +54,7 @@ def _arrays_from_entries(entries: List[Entry]) -> Optional[dict]:
 
 class TpuCompactionBackend(CompactionBackend):
     name = "tpu"
+    supports_subcompactions = True
 
     def __init__(self, fallback: Optional[CompactionBackend] = None):
         # default fallback is the VECTORIZED cpu path: on hosts where the
@@ -162,13 +163,21 @@ class TpuCompactionBackend(CompactionBackend):
         compression: int,
         bits_per_key: int,
         target_file_bytes: int,
+        max_subcompactions: int = 1,
+        io_budget=None,
     ) -> Optional[List[Tuple[str, dict]]]:
         """Merge + write output SSTs with the vectorized array sink and
         kernel-built blooms, splitting at ``target_file_bytes``. Inputs may
         be SSTReader objects — sink-written uniform files decode straight
         to lanes (no per-entry Python on the SOURCE side either) — or
         entry iterables. Returns [(path, props)] — empty list for an
-        all-tombstoned result — or None → tuple path."""
+        all-tombstoned result — or None → tuple path.
+
+        ``max_subcompactions > 1``: the job splits into disjoint
+        key-range slices resolved as ONE padded vmapped device batch
+        (tpu/compaction_service.resolve_slices_batched) — k smaller
+        bitonic sorts in one launch instead of one pow2(total) sort.
+        ``io_budget`` paces the output file writes."""
         from ..ops.bloom_tpu import bloom_build_tpu
         from ..storage.bloom import num_words_for
         from .chunked import FIELDS, run_kernel_arrays
@@ -226,14 +235,23 @@ class TpuCompactionBackend(CompactionBackend):
             MergeKind.UINT64_ADD if isinstance(merge_op, UInt64AddOperator)
             else MergeKind.NONE
         )
-        all_valid = np.ones(total, dtype=bool)
-        uniform_klen, seq32, key_words = fast_flags(
-            kl, lanes["seq_hi"], all_valid)
-        arrays, count = run_kernel_arrays(
-            lanes, total, kind, drop_tombstones,
-            pad_to=_next_pow2(total),
-            uniform_klen=uniform_klen, seq32=seq32, key_words=key_words,
-        )
+        arrays = count = None
+        if max_subcompactions > 1:
+            sliced = self._subcompact_arrays(
+                parts, lanes, total, kind, drop_tombstones,
+                max_subcompactions)
+            if sliced is not None:
+                arrays, count = sliced
+        if arrays is None:
+            all_valid = np.ones(total, dtype=bool)
+            uniform_klen, seq32, key_words = fast_flags(
+                kl, lanes["seq_hi"], all_valid)
+            arrays, count = run_kernel_arrays(
+                lanes, total, kind, drop_tombstones,
+                pad_to=_next_pow2(total),
+                uniform_klen=uniform_klen, seq32=seq32,
+                key_words=key_words,
+            )
         if arrays is None:
             return None
         if count == 0:
@@ -278,7 +296,52 @@ class TpuCompactionBackend(CompactionBackend):
                         pass
                 return None
             outputs.append((path, props))
+            if io_budget is not None:
+                try:
+                    io_budget.throttle(os.path.getsize(path))
+                except OSError:
+                    pass
         return outputs
+
+    @staticmethod
+    def _subcompact_arrays(parts, lanes, total, kind, drop_tombstones,
+                           max_subcompactions):
+        """Key-range subcompactions on the device: choose boundary keys
+        from the runs' key distribution (shared helpers with the CPU
+        path), slice every run at them, and resolve ALL slices as one
+        padded vmapped batch. Returns (arrays, count) concatenated in
+        boundary order — identical logical output to the single-shot
+        kernel — or None to take the unsliced path."""
+        from ..storage.native_compaction import (_first_row_ge,
+                                                 plan_subcompactions,
+                                                 slice_parts)
+        from .chunked import FIELDS
+        from .compaction_service import resolve_slices_batched
+
+        kl = lanes["key_len"]
+        klen = int(kl[0]) if len(kl) else 0
+        bounds = plan_subcompactions(parts, total, max_subcompactions, klen)
+        if not bounds:
+            return None
+        cuts = [[_first_row_ge(p, b, klen) for b in bounds] for p in parts]
+        slices = []
+        for si in range(len(bounds) + 1):
+            sub = slice_parts(parts, bounds, si, klen, cuts, fields=FIELDS)
+            if sub:
+                slices.append({
+                    f: np.concatenate([p[f] for p in sub]) for f in FIELDS})
+        if not slices:
+            return None
+        per_slice = resolve_slices_batched(slices, kind, drop_tombstones)
+        live = [(a, c) for a, c in per_slice if c]
+        if not live:
+            return {}, 0
+        fields = list(live[0][0].keys())
+        arrays = {
+            f: np.concatenate([np.asarray(a[f]) for a, _c in live])
+            for f in fields
+        }
+        return arrays, int(sum(c for _a, c in live))
 
     def _run_batch(
         self, batch: KVBatch, merge_op: Optional[MergeOperator],

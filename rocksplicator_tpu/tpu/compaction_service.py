@@ -354,6 +354,47 @@ def _shard_result(host: Dict[str, np.ndarray], s: int, count: int,
 
 
 # ---------------------------------------------------------------------------
+# key-range subcompactions as one device batch (round 16)
+# ---------------------------------------------------------------------------
+
+
+def resolve_slices_batched(
+    slice_lanes: List[Dict[str, np.ndarray]],
+    merge_kind: "MergeKind",
+    drop_tombstones: bool,
+) -> List[Tuple[dict, int]]:
+    """ONE compaction's key-range slices resolved as ONE padded vmapped
+    device launch — the TPU face of subcompactions: each slice is a
+    "shard" of the job, padded to the common pow2 capacity exactly like
+    the cross-db batched path, so k smaller sorts ride one launch
+    instead of one pow2(total) sort. Returns per-slice
+    ``(lane_arrays, count)`` in input order (empty slices come back as
+    ``({}, 0)``); slice boundaries are keys, so MERGE operand groups
+    are never split across slices by construction."""
+    from ..testing import failpoints as fp
+    from ..utils.stats import Stats
+
+    out: List[Tuple[dict, int]] = [({}, 0)] * len(slice_lanes)
+    batches: List[_LaneBatch] = []
+    index: List[int] = []
+    for i, lanes in enumerate(slice_lanes):
+        if lanes["key_len"].shape[0] == 0:
+            continue
+        fp.hit("compact.subcompact")
+        Stats.get().incr("compaction.subcompactions")
+        batches.append(_LaneBatch(lanes))
+        index.append(i)
+    if batches:
+        svc = TpuCompactionService.instance()
+        results = svc.compact_shard_batch(
+            batches, merge_kind=merge_kind,
+            drop_tombstones=drop_tombstones, return_arrays=True)
+        for i, res in zip(index, results):
+            out[i] = (res["arrays"], int(res["count"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # cross-DB batched full compaction (the post-load_sst path)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,659 @@
+"""Workload-adaptive compaction scheduler (round 16).
+
+Covers the three tentpole pieces — priority picks from the pressure
+gauges, key-range subcompactions, and the foreground-yielding IO
+budget — plus the new failpoint seams (compact.pick,
+compact.subcompact, compact.yield), the subcompaction slice-boundary
+correctness matrix (byte-identical vs the unsliced single-pass merge),
+crash-at-install atomicity, and the BatchCompactor priority-queue
+submission path.
+"""
+
+import os
+import struct
+import threading
+import time
+
+import pytest
+
+import rocksplicator_tpu.storage.native_compaction as nc
+from rocksplicator_tpu.storage.compaction_scheduler import (
+    READ_AMP_MIN_GETS, CompactionScheduler, IoBudget)
+from rocksplicator_tpu.storage.engine import DB, DBOptions
+from rocksplicator_tpu.storage.merge import UInt64AddOperator
+from rocksplicator_tpu.storage.records import OpType, WriteBatch
+from rocksplicator_tpu.storage.sst import SSTReader, SSTWriter
+from rocksplicator_tpu.testing import failpoints as fp
+from rocksplicator_tpu.utils.stats import Stats
+
+P, D, M = 1, 2, 3
+pack_u64 = struct.Struct(">Q").pack
+
+
+def counter(name: str) -> float:
+    return Stats.get().get_counter(name)
+
+
+def sched_picks(kind: str) -> float:
+    return counter(f"compaction.sched_picks kind={kind}")
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# priority picks
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_drains_l0_at_trigger(tmp_path):
+    """Parity with the legacy loop: L0 at the compaction trigger is
+    picked and drained — and the pick is counted by kind."""
+    base = sched_picks("l0")
+    opts = DBOptions(background_compaction=True, memtable_bytes=8 * 1024,
+                     level0_compaction_trigger=3)
+    assert opts.compaction_scheduler  # default on
+    with DB(str(tmp_path / "db"), opts) as db:
+        assert db._sched is not None
+        for i in range(3000):
+            db.put(b"k%06d" % (i % 900), b"v" * 24)
+        db.flush()
+        assert wait_until(
+            lambda: len(db._levels[0]) < 3 and sched_picks("l0") > base)
+        for i in range(0, 900, 97):
+            assert db.get(b"k%06d" % i) == b"v" * 24
+
+
+def test_scheduler_off_reverts_to_legacy_loop(tmp_path):
+    """compaction_scheduler=False (the RSTPU_COMPACTION_SCHED=0 A/B
+    arm): the fixed trigger loop still drains L0, no picks counted."""
+    base = sum(v["total"] for k, v in
+               Stats.get().export_state()["counters"].items()
+               if k.startswith("compaction.sched_picks"))
+    opts = DBOptions(background_compaction=True, memtable_bytes=8 * 1024,
+                     level0_compaction_trigger=3,
+                     compaction_scheduler=False)
+    with DB(str(tmp_path / "db"), opts) as db:
+        assert db._sched is None and db._io_budget is None
+        for i in range(3000):
+            db.put(b"k%06d" % (i % 900), b"v" * 24)
+        db.flush()
+        assert wait_until(lambda: len(db._levels[0]) < 3)
+    now = sum(v["total"] for k, v in
+              Stats.get().export_state()["counters"].items()
+              if k.startswith("compaction.sched_picks"))
+    assert now == base
+
+
+def test_level_debt_pick_drains_deep_level(tmp_path):
+    """A level whose bytes exceed its rocksdb-style target is picked
+    (kind=level) and compacted into the next level, clearing the debt —
+    the round-14 honest residual ("debt targets the current compactor
+    doesn't act on") closed."""
+    base = sched_picks("level")
+    opts = DBOptions(background_compaction=True, memtable_bytes=8 * 1024,
+                     level0_compaction_trigger=2,
+                     # tiny L1 target: the first L0->L1 compaction
+                     # overshoots it immediately
+                     max_bytes_for_level_base=4 * 1024,
+                     max_bytes_for_level_multiplier=10)
+    with DB(str(tmp_path / "db"), opts) as db:
+        for i in range(4000):
+            db.put(b"k%06d" % i, b"v" * 32)
+        db.flush()
+        # L0 drains into L1 (beyond its 4KB target), then the level
+        # pick must move the debt down until every level is on target
+        assert wait_until(
+            lambda: sched_picks("level") > base
+            and any(db._levels[2:])
+            and db.metrics_snapshot(max_age=0.0)[
+                "compaction_debt_bytes"][1] == 0,
+            timeout=20.0)
+        for i in range(0, 4000, 397):
+            assert db.get(b"k%06d" % i) == b"v" * 32
+    # reopen: the manifest carries the deep-level layout
+    with DB(str(tmp_path / "db"), DBOptions()) as db2:
+        assert db2.get(b"k000000") == b"v" * 32
+
+
+def test_read_amp_pick_below_trigger(tmp_path):
+    """A read-heavy window paying multi-file gets schedules an L0 drain
+    BELOW the file-count trigger (read-amp drives get-path cost)."""
+    opts = DBOptions(background_compaction=True, memtable_bytes=1 << 30,
+                     level0_compaction_trigger=100)  # never by count
+    with DB(str(tmp_path / "db"), opts) as db:
+        # 9 overlapping L0 files (no blooms help: same keys each time)
+        for _ in range(9):
+            for i in range(50):
+                db.put(b"k%04d" % i, b"v" * 16)
+            db.flush()
+        assert len(db._levels[0]) == 9
+        # misses consult every L0 file (no fence skips L0): read-amp ~9
+        for i in range(READ_AMP_MIN_GETS + 32):
+            db.get(b"zz%04d" % i)
+        # re-rank happens on the next EVENT (flush/install notify);
+        # mirror the live system where flushes keep arriving
+        db.put(b"wake", b"w")
+        db.flush()
+        assert wait_until(lambda: len(db._levels[0]) <= 2)
+        assert db.get(b"k0001") == b"v" * 16
+
+
+def test_manual_queue_and_batch_compactor(tmp_path):
+    """DB.schedule_compaction rides the scheduler's priority queue
+    (kind=manual), and the admin BatchCompactor submits through it —
+    post-ingest compactions obey the same priority order."""
+    from rocksplicator_tpu.admin.ingest_pipeline import BatchCompactor
+
+    base = sched_picks("manual")
+    opts = DBOptions(background_compaction=True, memtable_bytes=8 * 1024,
+                     level0_compaction_trigger=50)
+    with DB(str(tmp_path / "db"), opts) as db:
+        for i in range(600):
+            db.put(b"k%05d" % i, b"v" * 32)
+        db.flush()
+        comp = BatchCompactor(use_tpu=False)
+        try:
+            comp.compact("db", db)
+        finally:
+            comp.close()
+        assert sched_picks("manual") >= base + 1
+        # full compaction: everything at the bottom level
+        assert not any(db._levels[:-1][1:]) and not db._levels[0]
+        assert db._levels[-1]
+        assert db.get(b"k00001") == b"v" * 32
+
+    # inline-mode DBs (no compaction thread) report None and the
+    # caller falls back to direct compact_range
+    with DB(str(tmp_path / "db2"), DBOptions()) as db2:
+        db2.put(b"a", b"1")
+        assert db2.schedule_compaction() is None
+
+
+def test_schedule_compaction_fails_pending_on_close(tmp_path):
+    opts = DBOptions(background_compaction=True, memtable_bytes=1 << 30,
+                     # compactions can't start: auto disabled, so the
+                     # queued manual is consumed... actually manual
+                     # picks run even with auto disabled — use a fault
+                     # to wedge the loop instead
+                     disable_auto_compaction=False)
+    db = DB(str(tmp_path / "db"), opts)
+    try:
+        db.put(b"a", b"1")
+        fut = db.schedule_compaction()
+        assert fut is not None
+        fut.result(timeout=20)
+    finally:
+        db.close()
+    # post-close: no scheduler surface
+    with pytest.raises(Exception):
+        db.schedule_compaction()
+
+
+# ---------------------------------------------------------------------------
+# key-range subcompactions: slice-boundary correctness matrix
+# ---------------------------------------------------------------------------
+
+
+def _write_run(path, entries):
+    entries = sorted(entries, key=lambda e: (e[0], -e[1]))
+    w = SSTWriter(path)
+    for k, s, t, v in entries:
+        w.add(k, s, t, v)
+    w.finish()
+    return entries
+
+
+def _matrix_runs(root):
+    """Three overlapping runs stressing every slice-boundary hazard:
+    MERGE operand chains spread across runs, duplicate keys at many
+    seqs, tombstones shadowing puts from other runs."""
+    runs = []
+    # run 0: dense puts
+    runs.append(_write_run(os.path.join(root, "r0.tsst"), [
+        (b"k%04d" % i, 1000 + i, P, pack_u64(i)) for i in range(0, 600, 2)]))
+    # run 1: MERGE operands over half the keyspace + duplicate seqs
+    e = [(b"k%04d" % i, 5000 + i, M, pack_u64(7))
+         for i in range(0, 600, 3)]
+    e += [(b"k%04d" % i, 5600 + i, M, pack_u64(5))
+          for i in range(0, 600, 6)]
+    runs.append(_write_run(os.path.join(root, "r1.tsst"), e))
+    # run 2: tombstones + fresh puts
+    e = []
+    for i in range(0, 600, 5):
+        if i % 10:
+            e.append((b"k%04d" % i, 9000 + i, D, b""))
+        else:
+            e.append((b"k%04d" % i, 9000 + i, P, pack_u64(1)))
+    runs.append(_write_run(os.path.join(root, "r2.tsst"), e))
+    return [os.path.join(root, f"r{j}.tsst") for j in range(3)]
+
+
+def _merged_entries(outs):
+    ents = []
+    for p, _ in sorted(outs, key=lambda o: SSTReader(o[0]).min_key() or b""):
+        r = SSTReader(p)
+        ents.extend(r.iterate())
+        r.close()
+    return ents
+
+
+@pytest.mark.parametrize("drop_tombstones", [False, True])
+@pytest.mark.parametrize("merge_op", [None, UInt64AddOperator()],
+                         ids=["no-op", "uint64add"])
+def test_subcompaction_slice_matrix_byte_identical(
+        tmp_path, monkeypatch, drop_tombstones, merge_op):
+    """The acceptance matrix: sliced output is byte-identical to the
+    unsliced single-pass merge across MERGE chains, duplicate keys, and
+    tombstones straddling slice boundaries."""
+    monkeypatch.setattr(nc, "MIN_SLICE_ENTRIES", 16)
+    paths = _matrix_runs(str(tmp_path))
+    if merge_op is None:
+        # MERGE records without an operator decline the array path;
+        # use the tombstone/put runs only
+        paths = [paths[0], paths[2]]
+
+    def collect(nsub, tag):
+        cnt = [0]
+
+        def pf():
+            cnt[0] += 1
+            return str(tmp_path / f"out-{tag}-{cnt[0]}.tsst")
+
+        outs = nc.direct_merge_runs_to_files(
+            [SSTReader(p) for p in paths], merge_op, drop_tombstones,
+            pf, 4096, 0, 10, 8192, max_subcompactions=nsub)
+        assert outs is not None
+        return _merged_entries(outs)
+
+    base = counter("compaction.subcompactions")
+    unsliced = collect(1, f"u{drop_tombstones}")
+    assert counter("compaction.subcompactions") == base  # no slicing
+    sliced = collect(6, f"s{drop_tombstones}")
+    assert counter("compaction.subcompactions") >= base + 2
+    assert sliced == unsliced
+    assert len(sliced) > 0
+
+
+def test_slice_boundaries_never_split_a_key_group(tmp_path, monkeypatch):
+    """The invariant the matrix relies on, asserted directly: slice
+    boundaries are KEYS, so every row of a key — its whole MERGE
+    operand chain — lands in exactly one slice."""
+    monkeypatch.setattr(nc, "MIN_SLICE_ENTRIES", 16)
+    paths = _matrix_runs(str(tmp_path))
+    read = nc.read_runs_as_lanes(
+        [SSTReader(p) for p in paths], UInt64AddOperator())
+    assert read is not None
+    parts, lanes, total, vw = read
+    klen = int(lanes["key_len"][0])
+    bounds = nc.plan_subcompactions(parts, total, 6, klen)
+    assert bounds, "fixture too small to slice"
+    cuts = [[nc._first_row_ge(p, b, klen) for b in bounds] for p in parts]
+    seen = {}  # key -> slice index
+    for si in range(len(bounds) + 1):
+        for sub in nc.slice_parts(parts, bounds, si, klen, cuts):
+            n = sub["key_len"].shape[0]
+            for i in range(n):
+                k = nc._part_key(sub, i, klen)
+                assert seen.setdefault(k, si) == si, \
+                    f"key {k!r} split across slices {seen[k]} and {si}"
+    assert len(seen) > 0
+
+
+def test_subcompaction_crash_at_install_is_atomic(tmp_path, monkeypatch):
+    """A fault at the install seam mid-subcompacted-compaction leaves
+    the DB exactly pre-compaction on reopen: outputs are never visible,
+    inputs never dropped (manifest-first ordering)."""
+    monkeypatch.setattr(nc, "MIN_SLICE_ENTRIES", 16)
+    opts = DBOptions(memtable_bytes=1 << 30, max_subcompactions=4)
+    path = str(tmp_path / "db")
+    with DB(path, opts) as db:
+        for burst in range(3):
+            for i in range(300):
+                db.put(b"k%05d" % i, b"%03d" % burst + b"v" * 13)
+            db.flush()
+        before = list(db.new_iterator())
+        assert len(before) == 300
+        fp.activate("compact.install", "fail_nth:1")
+        try:
+            with pytest.raises(Exception):
+                db.compact_range()
+        finally:
+            fp.deactivate("compact.install")
+        # same process: content intact, a clean retry completes
+        assert list(db.new_iterator()) == before
+        db.compact_range()
+        assert list(db.new_iterator()) == before
+    # "crashed" variant: fault, close without retry, reopen from disk
+    path2 = str(tmp_path / "db2")
+    with DB(path2, opts) as db:
+        for burst in range(3):
+            for i in range(300):
+                db.put(b"k%05d" % i, b"%03d" % burst + b"v" * 13)
+            db.flush()
+        before = list(db.new_iterator())
+        fp.activate("compact.install", "fail_nth:1")
+        try:
+            with pytest.raises(Exception):
+                db.compact_range()
+        finally:
+            fp.deactivate("compact.install")
+    with DB(path2, DBOptions(max_subcompactions=4)) as db2:
+        assert list(db2.new_iterator()) == before
+
+
+def test_subcompact_fault_falls_back_to_unsliced(tmp_path, monkeypatch):
+    """A compact.subcompact fault fails the sliced attempt loudly; the
+    engine's tuple fallback still completes the compaction with the
+    same logical content and no orphan outputs."""
+    monkeypatch.setattr(nc, "MIN_SLICE_ENTRIES", 16)
+    opts = DBOptions(memtable_bytes=1 << 30, max_subcompactions=4)
+    with DB(str(tmp_path / "db"), opts) as db:
+        for i in range(400):
+            db.put(b"k%05d" % i, b"v" * 16)
+        db.flush()
+        for i in range(0, 400, 2):
+            db.put(b"k%05d" % i, b"w" * 16)
+        db.flush()
+        before = list(db.new_iterator())
+        fp.activate("compact.subcompact", "fail_nth:1")
+        try:
+            db.compact_range()  # sliced path raises, tuple path lands
+        finally:
+            fp.deactivate("compact.subcompact")
+        assert list(db.new_iterator()) == before
+        live = {n for files in db._levels for n in files}
+        on_disk = {f for f in os.listdir(db.path) if f.endswith(".tsst")}
+        assert on_disk == live, "slice fault leaked orphan outputs"
+
+
+def test_compact_pick_fault_is_retried(tmp_path):
+    """A compact.pick fault (chaos seam) fails one loop iteration; the
+    next pass re-picks and the drain still happens."""
+    opts = DBOptions(background_compaction=True, memtable_bytes=8 * 1024,
+                     level0_compaction_trigger=3)
+    with DB(str(tmp_path / "db"), opts) as db:
+        fp.activate("compact.pick", "fail_nth:1")
+        try:
+            for i in range(3000):
+                db.put(b"k%06d" % (i % 900), b"v" * 24)
+            db.flush()
+            assert wait_until(lambda: len(db._levels[0]) < 3, timeout=15.0)
+        finally:
+            fp.deactivate("compact.pick")
+        assert db.get(b"k000000") == b"v" * 24
+
+
+def test_compact_pick_fault_does_not_fail_manual_waiters(tmp_path):
+    """A transient pick-seam fault fires BEFORE manual futures are
+    dequeued, so a queued BatchCompactor compaction is retried by the
+    loop (the registry's contract) instead of reported failed to a
+    caller whose compaction was never attempted."""
+    opts = DBOptions(background_compaction=True, memtable_bytes=1 << 30)
+    with DB(str(tmp_path / "db"), opts) as db:
+        db.put(b"a", b"1")
+        fp.activate("compact.pick", "fail_nth:1")
+        try:
+            fut = db.schedule_compaction()
+            assert fut is not None
+            # the injected fault costs one loop pass (+1s backoff); the
+            # retry must then resolve the waiter with success
+            assert fut.result(timeout=20) is None
+        finally:
+            fp.deactivate("compact.pick")
+
+
+def test_level_pick_reserves_bottom_under_ingest_behind(tmp_path):
+    """allow_ingest_behind reserves the TRUE bottom level (same rule as
+    compact_range): level debt one above it is never picked — installing
+    there would permanently block ingest-behind — while shallower debt
+    still is, and _compact_level_bg refuses the reserved target even if
+    asked directly."""
+    opts = DBOptions(background_compaction=True, num_levels=4,
+                     allow_ingest_behind=True,
+                     disable_auto_compaction=True,  # rank by hand
+                     memtable_bytes=1 << 30,
+                     max_bytes_for_level_base=1,  # any bytes = debt
+                     max_bytes_for_level_multiplier=1)
+    with DB(str(tmp_path / "db"), opts) as db:
+        for i in range(50):
+            db.put(b"k%04d" % i, b"v" * 32)
+        db.flush()
+        with db._lock:
+            names = db._levels[0]
+            db._levels[0] = []
+            # debt parked at num_levels-2: its only install target is
+            # the reserved bottom level
+            db._levels[2] = list(names)
+            assert db._sched._level_candidate(1.0) is None
+            # the same debt one level up IS eligible (installs into 2)
+            db._levels[1] = db._levels[2]
+            db._levels[2] = []
+            pick = db._sched._level_candidate(1.0)
+            assert pick is not None and pick.level == 1
+            db._levels[2] = db._levels[1]
+            db._levels[1] = []
+        db._compact_level_bg(2)  # direct call: guard must refuse
+        assert not db._levels[3]
+        assert db._levels[2] == names
+
+
+# ---------------------------------------------------------------------------
+# IO budget: yield-to-foreground + token pacing + stall/read-heavy opening
+# ---------------------------------------------------------------------------
+
+
+def test_io_budget_yields_to_foreground_fsync():
+    budget = IoBudget(0)  # unmetered: only the yield tier
+    base = counter("compaction.yields")
+    assert budget.throttle(1 << 20) == 0.0  # no foreground: no yield
+    assert counter("compaction.yields") == base
+    IoBudget.fg_fsync_begin()
+    try:
+        t0 = time.monotonic()
+        budget.throttle(1 << 20)
+        elapsed = time.monotonic() - t0
+        assert counter("compaction.yields") == base + 1
+        assert elapsed >= 0.003  # waited for the (stuck) foreground fsync
+        # ... but NOT under stall pressure: compaction is the cure
+        # then, and must not wait on the foreground it is unblocking
+        budget.note_stall(500.0)
+        assert budget.throttle(1 << 20) == 0.0
+        assert counter("compaction.yields") == base + 1
+    finally:
+        IoBudget.fg_fsync_end()
+    # foreground done: next write sails through
+    budget2 = IoBudget(0)
+    assert budget2.throttle(1 << 20) == 0.0
+
+
+def test_io_budget_token_pacing_and_opening():
+    budget = IoBudget(1 << 20)  # 1 MB/s
+    # simulate recent foreground activity so the read-heavy opening
+    # does NOT apply
+    IoBudget.fg_fsync_begin()
+    IoBudget.fg_fsync_end()
+    budget.throttle(1 << 20)  # drain the initial burst
+    t0 = time.monotonic()
+    budget.throttle(1 << 19)  # 512KB over budget -> bounded sleep
+    assert time.monotonic() - t0 >= 0.05
+    # stall pressure OPENS the budget (debt drain un-delays writes)
+    budget.note_stall(500.0)
+    assert budget.stall_pressure() > 100.0
+    now = time.monotonic()
+    with budget._lock:
+        opened = budget._effective_rate_locked(now)
+    assert opened > (1 << 20)
+    # read-heavy opening: no foreground fsync for a while
+    IoBudget._fg_last = time.monotonic() - 10.0
+    with budget._lock:
+        wide_open = budget._effective_rate_locked(time.monotonic())
+    assert wide_open > opened
+
+
+def test_compact_yield_seam_trips_under_budget(tmp_path, monkeypatch):
+    """The compact.yield failpoint arms on the budget's yield path (the
+    chaos delay policy rides it); an exhausted token bucket trips it."""
+    base = counter("failpoint.trips site=compact.yield")
+    budget = IoBudget(1024)  # 1KB/s: any real write exhausts it
+    fp.activate("compact.yield", "delay_ms:1")
+    try:
+        budget.throttle(64 * 1024)
+        budget.throttle(64 * 1024)
+    finally:
+        fp.deactivate("compact.yield")
+    assert counter("failpoint.trips site=compact.yield") > base
+
+
+def test_budget_throttles_compaction_output(tmp_path):
+    """End to end: a metered engine's compaction pays yields; content
+    is unaffected; the admission-stall signal reaches the budget."""
+    base = counter("compaction.yields")
+    # 256 B/s: any real output file exhausts the bucket even after
+    # zlib squeezes the constant values
+    opts = DBOptions(background_compaction=True, memtable_bytes=1 << 30,
+                     level0_compaction_trigger=100,
+                     compaction_budget_bytes_per_sec=256)
+    with DB(str(tmp_path / "db"), opts) as db:
+        assert db._io_budget is not None and db._io_budget.rate == 256
+        # recent foreground activity: no read-heavy opening
+        IoBudget.fg_fsync_begin()
+        IoBudget.fg_fsync_end()
+        for burst in range(2):
+            for i in range(800):
+                db.put(b"k%05d" % i, b"v" * 64)
+            db.flush()
+        db.compact_range()
+        assert counter("compaction.yields") > base
+        assert db.get(b"k00007") == b"v" * 64
+        # runtime knob: set_options reaches the live bucket
+        db.set_options({"compaction_budget_bytes_per_sec": 0})
+        assert db._io_budget.rate == 0
+
+
+def test_record_stall_feeds_budget(tmp_path):
+    opts = DBOptions(background_compaction=True, memtable_bytes=8 * 1024)
+    with DB(str(tmp_path / "db"), opts) as db:
+        assert db._io_budget.stall_pressure() == 0.0
+        db._record_stall(time.monotonic() - 0.2)  # a 200ms stall
+        assert db._io_budget.stall_pressure() > 100.0
+        # and the scheduler's boost reads it
+        boost = db._sched._stall_boost()
+        assert boost > 1.5
+
+
+# ---------------------------------------------------------------------------
+# compaction-bench artifact shape (the make compaction-bench-smoke contract)
+# ---------------------------------------------------------------------------
+
+
+def test_compaction_bench_smoke_artifact_shape(tmp_path):
+    """Tiny in-process run of benchmarks/compaction_bench.py pinning
+    the artifact contract the make target and PERF round 16 rely on:
+    both arms present, a get-p99 pair, the three scheduler counters,
+    write-stall + debt fields, zero value mismatches."""
+    import json
+
+    from benchmarks.compaction_bench import main as bench_main
+
+    out = tmp_path / "cb.json"
+    rc = bench_main([
+        "--keys", "1500", "--rate", "700", "--duration", "1.5",
+        "--reps", "1", "--settle", "0.5", "--memtable_kb", "16",
+        "--target_file_kb", "32", "--level_base_kb", "32",
+        "--workers", "4", "--offline_keys", "3000",
+        "--min_slice_entries", "1024", "--out", str(out),
+    ])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["bench"] == "compaction_bench"
+    assert art["failures"] == []
+    assert "host_calibration" in art
+    samples = art["ab"]["samples"]
+    for mode in ("sched_on", "sched_off"):
+        assert samples[mode], mode
+        ph = samples[mode][0]
+        assert ph["get_p99_ms"] is not None
+        assert ph["put_p99_ms"] is not None
+        assert ph["value_mismatches"] == 0
+        for c in ("compaction.sched_picks", "compaction.yields",
+                  "compaction.subcompactions"):
+            assert c in ph["counters"]
+        for k in ("write_stall_ms_total", "debt_bytes_end_of_load",
+                  "debt_bytes_after_settle", "debt_drain_bytes_per_sec",
+                  "slow_write_traces"):
+            assert k in ph
+    # the scheduler-on arm actually scheduled; the off arm did not
+    assert samples["sched_on"][0]["counters"][
+        "compaction.sched_picks"] > 0
+    assert samples["sched_off"][0]["counters"][
+        "compaction.sched_picks"] == 0
+    off = art["subcompaction_offline"]
+    assert off["output_checksums_equal"]
+    assert off["subcompactions"] > 0
+    assert off["unsliced_sec"] > 0 and off["sliced_sec"] > 0
+
+
+# ---------------------------------------------------------------------------
+# scheduler unit: ranking
+# ---------------------------------------------------------------------------
+
+
+def test_pick_ranking_prefers_l0_storm_over_level_debt(tmp_path):
+    """At the slowdown trigger L0 outranks moderate level debt
+    (write-stall risk beats background debt); once L0 drains below the
+    trigger, the debt pick takes over. Pure ranking test: auto
+    compaction stays parked, picks are computed directly."""
+    opts = DBOptions(background_compaction=True, memtable_bytes=1 << 30,
+                     level0_compaction_trigger=2,
+                     level0_slowdown_writes_trigger=4,
+                     compaction_scheduler=True,
+                     disable_auto_compaction=True)  # loop stays parked
+    with DB(str(tmp_path / "db"), opts) as db:
+        sched = db._sched
+        for _ in range(5):
+            for i in range(40):
+                db.put(b"k%04d" % i, b"v" * 16)
+            with db._lock:
+                db._flush_locked()
+        with db._lock:
+            # fake URGENT L1 debt (boosted score >= LEVEL_URGENT_SCORE
+            # — the foreground just wrote, so the idle valley-drain
+            # path does not apply): move one file down, size the
+            # target so the score lands ~5
+            db._levels[1].append(db._levels[0].pop())
+            l1_bytes = sum(db._readers[n].file_size
+                           for n in db._levels[1])
+            db.options.max_bytes_for_level_base = max(1, l1_bytes // 5)
+            db.options.disable_auto_compaction = False
+            db._last_write_mono = time.monotonic()  # foreground live
+            # urgent debt (~5) outranks L0 at the slowdown trigger
+            # (4 files: score 2 + 2 = 4) — magnitude resolves the tie
+            pick = sched.pick_locked()
+            assert pick is not None and pick.kind == "level", pick
+            # moderate (non-urgent) debt defers while the foreground
+            # is live: L0 wins
+            db.options.max_bytes_for_level_base = max(1, l1_bytes // 2)
+            pick = sched.pick_locked()
+            assert pick is not None and pick.kind == "l0", pick
+            # ... but the SAME moderate debt is picked once the
+            # foreground has been idle (valley drain) and L0 is quiet
+            db._levels[1].extend(db._levels[0][:3])
+            del db._levels[0][:3]
+            db._last_write_mono = time.monotonic() - 10.0
+            pick = sched.pick_locked()
+            assert pick is not None and pick.kind == "level" \
+                and pick.level == 1, pick
+            # live foreground + moderate debt + quiet L0 = defer
+            db._last_write_mono = time.monotonic()
+            l1b = sum(db._readers[n].file_size for n in db._levels[1])
+            db.options.max_bytes_for_level_base = max(1, l1b // 2)
+            pick = sched.pick_locked()
+            assert pick is None, pick
+            db.options.disable_auto_compaction = True  # stay parked

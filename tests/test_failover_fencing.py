@@ -444,6 +444,11 @@ def test_set_db_epoch_adopts_in_place(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+# flaky_host: host-noise-flaky under full-suite load (passes standalone
+# and in targeted runs; the 1.2s session TTL races the loaded host's
+# scheduler — reap/rejoin may not complete inside the wait window when
+# 600+ tests contend) — retried once by the conftest guard
+@pytest.mark.flaky_host
 def test_participant_rejoins_after_session_expiry(tmp_path):
     """A reaped participant re-registers its ephemeral instance node,
     republishes current state, and resumes serving as FOLLOWER — the
@@ -606,6 +611,12 @@ def test_failover_fault_sites_registered():
 # ---------------------------------------------------------------------------
 
 
+# flaky_host: the pre-fault "baseline converged" gate is a wall-clock
+# bound on controller passes that races the loaded host's scheduler
+# under full-suite contention (passes standalone and in targeted runs;
+# seeded invariant VIOLATIONS would reproduce on the retry, so the
+# retry-once guard cannot mask a real regression)
+@pytest.mark.flaky_host
 def test_failover_chaos_schedules_hold_invariants(tmp_path):
     from tools.chaos_soak import run_failover_chaos
 

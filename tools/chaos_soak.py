@@ -163,6 +163,14 @@ DB_OPTS = dict(
     level0_compaction_trigger=3,
 )
 
+# Make key-range subcompactions REACHABLE at chaos scale: the
+# production threshold (32k entries per slice) would never slice the
+# tiny chaos memtables, leaving the compact.subcompact seam unarmed in
+# every schedule. The in-process chaos clusters inherit this.
+from rocksplicator_tpu.storage import native_compaction as _nc  # noqa: E402
+
+_nc.MIN_SLICE_ENTRIES = 256
+
 
 def _fault_menu(rng: random.Random) -> List[Tuple[str, str]]:
     """The schedule's candidate faults — every parameter drawn from the
@@ -186,6 +194,14 @@ def _fault_menu(rng: random.Random) -> List[Tuple[str, str]]:
         ("repl.pull", f"fail_prob:{rng.uniform(0.02, 0.10):.3f}@seed{s}"),
         ("repl.apply", f"fail_nth:{rng.randint(1, 3)}"),
         ("ack.expire", f"delay_ms:{rng.randint(5, 50)}"),
+        # round 16: the workload-adaptive compaction scheduler's seams —
+        # the chaos DBs run background compaction with the scheduler
+        # active, so pick faults (loop retries), subcompaction slice
+        # faults (fall back to the unsliced/tuple merge), and IO-budget
+        # yield delays all ride the standing data-plane invariants
+        ("compact.pick", f"fail_prob:{rng.uniform(0.05, 0.25):.3f}@seed{s}"),
+        ("compact.subcompact", f"fail_nth:{rng.randint(1, 3)}"),
+        ("compact.yield", f"delay_ms:{rng.randint(5, 30)}"),
     ]
 
 
