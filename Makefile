@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab metrics-smoke compaction-bench compaction-bench-smoke chaos-smoke chaos-failover-smoke reshard-smoke clean
+.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab metrics-smoke compaction-bench compaction-bench-smoke stream-merge-bench stream-merge-smoke chaos-smoke chaos-failover-smoke reshard-smoke clean
 
 # rstpu-check: the three-pass static suite (lock-order/blocking-under-
 # lock, event-loop blocking, failpoint/span/stats registries) over
@@ -111,7 +111,7 @@ compaction-bench:
 	$(PY) bench.py --compaction_bench --keys 30000 --rate 2100 \
 		--duration 10 --reps 3 --memtable_kb 32 --target_file_kb 64 \
 		--level_base_kb 128 --settle 2.5 --offline_keys 250000 \
-		--out benchmarks/results/compaction_bench_r16.json
+		--out benchmarks/results/compaction_bench_r17.json
 
 # sub-minute smoke of the same (tier-1 asserts the artifact shape):
 # fails loudly on value mismatches, a pick-less scheduler-on phase, or
@@ -133,6 +133,26 @@ macro-bench-sched-ab:
 		--preload_keys 4000 --sched_rate 1300 --sched_duration 8 \
 		--sched_reps 3 \
 		--out benchmarks/results/macro_bench_sched_ab.json
+
+# round-17 streaming bounded-memory merge A/B: one large full
+# compaction (lane image many times the configured budget) timed
+# through the chunked k-way streaming merge INTERLEAVED against the
+# in-RAM single pass on the same runs — outputs checksummed equal per
+# rep, the streamed arm's peak_bytes_materialized gated <= budget, the
+# in-RAM arm's peak gated OVER it (the ceiling is proven, not assumed)
+stream-merge-bench:
+	$(PY) -m benchmarks.stream_merge_bench --keys 400000 --runs 3 \
+		--reps 3 --budget_kb 2048 --target_file_kb 256 \
+		--out benchmarks/results/stream_merge_r17.json
+
+# sub-minute smoke of the same (tier-1 asserts the artifact shape):
+# fails loudly on checksum divergence, a streamed peak over budget, an
+# input too small to exceed the budget, or a chunk-seam-free stream
+stream-merge-smoke:
+	$(PY) -m benchmarks.stream_merge_bench --keys 30000 --runs 3 \
+		--reps 1 --budget_kb 256 --target_file_kb 32 \
+		--chunk_entries 2048 \
+		--out benchmarks/results/stream_merge_smoke.json
 
 # round-14 metrics-plane smoke (<10s): boots one replica in-process,
 # scrapes /metrics + /cluster_stats, validates Prometheus text-format

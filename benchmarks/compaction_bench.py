@@ -261,14 +261,32 @@ def run_offline_subcompaction(root: str, args) -> Dict:
     regime subcompactions are designed for (the serving phase above
     deliberately stays below the slice floor: parallel fan-out on
     small merges was measured to steal serving CPU for nothing).
-    Output equality is checksummed across both arms."""
-    import hashlib
+    Output equality is checksummed across both arms.
 
-    from rocksplicator_tpu.storage.engine import DB, DBOptions
+    Streaming (round 17) is pinned OFF here: this A/B measures the
+    in-RAM path's key-range slicing, and at 1M entries the auto
+    threshold would otherwise route both arms through the bounded-
+    memory merge (neither would slice). The streamed-vs-in-RAM A/B
+    lives in benchmarks/stream_merge_bench.py."""
+    import rocksplicator_tpu.storage.stream_merge as sm
 
     base_sub = _counters("compaction.subcompactions")
     out: Dict = {"entries": 4 * args.offline_keys}
     sums = {}
+    prev_stream = sm.STREAM_MODE_OVERRIDE
+    sm.STREAM_MODE_OVERRIDE = "never"
+    try:
+        return _offline_arms(root, args, out, sums, base_sub)
+    finally:
+        sm.STREAM_MODE_OVERRIDE = prev_stream
+
+
+def _offline_arms(root: str, args, out: Dict, sums: Dict,
+                  base_sub: float) -> Dict:
+    import hashlib
+
+    from rocksplicator_tpu.storage.engine import DB, DBOptions
+
     # the sliced arm forces >=2 slices: auto (0) resolves to
     # min(4, cores) which on a single-core host is 1 — the arm would
     # never slice and the "never sliced" gate would blame the floor

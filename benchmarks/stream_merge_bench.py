@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Streaming bounded-memory merge A/B: chunked k-way vs in-RAM compaction.
+
+One large full compaction (overlapping sorted runs whose lane image is
+several times the configured memory budget) timed through the round-17
+streaming chunked merge (``storage/stream_merge.py``, fixed lane windows
+per input run, carry-state across chunk boundaries) INTERLEAVED against
+the round-9 in-RAM single pass on the SAME runs — the ab_runner pattern,
+so host drift lands on both arms equally. Output equality is checksummed
+file-for-file per rep.
+
+The artifact's load-bearing numbers are the two peaks: the streamed
+arm's ``peak_bytes_materialized`` must stay UNDER the budget while the
+in-RAM arm's peak (and the input lane image) sit far OVER it — the proof
+that the ceiling is enforced, not advisory. Loud failure gates: checksum
+divergence, a streamed peak over budget, an in-RAM peak that never
+exceeded the budget (the input was too small to prove anything), or a
+stream that never crossed a chunk seam.
+
+``make stream-merge-smoke`` runs the sub-minute configuration; tier-1
+asserts the artifact shape (tests/test_stream_merge.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, List
+
+from benchmarks.ab_runner import (emit_gated_artifact, host_calibration,
+                                  run_interleaved)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _write_runs(root: str, keys: int, runs: int) -> List[str]:
+    """Overlapping sorted runs: run r covers every r-th key at a later
+    seq, so the merge sees dup-key stacks at every overlap."""
+    import struct
+
+    from rocksplicator_tpu.storage.sst import SSTWriter
+
+    pack = struct.Struct("<q").pack
+    paths = []
+    for r in range(runs):
+        path = os.path.join(root, f"run{r}.tsst")
+        w = SSTWriter(path, 16 * 1024)
+        step = r + 1
+        for i in range(0, keys, step):
+            w.add(b"k%09d" % i, (r + 1) * 1_000_000 + i, 1, pack(i * 7 + r))
+        w.finish()
+        paths.append(path)
+    return paths
+
+
+def _merge_arm(paths: List[str], root: str, tag: str, rep: int,
+               mode: str, budget_bytes: int,
+               target_file_bytes: int) -> Dict:
+    import rocksplicator_tpu.storage.native_compaction as nc
+    import rocksplicator_tpu.storage.stream_merge as sm
+    from rocksplicator_tpu.storage.sst import SSTReader
+    from rocksplicator_tpu.utils.stats import Stats
+
+    out_dir = os.path.join(root, f"out-{tag}-{rep}")
+    os.makedirs(out_dir, exist_ok=True)
+    cnt = [0]
+
+    def pf() -> str:
+        cnt[0] += 1
+        return os.path.join(out_dir, f"o{cnt[0]}.tsst")
+
+    stats = Stats.get()
+    chunks0 = stats.get_counter("compaction.stream_chunks")
+    refills0 = stats.get_counter("compaction.stream_refills")
+    sm.STREAM_MODE_OVERRIDE = mode
+    tracker = sm.CompactionMemoryBudget.get().tracker()
+    readers = [SSTReader(p) for p in paths]
+    input_bytes = sum(os.path.getsize(p) for p in paths)
+    try:
+        t0 = time.monotonic()
+        outs = nc.direct_merge_runs_to_files(
+            readers, None, True, pf, 16 * 1024, 0, 10, target_file_bytes,
+            mem_tracker=tracker, memory_budget_bytes=budget_bytes)
+        secs = time.monotonic() - t0
+    finally:
+        sm.STREAM_MODE_OVERRIDE = None
+        tracker.close()
+        for r in readers:
+            r.close()
+    if outs is None:
+        raise RuntimeError(f"{tag}: direct merge declined")
+    h = hashlib.sha256()
+    out_bytes = 0
+    for p, _props in outs:
+        with open(p, "rb") as f:
+            h.update(f.read())
+        out_bytes += os.path.getsize(p)
+    for p, _props in outs:
+        os.remove(p)
+    return {
+        "sec": round(secs, 3),
+        "mb_per_sec": round(input_bytes / 1e6 / max(secs, 1e-9), 2),
+        "peak_bytes_materialized": tracker.peak,
+        "output_files": len(outs),
+        "output_bytes": out_bytes,
+        "output_sha256": h.hexdigest(),
+        "stream_chunks": int(
+            stats.get_counter("compaction.stream_chunks") - chunks0),
+        "stream_refills": int(
+            stats.get_counter("compaction.stream_refills") - refills0),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--keys", type=int, default=400000,
+                   help="keyspace; run r holds every r-th key")
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--budget_kb", type=int, default=2048,
+                   help="compaction memory budget for the streamed arm "
+                        "(lane image must be several times this)")
+    p.add_argument("--target_file_kb", type=int, default=256,
+                   help="output file split size; the streaming sink "
+                        "buffers up to one file, so keep this well "
+                        "under --budget_kb")
+    p.add_argument("--chunk_entries", type=int, default=0,
+                   help="override RSTPU_COMPACT_CHUNK_ENTRIES (0 = knob)")
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+
+    import rocksplicator_tpu.storage.stream_merge as sm
+
+    budget = args.budget_kb * 1024
+    sm.CompactionMemoryBudget.reset_for_test(budget)
+    if args.chunk_entries:
+        sm.CHUNK_ENTRIES_OVERRIDE = args.chunk_entries
+    root = tempfile.mkdtemp(prefix="stream_merge_bench_")
+    entries = sum(len(range(0, args.keys, r + 1))
+                  for r in range(args.runs))
+    log(f"stream_merge_bench: writing {args.runs} runs, "
+        f"{entries} entries, budget {args.budget_kb} KiB")
+    paths = _write_runs(root, args.keys, args.runs)
+    input_bytes = sum(os.path.getsize(p) for p in paths)
+
+    # untimed warmup on a tiny run: the first merge of a process pays
+    # import + allocator first-touch costs that would land entirely on
+    # whichever arm runs first (the ab_runner lesson, in miniature)
+    warm_root = os.path.join(root, "warmup")
+    os.makedirs(warm_root, exist_ok=True)
+    warm_paths = _write_runs(warm_root, 4000, 2)
+    for mode in ("never", "always"):
+        _merge_arm(warm_paths, warm_root, f"w-{mode}", 0, mode, budget,
+                   args.target_file_kb * 1024)
+
+    def arm(mode: str, tag: str):
+        rep_box = [0]
+
+        def thunk() -> Dict:
+            rep_box[0] += 1
+            return _merge_arm(paths, root, tag, rep_box[0], mode, budget,
+                              args.target_file_kb * 1024)
+        return thunk
+
+    ab = run_interleaved(
+        [("in_ram", arm("never", "ram")),
+         ("streamed", arm("always", "str"))],
+        reps=args.reps, key="mb_per_sec", log=log)
+    ab["host_calibration"] = host_calibration(root)
+
+    failures: List[str] = []
+    ram_reps = [s for s in ab["samples"].get("in_ram", [])
+                if isinstance(s, dict)]
+    str_reps = [s for s in ab["samples"].get("streamed", [])
+                if isinstance(s, dict)]
+    if len(ram_reps) < args.reps or len(str_reps) < args.reps:
+        failures.append("an arm failed to complete every rep")
+    for a, b in zip(ram_reps, str_reps):
+        if a["output_sha256"] != b["output_sha256"]:
+            failures.append("streamed output diverged from in-RAM "
+                            "(checksum mismatch)")
+    for s in str_reps:
+        if s["peak_bytes_materialized"] > budget:
+            failures.append(
+                f"streamed peak {s['peak_bytes_materialized']} "
+                f"exceeded the {budget}-byte budget")
+        if s["stream_chunks"] < 2:
+            failures.append("streamed arm never crossed a chunk seam")
+    for s in ram_reps:
+        if s["peak_bytes_materialized"] <= budget:
+            failures.append(
+                "in-RAM peak never exceeded the budget — input too "
+                "small to prove the ceiling; raise --keys")
+        if s["stream_chunks"]:
+            failures.append("in_ram arm streamed")
+
+    result = {
+        "bench": "stream_merge_bench",
+        "entries": entries,
+        "runs": args.runs,
+        "input_bytes": input_bytes,
+        "budget_bytes": budget,
+        "chunk_entries": (args.chunk_entries
+                          or sm.default_chunk_entries()),
+        "ab": ab,
+        "failures": failures,
+    }
+    rc = emit_gated_artifact(result, args.out, "stream_merge_bench",
+                             log=log)
+    sm.CompactionMemoryBudget.reset_for_test()
+    sm.CHUNK_ENTRIES_OVERRIDE = None
+    import shutil
+    shutil.rmtree(root, ignore_errors=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

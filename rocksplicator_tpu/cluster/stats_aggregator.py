@@ -149,7 +149,9 @@ class ClusterStatsAggregator:
                 "read_rate_1m": 0.0, "write_rate_1m": 0.0,
                 "reads_total": 0.0, "writes_total": 0.0,
                 "max_applied_seq_lag": 0.0, "ack_window_depth": 0.0,
-                "compaction_debt_bytes": 0.0, "replicas_reporting": 0,
+                "compaction_debt_bytes": 0.0,
+                "compaction_peak_bytes_materialized": 0.0,
+                "replicas_reporting": 0,
                 "roles": {},
             })
 
@@ -206,6 +208,13 @@ class ClusterStatsAggregator:
                     k = (ep, db)
                     debt_by_ep_db[k] = (debt_by_ep_db.get(k, 0.0)
                                         + float(value))
+                elif base == "compaction.peak_bytes_materialized":
+                    # worst replica's compaction memory high-water —
+                    # the fleet view of the streaming-merge ceiling
+                    rec = shard_rec(db)
+                    rec["compaction_peak_bytes_materialized"] = max(
+                        rec["compaction_peak_bytes_materialized"],
+                        float(value))
             for name, st in (state.get("metrics") or {}).items():
                 base, tags = split_tagged(name)
                 if base in _LATENCY_FAMILIES:

@@ -31,6 +31,14 @@ KEY_BYTES = 24
 KEY_WORDS = KEY_BYTES // 4
 VAL_BYTES_DEFAULT = 8
 
+# The canonical lane set every array pipeline carries (tpu/chunked.py
+# kernel passes, the streaming merge's windows/chunks). LE key words are
+# byteswap-derived for device bloom hashing; CPU-only consumers drop them.
+LANE_FIELDS = (
+    "key_words_be", "key_words_le", "key_len", "seq_hi", "seq_lo",
+    "vtype", "val_words", "val_len",
+)
+
 Entry = Tuple[bytes, int, int, bytes]  # key, seq, vtype, value
 
 

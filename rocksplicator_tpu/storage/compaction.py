@@ -33,6 +33,12 @@ class CompactionBackend:
     # passes them to backends that declare support, so third-party
     # backend signatures stay valid.
     supports_subcompactions = False
+    # True on backends that additionally accept the round-17
+    # ``mem_tracker``/``memory_budget_bytes`` keywords (streaming
+    # bounded-memory merge + peak gauge) — a separate capability so a
+    # third-party backend that declared subcompaction support before
+    # round 17 keeps its narrower signature valid.
+    supports_memory_budget = False
 
     def merge_runs(
         self,
@@ -54,6 +60,7 @@ class CpuCompactionBackend(CompactionBackend):
 
     name = "cpu"
     supports_subcompactions = True
+    supports_memory_budget = True
 
     def merge_runs(
         self,
@@ -77,20 +84,27 @@ class CpuCompactionBackend(CompactionBackend):
         target_file_bytes: int,
         max_subcompactions: int = 1,
         io_budget=None,
+        mem_tracker=None,
+        memory_budget_bytes: int = 0,
     ):
         """[(path, props)], [] for an all-tombstoned result, or None →
         the engine's tuple path. Shared implementation with the native
         backend (storage/native_compaction.direct_merge_runs_to_files);
         the native C resolve is used when the library is loaded, the
-        numpy lexsort+reduceat resolve otherwise. With
-        ``max_subcompactions > 1`` the merge splits into parallel
-        key-range slices; ``io_budget`` paces output writes."""
+        numpy lexsort+reduceat resolve otherwise. Oversized inputs
+        stream through the bounded-memory chunked merge
+        (storage/stream_merge.py). With ``max_subcompactions > 1`` the
+        in-RAM merge splits into parallel key-range slices;
+        ``io_budget`` paces output writes; ``mem_tracker`` feeds the
+        peak-bytes-materialized gauge."""
         from .native_compaction import direct_merge_runs_to_files
 
         return direct_merge_runs_to_files(
             runs, merge_op, drop_tombstones, path_factory, block_bytes,
             compression, bits_per_key, target_file_bytes,
             max_subcompactions=max_subcompactions, io_budget=io_budget,
+            mem_tracker=mem_tracker,
+            memory_budget_bytes=memory_budget_bytes,
         )
 
 

@@ -242,6 +242,26 @@ class IoBudget:
         return slept
 
 
+def adaptive_chunk_entries(base_entries: int, io_budget) -> int:
+    """Workload-adaptive chunk sizing for the streaming bounded-memory
+    merge (storage/stream_merge.py) — the same stall signal that boosts
+    debt-drain priority SHRINKS the merge's working set: while the
+    delayed-write controller is stalling admissions the memtables are
+    growing, so the compaction should hold less lane memory and hit its
+    refill/yield seams more often. Halves per stall-pressure doubling
+    over STALL_BOOST_MS, floored at a quarter of the configured chunk.
+    The chunk cuts this sizes are the streaming analog of the round-16
+    subcompaction slice boundaries: both are key-aligned partitions of
+    one compaction's merge, sized by foreground pressure."""
+    if io_budget is None:
+        return base_entries
+    pressure = io_budget.stall_pressure()
+    if pressure <= STALL_BOOST_MS:
+        return base_entries
+    shrink = min(4.0, pressure / STALL_BOOST_MS)
+    return max(base_entries // 4, int(base_entries / shrink))
+
+
 class CompactionScheduler:
     """Per-db compaction candidate ranking. All ``*_locked`` methods
     run under the engine's DB lock (the engine's compaction thread and

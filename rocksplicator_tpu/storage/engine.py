@@ -138,6 +138,14 @@ class DBOptions:
     compaction_budget_bytes_per_sec: int = field(
         default_factory=lambda: int(os.environ.get(
             "RSTPU_COMPACT_BUDGET_BYTES", "0")))
+    # Hard ceiling on compaction lane bytes materialized in RAM
+    # (storage/stream_merge.py): full compactions whose projected
+    # working set exceeds it run as a streaming chunked k-way merge
+    # with fixed windows per input run instead of decoding every run at
+    # once — unlocking levels >> RAM. 0 = the process-wide default
+    # (RSTPU_COMPACT_MEM_BUDGET, 256 MiB). The per-compaction
+    # high-water feeds the compaction.peak_bytes_materialized gauge.
+    compaction_memory_budget_bytes: int = 0
 
     # Mutable at runtime via DB.set_options (reference setDBOptions RPC).
     MUTABLE = {
@@ -146,6 +154,7 @@ class DBOptions:
         "delayed_write_rate", "level0_slowdown_writes_trigger",
         "level0_stop_writes_trigger", "max_subcompactions",
         "compaction_budget_bytes_per_sec",
+        "compaction_memory_budget_bytes",
     }
 
 
@@ -271,6 +280,11 @@ class DB:
         self._files_consulted_total = 0
         self._bytes_flushed_total = 0
         self._bytes_compacted_total = 0
+        # high-water of live compaction lane bytes during the most
+        # recent direct/streaming merge (stream_merge.MemTracker) —
+        # the compaction.peak_bytes_materialized gauge the memory
+        # budget's acceptance test asserts against
+        self._compaction_peak_bytes = 0
         # last foreground write (monotonic): the scheduler defers batch
         # level-debt work while the foreground is live and drains it in
         # valleys (compaction_scheduler.IDLE_DRAIN_SEC). 0 = never
@@ -1588,15 +1602,23 @@ class DB:
                 allocated.append(name)
                 return os.path.join(self.path, name)
 
-            # subcompaction + IO-budget plumbing only for backends that
-            # declare support (keeps third-party backend signatures
-            # unchanged)
+            # subcompaction + IO-budget + memory-budget plumbing only
+            # for backends that declare support (keeps third-party
+            # backend signatures unchanged)
             kwargs = {}
+            tracker = None
             if getattr(self._backend, "supports_subcompactions", False):
                 kwargs["max_subcompactions"] = (
                     subcompactions if subcompactions is not None
                     else self._effective_subcompactions())
                 kwargs["io_budget"] = self._io_budget
+            if getattr(self._backend, "supports_memory_budget", False):
+                from .stream_merge import CompactionMemoryBudget
+
+                tracker = CompactionMemoryBudget.get().tracker()
+                kwargs["mem_tracker"] = tracker
+                kwargs["memory_budget_bytes"] = (
+                    self.options.compaction_memory_budget_bytes)
             try:
                 outputs = direct(
                     runs, self.options.merge_operator, drop_tombstones,
@@ -1607,6 +1629,13 @@ class DB:
             except Exception:
                 log.exception("direct merge sink failed; using tuple path")
                 outputs = None
+            finally:
+                if tracker is not None:
+                    tracker.close()
+                    if tracker.peak:
+                        # the peak_bytes_materialized gauge: high-water
+                        # of live lane bytes during this compaction
+                        self._compaction_peak_bytes = tracker.peak
             if outputs is not None:
                 names: List[str] = []
                 for path, _props in outputs:
@@ -1906,6 +1935,7 @@ class DB:
             consulted = self._files_consulted_total
             flushed = self._bytes_flushed_total
             compacted = self._bytes_compacted_total
+            compaction_peak = self._compaction_peak_bytes
         # WAL backlog sized OUTSIDE the lock (directory listing is IO);
         # the segment set is append/purge-only so a racing purge at
         # worst under-counts one segment
@@ -1932,6 +1962,7 @@ class DB:
             "files_consulted_total": consulted,
             "bytes_flushed_total": flushed,
             "bytes_compacted_total": compacted,
+            "compaction_peak_bytes_materialized": compaction_peak,
         }
         self._metrics_cache = (now, snap)
         return snap
@@ -2207,6 +2238,11 @@ DB_SCALAR_GAUGES = {
     "storage.unflushed_seqs": "unflushed_seqs",
     "storage.read_amp": "read_amp",
     "storage.write_amp": "write_amp",
+    # high-water of live lane bytes during the most recent compaction
+    # merge — the streaming bounded-memory pipeline's load-bearing
+    # ceiling proof (stream_merge.CompactionMemoryBudget)
+    "compaction.peak_bytes_materialized":
+        "compaction_peak_bytes_materialized",
 }
 _LEVEL_GAUGE_KEYS = {
     "storage.level_files": "level_files",

@@ -62,6 +62,8 @@ class NativeCompactionBackend(CpuCompactionBackend):
         target_file_bytes: int,
         max_subcompactions: int = 1,
         io_budget=None,
+        mem_tracker=None,
+        memory_budget_bytes: int = 0,
     ) -> Optional[List[Tuple[str, dict]]]:
         """[(path, props)], [] for an all-tombstoned result, or None →
         the engine's tuple path. (Shared with CpuCompactionBackend —
@@ -70,6 +72,8 @@ class NativeCompactionBackend(CpuCompactionBackend):
             runs, merge_op, drop_tombstones, path_factory, block_bytes,
             compression, bits_per_key, target_file_bytes,
             max_subcompactions=max_subcompactions, io_budget=io_budget,
+            mem_tracker=mem_tracker,
+            memory_budget_bytes=memory_budget_bytes,
         )
 
     # -- internals ---------------------------------------------------------
@@ -535,6 +539,8 @@ def direct_merge_runs_to_files(
     target_file_bytes: int,
     max_subcompactions: int = 1,
     io_budget=None,
+    mem_tracker=None,
+    memory_budget_bytes: int = 0,
 ) -> Optional[List[Tuple[str, dict]]]:
     """The CPU array compaction pipeline: runs → lanes → merge-resolve
     (native C when loaded, numpy lexsort+reduceat otherwise) → PLANAR
@@ -543,35 +549,61 @@ def direct_merge_runs_to_files(
     NativeCompactionBackend so every CPU-configured engine compacts
     array-to-array when the inputs allow it.
 
-    ``max_subcompactions > 1``: the merge splits into disjoint
-    key-range slices resolved+written in parallel across cores (see the
-    subcompaction block above); ``io_budget`` paces the output writes
-    so compaction IO yields to foreground fsyncs."""
+    Inputs whose projected lane image exceeds the compaction memory
+    budget (or the MAX_DIRECT_ENTRIES cap) stream through the chunked
+    bounded-memory merge instead of materializing here — byte-identical
+    output, working set fixed by RSTPU_COMPACT_MEM_BUDGET
+    (storage/stream_merge.py). Smaller compactions keep the in-RAM
+    path: it already fits the ceiling, and key-range subcompactions
+    (``max_subcompactions > 1``) can then resolve+write disjoint slices
+    in parallel across cores. ``io_budget`` paces the output writes so
+    compaction IO yields to foreground fsyncs; ``mem_tracker`` records
+    the materialized-bytes high-water for the
+    ``compaction.peak_bytes_materialized`` gauge on both paths."""
     from ..observability.span import start_span
+    from .stream_merge import maybe_stream_merge
 
     if merge_op is not None and not isinstance(merge_op, UInt64AddOperator):
         return None
+    streamed = maybe_stream_merge(
+        runs, merge_op, drop_tombstones, path_factory, block_bytes,
+        compression, bits_per_key, target_file_bytes,
+        io_budget=io_budget, mem_tracker=mem_tracker,
+        memory_budget_bytes=memory_budget_bytes,
+    )
+    if streamed is not None:
+        return streamed
     read = read_runs_as_lanes(runs, merge_op)
     if read is None:
         return None
     parts, lanes, total, vw = read
     if not lanes_resolvable(lanes, merge_op):
         return None
-    if max_subcompactions > 1:
-        kl = lanes["key_len"]
-        klen = int(kl[0]) if len(kl) else 0
-        bounds = plan_subcompactions(parts, total, max_subcompactions, klen)
-        if bounds:
-            return _subcompact_to_files(
-                parts, bounds, klen, vw, merge_op, drop_tombstones,
-                path_factory, block_bytes, compression, bits_per_key,
-                target_file_bytes, io_budget)
-    with start_span("compact.resolve", entries=total):
-        arrays, count = NativeCompactionBackend._resolve(
-            parts, lanes, total, vw, merge_op, drop_tombstones)
-    if count == 0:
-        return []  # fully compacted away — nothing to write
-    return write_resolved_lanes(
-        arrays, count, path_factory, block_bytes, compression,
-        bits_per_key, target_file_bytes, io_budget=io_budget,
-    )
+    # in-RAM accounting for the peak gauge: per-run parts plus their
+    # concatenation are live together right now
+    inram_bytes = 2 * int(sum(a.nbytes for a in lanes.values()))
+    if mem_tracker is not None:
+        mem_tracker.add(inram_bytes)
+    try:
+        if max_subcompactions > 1:
+            kl = lanes["key_len"]
+            klen = int(kl[0]) if len(kl) else 0
+            bounds = plan_subcompactions(
+                parts, total, max_subcompactions, klen)
+            if bounds:
+                return _subcompact_to_files(
+                    parts, bounds, klen, vw, merge_op, drop_tombstones,
+                    path_factory, block_bytes, compression, bits_per_key,
+                    target_file_bytes, io_budget)
+        with start_span("compact.resolve", entries=total):
+            arrays, count = NativeCompactionBackend._resolve(
+                parts, lanes, total, vw, merge_op, drop_tombstones)
+        if count == 0:
+            return []  # fully compacted away — nothing to write
+        return write_resolved_lanes(
+            arrays, count, path_factory, block_bytes, compression,
+            bits_per_key, target_file_bytes, io_budget=io_budget,
+        )
+    finally:
+        if mem_tracker is not None:
+            mem_tracker.sub(inram_bytes)

@@ -32,6 +32,11 @@ REGISTRY = {
     "compact.pick": "scheduler pick failure (compaction loop retries)",
     "compact.subcompact": "key-range subcompaction slice failure",
     "compact.yield": "IO-budget yield delay / failure on a compaction write",
+    # streaming bounded-memory merge (round 17): a fault at either seam
+    # kills the pipeline mid-stream — every written output is swept and
+    # nothing was installed, so reopen is exactly pre-compaction
+    "compact.stream.chunk": "streaming merge chunk resolve failure",
+    "compact.stream.refill": "streaming merge window refill failure",
     "objectstore.get": "object-store download failure",
     "objectstore.put": "object-store upload failure",
     "s3.request": "S3 request transient failure",

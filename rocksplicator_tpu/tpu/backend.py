@@ -55,6 +55,7 @@ def _arrays_from_entries(entries: List[Entry]) -> Optional[dict]:
 class TpuCompactionBackend(CompactionBackend):
     name = "tpu"
     supports_subcompactions = True
+    supports_memory_budget = True
 
     def __init__(self, fallback: Optional[CompactionBackend] = None):
         # default fallback is the VECTORIZED cpu path: on hosts where the
@@ -165,6 +166,8 @@ class TpuCompactionBackend(CompactionBackend):
         target_file_bytes: int,
         max_subcompactions: int = 1,
         io_budget=None,
+        mem_tracker=None,
+        memory_budget_bytes: int = 0,
     ) -> Optional[List[Tuple[str, dict]]]:
         """Merge + write output SSTs with the vectorized array sink and
         kernel-built blooms, splitting at ``target_file_bytes``. Inputs may
@@ -173,19 +176,37 @@ class TpuCompactionBackend(CompactionBackend):
         entry iterables. Returns [(path, props)] — empty list for an
         all-tombstoned result — or None → tuple path.
 
-        ``max_subcompactions > 1``: the job splits into disjoint
+        Inputs whose projected lane image exceeds the compaction memory
+        budget stream through the chunked bounded-memory merge with the
+        DEVICE chunk resolver — double-buffered chunks: decode chunk
+        N+1 on host while chunk N's lanes transfer back from device
+        (the resolve itself still syncs at submit; see TpuChunkResolver)
+        (storage/stream_merge.py + compaction_service.TpuChunkResolver).
+
+        ``max_subcompactions > 1``: an in-RAM job splits into disjoint
         key-range slices resolved as ONE padded vmapped device batch
         (tpu/compaction_service.resolve_slices_batched) — k smaller
         bitonic sorts in one launch instead of one pow2(total) sort.
         ``io_budget`` paces the output file writes."""
         from ..ops.bloom_tpu import bloom_build_tpu
         from ..storage.bloom import num_words_for
+        from ..storage.stream_merge import maybe_stream_merge
         from .chunked import FIELDS, run_kernel_arrays
+        from .compaction_service import TpuChunkResolver
         from .format import (planar_stride, planar_widths, read_sst_arrays,
                              write_sst_from_arrays)
 
         if merge_op is not None and not isinstance(merge_op, UInt64AddOperator):
             return None
+        streamed = maybe_stream_merge(
+            runs, merge_op, drop_tombstones, path_factory, block_bytes,
+            compression, bits_per_key, target_file_bytes,
+            io_budget=io_budget, mem_tracker=mem_tracker,
+            memory_budget_bytes=memory_budget_bytes,
+            resolver=TpuChunkResolver(),
+        )
+        if streamed is not None:
+            return streamed
         parts: List[dict] = []
         try:
             for run in runs:

@@ -30,10 +30,7 @@ from ..ops.kv_format import KVBatch
 
 log = logging.getLogger(__name__)
 
-FIELDS = (
-    "key_words_be", "key_words_le", "key_len", "seq_hi", "seq_lo",
-    "vtype", "val_words", "val_len",
-)
+from ..ops.kv_format import LANE_FIELDS as FIELDS  # noqa: E402 (canonical home)
 # kernel INPUT lanes: LE key words are byteswap-derived on device, so they
 # are carried between passes (FIELDS — outputs include them for the sinks)
 # but never shipped into a launch

@@ -688,3 +688,59 @@ def compact_dbs_batched(dbs, group_size: int = 8, pool=None):
                 db.abort_full_compaction(plan)
             except Exception:
                 pass
+
+
+# ---------------------------------------------------------------------------
+# streaming bounded-memory compaction: the device chunk resolver
+# ---------------------------------------------------------------------------
+
+
+class TpuChunkResolver:
+    """The TPU face of the streaming chunked merge
+    (storage/stream_merge.py): each merge chunk launches the
+    merge-resolve kernel with ``to_host=False`` so the output lanes stay
+    DEVICE-resident at submit; ``collect`` materializes them to host one
+    chunk later. The pipeline decodes chunk N+1's windows between
+    submit(N) and collect(N), so host decode (70% of a large compaction,
+    GIL-bound) overlaps chunk N's DEVICE→HOST transfer — the
+    double-buffered chunk shape LUDA (arxiv 2004.03054) uses and the
+    silicon bench needs. Honest scope: submit() still synchronizes on
+    the kernel itself (``run_kernel_arrays`` reads the
+    ``needs_cpu_fallback`` flag and count as Python scalars, forcing
+    the launch), so today only the transfer overlaps the next decode;
+    overlapping the resolve too needs an async fallback flag — silicon
+    follow-on work. Chunks pad to the next pow2 of the window total,
+    so steady-state launches reuse one compiled shape."""
+
+    # chunk lanes carry LE key words too (device bloom hashing)
+    from .chunked import FIELDS as fields
+    pipelined = True  # one chunk stays in flight behind the decode
+
+    def submit(self, parts, lanes, total: int, vw: int, merge_op,
+               drop_tombstones: bool):
+        from ..storage.merge import UInt64AddOperator
+        from ..storage.stream_merge import _StreamDecline
+        from .chunked import run_kernel_arrays
+
+        kind = (
+            MergeKind.UINT64_ADD
+            if isinstance(merge_op, UInt64AddOperator) else MergeKind.NONE
+        )
+        uniform_klen, seq32, key_words = fast_flags(
+            lanes["key_len"], lanes["seq_hi"],
+            np.ones(total, dtype=bool))
+        arrays, count = run_kernel_arrays(
+            lanes, total, kind, drop_tombstones,
+            pad_to=_next_pow2(total),
+            uniform_klen=uniform_klen, seq32=seq32, key_words=key_words,
+            to_host=False,
+        )
+        if arrays is None:
+            # kernel flagged limb-overflow risk: the whole stream
+            # declines and the caller's CPU/tuple fallback handles it
+            raise _StreamDecline("device kernel flagged cpu fallback")
+        return arrays, count
+
+    def collect(self, handle) -> Tuple[dict, int]:
+        arrays, count = handle
+        return {f: np.asarray(a) for f, a in arrays.items()}, count

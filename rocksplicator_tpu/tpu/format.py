@@ -82,6 +82,8 @@ def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
     property (flush-written / foreign files use the tuple path)."""
     if reader.props.get("planar"):
         return _read_planar_arrays(reader)
+    from ..ops.kv_format import UnsupportedBatch
+
     # Validate BEFORE reading the whole file: a file the array path will
     # reject must not pay a full pread+decompress only to be read again
     # by the tuple fallback.
@@ -96,29 +98,158 @@ def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
         # No sink prop (flush-written / foreign file): INFER the uniform
         # stride from block 0 so first-level compactions of flush output
         # still decode array-to-array. Probe only block 0 before
-        # committing to the full read; the per-row width checks below
-        # validate the inference (non-uniform files fail them and take
-        # the tuple path).
+        # committing to the full read; the per-row width checks in the
+        # shared row decode validate the inference (non-uniform files
+        # fail them and take the tuple path).
         if not reader.num_entries or not reader._index:
             return None
         b0 = reader._read_block(0, fill_cache=False)
-        if len(b0) < _ENTRY_FIXED_OVERHEAD:
+        inferred = _infer_uniform_widths(b0)
+        if inferred is None:
             return None
-        klen = int.from_bytes(b0[:4], "little")
-        if not (0 < klen <= 24) or len(b0) < _ENTRY_FIXED_OVERHEAD + klen:
-            return None
-        # first entry's vlen field sits after klen|key|seq|vtype
-        vlen = int.from_bytes(b0[klen + 13:klen + 17], "little")
-        if len(b0) % (_ENTRY_FIXED_OVERHEAD + klen + vlen):
-            return None
+        klen, vlen = inferred
         blocks = [b0] + [
             reader._read_block(i, fill_cache=False)
             for i in range(1, len(reader._index))
         ]
     raw = b"".join(blocks)
+    try:
+        lanes = _decode_uniform_rows(raw, klen, vlen)
+    except UnsupportedBatch:
+        return None  # misaligned/non-uniform — tuple path handles it
+    # ingestion-time global seqno overrides per-entry seqs, same as the
+    # reader's _effective_seq
+    if reader.global_seqno is not None:
+        n = len(lanes["seq_lo"])
+        lanes["seq_lo"] = np.full(
+            n, reader.global_seqno & 0xFFFFFFFF, dtype=np.uint32)
+        lanes["seq_hi"] = np.full(
+            n, reader.global_seqno >> 32, dtype=np.uint32)
+    return lanes
+
+
+class SstBlockLaneSource:
+    """Block-granular lane decoder over ONE streamable TSST file — the
+    SOURCE side of the bounded-memory chunked merge
+    (storage/stream_merge.py). Where :func:`read_sst_arrays`
+    materializes the whole file, this decodes an arbitrary block range
+    on demand so a compaction's working set stays a fixed window per
+    input run regardless of file size.
+
+    Block reads probe the decoded-block LRU but never fill it
+    (``fill_cache=False`` — the bulk-scan convention): a large streaming
+    compaction must not evict hot serving blocks.
+
+    ``probe`` returns None for files the lane representation can't
+    stream (non-uniform rows, foreign layouts); a block that later
+    violates the probed layout raises UnsupportedBatch and the caller
+    falls back to the non-streaming path."""
+
+    def __init__(self, reader, kind: str, klen: int, vlen: int):
+        self.reader = reader
+        self.kind = kind  # "planar" | "uniform"
+        self.klen = klen
+        self.vlen = vlen  # non-delete value width
+        self.num_blocks = len(reader._index)
+        self.num_entries = int(reader.num_entries)
+
+    @classmethod
+    def probe(cls, reader) -> Optional["SstBlockLaneSource"]:
+        props = reader.props
+        if not reader.num_entries or not reader._index:
+            return None
+        p = props.get("planar")
+        if p:
+            try:
+                klen, vlen = int(p[0]), int(p[1])
+            except (TypeError, ValueError, IndexError, KeyError):
+                return None
+            if not (0 < klen <= 24) or vlen < 0:
+                return None
+            return cls(reader, "planar", klen, vlen)
+        widths = props.get("uniform")
+        if widths:
+            try:
+                klen, vlen = int(widths[0]), int(widths[1])
+            except (TypeError, ValueError, IndexError):
+                return None
+            if not (0 < klen <= 24) or vlen < 0:
+                return None
+            return cls(reader, "uniform", klen, vlen)
+        # No sink prop (flush-written / foreign): infer the uniform
+        # stride from block 0 via the SAME helper read_sst_arrays uses —
+        # the per-block width checks in decode_blocks validate the
+        # inference on every later block.
+        b0 = reader._read_block(0, fill_cache=False)
+        inferred = _infer_uniform_widths(b0)
+        if inferred is None:
+            return None
+        return cls(reader, "uniform", *inferred)
+
+    def decode_blocks(self, b0: int, b1: int) -> Dict[str, np.ndarray]:
+        """Lane arrays for blocks [b0, b1). Raises UnsupportedBatch when
+        a block violates the probed layout (caller declines streaming)."""
+        from ..ops.kv_format import UnsupportedBatch
+
+        if self.kind == "planar":
+            try:
+                parts = [
+                    decode_planar_block(
+                        self.reader._read_block(i, fill_cache=False))
+                    for i in range(b0, b1)
+                ]
+            except Exception as e:
+                raise UnsupportedBatch(f"planar stream decode: {e}")
+            lanes = {f: np.concatenate([p[f] for p in parts])
+                     for f in parts[0]}
+            kl = lanes["key_len"]
+            if len(kl) and not (kl == self.klen).all():
+                raise UnsupportedBatch("planar stream: klen drift")
+            vl = lanes["val_len"][lanes["vtype"] != 2]
+            if len(vl) and not (vl == self.vlen).all():
+                raise UnsupportedBatch("planar stream: vlen drift")
+        else:
+            raw = b"".join(
+                self.reader._read_block(i, fill_cache=False)
+                for i in range(b0, b1))
+            lanes = _decode_uniform_rows(raw, self.klen, self.vlen)
+        seqno = self.reader.global_seqno
+        if seqno is not None:
+            n = len(lanes["seq_lo"])
+            lanes["seq_lo"] = np.full(
+                n, seqno & 0xFFFFFFFF, dtype=np.uint32)
+            lanes["seq_hi"] = np.full(n, seqno >> 32, dtype=np.uint32)
+        return lanes
+
+
+def _infer_uniform_widths(b0: bytes):
+    """(klen, vlen) of a uniform-stride file inferred from its first
+    block (no sink prop: flush-written / foreign files), or None when
+    block 0 can't carry a uniform stride. Shared by read_sst_arrays and
+    SstBlockLaneSource.probe; the per-row checks in
+    _decode_uniform_rows validate the inference on every block."""
+    if len(b0) < _ENTRY_FIXED_OVERHEAD:
+        return None
+    klen = int.from_bytes(b0[:4], "little")
+    if not (0 < klen <= 24) or len(b0) < _ENTRY_FIXED_OVERHEAD + klen:
+        return None
+    # first entry's vlen field sits after klen|key|seq|vtype
+    vlen = int.from_bytes(b0[klen + 13:klen + 17], "little")
+    if len(b0) % (_ENTRY_FIXED_OVERHEAD + klen + vlen):
+        return None
+    return klen, vlen
+
+
+def _decode_uniform_rows(raw: bytes, klen: int,
+                         vlen: int) -> Dict[str, np.ndarray]:
+    """Uniform-stride row bytes → lane arrays (the row-matrix half of
+    read_sst_arrays, shared with the block-range streaming source).
+    Raises UnsupportedBatch on per-row width drift."""
+    from ..ops.kv_format import UnsupportedBatch
+
     stride = _ENTRY_FIXED_OVERHEAD + klen + vlen
     if len(raw) % stride:
-        return None  # inconsistent — let the tuple path validate/complain
+        raise UnsupportedBatch("uniform stream: stride drift")
     n = len(raw) // stride
     mat = np.frombuffer(raw, dtype=np.uint8).reshape(n, stride)
     pos = 0
@@ -134,18 +265,13 @@ def read_sst_arrays(reader) -> Optional[Dict[str, np.ndarray]]:
     pos += 4
     val_bytes = mat[:, pos:pos + vlen]
     if not (klens == klen).all() or not (vlens == vlen).all():
-        return None  # misaligned/non-uniform — tuple path handles it
+        raise UnsupportedBatch("uniform stream: row width drift")
     key_buf = np.zeros((n, 24), dtype=np.uint8)
     key_buf[:, :klen] = key_bytes
-    # at least the default width so arrays from different runs concatenate
     vw = max(2, (vlen + 3) // 4)
     val_buf = np.zeros((n, vw * 4), dtype=np.uint8)
     if vlen:
         val_buf[:, :vlen] = val_bytes
-    # ingestion-time global seqno overrides per-entry seqs, same as the
-    # reader's _effective_seq
-    if reader.global_seqno is not None:
-        seqs = np.full(n, reader.global_seqno, dtype=np.uint64)
     return {
         "key_words_be": key_buf.view(">u4").astype(np.uint32).reshape(n, 6),
         "key_words_le": key_buf.view("<u4").reshape(n, 6).copy(),

@@ -171,6 +171,18 @@ from rocksplicator_tpu.storage import native_compaction as _nc  # noqa: E402
 
 _nc.MIN_SLICE_ENTRIES = 256
 
+# Same for the streaming bounded-memory merge (round 17): chaos-scale
+# compactions are a few thousand entries, far under the auto threshold,
+# so force streaming as the default full-compaction path with chunk
+# windows small enough that every compaction crosses multiple
+# compact.stream.chunk/refill seams. A stream fault mid-chunk sweeps
+# the partial outputs and the engine falls back (or retries) — the
+# ingest-atomicity invariant rides every schedule.
+from rocksplicator_tpu.storage import stream_merge as _sm  # noqa: E402
+
+_sm.STREAM_MODE_OVERRIDE = "always"
+_sm.CHUNK_ENTRIES_OVERRIDE = 512
+
 
 def _fault_menu(rng: random.Random) -> List[Tuple[str, str]]:
     """The schedule's candidate faults — every parameter drawn from the
@@ -202,6 +214,14 @@ def _fault_menu(rng: random.Random) -> List[Tuple[str, str]]:
         ("compact.pick", f"fail_prob:{rng.uniform(0.05, 0.25):.3f}@seed{s}"),
         ("compact.subcompact", f"fail_nth:{rng.randint(1, 3)}"),
         ("compact.yield", f"delay_ms:{rng.randint(5, 30)}"),
+        # round 17: the streaming bounded-memory merge runs as the
+        # default full-compaction path at chaos scale (see the
+        # STREAM_MODE_OVERRIDE block above) — kill it mid-chunk and
+        # mid-refill; outputs are swept, nothing installs, the
+        # invariants must hold
+        ("compact.stream.chunk", f"fail_nth:{rng.randint(1, 4)}"),
+        ("compact.stream.refill",
+         f"fail_prob:{rng.uniform(0.02, 0.15):.3f}@seed{s}"),
     ]
 
 
