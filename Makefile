@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab metrics-smoke compaction-bench compaction-bench-smoke stream-merge-bench stream-merge-smoke chaos-smoke chaos-failover-smoke reshard-smoke clean
+.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab metrics-smoke compaction-bench compaction-bench-smoke compaction-remote-bench compaction-remote-smoke stream-merge-bench stream-merge-smoke chaos-smoke chaos-failover-smoke reshard-smoke clean
 
 # rstpu-check: the three-pass static suite (lock-order/blocking-under-
 # lock, event-loop blocking, failpoint/span/stats registries) over
@@ -122,6 +122,33 @@ compaction-bench-smoke:
 		--level_base_kb 128 --settle 1 --offline_keys 8000 \
 		--min_slice_entries 4096 \
 		--out benchmarks/results/compaction_bench_smoke.json
+
+# round-18 disaggregated-compaction A/B: the SAME mixed load with the
+# worker tier on vs off (interleaved), compaction merges offloaded
+# through the coordinator job ledger to an in-process stateless worker.
+# Gates: tier-on serving-node compaction output bytes ~0 (the merge ran
+# on the worker: compaction.remote_offloaded_bytes vs .local_output_
+# bytes), get p99 recorded in both arms, zero value mismatches, and a
+# determinism section proving the remote-installed generation is
+# byte-identical (sorted SST sha256 set + full content hash) to the
+# local path's on the same input
+compaction-remote-bench:
+	$(PY) bench.py --compaction_bench --remote_ab --keys 20000 \
+		--rate 1800 --duration 8 --reps 3 --memtable_kb 32 \
+		--target_file_kb 64 --level_base_kb 128 --settle 2 \
+		--out benchmarks/results/compaction_remote_r18.json
+
+# sub-minute smoke of the same (tier-1 asserts the artifact shape) +
+# the remote_install chaos tooth: a leader patched to skip the epoch
+# gate must be CAUGHT installing a deposed leader's job
+compaction-remote-smoke:
+	$(PY) bench.py --compaction_bench --remote_ab --keys 4000 \
+		--rate 900 --duration 3 --reps 1 --memtable_kb 32 \
+		--target_file_kb 64 --level_base_kb 128 --settle 1 \
+		--out benchmarks/results/compaction_remote_smoke.json
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --schedules 1 --seed 7 \
+		--remote-every 1 \
+		--break-guard remote_install --expect-violation --conv-timeout 3
 
 # round-16 serving-SLO acceptance: the SAME 3-process macro-bench
 # cluster under a write-heavy mix, whole-cluster interleaved A/B of

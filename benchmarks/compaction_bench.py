@@ -340,6 +340,346 @@ def _offline_arms(root: str, args, out: Dict, sums: Dict,
     return out
 
 
+class _RemoteTier:
+    """In-process disaggregated compaction tier for the A/B: one
+    coordinator, one stateless worker, a ``local://`` object store.
+    Leaders attach per-db managers; the worker drains every db's jobs."""
+
+    def __init__(self, root: str):
+        from rocksplicator_tpu.cluster.coordinator import (
+            CoordinatorClient, CoordinatorServer)
+        from rocksplicator_tpu.compaction_remote import CompactionWorker
+
+        self.server = CoordinatorServer(port=0, session_ttl=5.0)
+        self._clients: List = []
+
+        def client():
+            c = CoordinatorClient("127.0.0.1", self.server.port)
+            self._clients.append(c)
+            return c
+
+        self._client = client
+        self.store_uri = f"local://{os.path.join(root, 'remote_store')}"
+        self._stop = threading.Event()
+        self.worker = CompactionWorker(
+            client(), os.path.join(root, "remote_worker"),
+            worker_id="bench-worker", poll_interval=0.02,
+            heartbeat_interval=0.5)
+        threading.Thread(target=self.worker.serve_forever,
+                         args=(self._stop,), daemon=True).start()
+
+    def attach(self, db, name: str):
+        from rocksplicator_tpu.compaction_remote import (
+            RemoteCompactionManager, RemoteDispatchPolicy)
+
+        mgr = RemoteCompactionManager(
+            name, db, self._client(), self.store_uri,
+            policy=RemoteDispatchPolicy(
+                enabled=True, size_floor_bytes=0, deadline_s=30.0,
+                claim_wait_s=5.0, heartbeat_timeout_s=5.0,
+                poll_interval_s=0.02),
+            epoch_provider=lambda: 1)
+        db.set_remote_compactor(mgr)
+        return mgr
+
+    def close(self) -> None:
+        self._stop.set()
+        for c in self._clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        self.server.stop()
+
+
+def run_remote_phase(root: str, mode: str, args, seed: int,
+                     tier: _RemoteTier) -> Dict:
+    """One arm of the tier on/off A/B: fresh db, preload, open-loop
+    mixed load with background compaction, settle, then read where the
+    compaction output bytes were written — serving node (local) or
+    worker tier (offloaded)."""
+    from rocksplicator_tpu.storage.engine import DB, DBOptions
+    from rocksplicator_tpu.storage.records import WriteBatch
+
+    opts = DBOptions(
+        background_compaction=True,
+        # scheduler pinned off in BOTH arms: the remote A/B measures
+        # where the merge ran, not which pick policy chose it
+        compaction_scheduler=False,
+        memtable_bytes=args.memtable_kb * 1024,
+        level0_compaction_trigger=4,
+        level0_slowdown_writes_trigger=8,
+        level0_stop_writes_trigger=16,
+        target_file_bytes=args.target_file_kb * 1024,
+        max_bytes_for_level_base=args.level_base_kb * 1024,
+    )
+    db = DB(os.path.join(root, f"db-{mode}-{seed}"), opts)
+    mgr = None
+    try:
+        if mode == "tier_on":
+            mgr = tier.attach(db, f"bench{mode}{seed}")
+        batch = None
+        for gid in range(args.keys):
+            if batch is None:
+                batch = WriteBatch()
+            batch.put(key_of(gid), preload_value(gid, args.value_bytes))
+            if batch.count() >= 64:
+                db.write(batch)
+                batch = None
+        if batch is not None:
+            db.write(batch)
+        db.flush()
+
+        mix = parse_mix(args.mix)
+        arrivals = poisson_arrivals(args.rate, args.duration, seed)
+        ops = op_stream(mix, len(arrivals), seed + 1)
+        zipf = ZipfianGenerator(args.keys, seed=seed + 2)
+        gids = [zipf.next() for _ in arrivals]
+        lat: Dict[str, List[float]] = {"get": [], "put": []}
+        errors = {"get": 0, "put": 0}
+        mismatches = [0]
+        lat_lock = threading.Lock()
+
+        def one_op(intended: float, op: str, gid: int) -> None:
+            try:
+                if op == "put":
+                    db.write(WriteBatch().put(
+                        key_of(gid), put_value(gid, args.value_bytes)))
+                else:
+                    got = db.get(key_of(gid))
+                    if got not in (preload_value(gid, args.value_bytes),
+                                   put_value(gid, args.value_bytes)):
+                        with lat_lock:
+                            mismatches[0] += 1
+            except Exception:
+                with lat_lock:
+                    errors[op] += 1
+                return
+            done = time.monotonic()
+            with lat_lock:
+                lat[op].append((done - intended) * 1000.0)
+
+        pool = ThreadPoolExecutor(max_workers=args.workers,
+                                  thread_name_prefix=f"crb-{mode}")
+        t0 = time.monotonic()
+        futs = []
+        for off, op, gid in zip(arrivals, ops, gids):
+            delay = (t0 + off) - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            futs.append(pool.submit(one_op, t0 + off, op, gid))
+        for f in futs:
+            f.result()
+        phase_sec = time.monotonic() - t0
+        pool.shutdown()
+        time.sleep(args.settle)
+
+        # zero acked-write loss across the offloaded installs
+        for gid in range(0, args.keys, max(1, args.keys // 128)):
+            got = db.get(key_of(gid))
+            if got not in (preload_value(gid, args.value_bytes),
+                           put_value(gid, args.value_bytes)):
+                mismatches[0] += 1
+
+        snap = db.metrics_snapshot(max_age=0.0)
+        gets = sorted(lat["get"])
+        puts = sorted(lat["put"])
+        return {
+            "mode": mode,
+            "offered_per_sec": args.rate,
+            "duration_sec": round(phase_sec, 2),
+            "achieved_per_sec": round(
+                (len(gets) + len(puts)) / max(phase_sec, 1e-6), 1),
+            "get_count": len(gets),
+            "put_count": len(puts),
+            "errors": dict(errors),
+            "value_mismatches": mismatches[0],
+            "get_p50_ms": round(percentile(gets, 50), 3) if gets else None,
+            "get_p99_ms": round(percentile(gets, 99), 3) if gets else None,
+            "put_p99_ms": round(percentile(puts, 99), 3) if puts else None,
+            "local_output_bytes": int(snap["bytes_compacted_local_total"]),
+            "remote_offloaded_bytes": int(
+                snap["remote_offloaded_bytes_total"]),
+            "tier": (mgr.counters() if mgr is not None else None),
+        }
+    finally:
+        db.close()
+
+
+class _BenchPick:
+    kind, level, score, reason = "l0", 0, 2.0, "bench"
+
+
+def run_remote_determinism(root: str, args, tier: _RemoteTier) -> Dict:
+    """Byte-identical installed generations: the SAME deterministic
+    load compacted through the worker tier vs through the local path —
+    the sorted sha256 set of live SSTs and the full iterator content
+    hash must both match (same merge code, same parameters, so same
+    bytes; this section proves it end to end through the object-store
+    round trip)."""
+    import hashlib
+
+    from rocksplicator_tpu.compaction_remote import file_checksum
+    from rocksplicator_tpu.storage.engine import DB, DBOptions
+    from rocksplicator_tpu.storage.records import WriteBatch
+
+    def build(tag: str):
+        db = DB(os.path.join(root, f"det-{tag}"), DBOptions(
+            memtable_bytes=8 * 1024, level0_compaction_trigger=100,
+            background_compaction=False,
+            target_file_bytes=args.target_file_kb * 1024))
+        n = max(256, args.keys // 8)
+        for gid in range(n):
+            db.write(WriteBatch().put(
+                key_of(gid), put_value(gid, args.value_bytes)))
+            if gid % 50 == 0:
+                db.flush()
+        for gid in range(0, n, 7):
+            db.write(WriteBatch().delete(key_of(gid)))
+        db.flush()
+        return db
+
+    def files_sha(db) -> List[str]:
+        return sorted(
+            file_checksum(os.path.join(db.path, name))
+            for level in db._levels for name in level)
+
+    def content_sha(db) -> str:
+        h = hashlib.sha256()
+        for k, v in db.new_iterator():
+            h.update(k)
+            h.update(v)
+        return h.hexdigest()
+
+    db_remote = build("remote")
+    db_local = build("local")
+    try:
+        mgr = tier.attach(db_remote, "benchdet")
+        outcome = mgr.maybe_offload(_BenchPick())
+        db_local.compact_range()
+        remote_files = files_sha(db_remote)
+        local_files = files_sha(db_local)
+        return {
+            "outcome": outcome,
+            "files": len(remote_files),
+            "file_checksums_equal": remote_files == local_files,
+            "content_checksums_equal":
+                content_sha(db_remote) == content_sha(db_local),
+        }
+    finally:
+        db_remote.close()
+        db_local.close()
+
+
+def remote_ab_failures(samples: Dict[str, List[Dict]],
+                       det: Dict) -> List[str]:
+    """Loud gates for the tier on/off A/B: both arms completed with a
+    get p99 and zero mismatches; the tier-on arm actually offloaded and
+    its serving-node output bytes went to ~0 (the acceptance criterion);
+    the tier-off arm offloaded nothing; the installed generations are
+    byte-identical to the local path."""
+    failures: List[str] = []
+    for mode in ("tier_off", "tier_on"):
+        if not samples.get(mode):
+            failures.append(f"no completed {mode} rep")
+    for mode, reps_data in samples.items():
+        for s in reps_data:
+            if s["value_mismatches"]:
+                failures.append(
+                    f"{mode}: {s['value_mismatches']} reads outside the "
+                    f"deterministic value set (acked-write loss)")
+            if s["get_p99_ms"] is None:
+                failures.append(f"{mode}: no get p99 recorded")
+    for s in samples.get("tier_on") or []:
+        total = s["remote_offloaded_bytes"] + s["local_output_bytes"]
+        if s["remote_offloaded_bytes"] <= 0:
+            failures.append("tier_on rep offloaded zero bytes")
+        elif s["local_output_bytes"] > 0.1 * total:
+            failures.append(
+                f"tier_on serving-node output bytes not ~0 "
+                f"({s['local_output_bytes']} local of {total} total)")
+    for s in samples.get("tier_off") or []:
+        if s["remote_offloaded_bytes"]:
+            failures.append("tier_off rep recorded offloaded bytes")
+    if det.get("outcome") != "installed":
+        failures.append(
+            f"determinism section did not install remotely "
+            f"({det.get('outcome')!r})")
+    if not det.get("file_checksums_equal"):
+        failures.append(
+            "remote-installed SSTs differ byte-for-byte from the "
+            "local path's")
+    if not det.get("content_checksums_equal"):
+        failures.append(
+            "remote-installed content differs from the local path's")
+    return failures
+
+
+def run_remote_ab(args) -> int:
+    """``--remote_ab``: interleaved tier-on/off under the same mixed
+    load, plus the byte-identical determinism section. Artifact:
+    benchmarks/results/compaction_remote_r18.json (full run)."""
+    import shutil
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="rstpu-compact-remote-")
+    t0 = time.monotonic()
+    result: Dict = {
+        "bench": "compaction_remote",
+        "config": {
+            "keys": args.keys, "value_bytes": args.value_bytes,
+            "rate": args.rate, "duration": args.duration,
+            "mix": args.mix, "reps": args.reps,
+            "workers": args.workers, "memtable_kb": args.memtable_kb,
+            "target_file_kb": args.target_file_kb,
+            "level_base_kb": args.level_base_kb,
+            "settle": args.settle, "seed": args.seed,
+            "note": ("disaggregated compaction A/B: same offered load, "
+                     "tier on vs off; tier-on serving-node compaction "
+                     "output bytes must go to ~0 with the merge running "
+                     "on the stateless worker"),
+        },
+        "host_calibration": host_calibration(root),
+    }
+    tier = _RemoteTier(root)
+    rep_counter = [0]
+
+    def variant(mode: str):
+        def run() -> Dict:
+            rep_counter[0] += 1
+            seed = args.seed + 101 * rep_counter[0]
+            return run_remote_phase(root, mode, args, seed, tier)
+        return run
+
+    try:
+        # baseline FIRST (ratio_vs_tier_off reads naturally); lower get
+        # p99 is better — the tier must not cost serving latency
+        result["ab"] = run_interleaved(
+            [("tier_off", variant("tier_off")),
+             ("tier_on", variant("tier_on"))],
+            reps=args.reps, key="get_p99_ms", higher_is_better=False,
+            log=log)
+        result["determinism"] = run_remote_determinism(root, args, tier)
+    finally:
+        tier.close()
+        shutil.rmtree(root, ignore_errors=True)
+    result["elapsed_sec"] = round(time.monotonic() - t0, 1)
+    result["failures"] = remote_ab_failures(
+        result["ab"]["samples"], result["determinism"])
+
+    rc = emit_gated_artifact(result, args.out, "compaction_remote", log)
+    if rc:
+        return rc
+    summ = result["ab"]["summary"]
+    on = (result["ab"]["samples"].get("tier_on") or [{}])[-1]
+    log(f"compaction_remote: get p99 tier_off="
+        f"{(summ.get('tier_off') or {}).get('median')}ms tier_on="
+        f"{(summ.get('tier_on') or {}).get('median')}ms; tier_on "
+        f"local={on.get('local_output_bytes')}B "
+        f"offloaded={on.get('remote_offloaded_bytes')}B")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--keys", type=int, default=8000)
@@ -372,8 +712,17 @@ def main(argv=None) -> int:
                         "one-shot compaction (4 overlapping L0 runs = "
                         "4x this many entries)")
     p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--remote_ab", action="store_true",
+                   help="run the round-18 disaggregated-compaction "
+                        "tier on/off A/B instead of the scheduler A/B: "
+                        "same mixed load, compaction merges offloaded "
+                        "to an in-process stateless worker via the job "
+                        "ledger; gates tier-on local output bytes ~0 "
+                        "and byte-identical installed generations")
     p.add_argument("--out")
     args = p.parse_args(argv)
+    if args.remote_ab:
+        return run_remote_ab(args)
 
     import shutil
     import tempfile

@@ -143,6 +143,11 @@ class Spectator:
                     # move ledger — surfacing it here is what lets an
                     # operator watch a move from /cluster_stats
                     stats["shard_moves"] = self._shard_moves()
+                    # disaggregated compaction tier (round 18): live
+                    # job ledger state — which shards have a published/
+                    # claimed job, which worker holds it, heartbeat age
+                    stats["remote_compactions"] = \
+                        self._remote_compactions()
                     self.cluster_stats = stats
                 if not endpoint_registered:
                     # serve /cluster_stats off this process's status
@@ -187,6 +192,23 @@ class Spectator:
             except (ValueError, UnicodeDecodeError):
                 counters = {}
         return {"active": active, "counters": counters}
+
+    def _remote_compactions(self) -> dict:
+        """Per-db remote compaction job state from the job ledger
+        (jobs published/claimed + worker liveness) plus the cluster-
+        lifetime published/claimed/installed/failed_over/fenced/reaped
+        counters — the operator's /cluster_stats view of the
+        disaggregated worker tier."""
+        from ..compaction_remote.queue import CompactionJobQueue
+
+        queue = CompactionJobQueue(self.coord)
+        try:
+            active = queue.active_jobs()
+        except Exception:
+            log.debug("remote-compaction ledger scan failed",
+                      exc_info=True)
+            active = {}
+        return {"active": active, "counters": queue.read_summary()}
 
     def cluster_stats_json(self) -> str:
         import json

@@ -1,0 +1,41 @@
+"""Disaggregated compaction worker tier (round 18).
+
+Leaders publish compaction jobs into a durable coordinator-backed
+ledger (``/compactions/<db>``); stateless workers claim exactly one job
+at a time, fetch the immutable input SSTs from the object store, run
+the round-17 bounded-memory streaming merge, and upload output SSTs
+plus a checksummed result manifest. The publishing leader verifies
+checksums and installs the new generation atomically through the
+engine's existing ``plan_full_compaction`` / ``install_full_compaction``
+seams — rejecting any result whose job epoch is stale, so a deposed
+leader's in-flight job can never install (the round-11 fencing rule
+extended to compaction). Serving correctness never depends on the tier
+being up: if no worker claims within the claim window, a worker dies
+mid-job (heartbeat expiry), a checksum mismatches, or the deadline
+passes, the pick falls back to the unchanged local compaction path.
+
+Module map:
+
+- :mod:`.jobs`     — job / result codecs + sha256 file manifests
+- :mod:`.queue`    — the coordinator ledger (publish/claim/heartbeat/result)
+- :mod:`.worker`   — the stateless merge worker (``tools/compaction_worker``)
+- :mod:`.install`  — leader-side publish → await → verify → fenced install
+- :mod:`.dispatch` — env-knob dispatch policy (``RSTPU_COMPACT_REMOTE``)
+"""
+
+from .dispatch import RemoteDispatchPolicy
+from .install import RemoteCompactionManager
+from .jobs import CompactionJob, JobResult, file_checksum
+from .queue import CompactionJobQueue, JobInFlightError
+from .worker import CompactionWorker
+
+__all__ = [
+    "CompactionJob",
+    "CompactionJobQueue",
+    "CompactionWorker",
+    "JobInFlightError",
+    "JobResult",
+    "RemoteCompactionManager",
+    "RemoteDispatchPolicy",
+    "file_checksum",
+]

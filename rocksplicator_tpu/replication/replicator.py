@@ -16,6 +16,7 @@ cancel + flag).
 
 from __future__ import annotations
 
+import logging
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional, Tuple
@@ -32,6 +33,8 @@ from .db_wrapper import DbWrapper
 from .handler import ReplicatorHandler
 from .replicated_db import LeaderResolver, ReplicatedDB, ReplicationFlags
 from .wire import ReplicaRole
+
+log = logging.getLogger(__name__)
 
 DEFAULT_REPLICATOR_PORT = 9091
 _EXECUTOR_THREADS = 16  # reference: ≥16 CPU threads (rocksdb_replicator.cpp:58-67)
@@ -139,7 +142,33 @@ class Replicator:
             rdb.stop()
             raise
         self._register_shard_gauges(name, rdb, wrapper)
+        self._maybe_attach_remote_compactor(name, rdb, wrapper)
         return rdb
+
+    def _maybe_attach_remote_compactor(self, name: str, rdb: ReplicatedDB,
+                                       wrapper: DbWrapper) -> None:
+        """Round 18: when the environment opts in (RSTPU_COMPACT_REMOTE
+        + coordinator endpoint + store URI), hook this shard's engine
+        into the disaggregated compaction tier — pressure picks above
+        the size floor publish to the job ledger instead of merging on
+        the serving node. The epoch provider reads the shard's LIVE
+        fencing epoch, so a job published before a deposition is
+        rejected at install (the round-11 fencing rule extended to
+        compaction). The ledger key is name@port: unique per replica,
+        since every replica compacts independently. Never fatal — the
+        tier is an optimization, serving never depends on it."""
+        engine = wrapper.gauge_target()
+        if engine is None:
+            return
+        try:
+            from ..compaction_remote.dispatch import attach_from_env
+
+            rdb._remote_compaction_mgr = attach_from_env(
+                f"{name}@{self.port}", engine,
+                epoch_provider=lambda: rdb.epoch)
+        except Exception:
+            log.exception("remote-compaction attach failed for %s", name)
+            rdb._remote_compaction_mgr = None
 
     def _register_shard_gauges(self, name: str, rdb: ReplicatedDB,
                                wrapper: DbWrapper) -> None:
@@ -178,6 +207,12 @@ class Replicator:
             raise KeyError(f"no such db: {name}")
         rdb.stop()
         self._unregister_shard_gauges(rdb)
+        mgr = getattr(rdb, "_remote_compaction_mgr", None)
+        if mgr is not None:
+            from ..compaction_remote.dispatch import detach
+
+            detach(rdb.wrapper.gauge_target(), mgr)
+            rdb._remote_compaction_mgr = None
         self._dbs.remove(name)
 
     def get_db(self, name: str) -> Optional[ReplicatedDB]:

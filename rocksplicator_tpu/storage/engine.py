@@ -280,6 +280,15 @@ class DB:
         self._files_consulted_total = 0
         self._bytes_flushed_total = 0
         self._bytes_compacted_total = 0
+        # split of bytes_compacted_total by WHERE the merge ran: bytes a
+        # remote worker produced (round 18 disaggregated tier) vs bytes
+        # this serving node's own compactions wrote. local = total -
+        # remote; the macro-bench acceptance drives local → ~0 tier-on.
+        self._remote_offloaded_bytes_total = 0
+        # round 18: when set (set_remote_compactor), non-manual picks
+        # offer themselves to the disaggregated worker tier before the
+        # local compaction dispatch
+        self._remote_compactor = None
         # high-water of live compaction lane bytes during the most
         # recent direct/streaming merge (stream_merge.MemTracker) —
         # the compaction.peak_bytes_materialized gauge the memory
@@ -1128,10 +1137,26 @@ class DB:
                     for f in manual_futs:
                         if not f.done():
                             f.set_result(None)
-                elif pick.kind == "level":
-                    self._compact_level_bg(pick.level)
                 else:
-                    self._compact_level0_bg()
+                    # round 18: offer non-manual picks to the
+                    # disaggregated worker tier first. "installed" — the
+                    # pick is satisfied remotely; "fenced" — this leader
+                    # was deposed mid-job, so neither the remote result
+                    # nor a local merge may run (surfaced as a bg error,
+                    # same backoff as any failed compaction); "declined"
+                    # — the unchanged local path below is the fallback.
+                    handled = "declined"
+                    if self._remote_compactor is not None:
+                        handled = self._remote_compactor.maybe_offload(pick)
+                    if handled == "fenced":
+                        raise StorageError(
+                            "remote compaction fenced: leader epoch "
+                            "stale — refusing local fallback")
+                    if handled != "installed":
+                        if pick.kind == "level":
+                            self._compact_level_bg(pick.level)
+                        else:
+                            self._compact_level0_bg()
                 with self._lock:
                     self._bg_compaction_error = None
                     self._bg_compaction_failures = 0
@@ -1283,13 +1308,20 @@ class DB:
                     wal_purge_ms=round((t3 - t2) * 1e3, 3),
                 )
 
-    def _note_compacted_locked(self, out_names: List[str]) -> None:
+    def _note_compacted_locked(self, out_names: List[str],
+                               remote: bool = False) -> None:
         """Write-amp accounting at a compaction install sink: bytes
         WRITTEN by the compaction (its outputs). Caller holds self._lock
-        and has already registered readers for ``out_names``."""
-        self._bytes_compacted_total += sum(
+        and has already registered readers for ``out_names``. ``remote``
+        marks bytes a disaggregated worker produced, which count toward
+        write-amp (the generation exists either way) but not toward the
+        serving node's local compaction output gauge."""
+        out_bytes = sum(
             self._readers[n].file_size for n in out_names
             if n in self._readers)
+        self._bytes_compacted_total += out_bytes
+        if remote:
+            self._remote_offloaded_bytes_total += out_bytes
 
     def _compact_level0_bg(self) -> None:
         """L0→L1 compaction with the merge OUTSIDE the DB lock. Safe
@@ -1738,6 +1770,7 @@ class DB:
     def install_full_compaction(self, plan: dict, entries=None,
                                 files: Optional[List[str]] = None,
                                 arrays: Optional[Tuple[dict, int]] = None,
+                                remote: bool = False,
                                 ) -> None:
         """Swap in a plan's externally-merged outputs (manifest first,
         then input GC — the compact_range crash-safety order). Outputs
@@ -1775,7 +1808,7 @@ class DB:
                         n for n in level_files if n not in input_set]
                 bottom = plan["bottom"]
                 self._levels[bottom] = out_names + self._levels[bottom]
-                self._note_compacted_locked(out_names)
+                self._note_compacted_locked(out_names, remote=remote)
                 self._fences.clear()
                 self._persist_manifest()
                 self._gc_files(plan["inputs"])
@@ -1816,6 +1849,14 @@ class DB:
         """Release a plan without installing (external merge declined or
         failed); the DB is untouched and compact_range remains safe."""
         self._compaction_mutex.release()
+
+    def set_remote_compactor(self, manager) -> None:
+        """Attach (or detach with None) a disaggregated-compaction
+        manager (compaction_remote.RemoteCompactionManager). Non-manual
+        background picks then publish to the worker tier before falling
+        back to the local merge — see _compaction_loop."""
+        with self._lock:
+            self._remote_compactor = manager
 
     def _remove_dead_files(
         self, dead: List[Tuple[str, Optional[SSTReader]]]
@@ -1935,6 +1976,7 @@ class DB:
             consulted = self._files_consulted_total
             flushed = self._bytes_flushed_total
             compacted = self._bytes_compacted_total
+            remote_offloaded = self._remote_offloaded_bytes_total
             compaction_peak = self._compaction_peak_bytes
         # WAL backlog sized OUTSIDE the lock (directory listing is IO);
         # the segment set is append/purge-only so a racing purge at
@@ -1962,6 +2004,8 @@ class DB:
             "files_consulted_total": consulted,
             "bytes_flushed_total": flushed,
             "bytes_compacted_total": compacted,
+            "bytes_compacted_local_total": compacted - remote_offloaded,
+            "remote_offloaded_bytes_total": remote_offloaded,
             "compaction_peak_bytes_materialized": compaction_peak,
         }
         self._metrics_cache = (now, snap)
@@ -2243,6 +2287,11 @@ DB_SCALAR_GAUGES = {
     # ceiling proof (stream_merge.CompactionMemoryBudget)
     "compaction.peak_bytes_materialized":
         "compaction_peak_bytes_materialized",
+    # disaggregated tier (round 18): the serving-shaped pair — output
+    # bytes this node's own compactions wrote vs bytes workers produced.
+    # Tier-on acceptance drives local_output_bytes → ~0.
+    "compaction.local_output_bytes": "bytes_compacted_local_total",
+    "compaction.remote_offloaded_bytes": "remote_offloaded_bytes_total",
 }
 _LEVEL_GAUGE_KEYS = {
     "storage.level_files": "level_files",

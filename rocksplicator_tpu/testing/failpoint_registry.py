@@ -67,4 +67,15 @@ REGISTRY = {
     "move.catchup": "shard-move WAL-tail catch-up phase failure",
     "move.flip": "shard-move epoch-bumped cutover phase failure",
     "move.retire": "shard-move source-retire phase failure",
+    # disaggregated compaction tier (round 18): one seam per handoff —
+    # arming fail_nth:1 kills the exchange at that boundary. Leader-side
+    # faults (publish/install) fall back to the unchanged local merge;
+    # worker-side faults (claim/fetch/upload/heartbeat) fail the job or
+    # make the worker look dead, so the leader reaps + republishes.
+    "compact.remote.publish": "compaction job ledger publish failure",
+    "compact.remote.claim": "worker job-claim failure at the ledger",
+    "compact.remote.fetch": "worker input-SST fetch failure",
+    "compact.remote.upload": "worker output-SST upload failure",
+    "compact.remote.install": "leader-side verified-install failure",
+    "compact.remote.heartbeat": "worker liveness heartbeat failure",
 }

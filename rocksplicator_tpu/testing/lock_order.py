@@ -58,7 +58,7 @@ RANKS = {
     "rocksplicator_tpu/replication/replicated_db.py:132": ('ReplicatedDB._epoch_lock', 38),
     "rocksplicator_tpu/replication/replicated_db.py:161": ('ReplicatedDB._expiry_lock', 39),
     "rocksplicator_tpu/replication/replicated_db.py:241": ('ReplicatedDB._write_traces_lock', 40),
-    "rocksplicator_tpu/replication/replicator.py:42": ('Replicator._instance_lock', 41),
+    "rocksplicator_tpu/replication/replicator.py:45": ('Replicator._instance_lock', 41),
     "rocksplicator_tpu/utils/retry_policy.py:57": ('RetryBudget._lock', 42),
     "rocksplicator_tpu/utils/s3_stub.py:48": ('S3StubServer.lock', 43),
     "rocksplicator_tpu/observability/collector.py:47": ('SpanCollector._instance_lock', 44),

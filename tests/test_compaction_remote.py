@@ -1,0 +1,789 @@
+"""Disaggregated compaction worker tier (round 18).
+
+Covers the job/result codecs, the ledger protocol (one-job lock,
+duplicate claim loses, heartbeat expiry → reap = republish), the
+worker's fetch-merge-upload loop (byte-identical to the local merge),
+and the leader's fenced install contract: stale-epoch reject,
+checksum-mismatch reject with output sweep + local fallback, automatic
+local fallback when no worker claims, idempotent recovery after a
+leader crash mid-job, and each failpoint seam
+("compact.remote.publish", "compact.remote.claim",
+"compact.remote.fetch", "compact.remote.upload",
+"compact.remote.install", "compact.remote.heartbeat").
+"""
+
+import json
+import os
+import struct
+import threading
+import time
+
+import pytest
+
+from rocksplicator_tpu.cluster.coordinator import (CoordinatorClient,
+                                                   CoordinatorServer)
+from rocksplicator_tpu.compaction_remote import (CompactionJob,
+                                                 CompactionJobQueue,
+                                                 CompactionWorker,
+                                                 JobInFlightError, JobResult,
+                                                 RemoteCompactionManager,
+                                                 RemoteDispatchPolicy,
+                                                 file_checksum)
+from rocksplicator_tpu.compaction_remote import install as install_mod
+from rocksplicator_tpu.storage.engine import DB, DBOptions
+from rocksplicator_tpu.storage.records import WriteBatch
+from rocksplicator_tpu.testing import failpoints as fp
+
+pack_u64 = struct.Struct(">Q").pack
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture
+def coord_pair(tmp_path):
+    server = CoordinatorServer(port=0, session_ttl=5.0)
+    clients = []
+
+    def make():
+        c = CoordinatorClient("127.0.0.1", server.port)
+        clients.append(c)
+        return c
+
+    make.server = server
+    try:
+        yield make
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        server.stop()
+
+
+def open_db(path, **over):
+    opts = dict(memtable_bytes=16 * 1024, level0_compaction_trigger=100,
+                background_compaction=False, target_file_bytes=1 << 20)
+    opts.update(over)
+    return DB(str(path), DBOptions(**opts))
+
+
+def load_db(db, n=300, prefix=b"k", deletes=True):
+    for i in range(n):
+        b = WriteBatch()
+        b.put(prefix + b"%06d" % i, pack_u64(i) * 4)
+        db.write(b)
+        if i % 60 == 0:
+            db.flush()
+    if deletes:
+        for i in range(0, n, 7):
+            b = WriteBatch()
+            b.delete(prefix + b"%06d" % i)
+            db.write(b)
+    db.flush()
+
+
+def expected_view(db, n=300, prefix=b"k"):
+    out = {}
+    for i in range(n):
+        k = prefix + b"%06d" % i
+        out[k] = db.get(k)
+    return out
+
+
+def make_tier(tmp_path, coord_make, db, db_name="db0", epoch=lambda: 1,
+              policy=None, start_worker=True):
+    """Leader-side manager + (optionally) a live worker thread."""
+    store_uri = f"local://{tmp_path}/store"
+    policy = policy or RemoteDispatchPolicy(
+        enabled=True, size_floor_bytes=0, deadline_s=30.0,
+        claim_wait_s=5.0, heartbeat_timeout_s=5.0)
+    mgr = RemoteCompactionManager(
+        db_name, db, coord_make(), store_uri, policy=policy,
+        epoch_provider=epoch)
+    stop = threading.Event()
+    worker = thread = None
+    if start_worker:
+        worker = CompactionWorker(
+            coord_make(), str(tmp_path / "wk"), worker_id="wk-1",
+            poll_interval=0.05)
+        thread = threading.Thread(
+            target=worker.serve_forever, args=(stop,), daemon=True)
+        thread.start()
+    return mgr, worker, stop
+
+
+class FakePick:
+    kind = "l0"
+    level = 0
+    score = 2.0
+    reason = "test"
+
+
+# ---------------------------------------------------------------------------
+# codecs
+# ---------------------------------------------------------------------------
+
+
+def test_job_codec_roundtrip():
+    job = CompactionJob(
+        job_id="j1", db_name="db0", epoch=7, store_uri="local:///tmp/s",
+        inputs=[{"name": "a.sst", "key": "k/a", "checksum": "c" * 64,
+                 "bytes": 123}],
+        bottom=3, drop_tombstones=False, merge_operator="uint64add",
+        memory_budget_bytes=1 << 20, deadline_ms=5000, published_ms=99)
+    back = CompactionJob.decode(job.encode())
+    assert back == job
+    assert back.input_bytes == 123
+    # decode drops unknown fields (version-skew tolerance)
+    data = json.loads(job.encode())
+    data["future_field"] = True
+    assert CompactionJob.decode(json.dumps(data).encode()) == job
+
+
+def test_result_codec_roundtrip():
+    res = JobResult(job_id="j1", db_name="db0", epoch=7, worker_id="w",
+                    status="failed", error="boom",
+                    outputs=[{"name": "o.sst", "key": "k/o",
+                              "checksum": "d" * 64, "bytes": 5}])
+    assert JobResult.decode(res.encode()) == res
+
+
+def test_file_checksum_is_sha256(tmp_path):
+    p = tmp_path / "f"
+    p.write_bytes(b"hello world")
+    import hashlib
+
+    assert file_checksum(str(p)) == hashlib.sha256(b"hello world").hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# ledger protocol
+# ---------------------------------------------------------------------------
+
+
+def test_publish_is_one_job_lock_and_duplicate_claim_loses(coord_pair):
+    q = CompactionJobQueue(coord_pair())
+    job = CompactionJob(job_id="j1", db_name="db0", epoch=1,
+                        store_uri="local:///x")
+    q.publish(job)
+    with pytest.raises(JobInFlightError):
+        q.publish(job)
+    assert q.list_open_jobs() == ["db0"]
+    won = q.claim("db0", "worker-A")
+    assert won is not None and won.job_id == "j1"
+    # duplicate claim loses — returns None, never raises
+    assert q.claim("db0", "worker-B") is None
+    assert q.claim_holder("db0") == "worker-A"
+    assert q.list_open_jobs() == []
+    # heartbeat landed at claim time
+    assert q.heartbeat_age_ms("db0") is not None
+    q.remove("db0")
+    assert q.get_job("db0") is None
+
+
+def test_reap_claim_republishes(coord_pair):
+    q = CompactionJobQueue(coord_pair())
+    q.publish(CompactionJob(job_id="j2", db_name="db0", epoch=1,
+                            store_uri="local:///x"))
+    assert q.claim("db0", "dead-worker") is not None
+    q.reap_claim("db0")
+    # the job node survives the reap: next scan re-offers it
+    assert q.list_open_jobs() == ["db0"]
+    live = q.claim("db0", "live-worker")
+    assert live is not None and live.job_id == "j2"
+    assert q.read_summary().get("reaped", 0) >= 1
+
+
+def test_active_jobs_surface(coord_pair):
+    q = CompactionJobQueue(coord_pair())
+    q.publish(CompactionJob(job_id="j3", db_name="db0", epoch=4,
+                            store_uri="local:///x",
+                            inputs=[{"name": "a", "key": "k",
+                                     "checksum": "c", "bytes": 10}]))
+    jobs = q.active_jobs()
+    assert jobs["db0"]["phase"] == "published"
+    assert jobs["db0"]["epoch"] == 4
+    assert jobs["db0"]["input_bytes"] == 10
+    q.claim("db0", "w1")
+    assert q.active_jobs()["db0"]["phase"] == "claimed"
+    assert q.active_jobs()["db0"]["worker"] == "w1"
+
+
+# ---------------------------------------------------------------------------
+# end to end: offload → worker merge → verified fenced install
+# ---------------------------------------------------------------------------
+
+
+def test_remote_compaction_end_to_end(tmp_path, coord_pair):
+    db = open_db(tmp_path / "db")
+    load_db(db)
+    want = expected_view(db)
+    files_before = sum(db.metrics_snapshot(max_age=0)["level_files"])
+    assert files_before > 1
+    mgr, worker, stop = make_tier(tmp_path, coord_pair, db)
+    try:
+        assert mgr.maybe_offload(FakePick()) == "installed"
+    finally:
+        stop.set()
+    snap = db.metrics_snapshot(max_age=0)
+    # the serving-shaped split: this node wrote ~0 compaction output
+    # bytes; the worker produced the whole generation
+    assert snap["remote_offloaded_bytes_total"] > 0
+    assert snap["bytes_compacted_local_total"] == 0
+    assert expected_view(db) == want
+    # reopen: the installed generation is durable and consistent
+    db.close()
+    db2 = open_db(tmp_path / "db")
+    try:
+        assert expected_view(db2) == want
+    finally:
+        db2.close()
+    assert worker.jobs_done == 1
+    assert mgr.installed == 1
+    # ledger and transfer objects swept
+    assert mgr._queue.get_job("db0") is None
+    assert mgr._store.list_objects("compactions/db0/") == []
+
+
+def test_remote_matches_local_byte_identical(tmp_path, coord_pair):
+    """Same inputs → remote path and local compact_range install
+    sha256-identical generations (the acceptance determinism gate)."""
+    db_a = open_db(tmp_path / "a")
+    db_b = open_db(tmp_path / "b")
+    for d in (db_a, db_b):
+        load_db(d)
+    mgr, _worker, stop = make_tier(tmp_path, coord_pair, db_a)
+    try:
+        assert mgr.maybe_offload(FakePick()) == "installed"
+    finally:
+        stop.set()
+    db_b.compact_range()
+
+    def gen_checksums(db):
+        snap = db.metrics_snapshot(max_age=0)
+        assert sum(snap["level_files"]) > 0
+        return sorted(
+            file_checksum(os.path.join(db.path, n))
+            for level in db._levels for n in level)
+
+    try:
+        assert gen_checksums(db_a) == gen_checksums(db_b)
+    finally:
+        db_a.close()
+        db_b.close()
+
+
+def test_no_worker_falls_back_local(tmp_path, coord_pair):
+    db = open_db(tmp_path / "db")
+    load_db(db, n=120)
+    want = expected_view(db, n=120)
+    policy = RemoteDispatchPolicy(enabled=True, size_floor_bytes=0,
+                                  deadline_s=5.0, claim_wait_s=0.3,
+                                  heartbeat_timeout_s=5.0)
+    mgr, _w, _stop = make_tier(tmp_path, coord_pair, db, policy=policy,
+                               start_worker=False)
+    t0 = time.monotonic()
+    assert mgr.maybe_offload(FakePick()) == "declined"
+    assert time.monotonic() - t0 < 4.0  # claim_wait, not deadline
+    assert mgr.failed_over == 1
+    # ledger swept → the local path (run by the engine loop after a
+    # decline) is free to compact
+    assert mgr._queue.get_job("db0") is None
+    db.compact_range()
+    assert expected_view(db, n=120) == want
+    snap = db.metrics_snapshot(max_age=0)
+    assert snap["remote_offloaded_bytes_total"] == 0
+    assert snap["bytes_compacted_local_total"] > 0
+    db.close()
+
+
+def test_size_floor_declines_without_publishing(tmp_path, coord_pair):
+    db = open_db(tmp_path / "db")
+    load_db(db, n=50)
+    policy = RemoteDispatchPolicy(enabled=True, size_floor_bytes=1 << 40,
+                                  claim_wait_s=0.2)
+    mgr, _w, _stop = make_tier(tmp_path, coord_pair, db, policy=policy,
+                               start_worker=False)
+    assert mgr.maybe_offload(FakePick()) == "declined"
+    assert mgr.failed_over == 0  # a floor decline is not a failover
+    db.compact_range()  # plan mutex was released
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# the install contract
+# ---------------------------------------------------------------------------
+
+
+def test_stale_epoch_result_is_fenced(tmp_path, coord_pair):
+    """A result published at epoch E must not install once the current
+    epoch moved past E — and the deposed leader runs NO local fallback."""
+    epoch = {"cur": 1}
+    db = open_db(tmp_path / "db")
+    load_db(db)
+    files_before = [list(level) for level in db._levels]
+    mgr, _worker, stop = make_tier(
+        tmp_path, coord_pair, db, epoch=lambda: epoch["cur"])
+    # depose the leader while the job is in flight: the worker merges
+    # at epoch 1, but by install time the cluster minted epoch 2
+    orig_publish = mgr._queue.publish
+
+    def publish_then_depose(job):
+        orig_publish(job)
+        epoch["cur"] = 2
+
+    mgr._queue.publish = publish_then_depose
+    try:
+        assert mgr.maybe_offload(FakePick()) == "fenced"
+    finally:
+        stop.set()
+    assert mgr.fenced == 1
+    # file generation untouched — nothing installed, nothing compacted
+    assert [list(level) for level in db._levels] == files_before
+    snap = db.metrics_snapshot(max_age=0)
+    assert snap["bytes_compacted_total"] == 0
+    # ledger + objects swept; plan released (compact_range works)
+    assert mgr._queue.get_job("db0") is None
+    assert mgr._store.list_objects("compactions/db0/") == []
+    db.compact_range()
+    db.close()
+
+
+def test_epoch_gate_predicate():
+    assert install_mod._epoch_is_current(5, 5)
+    assert install_mod._epoch_is_current(5, 4)
+    assert not install_mod._epoch_is_current(5, 6)
+
+
+def test_checksum_mismatch_rejects_sweeps_and_falls_back(
+        tmp_path, coord_pair):
+    """A worker result whose bytes don't match its manifest must not
+    install: outputs are swept and the pick falls back locally."""
+    db = open_db(tmp_path / "db")
+    load_db(db)
+    want = expected_view(db)
+    mgr, _worker, stop = make_tier(tmp_path, coord_pair, db)
+    # corrupt every uploaded output AFTER the worker posts its result,
+    # BEFORE the leader downloads: tamper via the store itself
+    orig_get_result = mgr._queue.get_result
+
+    def corrupt_then_return(name):
+        res = orig_get_result(name)
+        if res is not None and res.status == "done":
+            for out in res.outputs:
+                raw = bytearray(mgr._store.get_object_bytes(out["key"]))
+                raw[0] ^= 0xFF
+                mgr._store.put_object_bytes(out["key"], bytes(raw))
+        return res
+
+    mgr._queue.get_result = corrupt_then_return
+    sst_count_before = len(os.listdir(db.path))
+    try:
+        assert mgr.maybe_offload(FakePick()) == "declined"
+    finally:
+        stop.set()
+    assert mgr.failed_over == 1
+    # rejected outputs swept from the db dir (no orphan SSTs)
+    assert len(os.listdir(db.path)) <= sst_count_before
+    # the local fallback path is intact and produces the right data
+    db.compact_range()
+    assert expected_view(db) == want
+    snap = db.metrics_snapshot(max_age=0)
+    assert snap["remote_offloaded_bytes_total"] == 0
+    assert snap["bytes_compacted_local_total"] > 0
+    db.close()
+
+
+def test_worker_heartbeat_expiry_republishes_to_live_worker(
+        tmp_path, coord_pair):
+    """A worker that claims then dies (no heartbeats) is reaped on
+    expiry; the job republishes and a live worker completes it."""
+    db = open_db(tmp_path / "db")
+    load_db(db)
+    want = expected_view(db)
+    policy = RemoteDispatchPolicy(
+        enabled=True, size_floor_bytes=0, deadline_s=30.0,
+        claim_wait_s=10.0, heartbeat_timeout_s=0.4)
+    mgr, worker, stop = make_tier(tmp_path, coord_pair, db, policy=policy,
+                                  start_worker=False)
+    # the dead worker: claims the instant the job appears, then nothing
+    dead_q = CompactionJobQueue(coord_pair())
+
+    def dead_claimer():
+        while not wait_until(lambda: dead_q.list_open_jobs(), timeout=5.0,
+                             interval=0.01):
+            return
+        try:
+            dead_q.claim(dead_q.list_open_jobs()[0], "dead-worker")
+        except Exception:
+            pass
+
+    threading.Thread(target=dead_claimer, daemon=True).start()
+
+    # the live worker starts late, after the reap window
+    live = CompactionWorker(coord_pair(), str(tmp_path / "wk2"),
+                            worker_id="live-worker", poll_interval=0.05)
+    live_stop = threading.Event()
+    threading.Thread(target=live.serve_forever, args=(live_stop,),
+                     daemon=True).start()
+    try:
+        assert mgr.maybe_offload(FakePick()) == "installed"
+    finally:
+        live_stop.set()
+        stop.set()
+    assert mgr.republished >= 1
+    assert live.jobs_done == 1
+    assert expected_view(db) == want
+    db.close()
+
+
+def test_leader_restart_recovery_is_idempotent(tmp_path, coord_pair):
+    """Leader killed between publish and install: reopen is exactly
+    pre-compaction, recover() sweeps the orphan, and the next cycle
+    (publish → install) runs clean — re-install is impossible because
+    no plan survives the crash."""
+    db = open_db(tmp_path / "db")
+    load_db(db)
+    want = expected_view(db)
+    coord = coord_pair()
+    q = CompactionJobQueue(coord)
+    mgr, _w, _stop = make_tier(tmp_path, coord_pair, db,
+                               start_worker=False)
+    # crash mid-job: publish succeeded, leader dies before any await
+    plan = db.plan_full_compaction()
+    assert plan is not None
+    mgr._publish(plan, "deadjob0000beef", 1)
+    db.abort_full_compaction(plan)  # the mutex dies with the process
+    db.close()
+    assert q.get_job("db0") is not None
+
+    # restarted leader: reopen, sweep (BEFORE any worker can claim the
+    # orphan — recover-then-serve is the documented startup order),
+    # verify pre-compaction state
+    db2 = open_db(tmp_path / "db")
+    mgr2, _none, _stop2 = make_tier(tmp_path, coord_pair, db2,
+                                    start_worker=False)
+    mgr2.recover()
+    assert q.get_job("db0") is None
+    assert mgr2._store.list_objects("compactions/db0/") == []
+    assert expected_view(db2) == want
+    # recover() twice is a no-op (idempotent)
+    mgr2.recover()
+    worker2 = CompactionWorker(coord_pair(), str(tmp_path / "wk2"),
+                               worker_id="wk-2", poll_interval=0.05)
+    stop2 = threading.Event()
+    threading.Thread(target=worker2.serve_forever, args=(stop2,),
+                     daemon=True).start()
+    try:
+        assert mgr2.maybe_offload(FakePick()) == "installed"
+    finally:
+        stop2.set()
+    assert expected_view(db2) == want
+    db2.close()
+
+
+def test_ghost_ledger_entry_swept_then_fallback(tmp_path, coord_pair):
+    """A stale job node from a crashed predecessor blocks publish once:
+    the manager sweeps it and declines (local fallback), and the NEXT
+    offload publishes clean."""
+    db = open_db(tmp_path / "db")
+    load_db(db)
+    coord = coord_pair()
+    CompactionJobQueue(coord).publish(CompactionJob(
+        job_id="ghost", db_name="db0", epoch=0, store_uri="local:///x"))
+    mgr, _worker, stop = make_tier(tmp_path, coord_pair, db)
+    try:
+        assert mgr.maybe_offload(FakePick()) == "declined"
+        assert mgr.maybe_offload(FakePick()) == "installed"
+    finally:
+        stop.set()
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# failpoint seams
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seam", [
+    "compact.remote.publish", "compact.remote.install",
+])
+def test_leader_side_seams_fall_back_local(tmp_path, coord_pair, seam):
+    db = open_db(tmp_path / "db")
+    load_db(db, n=120)
+    want = expected_view(db, n=120)
+    mgr, _worker, stop = make_tier(tmp_path, coord_pair, db)
+    fp.activate(seam, "fail_nth:1")
+    try:
+        assert mgr.maybe_offload(FakePick()) == "declined"
+        assert fp.trip_counts().get(seam, 0) >= 1
+    finally:
+        fp.deactivate(seam)
+        stop.set()
+    assert mgr.failed_over == 1
+    # nothing half-installed; local path clean after the fault clears
+    db.compact_range()
+    assert expected_view(db, n=120) == want
+    db.close()
+
+
+@pytest.mark.parametrize("seam", [
+    "compact.remote.fetch", "compact.remote.upload",
+])
+def test_worker_side_seams_fail_job_then_retry_clean(
+        tmp_path, coord_pair, seam):
+    """A worker-side fault fails the job (posted as a failed result →
+    leader falls back); with the fault cleared the same tier completes
+    the next pick."""
+    db = open_db(tmp_path / "db")
+    load_db(db)
+    want = expected_view(db)
+    mgr, worker, stop = make_tier(tmp_path, coord_pair, db)
+    fp.activate(seam, "fail_nth:1")
+    try:
+        assert mgr.maybe_offload(FakePick()) == "declined"
+        assert worker.jobs_failed == 1
+        # retry after clear: the tier works again
+        fp.deactivate(seam)
+        assert mgr.maybe_offload(FakePick()) == "installed"
+    finally:
+        fp.deactivate(seam)
+        stop.set()
+    assert expected_view(db) == want
+    db.close()
+
+
+def test_claim_seam_leaves_job_for_next_scan(coord_pair):
+    q = CompactionJobQueue(coord_pair())
+    q.publish(CompactionJob(job_id="j9", db_name="db0", epoch=1,
+                            store_uri="local:///x"))
+    fp.activate("compact.remote.claim", "fail_nth:1")
+    try:
+        with pytest.raises(OSError):
+            q.claim("db0", "w1")
+    finally:
+        fp.deactivate("compact.remote.claim")
+    # the failed claim held nothing: job still open, a clean claim wins
+    assert q.list_open_jobs() == ["db0"]
+    assert q.claim("db0", "w1") is not None
+
+
+def test_heartbeat_seam_is_absorbed(tmp_path, coord_pair):
+    """Heartbeat faults never kill a worker mid-merge — the loop
+    absorbs them (worst case the leader reaps a live-looking-dead
+    worker, which is safe)."""
+    db = open_db(tmp_path / "db")
+    load_db(db)
+    want = expected_view(db)
+    mgr, worker, stop = make_tier(tmp_path, coord_pair, db)
+    fp.activate("compact.remote.heartbeat", "fail_prob:0.5@seed7")
+    try:
+        assert mgr.maybe_offload(FakePick()) == "installed"
+    finally:
+        fp.deactivate("compact.remote.heartbeat")
+        stop.set()
+    assert expected_view(db) == want
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# engine integration: the background loop offloads picks by itself
+# ---------------------------------------------------------------------------
+
+
+def test_background_loop_offloads_pressure_picks(tmp_path, coord_pair):
+    db = open_db(tmp_path / "db", background_compaction=True,
+                 level0_compaction_trigger=3, memtable_bytes=8 * 1024)
+    mgr, worker, stop = make_tier(tmp_path, coord_pair, db)
+    db.set_remote_compactor(mgr)
+    try:
+        for i in range(400):
+            b = WriteBatch()
+            b.put(b"bg%06d" % i, os.urandom(64))
+            db.write(b)
+        assert wait_until(
+            lambda: db.metrics_snapshot(max_age=0)[
+                "remote_offloaded_bytes_total"] > 0, timeout=30.0)
+        snap = db.metrics_snapshot(max_age=0)
+        assert snap["bytes_compacted_local_total"] == 0
+        for i in range(0, 400, 37):
+            assert db.get(b"bg%06d" % i) is not None
+    finally:
+        stop.set()
+        db.set_remote_compactor(None)
+        db.close()
+
+
+def test_spectator_remote_compactions_section(coord_pair, tmp_path):
+    from rocksplicator_tpu.cluster.publishers import CallbackPublisher
+    from rocksplicator_tpu.cluster.spectator import Spectator
+
+    q = CompactionJobQueue(coord_pair())
+    q.publish(CompactionJob(job_id="jx", db_name="db7", epoch=2,
+                            store_uri="local:///x",
+                            inputs=[{"name": "a", "key": "k",
+                                     "checksum": "c", "bytes": 42}]))
+    spec = Spectator("127.0.0.1", coord_pair.server.port, "c",
+                     [CallbackPublisher(lambda m: None)])
+    try:
+        rc = spec._remote_compactions()
+        assert rc["active"]["db7"]["job_id"] == "jx"
+        assert rc["active"]["db7"]["phase"] == "published"
+        assert rc["counters"].get("published", 0) >= 1
+    finally:
+        spec.stop()
+
+
+# ---------------------------------------------------------------------------
+# remote-A/B artifact shape (the make compaction-remote-smoke contract)
+# ---------------------------------------------------------------------------
+
+
+def test_compaction_remote_ab_artifact_shape(tmp_path):
+    """Tiny in-process run of benchmarks/compaction_bench.py
+    --remote_ab pinning the artifact contract the make target and PERF
+    round 18 rely on: both arms present with a get p99 and zero
+    mismatches, the tier-on arm offloaded with serving-node output
+    bytes ~0, the tier-off arm offloaded nothing, and the determinism
+    section's byte-identical checksums."""
+    from benchmarks.compaction_bench import main as bench_main
+
+    out = tmp_path / "crb.json"
+    rc = bench_main([
+        "--remote_ab", "--keys", "1500", "--rate", "700",
+        "--duration", "1.5", "--reps", "1", "--settle", "0.5",
+        "--memtable_kb", "16", "--target_file_kb", "32",
+        "--level_base_kb", "32", "--workers", "4", "--out", str(out),
+    ])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["bench"] == "compaction_remote"
+    assert art["failures"] == []
+    assert "host_calibration" in art
+    samples = art["ab"]["samples"]
+    for mode in ("tier_on", "tier_off"):
+        assert samples[mode], mode
+        ph = samples[mode][0]
+        assert ph["get_p99_ms"] is not None
+        assert ph["value_mismatches"] == 0
+        assert "local_output_bytes" in ph
+        assert "remote_offloaded_bytes" in ph
+    on = samples["tier_on"][0]
+    total = on["remote_offloaded_bytes"] + on["local_output_bytes"]
+    assert on["remote_offloaded_bytes"] > 0
+    assert on["local_output_bytes"] <= 0.1 * total
+    assert on["tier"]["installed"] > 0
+    off = samples["tier_off"][0]
+    assert off["remote_offloaded_bytes"] == 0
+    assert off["tier"] is None
+    det = art["determinism"]
+    assert det["outcome"] == "installed"
+    assert det["file_checksums_equal"]
+    assert det["content_checksums_equal"]
+
+
+# ---------------------------------------------------------------------------
+# serving-node env wiring (Replicator.add_db -> attach_from_env)
+# ---------------------------------------------------------------------------
+
+
+def test_attach_from_env_gates(tmp_path, monkeypatch):
+    """attach_from_env is strictly opt-in: off by default, and an
+    enable without the coordinator endpoint + store URI stays off
+    (warning, no hook) rather than half-configuring the tier."""
+    from rocksplicator_tpu.compaction_remote.dispatch import \
+        attach_from_env
+
+    for var in ("RSTPU_COMPACT_REMOTE", "RSTPU_COMPACT_COORD",
+                "RSTPU_COMPACT_REMOTE_STORE"):
+        monkeypatch.delenv(var, raising=False)
+    db = DB(str(tmp_path / "db"), DBOptions(background_compaction=False))
+    try:
+        assert attach_from_env("x", db, lambda: 1) is None
+        monkeypatch.setenv("RSTPU_COMPACT_REMOTE", "1")
+        assert attach_from_env("x", db, lambda: 1) is None
+        assert db._remote_compactor is None
+    finally:
+        db.close()
+
+
+def test_attach_from_env_wires_and_detaches(coord_pair, tmp_path,
+                                            monkeypatch):
+    """With the full env set, attach_from_env hooks the engine (and
+    recovers orphans first); an offloaded pick installs through the
+    tier; detach unhooks and closes the owned client."""
+    from rocksplicator_tpu.compaction_remote.dispatch import (
+        attach_from_env, detach)
+
+    monkeypatch.setenv("RSTPU_COMPACT_REMOTE", "1")
+    monkeypatch.setenv("RSTPU_COMPACT_COORD",
+                       f"127.0.0.1:{coord_pair.server.port}")
+    monkeypatch.setenv("RSTPU_COMPACT_REMOTE_STORE",
+                       f"local://{tmp_path}/store")
+    monkeypatch.setenv("RSTPU_COMPACT_REMOTE_FLOOR", "0")
+    monkeypatch.setenv("RSTPU_COMPACT_REMOTE_CLAIM_WAIT", "5")
+    db = open_db(tmp_path / "db")
+    load_db(db)
+    stop = threading.Event()
+    worker = CompactionWorker(coord_pair(), str(tmp_path / "wk"),
+                              worker_id="envwk", poll_interval=0.05)
+    threading.Thread(target=worker.serve_forever, args=(stop,),
+                     daemon=True).start()
+    try:
+        mgr = attach_from_env("envdb@1234", db, lambda: 1)
+        assert mgr is not None
+        assert db._remote_compactor is mgr
+        assert mgr.policy.size_floor_bytes == 0
+        assert mgr.maybe_offload(FakePick()) == "installed"
+        assert db.metrics_snapshot(max_age=0)[
+            "remote_offloaded_bytes_total"] > 0
+        detach(db, mgr)
+        assert db._remote_compactor is None
+    finally:
+        stop.set()
+        db.close()
+
+
+def test_replicator_add_db_attaches_remote_tier(coord_pair, tmp_path,
+                                                monkeypatch):
+    """The serving path end to end: Replicator.add_db on a tier-enabled
+    environment attaches a manager keyed name@port with the shard's
+    LIVE epoch as provider (adopt_epoch moves it); remove_db detaches."""
+    from rocksplicator_tpu.replication.db_wrapper import StorageDbWrapper
+    from rocksplicator_tpu.replication.replicator import Replicator
+    from rocksplicator_tpu.replication.wire import ReplicaRole
+
+    monkeypatch.setenv("RSTPU_COMPACT_REMOTE", "1")
+    monkeypatch.setenv("RSTPU_COMPACT_COORD",
+                       f"127.0.0.1:{coord_pair.server.port}")
+    monkeypatch.setenv("RSTPU_COMPACT_REMOTE_STORE",
+                       f"local://{tmp_path}/store")
+    db = open_db(tmp_path / "db")
+    repl = Replicator(port=0)
+    try:
+        rdb = repl.add_db("shard1", StorageDbWrapper(db),
+                          ReplicaRole.LEADER, epoch=3)
+        mgr = rdb._remote_compaction_mgr
+        assert mgr is not None
+        assert db._remote_compactor is mgr
+        assert mgr.db_name == f"shard1@{repl.port}"
+        assert mgr._epoch() == 3
+        rdb.adopt_epoch(7)  # the provider reads the LIVE epoch
+        assert mgr._epoch() == 7
+        repl.remove_db("shard1")
+        assert db._remote_compactor is None
+    finally:
+        try:
+            repl.remove_db("shard1")
+        except KeyError:
+            pass
+        repl.stop()
+        db.close()

@@ -382,6 +382,289 @@ class IngestFixture:
         self.handler.close()
 
 
+# seams the remote-compaction fixture arms one-at-a-time (registration
+# asserted by the registry pass like the ingest menu): leader-side
+# faults must fall back to the local merge; worker-side faults must
+# fail the job or look like a dead worker (reap → republish)
+_REMOTE_COMPACT_FAULTS = [
+    ("compact.remote.publish", "fail_nth:1"),
+    ("compact.remote.claim", "fail_nth:1"),
+    ("compact.remote.fetch", "fail_nth:1"),
+    ("compact.remote.upload", "fail_nth:1"),
+    ("compact.remote.install", "fail_nth:1"),
+    ("compact.remote.heartbeat", "fail_nth:1"),
+]
+
+
+class RemoteCompactionFixture:
+    """Disaggregated compaction tier (round 18) under chaos: one fresh
+    db + leader-side manager per step, a persistent worker draining the
+    job ledger. Every step runs ONE rotating scenario (a seam fault, a
+    worker kill mid-job, or a leader kill mid-job) and then ALWAYS the
+    deposition probe: a job whose epoch goes stale in flight must come
+    back "fenced" with the file generation untouched — the invariant
+    the ``remote_install`` break-guard demonstrably violates."""
+
+    def __init__(self, root: str):
+        from rocksplicator_tpu.cluster.coordinator import (
+            CoordinatorClient, CoordinatorServer)
+        from rocksplicator_tpu.compaction_remote import (
+            CompactionWorker, RemoteDispatchPolicy)
+
+        self.root = root
+        self.server = CoordinatorServer(port=0, session_ttl=5.0)
+        self._clients = []
+
+        def client():
+            c = CoordinatorClient("127.0.0.1", self.server.port)
+            self._clients.append(c)
+            return c
+
+        self._client = client
+        self.store_uri = f"local://{os.path.join(root, 'compact_store')}"
+        self.policy = RemoteDispatchPolicy(
+            enabled=True, size_floor_bytes=0, deadline_s=20.0,
+            claim_wait_s=2.0, heartbeat_timeout_s=0.5)
+        self._worker_stop = threading.Event()
+        self.worker = CompactionWorker(
+            client(), os.path.join(root, "compact_wk"),
+            worker_id="chaos-worker", poll_interval=0.05)
+        threading.Thread(target=self.worker.serve_forever,
+                         args=(self._worker_stop,), daemon=True).start()
+        self.counter = 0  # fresh-db namer
+        self.steps = 0  # scenario rotation
+        self.outcomes: Dict[str, int] = {}
+
+    def _fresh_db(self, epoch_provider):
+        from rocksplicator_tpu.compaction_remote import \
+            RemoteCompactionManager
+
+        self.counter += 1
+        name = f"rc{self.counter:05d}"
+        db = DB(os.path.join(self.root, "compact_dbs", name),
+                DBOptions(memtable_bytes=4 * 1024,
+                          level0_compaction_trigger=100,
+                          background_compaction=False))
+        for j in range(120):
+            db.write(WriteBatch().put(b"c%05d" % j, b"v%05d" % (j % 97)))
+            if j % 40 == 0:
+                db.flush()
+        for j in range(0, 120, 9):
+            db.write(WriteBatch().delete(b"c%05d" % j))
+        db.flush()
+        mgr = RemoteCompactionManager(
+            name, db, self._client(), self.store_uri,
+            policy=self.policy, epoch_provider=epoch_provider)
+        want = {b"c%05d" % j: db.get(b"c%05d" % j) for j in range(120)}
+        return name, db, mgr, want
+
+    class _Pick:
+        kind, level, score, reason = "l0", 0, 2.0, "chaos"
+
+    def _note(self, outcome: str) -> None:
+        self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+
+    def step(self, rng: random.Random, violations: List[str],
+             tag: str) -> None:
+        scenarios = ["clean", "worker_kill", "leader_kill"] + [
+            f"seam:{site}" for site, _ in _REMOTE_COMPACT_FAULTS]
+        scenario = scenarios[self.steps % len(scenarios)]
+        self.steps += 1
+        try:
+            self._run_scenario(scenario, rng, violations, tag)
+            # the standing probe, every step: a deposed leader's job
+            # must fence, and fencing must leave the generation alone
+            self._probe_deposition(violations, tag)
+        except Exception as e:
+            violations.append(
+                f"{tag}: remote-compaction fixture crashed "
+                f"({scenario}): {e!r}")
+
+    def _run_scenario(self, scenario: str, rng: random.Random,
+                      violations: List[str], tag: str) -> None:
+        name, db, mgr, want = self._fresh_db(lambda: 1)
+        fault = None
+        if scenario.startswith("seam:"):
+            fault = scenario.split(":", 1)[1]
+            fp.activate(fault, "fail_nth:1")
+        try:
+            if scenario == "worker_kill":
+                outcome = self._worker_kill(name, db, mgr)
+            elif scenario == "leader_kill":
+                outcome = self._leader_kill(name, db, mgr, want,
+                                            violations, tag)
+                self._note(f"{scenario}:{outcome}")
+                return  # db already reopened+closed inside
+            else:
+                outcome = mgr.maybe_offload(self._Pick())
+            self._note(f"{scenario}:{outcome}")
+            if outcome == "fenced":
+                violations.append(
+                    f"{tag}: remote {scenario}: unexpected fence at "
+                    f"stable epoch")
+                return
+            if outcome == "declined":
+                # the automatic local fallback must be intact
+                db.compact_range()
+            got = {k: db.get(k) for k in want}
+            if got != want:
+                bad = next(k for k in want if got[k] != want[k])
+                violations.append(
+                    f"{tag}: remote {scenario} ({outcome}): data "
+                    f"diverged at {bad!r}")
+                return
+            if fault:
+                # retry after clear: the tier must work again
+                fp.deactivate(fault)
+                fault = None
+                retry = mgr.maybe_offload(self._Pick())
+                if retry not in ("installed", "declined"):
+                    violations.append(
+                        f"{tag}: remote {scenario}: retry after clear "
+                        f"→ {retry}")
+                got = {k: db.get(k) for k in want}
+                if got != want:
+                    violations.append(
+                        f"{tag}: remote {scenario}: data diverged "
+                        f"after clean retry")
+        finally:
+            if fault:
+                fp.deactivate(fault)
+            db.close()
+
+    def _worker_kill(self, name: str, db, mgr) -> str:
+        """A claimer that dies instantly: claims the job the moment it
+        appears, never heartbeats, never merges. The leader must reap
+        on heartbeat expiry and the live worker must finish the job."""
+        from rocksplicator_tpu.compaction_remote import CompactionJobQueue
+
+        dead_q = CompactionJobQueue(self._client())
+        stop = threading.Event()
+
+        def dead_claimer():
+            while not stop.is_set():
+                try:
+                    open_dbs = dead_q.list_open_jobs()
+                    if name in open_dbs:
+                        dead_q.claim(name, "dead-chaos-worker")
+                        return
+                except Exception:
+                    pass
+                time.sleep(0.01)
+
+        t = threading.Thread(target=dead_claimer, daemon=True)
+        t.start()
+        try:
+            return mgr.maybe_offload(self._Pick())
+        finally:
+            stop.set()
+            t.join(timeout=2.0)
+
+    def _leader_kill(self, name: str, db, mgr, want,
+                     violations: List[str], tag: str) -> str:
+        """Leader killed between publish and install: reopen must be
+        exactly pre-compaction, recover() sweeps the orphan, and the
+        next pick completes clean."""
+        files_before = sorted(
+            n for level in db._levels for n in level)
+        plan = db.plan_full_compaction()
+        if plan is None:
+            db.close()
+            return "noplan"
+        mgr._publish(plan, f"chaoskill{self.counter:05d}", 1)
+        db.abort_full_compaction(plan)  # the crash drops the mutex
+        db.close()
+
+        db2 = DB(db.path, DBOptions(memtable_bytes=4 * 1024,
+                                    level0_compaction_trigger=100,
+                                    background_compaction=False))
+        try:
+            files_after = sorted(
+                n for level in db2._levels for n in level)
+            if files_after != files_before:
+                violations.append(
+                    f"{tag}: remote leader_kill: reopen NOT exactly "
+                    f"pre-compaction ({files_before} → {files_after})")
+                return "diverged"
+            got = {k: db2.get(k) for k in want}
+            if got != want:
+                violations.append(
+                    f"{tag}: remote leader_kill: reopened data "
+                    f"diverged")
+                return "diverged"
+            mgr._db = db2
+            mgr.recover()
+            if mgr._queue.get_job(name) is not None:
+                violations.append(
+                    f"{tag}: remote leader_kill: recover() left the "
+                    f"orphan job in the ledger")
+                return "orphan"
+            outcome = mgr.maybe_offload(self._Pick())
+            if outcome == "declined":
+                db2.compact_range()
+            got = {k: db2.get(k) for k in want}
+            if got != want:
+                violations.append(
+                    f"{tag}: remote leader_kill: post-recovery "
+                    f"compaction diverged")
+            return outcome
+        finally:
+            db2.close()
+
+    def _probe_deposition(self, violations: List[str], tag: str) -> None:
+        """Publish at epoch 1, mint epoch 2 mid-job: the install MUST
+        fence, and the file generation must be byte-for-byte untouched.
+        With --break-guard remote_install the epoch gate is patched
+        out, the stale job installs, and THIS probe is what catches
+        it. A transient "declined" (worker hiccup: the result never
+        arrived, so there was nothing to fence) is retried once before
+        judging."""
+        for attempt in (0, 1):
+            epoch = {"cur": 1}
+            name, db, mgr, want = self._fresh_db(lambda: epoch["cur"])
+            files_before = sorted(
+                n for level in db._levels for n in level)
+            orig_publish = mgr._queue.publish
+
+            def publish_then_depose(job, _pub=orig_publish):
+                _pub(job)
+                epoch["cur"] = 2  # a new leader was elected mid-job
+
+            mgr._queue.publish = publish_then_depose
+            try:
+                outcome = mgr.maybe_offload(self._Pick())
+                self._note(f"deposed:{outcome}")
+                files_after = sorted(
+                    n for level in db._levels for n in level)
+                if outcome == "installed" or (
+                        outcome == "fenced"
+                        and files_after != files_before):
+                    violations.append(
+                        f"{tag}: DEPOSED LEADER'S JOB INSTALLED: "
+                        f"stale-epoch result came back {outcome!r}, "
+                        f"generation {files_before} → {files_after} "
+                        f"(epoch gate broken?)")
+                    return
+                if outcome == "fenced":
+                    return  # the expected path: discarded, untouched
+                # declined = the result never arrived to be fenced
+                # (worker hiccup) — inconclusive, retry once
+            finally:
+                db.close()
+        violations.append(
+            f"{tag}: deposition probe inconclusive twice: no result "
+            f"ever reached the epoch gate (worker wedged?)")
+
+    def close(self) -> None:
+        self._worker_stop.set()
+        for c in self._clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        self.server.stop()
+
+
 # ---------------------------------------------------------------------------
 # coordinator-backed failover chaos (the control-plane schedule menu)
 # ---------------------------------------------------------------------------
@@ -738,6 +1021,19 @@ def _break_guard(kind: str):
         ShardMove._phase_cutover = broken_cutover
         return lambda: setattr(
             ShardMove, "_phase_cutover", orig_cutover)
+    if kind == "remote_install":
+        # a leader that installs a remote compaction result WITHOUT the
+        # epoch gate: a deposed leader's in-flight job comes back and
+        # swaps a generation into a db that a higher-epoch leader now
+        # owns. The remote-compaction fixture's standing deposition
+        # probe must catch the install that should have fenced.
+        from rocksplicator_tpu.compaction_remote import install as rc_install
+
+        orig_gate = rc_install._epoch_is_current
+        rc_install._epoch_is_current = \
+            lambda job_epoch, current_epoch: True
+        return lambda: setattr(
+            rc_install, "_epoch_is_current", orig_gate)
     if kind == "fencing":
         # a leader that IGNORES epochs: stale-epoch frames are served and
         # acked, a deposed leader never fences — the no-split-brain
@@ -2066,6 +2362,7 @@ def run_chaos(
     seed: int = 1,
     writes: int = 80,
     ingest_every: int = 4,
+    remote_every: int = 3,
     break_guard: Optional[str] = None,
     conv_timeout: float = 30.0,
     transport: Optional[str] = None,
@@ -2092,6 +2389,7 @@ def run_chaos(
     fp.clear()
     cluster = ChaosCluster(root)
     ingest = IngestFixture(root, cluster.hosts[0])
+    remote = RemoteCompactionFixture(root) if remote_every else None
     try:
         if not cluster.wait_converged(20.0):
             raise RuntimeError("cluster never converged at start")
@@ -2150,6 +2448,12 @@ def run_chaos(
                     f"reconvergence, first {lost[0]} (faults {faults})")
             if ingest_every and si % ingest_every == ingest_every - 1:
                 ingest.step(rng, violations, tag)
+            if remote is not None and si % remote_every == 0:
+                # disaggregated compaction tier (round 18): rotating
+                # seam/worker-kill/leader-kill scenario + the standing
+                # deposed-install probe — runs on si=0 so a broken
+                # remote_install guard is caught on the first schedule
+                remote.step(rng, violations, tag)
             gauge_snapshots.append(_gauge_snapshot(tag))
             log(f"  [{si + 1}/{schedules}] faults={faults} "
                 f"writes={n_writes} acked={len(acked)} "
@@ -2162,6 +2466,8 @@ def run_chaos(
         if undo:
             undo()
         ingest.close()
+        if remote is not None:
+            remote.close()
         cluster.stop()
         for k, v in saved_env.items():
             if v is None:
@@ -2179,6 +2485,7 @@ def run_chaos(
         "gauge_snapshots": gauge_snapshots,
         "failpoint_trips": fp.trip_counts(),
         "break_guard": break_guard,
+        "remote_outcomes": dict(remote.outcomes) if remote else {},
     }
 
 
@@ -2189,6 +2496,12 @@ def main(argv=None) -> int:
     ap.add_argument("--writes", type=int, default=80,
                     help="max writes per schedule")
     ap.add_argument("--ingest-every", type=int, default=4)
+    ap.add_argument("--remote-every", type=int, default=3,
+                    help="run a disaggregated-compaction scenario every "
+                         "N schedules (0 disables; data-plane mode "
+                         "only): seam faults, worker kill mid-job, "
+                         "leader kill mid-job, plus the standing "
+                         "deposed-install fence probe")
     ap.add_argument("--failover", action="store_true",
                     help="coordinator-backed control-plane schedules "
                          "(Controller + Spectator + 3 participants): "
@@ -2210,7 +2523,7 @@ def main(argv=None) -> int:
                          "policy, i.e. tcp; data-plane mode only)")
     ap.add_argument("--break-guard",
                     choices=["wal_hole", "meta_first", "fencing",
-                             "move_flip"])
+                             "move_flip", "remote_install"])
     ap.add_argument("--expect-violation", action="store_true",
                     help="exit 0 iff a violation WAS caught")
     ap.add_argument("--conv-timeout", type=float, default=30.0)
@@ -2220,6 +2533,13 @@ def main(argv=None) -> int:
         ap.error("--break-guard fencing requires --failover")
     if args.break_guard == "move_flip" and not args.reshard:
         ap.error("--break-guard move_flip requires --reshard")
+    if args.break_guard == "remote_install":
+        if args.failover or args.reshard:
+            ap.error("--break-guard remote_install is data-plane only "
+                     "(drop --failover/--reshard)")
+        if not args.remote_every:
+            ap.error("--break-guard remote_install requires "
+                     "--remote-every > 0")
     if args.failover and args.reshard:
         ap.error("--failover and --reshard are mutually exclusive")
 
@@ -2240,6 +2560,7 @@ def main(argv=None) -> int:
             result = run_chaos(
                 root, schedules=args.schedules, seed=args.seed,
                 writes=args.writes, ingest_every=args.ingest_every,
+                remote_every=args.remote_every,
                 break_guard=args.break_guard,
                 conv_timeout=args.conv_timeout,
                 transport=args.transport,
@@ -2277,6 +2598,9 @@ def main(argv=None) -> int:
               f"[{result['transport']}], "
               f"{result['writes']} writes ({result['acked']} acked), "
               f"{result['elapsed_sec']}s")
+        if result.get("remote_outcomes"):
+            print(f"chaos: remote-compaction outcomes "
+                  f"{result['remote_outcomes']}")
     print(f"chaos: failpoint trips: {result['failpoint_trips']}")
     if args.out:
         with open(args.out, "w") as f:
