@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab metrics-smoke compaction-bench compaction-bench-smoke compaction-remote-bench compaction-remote-smoke stream-merge-bench stream-merge-smoke chaos-smoke chaos-failover-smoke reshard-smoke clean
+.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab metrics-smoke compaction-bench compaction-bench-smoke compaction-remote-bench compaction-remote-smoke stream-merge-bench stream-merge-smoke overload-bench overload-smoke chaos-smoke chaos-failover-smoke reshard-smoke clean
 
 # rstpu-check: the three-pass static suite (lock-order/blocking-under-
 # lock, event-loop blocking, failpoint/span/stats registries) over
@@ -180,6 +180,40 @@ stream-merge-smoke:
 		--reps 1 --budget_kb 256 --target_file_kb 32 \
 		--chunk_entries 2048 \
 		--out benchmarks/results/stream_merge_smoke.json
+
+# round-19 tail-armor acceptance: three interleaved A/Bs on fresh
+# 3-process clusters per arm — (1) per-tenant admission with one tenant
+# offering 10x its ops/s quota past the serving knee (the gate: the
+# well-behaved tenants' pooled p99.9 with armor ON strictly beats OFF,
+# their goodput holds, and only the abuser sheds); (2) hedged
+# bounded-staleness follower reads against a server-side injected fat
+# tail (gates: hedged get p99 strictly better at a <=5% hedge rate,
+# zero hedges with RSTPU_HEDGE=0); (3) the unarmed-overhead guard
+# (RSTPU_TAIL_ARMOR=0 vs armed-but-idle, write-path mean bounded)
+overload-bench:
+	$(PY) bench.py --macro_bench --overload_ab --shards 2 \
+		--preload_keys 1000 --overload_quota 200 \
+		--overload_good_rate 130 --overload_good_tenants 3 \
+		--overload_duration 6 --overload_reps 3 \
+		--hedge_read_rate 400 --overhead_rate 500 \
+		--out benchmarks/results/overload_r19.json
+
+# ~30-second failure-gated smoke of the same (small keyspace, 1 rep,
+# shorter phases) in --overload_gates mechanical mode: fails loudly
+# if the armor stops shedding the abuser, the killswitch leaks typed
+# sheds or hedges, the hedge rate breaks its 5% budget, or any arm
+# records a value mismatch. The latency-median comparisons stay on
+# the full overload-bench — a 1-rep micro run's serving knee drifts
+# too much run-to-run for a strict p99.9 gate to test the armor
+# rather than the host.
+overload-smoke:
+	$(PY) bench.py --macro_bench --overload_ab --shards 2 \
+		--preload_keys 400 --overload_quota 80 \
+		--overload_good_rate 50 --overload_good_tenants 2 \
+		--overload_duration 3 --overload_reps 1 \
+		--hedge_read_rate 250 --overhead_rate 200 \
+		--overload_gates mechanical \
+		--out benchmarks/results/overload_smoke.json
 
 # round-14 metrics-plane smoke (<10s): boots one replica in-process,
 # scrapes /metrics + /cluster_stats, validates Prometheus text-format

@@ -39,6 +39,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import bisect
+import contextlib
 import json
 import os
 import random
@@ -988,6 +989,477 @@ def run_sched_ab(args) -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# overload A/B (round 19: tail armor — deadlines, admission, hedging)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _bench_env(**overrides: str):
+    """Set/restore env in the BENCH process: the client half of the
+    tail armor (deadline stamping, hedging) reads env here, not in the
+    children — an A/B that only flips the children's env would measure
+    half the killswitch."""
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _scrape_counter_sums(cluster: "Cluster",
+                         prefixes: Tuple[str, ...]) -> Dict[str, float]:
+    """Fleet totals of every stats counter under the given prefixes."""
+
+    async def scrape(port: int):
+        return await cluster.pool.call("127.0.0.1", port, "stats", {},
+                                       timeout=10.0)
+
+    sums: Dict[str, float] = {}
+    for port in cluster.ports[:3]:
+        st = cluster.ioloop.run_sync(scrape(port), timeout=15)
+        for k, v in (st.get("counters") or {}).items():
+            if k.startswith(prefixes):
+                sums[k] = sums.get(k, 0.0) + v["total"]
+    return sums
+
+
+async def _run_tenant_loop(cluster: "Cluster",
+                           tenant_rates: Dict[str, float],
+                           duration: float, total_keys: int,
+                           seed: int, max_inflight: int,
+                           deadline_ms: float) -> Dict:
+    """Open-loop per-tenant get storm at the LEADER (one admission
+    point, so "10x quota" means what it says): every op runs under
+    ``request_scope`` so the client stamps the tenant tag and a
+    relative deadline budget — exactly what an armored application
+    client does. Typed sheds (RETRY_LATER / DEADLINE_EXCEEDED) are
+    counted per tenant, NOT as errors: shedding is the armor working.
+    Latency is open-loop (completion minus intended arrival), so the
+    OFF arm's queue explosion lands in the percentiles."""
+    from rocksplicator_tpu.rpc.deadline import (DEADLINE_EXCEEDED,
+                                                RETRY_LATER, Deadline,
+                                                request_scope)
+    from rocksplicator_tpu.rpc.errors import RpcApplicationError, RpcError
+    from rocksplicator_tpu.rpc.router import ReadPolicy
+
+    policy = ReadPolicy.leader_only()
+    arrivals: List[Tuple[float, str]] = []
+    for i, (tenant, rate) in enumerate(sorted(tenant_rates.items())):
+        for off in poisson_arrivals(rate, duration, seed + 31 * i):
+            arrivals.append((off, tenant))
+    arrivals.sort()
+    zipf = ZipfianGenerator(total_keys, seed=seed + 7)
+    shards = cluster.shards
+    router = cluster.router
+    loop = asyncio.get_running_loop()
+    sem = asyncio.Semaphore(max_inflight)
+    per: Dict[str, Dict] = {
+        t: {"lat": [], "shed": 0, "deadline_shed": 0, "errors": 0}
+        for t in tenant_rates}
+
+    async def one_op(intended: float, tenant: str, gid: int):
+        rec = per[tenant]
+        async with sem:
+            try:
+                with request_scope(
+                        deadline=Deadline.after_ms(deadline_ms),
+                        tenant=tenant):
+                    await router.read(SEGMENT, shard_of(gid, shards),
+                                      op="get", keys=[key_of(gid)],
+                                      policy=policy, timeout=15.0)
+            except RpcApplicationError as e:
+                if e.code == RETRY_LATER:
+                    rec["shed"] += 1
+                elif e.code == DEADLINE_EXCEEDED:
+                    rec["deadline_shed"] += 1
+                else:
+                    rec["errors"] += 1
+                return
+            except RpcError:
+                rec["errors"] += 1
+                return
+            rec["lat"].append((loop.time() - intended) * 1000.0)
+
+    t0 = loop.time()
+    tasks = []
+    for off, tenant in arrivals:
+        delay = (t0 + off) - loop.time()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        tasks.append(asyncio.ensure_future(
+            one_op(t0 + off, tenant, zipf.next())))
+    if tasks:
+        await asyncio.wait(tasks)
+
+    out: Dict[str, Dict] = {}
+    for tenant, rec in per.items():
+        vals = sorted(rec["lat"])
+        out[tenant] = {
+            "offered_per_sec": tenant_rates[tenant],
+            "goodput_per_sec": round(len(vals) / duration, 1),
+            "shed": rec["shed"],
+            "deadline_shed": rec["deadline_shed"],
+            "errors": rec["errors"],
+            "p50_ms": round(percentile(vals, 50), 3) if vals else None,
+            "p99_ms": round(percentile(vals, 99), 3) if vals else None,
+            "p999_ms": round(percentile(vals, 99.9), 3) if vals else None,
+            # raw samples ride along so the caller can POOL tenants
+            # before taking a p99.9 (per-tenant sample counts are too
+            # small for a stable 1-in-1000 quantile); popped before the
+            # artifact is written
+            "_raw": vals,
+        }
+    return out
+
+
+def run_overload_ab(args) -> Dict:
+    """The round-19 acceptance bench: three interleaved A/Bs, each arm
+    on a FRESH 3-process cluster (armor knobs are process-env, and the
+    OFF arm's queue backlog must not leak into the next arm).
+
+    - ``tenant_ab`` — one abusive tenant offered 10x its ops/s quota
+      plus well-behaved tenants (within quota), total offered past the
+      serving knee, leader-only reads. armor_on children carry
+      ``RSTPU_TENANT_OPS``; armor_off children (and the bench-side
+      client) run ``RSTPU_TAIL_ARMOR=0``. The gate: the well-behaved
+      tenants' pooled p99.9 with armor ON is strictly better than OFF,
+      their goodput holds, and the abuser is the one shedding.
+    - ``hedge_ab`` — a read-only follower_ok phase against a cluster
+      whose replicas have a rare fat tail injected server-side
+      (``repl.read=delay_ms`` failpoint via RSTPU_FAILPOINTS, armed at
+      child import). RSTPU_HEDGE=1 vs 0 in the BENCH process (hedging
+      is client-side). Gates: hedged get p99 strictly better, hedge
+      rate within the 5% budget, zero hedges in the off arm.
+    - ``overhead_ab`` — the unarmed-overhead guard: NO overload, no
+      quotas, mixed get/put at a comfortable rate, RSTPU_TAIL_ARMOR
+      1 vs 0 everywhere. Armed-but-idle stamping+checking must cost
+      within host noise on the write path (gated as a mean-latency
+      ratio bound).
+    """
+    import shutil
+    import tempfile
+
+    from rocksplicator_tpu.rpc.router import ReadPolicy
+    from rocksplicator_tpu.utils.stats import Stats
+
+    total_keys = args.shards * args.preload_keys
+    quota = float(args.overload_quota)
+    abuser_rate = 10.0 * quota
+    tenant_rates = {"abuser": abuser_rate}
+    good_tenants = [f"good{i}" for i in range(args.overload_good_tenants)]
+    for t in good_tenants:
+        tenant_rates[t] = float(args.overload_good_rate)
+    rep_no = [0]
+
+    def fresh_cluster(root: str, extra_env: Dict[str, str],
+                      executor_threads: Optional[int] = None) -> Cluster:
+        cluster = Cluster(root, args.shards, args.preload_keys,
+                          args.value_bytes, args.write_window,
+                          args.read_info_ttl_ms, args.transport,
+                          executor_threads or args.executor_threads,
+                          extra_env=extra_env)
+        cluster.wait_catchup(total_keys)
+        return cluster
+
+    def tenant_arm(armor: str):
+        name = f"armor_{armor}"
+
+        def run() -> Dict:
+            rep_no[0] += 1
+            extra_env = ({"RSTPU_TAIL_ARMOR": "1",
+                          "RSTPU_TENANT_OPS": str(quota)}
+                         if armor == "on"
+                         else {"RSTPU_TAIL_ARMOR": "0"})
+            root = tempfile.mkdtemp(prefix="rstpu-overload-")
+            cluster = None
+            try:
+                with _bench_env(
+                        RSTPU_TAIL_ARMOR="1" if armor == "on" else "0"):
+                    Stats.reset_for_test()
+                    log(f"overload[{name}]: booting cluster "
+                        f"(quota={quota if armor == 'on' else 'none'} "
+                        f"ops/s, abuser offered={abuser_rate}/s)")
+                    # narrow dispatch on purpose: the overload signal
+                    # must come from the abuser monopolizing the
+                    # server's executor queue, not from how close the
+                    # host's raw CPU knee happens to sit to the
+                    # offered rate that day. With one dispatch thread
+                    # the OFF arm serializes the flood (queue-wait is
+                    # the damage) while the ON arm sheds the abuser
+                    # BEFORE dispatch, so the A/B tests the armor.
+                    cluster = fresh_cluster(
+                        root, extra_env,
+                        executor_threads=args.tenant_executor_threads)
+                    per_tenant = cluster.ioloop.run_sync(
+                        _run_tenant_loop(
+                            cluster, tenant_rates,
+                            args.overload_duration, total_keys,
+                            args.seed + 977 * rep_no[0],
+                            args.max_inflight,
+                            args.overload_deadline_ms),
+                        timeout=args.overload_duration + 180)
+                    server = _scrape_counter_sums(
+                        cluster, ("rpc.tenant_shed", "rpc.tenant_served",
+                                  "rpc.deadline_shed", "rpc.retry_later"))
+                good_goodput = round(sum(
+                    per_tenant[t]["goodput_per_sec"]
+                    for t in good_tenants), 1)
+                good_shed = sum(per_tenant[t]["shed"]
+                                + per_tenant[t]["deadline_shed"]
+                                for t in good_tenants)
+                good_pool = sorted(
+                    v for t in good_tenants
+                    for v in per_tenant[t]["_raw"])
+                for rec in per_tenant.values():
+                    rec.pop("_raw", None)
+                ab = per_tenant["abuser"]
+                return {
+                    "per_tenant": per_tenant,
+                    "good_p999_ms": (round(percentile(good_pool, 99.9), 3)
+                                     if good_pool else None),
+                    "good_p99_ms": (round(percentile(good_pool, 99), 3)
+                                    if good_pool else None),
+                    "good_goodput_per_sec": good_goodput,
+                    "good_offered_per_sec": round(sum(
+                        tenant_rates[t] for t in good_tenants), 1),
+                    "good_shed": good_shed,
+                    "abuser_offered_per_sec": abuser_rate,
+                    "abuser_goodput_per_sec": ab["goodput_per_sec"],
+                    "abuser_shed": ab["shed"] + ab["deadline_shed"],
+                    "errors": sum(per_tenant[t]["errors"]
+                                  for t in per_tenant),
+                    "server_counters": server,
+                }
+            finally:
+                if cluster is not None:
+                    cluster.stop()
+                shutil.rmtree(root, ignore_errors=True)
+        return run
+
+    def hedge_arm(hedge: str):
+        name = f"hedge_{hedge}"
+        inject = (f"repl.read=delay_ms:{args.hedge_inject_ms}:"
+                  f"{args.hedge_inject_prob}@seed{args.seed}")
+
+        def run() -> Dict:
+            rep_no[0] += 1
+            root = tempfile.mkdtemp(prefix="rstpu-overload-")
+            cluster = None
+            try:
+                with _bench_env(RSTPU_TAIL_ARMOR="1",
+                                RSTPU_HEDGE=hedge):
+                    Stats.reset_for_test()
+                    log(f"overload[{name}]: booting cluster "
+                        f"(server tail inject {inject})")
+                    cluster = fresh_cluster(
+                        root, {"RSTPU_FAILPOINTS": inject})
+                    phase = run_phase(
+                        cluster, ReadPolicy.follower_ok(args.max_lag),
+                        args.hedge_read_rate, args.overload_duration,
+                        total_keys, args.value_bytes, {"get": 1.0},
+                        args.seed + 977 * rep_no[0], args.max_inflight)
+                    stats = Stats.get()
+                    stats.flush()
+                    hedges = stats.get_counter("router.hedges op=get")
+                    wins = stats.get_counter("router.hedge_wins op=get")
+                    denied = stats.get_counter(
+                        "router.hedge_budget_denied op=get")
+                g = phase["ops"].get("get") or {}
+                reads = g.get("count", 0) + g.get("errors", 0)
+                return {
+                    "get_p99_ms": g.get("p99_ms"),
+                    "get_p50_ms": g.get("p50_ms"),
+                    "get_count": g.get("count", 0),
+                    "get_errors": g.get("errors", 0),
+                    "value_mismatches": phase["value_mismatches"],
+                    "hedges": int(hedges),
+                    "hedge_wins": int(wins),
+                    "hedge_budget_denied": int(denied),
+                    "hedge_rate": round(hedges / max(1, reads), 4),
+                }
+            finally:
+                if cluster is not None:
+                    cluster.stop()
+                shutil.rmtree(root, ignore_errors=True)
+        return run
+
+    def overhead_arm(armor: str):
+        name = f"armor_{armor}"
+
+        def run() -> Dict:
+            rep_no[0] += 1
+            root = tempfile.mkdtemp(prefix="rstpu-overload-")
+            cluster = None
+            try:
+                with _bench_env(
+                        RSTPU_TAIL_ARMOR="1" if armor == "on" else "0"):
+                    Stats.reset_for_test()
+                    log(f"overload[overhead {name}]: booting cluster")
+                    cluster = fresh_cluster(
+                        root,
+                        {"RSTPU_TAIL_ARMOR":
+                         "1" if armor == "on" else "0"})
+                    phase = run_phase(
+                        cluster, ReadPolicy.follower_ok(args.max_lag),
+                        args.overhead_rate, args.overload_duration,
+                        total_keys, args.value_bytes,
+                        {"get": 0.5, "put": 0.5},
+                        args.seed + 977 * rep_no[0], args.max_inflight)
+                g = phase["ops"].get("get") or {}
+                pw = phase["ops"].get("put") or {}
+                return {
+                    "put_mean_ms": pw.get("mean_ms"),
+                    "put_p99_ms": pw.get("p99_ms"),
+                    "get_mean_ms": g.get("mean_ms"),
+                    "get_p99_ms": g.get("p99_ms"),
+                    "put_errors": pw.get("errors", 0),
+                    "get_errors": g.get("errors", 0),
+                    "value_mismatches": phase["value_mismatches"],
+                    "achieved_per_sec": phase["achieved_per_sec"],
+                }
+            finally:
+                if cluster is not None:
+                    cluster.stop()
+                shutil.rmtree(root, ignore_errors=True)
+        return run
+
+    return {
+        "tenant_ab": run_interleaved(
+            [("armor_off", tenant_arm("off")),
+             ("armor_on", tenant_arm("on"))],
+            reps=args.overload_reps, key="good_p999_ms",
+            higher_is_better=False, log=log),
+        "hedge_ab": run_interleaved(
+            [("hedge_off", hedge_arm("0")), ("hedge_on", hedge_arm("1"))],
+            reps=args.overload_reps, key="get_p99_ms",
+            higher_is_better=False, log=log),
+        "overhead_ab": run_interleaved(
+            [("armor_off", overhead_arm("off")),
+             ("armor_on", overhead_arm("on"))],
+            reps=args.overload_reps, key="put_mean_ms",
+            higher_is_better=False, log=log),
+    }
+
+
+def _median_field(samples: List[Dict], field: str) -> Optional[float]:
+    from statistics import median
+
+    vals = [s[field] for s in samples or [] if s.get(field) is not None]
+    return median(vals) if vals else None
+
+
+def overload_failures(result: Dict,
+                      mechanical_only: bool = False) -> List[str]:
+    """The round-19 acceptance gates over the three A/B sections —
+    medians across interleaved reps (the ab_runner discipline: per-rep
+    comparisons on a drifting host gate the host, not the change).
+
+    ``mechanical_only`` (the smoke's mode) keeps every deterministic
+    gate — killswitch arms may not leak typed sheds or hedges, the
+    quota must actually bite the abuser, hedges must fire inside their
+    5% budget, zero value mismatches, and the armed good-tenant p99
+    stays inside a deadline-derived absolute bound — but drops the
+    latency-median A/B comparisons: on a 1-rep micro run the serving
+    knee itself drifts run to run, so a strict p99.9 comparison gates
+    the host, not the armor. The full ``make overload-bench`` runs
+    every gate."""
+    failures: List[str] = []
+    oab = result.get("overload_ab") or {}
+
+    t = oab.get("tenant_ab") or {}
+    ts = t.get("samples") or {}
+    on_p999 = _median_field(ts.get("armor_on"), "good_p999_ms")
+    off_p999 = _median_field(ts.get("armor_off"), "good_p999_ms")
+    if on_p999 is None or off_p999 is None:
+        failures.append("tenant_ab: missing good-tenant p99.9 in an arm")
+    elif not mechanical_only and not on_p999 < off_p999:
+        failures.append(
+            f"tenant_ab: good p99.9 armor_on {on_p999}ms not strictly "
+            f"better than armor_off {off_p999}ms")
+    on_good = _median_field(ts.get("armor_on"), "good_goodput_per_sec")
+    off_good = _median_field(ts.get("armor_off"), "good_goodput_per_sec")
+    if not mechanical_only and on_good is not None \
+            and off_good is not None and on_good < 0.8 * off_good:
+        failures.append(
+            f"tenant_ab: good-tenant goodput collapsed under armor "
+            f"({on_good}/s vs {off_good}/s off) — not graceful")
+    # deadline enforcement bounds a SUCCESSFUL armed op's latency:
+    # anything slower becomes a typed DEADLINE_EXCEEDED instead of a
+    # latency sample. 2x the budget leaves room for the open-loop
+    # intended-arrival anchor (client dispatch lag precedes the
+    # deadline scope), but a p99 past that means the armor isn't
+    # converting overload into typed sheds at all.
+    budget_ms = (result.get("config") or {}).get("deadline_budget_ms")
+    if budget_ms:
+        for s in ts.get("armor_on") or []:
+            p99 = s.get("good_p99_ms")
+            if p99 is not None and p99 > 2.0 * float(budget_ms):
+                failures.append(
+                    f"tenant_ab: armed good-tenant p99 {p99}ms over "
+                    f"the 2x deadline-budget bound "
+                    f"({2.0 * float(budget_ms)}ms)")
+    for s in ts.get("armor_on") or []:
+        if s["abuser_shed"] <= 0:
+            failures.append("tenant_ab: armor_on rep shed nothing "
+                            "from the abuser")
+        if s["abuser_goodput_per_sec"] > 0.35 * s["abuser_offered_per_sec"]:
+            failures.append(
+                f"tenant_ab: abuser goodput "
+                f"{s['abuser_goodput_per_sec']}/s not held near its "
+                f"quota (offered {s['abuser_offered_per_sec']}/s)")
+    for s in ts.get("armor_off") or []:
+        if s["abuser_shed"] + s["good_shed"] > 0:
+            failures.append("tenant_ab: armor_off rep shed typed "
+                            "errors (killswitch leak)")
+
+    h = oab.get("hedge_ab") or {}
+    hs = h.get("samples") or {}
+    on_p99 = _median_field(hs.get("hedge_on"), "get_p99_ms")
+    off_p99 = _median_field(hs.get("hedge_off"), "get_p99_ms")
+    if on_p99 is None or off_p99 is None:
+        failures.append("hedge_ab: missing get p99 in an arm")
+    elif not mechanical_only and not on_p99 < off_p99:
+        failures.append(
+            f"hedge_ab: hedged get p99 {on_p99}ms not strictly better "
+            f"than unhedged {off_p99}ms")
+    for s in hs.get("hedge_on") or []:
+        if s["hedges"] <= 0:
+            failures.append("hedge_ab: hedge_on rep fired zero hedges")
+        # 5% accrual + the small starting-credit transient
+        if s["hedge_rate"] > 0.055:
+            failures.append(
+                f"hedge_ab: hedge rate {s['hedge_rate']} over the "
+                f"5% budget")
+        if s["value_mismatches"]:
+            failures.append("hedge_ab: value mismatches under hedging")
+    for s in hs.get("hedge_off") or []:
+        if s["hedges"] > 0:
+            failures.append("hedge_ab: hedge_off rep fired hedges "
+                            "(killswitch leak)")
+
+    o = oab.get("overhead_ab") or {}
+    os_ = o.get("samples") or {}
+    on_mean = _median_field(os_.get("armor_on"), "put_mean_ms")
+    off_mean = _median_field(os_.get("armor_off"), "put_mean_ms")
+    if on_mean is None or off_mean is None:
+        failures.append("overhead_ab: missing put mean in an arm")
+    elif not mechanical_only and off_mean > 0 and on_mean / off_mean > 1.5:
+        failures.append(
+            f"overhead_ab: armed write-path mean {on_mean}ms vs "
+            f"unarmed {off_mean}ms — over the 1.5x host-noise bound")
+    for mode, reps_data in os_.items():
+        for s in reps_data:
+            if s["value_mismatches"]:
+                failures.append(f"overhead_ab {mode}: value mismatches")
+    return failures
+
+
+# ---------------------------------------------------------------------------
 # cluster-wide stats scrape (round 14: the spectator-aggregation path)
 # ---------------------------------------------------------------------------
 
@@ -1119,6 +1591,53 @@ def main(argv=None) -> int:
     p.add_argument("--sched_duration", type=float, default=8.0)
     p.add_argument("--sched_reps", type=int, default=2)
     p.add_argument("--sched_mix", default="get=0.5,put=0.5")
+    p.add_argument("--overload_ab", action="store_true",
+                   help="standalone mode: the round-19 tail-armor "
+                        "acceptance A/Bs (per-tenant admission under "
+                        "an abusive tenant, hedged follower reads "
+                        "against an injected server tail, and the "
+                        "unarmed-overhead guard), fresh cluster per arm")
+    p.add_argument("--overload_quota", type=float, default=200.0,
+                   help="per-tenant ops/s quota (RSTPU_TENANT_OPS) in "
+                        "the armor_on arm; the abuser offers 10x this")
+    p.add_argument("--overload_good_rate", type=float, default=130.0,
+                   help="offered ops/s per well-behaved tenant "
+                        "(must sit under the quota)")
+    p.add_argument("--overload_good_tenants", type=int, default=3)
+    p.add_argument("--tenant_executor_threads", type=int, default=1,
+                   help="executor threads per server in the tenant "
+                        "A/B only (default 1: the abuser flood must "
+                        "monopolize an explicit dispatch queue, not "
+                        "race the host's raw CPU knee — the armor "
+                        "sheds BEFORE dispatch, so the contrast is "
+                        "structural, not host-dependent)")
+    p.add_argument("--overload_duration", type=float, default=6.0,
+                   help="seconds per overload/hedge/overhead phase")
+    p.add_argument("--overload_reps", type=int, default=3)
+    p.add_argument("--overload_deadline_ms", type=float, default=2000.0,
+                   help="client deadline budget stamped on every "
+                        "tenant-phase op (armor_on arm)")
+    p.add_argument("--hedge_read_rate", type=float, default=400.0,
+                   help="offered get/s for the hedge A/B phase")
+    p.add_argument("--hedge_inject_ms", type=int, default=80,
+                   help="server-side injected read delay (the fat "
+                        "tail hedging should cut)")
+    p.add_argument("--hedge_inject_prob", type=float, default=0.025,
+                   help="probability of the injected delay per read "
+                        "(rare: the p95-derived hedge delay must stay "
+                        "UNDER the injected tail, or hedges fire too "
+                        "late to rescue it)")
+    p.add_argument("--overhead_rate", type=float, default=500.0,
+                   help="offered ops/s for the unarmed-overhead A/B "
+                        "(comfortably under the knee)")
+    p.add_argument("--overload_gates", choices=("full", "mechanical"),
+                   default="full",
+                   help="'full' (default) gates the latency medians "
+                        "too; 'mechanical' (the smoke) keeps only the "
+                        "deterministic gates — killswitch leaks, quota "
+                        "bite, hedge budget, value mismatches — since "
+                        "a 1-rep micro run's serving knee drifts too "
+                        "much for a strict p99.9 comparison")
     p.add_argument("--out", help="write the artifact JSON here")
     args = p.parse_args(argv)
 
@@ -1184,6 +1703,44 @@ def main(argv=None) -> int:
         result["failures"] = sched_ab_failures(
             result["sched_ab"]["samples"],
             picks_of=lambda s: s["compaction.sched_picks"])
+        return emit_gated_artifact(result, args.out, "macro_bench", log)
+    if args.overload_ab:
+        # standalone mode: every arm boots its own cluster (the armor
+        # switches are process-env knobs on BOTH sides of the wire)
+        result = {
+            "bench": "macro_bench_overload_ab",
+            "config": {
+                "shards": args.shards,
+                "preload_keys_per_shard": args.preload_keys,
+                "value_bytes": args.value_bytes,
+                "tenant_quota_ops": args.overload_quota,
+                "abuser_offered_per_sec": 10.0 * args.overload_quota,
+                "good_tenants": args.overload_good_tenants,
+                "good_rate_per_tenant": args.overload_good_rate,
+                "tenant_executor_threads": args.tenant_executor_threads,
+                "deadline_budget_ms": args.overload_deadline_ms,
+                "hedge_read_rate": args.hedge_read_rate,
+                "hedge_inject": (f"{args.hedge_inject_ms}ms @ "
+                                 f"p={args.hedge_inject_prob}"),
+                "overhead_rate": args.overhead_rate,
+                "duration": args.overload_duration,
+                "reps": args.overload_reps,
+                "max_lag": args.max_lag,
+                "transport": args.transport,
+                "seed": args.seed,
+                "gates": args.overload_gates,
+                "topology": ("1 leader + 2 followers (mode 1), "
+                             "3 OS processes, fresh cluster per arm"),
+            },
+            "host_calibration": host_calibration(root),
+        }
+        try:
+            result["overload_ab"] = run_overload_ab(args)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        result["elapsed_sec"] = round(time.monotonic() - t0, 1)
+        result["failures"] = overload_failures(
+            result, mechanical_only=args.overload_gates == "mechanical")
         return emit_gated_artifact(result, args.out, "macro_bench", log)
     result: Dict = {
         "bench": "macro_bench",

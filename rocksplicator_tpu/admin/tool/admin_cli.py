@@ -213,8 +213,10 @@ def cmd_move_shard(admin: AdminClient, args) -> int:
 
 
 def cmd_drain_node(admin: AdminClient, args) -> int:
-    """Move every replica off --node (least-loaded targets, sequential
-    moves) — the minimal whole-node evacuation."""
+    """Move every replica off --node (sequential moves) — the minimal
+    whole-node evacuation. Targets rank least-loaded-first by the
+    scraped /cluster_stats per-shard rates when the coordinator has a
+    published shard map, falling back to least shard count."""
     from ...cluster.shard_move import MoveError, drain_node
 
     coord = _coord_client(args.coord)

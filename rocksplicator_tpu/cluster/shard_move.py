@@ -727,18 +727,57 @@ def list_active_moves(coord: CoordinatorClient,
     return out
 
 
+def _scraped_shard_load(coord: CoordinatorClient,
+                        cluster: str) -> Optional[Dict[str, float]]:
+    """db_name -> (read+write) 1-minute rate from a one-shot
+    ``/cluster_stats`` scrape of every replica named by the PUBLISHED
+    shard map (coordinator ``shardmap`` node, the spectator's output).
+    None when no map is published, no replica answers, or the scrape
+    faults — the caller falls back to shard counts. This is the
+    round-14 hot-spot sensor's first concrete consumer (ROADMAP's
+    rebalancer item builds on the same signal)."""
+    raw = coord.get_or_none(cluster_path(cluster, "shardmap"))
+    if not raw:
+        return None
+    try:
+        shard_map = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    from .stats_aggregator import (ClusterStatsAggregator,
+                                   endpoints_from_shard_map)
+
+    endpoints, per_db = endpoints_from_shard_map(shard_map)
+    if not endpoints:
+        return None
+    agg = ClusterStatsAggregator()
+    try:
+        doc = agg.scrape_and_aggregate(endpoints, per_db)
+    except Exception:
+        log.warning("drain: cluster-stats scrape failed; falling back "
+                    "to shard counts", exc_info=True)
+        return None
+    finally:
+        agg.close()
+    if not doc.get("replicas_scraped"):
+        return None
+    return {db: (float(rec.get("read_rate_1m", 0.0))
+                 + float(rec.get("write_rate_1m", 0.0)))
+            for db, rec in (doc.get("per_shard") or {}).items()}
+
+
 def drain_node(coord: CoordinatorClient, cluster: str, node: str,
                store_uri: str, admin: Optional[AdminClient] = None,
                flags: Optional[MoveFlags] = None,
                log_fn=log.info) -> List[MoveRecord]:
     """Move every partition ``node`` serves to other live instances —
     the minimal whole-node evacuation built on move-shard. Targets are
-    chosen least-loaded-first among live instances not already hosting
-    the partition (the round-14 ``/cluster_stats`` hot-spot ranking is
-    the richer signal a future rebalancer consumes; shard COUNT is the
-    honest minimum for an evacuation). Sequential by design: an
-    evacuation should trickle, not trample serving traffic — the
-    per-move IngestGate and write-pause bounds apply to each step."""
+    chosen least-LOADED-first (round 19): candidates are ranked by the
+    scraped per-shard serving load they already carry (the round-14
+    ``/cluster_stats`` read/write hot-spot ranking), with shard count
+    as the tie-break and as the fallback whenever the map or the
+    scrape is unavailable. Sequential by design: an evacuation should
+    trickle, not trample serving traffic — the per-move IngestGate and
+    write-pause bounds apply to each step."""
     path = lambda *p: cluster_path(cluster, *p)  # noqa: E731
     states_of = {}
     for iid in coord.list(path("currentstates")):
@@ -753,6 +792,10 @@ def drain_node(coord: CoordinatorClient, cluster: str, node: str,
     if not partitions:
         log_fn(f"drain {node}: nothing to move")
         return []
+    db_load = _scraped_shard_load(coord, cluster)
+    if db_load is not None:
+        log_fn(f"drain {node}: ranking targets by scraped per-shard "
+               f"load ({len(db_load)} shard(s) reporting)")
     done: List[MoveRecord] = []
     for partition in sorted(partitions):
         hosting = {iid for iid, st in states_of.items()
@@ -763,9 +806,22 @@ def drain_node(coord: CoordinatorClient, cluster: str, node: str,
             raise MoveError(
                 f"drain {node}: no candidate instance for {partition} "
                 f"(every live node already hosts it)")
-        load = {iid: sum(1 for st in states_of.get(iid, {}).values()
-                         if st in _SERVING) for iid in candidates}
-        target = min(candidates, key=lambda iid: (load[iid], iid))
+        counts = {iid: sum(1 for st in states_of.get(iid, {}).values()
+                           if st in _SERVING) for iid in candidates}
+        if db_load is not None:
+            # an instance's load = the scraped 1m read+write rate summed
+            # over the partitions it currently SERVES; rounding absorbs
+            # scrape noise so near-equal instances fall through to the
+            # shard-count tie-break instead of thrashing on jitter
+            served = {iid: round(sum(
+                db_load.get(partition_name_to_db_name(p), 0.0)
+                for p, st in states_of.get(iid, {}).items()
+                if st in _SERVING), 1) for iid in candidates}
+            target = min(candidates,
+                         key=lambda iid: (served[iid], counts[iid], iid))
+        else:
+            target = min(candidates,
+                         key=lambda iid: (counts[iid], iid))
         log_fn(f"drain {node}: moving {partition} -> {target}")
         mv = ShardMove.start(coord, cluster, partition, node, target,
                              store_uri, admin=admin, flags=flags)

@@ -46,6 +46,10 @@ _SCRAPE_RETRY = RetryPolicy(max_attempts=2, base_delay=0.1, max_delay=0.5)
 # histogram families reported per op class in the fleet summary
 _LATENCY_FAMILIES = ("reads.latency_ms", "writes.latency_ms")
 
+# per-tenant admission telemetry (round 19 tail armor): served/shed
+# counters and the server-side latency histogram, tagged tenant=<t>
+_TENANT_FAMILY = "rpc.tenant_ms"
+
 
 def endpoints_from_shard_map(shard_map: Dict) -> Tuple[
         List[Endpoint], Dict[str, List[Endpoint]]]:
@@ -158,6 +162,14 @@ class ClusterStatsAggregator:
         hist_by_family_op: Dict[Tuple[str, str], List[Dict]] = {}
         counters_total: Dict[str, float] = {}
         debt_by_ep_db: Dict[Tuple[str, str], float] = {}
+        tenants: Dict[str, Dict] = {}
+        tenant_hists: Dict[str, List[Dict]] = {}
+
+        def tenant_rec(t: str) -> Dict:
+            return tenants.setdefault(t, {
+                "served_total": 0.0, "served_rate_1m": 0.0,
+                "shed_total": 0.0, "shed_rate_1m": 0.0,
+            })
 
         # In-process topologies (chaos/cluster tests) colocate several
         # replicators in ONE process sharing ONE Stats registry: two
@@ -191,6 +203,14 @@ class ClusterStatsAggregator:
                     rec = shard_rec(db)
                     rec["write_rate_1m"] += float(c.get("rate_1m", 0.0))
                     rec["writes_total"] += float(c.get("total", 0.0))
+                elif base == "rpc.tenant_served" and tags.get("tenant"):
+                    rec = tenant_rec(tags["tenant"])
+                    rec["served_total"] += float(c.get("total", 0.0))
+                    rec["served_rate_1m"] += float(c.get("rate_1m", 0.0))
+                elif base == "rpc.tenant_shed" and tags.get("tenant"):
+                    rec = tenant_rec(tags["tenant"])
+                    rec["shed_total"] += float(c.get("total", 0.0))
+                    rec["shed_rate_1m"] += float(c.get("rate_1m", 0.0))
             for name, value in (state.get("gauges") or {}).items():
                 base, tags = split_tagged(name)
                 db = tags.get("db")
@@ -220,6 +240,9 @@ class ClusterStatsAggregator:
                 if base in _LATENCY_FAMILIES:
                     op = tags.get("op", "?")
                     hist_by_family_op.setdefault((base, op), []).append(st)
+                elif base == _TENANT_FAMILY and tags.get("tenant"):
+                    tenant_hists.setdefault(
+                        tags["tenant"], []).append(st)
 
         # worst-replica compaction debt per shard (summed over levels
         # within one replica, max across replicas)
@@ -247,6 +270,22 @@ class ClusterStatsAggregator:
                     histogram_state_percentile(merged, 99), 3),
             }
 
+        # per-tenant fleet view (round 19): served/shed rollups plus the
+        # same exact log-bucket latency merge the op-class families get
+        for t, states in sorted(tenant_hists.items()):
+            merged = merge_histogram_states(states)
+            if not merged["count"]:
+                continue
+            tenant_rec(t)["latency_ms"] = {
+                "count": merged["count"],
+                "p50_ms": round(
+                    histogram_state_percentile(merged, 50), 3),
+                "p99_ms": round(
+                    histogram_state_percentile(merged, 99), 3),
+                "p999_ms": round(
+                    histogram_state_percentile(merged, 99.9), 3),
+            }
+
         hot = sorted(
             shard.items(),
             key=lambda kv: kv[1]["read_rate_1m"] + kv[1]["write_rate_1m"],
@@ -267,9 +306,11 @@ class ClusterStatsAggregator:
                 (rec["max_applied_seq_lag"] for rec in shard.values()),
                 default=0.0),
             "fleet_latency_ms": fleet_latency,
+            "per_tenant": tenants,
             "counters_total": {
                 k: v for k, v in sorted(counters_total.items())
-                if k.startswith(("replicator.", "reads.", "storage."))
+                if k.startswith(("replicator.", "reads.", "storage.",
+                                 "rpc."))
             },
             "histogram_merge": "exact-log-bucket",
         }

@@ -25,13 +25,17 @@ the CURRENT epoch is re-read and compared by :func:`_epoch_is_current`
 ``--break-guard remote_install`` tooth can patch it out and prove the
 deposed-leader install is otherwise caught.
 
-Crash safety: the plan's compaction mutex dies with the leader process,
-so a leader killed mid-job leaves only a ledger entry plus garbage
-objects. ``recover()`` (called on reopen, before serving) sweeps both;
-until then no install can happen because nothing holds a plan. Re-
-install after a leader restart is therefore idempotent by construction:
-the restarted leader sweeps the old job and re-plans from its reopened
-(exactly pre-compaction) manifest.
+Locking (narrowed in round 19): the remote round trip runs off a
+MUTEX-FREE snapshot (``engine.snapshot_full_compaction``) — the
+shard's compaction mutex is won only for the final verify+install
+(``engine.begin_full_install``, which revalidates the snapshot's
+inputs are still live), so local picks never wait behind a slow
+worker. Crash safety: a leader killed mid-job leaves only a ledger
+entry plus garbage objects — ``recover()`` (called on reopen, before
+serving) sweeps both; nothing can install because the install-time
+mutex+revalidation gate is process-local state. Re-install after a
+leader restart is therefore idempotent by construction: the restarted
+leader sweeps the old job and re-plans from its reopened manifest.
 """
 
 from __future__ import annotations
@@ -99,26 +103,34 @@ class RemoteCompactionManager:
             return "declined"
         if getattr(pick, "kind", None) not in OFFLOADABLE_KINDS:
             return "declined"
-        plan = self._db.plan_full_compaction()
+        # Snapshot WITHOUT the compaction mutex (round 19): the leader
+        # holds the shard's mutex only for the final verify+install, so
+        # local L0 picks and manual compact_range are never serialized
+        # behind a slow worker's whole publish→claim→merge→download
+        # round trip. The snapshot is revalidated under the mutex at
+        # install time (engine.begin_full_install); a concurrent local
+        # compaction that consumed an input makes the remote result
+        # STALE — it is discarded, the local outcome stands. A GC'd
+        # input mid-upload surfaces as an IO error here and falls back
+        # locally; correctness never depends on the race.
+        plan = self._db.snapshot_full_compaction()
         if plan is None:
             return "declined"
         job_id = uuid.uuid4().hex[:16]
-        # install_full_compaction consumes the plan's mutex even when it
-        # raises, so every error path below must know whether the plan
-        # is still ours to abort
+        # install_full_compaction consumes the mutex won by
+        # begin_full_install even when it raises; ``consumed`` tracks
+        # whether the install phase owns it (no mutex is held anywhere
+        # else anymore)
         consumed = {"plan": False}
         try:
             input_bytes = sum(r.file_size for r in plan["runs"])
             if input_bytes < self.policy.size_floor_bytes:
-                self._db.abort_full_compaction(plan)
                 return "declined"
             job = self._publish(plan, job_id, input_bytes)
             outcome = self._await_and_install(plan, job, consumed)
         except FencedInstallError as e:
             log.warning("%s: %s", self.db_name, e)
             self._sweep_job(job_id)
-            if not consumed["plan"]:
-                self._db.abort_full_compaction(plan)
             self.fenced += 1
             self._queue.bump_summary("fenced")
             Stats.get().incr(
@@ -129,15 +141,13 @@ class RemoteCompactionManager:
                           self.db_name)
             self._sweep_job(job_id)
             if not consumed["plan"]:
-                self._db.abort_full_compaction(plan)
                 self._note_failover()
                 return "declined"
-            # the plan died inside install_full_compaction itself — the
+            # the swap died inside install_full_compaction itself — the
             # pick was half-applied territory; surface to the bg loop
             raise
         if outcome != "installed":
             self._sweep_job(job_id)
-            self._db.abort_full_compaction(plan)
             self._note_failover()
             return "declined"
         return "installed"
@@ -248,9 +258,20 @@ class RemoteCompactionManager:
             fp.hit("compact.remote.install")
         except Exception:
             # outputs never joined the manifest — sweep them and let the
-            # caller fall back locally (plan mutex still held by caller)
+            # caller fall back locally (no mutex held yet)
             self._db._discard_outputs(local_names)
             raise
+        # verified generation on disk: only NOW win the compaction
+        # mutex, revalidating the snapshot's inputs are still live —
+        # the whole remote round trip above ran mutex-free (round 19)
+        if not self._db.begin_full_install(plan):
+            log.info("%s: snapshot went stale during remote merge "
+                     "(local compaction won); discarding job %s",
+                     self.db_name, job.job_id)
+            self._db._discard_outputs(local_names)
+            Stats.get().incr(
+                tagged("compaction.remote.stale", db=self.db_name))
+            return "stale"
         consumed["plan"] = True
         self._db.install_full_compaction(
             plan, files=local_names, remote=True)

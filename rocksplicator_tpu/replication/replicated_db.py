@@ -1087,6 +1087,22 @@ class ReplicatedDB:
             gate = self.read_gate(max_lag=max_lag, epoch=epoch)
             values = await self._loop.run_in_executor(
                 self._executor, self._do_read, op, keys, start, count)
+            if op in ("multi_get", "scan"):
+                # round-19 tail armor: re-check the request deadline
+                # before a potentially large response is serialized —
+                # the engine read may have spent the whole budget, and
+                # encoding N values nobody is waiting for only delays
+                # live requests behind this connection
+                from ..rpc.deadline import current_deadline
+
+                dl = current_deadline()
+                if dl is not None and dl.expired:
+                    self._stats.incr(tagged("reads.deadline_shed", op=op))
+                    raise RpcApplicationError(
+                        "DEADLINE_EXCEEDED",
+                        f"{self.name}: {op} deadline expired "
+                        f"{-dl.remaining_ms():.1f}ms ago before "
+                        "response serialization")
             if self.role in (ReplicaRole.LEADER, ReplicaRole.NOOP):
                 self._stats.incr(R["leader_served"])
             else:

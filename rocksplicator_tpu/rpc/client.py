@@ -17,6 +17,8 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from ..testing import failpoints as fp
+from .deadline import (DEADLINE_KEY, TENANT_KEY, armor_enabled,
+                       current_deadline, current_tenant)
 from .errors import (RpcApplicationError, RpcConnectionError, RpcTimeout,
                      RpcTransportConfigError)
 from .serde import decode_message, encode_message
@@ -142,10 +144,18 @@ class RpcClient:
         self, method: str, args: Optional[Dict[str, Any]] = None,
         timeout: Optional[float] = 30.0,
         tail_exempt: bool = False,
+        deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> Any:
         """``tail_exempt=True`` marks a call whose long RTT is BY DESIGN
         (a long-poll pull parks server-side up to max_wait_ms): the
-        tracing tail-keep path must not retain it as a slow outlier."""
+        tracing tail-keep path must not retain it as a slow outlier.
+
+        ``deadline_ms``/``tenant`` stamp the round-19 tail-armor frame
+        headers (rpc/deadline): an explicit value wins; otherwise the
+        AMBIENT request scope propagates — a handler fanning out
+        downstream re-stamps its caller's decremented budget and tenant
+        automatically, like the trace context."""
         if not self.is_good:
             raise RpcConnectionError(f"client {self.host}:{self.port} not connected")
         req_id = next(self._ids)
@@ -163,6 +173,22 @@ class RpcClient:
             }
             if sp.sampled:
                 msg[TRACE_KEY] = sp.to_wire()
+            if armor_enabled():
+                budget_ms = deadline_ms
+                if budget_ms is None:
+                    ambient = current_deadline()
+                    if ambient is not None:
+                        budget_ms = ambient.remaining_ms()
+                if budget_ms is not None:
+                    # relative budget on the wire — wall clocks across
+                    # processes are not comparable (deadline.py); an
+                    # already-negative budget still ships so the server
+                    # sheds with the TYPED error instead of serving it
+                    msg[DEADLINE_KEY] = round(float(budget_ms), 3)
+                wire_tenant = tenant if tenant is not None \
+                    else current_tenant()
+                if wire_tenant is not None:
+                    msg[TENANT_KEY] = wire_tenant
             header, chunks = encode_message(msg)
             try:
                 conn = self._conn
@@ -184,6 +210,31 @@ class RpcClient:
                 raise RpcTimeout(
                     f"{method} to {self.host}:{self.port} timed out"
                 ) from None
+            except asyncio.CancelledError:
+                # a cancelled caller (hedged-read loser) stops waiting
+                # HERE: drop the pending future so the late answer is
+                # discarded by _recv_loop's pop-miss, and tell the
+                # server to stop working on it — best-effort, off the
+                # cancellation path (the winner must not wait on the
+                # loser's cancel frame reaching a slow server)
+                self._pending.pop(req_id, None)
+                if armor_enabled():
+                    asyncio.ensure_future(self._send_cancel(req_id))
+                raise
+
+    async def _send_cancel(self, req_id: int) -> None:
+        """Best-effort ``cancel`` control frame (no "method" key, never
+        replied to): the server cancels the matching in-flight dispatch
+        task if the request is still running. Losing the frame is fine
+        — the reply is discarded client-side either way."""
+        conn = self._conn
+        if conn is None or not self.is_good:
+            return
+        try:
+            header, chunks = encode_message({"cancel": req_id})
+            await conn.send_frames([(header, chunks)])
+        except (ConnectionError, OSError):
+            pass
 
     async def close(self) -> None:
         self.is_good = False

@@ -86,10 +86,13 @@ class RpcClientPool:
 
     async def call(self, host: str, port: int, method: str, args=None,
                    timeout: Optional[float] = 30.0,
-                   tail_exempt: bool = False):
+                   tail_exempt: bool = False,
+                   deadline_ms: Optional[float] = None,
+                   tenant: Optional[str] = None):
         client = await self.get_client(host, port)
         return await client.call(method, args, timeout,
-                                 tail_exempt=tail_exempt)
+                                 tail_exempt=tail_exempt,
+                                 deadline_ms=deadline_ms, tenant=tenant)
 
     def peek(self, host: str, port: int) -> Optional[RpcClient]:
         return self._clients.get((host, port))

@@ -11,10 +11,12 @@ replicas share load. The map file is hot-reloaded via the file watcher.
 
 from __future__ import annotations
 
+import asyncio
 import enum
 import itertools
 import json
 import logging
+import os
 import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -24,6 +26,7 @@ from ..utils.file_watcher import FileWatcher
 from ..utils.stats import Stats, tagged
 from ..utils.timer import Timer
 from .client_pool import RpcClientPool
+from .deadline import armor_enabled
 from .errors import RpcApplicationError, RpcConnectionError, RpcTimeout
 
 log = logging.getLogger(__name__)
@@ -42,6 +45,13 @@ _READ_BOUNCE_CODES = frozenset(
 # dropped the db mid-repoint (SOURCE_NOT_FOUND)
 _WRITE_BOUNCE_CODES = frozenset(
     ("NOT_LEADER", "STALE_EPOCH", "SOURCE_NOT_FOUND"))
+
+
+def _retrieve_exception(task: "asyncio.Task") -> None:
+    """Done-callback for hedge arms: a loser that errors after the
+    winner returned must not log "exception was never retrieved"."""
+    if not task.cancelled():
+        task.exception()
 
 
 class Role(enum.Enum):
@@ -181,6 +191,13 @@ class RpcRouter:
         # followers (itertools.count is GIL-atomic enough for a counter)
         self._read_seq = itertools.count()
         self._stats = Stats.get()
+        # Hedge budget (round 19): every eligible follower_ok read
+        # earns RSTPU_HEDGE_PCT credit; firing one hedge spends 1.0 —
+        # a hard ≤PCT extra-read cap so hedging cannot amplify the very
+        # overload it defends against. The small cap bounds bursts
+        # after an idle stretch. Loop-thread only, no lock needed.
+        self._hedge_credit = 0.0
+        self._hedge_credit_cap = 5.0
         if shard_map_path is not None:
             FileWatcher.instance().add_file(shard_map_path, self._on_map_content)
 
@@ -361,6 +378,11 @@ class RpcRouter:
             "epoch": epoch,
         }
         with Timer(tagged("router.read_ms", op=op, policy=policy.kind)):
+            if (policy.kind == "follower_ok" and len(hosts) >= 2
+                    and self._hedging_on()):
+                return await self._hedged_read(
+                    hosts, op, policy, args, timeout,
+                    what=f"read {segment}:{shard}")
             return await self._failover_call(
                 hosts, "read", args, _READ_BOUNCE_CODES, timeout,
                 retry_timeouts=True, count_bounces=True,
@@ -392,6 +414,107 @@ class RpcRouter:
             hosts, "write", args, _WRITE_BOUNCE_CODES, timeout,
             retry_timeouts=False, count_bounces=False,
             what=f"write {segment}:{shard}")
+
+    # -- hedged bounded-staleness reads (round 19) ------------------------
+
+    @staticmethod
+    def _hedging_on() -> bool:
+        """Hedging rides the RSTPU_TAIL_ARMOR killswitch with its own
+        finer-grained switch (``RSTPU_HEDGE=0``): the overload bench
+        A/Bs the layers independently."""
+        return armor_enabled() and os.environ.get(
+            "RSTPU_HEDGE", "1").strip().lower() not in ("0", "false",
+                                                        "off", "no")
+
+    def _hedge_delay_s(self, op: str, policy: ReadPolicy) -> float:
+        """Backup-request delay: the streaming p95 of THIS op class's
+        routed read latency (the round-13 ``router.read_ms`` log-bucket
+        histogram — hedge only the slowest ~5%), floored so a cold or
+        microsecond-fast histogram can't make hedging fire on every
+        read. Floor via ``RSTPU_HEDGE_FLOOR_MS`` (default 5ms)."""
+        try:
+            floor_ms = float(
+                os.environ.get("RSTPU_HEDGE_FLOOR_MS", "") or 5.0)
+        except ValueError:
+            floor_ms = 5.0
+        p95 = self._stats.metric_percentile(
+            tagged("router.read_ms", op=op, policy=policy.kind), 95)
+        return max(floor_ms, p95 or 0.0) / 1e3
+
+    def _spend_hedge_credit(self) -> bool:
+        if self._hedge_credit < 1.0:
+            return False
+        self._hedge_credit -= 1.0
+        return True
+
+    async def _hedged_read(self, hosts: List[Host], op: str,
+                           policy: ReadPolicy, args: dict,
+                           timeout: float, what: str):
+        """Tail-shaving backup request: if the primary failover chain
+        hasn't answered within the p95-derived delay, fire the SAME
+        bounded-staleness read down a rotated chain starting at the
+        next replica and surface the first SUCCESS (reads are
+        idempotent by construction — both arms may execute fully). The
+        loser is cancelled, which rides RpcClient's cancellation path
+        into a best-effort wire ``cancel`` frame; a late answer is
+        discarded by the client's pending-future pop."""
+        try:
+            pct = float(os.environ.get("RSTPU_HEDGE_PCT", "") or 0.05)
+        except ValueError:
+            pct = 0.05
+        self._hedge_credit = min(self._hedge_credit_cap,
+                                 self._hedge_credit + pct)
+        primary = asyncio.ensure_future(self._failover_call(
+            hosts, "read", args, _READ_BOUNCE_CODES, timeout,
+            retry_timeouts=True, count_bounces=True, what=what))
+        primary.add_done_callback(_retrieve_exception)
+        done, _pending = await asyncio.wait(
+            {primary}, timeout=self._hedge_delay_s(op, policy))
+        if done:
+            # rstpu-check: allow(loop-blocking) primary is in `done` from asyncio.wait — result() on a finished task returns immediately
+            return primary.result()
+        if not self._spend_hedge_credit():
+            # over the extra-read budget: degrade to the plain chain
+            self._stats.incr(tagged("router.hedge_budget_denied", op=op))
+            return await primary
+        try:
+            await fp.async_hit("router.hedge.fire")
+        except fp.FailpointError:
+            # chaos seam: the hedge failed to launch — the primary arm
+            # must still win on its own (hedging is an optimization,
+            # never a correctness dependency)
+            return await primary
+        self._stats.incr(tagged("router.hedges", op=op))
+        backup = asyncio.ensure_future(self._failover_call(
+            hosts[1:] + hosts[:1], "read", args, _READ_BOUNCE_CODES,
+            timeout, retry_timeouts=True, count_bounces=False,
+            what=what + " (hedge)"))
+        backup.add_done_callback(_retrieve_exception)
+        arms = {primary, backup}
+        last_err: Optional[BaseException] = None
+        try:
+            while arms:
+                done, arms = await asyncio.wait(
+                    arms, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    if t.cancelled():
+                        continue
+                    err = t.exception()
+                    if err is None:
+                        if t is backup:
+                            self._stats.incr(
+                                tagged("router.hedge_wins", op=op))
+                        # rstpu-check: allow(loop-blocking) t is in `done` from asyncio.wait — result() on a finished task returns immediately
+                        return t.result()
+                    # an errored arm is not the verdict while the other
+                    # is still running: remember it and keep waiting
+                    last_err = err
+            raise last_err if last_err is not None \
+                else RpcConnectionError(f"{what}: no candidate answered")
+        finally:
+            for t in (primary, backup):
+                if not t.done():
+                    t.cancel()
 
     async def _failover_call(
         self,

@@ -21,8 +21,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
+from .admission import TenantAdmission, sanitize_tenant
+from .deadline import (DEADLINE_EXCEEDED, DEADLINE_KEY, RETRY_LATER,
+                       TENANT_KEY, Deadline, armor_enabled, request_scope)
 from .errors import RpcApplicationError, RpcTransportConfigError
 from .ioloop import IoLoop
 from .serde import decode_message, encode_message
@@ -37,9 +41,27 @@ from .transport import (
 )
 from ..observability.context import TRACE_KEY
 from ..observability.span import start_span
-from ..utils.stats import Stats
+from ..testing import failpoints as fp
+from ..utils.stats import Stats, tagged
 
 log = logging.getLogger(__name__)
+
+
+def _request_cost_bytes(args: Dict[str, Any]) -> int:
+    """Admission byte-cost of a request: the payload-bearing argument
+    sizes (a write's raw_batch, a multi_get's key list). One shallow
+    pass — this runs on every metered dispatch."""
+    cost = 0
+    for v in args.values():
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            cost += len(v)
+        elif isinstance(v, str):
+            cost += len(v)
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                if isinstance(item, (bytes, bytearray, memoryview, str)):
+                    cost += len(item)
+    return cost
 
 
 class RpcServer:
@@ -236,22 +258,54 @@ class RpcServer:
                 return
         await self._serve_connection(TcpConnection(reader, writer))
 
+    # methods a peer's best-effort ``cancel`` frame may abort mid-flight:
+    # idempotent reads only — cancelling a write task could leave the
+    # commit half-acked (the client-side hedger only hedges reads, but
+    # the wire frame is untrusted input and must not widen that contract)
+    _CANCELLABLE = frozenset({"read"})
+
     async def _serve_connection(self, conn: Connection) -> None:
         """Transport-agnostic per-connection serve loop (every transport's
         accept path funnels here)."""
         task = asyncio.current_task()
         inflight: set = set()
+        # req_id -> (dispatch task, method) for cancel-frame lookup
+        by_id: Dict[Any, tuple] = {}
         self._connections[task] = inflight
+        loop = asyncio.get_running_loop()
         try:
             while True:
                 frames = await conn.recv_frames()
+                # one receipt stamp per batch: queue wait measured in
+                # _dispatch is (dispatch start - receipt), i.e. the
+                # event-loop backlog a request sat behind — the signal
+                # the deadline check charges against the budget
+                recv_ts = loop.time()
                 for header, payload in frames:
                     msg = decode_message(header, payload)
+                    if "cancel" in msg and "method" not in msg:
+                        # control frame, never replied to: abort the
+                        # matching in-flight dispatch if it is still
+                        # running AND its method is cancellable
+                        entry = by_id.get(msg.get("cancel"))
+                        if entry is not None:
+                            t, m = entry
+                            if m in self._CANCELLABLE and not t.done():
+                                t.cancel()
+                                Stats.get().incr(
+                                    tagged("rpc.cancelled", method=m))
+                        continue
                     # Each request runs as its own task so slow handlers
                     # (e.g. long-poll replicate) don't block the
                     # connection.
-                    t = asyncio.ensure_future(self._dispatch(msg, conn))
+                    t = asyncio.ensure_future(
+                        self._dispatch(msg, conn, recv_ts))
                     inflight.add(t)
+                    req_id = msg.get("id")
+                    if req_id is not None:
+                        by_id[req_id] = (t, msg.get("method", ""))
+                        t.add_done_callback(
+                            lambda _f, rid=req_id: by_id.pop(rid, None))
                     t.add_done_callback(inflight.discard)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
@@ -269,13 +323,27 @@ class RpcServer:
             except (ConnectionError, OSError):
                 pass
 
-    async def _dispatch(self, msg: Dict[str, Any],
-                        conn: Connection) -> None:
+    async def _dispatch(self, msg: Dict[str, Any], conn: Connection,
+                        recv_ts: Optional[float] = None) -> None:
         req_id = msg.get("id")
         method = msg.get("method", "")
         args = msg.get("args") or {}
         stats = Stats.get()
         stats.incr(f"rpc.{method}.received")
+        # Round-19 tail armor (killswitch RSTPU_TAIL_ARMOR=0 restores
+        # the bare pre-armor dispatch): measure the event-loop backlog
+        # this request sat behind, then run the admission edge —
+        # deadline-vs-queue-wait shedding and per-tenant token buckets
+        # — BEFORE the handler, so dead or over-quota work is never
+        # computed.
+        armored = armor_enabled()
+        tenant = msg.get(TENANT_KEY) if armored else None
+        deadline: Optional[Deadline] = None
+        queue_wait_ms = 0.0
+        if armored and recv_ts is not None:
+            queue_wait_ms = max(
+                0.0,
+                (asyncio.get_running_loop().time() - recv_ts) * 1e3)
         # Reattach the caller's trace context (injected by RpcClient.call
         # into the JSON frame header): the server span joins the caller's
         # trace; without a header it rolls local head sampling. This task
@@ -283,13 +351,33 @@ class RpcServer:
         # scoped to this request.
         with start_span("rpc.server", remote=msg.get(TRACE_KEY),
                         method=method) as sp:
+            t0 = time.monotonic()
             try:
                 if self._draining:
                     raise RpcApplicationError("SHUTDOWN", "server draining")
+                if armored:
+                    deadline = await self._admission_check(
+                        method, msg, tenant, queue_wait_ms, stats)
                 fn = self._find_handler(method)
-                result = await fn(**args)
+                with request_scope(deadline=deadline, tenant=tenant):
+                    result = await fn(**args)
+                if deadline is not None and deadline.expired:
+                    # the budget ran out while the handler was working:
+                    # nobody is waiting for this reply — skip the
+                    # serialization and ship the typed error instead
+                    stats.incr(tagged("rpc.deadline_shed", method=method,
+                                      stage="post"))
+                    raise RpcApplicationError(
+                        DEADLINE_EXCEEDED,
+                        f"{method}: deadline expired during service "
+                        f"({-deadline.remaining_ms():.1f}ms ago)")
                 reply = {"id": req_id, "ok": True, "result": result}
                 stats.incr(f"rpc.{method}.success")
+                if tenant is not None:
+                    tname = sanitize_tenant(tenant)
+                    stats.incr(tagged("rpc.tenant_served", tenant=tname))
+                    stats.add_metric(tagged("rpc.tenant_ms", tenant=tname),
+                                     (time.monotonic() - t0) * 1e3)
             except RpcApplicationError as e:
                 reply = {
                     "id": req_id,
@@ -310,12 +398,86 @@ class RpcServer:
                 sp.annotate(error_code="INTERNAL")
                 stats.incr(f"rpc.{method}.internal_error")
             header, chunks = encode_message(reply)
+            if armored and tenant is not None:
+                # response bytes are only known after encode: post-hoc
+                # debit lets an oversized scan answer push the tenant's
+                # byte bucket negative, deferring its next admission
+                TenantAdmission.get().debit_bytes(
+                    tenant, len(header) + sum(len(c) for c in chunks))
             try:
                 # replies from concurrent dispatches coalesce in the
                 # transport (no per-connection write lock needed)
                 await conn.send_frames([(header, chunks)])
             except (ConnectionError, OSError):
                 pass
+
+    async def _admission_check(self, method: str, msg: Dict[str, Any],
+                         tenant: Optional[str], queue_wait_ms: float,
+                         stats) -> Optional[Deadline]:
+        """The round-19 admission edge, run before handler dispatch.
+        Raises typed errors (DEADLINE_EXCEEDED / RETRY_LATER) to shed;
+        returns the re-anchored request Deadline (or None) to scope
+        around the handler. Order matters: the deadline verdict first —
+        a dead request must not spend tenant tokens."""
+        deadline: Optional[Deadline] = None
+        budget_ms = msg.get(DEADLINE_KEY)
+        if budget_ms is not None:
+            stats.add_metric("rpc.queue_wait_ms", queue_wait_ms)
+            forced_expired = False
+            try:
+                await fp.async_hit("rpc.deadline.check")
+            except fp.FailpointError:
+                # an armed seam forces the expired verdict — chaos
+                # drives the shed path itself, not an INTERNAL error
+                forced_expired = True
+            remaining = float(budget_ms) - queue_wait_ms
+            if forced_expired or remaining <= 0.0:
+                stats.incr(tagged("rpc.deadline_shed", method=method))
+                raise RpcApplicationError(
+                    DEADLINE_EXCEEDED,
+                    f"{method}: deadline spent before dispatch (budget "
+                    f"{float(budget_ms):.1f}ms, queue "
+                    f"{queue_wait_ms:.1f}ms)")
+            if queue_wait_ms > remaining:
+                # backlog trend: we already queued longer than the whole
+                # budget that is left, so service + response would land
+                # dead — shed EARLY with a hint sized to the measured
+                # wait (the jittered consumption lives in retry_policy)
+                stats.incr(tagged("rpc.retry_later", method=method,
+                                  reason="backlog"))
+                raise RpcApplicationError(
+                    RETRY_LATER,
+                    f"{method}: queued {queue_wait_ms:.1f}ms with only "
+                    f"{remaining:.1f}ms of budget left",
+                    {"retry_after_ms": round(queue_wait_ms, 1)})
+            deadline = Deadline.after_ms(remaining)
+        if tenant is not None:
+            # only TAGGED requests are metered: internal plane traffic
+            # (replication pulls, coordinator RPCs) carries no tenant
+            # and must never be shed by a product tenant's bucket
+            adm = TenantAdmission.get()
+            forced_shed = False
+            try:
+                # armed even with no quotas configured: chaos forces the
+                # quota-shed path without env manipulation
+                await fp.async_hit("admission.shed")
+            except fp.FailpointError:
+                forced_shed = True
+            if adm.configured or forced_shed:
+                ok, retry_after_ms = (
+                    adm.admit(tenant,
+                              _request_cost_bytes(msg.get("args") or {}))
+                    if adm.configured else (True, None))
+                if forced_shed or not ok:
+                    tname = sanitize_tenant(tenant)
+                    stats.incr(tagged("rpc.tenant_shed", tenant=tname,
+                                      reason="quota"))
+                    raise RpcApplicationError(
+                        RETRY_LATER,
+                        f"{method}: tenant {tname} over quota",
+                        {"retry_after_ms":
+                         round(retry_after_ms or 10.0, 1)})
+        return deadline
 
     def _find_handler(self, method: str):
         for handler in self._handlers:

@@ -1760,6 +1760,57 @@ class DB:
             self._compaction_mutex.release()
             raise
 
+    def snapshot_full_compaction(self) -> Optional[dict]:
+        """Mutex-FREE sibling of :meth:`plan_full_compaction` for the
+        disaggregated tier (round 19): flush, then snapshot the live
+        input set WITHOUT taking the compaction mutex, so local L0
+        picks and manual compact_range keep running while a worker
+        merges off-node. The snapshot is only a CANDIDATE — before
+        installing, the caller must win the mutex and revalidate via
+        :meth:`begin_full_install`; a concurrent local compaction may
+        have consumed (and GC'd) any of these inputs, in which case the
+        remote result is discarded and the local outcome stands."""
+        self.flush()
+        with self._lock:
+            self._check_open()
+            bottom = self.options.num_levels - 1
+            if self.options.allow_ingest_behind:
+                bottom -= 1
+            inputs: List[str] = [
+                n for files in self._levels for n in files
+            ]
+            if not inputs:
+                return None
+            runs = [self._readers[n] for n in inputs]
+        return {
+            "inputs": inputs,
+            "runs": runs,
+            "bottom": bottom,
+            "drop_tombstones": not self.options.allow_ingest_behind,
+            "snapshot": True,
+        }
+
+    def begin_full_install(self, plan: dict) -> bool:
+        """Win the compaction mutex for a SNAPSHOT plan's install and
+        revalidate every input is still live (no local compaction
+        consumed one while the remote merge ran). True: the caller now
+        owns the mutex exactly as after :meth:`plan_full_compaction` —
+        exactly one of install_full_compaction / abort_full_compaction
+        must consume it. False: the snapshot is stale and NOTHING is
+        held — the caller discards the remote outputs."""
+        self._compaction_mutex.acquire()
+        try:
+            with self._lock:
+                self._check_open()
+                live = {n for files in self._levels for n in files}
+                if not set(plan["inputs"]) <= live:
+                    self._compaction_mutex.release()
+                    return False
+            return True
+        except BaseException:
+            self._compaction_mutex.release()
+            raise
+
     def allocate_sst(self) -> Tuple[str, str]:
         """Reserve an SST file name for an external compaction sink;
         returns (name, absolute path). The file only becomes live when a

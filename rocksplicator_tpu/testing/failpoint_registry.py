@@ -78,4 +78,12 @@ REGISTRY = {
     "compact.remote.upload": "worker output-SST upload failure",
     "compact.remote.install": "leader-side verified-install failure",
     "compact.remote.heartbeat": "worker liveness heartbeat failure",
+    # tail armor (round 19): arming these drives the SHED/DEGRADE paths
+    # themselves, not INTERNAL errors — a tripped deadline check forces
+    # the DEADLINE_EXCEEDED verdict, a tripped admission check forces a
+    # RETRY_LATER shed, and a tripped hedge launch falls back to the
+    # plain primary chain (hedging is never a correctness dependency)
+    "rpc.deadline.check": "server deadline check forces expired verdict",
+    "admission.shed": "tenant admission forces a RETRY_LATER shed",
+    "router.hedge.fire": "hedged-read backup launch failure",
 }

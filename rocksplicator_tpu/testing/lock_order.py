@@ -59,7 +59,7 @@ RANKS = {
     "rocksplicator_tpu/replication/replicated_db.py:161": ('ReplicatedDB._expiry_lock', 39),
     "rocksplicator_tpu/replication/replicated_db.py:241": ('ReplicatedDB._write_traces_lock', 40),
     "rocksplicator_tpu/replication/replicator.py:45": ('Replicator._instance_lock', 41),
-    "rocksplicator_tpu/utils/retry_policy.py:57": ('RetryBudget._lock', 42),
+    "rocksplicator_tpu/utils/retry_policy.py:77": ('RetryBudget._lock', 42),
     "rocksplicator_tpu/utils/s3_stub.py:48": ('S3StubServer.lock', 43),
     "rocksplicator_tpu/observability/collector.py:47": ('SpanCollector._instance_lock', 44),
     "rocksplicator_tpu/utils/ssl_context_manager.py:57": ('SslContextManager._lock', 45),
@@ -67,22 +67,25 @@ RANKS = {
     "rocksplicator_tpu/utils/stats.py:212": ('Stats._instance_lock', 47),
     "rocksplicator_tpu/utils/stats.py:218": ('Stats._lock', 48),
     "rocksplicator_tpu/utils/status_server.py:31": ('StatusServer._instance_lock', 49),
-    "rocksplicator_tpu/tpu/compaction_service.py:41": ('TpuCompactionService._instance_lock', 50),
-    "rocksplicator_tpu/storage/archive.py:63": ('WalArchiver._mutex', 51),
-    "rocksplicator_tpu/testing/failpoints.py:129": ('_Site.lock', 52),
-    "rocksplicator_tpu/utils/stats.py:200": ('_ThreadBuffer.lock', 53),
-    "rocksplicator_tpu/kafka/broker.py:204": ('kafka.broker:_clusters_lock', 54),
-    "rocksplicator_tpu/storage/native/binding.py:472": ('storage.native.binding:_native_lock', 55),
-    "rocksplicator_tpu/testing/failpoints.py:161": ('testing.failpoints:_lock', 56),
-    "rocksplicator_tpu/utils/objectstore.py:379": ('utils.objectstore:_store_cache_lock', 57),
-    "rocksplicator_tpu/admin/db_manager.py:20": ('ApplicationDBManager._lock', 58),
-    "rocksplicator_tpu/cluster/coordinator.py:296": ('CoordinatorServer._lock', 59),
-    "rocksplicator_tpu/storage/engine.py:222": ('DB._lock', 60),
-    "rocksplicator_tpu/storage/engine.py:258": ('DB._manifest_mutex', 61),
-    "rocksplicator_tpu/utils/file_watcher.py:40": ('FileWatcher._instance_lock', 62),
-    "rocksplicator_tpu/cluster/participant.py:75": ('Participant._state_lock', 63),
-    "rocksplicator_tpu/storage/compaction_scheduler.py:123": ('IoBudget._lock', 64),
-    "rocksplicator_tpu/storage/wal.py:68": ('WalWriter._sync_lock', 65),
+    "rocksplicator_tpu/rpc/admission.py:115": ('TenantAdmission._instance_lock', 50),
+    "rocksplicator_tpu/rpc/admission.py:125": ('TenantAdmission._lock', 51),
+    "rocksplicator_tpu/rpc/admission.py:67": ('TokenBucket._lock', 52),
+    "rocksplicator_tpu/tpu/compaction_service.py:41": ('TpuCompactionService._instance_lock', 53),
+    "rocksplicator_tpu/storage/archive.py:63": ('WalArchiver._mutex', 54),
+    "rocksplicator_tpu/testing/failpoints.py:129": ('_Site.lock', 55),
+    "rocksplicator_tpu/utils/stats.py:200": ('_ThreadBuffer.lock', 56),
+    "rocksplicator_tpu/kafka/broker.py:204": ('kafka.broker:_clusters_lock', 57),
+    "rocksplicator_tpu/storage/native/binding.py:472": ('storage.native.binding:_native_lock', 58),
+    "rocksplicator_tpu/testing/failpoints.py:161": ('testing.failpoints:_lock', 59),
+    "rocksplicator_tpu/utils/objectstore.py:379": ('utils.objectstore:_store_cache_lock', 60),
+    "rocksplicator_tpu/admin/db_manager.py:20": ('ApplicationDBManager._lock', 61),
+    "rocksplicator_tpu/cluster/coordinator.py:296": ('CoordinatorServer._lock', 62),
+    "rocksplicator_tpu/storage/engine.py:222": ('DB._lock', 63),
+    "rocksplicator_tpu/storage/engine.py:258": ('DB._manifest_mutex', 64),
+    "rocksplicator_tpu/utils/file_watcher.py:40": ('FileWatcher._instance_lock', 65),
+    "rocksplicator_tpu/cluster/participant.py:75": ('Participant._state_lock', 66),
+    "rocksplicator_tpu/storage/compaction_scheduler.py:123": ('IoBudget._lock', 67),
+    "rocksplicator_tpu/storage/wal.py:68": ('WalWriter._sync_lock', 68),
 }
 
 # static partial order: (acquired-first, acquired-second)

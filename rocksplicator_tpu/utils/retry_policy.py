@@ -30,7 +30,27 @@ from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 __all__ = ["RetryPolicy", "RetryBudget", "retry_call", "backoff_step",
-           "seeded_rng"]
+           "seeded_rng", "retry_after_hint"]
+
+
+def retry_after_hint(exc: BaseException) -> Optional[float]:
+    """The server's retry-after hint, in SECONDS, from a typed
+    ``RETRY_LATER`` application error (round-19 tail armor: the
+    admission edge sheds with ``data={"retry_after_ms": ...}`` sized to
+    the bucket refill / measured backlog). Duck-typed on ``.code`` /
+    ``.data`` so this layer needs nothing from the rpc package. None
+    for every other exception shape — callers fall back to their
+    policy's own jittered delay."""
+    if getattr(exc, "code", None) != "RETRY_LATER":
+        return None
+    data = getattr(exc, "data", None)
+    if not isinstance(data, dict):
+        return None
+    try:
+        hint_ms = float(data.get("retry_after_ms"))
+    except (TypeError, ValueError):
+        return None
+    return max(0.0, hint_ms / 1e3)
 
 
 def seeded_rng(env_var: str = "RSTPU_RETRY_SEED") -> random.Random:
@@ -125,13 +145,19 @@ def backoff_step(
     budget: Optional[RetryBudget] = None,
     rng: Optional[random.Random] = None,
     sleep: Callable[[float], None] = time.sleep,
+    hint: Optional[float] = None,
 ) -> bool:
     """One retry-accounting step — the ONE place retries are counted
     (``retry.attempts op=<op>`` on /stats), budget-gated, and slept.
     Returns False when the attempt count or budget is exhausted (caller
     surfaces its error); True after sleeping the jittered delay. Shared
     by :func:`retry_call` and loops that interleave their own
-    status-code handling (the S3 client)."""
+    status-code handling (the S3 client).
+
+    ``hint`` (seconds, from :func:`retry_after_hint`) is a server-side
+    retry-after floor: the delay becomes ``max(jittered, hint * (1 +
+    U[0,0.25]))`` — honoring the admission edge's backlog estimate
+    while re-jittering so a shed cohort doesn't return in lockstep."""
     if attempt >= policy.max_attempts - 1:
         return False
     if budget is not None and not budget.try_spend():
@@ -142,7 +168,10 @@ def backoff_step(
         Stats.get().incr(tagged("retry.attempts", op=op or "?"))
     except Exception:
         pass
-    sleep(policy.delay(attempt, rng))
+    delay = policy.delay(attempt, rng)
+    if hint is not None and hint > 0.0:
+        delay = max(delay, hint * (1.0 + 0.25 * (rng or random).random()))
+    sleep(delay)
     return True
 
 
@@ -167,6 +196,7 @@ def retry_call(
             if not classify(e):
                 raise
             if not backoff_step(policy, attempt, op=op, budget=budget,
-                                rng=rng, sleep=sleep):
+                                rng=rng, sleep=sleep,
+                                hint=retry_after_hint(e)):
                 raise
             attempt += 1

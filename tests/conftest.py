@@ -77,10 +77,12 @@ def pytest_runtest_protocol(item, nextitem):
 def _fresh_singletons():
     """Reset process-wide singletons between tests."""
     from rocksplicator_tpu.observability.collector import SpanCollector
+    from rocksplicator_tpu.rpc.admission import TenantAdmission
     from rocksplicator_tpu.utils.stats import Stats
 
     Stats.reset_for_test()
     SpanCollector.reset_for_test()
+    TenantAdmission.reset_for_test()
     yield
 
 

@@ -1,0 +1,781 @@
+"""Round-19 tail armor: deadline propagation, hedged follower reads,
+per-tenant admission (rpc/deadline.py, rpc/admission.py, the server
+admission edge, RpcRouter._hedged_read, retry_policy's retry-after
+hint).
+
+The edge cases the tentpole is judged on: a deadline already expired at
+admission, one expiring mid-queue (RETRY_LATER backlog) and mid-service
+(stage=post), a hedged read where BOTH replicas answer (one result
+surfaced, loser counters right), RETRY_LATER honored by retry_policy
+with jittered backoff, and tenant-bucket refill determinism under
+RSTPU_RETRY_SEED. The armed failpoint seams ("rpc.deadline.check",
+"admission.shed", "router.hedge.fire") force each shed/degrade path
+deterministically.
+"""
+
+import asyncio
+import itertools
+import json
+import random
+
+import pytest
+
+from rocksplicator_tpu.rpc import (
+    ClusterLayout,
+    IoLoop,
+    RpcApplicationError,
+    RpcClientPool,
+    RpcRouter,
+    RpcServer,
+)
+from rocksplicator_tpu.rpc.admission import (
+    TenantAdmission,
+    TokenBucket,
+    sanitize_tenant,
+)
+from rocksplicator_tpu.rpc.deadline import (
+    DEADLINE_EXCEEDED,
+    RETRY_LATER,
+    Deadline,
+    current_deadline,
+    current_tenant,
+    request_scope,
+)
+from rocksplicator_tpu.rpc.router import ReadPolicy
+from rocksplicator_tpu.testing import failpoints as fp
+from rocksplicator_tpu.utils.stats import Stats, tagged
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset_for_test()
+    yield
+    fp.reset_for_test()
+
+
+def _counter(name: str) -> float:
+    s = Stats.get()
+    s.flush()
+    return s.get_counter(name)
+
+
+class ArmorHandler:
+    async def handle_echo(self, text=""):
+        return {"text": text}
+
+    async def handle_budget(self):
+        """Reports the re-anchored server-side deadline budget."""
+        dl = current_deadline()
+        return {"remaining_ms": None if dl is None else dl.remaining_ms(),
+                "tenant": current_tenant()}
+
+    async def handle_read(self, delay=None, **_kw):
+        """Named ``read`` so it is wire-cancellable (_CANCELLABLE).
+        Router-driven reads carry no ``delay`` arg; per-server slowness
+        comes from the handler's ``delay_s`` attribute."""
+        d = delay if delay is not None else getattr(self, "delay_s", 0.0)
+        try:
+            await asyncio.sleep(d)
+        except asyncio.CancelledError:
+            self.saw_cancel = True
+            raise
+        self.answered = True
+        return {"who": getattr(self, "who", "?")}
+
+    async def handle_slow(self, delay=1.0):
+        await asyncio.sleep(delay)
+        return {"done": True}
+
+
+@pytest.fixture()
+def armor_server():
+    ioloop = IoLoop.default()
+    server = RpcServer(port=0, ioloop=ioloop)
+    handler = ArmorHandler()
+    server.add_handler(handler)
+    server.start()
+    yield server, handler, ioloop
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expired_at_admission_sheds_typed(armor_server):
+    server, _h, ioloop = armor_server
+
+    async def go():
+        pool = RpcClientPool()
+        try:
+            with pytest.raises(RpcApplicationError) as ei:
+                await pool.call("127.0.0.1", server.port, "echo",
+                                {"text": "dead"}, deadline_ms=0.0)
+            assert ei.value.code == DEADLINE_EXCEEDED
+            # a live request on the same connection still serves
+            ok = await pool.call("127.0.0.1", server.port, "echo",
+                                 {"text": "alive"}, deadline_ms=5000.0)
+            assert ok["text"] == "alive"
+        finally:
+            await pool.close()
+
+    ioloop.run_sync(go(), timeout=15)
+    assert _counter(tagged("rpc.deadline_shed", method="echo")) == 1
+
+
+def test_deadline_mid_queue_retry_later_and_reanchor():
+    """The _admission_check verdict table, driven with synthetic queue
+    waits: queue longer than the WHOLE budget → expired; queue longer
+    than the REMAINING budget → RETRY_LATER with the measured wait as
+    the retry-after hint; otherwise the deadline re-anchors minus
+    queue time."""
+    ioloop = IoLoop.default()
+    server = RpcServer(port=0, ioloop=ioloop)
+    stats = Stats.get()
+
+    def check(msg, queue_wait_ms):
+        return ioloop.run_sync(server._admission_check(
+            "echo", msg, None, queue_wait_ms, stats), timeout=10)
+
+    # queue 120ms > budget 100ms: spent before dispatch
+    with pytest.raises(RpcApplicationError) as ei:
+        check({"deadline": 100.0}, 120.0)
+    assert ei.value.code == DEADLINE_EXCEEDED
+
+    # queue 60ms, budget 100ms: 40ms left < 60ms queue trend — shed
+    # EARLY with the measured wait as the hint
+    with pytest.raises(RpcApplicationError) as ei2:
+        check({"deadline": 100.0}, 60.0)
+    assert ei2.value.code == RETRY_LATER
+    assert ei2.value.data["retry_after_ms"] == 60.0
+    assert _counter(tagged("rpc.retry_later", method="echo",
+                           reason="backlog")) == 1
+
+    # queue 10ms, budget 100ms: admitted, re-anchored to ~90ms
+    dl = check({"deadline": 100.0}, 10.0)
+    assert dl is not None and 80.0 < dl.remaining_ms() <= 90.0
+
+    # no deadline on the frame: nothing to check
+    assert check({}, 10.0) is None
+
+
+def test_deadline_expires_mid_service_stage_post(armor_server):
+    server, _h, ioloop = armor_server
+
+    async def go():
+        pool = RpcClientPool()
+        try:
+            with pytest.raises(RpcApplicationError) as ei:
+                await pool.call("127.0.0.1", server.port, "read",
+                                {"delay": 0.08}, deadline_ms=20.0)
+            assert ei.value.code == DEADLINE_EXCEEDED
+            assert "during service" in ei.value.message
+        finally:
+            await pool.close()
+
+    ioloop.run_sync(go(), timeout=15)
+    assert _counter(tagged("rpc.deadline_shed", method="read",
+                           stage="post")) == 1
+
+
+def test_ambient_deadline_and_tenant_restamp_downstream(armor_server):
+    """A handler fanning out re-stamps its DECREMENTED budget and
+    tenant without plumbing arguments — the contextvar carriers."""
+    server, _h, ioloop = armor_server
+
+    async def go():
+        pool = RpcClientPool()
+        try:
+            with request_scope(deadline=Deadline.after_ms(500.0),
+                               tenant="tnt-a"):
+                return await pool.call("127.0.0.1", server.port, "budget")
+        finally:
+            await pool.close()
+
+    out = ioloop.run_sync(go(), timeout=15)
+    # the server re-anchored a budget <= our 500ms, minus wire+queue
+    assert out["remaining_ms"] is not None
+    assert 0.0 < out["remaining_ms"] <= 500.0
+    assert out["tenant"] == "tnt-a"
+
+
+def test_killswitch_unarmed_stamps_and_checks_nothing(
+        armor_server, monkeypatch):
+    monkeypatch.setenv("RSTPU_TAIL_ARMOR", "0")
+    server, _h, ioloop = armor_server
+
+    async def go():
+        pool = RpcClientPool()
+        try:
+            # a zero budget would shed when armed; unarmed it serves
+            out = await pool.call("127.0.0.1", server.port, "budget",
+                                  deadline_ms=0.0, tenant="noisy")
+            assert out["remaining_ms"] is None
+            assert out["tenant"] is None
+        finally:
+            await pool.close()
+
+    ioloop.run_sync(go(), timeout=15)
+    assert _counter(tagged("rpc.deadline_shed", method="budget")) == 0
+
+
+# ---------------------------------------------------------------------------
+# failpoint-forced sheds (the chaos seams, deterministically)
+# ---------------------------------------------------------------------------
+
+
+def test_failpoint_forces_deadline_shed(armor_server):
+    server, _h, ioloop = armor_server
+
+    async def go():
+        pool = RpcClientPool()
+        try:
+            with fp.failpoint("rpc.deadline.check", "fail_first:1"):
+                with pytest.raises(RpcApplicationError) as ei:
+                    await pool.call("127.0.0.1", server.port, "echo",
+                                    {"text": "x"}, deadline_ms=60_000.0)
+                assert ei.value.code == DEADLINE_EXCEEDED
+        finally:
+            await pool.close()
+
+    ioloop.run_sync(go(), timeout=15)
+
+
+def test_failpoint_forces_admission_shed_without_quotas(armor_server):
+    """admission.shed works with NO quotas configured — chaos forces
+    the tenant quota-shed path without env manipulation."""
+    server, _h, ioloop = armor_server
+    assert not TenantAdmission.get().configured
+
+    async def go():
+        pool = RpcClientPool()
+        try:
+            with fp.failpoint("admission.shed", "fail_first:1"):
+                with pytest.raises(RpcApplicationError) as ei:
+                    await pool.call("127.0.0.1", server.port, "echo",
+                                    {"text": "x"}, tenant="noisy")
+                assert ei.value.code == RETRY_LATER
+                assert ei.value.data["retry_after_ms"] > 0
+        finally:
+            await pool.close()
+
+    ioloop.run_sync(go(), timeout=15)
+    assert _counter(tagged("rpc.tenant_shed", tenant="noisy",
+                           reason="quota")) == 1
+
+
+# ---------------------------------------------------------------------------
+# per-tenant admission
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_quota_sheds_noisy_not_quiet(armor_server, monkeypatch):
+    monkeypatch.setenv("RSTPU_TENANT_OPS", "3")
+    TenantAdmission.reset_for_test()
+    server, _h, ioloop = armor_server
+
+    async def go():
+        pool = RpcClientPool()
+        outcomes = {"noisy_ok": 0, "noisy_shed": 0, "quiet_ok": 0}
+        try:
+            for i in range(10):
+                try:
+                    await pool.call("127.0.0.1", server.port, "echo",
+                                    {"text": str(i)}, tenant="noisy")
+                    outcomes["noisy_ok"] += 1
+                except RpcApplicationError as e:
+                    assert e.code == RETRY_LATER
+                    assert e.data["retry_after_ms"] > 0
+                    outcomes["noisy_shed"] += 1
+            # the noisy tenant's exhausted bucket is NOT the quiet
+            # tenant's problem: equal per-tenant buckets
+            await pool.call("127.0.0.1", server.port, "echo",
+                            {"text": "q"}, tenant="quiet")
+            outcomes["quiet_ok"] += 1
+            # untagged internal-plane traffic is never metered
+            await pool.call("127.0.0.1", server.port, "echo",
+                            {"text": "internal"})
+        finally:
+            await pool.close()
+        return outcomes
+
+    out = ioloop.run_sync(go(), timeout=15)
+    assert out["noisy_shed"] >= 6  # burst capacity ~3 of 10
+    assert out["noisy_ok"] >= 1
+    assert out["quiet_ok"] == 1
+    assert _counter(tagged("rpc.tenant_shed", tenant="noisy",
+                           reason="quota")) == out["noisy_shed"]
+    assert _counter(tagged("rpc.tenant_served", tenant="quiet")) == 1
+    assert _counter(tagged("rpc.tenant_shed", tenant="quiet",
+                           reason="quota")) == 0
+
+
+def test_token_bucket_refill_deterministic_with_fake_clock():
+    now = [100.0]
+    b = TokenBucket(rate=10.0, capacity=10.0, clock=lambda: now[0])
+    for _ in range(10):
+        assert b.try_take(1.0) == 0.0
+    # empty: the refill horizon for one token at 10/s is exactly 0.1s
+    assert b.try_take(1.0) == pytest.approx(0.1)
+    now[0] += 0.5  # 5 tokens back
+    assert b.tokens == pytest.approx(5.0)
+    assert b.try_take(5.0) == 0.0
+    # post-hoc debit may go negative; refill pays it off first
+    b.debit(3.0)
+    assert b.tokens == pytest.approx(-3.0)
+    now[0] += 0.3
+    assert b.tokens == pytest.approx(0.0)
+
+
+def test_tenant_admission_hints_deterministic_under_seed(monkeypatch):
+    monkeypatch.setenv("RSTPU_RETRY_SEED", "17")
+
+    def hints():
+        now = [0.0]
+        adm = TenantAdmission(ops_per_sec=2.0, clock=lambda: now[0])
+        out = []
+        for _ in range(6):
+            ok, retry_ms = adm.admit("t")
+            out.append(round(retry_ms, 6))
+        return out
+
+    a, b = hints(), hints()
+    assert a == b  # same seed, same jittered hint schedule
+    shed = [h for h in a if h > 0]
+    assert shed  # the 2-token burst exhausted; hints are jittered +0..25%
+    assert all(500.0 <= h <= 500.0 * 1.25 for h in shed)
+
+
+def test_admission_refunds_op_when_byte_bucket_refuses():
+    now = [0.0]
+    adm = TenantAdmission(ops_per_sec=10.0, bytes_per_sec=100.0,
+                          clock=lambda: now[0],
+                          rng=random.Random(1))
+    ok, retry_ms = adm.admit("t", cost_bytes=10_000)  # 100x the burst
+    assert not ok and retry_ms > 0
+    ops, _byt = adm._buckets_for("t")
+    assert ops.tokens == pytest.approx(10.0)  # shed cost the tenant nothing
+
+
+def test_sanitize_tenant_clamps_hostile_tags():
+    assert sanitize_tenant(None) == "default"
+    assert sanitize_tenant("") == "default"
+    assert sanitize_tenant('evil" } \n{') == "evil______"
+    assert len(sanitize_tenant("x" * 500)) == 32
+    assert sanitize_tenant("ok-tenant_1.a") == "ok-tenant_1.a"
+
+
+# ---------------------------------------------------------------------------
+# RETRY_LATER honored by retry_policy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_after_hint_extraction():
+    from rocksplicator_tpu.utils.retry_policy import retry_after_hint
+
+    e = RpcApplicationError(RETRY_LATER, "busy", {"retry_after_ms": 250.0})
+    assert retry_after_hint(e) == pytest.approx(0.25)
+    assert retry_after_hint(RpcApplicationError("INTERNAL", "x")) is None
+    assert retry_after_hint(ValueError("not typed")) is None
+    assert retry_after_hint(
+        RpcApplicationError(RETRY_LATER, "no hint")) is None
+
+
+def test_backoff_step_floors_delay_on_hint_with_jitter():
+    from rocksplicator_tpu.utils.retry_policy import (RetryPolicy,
+                                                      backoff_step)
+
+    policy = RetryPolicy(max_attempts=4, base_delay=0.001, max_delay=0.002)
+    slept = []
+
+    def record(d):
+        slept.append(d)
+
+    ok = backoff_step(policy, 0, op="t", rng=random.Random(5),
+                      sleep=record, hint=0.2)
+    assert ok
+    # jittered floor: hint * (1 + U[0, 0.25]) — never BELOW the server's
+    # estimate, never a lockstep cohort either
+    assert 0.2 <= slept[0] <= 0.25
+    # determinism under the same rng seed
+    slept2 = []
+    backoff_step(policy, 0, op="t", rng=random.Random(5),
+                 sleep=slept2.append, hint=0.2)
+    assert slept2 == slept
+
+
+def test_retry_call_consumes_server_hint():
+    from rocksplicator_tpu.utils.retry_policy import (RetryPolicy,
+                                                      retry_call)
+
+    slept = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RpcApplicationError(RETRY_LATER, "busy",
+                                      {"retry_after_ms": 100.0})
+        return "served"
+
+    out = retry_call(
+        flaky,
+        policy=RetryPolicy(max_attempts=5, base_delay=0.001,
+                           max_delay=0.002),
+        classify=lambda e: isinstance(e, RpcApplicationError)
+        and e.code == RETRY_LATER,
+        op="t", rng=random.Random(3), sleep=slept.append)
+    assert out == "served" and calls["n"] == 3
+    assert all(0.1 <= d <= 0.125 for d in slept)
+
+
+# ---------------------------------------------------------------------------
+# hedged follower reads
+# ---------------------------------------------------------------------------
+
+
+def _two_replica_router(ioloop, slow_delay=0.25):
+    """A follower_ok layout whose FOLLOWER is slow and LEADER fast —
+    the primary chain starts at the follower, the hedge covers it.
+    Shard-map host keys are ip:service_port:az:repl_port; routed reads
+    dial the 4th field."""
+    slow, fast = ArmorHandler(), ArmorHandler()
+    slow.who, fast.who = "slow", "fast"
+    slow.delay_s = slow_delay
+    slow_srv = RpcServer(port=0, ioloop=ioloop)
+    slow_srv.add_handler(slow)
+    slow_srv.start()
+    fast_srv = RpcServer(port=0, ioloop=ioloop)
+    fast_srv.add_handler(fast)
+    fast_srv.start()
+    shard_map = {
+        "seg": {
+            "num_shards": 1,
+            f"127.0.0.1:1:az1:{slow_srv.port}": ["00000:S"],
+            f"127.0.0.1:2:az1:{fast_srv.port}": ["00000:M"],
+        }
+    }
+    router = RpcRouter(local_az="az1")
+    router.update_layout(ClusterLayout.parse(json.dumps(shard_map).encode()))
+    router._read_seq = itertools.count()  # pin rotation: follower first
+
+    async def read():
+        # NB: does NOT close the pool — the loser's best-effort cancel
+        # frame is fire-and-forget and needs the connection alive;
+        # callers close via _teardown_router after their assertions
+        return await router.read(
+            "seg", 0, op="get", keys=[b"k"],
+            policy=ReadPolicy.follower_ok(max_lag=5), timeout=10.0)
+
+    return router, slow_srv, fast_srv, slow, fast, read
+
+
+def _teardown_router(ioloop, router, *servers):
+    ioloop.run_sync(router.pool.close(), timeout=10)
+    for srv in servers:
+        srv.stop()
+
+
+def test_hedged_read_loser_cancelled_one_result(monkeypatch):
+    """The hedge covers a slow follower; exactly ONE result surfaces,
+    the hedge win is counted, and the loser's cancel frame lands
+    (reads are the only wire-cancellable method)."""
+    monkeypatch.setenv("RSTPU_HEDGE_FLOOR_MS", "10")
+    ioloop = IoLoop.default()
+    router, slow_srv, fast_srv, slow, _fast, read = _two_replica_router(
+        ioloop)
+    router._hedge_credit = 1.0  # primed: the hedge may fire immediately
+
+    try:
+        # the slow follower sleeps 250ms; the 10ms hedge floor fires the
+        # backup at the fast leader, which wins
+        out = ioloop.run_sync(read(), timeout=20)
+        assert out["who"] == "fast"
+        assert _counter(tagged("router.hedges", op="get")) == 1
+        assert _counter(tagged("router.hedge_wins", op="get")) == 1
+        # loser cancelled over the wire: the slow server cancelled its
+        # in-flight read task (best-effort, so poll briefly)
+        deadline = Deadline.after_ms(3000.0)
+        while not getattr(slow, "saw_cancel", False) \
+                and not deadline.expired:
+            ioloop.run_sync(asyncio.sleep(0.02))
+        assert getattr(slow, "saw_cancel", False)
+        assert _counter(tagged("rpc.cancelled", method="read")) == 1
+    finally:
+        _teardown_router(ioloop, router, slow_srv, fast_srv)
+
+
+def test_hedged_read_both_replicas_answer_late_reply_discarded(
+        monkeypatch):
+    """BOTH replicas answer (the loser's cancel frame suppressed): one
+    result surfaces, the loser's late reply is discarded by the
+    client's pending-future pop, and the loser counters stay right —
+    no double-surfaced result, no unhandled-reply error."""
+    from rocksplicator_tpu.rpc.client import RpcClient
+
+    monkeypatch.setenv("RSTPU_HEDGE_FLOOR_MS", "10")
+
+    async def no_cancel(self, req_id):
+        return None
+
+    monkeypatch.setattr(RpcClient, "_send_cancel", no_cancel)
+    ioloop = IoLoop.default()
+    router, slow_srv, fast_srv, slow, fast, read = _two_replica_router(
+        ioloop, slow_delay=0.1)
+    router._hedge_credit = 1.0
+
+    try:
+        out = ioloop.run_sync(read(), timeout=20)
+        assert out["who"] == "fast"
+        assert _counter(tagged("router.hedges", op="get")) == 1
+        assert _counter(tagged("router.hedge_wins", op="get")) == 1
+        # with no cancel frame the slow replica runs to completion and
+        # ANSWERS — the reply has nobody waiting and is dropped
+        deadline = Deadline.after_ms(3000.0)
+        while not getattr(slow, "answered", False) \
+                and not deadline.expired:
+            ioloop.run_sync(asyncio.sleep(0.02))
+        assert getattr(slow, "answered", False)
+        assert getattr(fast, "answered", False)
+        assert not getattr(slow, "saw_cancel", False)
+        assert _counter(tagged("rpc.cancelled", method="read")) == 0
+    finally:
+        _teardown_router(ioloop, router, slow_srv, fast_srv)
+
+
+def test_hedge_budget_denied_degrades_to_plain_chain(monkeypatch):
+    monkeypatch.setenv("RSTPU_HEDGE_FLOOR_MS", "5")
+    monkeypatch.setenv("RSTPU_HEDGE_PCT", "0.0")  # never earns credit
+    ioloop = IoLoop.default()
+    router, slow_srv, fast_srv, _slow, _fast, read = _two_replica_router(
+        ioloop, slow_delay=0.05)
+    router._hedge_credit = 0.0
+
+    try:
+        out = ioloop.run_sync(read(), timeout=20)
+        # no credit: the plain chain runs — the slow follower still
+        # answers (≤5% extra-read budget is a hard cap, not a hint)
+        assert out["who"] == "slow"
+        assert _counter(tagged("router.hedge_budget_denied",
+                               op="get")) == 1
+        assert _counter(tagged("router.hedges", op="get")) == 0
+    finally:
+        _teardown_router(ioloop, router, slow_srv, fast_srv)
+
+
+def test_hedge_fire_failpoint_falls_back_to_primary(monkeypatch):
+    """router.hedge.fire armed: the hedge fails to launch and the
+    primary arm must win on its own — hedging is an optimization,
+    never a correctness dependency."""
+    monkeypatch.setenv("RSTPU_HEDGE_FLOOR_MS", "5")
+    ioloop = IoLoop.default()
+    router, slow_srv, fast_srv, _slow, _fast, read = _two_replica_router(
+        ioloop, slow_delay=0.05)
+    router._hedge_credit = 1.0
+
+    try:
+        with fp.failpoint("router.hedge.fire", "fail_first:1"):
+            out = ioloop.run_sync(read(), timeout=20)
+        assert out["who"] == "slow"  # primary answered; no backup ran
+        assert _counter(tagged("router.hedges", op="get")) == 0
+        assert _counter(tagged("router.hedge_wins", op="get")) == 0
+    finally:
+        _teardown_router(ioloop, router, slow_srv, fast_srv)
+
+
+def test_hedging_killswitch_off_uses_plain_chain(monkeypatch):
+    monkeypatch.setenv("RSTPU_HEDGE", "0")
+    ioloop = IoLoop.default()
+    router, slow_srv, fast_srv, _slow, _fast, read = _two_replica_router(
+        ioloop, slow_delay=0.03)
+    router._hedge_credit = 5.0
+
+    try:
+        out = ioloop.run_sync(read(), timeout=20)
+        assert out["who"] == "slow"
+        assert _counter(tagged("router.hedges", op="get")) == 0
+    finally:
+        _teardown_router(ioloop, router, slow_srv, fast_srv)
+
+
+# ---------------------------------------------------------------------------
+# wire cancel frames
+# ---------------------------------------------------------------------------
+
+
+def test_client_cancel_sends_wire_cancel_for_reads(armor_server):
+    server, handler, ioloop = armor_server
+
+    async def go():
+        pool = RpcClientPool()
+        try:
+            task = asyncio.ensure_future(pool.call(
+                "127.0.0.1", server.port, "read", {"delay": 5.0}))
+            await asyncio.sleep(0.1)  # in flight on the server
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            # best-effort frame: give the server a beat to process it
+            for _ in range(100):
+                if getattr(handler, "saw_cancel", False):
+                    break
+                await asyncio.sleep(0.02)
+        finally:
+            await pool.close()
+
+    ioloop.run_sync(go(), timeout=20)
+    assert getattr(handler, "saw_cancel", False)
+    assert _counter(tagged("rpc.cancelled", method="read")) == 1
+
+
+def test_cancel_frame_ignored_for_non_cancellable_methods(armor_server):
+    """Only reads are wire-cancellable: cancelling a ``slow`` call
+    (a stand-in for any non-idempotent method) abandons the reply but
+    must NOT cancel server-side work."""
+    server, _handler, ioloop = armor_server
+
+    async def go():
+        pool = RpcClientPool()
+        try:
+            task = asyncio.ensure_future(pool.call(
+                "127.0.0.1", server.port, "slow", {"delay": 0.3}))
+            await asyncio.sleep(0.05)
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            await asyncio.sleep(0.4)  # let the handler finish
+        finally:
+            await pool.close()
+
+    ioloop.run_sync(go(), timeout=20)
+    assert _counter(tagged("rpc.cancelled", method="slow")) == 0
+    assert _counter("rpc.slow.success") == 1  # ran to completion
+
+
+# ---------------------------------------------------------------------------
+# /cluster_stats per-tenant rollup
+# ---------------------------------------------------------------------------
+
+
+def test_aggregator_rolls_up_per_tenant():
+    import time as _time
+
+    from rocksplicator_tpu.cluster.stats_aggregator import \
+        ClusterStatsAggregator
+    from rocksplicator_tpu.utils.stats import _Histogram
+
+    now = _time.time()
+    h1, h2 = _Histogram(), _Histogram()
+    for v in (1.0, 2.0):
+        h1.add(v, now)
+    h2.add(50.0, now)
+
+    def mk(hist, served, shed):
+        return {
+            "counters": {
+                tagged("rpc.tenant_served", tenant="noisy"):
+                    {"total": served, "rate_1m": served},
+                tagged("rpc.tenant_shed", tenant="noisy",
+                       reason="quota"):
+                    {"total": shed, "rate_1m": shed},
+            },
+            "gauges": {},
+            "metrics": {tagged("rpc.tenant_ms", tenant="noisy"):
+                        hist.state()},
+            "shard_roles": {},
+        }
+
+    cs = ClusterStatsAggregator.aggregate(
+        {"h1:1": mk(h1, 10.0, 2.0), "h2:1": mk(h2, 5.0, 1.0)})
+    rec = cs["per_tenant"]["noisy"]
+    assert rec["served_total"] == 15.0
+    assert rec["shed_total"] == 3.0
+    assert rec["latency_ms"]["count"] == 3
+    assert rec["latency_ms"]["p99_ms"] >= 2.0
+
+
+# ---------------------------------------------------------------------------
+# the chaos overload schedule (satellite: zero acked-write loss while
+# sheds/hedges fire)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.flaky_host
+def test_overload_shed_chaos_schedule_holds_invariants(
+        tmp_path, monkeypatch):
+    import tools.chaos_soak as cs
+
+    monkeypatch.setattr(
+        cs, "_failover_deck",
+        lambda rng, schedules, bg: ["overload_shed"] * schedules)
+    result = cs.run_failover_chaos(
+        str(tmp_path / "chaos"), schedules=1, seed=4242,
+        log=lambda *a: None)
+    assert result["violations"] == [], result["violations"]
+    assert result["acked"] > 0
+    # the schedule actually shed: its zero-budget probes guarantee it
+    assert result["read_bounces"] > 0
+
+
+# ---------------------------------------------------------------------------
+# overload-bench artifact shape (the make overload-smoke contract)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.flaky_host
+def test_overload_ab_artifact_shape(tmp_path):
+    """End-to-end micro run of `--overload_ab`: the three A/B sections
+    with their samples/summary blocks, per-tenant breakdowns, hedge
+    counters, and host_calibration. Runs in --overload_gates
+    mechanical mode (the smoke's mode): the deterministic gates must
+    hold at any scale (killswitch arms never leak typed sheds or
+    hedges, the hedge rate stays inside its 5% budget, zero value
+    mismatches), while the latency-median comparisons — which need
+    real phase lengths to be stable — stay on the full
+    overload-bench."""
+    from benchmarks.macro_bench import main as macro_main
+
+    out = tmp_path / "overload.json"
+    rc = macro_main([
+        "--overload_ab", "--shards", "1", "--preload_keys", "120",
+        "--value_bytes", "48", "--overload_quota", "40",
+        "--overload_good_rate", "25", "--overload_good_tenants", "2",
+        "--overload_duration", "1.2", "--overload_reps", "1",
+        "--hedge_read_rate", "150", "--overhead_rate", "120",
+        "--overload_gates", "mechanical",
+        "--seed", "5", "--out", str(out),
+    ])
+    art = json.loads(out.read_text())
+    assert rc == 0, art["failures"]
+    assert art["bench"] == "macro_bench_overload_ab"
+    assert art["config"]["gates"] == "mechanical"
+    assert "fsync_per_sec" in art["host_calibration"]
+    assert art["failures"] == []
+    oab = art["overload_ab"]
+
+    ts = oab["tenant_ab"]["samples"]
+    assert ts["armor_on"] and ts["armor_off"]
+    for s in ts["armor_on"]:
+        assert s["abuser_shed"] > 0  # quota actually bit
+        assert set(s["per_tenant"]) == {"abuser", "good0", "good1"}
+        assert any(k.startswith("rpc.tenant_shed")
+                   for k in s["server_counters"])
+    for s in ts["armor_off"]:
+        assert s["abuser_shed"] + s["good_shed"] == 0  # killswitch
+    for s in ts["armor_on"] + ts["armor_off"]:
+        for rec in s["per_tenant"].values():
+            assert "_raw" not in rec  # pooled samples never persisted
+
+    hs = oab["hedge_ab"]["samples"]
+    for s in hs["hedge_on"]:
+        assert s["hedges"] > 0
+        assert s["hedge_rate"] <= 0.055
+        assert s["value_mismatches"] == 0
+    for s in hs["hedge_off"]:
+        assert s["hedges"] == 0  # killswitch
+
+    for mode, reps_data in oab["overhead_ab"]["samples"].items():
+        for s in reps_data:
+            assert s["value_mismatches"] == 0
+            assert s["put_mean_ms"] is not None, mode

@@ -675,6 +675,9 @@ _FAILOVER_FAULT_SITES = [
     "coordinator.heartbeat", "coordinator.reap", "coordinator.wal.append",
     "participant.transition", "shardmap.publish", "controller.assign",
     "repl.pull",
+    # round 19: the tail-armor shed/hedge seams the overload schedule arms
+    "rpc.deadline.check", "admission.shed", "router.hedge.fire",
+    "repl.read",
 ]
 
 FAILOVER_SESSION_TTL = 1.0
@@ -838,10 +841,11 @@ class FailoverCluster:
 
     # -- RPC straight at a node's replication plane (the follower frame
     # -- a harness probe fakes rides the REAL wire path)
-    def rpc(self, port: int, method: str, args: dict, timeout: float = 5.0):
+    def rpc(self, port: int, method: str, args: dict, timeout: float = 5.0,
+            **kw):
         async def go():
             return await self._pool.call("127.0.0.1", port, method, args,
-                                         timeout=timeout)
+                                         timeout=timeout, **kw)
 
         return self._ioloop.run_sync(go(), timeout=timeout + 5)
 
@@ -1405,6 +1409,86 @@ def _schedule_blip(kind):
     return run
 
 
+def _schedule_overload_shed(cluster, rng, acked, violations, tag, timings):
+    """Round-19 overload schedule: tail-armor sheds and hedges fire
+    while acked writes keep landing. The armed seams force the TYPED
+    degrade paths — ``rpc.deadline.check`` forces expired verdicts,
+    ``admission.shed`` forces tenant RETRY_LATER sheds, and
+    ``router.hedge.fire`` makes hedge launches fall back to the primary
+    arm — while ``repl.read`` delays make real hedges (and their
+    loser-cancel frames, the obvious new race) actually fire. A shed is
+    a typed refusal, never damage: the standing invariants (zero
+    acked-write loss, bounded staleness) must hold unchanged."""
+    from rocksplicator_tpu.rpc.errors import RpcApplicationError
+    from rocksplicator_tpu.rpc.router import (ClusterLayout, ReadPolicy,
+                                              RpcRouter)
+
+    s = rng.randrange(1 << 16)
+    fp.activate("rpc.deadline.check",
+                f"fail_prob:{rng.uniform(0.2, 0.5):.2f}@seed{s}")
+    fp.activate("admission.shed",
+                f"fail_prob:{rng.uniform(0.2, 0.5):.2f}@seed{s + 1}")
+    fp.activate("router.hedge.fire",
+                f"fail_prob:{rng.uniform(0.2, 0.4):.2f}@seed{s + 2}")
+    # stall ~half the read serves so the p95-floored hedge delay is
+    # actually beaten and backup arms launch (then get cancelled)
+    fp.activate("repl.read",
+                f"delay_ms:{rng.randint(15, 30)}:0.5@seed{s + 3}")
+    saved_floor = os.environ.get("RSTPU_HEDGE_FLOOR_MS")
+    os.environ["RSTPU_HEDGE_FLOOR_MS"] = "2"
+    router = RpcRouter(pool=cluster._pool)
+    sheds = 0
+    try:
+        cluster.write_some(rng, tag, rng.randint(6, 12), acked)
+        db = cluster.db_names[0]
+        for node in cluster.nodes:
+            # one zero-budget probe per node guarantees the deadline
+            # shed fires even if every probability roll misses
+            for deadline_ms in [0.0] + [
+                    rng.choice([50.0, 2000.0])
+                    for _ in range(rng.randint(2, 4))]:
+                try:
+                    cluster.rpc(node.replicator.port, "read",
+                                dict(db_name=db, op="get", keys=[b"probe"],
+                                     max_lag=5),
+                                deadline_ms=deadline_ms,
+                                tenant=rng.choice(["noisy", "quiet"]))
+                except RpcApplicationError as e:
+                    if e.code in ("DEADLINE_EXCEEDED", "RETRY_LATER"):
+                        sheds += 1
+                    timings["read_bounces"] += 1
+                except Exception:
+                    timings["read_bounces"] += 1
+        if cluster.maps:
+            router.update_layout(ClusterLayout.parse(
+                json.dumps(cluster.maps[-1]).encode()))
+            router._hedge_credit = router._hedge_credit_cap  # prime budget
+
+            async def hedged():
+                return await router.read(
+                    cluster.segment, 0, op="get", keys=[b"probe"],
+                    policy=ReadPolicy.follower_ok(max_lag=5), timeout=5.0)
+
+            for _ in range(rng.randint(6, 10)):
+                try:
+                    cluster._ioloop.run_sync(hedged(), timeout=10)
+                except Exception:
+                    timings["read_bounces"] += 1
+        # writes must keep landing while the serving path is shedding
+        cluster.write_some(rng, tag + "-during", rng.randint(4, 8), acked)
+    finally:
+        if saved_floor is None:
+            os.environ.pop("RSTPU_HEDGE_FLOOR_MS", None)
+        else:
+            os.environ["RSTPU_HEDGE_FLOOR_MS"] = saved_floor
+        fp.clear()
+    if sheds == 0:
+        violations.append(
+            f"{tag}: overload schedule armed shed seams but ZERO typed "
+            f"sheds fired (the zero-budget probes must shed)")
+    time.sleep(rng.uniform(0.1, 0.3))
+
+
 _FAILOVER_SCHEDULES = {
     "leader_crash": _schedule_leader_crash,
     "session_expiry": _schedule_session_expiry,
@@ -1415,10 +1499,12 @@ _FAILOVER_SCHEDULES = {
     "reap_blip": _schedule_blip("reap_blip"),
     "shardmap_blip": _schedule_blip("shardmap_blip"),
     "read_blip": _schedule_blip("read_blip"),
+    "overload_shed": _schedule_overload_shed,
 }
 _HEAVY_KINDS = ["leader_crash", "session_expiry", "coordinator_failover",
                 "coordinator_wal_torn", "follower_expiry"]
-_LIGHT_KINDS = ["hb_delay", "reap_blip", "shardmap_blip", "read_blip"]
+_LIGHT_KINDS = ["hb_delay", "reap_blip", "shardmap_blip", "read_blip",
+                "overload_shed"]
 
 
 def _failover_deck(rng: random.Random, schedules: int,
