@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab metrics-smoke compaction-bench compaction-bench-smoke compaction-remote-bench compaction-remote-smoke stream-merge-bench stream-merge-smoke overload-bench overload-smoke chaos-smoke chaos-failover-smoke reshard-smoke clean
+.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab macro-bench-hot-shift metrics-smoke compaction-bench compaction-bench-smoke compaction-remote-bench compaction-remote-smoke stream-merge-bench stream-merge-smoke overload-bench overload-smoke chaos-smoke chaos-failover-smoke reshard-smoke rebalance-smoke clean
 
 # rstpu-check: the three-pass static suite (lock-order/blocking-under-
 # lock, event-loop blocking, failpoint/span/stats registries) over
@@ -214,6 +214,43 @@ overload-smoke:
 		--hedge_read_rate 250 --overhead_rate 200 \
 		--overload_gates mechanical \
 		--out benchmarks/results/overload_smoke.json
+
+# round-20 hot-shift rebalancer A/B (the autonomy acceptance number,
+# ~4 min): mixed zipfian workload whose hot set SHIFTS shards at the
+# 1/3 mark, interleaved rebalancer-ON vs OFF on fresh 4-node clusters;
+# the ON arm drives the production RebalancerPolicy (EWMA + hysteresis
+# + sustain) with DirectShardMove as actuator. A symmetric 3ms
+# executor-occupancy read stall (repl.read.serve failpoint) makes the
+# per-process serving knee rate-derived, so the A/B measures PLACEMENT
+# even on a 1-core host where CPU is zero-sum across processes. Gates:
+# final-window get p99 ON strictly < OFF, >=1 successful move AFTER
+# the shift (re-detection), zero moves in the OFF arm, zero value
+# mismatches, zero acked-write loss (every acked put read back).
+macro-bench-hot-shift:
+	$(PY) bench.py --macro_bench --hot_shift --shards 4 \
+		--preload_keys 500 --hot_rate 520 --hot_duration 5 \
+		--hot_reps 2 \
+		--out benchmarks/results/macro_bench_hot_shift.json
+
+# round-20 rebalancer chaos smoke (~45s + ~20s tooth): 3 seeded
+# schedules (4 nodes / 2 shards) where placement changes are initiated
+# by the POLICY loop itself — a policy-detected hot shard moved, a
+# policy-detected overwhelming shard range-SPLIT into virtual children,
+# and a seam-faulted tick (rebalance.decide/plan/dispatch +
+# move.catchup kills, resumed from the durable ledgers) — each holding
+# the SEVENTH standing invariant: leaf convergence (splits published in
+# __splits__, one leader per CHILD), per-owning-range acked
+# readability, parent retired everywhere, bounded convergence. Then the
+# split_cutover tooth: a splitter patched to flip on "the snapshot is
+# good enough" (observer tail severed, no drain) must be CAUGHT losing
+# acked post-snapshot writes on the high child (--expect-violation).
+rebalance-smoke:
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --rebalance \
+		--schedules 3 --seed 1 \
+		--out benchmarks/results/chaos_rebalance_smoke.json
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --rebalance \
+		--schedules 1 --seed 7 \
+		--break-guard split_cutover --expect-violation
 
 # round-14 metrics-plane smoke (<10s): boots one replica in-process,
 # scrapes /metrics + /cluster_stats, validates Prometheus text-format

@@ -341,6 +341,7 @@ class Cluster:
                  extra_env: Optional[Dict[str, str]] = None):
         self.shards = shards
         self.with_move_node = with_move_node
+        self._moved: Dict[int, int] = {}  # shard -> current leader idx
         self.procs: List[subprocess.Popen] = []
         n = 4 if with_move_node else 3
         self.ports = [reserve_port() for _ in range(n)]
@@ -395,18 +396,24 @@ class Cluster:
     def apply_move_layout(self, shard: int, new_leader_idx: int) -> None:
         """Re-teach the driver's router after a completed shard move:
         ``shard``'s leader is now node ``new_leader_idx`` (what the
-        shardmap-agent file refresh does for real clients)."""
+        shardmap-agent file refresh does for real clients). CUMULATIVE:
+        every move applied so far stays applied — the hot-shift
+        rebalancer arm relocates several shards in one run, and a
+        rebuild that forgot an earlier move would route that shard back
+        to its RETIRED old leader."""
         from rocksplicator_tpu.rpc.router import ClusterLayout
 
+        self._moved[shard] = new_leader_idx
         layout: Dict = {SEGMENT: {"num_shards": self.shards}}
         marks = {0: "M", 1: "S", 2: "S", 3: None}
         for i, port in enumerate(self.ports):
             entries = []
             for s in range(self.shards):
-                if s == shard:
-                    # moved shard: leader on the new node, the two
+                moved_to = self._moved.get(s)
+                if moved_to is not None:
+                    # moved shard: leader on its new node, the two
                     # surviving followers unchanged, old leader retired
-                    if i == new_leader_idx:
+                    if i == moved_to:
                         mark = "M"
                     elif i in (1, 2):
                         mark = "S"
@@ -550,7 +557,9 @@ async def _run_open_loop(cluster: Cluster, policy, rate: float,
                          value_bytes: int, mix: Dict[str, float],
                          seed: int, max_inflight: int,
                          server_get_sink: Optional[List[float]] = None,
-                         sample_log: Optional[List] = None
+                         sample_log: Optional[List] = None,
+                         gid_source=None,
+                         acked_puts: Optional[set] = None
                          ) -> PhaseResult:
     from rocksplicator_tpu.rpc.errors import RpcError
     from rocksplicator_tpu.storage import WriteBatch
@@ -581,6 +590,12 @@ async def _run_open_loop(cluster: Cluster, policy, rate: float,
                         key_of(gid), put_value(gid, value_bytes))
                     await router.write(SEGMENT, shard_of(gid, shards),
                                        batch.encode(), timeout=15.0)
+                    if acked_puts is not None:
+                        # durably acked: the hot-shift gate reads every
+                        # one of these back after the run — a key that
+                        # lost its put across a policy-driven move is
+                        # an acked-write loss
+                        acked_puts.add(gid)
                 else:
                     if op == "get":
                         args = {"keys": [key_of(gid)]}
@@ -625,6 +640,7 @@ async def _run_open_loop(cluster: Cluster, policy, rate: float,
                 # windows samples into before/during/after the flip
                 sample_log.append((loop.time(), op, lat_ms))
 
+    next_gid = gid_source or zipf.next
     t0 = loop.time()
     tasks = []
     for off, op in zip(arrivals, opnames):
@@ -632,7 +648,7 @@ async def _run_open_loop(cluster: Cluster, policy, rate: float,
         if delay > 0:
             await asyncio.sleep(delay)
         tasks.append(asyncio.ensure_future(
-            one_op(t0 + off, op, zipf.next())))
+            one_op(t0 + off, op, next_gid())))
     if tasks:
         await asyncio.wait(tasks)
     res.bounced = int(_router_bounces(cluster) - base_bounces)
@@ -1460,6 +1476,329 @@ def overload_failures(result: Dict,
 
 
 # ---------------------------------------------------------------------------
+# hot-shift rebalancer A/B (round 20: the autonomy acceptance number)
+# ---------------------------------------------------------------------------
+
+
+def run_hot_shift_phase(cluster: Cluster, root: str, policy,
+                        rebalance_on: bool, args, total_keys: int,
+                        seed: int, mix: Dict[str, float]) -> Dict:
+    """One 3-window open-loop phase whose zipfian hot set SHIFTS shards
+    at the 1/3 mark: ``--hot_frac`` of ops target one hot shard
+    (zipfian key popularity WITHIN it), the rest spread uniformly; at
+    ``t_shift`` the hot shard flips from 0 to ``shards // 2``. All four
+    shard leaders start crammed on node 0 (the macro-bench's static
+    layout), so the hot shard rides the most-loaded dispatch queue in
+    both arms — until the ON arm's driver notices.
+
+    The ON arm runs the PRODUCTION policy (RebalancerPolicy: EWMA +
+    hysteresis + sustain) fed with per-shard dispatched-op rates, and
+    actuates each decision with DirectShardMove onto the spare node —
+    the same sense→decide→act loop the coordinator-mode Rebalancer
+    runs, minus the coordinator. The OFF arm runs no driver. Samples
+    are windowed before/settle/after the shift; the A/B gate compares
+    the AFTER window's get p99 — the number that says whether the
+    policy re-detected and re-homed the NEW hot shard autonomously.
+
+    Correctness rides along: every acked put is read back at the end
+    (leader_only) and must return its exact put value — an acked write
+    lost across a policy-initiated cutover fails the run, as does any
+    mid-run get outside the deterministic preload/put value set."""
+    from rocksplicator_tpu.cluster.rebalancer import (RebalancerFlags,
+                                                      RebalancerPolicy)
+    from rocksplicator_tpu.cluster.shard_move import (DirectMovePlan,
+                                                      DirectNode,
+                                                      DirectShardMove,
+                                                      MoveFlags)
+    from rocksplicator_tpu.rpc.errors import RpcError
+    from rocksplicator_tpu.utils.segment_utils import segment_to_db_name
+
+    shards = cluster.shards
+    duration = float(args.hot_duration)
+    keys_per_shard = total_keys // shards
+    hot_ref = [0]                # flipped by the shifter mid-run
+    h1 = shards // 2             # the post-shift hot shard (≠ 0)
+    counts = [0] * shards        # dispatched ops per shard (policy feed)
+    rng = random.Random(seed ^ 0x517F7)
+    zipf = ZipfianGenerator(keys_per_shard, seed=seed + 2)
+    info: Dict = {}
+
+    def gid_source() -> int:
+        # hot ops: zipfian rank within the hot shard's keyspace; cold
+        # ops: uniform over all shards. gid = k*shards + s keeps the
+        # round-robin dealing (shard_of == gid % shards) intact.
+        if rng.random() < args.hot_frac:
+            s = hot_ref[0]
+            k = zipf.next()
+        else:
+            s = rng.randrange(shards)
+            k = rng.randrange(keys_per_shard)
+        counts[s] += 1
+        return k * shards + s
+
+    def shifter():
+        time.sleep(duration)
+        info["t_shift"] = time.monotonic()
+        hot_ref[0] = h1
+
+    moves: List[Dict] = []
+    stop = threading.Event()
+    leaders = {s: 0 for s in range(shards)}
+    db_to_shard = {segment_to_db_name(SEGMENT, s): s
+                   for s in range(shards)}
+
+    def node(i: int) -> DirectNode:
+        return DirectNode("127.0.0.1", cluster.admin_ports[i],
+                          cluster.ports[i])
+
+    def driver():
+        # bench-sized policy knobs: fast EWMA, 2-tick sustain, and a
+        # hot_factor low enough that one shard carrying ~hot_frac of a
+        # 4-shard fleet clears it; split_factor effectively off (direct
+        # mode has no coordinator to host a range split — moves only)
+        rp = RebalancerPolicy(RebalancerFlags(
+            ewma_alpha=0.5, hot_factor=1.6, cool_factor=1.2, sustain=2,
+            max_concurrent=1, split_factor=1e9, min_rate=10.0))
+        info["policy"] = rp
+        prev = list(counts)
+        t_prev = time.monotonic()
+        while not stop.wait(0.4):
+            cur = list(counts)
+            now = time.monotonic()
+            dt = max(1e-3, now - t_prev)
+            rates = {db: (cur[s] - prev[s]) / dt
+                     for db, s in db_to_shard.items()}
+            prev, t_prev = cur, now
+            for d in rp.observe(rates):
+                s = db_to_shard[d.db_name]
+                if leaders[s] != 0:
+                    # already re-homed; only the spare can take leaders
+                    rp.forget(d.db_name)
+                    continue
+                rec = {"shard": s, "kind": d.kind,
+                       "ewma": round(d.ewma, 1),
+                       "fleet_mean": round(d.fleet_mean, 1),
+                       "after_shift": "t_shift" in info,
+                       "t_sec": round(now - info["t0"], 2)}
+                try:
+                    plan = DirectMovePlan(
+                        db_name=d.db_name, source=node(0),
+                        target=node(3), leader=node(0),
+                        followers=[node(1), node(2)],
+                        store_uri=os.path.join(root, "hotshift-bucket"))
+                    timings = DirectShardMove(plan, flags=MoveFlags(
+                        catchup_lag_threshold=32, catchup_timeout=60.0,
+                        cutover_pause_ms=3000.0,
+                        poll_interval=0.05)).run()
+                except Exception as e:
+                    rec.update(ok=False, error=repr(e))
+                    moves.append(rec)
+                    rp.forget(d.db_name)
+                    continue
+                leaders[s] = 3
+                cluster.apply_move_layout(s, 3)
+                rec.update(ok=True, timings_ms=timings)
+                moves.append(rec)
+                rp.forget(d.db_name)
+
+    sample_log: List = []
+    acked_puts: set = set()
+    info["t0"] = time.monotonic()
+    threads = [threading.Thread(target=shifter, name="hot-shifter",
+                                daemon=True)]
+    if rebalance_on:
+        threads.append(threading.Thread(target=driver,
+                                        name="hot-rebalancer",
+                                        daemon=True))
+    for th in threads:
+        th.start()
+    res = cluster.ioloop.run_sync(
+        _run_open_loop(cluster, policy, args.hot_rate, duration * 3,
+                       total_keys, args.value_bytes, mix, seed,
+                       args.max_inflight, sample_log=sample_log,
+                       gid_source=gid_source, acked_puts=acked_puts),
+        timeout=duration * 3 + 240)
+    stop.set()
+    for th in threads:
+        th.join(timeout=150)
+
+    # the acked-write-loss sweep: every key this phase acked a put for
+    # must read back its exact put value from the CURRENT leader —
+    # wherever the policy moved it
+    async def verify_acked() -> List[int]:
+        sem = asyncio.Semaphore(64)
+        lost: List[int] = []
+
+        async def check(gid: int):
+            async with sem:
+                for attempt in range(3):
+                    try:
+                        r = await cluster.router.read(
+                            SEGMENT, shard_of(gid, shards), op="get",
+                            keys=[key_of(gid)], policy=policy,
+                            timeout=15.0)
+                    except RpcError:
+                        await asyncio.sleep(0.2 * (attempt + 1))
+                        continue
+                    got = r["values"][0]
+                    got = bytes(got) if got is not None else None
+                    if got != put_value(gid, args.value_bytes):
+                        lost.append(gid)
+                    return
+                lost.append(gid)  # unreadable counts as lost
+
+        await asyncio.gather(*[check(g) for g in sorted(acked_puts)])
+        return sorted(lost)
+
+    lost = cluster.ioloop.run_sync(verify_acked(),
+                                   timeout=30 + len(acked_puts))
+
+    t_shift = info.get("t_shift")
+    inf = float("inf")
+    windows: Dict[str, Dict] = {}
+    for name, lo, hi in (
+            ("before", -inf, t_shift or inf),
+            ("settle", t_shift or inf,
+             (t_shift + duration) if t_shift else inf),
+            ("after", (t_shift + duration) if t_shift else inf, inf)):
+        gets = sorted(lat for ts, op, lat in sample_log
+                      if op == "get" and lat is not None and lo <= ts < hi)
+        windows[name] = {
+            "get_count": len(gets),
+            "get_errors": sum(1 for ts, op, lat in sample_log
+                              if op == "get" and lat is None
+                              and lo <= ts < hi),
+            "get_p50_ms": round(percentile(gets, 50), 3) if gets else None,
+            "get_p99_ms": round(percentile(gets, 99), 3) if gets else None,
+            "put_errors": sum(1 for ts, op, lat in sample_log
+                              if op == "put" and lat is None
+                              and lo <= ts < hi),
+        }
+    policy_obj = info.get("policy")
+    return {
+        "after_get_p99_ms": windows["after"]["get_p99_ms"],
+        "after_get_p50_ms": windows["after"]["get_p50_ms"],
+        "windows": windows,
+        "moves": moves,
+        "moves_ok": sum(1 for m in moves if m.get("ok")),
+        "moves_after_shift": sum(1 for m in moves
+                                 if m.get("ok") and m.get("after_shift")),
+        "acked_puts": len(acked_puts),
+        "acked_write_losses": len(lost),
+        "lost_gids": lost[:20],
+        "value_mismatches": res.value_mismatches,
+        "achieved_per_sec": res.summarize(
+            args.hot_rate, duration * 3)["achieved_per_sec"],
+        "policy_snapshot": (policy_obj.snapshot()
+                            if policy_obj is not None else None),
+    }
+
+
+def run_hot_shift_ab(args) -> Dict:
+    """Interleaved rebalancer-ON vs OFF over the hot-shift workload:
+    fresh 4-node cluster (3 replicas + spare, admin plane on) per arm
+    per rep — the ON arm's moves rewrite placement, so arms can never
+    share a cluster. Lower after-window get p99 wins."""
+    import shutil
+    import tempfile
+
+    from rocksplicator_tpu.rpc.router import ReadPolicy
+
+    mix = parse_mix(args.hot_mix)
+    total_keys = args.shards * args.preload_keys
+    # leader_only on purpose: every op for a shard rides its leader's
+    # dispatch queue, so placement IS the latency story the A/B tells
+    policy = ReadPolicy.leader_only()
+    rep_no = [0]
+
+    def arm(on: bool):
+        name = "rebalance_on" if on else "rebalance_off"
+
+        def run() -> Dict:
+            rep_no[0] += 1
+            root = tempfile.mkdtemp(prefix="rstpu-hotshift-")
+            cluster = None
+            try:
+                log(f"hot_shift[{name}]: booting 4-node cluster "
+                    f"({args.shards} shards, all leaders on node 0, "
+                    f"read stall {args.hot_inject_ms}ms)")
+                # symmetric per-read executor stall in BOTH arms: the
+                # serving knee is the same everywhere; only WHERE the
+                # hot shard's queue lives differs between arms
+                extra_env = ({"RSTPU_FAILPOINTS":
+                              f"repl.read.serve=delay_ms:"
+                              f"{args.hot_inject_ms}"}
+                             if args.hot_inject_ms > 0 else {})
+                cluster = Cluster(root, args.shards, args.preload_keys,
+                                  args.value_bytes, args.write_window,
+                                  args.read_info_ttl_ms, args.transport,
+                                  args.hot_executor_threads,
+                                  with_move_node=True,
+                                  extra_env=extra_env)
+                cluster.wait_catchup(total_keys)
+                return run_hot_shift_phase(
+                    cluster, root, policy, on, args, total_keys,
+                    args.seed + 271 * rep_no[0], mix)
+            finally:
+                if cluster is not None:
+                    cluster.stop()
+                shutil.rmtree(root, ignore_errors=True)
+        return name, run
+
+    return run_interleaved([arm(False), arm(True)], reps=args.hot_reps,
+                           key="after_get_p99_ms",
+                           higher_is_better=False, log=log)
+
+
+def hot_shift_failures(ab: Dict) -> List[str]:
+    """The round-20 autonomy acceptance gates: final-window fleet get
+    p99 strictly better with the rebalancer ON (median across
+    interleaved reps), zero value mismatches, zero acked-write losses,
+    the ON arm demonstrably re-detected the post-shift hot shard (≥1
+    successful move AFTER t_shift), and the OFF arm moved nothing."""
+    failures: List[str] = []
+    samples = ab.get("samples") or {}
+    for name in ("rebalance_off", "rebalance_on"):
+        if not samples.get(name):
+            failures.append(f"no completed {name} rep")
+        for s in samples.get(name) or []:
+            if s["value_mismatches"]:
+                failures.append(
+                    f"{name}: {s['value_mismatches']} value mismatches")
+            if s["acked_write_losses"]:
+                failures.append(
+                    f"{name}: {s['acked_write_losses']} acked put(s) "
+                    f"did not read back their value after the run "
+                    f"(gids {s['lost_gids']})")
+            if s["after_get_p99_ms"] is None:
+                failures.append(
+                    f"{name}: no gets completed in the after window")
+    for s in samples.get("rebalance_on") or []:
+        if not s["moves_after_shift"]:
+            failures.append(
+                "rebalance_on rep dispatched no successful move AFTER "
+                "the hot-set shift (policy failed to re-detect)")
+        for m in s["moves"]:
+            if not m.get("ok"):
+                failures.append(
+                    f"rebalance_on move of shard {m['shard']} failed: "
+                    f"{m.get('error')}")
+    for s in samples.get("rebalance_off") or []:
+        if s["moves"]:
+            failures.append("rebalance_off arm executed moves "
+                            "(killswitch leak)")
+    ratio = (ab.get("ratio_vs_rebalance_off") or {}).get("rebalance_on")
+    if ratio is None:
+        if not failures:
+            failures.append("no ON/OFF after-window p99 ratio computed")
+    elif ratio >= 1.0:
+        failures.append(
+            f"after-window get p99 ON/OFF ratio {ratio} >= 1.0 — the "
+            f"rebalancer did not improve the post-shift tail")
+    return failures
+
+
+# ---------------------------------------------------------------------------
 # cluster-wide stats scrape (round 14: the spectator-aggregation path)
 # ---------------------------------------------------------------------------
 
@@ -1630,6 +1969,42 @@ def main(argv=None) -> int:
     p.add_argument("--overhead_rate", type=float, default=500.0,
                    help="offered ops/s for the unarmed-overhead A/B "
                         "(comfortably under the knee)")
+    p.add_argument("--hot_shift", action="store_true",
+                   help="standalone mode: interleaved rebalancer-ON vs "
+                        "OFF A/B over a workload whose zipfian hot set "
+                        "SHIFTS shards mid-run; the ON arm drives the "
+                        "production RebalancerPolicy with "
+                        "DirectShardMove as actuator; gates: final-"
+                        "window get p99 ON < OFF, zero value "
+                        "mismatches, zero acked-write loss")
+    p.add_argument("--hot_rate", type=float, default=520.0,
+                   help="offered ops/s for the hot-shift phase: with "
+                        "the default 3ms read stall the all-on-node-0 "
+                        "arm offers ~390 gets/s against a ~300/s "
+                        "single-executor knee (overloaded), while the "
+                        "rebalanced end-state's hottest node sits at "
+                        "~260 gets/s (under it)")
+    p.add_argument("--hot_frac", type=float, default=0.55,
+                   help="fraction of ops targeting the hot shard")
+    p.add_argument("--hot_duration", type=float, default=6.0,
+                   help="seconds per hot-shift window (3 windows: "
+                        "before/settle/after; shift at the 1/3 mark)")
+    p.add_argument("--hot_reps", type=int, default=2)
+    p.add_argument("--hot_mix", default="get=0.75,put=0.25",
+                   help="op mix for the hot-shift phase")
+    p.add_argument("--hot_executor_threads", type=int, default=1,
+                   help="executor threads per server in the hot-shift "
+                        "A/B (default 1: the hot shard must monopolize "
+                        "an explicit dispatch queue — the same "
+                        "structural-knee discipline as the tenant A/B)")
+    p.add_argument("--hot_inject_ms", type=int, default=3,
+                   help="server-side executor-occupancy stall per read "
+                        "(repl.read.serve failpoint, BOTH arms): makes "
+                        "the per-process serving knee rate-derived "
+                        "(~1000/ms gets/s) instead of host-derived, so "
+                        "the A/B contrast is placement, even on a "
+                        "1-core host where CPU is zero-sum across "
+                        "server processes")
     p.add_argument("--overload_gates", choices=("full", "mechanical"),
                    default="full",
                    help="'full' (default) gates the latency medians "
@@ -1703,6 +2078,40 @@ def main(argv=None) -> int:
         result["failures"] = sched_ab_failures(
             result["sched_ab"]["samples"],
             picks_of=lambda s: s["compaction.sched_picks"])
+        return emit_gated_artifact(result, args.out, "macro_bench", log)
+    if args.hot_shift:
+        # standalone mode: fresh 4-node cluster per arm per rep (the
+        # ON arm's policy-driven moves rewrite placement)
+        result = {
+            "bench": "macro_bench_hot_shift",
+            "config": {
+                "shards": args.shards,
+                "preload_keys_per_shard": args.preload_keys,
+                "value_bytes": args.value_bytes,
+                "mix": parse_mix(args.hot_mix),
+                "rate": args.hot_rate,
+                "hot_frac": args.hot_frac,
+                "window_duration": args.hot_duration,
+                "shift_at": "t0 + window_duration (hot shard 0 -> "
+                            f"{args.shards // 2})",
+                "reps": args.hot_reps,
+                "executor_threads": args.hot_executor_threads,
+                "read_stall_ms": args.hot_inject_ms,
+                "read_policy": "leader_only",
+                "transport": args.transport,
+                "seed": args.seed,
+                "topology": ("1 leader + 2 followers + spare "
+                             "(mode 1), 4 OS processes, fresh cluster "
+                             "per arm"),
+            },
+            "host_calibration": host_calibration(root),
+        }
+        try:
+            result["hot_shift_ab"] = run_hot_shift_ab(args)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        result["elapsed_sec"] = round(time.monotonic() - t0, 1)
+        result["failures"] = hot_shift_failures(result["hot_shift_ab"])
         return emit_gated_artifact(result, args.out, "macro_bench", log)
     if args.overload_ab:
         # standalone mode: every arm boots its own cluster (the armor

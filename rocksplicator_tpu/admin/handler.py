@@ -431,6 +431,106 @@ class AdminHandler:
         await self._run(do)
         return {}
 
+    async def handle_rename_db(
+        self,
+        db_name: str = "",
+        new_db_name: str = "",
+        new_role: str = "",
+        upstream_ip: str = "",
+        upstream_port: int = 0,
+        epoch: int = 0,
+    ) -> dict:
+        """renameDB — the shard-split cutover primitive: close the db,
+        rename its storage directory, reopen under the new name with the
+        given role/upstream/epoch (role empty = keep the current one).
+        A range-split child starts life as a full copy of its parent
+        under the PARENT's name (so the WAL-tail pull addresses match);
+        at cutover this flips the copy to its child identity in one
+        local, idempotent step.
+
+        Idempotent for a resumed driver: if the new name is already
+        registered and the old is gone, the rename already happened —
+        succeed. If the process crashed between the directory rename and
+        the reopen, the orphaned directory is adopted under the new
+        name. Both per-db admin locks are taken in sorted-name order (a
+        concurrent opposite-direction rename must not deadlock)."""
+        if not new_db_name or new_db_name == db_name:
+            raise RpcApplicationError(DB_ADMIN_ERROR,
+                                      f"bad rename target {new_db_name!r}")
+        parsed = _parse_role(new_role) if new_role else None
+        upstream = (upstream_ip, upstream_port) if upstream_ip else None
+
+        def do():
+            first, second = sorted((db_name, new_db_name))
+            with self._db_admin_lock.locked(first), \
+                    self._db_admin_lock.locked(second):
+                if self.db_manager.get_db(new_db_name) is not None:
+                    if self.db_manager.get_db(db_name) is None:
+                        return  # resumed after a completed rename
+                    raise RpcApplicationError(DB_ALREADY_EXISTS, new_db_name)
+                old_path = self._db_path(db_name)
+                new_path = self._db_path(new_db_name)
+                app_db = self.db_manager.get_db(db_name)
+                role = parsed
+                mode: Optional[int] = None
+                live_epoch = 0
+                up = upstream
+                if app_db is not None:
+                    if role is None:
+                        role = app_db.role
+                    mode = _current_mode(app_db)
+                    live_epoch = _current_epoch(app_db)
+                    if (up is None and app_db.replicated_db is not None
+                            and role in (ReplicaRole.FOLLOWER,
+                                         ReplicaRole.OBSERVER)):
+                        up = app_db.replicated_db.upstream_addr
+                    self.db_manager.remove_db(db_name)  # closes storage
+                elif not os.path.exists(old_path):
+                    # crashed between rename and reopen: adopt the dir
+                    if not os.path.exists(new_path):
+                        raise RpcApplicationError(DB_NOT_FOUND, db_name)
+                if os.path.exists(old_path):
+                    if os.path.exists(new_path):
+                        # leftover from a crashed earlier attempt — the
+                        # live data is still under the OLD name
+                        destroy_db(new_path)
+                    os.rename(old_path, new_path)
+                if role is None:
+                    raise RpcApplicationError(
+                        INVALID_DB_ROLE, "rename of unregistered db "
+                        "requires an explicit new_role")
+                if role in (ReplicaRole.FOLLOWER, ReplicaRole.OBSERVER) \
+                        and up is None:
+                    raise RpcApplicationError(
+                        INVALID_UPSTREAM, "follower requires upstream")
+                self._open_app_db(new_db_name, role, up,
+                                  replication_mode=mode,
+                                  epoch=max(int(epoch), live_epoch))
+                meta = self.get_meta_data(db_name)
+                self.write_meta_data(new_db_name, meta.s3_bucket,
+                                     meta.s3_path,
+                                     meta.last_kafka_msg_timestamp_ms)
+                self.clear_meta_data(db_name)
+
+        await self._run(do)
+        return {}
+
+    async def handle_set_tenant_quota(
+        self, tenant: str = "", ops_per_sec: float = 0.0,
+        bytes_per_sec: float = 0.0,
+    ) -> dict:
+        """Runtime-mutable per-tenant admission quotas: override THIS
+        node's token-bucket rates for one tenant without a restart
+        (round-19 residual: quotas were static per-node env). Zero/zero
+        clears the override back to the env defaults."""
+        from ..rpc.admission import TenantAdmission, sanitize_tenant
+
+        name = sanitize_tenant(tenant)
+        TenantAdmission.get().set_quota(
+            name, float(ops_per_sec), float(bytes_per_sec))
+        return {"tenant": name, "ops_per_sec": float(ops_per_sec),
+                "bytes_per_sec": float(bytes_per_sec)}
+
     async def handle_check_pull_stall(self, db_name: str = "") -> dict:
         """Flags-only sibling of check_db for the participant's 5s
         stall-heal probe: two booleans read straight off the

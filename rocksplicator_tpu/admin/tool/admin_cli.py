@@ -232,6 +232,140 @@ def cmd_drain_node(admin: AdminClient, args) -> int:
         coord.close()
 
 
+def cmd_split_shard(admin: AdminClient, args) -> int:
+    """Live hot-shard range split: the parent hash slot becomes two
+    range-partitioned virtual children (low = the parent's replicas
+    renamed in place; high = snapshot → observer catch-up → rename on
+    --target). --split_key is the hex boundary; omit it to sample the
+    leader's keyspace median. --resume continues a recorded split,
+    --abort unwinds a strictly pre-cutover one."""
+    from ...cluster.shard_split import (ShardSplit, SplitError,
+                                        choose_split_key)
+
+    partition = f"{args.segment}_{args.shard}"
+    coord = _coord_client(args.coord)
+    try:
+        if args.abort:
+            ShardSplit.resume(coord, args.cluster, partition,
+                              admin=admin).abort()
+            print(f"{partition}: split aborted")
+            return 0
+        if args.resume:
+            sp = ShardSplit.resume(coord, args.cluster, partition,
+                                   admin=admin)
+        else:
+            if not (args.target and args.store_uri):
+                print("split-shard: --target and --store_uri are "
+                      "required for a new split", file=sys.stderr)
+                return 2
+            split_key = bytes.fromhex(args.split_key) \
+                if args.split_key else None
+            if split_key is None:
+                # sample the leader's keyspace for the median boundary
+                from ...cluster.model import (InstanceInfo, cluster_path,
+                                              decode_states as _ds)
+                from ...utils.segment_utils import (
+                    db_name_to_partition_name, segment_to_db_name)
+                db_name = segment_to_db_name(args.segment, args.shard)
+                leader_addr = None
+                for iid in coord.list(
+                        cluster_path(args.cluster, "currentstates")):
+                    st = _ds(coord.get_or_none(cluster_path(
+                        args.cluster, "currentstates", iid))).get(
+                            db_name_to_partition_name(db_name))
+                    if st in ("LEADER", "MASTER"):
+                        raw = coord.get_or_none(cluster_path(
+                            args.cluster, "instances", iid))
+                        if raw:
+                            info = InstanceInfo.decode(raw)
+                            leader_addr = (info.host, info.repl_port)
+                        break
+                if leader_addr is not None:
+                    split_key = choose_split_key(admin, leader_addr,
+                                                 db_name)
+            if not split_key:
+                print("split-shard: no --split_key given and the "
+                      "keyspace sample found no usable boundary",
+                      file=sys.stderr)
+                return 1
+            sp = ShardSplit.start(
+                coord, args.cluster, args.segment, args.shard,
+                split_key, args.target, args.store_uri, admin=admin)
+        rec = sp.run()
+        print(json.dumps({
+            "split_id": rec.split_id, "segment": rec.segment,
+            "parent_shard": rec.parent_shard,
+            "split_key": rec.split_key, "low_shard": rec.low_shard,
+            "high_shard": rec.high_shard, "epoch": rec.epoch,
+        }))
+        return 0
+    except SplitError as e:
+        print(f"split failed: {e}", file=sys.stderr)
+        return 1
+    finally:
+        coord.close()
+
+
+def cmd_rebalance(admin: AdminClient, args) -> int:
+    """Rebalancer control surface: ``status`` prints the durable status
+    document, ``pause``/``resume`` flip the durable pause flag every
+    rebalancer honors, ``once`` runs a single sense→decide→plan→
+    dispatch tick inline (policy-initiated moves/splits, no loop)."""
+    from ...cluster.rebalancer import Rebalancer
+    from ...cluster.model import cluster_path
+
+    coord = _coord_client(args.coord)
+    try:
+        if args.action == "status":
+            raw = coord.get_or_none(cluster_path(args.cluster,
+                                                 "rebalancer"))
+            doc = {}
+            if raw:
+                try:
+                    doc = json.loads(bytes(raw).decode())
+                except (ValueError, UnicodeDecodeError):
+                    doc = {}
+            print(json.dumps(doc, indent=1, sort_keys=True))
+            return 0
+        if args.action in ("pause", "resume"):
+            Rebalancer.set_paused(coord, args.cluster,
+                                  args.action == "pause")
+            print(f"rebalancer {args.action}d")
+            return 0
+        # once
+        if not args.store_uri:
+            print("rebalance once: --store_uri is required (move/split "
+                  "snapshots land there)", file=sys.stderr)
+            return 2
+        rb = Rebalancer(coord, args.cluster, args.store_uri, admin=admin)
+        plans = rb.once()
+        for t in rb._workers:
+            t.join()
+        print(json.dumps({"dispatched": plans,
+                          "counters": rb._dispatched}))
+        return 0
+    finally:
+        coord.close()
+
+
+def cmd_set_tenant_quota(admin: AdminClient, args) -> int:
+    """Push a live per-tenant admission quota override to each node
+    (host:admin_port list) — takes effect on the tenant's next request,
+    no restart."""
+    rc = 0
+    for spec in args.nodes:
+        ip, _, port = spec.partition(":")
+        try:
+            r = admin.set_tenant_quota((ip, int(port)), args.tenant,
+                                       args.ops_per_sec,
+                                       args.bytes_per_sec)
+            print(f"{spec}: {json.dumps(r)}")
+        except Exception as e:
+            print(f"{spec}: FAILED {e}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def cmd_backup(admin: AdminClient, args) -> int:
     r = admin.backup_db_to_store(
         (args.host, args.port), args.db, args.store_uri, args.backup_path
@@ -311,6 +445,43 @@ def build_parser() -> argparse.ArgumentParser:
                     help="unwind a pre-cutover move (sweeps the "
                          "target's half-built replica)")
     sp.set_defaults(fn=cmd_move_shard)
+
+    sp = sub.add_parser("split-shard")
+    sp.add_argument("--coord", required=True, help="host:port")
+    sp.add_argument("--cluster", required=True)
+    sp.add_argument("--segment", required=True)
+    sp.add_argument("--shard", type=int, required=True,
+                    help="parent shard (hash slot or live child)")
+    sp.add_argument("--split_key", default="",
+                    help="hex boundary key; omitted = sample the "
+                         "leader's keyspace median")
+    sp.add_argument("--target", default="",
+                    help="instance_id receiving the high child")
+    sp.add_argument("--store_uri", default="",
+                    help="object store for the split snapshot")
+    sp.add_argument("--resume", action="store_true",
+                    help="continue the recorded in-flight split")
+    sp.add_argument("--abort", action="store_true",
+                    help="unwind a strictly pre-cutover split")
+    sp.set_defaults(fn=cmd_split_shard)
+
+    sp = sub.add_parser("rebalance")
+    sp.add_argument("action",
+                    choices=("status", "pause", "resume", "once"))
+    sp.add_argument("--coord", required=True, help="host:port")
+    sp.add_argument("--cluster", required=True)
+    sp.add_argument("--store_uri", default="",
+                    help="object store for policy-initiated move/split "
+                         "snapshots (required for `once`)")
+    sp.set_defaults(fn=cmd_rebalance)
+
+    sp = sub.add_parser("set-tenant-quota")
+    sp.add_argument("--tenant", required=True)
+    sp.add_argument("--ops_per_sec", type=float, default=0.0)
+    sp.add_argument("--bytes_per_sec", type=float, default=0.0)
+    sp.add_argument("nodes", nargs="+",
+                    help="host:admin_port of each node to push to")
+    sp.set_defaults(fn=cmd_set_tenant_quota)
 
     sp = sub.add_parser("drain-node")
     sp.add_argument("--coord", required=True, help="host:port")

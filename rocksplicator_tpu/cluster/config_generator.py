@@ -14,7 +14,8 @@ import logging
 from typing import Dict, List
 
 from ..utils.segment_utils import partition_name_to_db_name, extract_shard_id, db_name_to_segment
-from .model import InstanceInfo, ResourceDef, cluster_path, decode_states
+from .model import (InstanceInfo, ResourceDef, SplitRecord, cluster_path,
+                    decode_states)
 
 log = logging.getLogger(__name__)
 
@@ -39,6 +40,20 @@ def generate_shard_map(coord, cluster: str) -> Dict:
     shard_map: Dict[str, Dict] = {
         seg: {"num_shards": r.num_shards} for seg, r in resources.items()
     }
+    # ACTIVE range splits ride inside the segment body under the
+    # reserved "__splits__" key: {parent_shard: {split_key, low, high}}.
+    # num_shards stays the HASH width (clients keep hashing to the
+    # parent slot); the router resolves slot → serving child by range.
+    for p in coord.list(path("splits")):
+        rec = SplitRecord.decode(coord.get_or_none(path("splits", p)))
+        if rec is None or rec.phase != "active" or rec.segment not in shard_map:
+            continue
+        shard_map[rec.segment].setdefault("__splits__", {})[
+            str(rec.parent_shard)] = {
+                "split_key": rec.split_key,
+                "low": rec.low_shard,
+                "high": rec.high_shard,
+        }
     for iid, info in instances.items():
         states = decode_states(coord.get_or_none(path("currentstates", iid)))
         host_key = f"{info.host}:{info.admin_port}:{info.az}:{info.repl_port}"

@@ -30,6 +30,7 @@ from .model import (
     PartitionAssignment,
     PlacementPin,
     ResourceDef,
+    SplitRecord,
     cluster_path,
     decode_states,
     encode_assignments,
@@ -58,6 +59,31 @@ def _state_names(state_model: str) -> Tuple[str, str]:
     return LEADER, FOLLOWER
 
 
+def effective_shards(resource: ResourceDef,
+                     splits: Optional[List[SplitRecord]] = None
+                     ) -> List[int]:
+    """The shard ids this resource actually SERVES: the hash range
+    ``range(num_shards)`` with every ACTIVE-split parent replaced —
+    transitively, since children can split again — by its range
+    children. The hash map is untouched by splits (keys still hash to
+    the parent slot; routers chase the split records by range), so this
+    is purely the controller's enumeration of which partitions need
+    replicas and leaders."""
+    by_parent = {r.parent_shard: r for r in (splits or [])
+                 if r.segment == resource.segment and r.phase == "active"}
+    out: List[int] = []
+    for s in range(resource.num_shards):
+        frontier = [s]
+        while frontier:
+            cur = frontier.pop()
+            rec = by_parent.get(cur)
+            if rec is None:
+                out.append(cur)
+            else:
+                frontier.extend((rec.low_shard, rec.high_shard))
+    return sorted(out)
+
+
 def assign_resource(
     resource: ResourceDef,
     instances: Dict[str, InstanceInfo],
@@ -65,6 +91,7 @@ def assign_resource(
     per_instance: Dict[str, Dict[str, PartitionAssignment]],
     epochs: Dict[str, Dict],
     pins: Optional[Dict[str, PlacementPin]] = None,
+    splits: Optional[List[SplitRecord]] = None,
 ) -> Set[str]:
     """Compute one resource's target assignments (pure — no coordinator
     I/O, so the two-phase handoff edges are directly unit-testable).
@@ -85,13 +112,22 @@ def assign_resource(
     the SAME demote → no-live-leader → epoch-mint → promote machinery as
     a failover, so a pinned cutover is epoch-stamped end to end. A pin
     whose instances are all dead is ignored (a pin can never un-serve a
-    partition)."""
+    partition).
+
+    ``splits`` (hot-shard range splits, round 20) swaps ACTIVE-split
+    parents out of the enumeration for their range children
+    (:func:`effective_shards`): the parent gets NO assignment — its
+    stale replicas retire through Offline→Dropped exactly like a
+    removed resource's — while each child is assigned like any
+    partition. The split cutover pre-seeded the children's epoch ledger
+    and pins, so the first child pass finds a recorded leader matching
+    the pinned preferred leader and mints nothing."""
     leader_state, follower_state = _state_names(resource.state_model)
     changed: Set[str] = set()
     iids = sorted(instances)
     if not iids:
         return changed
-    for shard in range(resource.num_shards):
+    for shard in effective_shards(resource, splits):
         partition = db_name_to_partition_name(
             segment_to_db_name(resource.segment, shard)
         )
@@ -222,6 +258,10 @@ class Controller:
             # immediately — the cutover window is the interval between
             # the pin landing and the flip completing
             self.coord.watch(self._path("placements"), self._on_change),
+            # a split's activation re-enumerates the segment's shards:
+            # the children need assignments (and the parent needs to
+            # retire) on the next pass, not an interval later
+            self.coord.watch(self._path("splits"), self._on_change),
         ]
 
     def _on_change(self, _snap) -> None:
@@ -269,6 +309,7 @@ class Controller:
         current = self._current_states()
         epochs = self._load_epochs()
         pins = self._load_pins()
+        splits = self._load_splits()
         per_instance: Dict[str, Dict[str, PartitionAssignment]] = {
             iid: {} for iid in instances
         }
@@ -280,7 +321,7 @@ class Controller:
             resource = ResourceDef.decode(raw)
             changed |= assign_resource(
                 resource, instances, current, per_instance, epochs,
-                pins=pins)
+                pins=pins, splits=splits)
         for partition in sorted(changed):
             mine = epochs[partition]
             merged = self._persist_epoch(partition, mine)
@@ -324,6 +365,17 @@ class Controller:
                 self.coord.get_or_none(self._path("placements", p)))
             if pin is not None and pin.replicas:
                 out[p] = pin
+        return out
+
+    def _load_splits(self) -> List[SplitRecord]:
+        """ACTIVE shard-split records — the routing truth that swaps
+        split parents for their range children in assignment."""
+        out: List[SplitRecord] = []
+        for p in self.coord.list(self._path("splits")):
+            rec = SplitRecord.decode(
+                self.coord.get_or_none(self._path("splits", p)))
+            if rec is not None and rec.phase == "active":
+                out.append(rec)
         return out
 
     def _load_epochs(self) -> Dict[str, Dict]:

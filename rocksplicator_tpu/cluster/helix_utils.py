@@ -142,6 +142,27 @@ class AdminClient:
                          s3_bucket=store_uri, s3_path=sst_path,
                          timeout=600.0, **kw)
 
+    def rename_db(self, addr, db_name: str, new_db_name: str,
+                  new_role: str = "",
+                  upstream: Optional[Tuple[str, int]] = None,
+                  epoch: int = 0) -> None:
+        """Flip a local full-copy to its child identity (shard-split
+        cutover primitive): close → rename storage dir → reopen under
+        the new name with the given role/upstream/epoch."""
+        args: Dict[str, Any] = {"db_name": db_name,
+                                "new_db_name": new_db_name,
+                                "new_role": new_role, "epoch": int(epoch)}
+        if upstream:
+            args["upstream_ip"], args["upstream_port"] = upstream
+        self.call(addr, "rename_db", timeout=60.0, **args)
+
+    def set_tenant_quota(self, addr, tenant: str, ops_per_sec: float,
+                         bytes_per_sec: float) -> dict:
+        """Override one tenant's admission quota on one node, live."""
+        return self.call(addr, "set_tenant_quota", tenant=tenant,
+                         ops_per_sec=float(ops_per_sec),
+                         bytes_per_sec=float(bytes_per_sec), timeout=10.0)
+
     def compact_db(self, addr, db_name: str) -> None:
         self.call(addr, "compact_db", db_name=db_name, timeout=600.0)
 

@@ -16,6 +16,9 @@ Path conventions (the ZK tree equivalent):
     /clusters/<cluster>/placements/<partition>         placement pins (moves)
     /clusters/<cluster>/moves/<partition>              live shard-move ledger
     /clusters/<cluster>/moves_summary                  move counters (spectator)
+    /clusters/<cluster>/splits/<partition>             shard-split ledger (routing truth once active)
+    /clusters/<cluster>/splits_summary                 split counters (spectator)
+    /clusters/<cluster>/rebalancer                     rebalancer pause flag + status
 """
 
 from __future__ import annotations
@@ -103,6 +106,84 @@ class PlacementPin:
         return cls(replicas=list(d.get("replicas") or []),
                    preferred_leader=d.get("preferred_leader"),
                    move_id=d.get("move_id", ""))
+
+
+@dataclass
+class SplitRecord:
+    """One hot shard's range split — durable at
+    ``/clusters/<cluster>/splits/<parent_partition>``.
+
+    A split carves a parent hash slot into two range-partitioned VIRTUAL
+    child shards: the hash map (``num_shards``) is untouched, so every
+    existing key still hashes to the parent slot; routers then resolve
+    key → child by comparing the key against ``split_key`` (children may
+    split again — resolution chases records transitively). Child shard
+    ids are allocated ABOVE the resource's hash range so they can never
+    collide with a hashed slot.
+
+    Like a move record, the split is a resumable step machine: ``phase``
+    is written BEFORE the phase's side effects run, so a crashed driver
+    resumes idempotently. Phases mirror the move ledger
+    (planned → snapshot → restore → catchup → cutover) and terminate at
+    ``active`` — unlike a move record, an ACTIVE split record is never
+    deleted: it IS the routing truth the shard map's ``__splits__``
+    section and the controller's child-partition enumeration are
+    generated from. Abort is legal strictly pre-cutover (children are
+    invisible until the cutover publishes them).
+
+    ``low_shard`` serves keys < ``split_key``; ``high_shard`` serves
+    keys >= ``split_key``. ``split_key`` is hex-encoded (keys are
+    arbitrary bytes; JSON can't carry them raw). ``epoch`` is the
+    children's starting fencing epoch (parent epoch + 1), minted at
+    cutover so a deposed parent leader can never ack into a child's
+    lineage."""
+
+    segment: str
+    parent_shard: int
+    split_key: str  # hex-encoded boundary key
+    low_shard: int
+    high_shard: int
+    phase: str = "planned"
+    split_id: str = ""
+    epoch: int = 0
+    # the copied-out child: which child shard moved away and where its
+    # leader landed; the low child stays on the parent's replica set
+    moved_child: int = -1
+    target_instance: str = ""
+    # step-machine bookkeeping (same shape as MoveRecord; the routing
+    # consumers above ignore these)
+    store_uri: str = ""
+    snapshot_prefix: str = ""
+    snapshot_seq: int = 0
+    catchup_lag: int = -1
+    started_ms: int = 0
+    updated_ms: int = 0
+
+    PHASES = ("planned", "snapshot", "restore", "catchup", "cutover",
+              "active")
+
+    @property
+    def split_key_bytes(self) -> bytes:
+        return bytes.fromhex(self.split_key)
+
+    def child_shards(self) -> List[int]:
+        return [self.low_shard, self.high_shard]
+
+    def encode(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def decode(cls, raw: Optional[bytes]) -> Optional["SplitRecord"]:
+        if not raw:
+            return None
+        try:
+            d = json.loads(bytes(raw).decode())
+        except (ValueError, UnicodeDecodeError):
+            return None
+        try:
+            return cls(**d)
+        except TypeError:
+            return None
 
 
 @dataclass

@@ -1,0 +1,646 @@
+"""Live hot-shard range splitting: one hash slot → two range-partitioned
+virtual child shards, as a resumable step machine.
+
+Reference: the reference fleet delegates reshaping to Helix's rebalancer
++ ConfigGenerator (PAPER.md L4); when a SINGLE partition outgrows every
+placement, operators there re-shard the whole resource (shard-count
+doubling with a bulk copy). Here the split is surgical and live: the
+hash map (``num_shards``) is untouched — every key still hashes to the
+parent slot — and a durable :class:`~.model.SplitRecord` teaches
+routers/the controller to resolve key → child by RANGE under that slot
+(``rpc/router.py`` chases records transitively, so children can split
+again).
+
+Mechanics reuse the fault-proven shard-move machinery piecewise:
+
+- both children start life as FULL COPIES of the parent. The **low**
+  child (keys < split_key) is the parent's own replica set, flipped in
+  place by the new ``rename_db`` admin primitive (zero data movement);
+  the **high** child is seeded by snapshot → hidden-OBSERVER restore →
+  WAL-tail catch-up onto the target instance, exactly like a move's
+  destination (restored under the PARENT's name so the tail pull
+  addresses match).
+- out-of-range keys inside a child are harmless garbage: the router
+  routes strictly by range, so they are never read or written again
+  (space is reclaimed by a later manual compact/trim — an honest
+  residual, see PARITY.md).
+- **cutover** (failpoint ``split.cutover``) runs under the parent
+  leader's auto-expiring write pause: drain the high seed to exact
+  equality, write the children's fencing-epoch ledger records
+  (parent epoch + 1) and placement pins, then rename leader-first —
+  the instant the parent leader's db closes, no writer can ack into
+  the parent lineage, so a crash mid-sequence leaves the shard
+  temporarily leaderless (resume finishes it), never forked.
+- the record's ``active`` phase is terminal and PERMANENT: it is the
+  routing truth the shard map's ``__splits__`` section and the
+  controller's child-partition enumeration are generated from. The
+  controller then treats each child like any partition — pins top the
+  high child up to full replication through the ordinary
+  rebuild-from-peer path, and the parent's stale assignments retire
+  through Offline→Dropped.
+
+Every phase is written to ``/clusters/<c>/splits/<parent_partition>``
+BEFORE its side effects run; a driver killed at any seam resumes
+idempotently (``ShardSplit.resume``) or, strictly pre-cutover, aborts
+(``ShardSplit.abort`` — sweep the hidden seed + snapshot, delete the
+record; children were never visible).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from ..rpc.errors import RpcApplicationError, RpcError
+from ..testing import failpoints as fp
+from ..utils.objectstore import build_object_store
+from ..utils.segment_utils import (
+    db_name_to_partition_name,
+    segment_to_db_name,
+)
+from ..utils.stats import Stats
+from .coordinator import CoordinatorClient
+from .helix_utils import AdminClient
+from .model import (InstanceInfo, PlacementPin, ResourceDef, SplitRecord,
+                    cluster_path, decode_states)
+from .shard_move import MoveFlags, list_active_moves
+
+log = logging.getLogger(__name__)
+
+_LEADERLIKE = {"LEADER", "MASTER"}
+_SERVING = _LEADERLIKE | {"FOLLOWER", "SLAVE"}
+
+
+class SplitError(RuntimeError):
+    """A phase failed in a way the driver cannot ride through. The
+    split record stays durable; resume or abort it explicitly."""
+
+
+class SplitInFlightError(SplitError):
+    """A split for this partition is already recorded."""
+
+
+def list_splits(coord: CoordinatorClient,
+                cluster: str) -> List[SplitRecord]:
+    """Every recorded split (any phase), newest-path order."""
+    out: List[SplitRecord] = []
+    for p in coord.list(cluster_path(cluster, "splits")):
+        rec = SplitRecord.decode(
+            coord.get_or_none(cluster_path(cluster, "splits", p)))
+        if rec is not None:
+            out.append(rec)
+    return out
+
+
+def active_splits(coord: CoordinatorClient,
+                  cluster: str) -> List[SplitRecord]:
+    """The routing truth: splits whose children are live."""
+    return [r for r in list_splits(coord, cluster) if r.phase == "active"]
+
+
+def choose_split_key(admin: AdminClient, repl_addr: Tuple[str, int],
+                     db_name: str, sample: int = 257) -> Optional[bytes]:
+    """Median key of a bounded leader scan — the default range boundary
+    when the caller (rebalancer / CLI) doesn't name one. A scan-based
+    median splits the OBSERVED keyspace evenly; with a skewed range the
+    halves are still both strictly smaller than the parent, which is
+    all a split needs to make progress."""
+    try:
+        r = admin.call(repl_addr, "read", db_name=db_name, op="scan",
+                       start=b"", count=int(sample), timeout=10.0)
+    except (RpcError, RpcApplicationError):
+        return None
+    keys = []
+    for row in (r or {}).get("values") or []:
+        if isinstance(row, (list, tuple)) and row:
+            keys.append(bytes(row[0]))
+    if len(keys) < 2:
+        return None
+    keys.sort()
+    mid = keys[len(keys) // 2]
+    return mid if mid != keys[0] else None
+
+
+class ShardSplit:
+    """Coordinator-backed splitter for one partition. Construct via
+    :meth:`start` (new split) or :meth:`resume`; :meth:`run` executes to
+    the terminal ``active`` phase; :meth:`abort` unwinds pre-cutover."""
+
+    def __init__(self, coord: CoordinatorClient, cluster: str,
+                 record: SplitRecord,
+                 admin: Optional[AdminClient] = None,
+                 flags: Optional[MoveFlags] = None):
+        self.coord = coord
+        self.cluster = cluster
+        self.rec = record
+        self.flags = flags or MoveFlags()
+        self.admin = admin or AdminClient()
+        self._owns_admin = admin is None
+        self._path = lambda *p: cluster_path(cluster, *p)
+        self._stats = Stats.get()
+        self._last_record_put = 0.0
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def start(cls, coord: CoordinatorClient, cluster: str, segment: str,
+              parent_shard: int, split_key: bytes, target: str,
+              store_uri: str, admin: Optional[AdminClient] = None,
+              flags: Optional[MoveFlags] = None) -> "ShardSplit":
+        """Record and return a NEW split (phase ``planned``). Child
+        shard ids are allocated ABOVE the resource's hash range (and
+        above every child any recorded split already claimed), so a
+        child id can never collide with a hashed slot."""
+        if not split_key:
+            raise SplitError("empty split key")
+        raw = coord.get_or_none(cluster_path(cluster, "resources",
+                                             segment))
+        if raw is None:
+            raise SplitError(f"unknown segment {segment!r}")
+        resource = ResourceDef.decode(raw)
+        if not (0 <= parent_shard < resource.num_shards or any(
+                parent_shard in r.child_shards()
+                for r in list_splits(coord, cluster)
+                if r.segment == segment)):
+            raise SplitError(
+                f"{segment}: shard {parent_shard} is neither a hash "
+                f"slot nor a live child")
+        next_id = resource.num_shards
+        for r in list_splits(coord, cluster):
+            if r.segment == segment:
+                next_id = max(next_id, r.low_shard + 1, r.high_shard + 1)
+        db_name = segment_to_db_name(segment, parent_shard)
+        partition = db_name_to_partition_name(db_name)
+        if any(m.partition == partition
+               for m in list_active_moves(coord, cluster)):
+            raise SplitError(
+                f"{partition}: a shard move is in flight — splitting "
+                f"under it would race the placement pin")
+        rec = SplitRecord(
+            segment=segment, parent_shard=parent_shard,
+            split_key=bytes(split_key).hex(),
+            low_shard=next_id, high_shard=next_id + 1,
+            split_id=uuid.uuid4().hex[:12],
+            moved_child=next_id + 1, target_instance=target,
+            store_uri=store_uri,
+            snapshot_prefix=f"splits/{db_name}/{uuid.uuid4().hex[:12]}",
+            started_ms=int(time.time() * 1000),
+        )
+        sp = cls(coord, cluster, rec, admin=admin, flags=flags)
+        try:
+            sp._validate_plan()
+            sp.coord.create(sp._record_path(), rec.encode())
+        except RpcApplicationError as e:
+            sp.close()
+            if e.code == "NODE_EXISTS":
+                raise SplitInFlightError(
+                    f"{partition}: a split is already recorded — resume "
+                    f"or abort it first") from e
+            raise
+        except BaseException:
+            sp.close()
+            raise
+        sp._stats.incr("shard_splits.started")
+        sp._bump_summary("started")
+        return sp
+
+    @classmethod
+    def resume(cls, coord: CoordinatorClient, cluster: str,
+               partition: str, admin: Optional[AdminClient] = None,
+               flags: Optional[MoveFlags] = None) -> "ShardSplit":
+        raw = coord.get_or_none(cluster_path(cluster, "splits",
+                                             partition))
+        rec = SplitRecord.decode(raw)
+        if rec is None:
+            raise SplitError(f"{partition}: no split recorded")
+        if rec.phase == "active":
+            raise SplitError(f"{partition}: split already active")
+        sp = cls(coord, cluster, rec, admin=admin, flags=flags)
+        sp._stats.incr("shard_splits.resumed")
+        sp._bump_summary("resumed")
+        return sp
+
+    # -- plumbing --------------------------------------------------------
+
+    @property
+    def parent_db(self) -> str:
+        return segment_to_db_name(self.rec.segment, self.rec.parent_shard)
+
+    @property
+    def parent_partition(self) -> str:
+        return db_name_to_partition_name(self.parent_db)
+
+    def _child_db(self, shard: int) -> str:
+        return segment_to_db_name(self.rec.segment, shard)
+
+    def _child_partition(self, shard: int) -> str:
+        return db_name_to_partition_name(self._child_db(shard))
+
+    def _record_path(self) -> str:
+        return self._path("splits", self.parent_partition)
+
+    def _save(self, phase: Optional[str] = None,
+              force: bool = True) -> None:
+        now = time.monotonic()
+        if phase is not None:
+            self.rec.phase = phase
+        elif not force and (now - self._last_record_put
+                            < self.flags.record_update_interval):
+            return
+        self.rec.updated_ms = int(time.time() * 1000)
+        self.coord.put(self._record_path(), self.rec.encode())
+        self._last_record_put = now
+
+    def _bump_summary(self, key: str) -> None:
+        path = self._path("splits_summary")
+        try:
+            raw = self.coord.get_or_none(path)
+            d = json.loads(bytes(raw).decode()) if raw else {}
+            d[key] = int(d.get(key, 0)) + 1
+            self.coord.put(path, json.dumps(d).encode())
+        except Exception:
+            log.debug("splits_summary bump failed", exc_info=True)
+
+    def _instances(self) -> Dict[str, InstanceInfo]:
+        out: Dict[str, InstanceInfo] = {}
+        for iid in self.coord.list(self._path("instances")):
+            raw = self.coord.get_or_none(self._path("instances", iid))
+            if raw:
+                out[iid] = InstanceInfo.decode(raw)
+        return out
+
+    def _states(self, partition: Optional[str] = None) -> Dict[str, str]:
+        partition = partition or self.parent_partition
+        out: Dict[str, str] = {}
+        for iid in self.coord.list(self._path("currentstates")):
+            st = decode_states(self.coord.get_or_none(
+                self._path("currentstates", iid))).get(partition)
+            if st:
+                out[iid] = st
+        return out
+
+    def _admin_addr(self, info: InstanceInfo) -> Tuple[str, int]:
+        return (info.host, info.admin_port)
+
+    def _seq(self, info: InstanceInfo, db: Optional[str] = None
+             ) -> Optional[int]:
+        return self.admin.get_sequence_number(
+            self._admin_addr(info), db or self.parent_db)
+
+    def _leader(self) -> Optional[Tuple[str, InstanceInfo]]:
+        instances = self._instances()
+        for iid, st in self._states().items():
+            if st in _LEADERLIKE and iid in instances:
+                return (iid, instances[iid])
+        return None
+
+    def _target_info(self) -> InstanceInfo:
+        info = self._instances().get(self.rec.target_instance)
+        if info is None:
+            raise SplitError(
+                f"{self.parent_partition}: target "
+                f"{self.rec.target_instance} is not a live instance")
+        return info
+
+    def _validate_plan(self) -> None:
+        instances = self._instances()
+        states = self._states()
+        if self.rec.target_instance not in instances:
+            raise SplitError(
+                f"target {self.rec.target_instance} is not live")
+        if not any(st in _LEADERLIKE for st in states.values()):
+            raise SplitError(
+                f"{self.parent_partition}: no live leader to split")
+        if self.rec.target_instance in states:
+            raise SplitError(
+                f"target {self.rec.target_instance} already serves "
+                f"{self.parent_partition} — pick a non-hosting instance")
+        if self._seq(instances[self.rec.target_instance]) is not None:
+            raise SplitError(
+                f"target {self.rec.target_instance} already holds a "
+                f"{self.parent_db} replica (leftover?) — sweep it first")
+
+    # -- the step machine ------------------------------------------------
+
+    def run(self) -> SplitRecord:
+        order = {p: i for i, p in enumerate(SplitRecord.PHASES)}
+        start_at = order.get(self.rec.phase, 0)
+        try:
+            if start_at <= order["snapshot"]:
+                self._save("snapshot")
+                self._phase_snapshot()
+            if start_at <= order["restore"]:
+                self._save("restore")
+                self._phase_restore()
+            if start_at <= order["catchup"]:
+                self._save("catchup")
+                self._phase_catchup()
+            if start_at <= order["cutover"]:
+                self._save("cutover")
+                self._phase_cutover()
+            self._finish()
+            self.close()
+            return self.rec
+        finally:
+            pass
+
+    def close(self) -> None:
+        if self._owns_admin:
+            self.admin.close()
+            self._owns_admin = False
+
+    def _phase_snapshot(self) -> None:
+        rec = self.rec
+        led = self._leader()
+        if led is None:
+            raise SplitError(f"{self.parent_partition}: no live leader "
+                             f"to snapshot")
+        r = self.admin.backup_db_to_store(
+            self._admin_addr(led[1]), self.parent_db, rec.store_uri,
+            rec.snapshot_prefix)
+        rec.snapshot_seq = int(r.get("seq") or 0)
+        self._save()
+
+    def _phase_restore(self) -> None:
+        rec = self.rec
+        target = self._target_info()
+        existing = self._seq(target)
+        if existing is not None and existing >= rec.snapshot_seq > 0:
+            return  # resumed past the restore
+        led = self._leader()
+        if led is None:
+            raise SplitError(f"{self.parent_partition}: no live leader "
+                             f"to tail from after restore")
+        # hidden OBSERVER under the PARENT's name: the WAL-tail pull
+        # addresses by db name, and observer pulls never count toward
+        # semi-sync acks (an aborted split sweeps this replica — it must
+        # never have been an acker)
+        self.admin.restore_db_from_store(
+            self._admin_addr(target), self.parent_db, rec.store_uri,
+            rec.snapshot_prefix,
+            upstream=(led[1].host, led[1].repl_port), role="OBSERVER")
+        self._save()
+
+    def _lag(self) -> Optional[int]:
+        led = self._leader()
+        if led is None:
+            return None
+        target = self._instances().get(self.rec.target_instance)
+        if target is None:
+            raise SplitError(f"{self.parent_partition}: target died "
+                             f"during catch-up")
+        lseq = self._seq(led[1])
+        tseq = self._seq(target)
+        if lseq is None or tseq is None:
+            return None
+        return max(0, lseq - tseq)
+
+    def _phase_catchup(self) -> None:
+        rec, flags = self.rec, self.flags
+        deadline = time.monotonic() + flags.catchup_timeout
+        while True:
+            lag = self._lag()
+            if lag is not None:
+                rec.catchup_lag = lag
+                self._save(force=False)
+                if lag <= flags.catchup_lag_threshold:
+                    self._save()
+                    return
+            if time.monotonic() > deadline:
+                raise SplitError(
+                    f"{self.parent_partition}: split catch-up lag "
+                    f"{rec.catchup_lag} never reached threshold within "
+                    f"{flags.catchup_timeout}s")
+            time.sleep(flags.poll_interval)
+
+    def _put_epoch_record(self, partition: str, leader_iid: str,
+                          epoch: int) -> None:
+        """Seed a child's fencing-epoch ledger record, max-merging
+        against anything already there (a resumed cutover re-puts; the
+        controller only writes child records AFTER the split activates,
+        so pre-active this driver is the only writer)."""
+        path = self._path("epochs", partition)
+        raw = self.coord.get_or_none(path)
+        if raw:
+            try:
+                existing = json.loads(bytes(raw).decode())
+                if int(existing.get("epoch", 0)) >= epoch:
+                    return
+            except (ValueError, UnicodeDecodeError):
+                pass
+        self.coord.put(path, json.dumps(
+            {"epoch": int(epoch), "leader": leader_iid}).encode())
+
+    def _phase_cutover(self) -> None:
+        """The fenced flip: pause → drain-to-0 → child ledgers/pins →
+        rename LEADER-FIRST → children live. Leader-first is the loss
+        guard (and what the chaos harness's ``split_cutover`` tooth
+        breaks): the instant the parent leader's db closes, nothing can
+        ack into the parent lineage, so post-pause stragglers are
+        refused rather than stranded on a copy a child never sees."""
+        fp.hit("split.cutover")
+        rec = self.rec
+        instances = self._instances()
+        states = self._states()
+        target = instances.get(rec.target_instance)
+        if target is None:
+            raise SplitError(f"{self.parent_partition}: target "
+                             f"{rec.target_instance} died at cutover")
+        led = self._leader()
+        low_db = self._child_db(rec.low_shard)
+        high_db = self._child_db(rec.high_shard)
+        leader_iid: Optional[str] = None
+        hosting = [iid for iid, st in states.items() if st in _SERVING]
+        if led is not None and self._seq(led[1]) is not None:
+            # the parent still exists: drain the high seed to EXACT
+            # equality under the write pause, then mint the children's
+            # epoch from the live parent epoch
+            leader_iid, leader = led
+            if self._seq(target) is None:
+                raise SplitError(
+                    f"{self.parent_partition}: target no longer holds "
+                    f"the {self.parent_db} seed at cutover")
+            self._cutover_drain(leader)
+            info = self.admin.check_db(self._admin_addr(leader),
+                                       self.parent_db)
+            live_epoch = int((info or {}).get("epoch") or 0)
+            ledger = self.coord.get_or_none(
+                self._path("epochs", self.parent_partition))
+            rec_epoch = 0
+            if ledger:
+                try:
+                    rec_epoch = int(json.loads(
+                        bytes(ledger).decode()).get("epoch", 0))
+                except (ValueError, UnicodeDecodeError):
+                    pass
+            rec.epoch = max(live_epoch, rec_epoch) + 1
+            self._save()
+        elif rec.epoch <= 0:
+            raise SplitError(
+                f"{self.parent_partition}: parent gone but no child "
+                f"epoch recorded — cannot resume this cutover")
+        # resumed cutovers must re-derive who the low child's replicas
+        # are even when the parent claims are already gone
+        if leader_iid is None:
+            prior = self.coord.get_or_none(
+                self._path("placements",
+                           self._child_partition(rec.low_shard)))
+            pin = PlacementPin.decode(prior)
+            hosting = list(pin.replicas) if pin else hosting
+            leader_iid = pin.preferred_leader if pin else None
+        low_replicas = sorted(set(hosting) - {rec.target_instance}) \
+            or [leader_iid for leader_iid in [leader_iid] if leader_iid]
+        # children's durable identity BEFORE any rename: ledger records
+        # (epoch, leader) + placement pins. The controller reads both
+        # the moment the split activates, so its first child assignments
+        # already match the renamed reality (sticky recorded leader, no
+        # second epoch mint).
+        low_part = self._child_partition(rec.low_shard)
+        high_part = self._child_partition(rec.high_shard)
+        self._put_epoch_record(low_part, leader_iid or "", rec.epoch)
+        self._put_epoch_record(high_part, rec.target_instance, rec.epoch)
+        self.coord.put(self._path("placements", low_part), PlacementPin(
+            replicas=low_replicas, preferred_leader=leader_iid,
+            move_id=rec.split_id).encode())
+        self.coord.put(self._path("placements", high_part), PlacementPin(
+            replicas=[rec.target_instance],
+            preferred_leader=rec.target_instance,
+            move_id=rec.split_id).encode())
+        # renames: LEADER FIRST (closes the parent lineage to writers),
+        # then the high seed (already at exact equality), then the
+        # parent followers in place. Each rename is idempotent on
+        # resume (done = no-op inside the handler).
+        if led is not None and leader_iid in instances:
+            self.admin.rename_db(
+                self._admin_addr(instances[leader_iid]), self.parent_db,
+                low_db, new_role="LEADER", epoch=rec.epoch)
+        self.admin.rename_db(
+            self._admin_addr(target), self.parent_db, high_db,
+            new_role="LEADER", epoch=rec.epoch)
+        leader_info = instances.get(leader_iid or "")
+        for iid in low_replicas:
+            if iid == leader_iid:
+                continue
+            info = instances.get(iid)
+            if info is None:
+                continue
+            try:
+                self.admin.rename_db(
+                    self._admin_addr(info), self.parent_db, low_db,
+                    new_role="FOLLOWER",
+                    upstream=((leader_info.host, leader_info.repl_port)
+                              if leader_info else None),
+                    epoch=rec.epoch)
+            except (RpcError, RpcApplicationError) as e:
+                # a follower that raced away (dead / already renamed /
+                # never hosted) self-heals through the controller's
+                # child assignment — the leader rename above is the
+                # only rename correctness depends on
+                log.warning("%s: follower rename on %s failed: %r",
+                            self.parent_partition, iid, e)
+
+    def _cutover_drain(self, leader: InstanceInfo) -> None:
+        flags = self.flags
+        last_lag = None
+        for _attempt in range(flags.cutover_attempts):
+            try:
+                self.admin.pause_db_writes(
+                    self._admin_addr(leader), self.parent_db,
+                    flags.cutover_pause_ms)
+            except (RpcError, RpcApplicationError):
+                continue
+            pause_deadline = (time.monotonic()
+                              + flags.cutover_pause_ms / 1000.0)
+            while time.monotonic() < pause_deadline:
+                lag = self._lag()
+                if lag is not None:
+                    last_lag = lag
+                    self.rec.catchup_lag = lag
+                    if lag == 0:
+                        return
+                time.sleep(flags.poll_interval)
+        raise SplitError(
+            f"{self.parent_partition}: high seed never drained to 0 "
+            f"across {flags.cutover_attempts} pause windows (last lag "
+            f"{last_lag})")
+
+    def _finish(self) -> None:
+        rec = self.rec
+        # the activation IS the publish: spectator emits __splits__,
+        # routers resolve by range, the controller enumerates children
+        # and retires the parent's assignments
+        self._save("active")
+        self.coord.delete_if_exists(
+            self._path("placements", self.parent_partition))
+        self._await_children()
+        self._sweep_snapshot()
+        self._stats.incr("shard_splits.completed")
+        self._bump_summary("completed")
+        log.info("%s: split %s active (low=%d high=%d @ %s)",
+                 self.parent_partition, rec.split_id, rec.low_shard,
+                 rec.high_shard, rec.split_key)
+
+    def _await_children(self) -> None:
+        """Wait for both children to have a leaderlike claim in the
+        published current states — the moment the shard map serves them
+        and the harness can declare the split live."""
+        flags = self.flags
+        deadline = time.monotonic() + flags.flip_timeout
+        wanted = [self._child_partition(self.rec.low_shard),
+                  self._child_partition(self.rec.high_shard)]
+        while time.monotonic() < deadline:
+            if all(any(st in _LEADERLIKE
+                       for st in self._states(p).values())
+                   for p in wanted):
+                return
+            time.sleep(flags.poll_interval)
+        raise SplitError(
+            f"{self.parent_partition}: children never reached a leader "
+            f"claim within {flags.flip_timeout}s")
+
+    def _sweep_snapshot(self) -> None:
+        try:
+            store = build_object_store(self.rec.store_uri)
+            for key in store.list_objects(
+                    self.rec.snapshot_prefix.rstrip("/") + "/"):
+                store.delete_object(key)
+        except Exception:
+            log.warning("%s: split snapshot sweep failed",
+                        self.parent_partition, exc_info=True)
+
+    # -- abort -----------------------------------------------------------
+
+    def abort(self) -> None:
+        """Unwind a strictly PRE-cutover split: sweep the hidden high
+        seed and the snapshot, delete the record. At or past cutover the
+        children's identity is being published — the only safe direction
+        is forward (resume)."""
+        rec = self.rec
+        order = {p: i for i, p in enumerate(SplitRecord.PHASES)}
+        if order.get(rec.phase, 0) >= order["cutover"]:
+            raise SplitError(
+                f"{self.parent_partition}: split already at {rec.phase}"
+                f" — past the point of no return; resume it instead")
+        target = self._instances().get(rec.target_instance)
+        if target is not None:
+            try:
+                self.admin.clear_db(self._admin_addr(target),
+                                    self.parent_db, reopen=False)
+            except (RpcError, RpcApplicationError) as e:
+                if getattr(e, "code", None) != "DB_NOT_FOUND":
+                    raise SplitError(
+                        f"{self.parent_partition}: abort could not "
+                        f"sweep the seed on {rec.target_instance} "
+                        f"({e!r}) — record kept, retry") from e
+        try:
+            self._sweep_snapshot()
+        finally:
+            self.coord.delete_if_exists(self._record_path())
+            self._stats.incr("shard_splits.aborted")
+            self._bump_summary("aborted")
+            self.close()
+        log.info("%s: split %s aborted at phase %s",
+                 self.parent_partition, rec.split_id, rec.phase)

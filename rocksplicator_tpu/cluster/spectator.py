@@ -148,6 +148,11 @@ class Spectator:
                     # claimed job, which worker holds it, heartbeat age
                     stats["remote_compactions"] = \
                         self._remote_compactions()
+                    # hot-shard range splits + the rebalancer's own
+                    # pause/decision status (round 20): the operator's
+                    # one-stop view of WHY placement is changing
+                    stats["shard_splits"] = self._shard_splits()
+                    stats["rebalancer"] = self._rebalancer_status()
                     self.cluster_stats = stats
                 if not endpoint_registered:
                     # serve /cluster_stats off this process's status
@@ -192,6 +197,55 @@ class Spectator:
             except (ValueError, UnicodeDecodeError):
                 counters = {}
         return {"active": active, "counters": counters}
+
+    def _shard_splits(self) -> dict:
+        """Split-ledger view: in-flight splits with phase/lag progress,
+        ACTIVE splits as the permanent routing records they are, plus
+        the cluster-lifetime started/completed/aborted/resumed
+        counters (splits_summary) — the _shard_moves shape applied to
+        the round-20 splitter."""
+        import json as _json
+
+        from ..utils.segment_utils import (db_name_to_partition_name,
+                                           segment_to_db_name)
+        from .shard_split import list_splits
+
+        in_flight, active = {}, {}
+        for rec in list_splits(self.coord, self.cluster):
+            partition = db_name_to_partition_name(
+                segment_to_db_name(rec.segment, rec.parent_shard))
+            doc = {
+                "split_id": rec.split_id, "phase": rec.phase,
+                "split_key": rec.split_key,
+                "low_shard": rec.low_shard, "high_shard": rec.high_shard,
+                "target": rec.target_instance, "epoch": rec.epoch,
+                "catchup_lag": rec.catchup_lag,
+                "updated_ms": rec.updated_ms,
+            }
+            (active if rec.phase == "active" else in_flight)[partition] \
+                = doc
+        counters = {}
+        raw = self.coord.get_or_none(self._path("splits_summary"))
+        if raw:
+            try:
+                counters = _json.loads(bytes(raw).decode())
+            except (ValueError, UnicodeDecodeError):
+                counters = {}
+        return {"in_flight": in_flight, "active": active,
+                "counters": counters}
+
+    def _rebalancer_status(self) -> dict:
+        """The rebalancer's durable status document (pause flag, last
+        decisions, per-shard EWMA snapshot) verbatim."""
+        import json as _json
+
+        raw = self.coord.get_or_none(self._path("rebalancer"))
+        if raw:
+            try:
+                return _json.loads(bytes(raw).decode())
+            except (ValueError, UnicodeDecodeError):
+                pass
+        return {}
 
     def _remote_compactions(self) -> dict:
         """Per-db remote compaction job state from the job ledger

@@ -166,13 +166,26 @@ class LeaderFollowerStateModel(StateModel):
                 ctx.local_admin_addr, self.db_name
             ) or 0
             need_rebuild = best_seq - local > REBUILD_SEQ_GAP
-            if (not need_rebuild and best_addr is not None
+            # probe the node the puller will ACTUALLY pull from — the
+            # leader when one exists, not the max-seq replica: a
+            # tie-broken probe of a sibling whose WAL reaches back
+            # fine passes the check while the real upstream's WAL is
+            # purged past us, and the follower wedges at its old seq
+            # through every heal replan (found by the rebalance chaos
+            # harness: a split-child follower stuck at 0 behind a
+            # child leader whose WAL began at the cutover snapshot)
+            probe = leader if leader is not None else best_addr
+            if (not need_rebuild and probe is not None
                     and best_seq > local):
                 donor = ctx.admin.check_db(
-                    (best_addr.host, best_addr.admin_port), self.db_name)
-                oldest = (donor or {}).get("oldest_wal_seq")
-                if oldest is not None and local + 1 < int(oldest):
-                    need_rebuild = True
+                    (probe.host, probe.admin_port), self.db_name)
+                if donor is not None:
+                    oldest = donor.get("oldest_wal_seq")
+                    # an empty donor WAL (oldest None) serves NO
+                    # history: with the donor ahead of us that is a
+                    # gap too, not a pass
+                    if oldest is None or local + 1 < int(oldest):
+                        need_rebuild = True
             if (
                 need_rebuild
                 and ctx.backup_store_uri
@@ -311,14 +324,28 @@ class LeaderFollowerStateModel(StateModel):
             ctx.log_event(self.partition, "deposed_resync_success")
             return
         upstream = ctx.local_repl_addr
-        ctx.admin.change_db_role_and_upstream(
-            ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream,
-            epoch=ctx.partition_epoch(self.partition),
-        )
+        try:
+            ctx.admin.change_db_role_and_upstream(
+                ctx.local_admin_addr, self.db_name, "FOLLOWER", upstream,
+                epoch=ctx.partition_epoch(self.partition),
+            )
+        except RpcApplicationError as e:
+            if e.code != "DB_NOT_FOUND":
+                raise
+            # the db vanished under us — a split cutover renamed it to a
+            # child lineage. The demote's goal (this replica no longer
+            # acks as leader) is already met more strongly than a role
+            # flip could: there is nothing here to ack.
+            ctx.log_event(self.partition, "leader_to_follower_db_gone")
         ctx.log_event(self.partition, "leader_to_follower_success")
 
     def on_become_offline_from_follower(self) -> None:
-        self.ctx.admin.close_db(self.ctx.local_admin_addr, self.db_name)
+        try:
+            self.ctx.admin.close_db(self.ctx.local_admin_addr, self.db_name)
+        except RpcApplicationError as e:
+            if e.code != "DB_NOT_FOUND":
+                raise
+            # renamed away by a split cutover: already as offline as it gets
 
     def on_become_dropped_from_offline(self) -> None:
         # destroy local data (reference: Offline→Dropped removes the db)

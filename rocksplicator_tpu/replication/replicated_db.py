@@ -764,6 +764,15 @@ class ReplicatedDB:
                             max_updates
                         )
                     sp_read.annotate(updates=len(updates))
+            except RpcApplicationError:
+                # already typed for the puller — WAL_GAP above all: the
+                # SOURCE_READ_ERROR wrapper below would mask the code
+                # the puller's stall detection keys on, leaving a
+                # behind-the-purge-horizon follower retrying seq 1
+                # forever instead of flagging the snapshot rebuild
+                # (found by the rebalance chaos harness: a fresh
+                # split-child follower wedged exactly this way)
+                raise
             except Exception as e:
                 log.exception("%s: WAL read failed", self.name)
                 raise RpcApplicationError(
@@ -1138,6 +1147,12 @@ class ReplicatedDB:
         wrapper (CDC observer) bounces cleanly down the router's chain."""
         from .db_wrapper import execute_read_op
 
+        # sync hit ON the executor thread (unlike the loop-side
+        # repl.read seam above): a delay policy here OCCUPIES a
+        # dispatch slot without burning CPU — the hot-shift bench's
+        # deterministic per-read service cost, so the serving knee is
+        # rate-derived rather than host-derived even on a 1-core box
+        fp.hit("repl.read.serve")
         try:
             return execute_read_op(self.wrapper, op, keys=keys,
                                    start=start, count=count)

@@ -125,6 +125,10 @@ class TenantAdmission:
         self._lock = threading.Lock()
         self._buckets: Dict[str, Tuple[Optional[TokenBucket],
                                        Optional[TokenBucket]]] = {}
+        # tenant -> (ops_rate, bytes_rate) runtime overrides (the
+        # set_tenant_quota admin RPC): take effect on the NEXT admit —
+        # no restart, no env round trip
+        self._overrides: Dict[str, Tuple[float, float]] = {}
 
     # -- singleton wiring --------------------------------------------------
 
@@ -163,7 +167,38 @@ class TenantAdmission:
 
     @property
     def configured(self) -> bool:
-        return self._ops_rate > 0.0 or self._bytes_rate > 0.0
+        if self._ops_rate > 0.0 or self._bytes_rate > 0.0:
+            return True
+        with self._lock:
+            return any(o > 0.0 or b > 0.0
+                       for o, b in self._overrides.values())
+
+    def set_quota(self, tenant: Optional[str], ops_per_sec: float,
+                  bytes_per_sec: float) -> None:
+        """Runtime quota override for one tenant (the set_tenant_quota
+        admin RPC). The tenant's buckets are rebuilt at the new rates on
+        its next admission — a RAISE takes effect without restart and
+        without waiting out a starved bucket's refill horizon. Zero/zero
+        clears the override back to the env-configured default tier."""
+        name = sanitize_tenant(tenant)
+        ops = max(0.0, float(ops_per_sec))
+        byt = max(0.0, float(bytes_per_sec))
+        with self._lock:
+            if ops <= 0.0 and byt <= 0.0:
+                self._overrides.pop(name, None)
+            else:
+                self._overrides[name] = (ops, byt)
+            # drop the live buckets so _buckets_for rebuilds at the new
+            # rates (keeping them would pin the old refill rate — and a
+            # raised tenant would stay starved behind its old horizon)
+            self._buckets.pop(name, None)
+
+    def quota_for(self, tenant: Optional[str]) -> Tuple[float, float]:
+        """(ops_rate, bytes_rate) currently in force for a tenant."""
+        name = sanitize_tenant(tenant)
+        with self._lock:
+            return self._overrides.get(
+                name, (self._ops_rate, self._bytes_rate))
 
     def _buckets_for(self, tenant: str) -> Tuple[Optional[TokenBucket],
                                                  Optional[TokenBucket]]:
@@ -172,11 +207,15 @@ class TenantAdmission:
             if pair is None:
                 # equal per-tenant buckets = the weighted-fair default
                 # tier (every tenant weight 1); created lazily on first
-                # sight so the tenant universe never needs declaring
-                ops = TokenBucket(self._ops_rate, clock=self._clock) \
-                    if self._ops_rate > 0 else None
-                byt = TokenBucket(self._bytes_rate, clock=self._clock) \
-                    if self._bytes_rate > 0 else None
+                # sight so the tenant universe never needs declaring.
+                # A runtime override (set_quota) replaces this tenant's
+                # default rates.
+                ops_rate, bytes_rate = self._overrides.get(
+                    tenant, (self._ops_rate, self._bytes_rate))
+                ops = TokenBucket(ops_rate, clock=self._clock) \
+                    if ops_rate > 0 else None
+                byt = TokenBucket(bytes_rate, clock=self._clock) \
+                    if bytes_rate > 0 else None
                 pair = (ops, byt)
                 self._buckets[tenant] = pair
             return pair
@@ -209,7 +248,7 @@ class TenantAdmission:
 
     def debit_bytes(self, tenant: Optional[str], nbytes: int) -> None:
         """Post-hoc response-bytes charge (size unknown at admission)."""
-        if not self.configured or nbytes <= 0 or self._bytes_rate <= 0:
+        if not self.configured or nbytes <= 0:
             return
         _ops, byt = self._buckets_for(sanitize_tenant(tenant))
         if byt is not None:

@@ -46,6 +46,11 @@ _READ_BOUNCE_CODES = frozenset(
 _WRITE_BOUNCE_CODES = frozenset(
     ("NOT_LEADER", "STALE_EPOCH", "SOURCE_NOT_FOUND"))
 
+# ops eligible for the round-19 tail-shaving backup request. scan is
+# deliberately excluded: a hedged scan doubles an UNBOUNDED amount of
+# engine work for one credit, and split-parent scans already fan out
+_HEDGEABLE_OPS = frozenset(("get", "multi_get"))
+
 
 def _retrieve_exception(task: "asyncio.Task") -> None:
     """Done-callback for hedge arms: a loser that errors after the
@@ -128,6 +133,11 @@ class _Segment:
     num_shards: int = 0
     # shard -> [(host, role)]
     shard_to_hosts: Dict[int, List[Tuple[Host, Role]]] = field(default_factory=dict)
+    # hot-shard range splits (round 20): parent shard -> (split_key,
+    # low child, high child). num_shards stays the HASH width — keys
+    # still hash to the parent slot; resolve_shard chases these records
+    # (transitively: children can split again) to the serving child.
+    splits: Dict[int, Tuple[bytes, int, int]] = field(default_factory=dict)
 
 
 class ClusterLayout:
@@ -148,6 +158,13 @@ class ClusterLayout:
             for key, value in body.items():
                 if key in ("num_shards", "num_leaf_segments"):
                     seg.num_shards = int(value)
+                    continue
+                if key == "__splits__":
+                    # {"<parent>": {"split_key": hex, "low": n, "high": n}}
+                    for parent, rec in dict(value).items():
+                        seg.splits[int(parent)] = (
+                            bytes.fromhex(str(rec["split_key"])),
+                            int(rec["low"]), int(rec["high"]))
                     continue
                 parts = key.split(":")
                 if len(parts) < 2:
@@ -305,6 +322,87 @@ class RpcRouter:
                 break
         return clients
 
+    # -- hot-shard range splits (round 20) --------------------------------
+
+    def resolve_shard(self, segment: str, shard: int,
+                      key: Optional[bytes]) -> int:
+        """Slot → serving shard: chase the segment's split records by
+        range (keys < split_key → low child, >= → high) transitively
+        until an unsplit shard answers. A no-split segment returns the
+        input shard unchanged — the pre-split hot path costs one dict
+        miss."""
+        seg = self._layout.segments.get(segment)
+        if seg is None or not seg.splits or key is None:
+            return shard
+        k = bytes(key)
+        while True:
+            sp = seg.splits.get(shard)
+            if sp is None:
+                return shard
+            split_key, low, high = sp
+            shard = low if k < split_key else high
+
+    async def _split_multi_get(self, segment: str, shard: int, keys,
+                               policy, epoch, timeout: float):
+        """multi_get across a split parent: partition keys by serving
+        child, fan the per-child multi_gets out concurrently, stitch
+        values back in the caller's key order. Response metadata (lag,
+        epoch, role) is per-replica and meaningless across a fan-out —
+        the stitched response carries one child's, values are exact."""
+        groups: Dict[int, List[Tuple[int, bytes]]] = {}
+        for i, k in enumerate(keys):
+            child = self.resolve_shard(segment, shard, bytes(k))
+            groups.setdefault(child, []).append((i, bytes(k)))
+        ordered = sorted(groups.items())
+        results = await asyncio.gather(*[
+            self.read(segment, child, op="multi_get",
+                      keys=[k for _i, k in items], policy=policy,
+                      epoch=epoch, timeout=timeout)
+            for child, items in ordered])
+        values: List = [None] * len(keys)
+        for (child, items), r in zip(ordered, results):
+            got = (r or {}).get("values") or []
+            for (i, _k), v in zip(items, got):
+                values[i] = v
+        resp = dict(results[-1] or {}) if results else {}
+        resp["values"] = values
+        return resp
+
+    async def _split_scan(self, segment: str,
+                          sp: Tuple[bytes, int, int], start, count,
+                          policy, epoch, timeout: float):
+        """Ordered scan across a split parent: low child first (rows
+        truncated at the boundary — everything a full-copy child holds
+        at or past its split key is pre-split garbage the range owns to
+        the OTHER child), then continue into the high child from
+        max(start, split_key). Nested splits recurse through read()."""
+        split_key, low, high = sp
+        want = 10 if count is None else max(1, int(count))
+        out: List = []
+        meta = None
+        s = bytes(start) if start is not None else b""
+        if s < split_key:
+            r = await self.read(segment, low, op="scan",
+                                start=(s or None), count=want,
+                                policy=policy, epoch=epoch,
+                                timeout=timeout)
+            meta = r
+            for row in (r or {}).get("values") or []:
+                if bytes(row[0]) >= split_key:
+                    break  # ordered: the rest is out-of-range garbage
+                out.append(row)
+        if len(out) < want:
+            r = await self.read(segment, high, op="scan",
+                                start=max(s, split_key),
+                                count=want - len(out), policy=policy,
+                                epoch=epoch, timeout=timeout)
+            if meta is None:
+                meta = r
+            out.extend((r or {}).get("values") or [])
+        resp = dict(meta or {})
+        resp["values"] = out[:want]
+        return resp
+
     # -- bounded-staleness reads (round 13) -------------------------------
 
     def read_pick(self, segment: str, shard: int,
@@ -362,6 +460,29 @@ class RpcRouter:
         another host is safe — unlike the write path, which must not
         re-commit on a timeout)."""
         policy = policy or ReadPolicy.leader_only()
+        seg = self._layout.segments.get(segment)
+        if seg is not None and seg.splits:
+            # slot → serving child before any host is picked: the
+            # parent db no longer exists once a split activates
+            if op == "get":
+                k = (keys[0] if keys else None) \
+                    if isinstance(keys, (list, tuple)) else keys
+                if k is not None:
+                    shard = self.resolve_shard(segment, shard, bytes(k))
+            elif op == "multi_get" and keys:
+                targets = {self.resolve_shard(segment, shard, bytes(k))
+                           for k in keys}
+                if len(targets) == 1:
+                    shard = targets.pop()
+                else:
+                    return await self._split_multi_get(
+                        segment, shard, keys, policy, epoch, timeout)
+            elif op == "scan":
+                sp = seg.splits.get(shard)
+                if sp is not None:
+                    return await self._split_scan(
+                        segment, sp, start, count, policy, epoch,
+                        timeout)
         await fp.async_hit("router.read_pick")
         hosts = self.read_pick(segment, shard, policy)
         if not hosts:
@@ -379,7 +500,7 @@ class RpcRouter:
         }
         with Timer(tagged("router.read_ms", op=op, policy=policy.kind)):
             if (policy.kind == "follower_ok" and len(hosts) >= 2
-                    and self._hedging_on()):
+                    and op in _HEDGEABLE_OPS and self._hedging_on()):
                 return await self._hedged_read(
                     hosts, op, policy, args, timeout,
                     what=f"read {segment}:{shard}")
@@ -395,12 +516,21 @@ class RpcRouter:
         raw_batch: bytes,
         epoch: Optional[int] = None,
         timeout: float = 30.0,
+        key: Optional[bytes] = None,
     ):
         """Routed leader write over the replication plane's ``write``
         RPC (one encoded WriteBatch). NOT_LEADER / STALE_EPOCH (a
         fenced ex-leader still in the map) / SOURCE_NOT_FOUND /
         connection errors fall through to the next mapped leader
-        candidate — the stale shard-map cases during a handoff."""
+        candidate — the stale shard-map cases during a handoff.
+
+        ``key`` opts the write into split resolution: under an active
+        range split the hash slot's db is gone and the serving child
+        depends on the key, which the encoded batch doesn't expose to
+        the router. Callers writing to possibly-split segments pass the
+        batch's key (single-key batches — a multi-key batch spanning a
+        split boundary must be split by the CALLER)."""
+        shard = self.resolve_shard(segment, shard, key)
         hosts = self.get_hosts_for(segment, shard, Role.LEADER,
                                    Quantity.ALL)
         if not hosts:

@@ -86,4 +86,20 @@ REGISTRY = {
     "rpc.deadline.check": "server deadline check forces expired verdict",
     "admission.shed": "tenant admission forces a RETRY_LATER shed",
     "router.hedge.fire": "hedged-read backup launch failure",
+    # autonomous rebalancer + hot-shard range splits (round 20): the
+    # decide/plan/dispatch seams kill the policy loop between sensing
+    # and acting (the tick's work is re-derived from durable ledgers on
+    # the next tick); split.cutover kills the splitter AT the fenced
+    # flip — the recorded cutover phase resumes idempotently, and the
+    # chaos harness's split_cutover break-guard tooth lives on the same
+    # seam
+    "rebalance.decide": "rebalancer hot-spot decision failure",
+    # executor-side sibling of repl.read: a delay policy here holds a
+    # dispatch-executor slot while sleeping (no CPU), giving benches a
+    # deterministic per-read service cost — the hot-shift A/B's
+    # structural serving knee
+    "repl.read.serve": "engine-side read execution failure / stall",
+    "rebalance.plan": "rebalancer move/split planning failure",
+    "rebalance.dispatch": "rebalancer actuator dispatch failure",
+    "split.cutover": "shard-split fenced cutover phase failure",
 }

@@ -108,6 +108,65 @@ def test_ping_and_not_found(nodes, call):
     assert ei.value.code == "DB_NOT_FOUND"
 
 
+def test_set_tenant_quota_live_raise(nodes, call, monkeypatch):
+    """Runtime-mutable per-tenant quotas (round-19 residual closed): a
+    noisy tenant starved at the static env tier gets its quota RAISED
+    via the ``set_tenant_quota`` admin RPC and serves on the very next
+    call — no restart, no waiting out the starved bucket's refill
+    horizon — while its shed counters carry over unchanged. Zero/zero
+    clears the override back to the env default tier."""
+    from rocksplicator_tpu.rpc.admission import TenantAdmission
+    from rocksplicator_tpu.utils.stats import Stats, tagged
+
+    monkeypatch.setenv("RSTPU_TENANT_OPS", "2")
+    TenantAdmission.reset_for_test()
+    n = nodes("q")
+    ioloop = IoLoop.default()
+    pool = RpcClientPool()
+
+    def ping(tenant):
+        async def go():
+            return await pool.call("127.0.0.1", n.admin_port, "ping", {},
+                                   tenant=tenant, timeout=10)
+        try:
+            ioloop.run_sync(go())
+            return True
+        except RpcApplicationError as e:
+            assert e.code == "RETRY_LATER"
+            return False
+
+    def shed_count():
+        s = Stats.get()
+        s.flush()
+        return s.get_counter(tagged("rpc.tenant_shed", tenant="noisy",
+                                    reason="quota"))
+
+    try:
+        outcomes = [ping("noisy") for _ in range(8)]
+        assert not all(outcomes)  # the 2-op env tier starves it
+        sheds_before = shed_count()
+        assert sheds_before >= 1
+        # the RAISE, over the wire (the admin RPC itself is internal
+        # plane — untagged, never metered)
+        out = call(n, "set_tenant_quota", tenant="noisy",
+                   ops_per_sec=1000.0)
+        assert out == {"tenant": "noisy", "ops_per_sec": 1000.0,
+                       "bytes_per_sec": 0.0}
+        assert TenantAdmission.get().quota_for("noisy") == (1000.0, 0.0)
+        # effective immediately, and the raise rebuilt ONLY this
+        # tenant's buckets — other tenants stay on the env tier
+        assert all(ping("noisy") for _ in range(8))
+        assert TenantAdmission.get().quota_for("other") == (2.0, 0.0)
+        # per-tenant counters survived the rebuild: no resets, and no
+        # new sheds after the raise
+        assert shed_count() == sheds_before
+        # zero/zero clears the override back to the env default
+        call(n, "set_tenant_quota", tenant="noisy")
+        assert TenantAdmission.get().quota_for("noisy") == (2.0, 0.0)
+    finally:
+        ioloop.run_sync(pool.close())
+
+
 def test_add_db_write_read_seq(nodes, call):
     n = nodes("a")
     call(n, "add_db", db_name="seg00001", role="LEADER")

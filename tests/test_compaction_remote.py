@@ -99,9 +99,9 @@ def expected_view(db, n=300, prefix=b"k"):
 
 
 def make_tier(tmp_path, coord_make, db, db_name="db0", epoch=lambda: 1,
-              policy=None, start_worker=True):
+              policy=None, start_worker=True, store_uri=None):
     """Leader-side manager + (optionally) a live worker thread."""
-    store_uri = f"local://{tmp_path}/store"
+    store_uri = store_uri or f"local://{tmp_path}/store"
     policy = policy or RemoteDispatchPolicy(
         enabled=True, size_floor_bytes=0, deadline_s=30.0,
         claim_wait_s=5.0, heartbeat_timeout_s=5.0)
@@ -688,6 +688,70 @@ def test_compaction_remote_ab_artifact_shape(tmp_path):
     assert det["outcome"] == "installed"
     assert det["file_checksums_equal"]
     assert det["content_checksums_equal"]
+
+
+# ---------------------------------------------------------------------------
+# non-local store path (round-20 satellite: the round-18 tier had only
+# ever run over local://; the S3 stub exercises the SigV4 client's
+# retry/latency classification on the store get/put path end-to-end)
+# ---------------------------------------------------------------------------
+
+
+def test_remote_tier_end_to_end_over_s3_stub(coord_pair, tmp_path,
+                                             monkeypatch):
+    """The full offload exchange — leader uploads inputs, worker
+    fetches/merges/uploads, leader verifies + installs — against an
+    ``s3://`` store (SigV4 stub server), with transient request faults
+    armed so the unified retry policy's transient-vs-permanent
+    classification is exercised on the actual transfer path. The
+    installed view must be byte-identical and every output object must
+    live in the stub bucket."""
+    from rocksplicator_tpu.utils import objectstore
+    from rocksplicator_tpu.utils.s3_stub import S3StubServer
+    from rocksplicator_tpu.utils.stats import Stats
+
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "test-access")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "test-secret")
+    monkeypatch.setenv("RSTPU_RETRY_SEED", "9")
+    srv = S3StubServer(access_key="test-access", secret_key="test-secret")
+    endpoint = srv.start()
+    monkeypatch.setenv("RSTPU_S3_ENDPOINT", endpoint)
+
+    def drop_cached_stores():
+        # build_object_store caches by URI; the s3:// entry bakes in
+        # this test's endpoint and must not leak into other tests
+        with objectstore._store_cache_lock:
+            objectstore._store_cache.clear()
+
+    drop_cached_stores()
+    db = open_db(tmp_path / "db")
+    load_db(db)
+    want = expected_view(db)
+    mgr, _worker, stop = make_tier(tmp_path, coord_pair, db,
+                                   store_uri="s3://test-bucket")
+    fp.activate("s3.request", "fail_first:2")
+    try:
+        assert mgr.maybe_offload(FakePick()) == "installed"
+        assert expected_view(db) == want
+        # the transient faults were absorbed INSIDE the store client's
+        # retry loop — they never surfaced as a failed job
+        assert fp.trip_counts()["s3.request"] == 2
+        Stats.get().flush()
+        assert Stats.get().get_counter(
+            "retry.attempts op=s3.request") >= 2.0
+        # the exchange actually transited the stub bucket (the engine
+        # counted the offloaded bytes) and the job's objects were swept
+        # after the verified install — nothing leaks in the bucket
+        assert db.metrics_snapshot(max_age=0)[
+            "remote_offloaded_bytes_total"] > 0
+        assert "test-bucket" in srv.data
+        assert list(srv.data["test-bucket"]) == []
+    finally:
+        fp.deactivate("s3.request")
+        stop.set()
+        db.close()
+        drop_cached_stores()
+        srv.stop()
 
 
 # ---------------------------------------------------------------------------

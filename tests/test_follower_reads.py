@@ -568,6 +568,34 @@ def test_read_failpoint_seams(pair):
     assert bytes(r["values"][0]) == b"v0001"
 
 
+def test_read_serve_failpoint_occupies_executor_side(pair):
+    """The "repl.read.serve" seam runs INSIDE _do_read on the dispatch
+    executor thread (unlike the loop-side "repl.read" seam): a delay
+    policy there holds the executor slot for the stall — the hot-shift
+    bench's deterministic per-read service cost — and a fail policy
+    surfaces as a read error exactly like an engine-side fault."""
+    pair.write(3)
+    assert wait_until(pair.converged)
+    fp.activate("repl.read.serve", "delay_ms:80")
+    try:
+        t0 = time.monotonic()
+        r = pair.read(pair.leader.port, op="get", keys=[b"k0001"])
+        elapsed = time.monotonic() - t0
+        assert bytes(r["values"][0]) == b"v0001"  # stalls, never corrupts
+        assert elapsed >= 0.08
+        assert fp.trip_counts()["repl.read.serve"] == 1
+    finally:
+        fp.deactivate("repl.read.serve")
+    fp.activate("repl.read.serve", "fail_nth:1")
+    try:
+        with pytest.raises(RpcError):
+            pair.read(pair.leader.port, op="get", keys=[b"k0001"])
+    finally:
+        fp.deactivate("repl.read.serve")
+    r = pair.read(pair.leader.port, op="get", keys=[b"k0001"])
+    assert bytes(r["values"][0]) == b"v0001"
+
+
 # ---------------------------------------------------------------------------
 # workload generators: deterministic under a fixed seed
 # ---------------------------------------------------------------------------

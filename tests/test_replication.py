@@ -418,9 +418,12 @@ def test_max_number_box_stress():
 # ---------------------------------------------------------------------------
 
 
-def test_wal_gap_raises_source_read_error(hosts):
+def test_wal_gap_raises_typed_gap_error(hosts):
     """A follower asking for purged history must get an error (rebuild
-    signal), never a silent skip."""
+    signal), never a silent skip — and the signal must be the TYPED
+    WAL_GAP code the puller's rebuild detection keys on, not swallowed
+    into the generic SOURCE_READ_ERROR wrapper (a gap masked that way
+    left a behind-the-purge-horizon follower retrying forever)."""
     import os
     from rocksplicator_tpu.rpc.errors import RpcApplicationError
     leader = hosts("l")
@@ -440,7 +443,7 @@ def test_wal_gap_raises_source_read_error(hosts):
         return await lrdb.handle_replicate_request(seq_no=1, max_wait_ms=0)
     with pytest.raises(RpcApplicationError) as ei:
         asyncio.run_coroutine_threadsafe(ask(), leader.replicator.ioloop.loop).result(5)
-    assert ei.value.code == "SOURCE_READ_ERROR"
+    assert ei.value.code == "WAL_GAP"
 
 
 def test_apply_rejects_seq_discontinuity(hosts):

@@ -200,6 +200,120 @@ def test_ambient_deadline_and_tenant_restamp_downstream(armor_server):
     assert out["tenant"] == "tnt-a"
 
 
+class RelayHandler:
+    """One hop of a chained L -> F1 -> F2 read: forwards downstream on
+    the AMBIENT (re-anchored, queue-decremented) deadline — no explicit
+    budget plumbing anywhere in the chain."""
+
+    def __init__(self, next_port=0, next_method="budget", pre_sleep=0.0):
+        self.pool = None
+        self.next_port = next_port
+        self.next_method = next_method
+        self.pre_sleep = pre_sleep
+
+    async def handle_relay(self):
+        if self.pool is None:
+            self.pool = RpcClientPool()
+        dl = current_deadline()
+        mine = None if dl is None else dl.remaining_ms()
+        if self.pre_sleep:
+            # service time AFTER observing own budget, BEFORE the
+            # downstream hop observes its — the decrement is measured
+            await asyncio.sleep(self.pre_sleep)
+        down = await self.pool.call("127.0.0.1", self.next_port,
+                                    self.next_method)
+        chain = down.get("remaining_chain") or [down.get("remaining_ms")]
+        return {"remaining_chain": [mine] + chain}
+
+
+def _relay_chain(ioloop, mid_sleep=0.0, near_sleep=0.0):
+    """far (budget reporter) <- mid relay <- near relay; returns the
+    three servers plus their handlers for teardown."""
+    far_srv = RpcServer(port=0, ioloop=ioloop)
+    far_srv.add_handler(ArmorHandler())
+    far_srv.start()
+    mid_h = RelayHandler(next_port=far_srv.port, next_method="budget",
+                         pre_sleep=mid_sleep)
+    mid_srv = RpcServer(port=0, ioloop=ioloop)
+    mid_srv.add_handler(mid_h)
+    mid_srv.start()
+    near_h = RelayHandler(next_port=mid_srv.port, next_method="relay",
+                          pre_sleep=near_sleep)
+    near_srv = RpcServer(port=0, ioloop=ioloop)
+    near_srv.add_handler(near_h)
+    near_srv.start()
+    return near_srv, (far_srv, mid_srv, near_srv), (mid_h, near_h)
+
+
+def _teardown_chain(ioloop, servers, handlers):
+    for h in handlers:
+        if h.pool is not None:
+            ioloop.run_sync(h.pool.close(), timeout=10)
+    for srv in servers:
+        srv.stop()
+
+
+def test_deadline_depth2_budget_compounds():
+    """Round-19 residual closed at depth 2: across L -> F1 -> F2 each
+    hop re-anchors to a STRICTLY smaller budget (wire + queue + the
+    hop's own service time all decrement), so the far hop sees the
+    compounded remainder of the original client deadline — never a
+    fresh one."""
+    ioloop = IoLoop.default()
+    near_srv, servers, handlers = _relay_chain(
+        ioloop, mid_sleep=0.03, near_sleep=0.03)
+
+    async def go():
+        pool = RpcClientPool()
+        try:
+            return await pool.call("127.0.0.1", near_srv.port, "relay",
+                                   deadline_ms=1000.0)
+        finally:
+            await pool.close()
+
+    try:
+        out = ioloop.run_sync(go(), timeout=15)
+    finally:
+        _teardown_chain(ioloop, servers, handlers)
+    l_ms, f1_ms, f2_ms = out["remaining_chain"]
+    assert all(v is not None for v in (l_ms, f1_ms, f2_ms))
+    assert 0.0 < f2_ms < f1_ms < l_ms <= 1000.0
+    # each relay slept 30ms AFTER observing its own budget and BEFORE
+    # the downstream hop observed its: the decrement is measured time,
+    # not a fixed haircut
+    assert f1_ms <= l_ms - 25.0
+    assert f2_ms <= f1_ms - 25.0
+
+
+def test_deadline_depth2_far_hop_sheds_typed():
+    """The compounded budget expires mid-chain: the FAR hop sheds a
+    typed DEADLINE_EXCEEDED at admission (the relays never shed — their
+    own budgets were live when they forwarded), and the typed error —
+    not a transport timeout — propagates back through both relays to
+    the client."""
+    ioloop = IoLoop.default()
+    near_srv, servers, handlers = _relay_chain(ioloop, mid_sleep=0.12)
+
+    async def go():
+        pool = RpcClientPool()
+        try:
+            # mid sleeps past the whole 80ms budget, so F2's admission
+            # sees an already-spent deadline
+            with pytest.raises(RpcApplicationError) as ei:
+                await pool.call("127.0.0.1", near_srv.port, "relay",
+                                deadline_ms=80.0)
+            return ei.value
+        finally:
+            await pool.close()
+
+    try:
+        err = ioloop.run_sync(go(), timeout=15)
+    finally:
+        _teardown_chain(ioloop, servers, handlers)
+    assert err.code == DEADLINE_EXCEEDED
+    assert _counter(tagged("rpc.deadline_shed", method="budget")) == 1
+
+
 def test_killswitch_unarmed_stamps_and_checks_nothing(
         armor_server, monkeypatch):
     monkeypatch.setenv("RSTPU_TAIL_ARMOR", "0")
@@ -595,6 +709,110 @@ def test_hedging_killswitch_off_uses_plain_chain(monkeypatch):
         out = ioloop.run_sync(read(), timeout=20)
         assert out["who"] == "slow"
         assert _counter(tagged("router.hedges", op="get")) == 0
+    finally:
+        _teardown_router(ioloop, router, slow_srv, fast_srv)
+
+
+# ---------------------------------------------------------------------------
+# hedged multi_get (round-20 satellite: round-19 hedging covered the
+# bounded-staleness get chain only)
+# ---------------------------------------------------------------------------
+
+
+class MultiGetHandler:
+    """Replica whose ``read`` echoes a value derived from each key, so
+    a hedge/stitch bug shows up as a VALUE diff, not just a who-won
+    diff."""
+
+    delay_s = 0.0
+    who = "?"
+
+    async def handle_read(self, op="get", keys=None, **_kw):
+        try:
+            await asyncio.sleep(self.delay_s)
+        except asyncio.CancelledError:
+            self.saw_cancel = True
+            raise
+        self.answered = True
+        return {"who": self.who,
+                "values": [b"v:" + bytes(k) for k in (keys or [])]}
+
+
+def _two_replica_multiget_router(ioloop, slow_delay=0.25):
+    slow, fast = MultiGetHandler(), MultiGetHandler()
+    slow.who, fast.who = "slow", "fast"
+    slow.delay_s = slow_delay
+    slow_srv = RpcServer(port=0, ioloop=ioloop)
+    slow_srv.add_handler(slow)
+    slow_srv.start()
+    fast_srv = RpcServer(port=0, ioloop=ioloop)
+    fast_srv.add_handler(fast)
+    fast_srv.start()
+    shard_map = {
+        "seg": {
+            "num_shards": 1,
+            f"127.0.0.1:1:az1:{slow_srv.port}": ["00000:S"],
+            f"127.0.0.1:2:az1:{fast_srv.port}": ["00000:M"],
+        }
+    }
+    router = RpcRouter(local_az="az1")
+    router.update_layout(ClusterLayout.parse(json.dumps(shard_map).encode()))
+    router._read_seq = itertools.count()  # pin rotation: follower first
+
+    async def read(keys):
+        return await router.read(
+            "seg", 0, op="multi_get", keys=keys,
+            policy=ReadPolicy.follower_ok(max_lag=5), timeout=10.0)
+
+    return router, slow_srv, fast_srv, slow, fast, read
+
+
+def test_hedged_multi_get_wins_with_identical_values(monkeypatch):
+    """multi_get rides the same hedge machinery as get: p95-derived
+    delay, credit budget, cancel-the-loser — and the surfaced values
+    are byte-identical per key, in the caller's key order."""
+    monkeypatch.setenv("RSTPU_HEDGE_FLOOR_MS", "10")
+    ioloop = IoLoop.default()
+    router, slow_srv, fast_srv, slow, _fast, read = \
+        _two_replica_multiget_router(ioloop)
+    router._hedge_credit = 1.0
+
+    keys = [b"k2", b"k0", b"k1"]
+    try:
+        out = ioloop.run_sync(read(keys), timeout=20)
+        assert out["who"] == "fast"
+        assert [bytes(v) for v in out["values"]] == [b"v:" + k
+                                                     for k in keys]
+        assert _counter(tagged("router.hedges", op="multi_get")) == 1
+        assert _counter(tagged("router.hedge_wins", op="multi_get")) == 1
+        # the slow loser's wire cancel landed (best-effort, so poll)
+        deadline = Deadline.after_ms(3000.0)
+        while not getattr(slow, "saw_cancel", False) \
+                and not deadline.expired:
+            ioloop.run_sync(asyncio.sleep(0.02))
+        assert getattr(slow, "saw_cancel", False)
+    finally:
+        _teardown_router(ioloop, router, slow_srv, fast_srv)
+
+
+def test_multi_get_unhedged_identity_when_budget_denied(monkeypatch):
+    """No credit: the plain chain serves the slow follower's answer —
+    value identity is a property of the read, not of who wins."""
+    monkeypatch.setenv("RSTPU_HEDGE_FLOOR_MS", "5")
+    monkeypatch.setenv("RSTPU_HEDGE_PCT", "0.0")
+    ioloop = IoLoop.default()
+    router, slow_srv, fast_srv, _slow, _fast, read = \
+        _two_replica_multiget_router(ioloop, slow_delay=0.05)
+    router._hedge_credit = 0.0
+
+    keys = [b"a", b"b"]
+    try:
+        out = ioloop.run_sync(read(keys), timeout=20)
+        assert out["who"] == "slow"
+        assert [bytes(v) for v in out["values"]] == [b"v:a", b"v:b"]
+        assert _counter(tagged("router.hedges", op="multi_get")) == 0
+        assert _counter(tagged("router.hedge_budget_denied",
+                               op="multi_get")) == 1
     finally:
         _teardown_router(ioloop, router, slow_srv, fast_srv)
 

@@ -93,6 +93,31 @@ schedule the harness holds the **sixth standing invariant**:
    resumable to completion — a move that can do neither is the
    half-flipped-map state and a violation by itself.
 
+``--rebalance`` (round 20) runs the AUTONOMOUS REBALANCER: a 4-node /
+2-hash-shard cluster where the harness only drives SKEWED write load —
+it never names a source, target, or split key. The policy loop
+(``cluster/rebalancer.py``) must sense the sustained hot shard from
+real per-db rates (EWMA + hysteresis + consecutive-tick sustain),
+plan, and dispatch the live move — or, past the split threshold, the
+hot-shard RANGE SPLIT (``cluster/shard_split.py``: snapshot → hidden
+observer → catch-up → paused-drain fenced cutover renaming the parent
+into range children). Schedules blip every rebalancer seam
+(``rebalance.decide/plan/dispatch`` — the tick's work re-derives from
+durable ledgers on the next tick), kill a dispatched move mid-catch-up,
+and kill the splitter AT ``split.cutover``; both must finish via
+resume. After EVERY schedule the harness holds the **seventh standing
+invariant**:
+
+7. **policy-initiated placement** — every LEAF of the split forest
+   converges (one unfenced leader per child in the current states, the
+   published map — including its ``__splits__`` routing records — and
+   the data plane), zero acked-write loss where each acked key is
+   checked on the child OWNING its range (resolved through the split
+   records exactly as the router resolves it), the split-retired
+   parent lineage gone from every node, and bounded convergence. The
+   sharp probe runs WAITLESS once converged: an acked tail lost at a
+   split cutover can never heal and must be caught, not outwaited.
+
 - ``fencing`` (``--failover`` only) — the leader IGNORES epochs
   (``ReplicatedDB._reject_stale_epoch`` patched to a no-op): the
   stale-frame probes in the leader-crash schedule must catch it acking
@@ -102,6 +127,14 @@ schedule the harness holds the **sixth standing invariant**:
   target's data plane the moment catch-up is "close": the lineage
   probes must catch the two coexisting serving lineages / the acked
   tail missing on the new leader.
+- ``split_cutover`` (``--rebalance`` only) — the naive SPLIT cutover:
+  "the snapshot is good enough" — the hidden observer's WAL-tail pull
+  severed, catch-up skipped, and the paused drain-to-exact-equality
+  no-op'd before the rename. The REAL cutover refuses to flip a
+  non-drained child; the naive one renames a frozen snapshot into the
+  high child — keys at/above the split key acked after the snapshot
+  are absent from the child that owns them FOREVER: the per-child
+  acked-readability probe must catch the loss.
 
 Usage::
 
@@ -113,6 +146,9 @@ Usage::
         --expect-violation                                      # tooth
     python -m tools.chaos_soak --reshard --schedules 15 --seed 1
     python -m tools.chaos_soak --reshard --break-guard move_flip \
+        --expect-violation                                      # tooth
+    python -m tools.chaos_soak --rebalance --schedules 3 --seed 1
+    python -m tools.chaos_soak --rebalance --break-guard split_cutover \
         --expect-violation                                      # tooth
 """
 
@@ -767,9 +803,11 @@ class FailoverCluster:
     reference Helix topology in one process, chaos-sized. ``num_nodes``
     above the replica count leaves spare hosts for the reshard
     schedules' live shard moves (3 of 4 host the shard; moves relocate
-    replicas onto the free node)."""
+    replicas onto the free node). ``num_shards`` above 1 gives the
+    rebalance schedules a fleet MEAN to compare hot shards against."""
 
-    def __init__(self, root: str, num_nodes: int = 3):
+    def __init__(self, root: str, num_nodes: int = 3,
+                 num_shards: int = 1):
         import itertools as _it
 
         from rocksplicator_tpu.cluster.controller import Controller
@@ -785,7 +823,7 @@ class FailoverCluster:
         self.root = root
         self.cluster = "chaos"
         self.segment = "seg"
-        self.num_shards = 1
+        self.num_shards = num_shards
         self.partitions = [f"{self.segment}_{s}"
                            for s in range(self.num_shards)]
         self.db_names = [segment_to_db_name(self.segment, s)
@@ -1025,6 +1063,36 @@ def _break_guard(kind: str):
         ShardMove._phase_cutover = broken_cutover
         return lambda: setattr(
             ShardMove, "_phase_cutover", orig_cutover)
+    if kind == "split_cutover":
+        # the naive split cutover: "the snapshot is good enough" — the
+        # hidden observer's WAL-tail pull severed (self-upstream), the
+        # catch-up wait skipped, the paused drain-to-exact-equality
+        # no-op'd. The REAL cutover refuses to flip a non-drained child
+        # (the drain polls lag==0 under the pause and times out); the
+        # naive one renames a frozen snapshot into the high child, so
+        # every key >= split_key acked after the snapshot seq is absent
+        # from the child that now OWNS it — the rebalance harness's
+        # per-child acked-readability probe must catch the loss.
+        from rocksplicator_tpu.cluster.shard_split import ShardSplit
+
+        orig_catchup = ShardSplit._phase_catchup
+        orig_drain = ShardSplit._cutover_drain
+
+        def naive_catchup(self):
+            target = self._instances().get(self.rec.target_instance)
+            if target is not None:
+                self.admin.change_db_role_and_upstream(
+                    self._admin_addr(target), self.parent_db, "OBSERVER",
+                    upstream=(target.host, target.repl_port))
+
+        ShardSplit._phase_catchup = naive_catchup
+        ShardSplit._cutover_drain = lambda self, leader: None
+
+        def undo():
+            ShardSplit._phase_catchup = orig_catchup
+            ShardSplit._cutover_drain = orig_drain
+
+        return undo
     if kind == "remote_install":
         # a leader that installs a remote compaction result WITHOUT the
         # epoch gate: a deposed leader's in-flight job comes back and
@@ -2438,6 +2506,611 @@ def run_reshard_chaos(
 
 
 # ---------------------------------------------------------------------------
+# rebalance schedules (round 20): the POLICY decides — the harness only
+# drives skewed load and checks the aftermath
+# ---------------------------------------------------------------------------
+
+# 2 policy-initiated moves + 1 policy-initiated split per 3-schedule
+# smoke: the harness never names a source/target/split key — it offers
+# a hot shard and the rebalancer's sense→decide→plan→dispatch loop does
+# the rest (the faulted variant blips every rebalancer seam —
+# "rebalance.decide" / "rebalance.plan" / "rebalance.dispatch" — plus
+# the dispatched move's catch-up, and the split schedule kills the
+# splitter AT "split.cutover"; both must recover via resume)
+_REBALANCE_KINDS = [
+    "rebalance_move_hot", "rebalance_split_hot", "rebalance_move_faulted",
+]
+
+
+def _rebalance_flags(split: bool):
+    """Chaos-sized policy knobs: fast EWMA, 2-tick sustain, thresholds
+    scaled to a 2-shard fleet (with N=2 the reference hot_factor=2.0 is
+    unreachable — hot > 2x mean needs hot > hot + cold)."""
+    from rocksplicator_tpu.cluster.rebalancer import RebalancerFlags
+
+    return RebalancerFlags(
+        interval=0.0, ewma_alpha=0.7, hot_factor=1.2, cool_factor=1.05,
+        sustain=2, max_concurrent=1,
+        split_factor=(1.5 if split else 100.0), min_rate=2.0)
+
+
+class _SeqRateLoad:
+    """db_name -> write rate measured from the data plane's OWN sequence
+    numbers (per-db max across nodes, delta over wall time). The
+    rebalancer's production load_fn scrapes /cluster_stats; the chaos
+    cluster runs no status servers, so the harness feeds the policy the
+    same signal from the source those rates are derived from — real
+    load, never a synthesized number."""
+
+    def __init__(self, cluster: FailoverCluster):
+        self.cluster = cluster
+        self._prev: Dict[str, Tuple[int, float]] = {}
+
+    def __call__(self) -> Optional[Dict[str, float]]:
+        from rocksplicator_tpu.utils.segment_utils import \
+            partition_name_to_db_name
+
+        now = time.monotonic()
+        dbs = set()
+        for n in self.cluster.nodes:
+            for partition, st in list(
+                    n.participant.current_states.items()):
+                if st in ("LEADER", "MASTER", "FOLLOWER", "SLAVE"):
+                    dbs.add(partition_name_to_db_name(partition))
+        rates: Dict[str, float] = {}
+        for db in dbs:
+            seqs = [s for s in self.cluster.seqs(db) if s is not None]
+            if not seqs:
+                continue
+            seq = max(seqs)
+            prev = self._prev.get(db)
+            self._prev[db] = (seq, now)
+            if prev is None:
+                continue  # first sighting (fresh split child): no rate
+            rates[db] = max(0.0, (seq - prev[0]) / max(1e-3,
+                                                       now - prev[1]))
+        for db in list(self._prev):
+            if db not in dbs:
+                del self._prev[db]  # renamed away mid-split / retired
+        return rates or None
+
+
+class _ShardWriter:
+    """Write load aimed at ONE partition's current leader — the hot (or
+    cold) side of the skew the policy observes. Acked (key, val) pairs
+    land in a per-hash-shard ledger; after a split the checker resolves
+    each key to its OWNING child by range, so the acked-readability
+    probe follows the keys across the cutover."""
+
+    def __init__(self, cluster: FailoverCluster, shard: int, tag: str,
+                 interval: float, acked_by_shard: Dict[int, List],
+                 prefix: bytes = b"k"):
+        from rocksplicator_tpu.utils.segment_utils import (
+            db_name_to_partition_name, segment_to_db_name)
+
+        self.cluster = cluster
+        self.shard = shard
+        self.db = segment_to_db_name(cluster.segment, shard)
+        self.partition = db_name_to_partition_name(self.db)
+        self.tag = tag
+        self.interval = interval
+        self.prefix = prefix
+        self.errors = 0
+        self._ledger = acked_by_shard.setdefault(shard, [])
+        self._waiters: List = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"chaos-rebalance-writer-{shard}",
+            daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        i = 0
+        while not self._stop.wait(self.interval):
+            i += 1
+            key = self.prefix + (b"%s-%05d" % (self.tag.encode(), i))
+            node = self.cluster.leader_node(self.partition)
+            app = (node.handler.db_manager.get_db(self.db)
+                   if node is not None else None)
+            if app is None:
+                self.errors += 1  # paused / renamed mid-split: expected
+                continue
+            try:
+                w = app.write_async(WriteBatch().put(key, key))
+            except Exception:
+                self.errors += 1
+                continue
+            with self._lock:
+                self._waiters.append((key, w))
+
+    def stop_collect(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        with self._lock:
+            waiters, self._waiters = self._waiters, []
+        for key, w in waiters:
+            try:
+                w.future.result(3.0)
+            except Exception:
+                continue
+            if w.acked:
+                self._ledger.append((key, key))
+
+
+def _split_leaves(cluster: FailoverCluster) -> List[int]:
+    """The serving frontier: every hash slot chased through ACTIVE
+    split records to its leaf children (the controller's
+    effective_shards over the live ledger)."""
+    from rocksplicator_tpu.cluster.shard_split import list_splits
+
+    by_parent = {
+        r.parent_shard: r
+        for r in list_splits(cluster.client, cluster.cluster)
+        if r.segment == cluster.segment and r.phase == "active"}
+    leaves: List[int] = []
+
+    def chase(s: int) -> None:
+        r = by_parent.get(s)
+        if r is None:
+            leaves.append(s)
+        else:
+            chase(r.low_shard)
+            chase(r.high_shard)
+
+    for s in range(cluster.num_shards):
+        chase(s)
+    return leaves
+
+
+def _owning_leaf(cluster: FailoverCluster, shard: int, key: bytes) -> int:
+    """Which leaf serves ``key`` under hash slot ``shard`` — the same
+    transitive range chase the router runs."""
+    from rocksplicator_tpu.cluster.shard_split import list_splits
+
+    by_parent = {
+        r.parent_shard: r
+        for r in list_splits(cluster.client, cluster.cluster)
+        if r.segment == cluster.segment and r.phase == "active"}
+    while shard in by_parent:
+        r = by_parent[shard]
+        shard = (r.low_shard if key < r.split_key_bytes
+                 else r.high_shard)
+    return shard
+
+
+def _tick_rebalancer(reb, timings: Dict, tag: str,
+                     violations: List[str], want_kind: str,
+                     timeout: float = 30.0) -> List[dict]:
+    """Drive sense→decide→plan→dispatch ticks until a plan of
+    ``want_kind`` dispatches. Armed rebalancer seams raise out of a
+    tick; the next tick re-derives everything from the durable ledgers
+    (exactly what run_forever's catch-all rides on)."""
+    dispatched: List[dict] = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            dispatched += reb.once()
+        except Exception:
+            timings["tick_errors"] += 1
+        if any(p["kind"] == want_kind for p in dispatched):
+            return dispatched
+        time.sleep(0.3)
+    violations.append(
+        f"{tag}: rebalancer never dispatched a {want_kind} "
+        f"(policy {reb.policy.snapshot()}, dispatched {dispatched})")
+    return dispatched
+
+
+def _join_rebalance_workers(reb, tag: str, violations: List[str],
+                            timeout: float = 90.0) -> None:
+    deadline = time.monotonic() + timeout
+    for t in list(reb._workers):
+        t.join(max(0.1, deadline - time.monotonic()))
+        if t.is_alive():
+            violations.append(f"{tag}: actuator {t.name} wedged "
+                              f"(no exit in {timeout}s)")
+
+
+def _rebalance_schedule(kind: str):
+    def run(cluster: FailoverCluster, rng: random.Random,
+            acked_by_shard: Dict[int, List], violations: List[str],
+            tag: str, timings: Dict) -> None:
+        from rocksplicator_tpu.cluster.model import cluster_path
+        from rocksplicator_tpu.cluster.rebalancer import Rebalancer
+        from rocksplicator_tpu.cluster.shard_move import MoveRecord, \
+            ShardMove
+        from rocksplicator_tpu.cluster.shard_split import ShardSplit
+        from rocksplicator_tpu.utils.segment_utils import (
+            db_name_to_partition_name, segment_to_db_name)
+
+        want_split = kind == "rebalance_split_hot"
+        leaves = _split_leaves(cluster)
+        hot, cold = leaves[0], leaves[1:]
+        hot_db = segment_to_db_name(cluster.segment, hot)
+        hot_partition = db_name_to_partition_name(hot_db)
+        if want_split:
+            # enough keys on BOTH sides of the eventual median that
+            # choose_split_key has a real keyspace to bisect and both
+            # children inherit acked history to be checked against
+            pre = acked_by_shard.setdefault(hot, [])
+            node = cluster.leader_node(hot_partition)
+            app = (node.handler.db_manager.get_db(hot_db)
+                   if node is not None else None)
+            if app is None:
+                violations.append(f"{tag}: no leader to preload")
+                return
+            waiters = []
+            for i in range(120):
+                for prefix in (b"a", b"z"):
+                    key = prefix + (b"%05d" % i)
+                    waiters.append((key, app.write_async(
+                        WriteBatch().put(key, key))))
+            for key, w in waiters:
+                try:
+                    w.future.result(5.0)
+                except Exception:
+                    continue
+                if w.acked:
+                    pre.append((key, key))
+        reb = Rebalancer(
+            cluster.client, cluster.cluster, cluster.store_uri,
+            flags=_rebalance_flags(split=want_split),
+            move_flags=_move_flags(), admin=cluster.admin,
+            load_fn=_SeqRateLoad(cluster))
+        # the durable pause flag gates the tick before any sensing
+        Rebalancer.set_paused(cluster.client, cluster.cluster, True)
+        if not reb.paused:
+            violations.append(f"{tag}: durable pause flag not visible")
+        Rebalancer.set_paused(cluster.client, cluster.cluster, False)
+        if kind == "rebalance_move_faulted":
+            # every rebalancer seam blipped once + the dispatched
+            # move killed mid-catch-up: the loop must ride the seam
+            # faults and the harness must RESUME the crashed move
+            fp.activate("rebalance.decide", "fail_nth:2")
+            fp.activate("rebalance.plan", "fail_nth:1")
+            fp.activate("rebalance.dispatch", "fail_nth:1")
+            fp.activate("move.catchup", "fail_nth:1")
+        elif want_split and not timings.get("fast_fail"):
+            # kill the splitter AT the fenced flip: the durable record
+            # holds phase=cutover; resume finishes it idempotently
+            fp.activate("split.cutover", "fail_nth:1")
+        # the skew: one shard driven hard, the rest trickling — fleet
+        # mean stays low, the hot EWMA must clear the enter band for
+        # `sustain` consecutive ticks before the policy may act
+        writers = [_ShardWriter(cluster, hot, tag, interval=0.004,
+                                acked_by_shard=acked_by_shard,
+                                prefix=b"zz")]
+        writers += [_ShardWriter(cluster, s, tag, interval=0.25,
+                                 acked_by_shard=acked_by_shard)
+                    for s in cold[:2]]
+        t0 = time.monotonic()
+        try:
+            plans = _tick_rebalancer(
+                reb, timings, tag, violations,
+                want_kind="split" if want_split else "move")
+            _join_rebalance_workers(reb, tag, violations)
+            for p in plans:
+                timings["dispatched"][p["kind"]] = \
+                    timings["dispatched"].get(p["kind"], 0) + 1
+            fp.clear()
+            # a crashed actuator left its durable record mid-phase:
+            # finish the job the way an operator (or the next tick's
+            # budget accounting + resume tooling) would
+            if kind == "rebalance_move_faulted":
+                raw = cluster.client.get_or_none(cluster_path(
+                    cluster.cluster, "moves", hot_partition))
+                if raw is not None:
+                    rec = MoveRecord.decode(raw)
+                    try:
+                        ShardMove.resume(
+                            cluster.client, cluster.cluster,
+                            hot_partition, admin=cluster.admin,
+                            flags=_move_flags()).run()
+                        timings["resumes"] += 1
+                    except Exception as e:
+                        violations.append(
+                            f"{tag}: RESUME FAILED from phase "
+                            f"{rec.phase}: {e!r}")
+            elif want_split:
+                from rocksplicator_tpu.cluster.model import SplitRecord
+
+                raw = cluster.client.get_or_none(cluster_path(
+                    cluster.cluster, "splits", hot_partition))
+                rec = SplitRecord.decode(raw) if raw is not None else None
+                if rec is not None and rec.phase != "active":
+                    try:
+                        ShardSplit.resume(
+                            cluster.client, cluster.cluster,
+                            hot_partition, admin=cluster.admin,
+                            flags=_move_flags()).run()
+                        timings["resumes"] += 1
+                    except Exception as e:
+                        violations.append(
+                            f"{tag}: SPLIT RESUME FAILED from phase "
+                            f"{rec.phase}: {e!r}")
+                elif rec is None:
+                    violations.append(
+                        f"{tag}: split dispatched but no record left")
+        finally:
+            fp.clear()
+            for w in writers:
+                w.stop_collect()
+                timings["write_errors"] += w.errors
+            reb.stop(timeout=5.0)
+        timings["schedule_ms"].append(
+            round((time.monotonic() - t0) * 1000.0, 1))
+
+    return run
+
+
+def _check_rebalance_invariants(cluster: FailoverCluster,
+                                acked_by_shard: Dict[int, List],
+                                tag: str, violations: List[str],
+                                timeout: float = 45.0) -> int:
+    """The SEVENTH standing invariant, after EVERY rebalance schedule:
+    every leaf partition of the split forest converges (one leader +
+    full replica set at equal seqs; the shard map and the data plane
+    agree on exactly one unfenced leader each), NO node holds a db
+    outside the leaf set (the split-retired parent must be gone
+    everywhere), active splits are published in the map's __splits__
+    section, every acked write is readable on every current host of
+    the child that OWNS its key range, and the heal stays inside the
+    controller-pass bound."""
+    from rocksplicator_tpu.cluster.shard_split import list_splits
+    from rocksplicator_tpu.utils.segment_utils import (
+        db_name_to_partition_name, segment_to_db_name)
+
+    passes0 = cluster.controller.passes
+    detail: Dict = {}
+
+    def leaf_view():
+        leaves = _split_leaves(cluster)
+        return leaves, {
+            s: (segment_to_db_name(cluster.segment, s),
+                db_name_to_partition_name(
+                    segment_to_db_name(cluster.segment, s)))
+            for s in leaves}
+
+    def healthy():
+        from rocksplicator_tpu.storage.errors import StorageError
+
+        leaves, names = leaf_view()
+        expected_dbs = {db for db, _p in names.values()}
+        splits = list_splits(cluster.client, cluster.cluster)
+        try:
+            for s in leaves:
+                db, partition = names[s]
+                hosts = [n for n in cluster.nodes
+                         if n.state_of(partition)]
+                states = sorted(n.state_of(partition) for n in hosts)
+                if states != ["FOLLOWER", "FOLLOWER", "LEADER"]:
+                    detail[partition] = cluster.states(partition)
+                    return False
+                seqs = []
+                for n in hosts:
+                    app = n.handler.db_manager.get_db(db)
+                    if app is None:
+                        detail[partition] = (n.name, "db closed")
+                        return False
+                    seqs.append(app.db.latest_sequence_number_relaxed())
+                if len(set(seqs)) != 1:
+                    detail[partition] = ("seqs", seqs)
+                    return False
+                host_names = {n.name for n in hosts}
+                for n in cluster.nodes:
+                    if n.name not in host_names and \
+                            n.handler.db_manager.get_db(db) is not None:
+                        detail[partition] = ("garbage", n.name)
+                        return False
+                dp_leaders = [
+                    n.name for n in cluster.nodes
+                    if (lambda rdb: rdb is not None
+                        and rdb.role is ReplicaRole.LEADER
+                        and not rdb.fenced
+                        and not rdb.removed)(n.rdb(db))]
+                if len(dp_leaders) != 1:
+                    detail[partition] = ("lineages", dp_leaders)
+                    return False
+            # the split-retired parent is gone EVERYWHERE: its lineage
+            # was closed to writers by the leader rename at cutover and
+            # every replica was renamed into a child — a parent-named
+            # db still open anywhere is a stranded pre-split lineage a
+            # router retry could read stale data from
+            for r in splits:
+                if r.phase != "active":
+                    continue
+                parent_db = segment_to_db_name(cluster.segment,
+                                               r.parent_shard)
+                if parent_db in expected_dbs:
+                    continue  # re-split child reusing a leaf id
+                for n in cluster.nodes:
+                    if n.handler.db_manager.get_db(parent_db) \
+                            is not None:
+                        detail["parent"] = (parent_db, n.name)
+                        return False
+        except StorageError as e:
+            detail["transition"] = repr(e)
+            return False
+        if not cluster.maps:
+            detail["map"] = "never published"
+            return False
+        seg = cluster.maps[-1].get(cluster.segment) or {}
+        active = [r for r in splits if r.phase == "active"]
+        published = seg.get("__splits__") or {}
+        for r in active:
+            if str(r.parent_shard) not in published:
+                detail["map"] = f"split of {r.parent_shard} unpublished"
+                return False
+        for s in leaves:
+            mark = f"{s:05d}:M"
+            n_leaders = sum(
+                1 for host, entries in seg.items()
+                if host not in ("num_shards", "__splits__")
+                for e in entries if e == mark)
+            if n_leaders != 1:
+                detail["map"] = f"shard {s}: {n_leaders} leaders in map"
+                return False
+        return True
+
+    def stable_healthy():
+        if not healthy():
+            return False
+        time.sleep(0.35)
+        return healthy()
+
+    ok = cluster.wait(stable_healthy, timeout)
+    passes = cluster.controller.passes - passes0
+    if not ok:
+        violations.append(
+            f"{tag}: NO HEAL within {timeout}s / {passes} controller "
+            f"passes — {detail}")
+        # fall through to the acked probe anyway: when the cluster is
+        # wedged BECAUSE data went missing (the split_cutover tooth),
+        # the loss itself is the diagnosis, not the non-convergence
+    elif passes > RESHARD_PASS_BOUND:
+        violations.append(
+            f"{tag}: healed but took {passes} controller passes "
+            f"(bound {RESHARD_PASS_BOUND})")
+    # the sharp probe, strict and waitless once converged: every acked
+    # key readable on every current host of the child OWNING its range
+    # — what the split_cutover tooth (rename without pause/drain) loses
+    _leaves, names = leaf_view()
+    for shard, ledger in sorted(acked_by_shard.items()):
+        for key, val in ledger:
+            leaf = _owning_leaf(cluster, shard, key)
+            db, partition = names.get(leaf, (None, None))
+            if db is None:
+                violations.append(
+                    f"{tag}: acked key {key!r} resolves to unserved "
+                    f"leaf {leaf}")
+                return passes
+            for n in cluster.nodes:
+                if not n.state_of(partition):
+                    continue
+                if not ok and n.state_of(partition) not in _LEADERLIKE:
+                    # unconverged cluster: a follower mid-rebuild is
+                    # legitimately incomplete — only the child LEADER's
+                    # copy is the can-never-heal truth
+                    continue
+                app = n.handler.db_manager.get_db(db)
+                if app is None or app.db.get(key) != val:
+                    violations.append(
+                        f"{tag}: ACKED WRITE {key!r} MISSING ON CHILD "
+                        f"{db} host {n.name} (lost across the split "
+                        f"cutover)")
+                    return passes
+    return passes
+
+
+def _rebalance_deck(rng: random.Random, schedules: int,
+                    break_guard: Optional[str]) -> List[str]:
+    """move, split, faulted-move in order (the smoke's 2 moves + 1
+    split); the split_cutover tooth leads with the split it breaks."""
+    if break_guard == "split_cutover":
+        deck = ["rebalance_split_hot"]
+    else:
+        deck = []
+    core = list(_REBALANCE_KINDS)
+    while len(deck) < schedules:
+        deck.extend(core)
+    return deck[:schedules]
+
+
+def run_rebalance_chaos(
+    root: str,
+    schedules: int = 3,
+    seed: int = 1,
+    break_guard: Optional[str] = None,
+    heal_timeout: float = 45.0,
+    log=print,
+) -> Dict:
+    """Autonomous-rebalancer schedules: the harness drives SKEWED load
+    at a 4-node / 2-hash-shard cluster and the policy loop must sense
+    the sustained hot spot, plan, and dispatch the move — or, past the
+    split threshold, the range split — on its own. Seam faults
+    ("rebalance.decide/plan/dispatch", "split.cutover",
+    move.catchup) kill the loop and its actuators mid-flight; durable
+    ledgers + resume must finish every job. After every schedule the
+    SEVENTH standing invariant is checked."""
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("RSTPU_RETRY_SEED", "RSTPU_PULL_RETRY_SEED")
+    }
+    os.environ["RSTPU_RETRY_SEED"] = str(seed)
+    os.environ["RSTPU_PULL_RETRY_SEED"] = str(seed)
+    undo = _break_guard(break_guard) if break_guard else None
+    violations: List[str] = []
+    acked_by_shard: Dict[int, List[Tuple[bytes, bytes]]] = {}
+    timings: Dict = {"schedule_ms": [], "dispatched": {}, "resumes": 0,
+                     "tick_errors": 0, "write_errors": 0,
+                     "passes_used": [],
+                     "fast_fail": bool(break_guard)}
+    gauge_snapshots: List[Dict] = []
+    fp.clear()
+    t_setup = time.monotonic()
+    cluster = FailoverCluster(root, num_nodes=4, num_shards=2)
+    deck: List[str] = []
+    try:
+        cluster.wait_initial_convergence()
+        setup_sec = round(time.monotonic() - t_setup, 1)
+        deck = _rebalance_deck(random.Random(seed), schedules,
+                               break_guard)
+        log(f"  cluster up in {setup_sec}s (4 nodes / 2 hash shards); "
+            f"deck: {deck}")
+        for si, kind in enumerate(deck):
+            rng = random.Random(seed * 1_000_003 + si)
+            tag = f"s{si:02d}-{kind}/seed {seed}"
+            try:
+                _rebalance_schedule(kind)(
+                    cluster, rng, acked_by_shard, violations, tag,
+                    timings)
+            finally:
+                fp.clear()
+            if violations and break_guard:
+                break
+            timings["passes_used"].append(
+                _check_rebalance_invariants(
+                    cluster, acked_by_shard, tag, violations,
+                    timeout=heal_timeout))
+            gauge_snapshots.append(_gauge_snapshot(tag))
+            acked = sum(len(v) for v in acked_by_shard.values())
+            log(f"  [{si + 1}/{len(deck)}] {kind}: acked={acked} "
+                f"dispatched={timings['dispatched']} "
+                f"resumes={timings['resumes']} "
+                f"violations={len(violations)}")
+            if violations and break_guard:
+                break
+    finally:
+        fp.clear()
+        if undo:
+            undo()
+        cluster.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "mode": "rebalance",
+        "schedules": len(deck),
+        "deck": deck,
+        "seed": seed,
+        "acked": sum(len(v) for v in acked_by_shard.values()),
+        "write_errors": timings["write_errors"],
+        "violations": violations,
+        "dispatched": timings["dispatched"],
+        "resumes": timings["resumes"],
+        "tick_errors": timings["tick_errors"],
+        "schedule_ms": timings["schedule_ms"],
+        "passes_used": timings["passes_used"],
+        "gauge_snapshots": gauge_snapshots,
+        "failpoint_trips": fp.trip_counts(),
+        "break_guard": break_guard,
+    }
+
+
+# ---------------------------------------------------------------------------
 # the run loop
 # ---------------------------------------------------------------------------
 
@@ -2603,13 +3276,23 @@ def main(argv=None) -> int:
                          "the SIXTH standing invariant (exactly one "
                          "serving lineage, zero acked-write loss across "
                          "the move, bounded convergence)")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="autonomous-rebalancer schedules (4 nodes / 2 "
+                         "hash shards): skewed load only — the policy "
+                         "loop itself must sense the sustained hot "
+                         "shard and dispatch the move (or, past the "
+                         "split threshold, the RANGE SPLIT), riding "
+                         "decide/plan/dispatch seam faults and a "
+                         "splitter killed AT the fenced cutover — "
+                         "holding the SEVENTH standing invariant")
     ap.add_argument("--transport", choices=["tcp", "uds", "loopback"],
                     help="run the cluster's RPC plane on this byte layer "
                          "(RSTPU_TRANSPORT for the run; default: ambient "
                          "policy, i.e. tcp; data-plane mode only)")
     ap.add_argument("--break-guard",
                     choices=["wal_hole", "meta_first", "fencing",
-                             "move_flip", "remote_install"])
+                             "move_flip", "remote_install",
+                             "split_cutover"])
     ap.add_argument("--expect-violation", action="store_true",
                     help="exit 0 iff a violation WAS caught")
     ap.add_argument("--conv-timeout", type=float, default=30.0)
@@ -2619,6 +3302,8 @@ def main(argv=None) -> int:
         ap.error("--break-guard fencing requires --failover")
     if args.break_guard == "move_flip" and not args.reshard:
         ap.error("--break-guard move_flip requires --reshard")
+    if args.break_guard == "split_cutover" and not args.rebalance:
+        ap.error("--break-guard split_cutover requires --rebalance")
     if args.break_guard == "remote_install":
         if args.failover or args.reshard:
             ap.error("--break-guard remote_install is data-plane only "
@@ -2626,13 +3311,19 @@ def main(argv=None) -> int:
         if not args.remote_every:
             ap.error("--break-guard remote_install requires "
                      "--remote-every > 0")
-    if args.failover and args.reshard:
-        ap.error("--failover and --reshard are mutually exclusive")
+    if sum(map(bool, (args.failover, args.reshard, args.rebalance))) > 1:
+        ap.error("--failover / --reshard / --rebalance are mutually "
+                 "exclusive")
 
     root = tempfile.mkdtemp(prefix="rstpu-chaos-")
     t0 = time.monotonic()
     try:
-        if args.reshard:
+        if args.rebalance:
+            result = run_rebalance_chaos(
+                root, schedules=args.schedules, seed=args.seed,
+                break_guard=args.break_guard,
+            )
+        elif args.reshard:
             result = run_reshard_chaos(
                 root, schedules=args.schedules, seed=args.seed,
                 break_guard=args.break_guard,
@@ -2654,7 +3345,16 @@ def main(argv=None) -> int:
     finally:
         shutil.rmtree(root, ignore_errors=True)
     result["elapsed_sec"] = round(time.monotonic() - t0, 1)
-    if args.reshard:
+    if args.rebalance:
+        print(f"chaos[rebalance]: {result['schedules']} schedules, "
+              f"{result['acked']} acked writes through policy-driven "
+              f"placement ({result['write_errors']} refused), "
+              f"{result['elapsed_sec']}s")
+        print(f"chaos[rebalance]: dispatched {result['dispatched']}, "
+              f"{result['resumes']} resumed after kills, "
+              f"{result['tick_errors']} seam-faulted ticks, controller "
+              f"passes {result['passes_used']}")
+    elif args.reshard:
         print(f"chaos[reshard]: {result['schedules']} schedules, "
               f"{result['acked']} acked writes through live moves "
               f"({result['write_errors']} refused), "
@@ -2698,13 +3398,18 @@ def main(argv=None) -> int:
               f"--schedules {args.schedules} --seed {args.seed}"
               + (" --failover" if args.failover else "")
               + (" --reshard" if args.reshard else "")
+              + (" --rebalance" if args.rebalance else "")
               + (f" --transport {args.transport}"
                  if args.transport else "")
               + (f" --break-guard {args.break_guard}"
                  if args.break_guard else ""))
         return 0 if args.expect_violation else 1
     print("chaos: all invariants held"
-          + ((" (exactly one serving lineage per shard, zero acked "
+          + ((" (policy-initiated placement: one unfenced leader per "
+              "CHILD, zero acked loss resolved per owning range, "
+              "parent retired everywhere, bounded convergence)"
+              if args.rebalance else
+              " (exactly one serving lineage per shard, zero acked "
               "loss across the move, bounded convergence, no stranded "
               "replicas)" if args.reshard else
               " (exactly-one-leader, zero acked loss across handoff, "
