@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab macro-bench-hot-shift metrics-smoke compaction-bench compaction-bench-smoke compaction-remote-bench compaction-remote-smoke stream-merge-bench stream-merge-smoke overload-bench overload-smoke chaos-smoke chaos-failover-smoke reshard-smoke rebalance-smoke clean
+.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab macro-bench-hot-shift macro-bench-cdc metrics-smoke compaction-bench compaction-bench-smoke compaction-remote-bench compaction-remote-smoke stream-merge-bench stream-merge-smoke overload-bench overload-smoke chaos-smoke chaos-failover-smoke reshard-smoke rebalance-smoke cdc-smoke clean
 
 # rstpu-check: the three-pass static suite (lock-order/blocking-under-
 # lock, event-loop blocking, failpoint/span/stats registries) over
@@ -252,6 +252,23 @@ rebalance-smoke:
 		--schedules 1 --seed 7 \
 		--break-guard split_cutover --expect-violation
 
+# round-21 CDC streaming-ingest acceptance (~2 min): the 3-process
+# macro-bench cluster (churn engine profile so memtable/L0 pressure is
+# real) serving a mixed workload while an in-process kafka broker
+# feeds every shard's leader-side IngestionWatcher; a baseline serve
+# phase then the SAME serve phase with an open-loop CDC producer
+# bursting records at the broker. The artifact gates: applied records
+# == produced records with zero dedup-skips after drain (exactly-once
+# under load), backpressure demonstrably engaging (kafka.cdc.
+# paced_sleeps > 0 — gauge-driven fetch pacing, not memtable
+# stacking), and produce→readable freshness p50/p99 measured by
+# marker probes against a FOLLOWER (the full produce → broker →
+# consume → write_many → replicate path).
+macro-bench-cdc:
+	$(PY) bench.py --macro_bench --cdc --shards 4 --preload_keys 2000 \
+		--value_bytes 128 \
+		--out benchmarks/results/macro_bench_cdc_r21.json
+
 # round-14 metrics-plane smoke (<10s): boots one replica in-process,
 # scrapes /metrics + /cluster_stats, validates Prometheus text-format
 # parseability, the presence of every registered gauge family (engine
@@ -326,6 +343,26 @@ reshard-smoke:
 	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --reshard \
 		--schedules 1 --seed 7 \
 		--break-guard move_flip --expect-violation
+
+# round-21 CDC streaming-ingest chaos smoke (~1 min + ~20s tooth):
+# seeded cdc_burst schedules — the exactly-once consumer killed and
+# restarted at each of the kafka.fetch / kafka.apply / kafka.checkpoint
+# seams mid-batch, a multi-kill burst, and a leader failover
+# mid-consume — each holding the EIGHTH standing invariant: applied
+# records == produced prefix, exactly once, per partition, on every
+# replica of the serving lineage (the WAL-riding watermark is the only
+# resume authority). Then the cdc_dedup tooth: a consumer patched to
+# commit its checkpoint in a SEPARATE batch after the records
+# (at-least-once, the naive design) must be CAUGHT re-applying
+# records after a crash between the two (--expect-violation). A
+# violation prints the reproducing --seed.
+cdc-smoke:
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --cdc \
+		--schedules 2 --seed 1 \
+		--out benchmarks/results/chaos_cdc_smoke.json
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --cdc \
+		--schedules 1 --seed 7 \
+		--break-guard cdc_dedup --expect-violation
 
 clean:
 	$(MAKE) -C rocksplicator_tpu/storage/native clean

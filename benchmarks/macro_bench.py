@@ -338,15 +338,19 @@ class Cluster:
                  read_info_ttl_ms: int, transport: str,
                  executor_threads: int, with_move_node: bool = False,
                  db_profile: str = "default",
-                 extra_env: Optional[Dict[str, str]] = None):
+                 extra_env: Optional[Dict[str, str]] = None,
+                 with_admin: bool = False):
         self.shards = shards
         self.with_move_node = with_move_node
         self._moved: Dict[int, int] = {}  # shard -> current leader idx
         self.procs: List[subprocess.Popen] = []
         n = 4 if with_move_node else 3
         self.ports = [reserve_port() for _ in range(n)]
+        # with_admin: admin RPC plane on the 3 replicas WITHOUT the 4th
+        # move-destination node (the --cdc mode drives
+        # start_message_ingestion against the leader's admin port)
         self.admin_ports = ([reserve_port() for _ in range(n)]
-                            if with_move_node else [])
+                            if (with_move_node or with_admin) else [])
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    RSTPU_TRANSPORT=transport)
         env.update(extra_env or {})
@@ -1862,6 +1866,289 @@ def p99_agreement(result: Dict, server_get_ms: List[float]) -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# CDC streaming ingest phase (round 19: kafka wire -> exactly-once
+# follower apply with WAL-riding checkpoints + pacing backpressure)
+# ---------------------------------------------------------------------------
+
+
+def _cdc_value(i: int, nbytes: int) -> bytes:
+    seed = b"c%d." % i
+    return (seed * (nbytes // len(seed) + 1))[:nbytes]
+
+
+def run_cdc_phase(args, root: str) -> Dict:
+    """CDC streaming ingest under serving load, serving-shaped numbers:
+
+    - boots the 3-process churn-profile cluster WITH the admin plane,
+      plus a networked BrokerServer in the driver;
+    - phase 1 (baseline): the open-loop mixed workload alone;
+    - phase 2 (cdc): the same workload while a producer streams CDC
+      records into the broker and the leader's IngestionWatchers (one
+      per shard, started via the startMessageIngestion admin RPC,
+      ``broker://`` transport) apply them through the grouped-commit
+      write path — watermark checkpoints riding every batch;
+    - a freshness sampler produces marker records and polls a FOLLOWER
+      until each is readable: produce -> replicated-readable wall time,
+      the end-to-end freshness the artifact reports as p50/p99;
+    - after the producer stops, the drain must converge to EXACTLY the
+      produced count (``kafka.cdc.records_applied`` delta == produced,
+      zero ``dup_skipped``) — the exactly-once invariant, serving-shaped;
+    - backpressure must demonstrably engage: the churn engine profile
+      builds real flush/L0 debt, so ``kafka.cdc.paced_sleeps``/
+      ``paced_ms`` (the delayed-write-controller-derived fetch pacing)
+      must be nonzero.
+    """
+    from rocksplicator_tpu.kafka.network import BrokerServer
+    from rocksplicator_tpu.rpc.router import ReadPolicy
+    from rocksplicator_tpu.utils.segment_utils import segment_to_db_name
+
+    mix = parse_mix(args.cdc_mix)
+    total_keys = args.shards * args.preload_keys
+    policy = ReadPolicy.follower_ok(args.max_lag)
+    topic = "cdc_bench"
+    out: Dict = {}
+
+    cluster = Cluster(
+        root, args.shards, args.preload_keys, args.value_bytes,
+        args.write_window, args.read_info_ttl_ms, args.transport,
+        args.executor_threads, db_profile="churn", with_admin=True)
+    broker = None
+    try:
+        cluster.wait_catchup(total_keys)
+        log(f"cdc: baseline phase (no CDC) {args.cdc_serve_rate}/s "
+            f"x {args.cdc_duration}s")
+        out["baseline"] = run_phase(
+            cluster, policy, args.cdc_serve_rate, args.cdc_duration,
+            total_keys, args.value_bytes, mix, args.seed, args.max_inflight)
+
+        broker = BrokerServer(
+            data_dir=os.path.join(root, "broker")).start()
+        bport = broker.port
+
+        async def bcall(method: str, **a):
+            return await cluster.pool.call("127.0.0.1", bport, method, a,
+                                           timeout=15.0)
+
+        cluster.ioloop.run_sync(
+            bcall("broker_create_topic", topic=topic,
+                  num_partitions=args.shards), timeout=20)
+        for s in range(args.shards):
+            db_name = segment_to_db_name(SEGMENT, s)
+
+            async def start(db=db_name):
+                return await cluster.pool.call(
+                    "127.0.0.1", cluster.admin_ports[0],
+                    "start_message_ingestion",
+                    {"db_name": db, "topic_name": topic,
+                     "kafka_broker_serverset_path":
+                         f"broker://127.0.0.1:{bport}"},
+                    timeout=30.0)
+
+            cluster.ioloop.run_sync(start(), timeout=35)
+        log(f"cdc: {args.shards} IngestionWatchers consuming "
+            f"broker://127.0.0.1:{bport} topic={topic}")
+
+        before = _scrape_counter_sums(cluster, ("kafka.cdc.",))
+        produced = [0]       # records (producer + markers)
+        produced_bytes = [0]
+        stop_producing = threading.Event()
+        freshness_ms: List[float] = []
+        probe_timeouts = [0]
+
+        def producer():
+            """Open-loop CDC stream at cdc_rate across all partitions,
+            bursts dispatched as one gather per tick (the per-record
+            sync-RPC round trip would cap the rate well below target)."""
+            i = 0
+            t0 = time.monotonic()
+            while not stop_producing.is_set():
+                due = int((time.monotonic() - t0) * args.cdc_rate)
+                burst = min(due - i, 64)
+                if burst <= 0:
+                    time.sleep(0.005)
+                    continue
+                msgs = []
+                for _ in range(burst):
+                    key = b"cdc%08d" % i
+                    val = _cdc_value(i, args.cdc_value_bytes)
+                    msgs.append((i % args.shards, key, val))
+                    produced_bytes[0] += len(key) + len(val)
+                    i += 1
+
+                async def send():
+                    await asyncio.gather(*[
+                        bcall("broker_produce", topic=topic, partition=p,
+                              key=k, value=v,
+                              timestamp_ms=int(time.time() * 1000))
+                        for (p, k, v) in msgs])
+
+                cluster.ioloop.run_sync(send(), timeout=30)
+                produced[0] += burst
+            # markers ride the same stream: fold them into the total
+
+        def sampler():
+            """Produce a marker, poll a FOLLOWER until readable: the
+            produce -> replicated-readable freshness distribution."""
+            m = 0
+            while not stop_producing.is_set():
+                shard = m % args.shards
+                key = b"cdcmark%06d" % m
+                val = _cdc_value(10_000_000 + m, args.cdc_value_bytes)
+                t_prod = time.monotonic()
+                cluster.ioloop.run_sync(
+                    bcall("broker_produce", topic=topic, partition=shard,
+                          key=key, value=val,
+                          timestamp_ms=int(time.time() * 1000)),
+                    timeout=30)
+                produced[0] += 1
+                produced_bytes[0] += len(key) + len(val)
+
+                async def read():
+                    r = await cluster.pool.call(
+                        "127.0.0.1", cluster.ports[1], "read",
+                        {"db_name": segment_to_db_name(SEGMENT, shard),
+                         "op": "get", "keys": [key],
+                         "max_lag": 1 << 30}, timeout=5.0)
+                    return r["values"][0]
+
+                deadline = time.monotonic() + args.cdc_probe_timeout
+                seen = False
+                while time.monotonic() < deadline:
+                    try:
+                        if cluster.ioloop.run_sync(read(), timeout=10) \
+                                == val:
+                            seen = True
+                            break
+                    except Exception:
+                        pass
+                    time.sleep(0.003)
+                if seen:
+                    freshness_ms.append(
+                        (time.monotonic() - t_prod) * 1000.0)
+                else:
+                    probe_timeouts[0] += 1
+                m += 1
+                time.sleep(0.1)
+
+        threads = [threading.Thread(target=producer, daemon=True),
+                   threading.Thread(target=sampler, daemon=True)]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        log(f"cdc: CDC phase — {args.cdc_rate} rec/s x "
+            f"{args.cdc_value_bytes}B CDC stream + {args.cdc_serve_rate}/s"
+            f" mixed serving load x {args.cdc_duration}s")
+        out["with_cdc"] = run_phase(
+            cluster, policy, args.cdc_serve_rate, args.cdc_duration,
+            total_keys, args.value_bytes, mix, args.seed + 31,
+            args.max_inflight)
+        stop_producing.set()
+        for t in threads:
+            t.join(timeout=30)
+        produce_window = time.monotonic() - t_start
+
+        # drain: applied must converge to EXACTLY the produced count
+        def applied_delta() -> Dict[str, float]:
+            now = _scrape_counter_sums(cluster, ("kafka.cdc.",))
+            return {k: now.get(k, 0.0) - before.get(k, 0.0)
+                    for k in set(now) | set(before)}
+
+        deadline = time.monotonic() + args.cdc_drain_timeout
+        delta = applied_delta()
+        while time.monotonic() < deadline and (
+                delta.get("kafka.cdc.records_applied", 0) < produced[0]):
+            time.sleep(0.25)
+            delta = applied_delta()
+        drain_sec = time.monotonic() - t_start - produce_window
+
+        for s in range(args.shards):
+            db_name = segment_to_db_name(SEGMENT, s)
+
+            async def stop_ing(db=db_name):
+                return await cluster.pool.call(
+                    "127.0.0.1", cluster.admin_ports[0],
+                    "stop_message_ingestion", {"db_name": db},
+                    timeout=30.0)
+
+            try:
+                cluster.ioloop.run_sync(stop_ing(), timeout=35)
+            except Exception:
+                pass
+
+        freshness_ms.sort()
+        applied = int(delta.get("kafka.cdc.records_applied", 0))
+        bytes_applied = delta.get("kafka.cdc.bytes_applied", 0.0)
+        out["cdc"] = {
+            "produced_records": produced[0],
+            "produced_mb": round(produced_bytes[0] / 1e6, 3),
+            "applied_records": applied,
+            "dup_skipped": int(delta.get("kafka.cdc.dup_skipped", 0)),
+            "consumer_errors": int(
+                delta.get("kafka.cdc.consumer_errors", 0)),
+            "retry_later": int(delta.get("kafka.cdc.retry_later", 0)),
+            "apply_batches": int(delta.get("kafka.cdc.batches", 0)),
+            "consume_mb_per_sec": round(
+                bytes_applied / 1e6 / max(0.001, produce_window + max(
+                    0.0, drain_sec)), 3),
+            "produce_window_sec": round(produce_window, 2),
+            "drain_sec": round(max(0.0, drain_sec), 2),
+            "paced_sleeps": int(delta.get("kafka.cdc.paced_sleeps", 0)),
+            "paced_ms": round(delta.get("kafka.cdc.paced_ms", 0.0), 1),
+            "freshness_samples": len(freshness_ms),
+            "freshness_probe_timeouts": probe_timeouts[0],
+            "freshness_p50_ms": percentile(freshness_ms, 50.0),
+            "freshness_p99_ms": percentile(freshness_ms, 99.0),
+        }
+        g0 = out["baseline"]["ops"].get("get") or {}
+        g1 = out["with_cdc"]["ops"].get("get") or {}
+        log(f"cdc: applied {applied}/{produced[0]} "
+            f"({out['cdc']['consume_mb_per_sec']} MB/s), freshness "
+            f"p99={out['cdc']['freshness_p99_ms']}ms "
+            f"({len(freshness_ms)} samples), paced_sleeps="
+            f"{out['cdc']['paced_sleeps']}, get p99 "
+            f"{g0.get('p99_ms')} -> {g1.get('p99_ms')}ms under CDC")
+        return out
+    finally:
+        if broker is not None:
+            broker.stop()
+        cluster.stop()
+
+
+def cdc_failures(result: Dict) -> List[str]:
+    """Loud gates for the --cdc artifact (the smoke relies on these)."""
+    failures: List[str] = []
+    cdc = result.get("cdc_phase", {}).get("cdc") or {}
+    if not cdc:
+        return ["cdc phase produced no summary"]
+    if cdc["applied_records"] != cdc["produced_records"]:
+        failures.append(
+            f"exactly-once violated: applied {cdc['applied_records']} != "
+            f"produced {cdc['produced_records']} after drain")
+    if cdc["dup_skipped"]:
+        failures.append(
+            f"{cdc['dup_skipped']} duplicate offsets skipped in a "
+            f"crash-free run (consumer re-fetched acked records)")
+    if not cdc["paced_sleeps"]:
+        failures.append(
+            "backpressure never engaged (kafka.cdc.paced_sleeps == 0 "
+            "under the churn profile)")
+    if not cdc["freshness_samples"]:
+        failures.append("no freshness samples completed")
+    if cdc["freshness_probe_timeouts"] > cdc["freshness_samples"]:
+        failures.append(
+            f"freshness probes mostly timed out "
+            f"({cdc['freshness_probe_timeouts']} timeouts vs "
+            f"{cdc['freshness_samples']} samples)")
+    base = result.get("cdc_phase", {}).get("baseline", {})
+    with_cdc = result.get("cdc_phase", {}).get("with_cdc", {})
+    for name, phase in (("baseline", base), ("with_cdc", with_cdc)):
+        g = (phase.get("ops") or {}).get("get") or {}
+        if not g.get("count"):
+            failures.append(f"no reads completed in the {name} phase")
+    return failures
+
+
+# ---------------------------------------------------------------------------
 # main
 # ---------------------------------------------------------------------------
 
@@ -2005,6 +2292,26 @@ def main(argv=None) -> int:
                         "the A/B contrast is placement, even on a "
                         "1-core host where CPU is zero-sum across "
                         "server processes")
+    p.add_argument("--cdc", action="store_true",
+                   help="CDC streaming-ingest phase: baseline mixed "
+                        "phase, then the same load while a producer "
+                        "streams into a networked broker and the "
+                        "leader's IngestionWatchers apply exactly-once "
+                        "with WAL-riding checkpoints; artifact gates on "
+                        "applied==produced, backpressure engaging, and "
+                        "follower-readable freshness samples")
+    p.add_argument("--cdc_rate", type=float, default=600.0,
+                   help="CDC records/s offered to the broker")
+    p.add_argument("--cdc_value_bytes", type=int, default=256)
+    p.add_argument("--cdc_duration", type=float, default=8.0,
+                   help="seconds per phase (baseline and with-CDC)")
+    p.add_argument("--cdc_serve_rate", type=float, default=400.0,
+                   help="foreground mixed ops/s during both phases")
+    p.add_argument("--cdc_mix", default="get=0.7,put=0.3")
+    p.add_argument("--cdc_probe_timeout", type=float, default=15.0,
+                   help="per-marker freshness probe deadline (s)")
+    p.add_argument("--cdc_drain_timeout", type=float, default=90.0,
+                   help="post-produce drain deadline (s)")
     p.add_argument("--overload_gates", choices=("full", "mechanical"),
                    default="full",
                    help="'full' (default) gates the latency medians "
@@ -2048,6 +2355,38 @@ def main(argv=None) -> int:
 
     root = tempfile.mkdtemp(prefix="rstpu-macro-")
     t0 = time.monotonic()
+    if args.cdc:
+        # standalone mode: the churn cluster + admin plane + broker
+        # belong to the CDC phase runner
+        result = {
+            "bench": "macro_bench_cdc",
+            "config": {
+                "shards": args.shards,
+                "preload_keys_per_shard": args.preload_keys,
+                "value_bytes": args.value_bytes,
+                "mix": parse_mix(args.cdc_mix),
+                "serve_rate": args.cdc_serve_rate,
+                "cdc_rate": args.cdc_rate,
+                "cdc_value_bytes": args.cdc_value_bytes,
+                "duration": args.cdc_duration,
+                "max_lag": args.max_lag,
+                "transport": args.transport,
+                "seed": args.seed,
+                "db_profile": "churn",
+                "topology": ("1 leader + 2 followers (mode 1), 3 OS "
+                             "processes + driver-hosted BrokerServer; "
+                             "IngestionWatcher per shard on the leader "
+                             "via startMessageIngestion"),
+            },
+            "host_calibration": host_calibration(root),
+        }
+        try:
+            result["cdc_phase"] = run_cdc_phase(args, root)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        result["elapsed_sec"] = round(time.monotonic() - t0, 1)
+        result["failures"] = cdc_failures(result)
+        return emit_gated_artifact(result, args.out, "macro_bench", log)
     if args.sched_ab:
         # standalone mode: each arm boots its own cluster (the
         # scheduler switch is a process-env knob), so the normal

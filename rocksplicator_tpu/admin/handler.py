@@ -82,12 +82,16 @@ _RESERVED = object()
 
 @dataclass
 class DBMetaData:
-    """rocksdb_admin.thrift DBMetaData."""
+    """rocksdb_admin.thrift DBMetaData (+ the split-trim retain range:
+    hex key bounds a range-split child keeps across reopens so its
+    compactions keep dropping the other half's keys)."""
 
     db_name: str
     s3_bucket: str = ""
     s3_path: str = ""
     last_kafka_msg_timestamp_ms: int = 0
+    retain_lo: str = ""
+    retain_hi: str = ""
 
     def encode(self) -> bytes:
         return json.dumps(asdict(self)).encode("utf-8")
@@ -216,13 +220,20 @@ class AdminHandler:
     def write_meta_data(
         self, db_name: str, s3_bucket: str = "", s3_path: str = "",
         last_kafka_msg_timestamp_ms: Optional[int] = None,
+        retain_lo: Optional[str] = None, retain_hi: Optional[str] = None,
     ) -> None:
-        """admin_handler.cpp:578-595."""
+        """admin_handler.cpp:578-595. ``retain_lo``/``retain_hi``: None
+        keeps the stored bounds (the common metadata update must never
+        erase a split child's trim range)."""
         meta = self.get_meta_data(db_name)
         meta.s3_bucket = s3_bucket
         meta.s3_path = s3_path
         if last_kafka_msg_timestamp_ms is not None:
             meta.last_kafka_msg_timestamp_ms = last_kafka_msg_timestamp_ms
+        if retain_lo is not None:
+            meta.retain_lo = retain_lo
+        if retain_hi is not None:
+            meta.retain_hi = retain_hi
         self._meta_db.put(db_name.encode("utf-8"), meta.encode())
 
     def clear_meta_data(self, db_name: str) -> None:
@@ -244,6 +255,13 @@ class AdminHandler:
         if overwrite:
             destroy_db(path)
         options = self._options_for(db_name)
+        # a split child's retain range is durable identity (DBMetaData),
+        # not dbconfig: reapply it on every reopen so scheduled
+        # compactions keep trimming the inherited other-half keys
+        meta = self.get_meta_data(db_name)
+        if meta.retain_lo or meta.retain_hi:
+            options.retain_lo = meta.retain_lo or None
+            options.retain_hi = meta.retain_hi or None
         db = DB(path, options)
         app_db = ApplicationDB(
             db_name, db, role,
@@ -439,6 +457,8 @@ class AdminHandler:
         upstream_ip: str = "",
         upstream_port: int = 0,
         epoch: int = 0,
+        retain_lo: str = "",
+        retain_hi: str = "",
     ) -> dict:
         """renameDB — the shard-split cutover primitive: close the db,
         rename its storage directory, reopen under the new name with the
@@ -447,6 +467,12 @@ class AdminHandler:
         under the PARENT's name (so the WAL-tail pull addresses match);
         at cutover this flips the copy to its child identity in one
         local, idempotent step.
+
+        ``retain_lo``/``retain_hi`` (hex, [lo, hi)) record the child's
+        key range in its durable metadata: every reopen folds the bounds
+        into the engine options, and scheduled compactions then DROP the
+        inherited other-half keys (DBOptions.retain_lo — the split-trim
+        path) instead of carrying dead bytes forever.
 
         Idempotent for a resumed driver: if the new name is already
         registered and the old is gone, the rename already happened —
@@ -503,14 +529,18 @@ class AdminHandler:
                         and up is None:
                     raise RpcApplicationError(
                         INVALID_UPSTREAM, "follower requires upstream")
-                self._open_app_db(new_db_name, role, up,
-                                  replication_mode=mode,
-                                  epoch=max(int(epoch), live_epoch))
+                # metadata BEFORE reopen: _open_app_db reads the retain
+                # range out of the new name's metadata record
                 meta = self.get_meta_data(db_name)
                 self.write_meta_data(new_db_name, meta.s3_bucket,
                                      meta.s3_path,
-                                     meta.last_kafka_msg_timestamp_ms)
+                                     meta.last_kafka_msg_timestamp_ms,
+                                     retain_lo=retain_lo or None,
+                                     retain_hi=retain_hi or None)
                 self.clear_meta_data(db_name)
+                self._open_app_db(new_db_name, role, up,
+                                  replication_mode=mode,
+                                  epoch=max(int(epoch), live_epoch))
 
         await self._run(do)
         return {}

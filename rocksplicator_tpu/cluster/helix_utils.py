@@ -145,13 +145,18 @@ class AdminClient:
     def rename_db(self, addr, db_name: str, new_db_name: str,
                   new_role: str = "",
                   upstream: Optional[Tuple[str, int]] = None,
-                  epoch: int = 0) -> None:
+                  epoch: int = 0, retain_lo: str = "",
+                  retain_hi: str = "") -> None:
         """Flip a local full-copy to its child identity (shard-split
         cutover primitive): close → rename storage dir → reopen under
-        the new name with the given role/upstream/epoch."""
+        the new name with the given role/upstream/epoch.
+        ``retain_lo``/``retain_hi`` (hex, [lo, hi)) durably record the
+        child's key range so its compactions trim the other half."""
         args: Dict[str, Any] = {"db_name": db_name,
                                 "new_db_name": new_db_name,
-                                "new_role": new_role, "epoch": int(epoch)}
+                                "new_role": new_role, "epoch": int(epoch),
+                                "retain_lo": retain_lo,
+                                "retain_hi": retain_hi}
         if upstream:
             args["upstream_ip"], args["upstream_port"] = upstream
         self.call(addr, "rename_db", timeout=60.0, **args)

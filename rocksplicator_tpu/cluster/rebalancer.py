@@ -8,9 +8,14 @@ donor/target, runs the move tool. This module automates exactly that
 runbook, with the same conservatism a careful operator applies:
 
 - **sense** — scrape the published shard map's replicas for per-shard
-  1-minute read+write rates (``_scraped_shard_load`` — the identical
-  signal ``drain_node`` ranks targets by), then fold each scrape into a
-  per-shard EWMA. One scrape is an anecdote; the EWMA plus a
+  stat records and fold them into ONE hot-spot score per shard
+  (:func:`composite_loads`): 1-minute read+write rate by default — the
+  identical signal ``drain_node`` ranks targets by — optionally blended
+  with ``replicator.applied_seq_lag`` and worst-replica compaction debt
+  via ``RSTPU_REBALANCE_WEIGHTS="rate=1,lag=0.5,debt=0.2"`` (a shard
+  whose followers can't keep up, or that is drowning in uncompacted
+  levels, is hot even at peer-equal serving rates). Each scrape folds
+  into a per-shard EWMA. One scrape is an anecdote; the EWMA plus a
   consecutive-scrapes requirement (``sustain``) is evidence.
 - **decide** (failpoint ``rebalance.decide``) — a shard is HOT when its
   EWMA exceeds ``hot_factor`` x the fleet mean for ``sustain``
@@ -67,7 +72,8 @@ from .coordinator import CoordinatorClient
 from .helix_utils import AdminClient
 from .model import InstanceInfo, cluster_path, decode_states
 from .shard_move import (MoveError, MoveFlags, ShardMove,
-                         _scraped_shard_load, list_active_moves)
+                         _scraped_shard_load, _scraped_shard_stats,
+                         list_active_moves)
 from .shard_split import (ShardSplit, SplitError, choose_split_key,
                           list_splits)
 
@@ -84,6 +90,47 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _parse_weights(raw: str) -> Dict[str, float]:
+    """``RSTPU_REBALANCE_WEIGHTS="rate=1,lag=0.5,debt=0.2"`` → the
+    composite-score weights. Unknown keys and garbage values are
+    ignored; the default is rate-only (the pre-weights behavior)."""
+    out = {"rate": 1.0, "lag": 0.0, "debt": 0.0}
+    for part in (raw or "").split(","):
+        key, sep, val = part.partition("=")
+        key = key.strip()
+        if sep and key in out:
+            try:
+                out[key] = float(val)
+            except ValueError:
+                pass
+    return out
+
+
+def composite_loads(per_shard: Dict[str, dict],
+                    weights: Dict[str, float]) -> Dict[str, float]:
+    """Fold the aggregated per-shard stats records into ONE hot-spot
+    score per shard: ``rate`` weights the 1-minute read+write ops/s,
+    ``lag`` weights ``max_applied_seq_lag`` (one lagging seq ≈ one
+    pending op, so the units line up naturally — a shard whose
+    followers can't keep up is hot even when its serving rate matches
+    its peers), ``debt`` weights worst-replica compaction debt per MiB
+    (a shard drowning in uncompacted levels amplifies every read).
+    With the default weights the score IS the rate — bit-identical to
+    the pre-weights sensor."""
+    w_rate = weights.get("rate", 1.0)
+    w_lag = weights.get("lag", 0.0)
+    w_debt = weights.get("debt", 0.0)
+    out: Dict[str, float] = {}
+    for db, rec in per_shard.items():
+        score = w_rate * (float(rec.get("read_rate_1m", 0.0))
+                          + float(rec.get("write_rate_1m", 0.0)))
+        score += w_lag * float(rec.get("max_applied_seq_lag", 0.0))
+        score += w_debt * (
+            float(rec.get("compaction_debt_bytes", 0.0)) / (1 << 20))
+        out[db] = score
+    return out
+
+
 @dataclass
 class RebalancerFlags:
     """Policy + loop knobs (env-overridable, RSTPU_REBALANCE_*)."""
@@ -96,6 +143,11 @@ class RebalancerFlags:
     max_concurrent: int = 1       # moves+splits in flight, fleet-wide
     split_factor: float = 4.0     # split instead of move above this
     min_rate: float = 1.0         # ops/s floor below which nothing is hot
+    # composite-score weights (RSTPU_REBALANCE_WEIGHTS): rate-only by
+    # default; lag/debt fold replication and compaction health into the
+    # same hot-spot ranking
+    weights: Dict[str, float] = field(
+        default_factory=lambda: {"rate": 1.0, "lag": 0.0, "debt": 0.0})
 
     @classmethod
     def from_env(cls) -> "RebalancerFlags":
@@ -109,6 +161,8 @@ class RebalancerFlags:
                 _env_float("RSTPU_REBALANCE_MAX_CONCURRENT", 1)),
             split_factor=_env_float("RSTPU_REBALANCE_SPLIT_FACTOR", 4.0),
             min_rate=_env_float("RSTPU_REBALANCE_MIN_RATE", 1.0),
+            weights=_parse_weights(
+                os.environ.get("RSTPU_REBALANCE_WEIGHTS", "")),
         )
 
 
@@ -217,8 +271,7 @@ class Rebalancer:
         self.move_flags = move_flags or MoveFlags()
         self.admin = admin or AdminClient()
         self._owns_admin = admin is None
-        self._load_fn = load_fn or (
-            lambda: _scraped_shard_load(coord, cluster))
+        self._load_fn = load_fn or self._composite_scrape
         self.policy = RebalancerPolicy(self.flags)
         self._path = lambda *p: cluster_path(cluster, *p)
         self._stats = Stats.get()
@@ -227,6 +280,17 @@ class Rebalancer:
         self._workers: List[threading.Thread] = []
         self._dispatched = {"moves": 0, "splits": 0, "failed": 0}
         self._last_decisions: List[dict] = []
+
+    def _composite_scrape(self) -> Optional[Dict[str, float]]:
+        """Default sensor: the aggregated per-shard stat records folded
+        through the ``RSTPU_REBALANCE_WEIGHTS`` composite score. With
+        default weights this is exactly ``_scraped_shard_load`` (serving
+        rate only); lag/debt weights let a replication-lagging or
+        compaction-indebted shard outrank a rate-equal peer."""
+        per = _scraped_shard_stats(self.coord, self.cluster)
+        if per is None:
+            return None
+        return composite_loads(per, self.flags.weights)
 
     # -- pause flag + status ---------------------------------------------
 

@@ -727,15 +727,17 @@ def list_active_moves(coord: CoordinatorClient,
     return out
 
 
-def _scraped_shard_load(coord: CoordinatorClient,
-                        cluster: str) -> Optional[Dict[str, float]]:
-    """db_name -> (read+write) 1-minute rate from a one-shot
-    ``/cluster_stats`` scrape of every replica named by the PUBLISHED
-    shard map (coordinator ``shardmap`` node, the spectator's output).
-    None when no map is published, no replica answers, or the scrape
-    faults — the caller falls back to shard counts. This is the
-    round-14 hot-spot sensor's first concrete consumer (ROADMAP's
-    rebalancer item builds on the same signal)."""
+def _scraped_shard_stats(coord: CoordinatorClient,
+                         cluster: str) -> Optional[Dict[str, dict]]:
+    """db_name -> the full aggregated per-shard stats record (1-minute
+    read/write rates, ``max_applied_seq_lag``, worst-replica
+    ``compaction_debt_bytes``, ...) from a one-shot ``/cluster_stats``
+    scrape of every replica named by the PUBLISHED shard map
+    (coordinator ``shardmap`` node, the spectator's output). None when
+    no map is published, no replica answers, or the scrape faults —
+    callers fall back to shard counts. This is the round-14 hot-spot
+    sensor feeding both drain-node target ranking and the rebalancer's
+    composite score."""
     raw = coord.get_or_none(cluster_path(cluster, "shardmap"))
     if not raw:
         return None
@@ -760,9 +762,19 @@ def _scraped_shard_load(coord: CoordinatorClient,
         agg.close()
     if not doc.get("replicas_scraped"):
         return None
+    return dict(doc.get("per_shard") or {})
+
+
+def _scraped_shard_load(coord: CoordinatorClient,
+                        cluster: str) -> Optional[Dict[str, float]]:
+    """db_name -> (read+write) 1-minute rate — the rate-only fold of
+    ``_scraped_shard_stats`` (drain-node's ranking signal)."""
+    per_shard = _scraped_shard_stats(coord, cluster)
+    if per_shard is None:
+        return None
     return {db: (float(rec.get("read_rate_1m", 0.0))
                  + float(rec.get("write_rate_1m", 0.0)))
-            for db, rec in (doc.get("per_shard") or {}).items()}
+            for db, rec in per_shard.items()}
 
 
 def drain_node(coord: CoordinatorClient, cluster: str, node: str,

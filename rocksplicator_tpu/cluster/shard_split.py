@@ -513,13 +513,18 @@ class ShardSplit:
         # then the high seed (already at exact equality), then the
         # parent followers in place. Each rename is idempotent on
         # resume (done = no-op inside the handler).
+        # each child's rename carries its retained half of the key
+        # range ([lo, hi) in split_key hex): durable trim metadata, so
+        # the child's first scheduled compaction drops the other half's
+        # bytes instead of hauling the full parent copy forever
         if led is not None and leader_iid in instances:
             self.admin.rename_db(
                 self._admin_addr(instances[leader_iid]), self.parent_db,
-                low_db, new_role="LEADER", epoch=rec.epoch)
+                low_db, new_role="LEADER", epoch=rec.epoch,
+                retain_hi=rec.split_key)
         self.admin.rename_db(
             self._admin_addr(target), self.parent_db, high_db,
-            new_role="LEADER", epoch=rec.epoch)
+            new_role="LEADER", epoch=rec.epoch, retain_lo=rec.split_key)
         leader_info = instances.get(leader_iid or "")
         for iid in low_replicas:
             if iid == leader_iid:
@@ -533,7 +538,7 @@ class ShardSplit:
                     new_role="FOLLOWER",
                     upstream=((leader_info.host, leader_info.repl_port)
                               if leader_info else None),
-                    epoch=rec.epoch)
+                    epoch=rec.epoch, retain_hi=rec.split_key)
             except (RpcError, RpcApplicationError) as e:
                 # a follower that raced away (dead / already renamed /
                 # never hosted) self-heals through the controller's

@@ -26,10 +26,12 @@ Record batches are the v2 format: zigzag-varint records inside a
 CRC-32C-protected batch frame. Compression: incoming gzip batches
 (attributes codec 1 — what a default Java/librdkafka producer with
 ``compression.type=gzip`` ships) are decoded via stdlib zlib with bounded
-decompression; snappy/lz4/zstd are still rejected loudly (codec bytes
-must never be handed up as record bytes; snappy awaits the native-module
-codec). Produced batches are uncompressed by default (``codec="gzip"``
-opt-in).
+decompression; snappy batches (codec 2) decode through a pure-python
+block-format decoder that also understands snappy-java's xerial stream
+framing; lz4/zstd are still rejected loudly (codec bytes must never be
+handed up as record bytes). Produced batches are uncompressed by default
+(``codec="gzip"``/``codec="snappy"`` opt-in; the snappy encoder emits
+literal-only blocks — valid snappy, no match search).
 """
 
 from __future__ import annotations
@@ -256,6 +258,137 @@ def _gunzip_bounded(data: bytes, cap: int) -> bytes:
     return raw
 
 
+# snappy-java's stream framing (what a Java producer's snappy codec
+# actually ships): 8-byte magic, version, compat, then [len_be4, raw
+# snappy block]*. librdkafka ships the raw block alone.
+_XERIAL_MAGIC = b"\x82SNAPPY\x00"
+
+
+def _snappy_uvarint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        if pos >= len(data) or shift > 31:
+            raise ValueError("kafka batch: bad snappy preamble")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+
+
+def _snappy_block(data: bytes, cap: int) -> bytes:
+    """Pure-python snappy *block format* decode (format_description.txt):
+    little-endian-varint uncompressed length, then tagged elements —
+    literals (tag 00, lengths 1-60 inline, 61-64 → 1-4 trailing length
+    bytes) and back-references (tag 01: 4-11 bytes at an 11-bit offset;
+    tag 10/11: 1-64 bytes at a 16/32-bit offset), overlap-legal (an
+    offset shorter than the copy length repeats the tail, the RLE
+    idiom). Bounded: the declared length must fit ``cap`` and every
+    element is range-checked, so hostile bytes fail loudly instead of
+    ballooning memory or reading out of bounds."""
+    n, pos = _snappy_uvarint(data, 0)
+    if n > cap:
+        raise ValueError("kafka batch: snappy records exceed size cap")
+    out = bytearray()
+    ln = len(data)
+    while pos < ln:
+        tag = data[pos]
+        pos += 1
+        typ = tag & 3
+        if typ == 0:  # literal
+            length = tag >> 2
+            if length >= 60:
+                nb = length - 59
+                if pos + nb > ln:
+                    raise ValueError("kafka batch: bad snappy literal")
+                length = int.from_bytes(data[pos:pos + nb], "little")
+                pos += nb
+            length += 1
+            if pos + length > ln or len(out) + length > n:
+                raise ValueError("kafka batch: bad snappy literal")
+            out += data[pos:pos + length]
+            pos += length
+            continue
+        if typ == 1:  # copy, 1-byte offset
+            if pos >= ln:
+                raise ValueError("kafka batch: bad snappy copy")
+            length = ((tag >> 2) & 0x07) + 4
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif typ == 2:  # copy, 2-byte offset
+            if pos + 2 > ln:
+                raise ValueError("kafka batch: bad snappy copy")
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:  # copy, 4-byte offset
+            if pos + 4 > ln:
+                raise ValueError("kafka batch: bad snappy copy")
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out) or len(out) + length > n:
+            raise ValueError("kafka batch: bad snappy copy")
+        if offset >= length:
+            start = len(out) - offset
+            out += out[start:start + length]
+        else:  # overlapping copy: byte-at-a-time semantics
+            for _ in range(length):
+                out.append(out[-offset])
+    if len(out) != n:
+        raise ValueError("kafka batch: snappy length mismatch")
+    return bytes(out)
+
+
+def _snappy_bounded(data: bytes, cap: int) -> bytes:
+    """Codec-2 records decode: raw snappy block (librdkafka) or the
+    xerial stream framing (snappy-java), auto-detected by magic."""
+    if data[:8] == _XERIAL_MAGIC:
+        if len(data) < 16:
+            raise ValueError("kafka batch: truncated snappy stream")
+        out = bytearray()
+        pos = 16  # magic + version + compat
+        while pos < len(data):
+            if pos + 4 > len(data):
+                raise ValueError("kafka batch: truncated snappy stream")
+            blen = int.from_bytes(data[pos:pos + 4], "big")
+            pos += 4
+            if pos + blen > len(data):
+                raise ValueError("kafka batch: truncated snappy stream")
+            out += _snappy_block(data[pos:pos + blen], cap - len(out))
+            pos += blen
+        return bytes(out)
+    return _snappy_block(data, cap)
+
+
+def _snappy_compress(data: bytes) -> bytes:
+    """Literal-only snappy block encoding (valid per the format spec;
+    no back-reference search — the encoder exists for round-trips and a
+    second produce codec, the pure-python *decoder* is the parity
+    item)."""
+    out = bytearray()
+    n = len(data)
+    # preamble: uncompressed length, little-endian varint
+    v = n
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    pos = 0
+    while pos < n:
+        length = min(n - pos, 1 << 16)
+        if length <= 60:
+            out.append((length - 1) << 2)
+        else:
+            nb = ((length - 1).bit_length() + 7) // 8
+            out.append((59 + nb) << 2)
+            out += (length - 1).to_bytes(nb, "little")
+        out += data[pos:pos + length]
+        pos += length
+    return bytes(out)
+
+
 def encode_record_batch(base_offset: int,
                         records: Sequence[Tuple[int, bytes, bytes]],
                         codec: Optional[str] = None) -> bytes:
@@ -265,12 +398,12 @@ def encode_record_batch(base_offset: int,
     only the records array is wrapped); default is uncompressed."""
     if not records:
         return b""
-    if codec not in (None, "gzip"):
+    if codec not in (None, "gzip", "snappy"):
         raise ValueError(f"unsupported kafka codec: {codec}")
     first_ts = records[0][0]
     max_ts = max(r[0] for r in records)
     body = _W()
-    body.i16(1 if codec == "gzip" else 0)  # attributes: compression codec
+    body.i16({"gzip": 1, "snappy": 2}.get(codec, 0))  # attributes codec
     body.i32(len(records) - 1)       # lastOffsetDelta
     body.i64(first_ts)
     body.i64(max_ts)
@@ -278,7 +411,7 @@ def encode_record_batch(base_offset: int,
     body.i32(len(records))
     # uncompressed (the hot default): records append straight into body;
     # gzip diverts them through an intermediate buffer for the wrapper
-    recs = _W() if codec == "gzip" else body
+    recs = _W() if codec in ("gzip", "snappy") else body
     for delta, (ts, key, value) in enumerate(records):
         rec = _W()
         rec.i8(0)                    # record attributes
@@ -299,6 +432,8 @@ def encode_record_batch(base_offset: int,
         # defaults to 0 in zlib's stream header, keeping output stable
         c = zlib.compressobj(wbits=31)
         body.raw(c.compress(bytes(recs.b)) + c.flush())
+    elif codec == "snappy":
+        body.raw(_snappy_compress(bytes(recs.b)))
     crc = crc32c(bytes(body.b))
     # batch_length counts everything after the length field itself
     batch_len = 4 + 1 + 4 + len(body.b)  # leader_epoch + magic + crc + body
@@ -342,10 +477,10 @@ def decode_record_set(buf: bytes) -> Tuple[
         r = _R(body)
         attributes = r.i16()
         codec = attributes & 0x07
-        if codec not in (0, 1):
-            # snappy(2)/lz4(3)/zstd(4): no in-image codec — reject loudly
-            # rather than hand codec bytes up as record bytes (snappy
-            # lands with the native module)
+        if codec not in (0, 1, 2):
+            # lz4(3)/zstd(4): no in-image codec — reject loudly rather
+            # than hand codec bytes up as record bytes (gzip rides
+            # stdlib zlib, snappy has the pure-python block decoder)
             raise ValueError(
                 f"kafka batch: compression codec {codec} "
                 f"not supported")
@@ -370,6 +505,10 @@ def decode_record_set(buf: bytes) -> Tuple[
         rbuf, rpos = body, r.pos
         if codec == 1:
             rbuf = _gunzip_bounded(body[r.pos:], gunzip_budget)
+            gunzip_budget -= len(rbuf)
+            rpos = 0
+        elif codec == 2:
+            rbuf = _snappy_bounded(body[r.pos:], gunzip_budget)
             gunzip_budget -= len(rbuf)
             rpos = 0
         for _ in range(count):

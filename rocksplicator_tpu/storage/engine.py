@@ -146,6 +146,17 @@ class DBOptions:
     # (RSTPU_COMPACT_MEM_BUDGET, 256 MiB). The per-compaction
     # high-water feeds the compaction.peak_bytes_materialized gauge.
     compaction_memory_budget_bytes: int = 0
+    # Retained key range [retain_lo, retain_hi) as hex strings (the
+    # SplitRecord split_key encoding): compactions DROP user keys
+    # outside the range — the range-split child's garbage trim. A child
+    # born by renaming a full parent copy serves only its half; its
+    # first scheduled compaction rewrites inputs without the other
+    # half's bytes instead of carrying them to the bottom level
+    # forever. Keys in the reserved internal namespace (leading NUL —
+    # CDC watermarks/applies counters, storage/…/checkpoint.py) are
+    # ALWAYS retained regardless of the range. None/"" = no bound.
+    retain_lo: Optional[str] = None
+    retain_hi: Optional[str] = None
 
     # Mutable at runtime via DB.set_options (reference setDBOptions RPC).
     MUTABLE = {
@@ -154,8 +165,22 @@ class DBOptions:
         "delayed_write_rate", "level0_slowdown_writes_trigger",
         "level0_stop_writes_trigger", "max_subcompactions",
         "compaction_budget_bytes_per_sec",
-        "compaction_memory_budget_bytes",
+        "compaction_memory_budget_bytes", "retain_lo", "retain_hi",
     }
+
+    def retain_bounds(self) -> Optional[Tuple[Optional[bytes],
+                                              Optional[bytes]]]:
+        """Decoded (lo, hi) byte bounds, or None when no trim is
+        configured. Malformed hex disables the trim (never drop data on
+        a bad knob) rather than raising mid-compaction."""
+        if not self.retain_lo and not self.retain_hi:
+            return None
+        try:
+            lo = bytes.fromhex(self.retain_lo) if self.retain_lo else None
+            hi = bytes.fromhex(self.retain_hi) if self.retain_hi else None
+        except ValueError:
+            return None
+        return (lo, hi)
 
 
 class _MergedMemView:
@@ -1615,12 +1640,35 @@ class DB:
             n = min(4, os.cpu_count() or 1)
         return max(1, n)
 
+    @staticmethod
+    def _retain_filter(stream, lo: Optional[bytes], hi: Optional[bytes]):
+        """Drop entries whose user key falls outside [lo, hi) — the
+        split-child garbage trim. The reserved internal namespace
+        (leading NUL: CDC watermarks + applies counters) is ALWAYS
+        retained: that state belongs to the db, not to the key range it
+        serves, and must survive the trim."""
+        for entry in stream:
+            key = entry[0]
+            if not key.startswith(b"\x00"):
+                if lo is not None and key < lo:
+                    continue
+                if hi is not None and key >= hi:
+                    continue
+            yield entry
+
     def _write_merged(self, runs: List, drop_tombstones: bool,
                       subcompactions: Optional[int] = None) -> List[str]:
+        retain = self.options.retain_bounds()
         # Backends with a direct file sink (the TPU pipeline: kernel output
         # arrays → vectorized block assembly + kernel-built bloom) skip the
         # per-entry tuple path entirely, splitting at target_file_bytes.
+        # A retain trim forces the tuple path: the direct sinks consume
+        # whole runs and have no per-entry seam to drop out-of-range keys
+        # at (only split children pay this, and only until their trim-
+        # triggering compactions have rewritten the inherited files).
         direct = getattr(self._backend, "merge_runs_to_files", None)
+        if retain is not None:
+            direct = None
         if direct is not None:
             # readers are re-iterable; materialize only raw iterables so a
             # failed direct attempt can still fall back to the tuple path
@@ -1679,6 +1727,9 @@ class DB:
         stream = self._backend.merge_runs(
             streams, self.options.merge_operator, drop_tombstones
         )
+        if retain is not None:
+            stream = self._retain_filter(stream, *retain)
+            Stats.get().incr("compaction.retain_trims")
         return self._write_entry_stream(stream, io_budget=self._io_budget)
 
     def _write_entry_stream(self, stream, io_budget=None) -> List[str]:
@@ -2076,9 +2127,15 @@ class DB:
                     raise InvalidArgument(f"option not mutable: {k}")
             for k, v in updates.items():
                 current = getattr(self.options, k)
-                # _coerce handles "false"→False etc. (same class of bug as
-                # flags string coercion).
-                setattr(self.options, k, _coerce(v, type(current)))
+                if current is None or v is None:
+                    # Optional[str] knobs (retain_lo/retain_hi): no
+                    # current type to coerce to; "" clears the bound
+                    setattr(self.options, k,
+                            None if v in (None, "") else str(v))
+                else:
+                    # _coerce handles "false"→False etc. (same class of
+                    # bug as flags string coercion).
+                    setattr(self.options, k, _coerce(v, type(current)))
             if ("compaction_budget_bytes_per_sec" in updates
                     and self._io_budget is not None):
                 self._io_budget.set_rate(

@@ -102,4 +102,11 @@ REGISTRY = {
     "rebalance.plan": "rebalancer move/split planning failure",
     "rebalance.dispatch": "rebalancer actuator dispatch failure",
     "split.cutover": "shard-split fenced cutover phase failure",
+    # CDC streaming ingest (round 21): the three consumer seams — a
+    # fail_nth at any of them kills the consumer thread mid-batch; a
+    # restart must resume from the WAL-riding watermark exactly-once
+    # (the batch either committed with its watermark or neither did)
+    "kafka.fetch": "CDC consumer fetch-round failure (pre-drain)",
+    "kafka.apply": "CDC grouped-commit apply failure (pre-write)",
+    "kafka.checkpoint": "CDC watermark fold failure (pre-checkpoint)",
 }

@@ -18,7 +18,7 @@ runtime's dynamic cycle detection.
 # construction site (repo-relative file:line) -> (name, rank)
 RANKS = {
     "rocksplicator_tpu/replication/ack_window.py:127": ('AckWindow._cond', 0),
-    "rocksplicator_tpu/admin/handler.py:157": ('AdminHandler._db_admin_lock', 1),
+    "rocksplicator_tpu/admin/handler.py:161": ('AdminHandler._db_admin_lock', 1),
     "rocksplicator_tpu/admin/ingest_pipeline.py:123": ('BatchCompactor._lock', 2),
     "rocksplicator_tpu/storage/sst.py:99": ('BlockCache._instance_lock', 3),
     "rocksplicator_tpu/storage/sst.py:103": ('BlockCache._lock', 4),
@@ -29,7 +29,7 @@ RANKS = {
     "rocksplicator_tpu/storage/stream_merge.py:131": ('CompactionMemoryBudget._lock', 9),
     "rocksplicator_tpu/utils/rate_limiter.py:25": ('ConcurrentRateLimiter._lock', 10),
     "rocksplicator_tpu/cluster/coordinator.py:303": ('CoordinatorServer._snapshot_mutex', 11),
-    "rocksplicator_tpu/storage/engine.py:251": ('DB._compaction_mutex', 12),
+    "rocksplicator_tpu/storage/engine.py:276": ('DB._compaction_mutex', 12),
     "rocksplicator_tpu/utils/dbconfig.py:48": ('DBConfigManager._instance_lock', 13),
     "rocksplicator_tpu/cluster/publishers.py:69": ('DedupPublisher._lock', 14),
     "rocksplicator_tpu/utils/concurrent_map.py:22": ('FastReadMap._write_lock', 15),
@@ -44,9 +44,9 @@ RANKS = {
     "rocksplicator_tpu/replication/iter_cache.py:41": ('IterCache._lock', 24),
     "rocksplicator_tpu/kafka/watcher.py:165": ('KafkaBrokerFileWatcher._lock', 25),
     "rocksplicator_tpu/kafka/watcher.py:191": ('KafkaBrokerFileWatcherManager._lock', 26),
-    "rocksplicator_tpu/kafka/wire.py:434": ('KafkaWireBroker._lock', 27),
-    "rocksplicator_tpu/kafka/wire.py:722": ('KafkaWireConsumer._lock', 28),
-    "rocksplicator_tpu/kafka/wire.py:951": ('KafkaWireProducer._lock', 29),
+    "rocksplicator_tpu/kafka/wire.py:573": ('KafkaWireBroker._lock', 27),
+    "rocksplicator_tpu/kafka/wire.py:861": ('KafkaWireConsumer._lock', 28),
+    "rocksplicator_tpu/kafka/wire.py:1090": ('KafkaWireProducer._lock', 29),
     "rocksplicator_tpu/replication/ack_window.py:57": ('MaxNumberBox._cond', 30),
     "rocksplicator_tpu/storage/stream_merge.py:176": ('MemTracker._lock', 31),
     "rocksplicator_tpu/admin/cdc.py:79": ('MemoryPublisher._lock', 32),
@@ -80,8 +80,8 @@ RANKS = {
     "rocksplicator_tpu/utils/objectstore.py:379": ('utils.objectstore:_store_cache_lock', 60),
     "rocksplicator_tpu/admin/db_manager.py:20": ('ApplicationDBManager._lock', 61),
     "rocksplicator_tpu/cluster/coordinator.py:296": ('CoordinatorServer._lock', 62),
-    "rocksplicator_tpu/storage/engine.py:222": ('DB._lock', 63),
-    "rocksplicator_tpu/storage/engine.py:258": ('DB._manifest_mutex', 64),
+    "rocksplicator_tpu/storage/engine.py:247": ('DB._lock', 63),
+    "rocksplicator_tpu/storage/engine.py:283": ('DB._manifest_mutex', 64),
     "rocksplicator_tpu/utils/file_watcher.py:40": ('FileWatcher._instance_lock', 65),
     "rocksplicator_tpu/cluster/participant.py:75": ('Participant._state_lock', 66),
     "rocksplicator_tpu/storage/compaction_scheduler.py:123": ('IoBudget._lock', 67),
@@ -90,14 +90,14 @@ RANKS = {
 
 # static partial order: (acquired-first, acquired-second)
 ORDER = {
-    ("rocksplicator_tpu/admin/handler.py:157", "rocksplicator_tpu/admin/db_manager.py:20"),
+    ("rocksplicator_tpu/admin/handler.py:161", "rocksplicator_tpu/admin/db_manager.py:20"),
     ("rocksplicator_tpu/cluster/coordinator.py:303", "rocksplicator_tpu/cluster/coordinator.py:296"),
     ("rocksplicator_tpu/cluster/participant.py:76", "rocksplicator_tpu/cluster/participant.py:75"),
-    ("rocksplicator_tpu/storage/engine.py:222", "rocksplicator_tpu/storage/compaction_scheduler.py:123"),
-    ("rocksplicator_tpu/storage/engine.py:222", "rocksplicator_tpu/storage/wal.py:68"),
-    ("rocksplicator_tpu/storage/engine.py:251", "rocksplicator_tpu/storage/compaction_scheduler.py:123"),
-    ("rocksplicator_tpu/storage/engine.py:251", "rocksplicator_tpu/storage/engine.py:222"),
-    ("rocksplicator_tpu/storage/engine.py:251", "rocksplicator_tpu/storage/engine.py:258"),
-    ("rocksplicator_tpu/storage/engine.py:251", "rocksplicator_tpu/storage/wal.py:68"),
+    ("rocksplicator_tpu/storage/engine.py:247", "rocksplicator_tpu/storage/compaction_scheduler.py:123"),
+    ("rocksplicator_tpu/storage/engine.py:247", "rocksplicator_tpu/storage/wal.py:68"),
+    ("rocksplicator_tpu/storage/engine.py:276", "rocksplicator_tpu/storage/compaction_scheduler.py:123"),
+    ("rocksplicator_tpu/storage/engine.py:276", "rocksplicator_tpu/storage/engine.py:247"),
+    ("rocksplicator_tpu/storage/engine.py:276", "rocksplicator_tpu/storage/engine.py:283"),
+    ("rocksplicator_tpu/storage/engine.py:276", "rocksplicator_tpu/storage/wal.py:68"),
     ("rocksplicator_tpu/utils/dbconfig.py:48", "rocksplicator_tpu/utils/file_watcher.py:40"),
 }

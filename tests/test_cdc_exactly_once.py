@@ -1,0 +1,292 @@
+"""CDC exactly-once: crash-resume at every seam + leader failover.
+
+The tentpole contract (ISSUE 19): every apply batch carries its
+partition's consumer-offset watermark in the SAME engine WriteBatch as
+the records it covers — one batch, one WAL record, crash-atomic. A
+consumer killed at any seam (fetch / apply / checkpoint-fold) reopens,
+reads the durable watermark, seeks to it, and skips re-delivered
+offsets below it: zero duplicates, zero gaps, keyed on the watermark
+and never on record contents.
+
+The witness is the applies counter (kafka/checkpoint.py): a
+read-modify-write total that rides every records batch. Coupled
+checkpointing keeps ``applies_total == watermark.offset`` through any
+crash; a checkpoint decoupled from its batch (the chaos harness's
+``cdc_dedup`` tooth) re-applies records on resume and leaves the
+counter ahead — caught even though record applies are idempotent
+upserts (state-compare alone could never see the duplicate).
+
+Leader failover: the watermark replicates WITH the records (it is just
+a key in the batch), so a consumer restarted against the promoted
+follower resumes from the new lineage's own durable watermark —
+exactly-once across failover by the same construction.
+"""
+
+import os
+import time
+
+import pytest
+
+from rocksplicator_tpu.kafka import ingestion as ingestion_mod
+from rocksplicator_tpu.kafka.broker import MockConsumer, MockKafkaCluster
+from rocksplicator_tpu.kafka.checkpoint import read_applies, read_watermark
+from rocksplicator_tpu.kafka.ingestion import IngestionWatcher
+from rocksplicator_tpu.storage import DB
+from rocksplicator_tpu.testing import failpoints as fp
+
+TOPIC = "cdc_t"
+
+
+def wait_until(pred, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset_for_test()
+    yield
+    fp.reset_for_test()
+
+
+@pytest.fixture(autouse=True)
+def _small_batches(monkeypatch):
+    """Shrink the drain/batch shape so a couple hundred messages span
+    many fetch rounds and many per-round batches — a mid-batch kill has
+    real partial progress to tear."""
+    monkeypatch.setattr(ingestion_mod, "MAX_DRAIN", 40)
+    monkeypatch.setattr(ingestion_mod, "BATCH_RECORDS", 16)
+
+
+def _produce_deck(cluster, n, base_ts=1000):
+    """Deterministic produce history with overwrites and deletes, so
+    the final state is a real fold of the log (not set-of-keys)."""
+    expect = {}
+    for i in range(n):
+        key = b"k%03d" % (i % 150)
+        value = b"" if (i % 37 == 0 and i > 0) else b"v%d" % i
+        cluster.produce(TOPIC, 0, key, value, timestamp_ms=base_ts + i)
+        if value:
+            expect[key] = value
+        else:
+            expect.pop(key, None)
+    return expect
+
+
+def _fold_matches(engine, expect):
+    for k, v in expect.items():
+        if engine.get(k) != v:
+            return False
+    return True
+
+
+def _watcher(db, consumer, name="ev00000"):
+    w = IngestionWatcher(None, name, db, consumer, TOPIC, [0], 0)
+    w.start()
+    return w
+
+
+# the kill point per seam, tuned to land mid-stream: fetch dies entering
+# round 3 (80 records applied), apply dies on round 2's grouped commit
+# (40 applied, 40 drained-and-lost), checkpoint dies folding round 2's
+# second batch (40 applied, round 2 partially built)
+SEAM_KILLS = {
+    "kafka.fetch": "fail_nth:3",
+    "kafka.apply": "fail_nth:2",
+    "kafka.checkpoint": "fail_nth:5",
+}
+
+
+@pytest.mark.parametrize("seam", sorted(SEAM_KILLS))
+def test_crash_resume_exactly_once_at_seam(tmp_path, seam):
+    """Kill the consumer thread at each registered seam mid-batch,
+    reopen the engine from disk, resume — applied records must equal
+    the produced prefix exactly once per partition: watermark == applies
+    counter == produced count, and the state is the fold of the log."""
+    cluster = MockKafkaCluster()
+    cluster.create_topic(TOPIC, 1)
+    expect = _produce_deck(cluster, 200)
+
+    path = os.path.join(str(tmp_path), "db")
+    db = DB(path)
+    fp.activate(seam, SEAM_KILLS[seam])
+    w = _watcher(db, MockConsumer(cluster))
+    try:
+        assert wait_until(lambda: w.error is not None), \
+            f"{seam} kill never fired"
+        assert wait_until(lambda: not w.alive)
+    finally:
+        w.stop()
+    fp.clear()
+    # partial progress only: the durable watermark names a strict prefix
+    wm = read_watermark(db, TOPIC, 0)
+    applied_before = 0 if wm is None else wm["offset"]
+    assert applied_before < 200
+    # even mid-crash the invariant holds: counter == watermark (the
+    # batch that carried one carried the other)
+    assert read_applies(db, TOPIC, 0) == applied_before
+
+    # crash = process death: reopen the engine from disk
+    db.close()
+    db = DB(path)
+    try:
+        w2 = _watcher(db, MockConsumer(cluster))
+        try:
+            assert wait_until(lambda: w2.watermark(0) == 200)
+            assert wait_until(w2.replay_done.is_set)
+            # live tail after resume stays exactly-once
+            for i in range(10):
+                cluster.produce(TOPIC, 0, b"live%d" % i, b"lv%d" % i,
+                                timestamp_ms=9000 + i)
+                expect[b"live%d" % i] = b"lv%d" % i
+            assert wait_until(lambda: w2.watermark(0) == 210)
+            assert w2.error is None
+        finally:
+            w2.stop()
+        wm = read_watermark(db, TOPIC, 0)
+        assert wm is not None and wm["offset"] == 210
+        assert read_applies(db, TOPIC, 0) == 210  # zero dups, zero gaps
+        assert _fold_matches(db, expect)
+    finally:
+        db.close()
+
+
+def test_resume_survives_double_crash_same_seam(tmp_path):
+    """Two consecutive kills at the apply seam (the batch-loss seam —
+    drained messages die un-applied) still converge exactly-once: every
+    resume is from the durable watermark, never from consumer memory."""
+    cluster = MockKafkaCluster()
+    cluster.create_topic(TOPIC, 1)
+    expect = _produce_deck(cluster, 200)
+    path = os.path.join(str(tmp_path), "db")
+    db = DB(path)
+    try:
+        for _ in range(2):
+            fp.activate("kafka.apply", "fail_nth:2")
+            w = _watcher(db, MockConsumer(cluster))
+            assert wait_until(lambda: w.error is not None)
+            w.stop()
+            fp.clear()
+        w = _watcher(db, MockConsumer(cluster))
+        try:
+            assert wait_until(lambda: w.watermark(0) == 200)
+        finally:
+            w.stop()
+        assert read_watermark(db, TOPIC, 0)["offset"] == 200
+        assert read_applies(db, TOPIC, 0) == 200
+        assert _fold_matches(db, expect)
+    finally:
+        db.close()
+
+
+def test_cdc_chaos_smoke(tmp_path):
+    """One pass of the cdc_burst chaos deck's first schedule (the
+    checkpoint-seam kill) — the tier-1-sized gate `make cdc-smoke`
+    wires in: a kill/resume cycle against a real 3-replica group must
+    hold invariant 8 (exactly-once on every serving replica)."""
+    from tools.chaos_soak import run_cdc_chaos
+
+    result = run_cdc_chaos(
+        str(tmp_path / "chaos"), schedules=1, seed=11,
+        log=lambda *a: None)
+    assert result["violations"] == []
+    assert result["consumer_starts"] >= 2  # a resume actually happened
+    assert result["failpoint_trips"].get("kafka.checkpoint", 0) >= 1
+
+
+def test_cdc_chaos_catches_decoupled_checkpoint(tmp_path):
+    """The tooth: a consumer whose offset checkpoint is decoupled from
+    its apply batch (records first, watermark in a separate write — the
+    at-least-once bug class) must be CAUGHT by the applies-counter
+    witness, proving the fold-into-the-batch guard is load-bearing.
+    State-compare alone could never see it: applies are idempotent."""
+    from tools.chaos_soak import run_cdc_chaos
+
+    result = run_cdc_chaos(
+        str(tmp_path / "chaos"), schedules=1, seed=1,
+        break_guard="cdc_dedup", log=lambda *a: None)
+    assert result["violations"], "cdc_dedup tooth NOT caught"
+    assert any("applies_total" in v for v in result["violations"])
+
+
+class _ReplTarget:
+    """ApplicationDB-shaped shim over a ReplicatedDB for the failover
+    test: ``.db`` exposes the local engine (watermark reads, pacing
+    gauges), ``write_many`` routes each batch through replication — so
+    the watermark PUT replicates with the records it covers and fencing
+    surfaces as a write error, exactly like the real serving stack."""
+
+    def __init__(self, engine, rdb):
+        self.db = engine
+        self._rdb = rdb
+
+    def write_many(self, batches):
+        for b in batches:
+            self._rdb.write(b)
+
+
+def test_leader_failover_mid_consume_resumes_exactly_once(tmp_path):
+    """Round-11 fencing harness, CDC on top: consume into the leader of
+    a semi-sync 3-replica group, depose it mid-consume (epoch-2
+    promotion + the fencing pull), and restart the consumer against the
+    promoted follower. The watermark rode the replicated batches, so
+    the new lineage resumes from ITS OWN durable watermark — exactly
+    once across the failover, zero dups zero gaps by the same
+    construction as a local crash."""
+    from test_failover_fencing import _Cluster3, DB_NAME
+    from rocksplicator_tpu.replication import ReplicaRole, StorageDbWrapper
+
+    cluster = MockKafkaCluster()
+    cluster.create_topic(TOPIC, 1)
+    expect = _produce_deck(cluster, 60)
+
+    repl = _Cluster3(str(tmp_path))
+    old_leader = repl.rdbs[0]
+    try:
+        w = _watcher(_ReplTarget(repl.dbs[0], old_leader),
+                     MockConsumer(cluster), name=DB_NAME)
+        assert wait_until(lambda: w.watermark(0) == 60)
+        assert wait_until(repl.converged)
+        # the controller's promotion at the data plane: follower 1 takes
+        # epoch 2; follower 2 adopts it and its next pull (still aimed at
+        # the old leader) fences the deposed lineage
+        repl.hosts[1].remove_db(DB_NAME)
+        new_leader = repl.hosts[1].add_db(
+            DB_NAME, StorageDbWrapper(repl.dbs[1]), ReplicaRole.LEADER,
+            replication_mode=1, epoch=2)
+        repl.rdbs[1] = new_leader
+        repl.rdbs[2].adopt_epoch(2)
+        assert wait_until(lambda: old_leader.fenced, timeout=10.0)
+        # mid-consume traffic now lands on a fenced leader: the write
+        # raises (no RETRY_LATER hint) and the consumer dies loudly
+        _produce_deck_2 = [(b"post%02d" % i, b"pv%d" % i)
+                           for i in range(40)]
+        for k, v in _produce_deck_2:
+            cluster.produce(TOPIC, 0, k, v, timestamp_ms=7000)
+            expect[k] = v
+        assert wait_until(lambda: w.error is not None, timeout=10.0)
+        w.stop()
+        # restart against the promoted follower (its follower repointed,
+        # so semi-sync acks flow on the new lineage)
+        repl.rdbs[2].reset_upstream(("127.0.0.1", repl.hosts[1].port))
+        wm = read_watermark(repl.dbs[1], TOPIC, 0)
+        assert wm is not None and wm["offset"] == 60  # replicated in-batch
+        w2 = _watcher(_ReplTarget(repl.dbs[1], new_leader),
+                      MockConsumer(cluster), name=DB_NAME)
+        try:
+            assert wait_until(lambda: w2.watermark(0) == 100)
+            assert w2.error is None
+        finally:
+            w2.stop()
+        assert read_watermark(repl.dbs[1], TOPIC, 0)["offset"] == 100
+        assert read_applies(repl.dbs[1], TOPIC, 0) == 100
+        assert _fold_matches(repl.dbs[1], expect)
+        # and the new lineage replicates the consumed state onward
+        assert wait_until(
+            lambda: read_applies(repl.dbs[2], TOPIC, 0) == 100)
+    finally:
+        repl.stop()

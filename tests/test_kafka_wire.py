@@ -144,8 +144,9 @@ def test_record_batch_gzip_golden_frame():
 
 
 def test_record_batch_gzip_roundtrip_and_guards(monkeypatch):
-    """Encoder gzip opt-in round-trips through the decoder; snappy/lz4/
-    zstd stay loudly rejected; bounded decompression caps a gzip bomb."""
+    """Encoder gzip opt-in round-trips through the decoder; lz4/zstd stay
+    loudly rejected (snappy now decodes — garbage snappy bytes still die
+    loudly, just deeper); bounded decompression caps a gzip bomb."""
     import struct as _s
 
     records = [(1000, b"k1", b"v" * 300), (1010, None, b"v2")]
@@ -162,9 +163,13 @@ def test_record_batch_gzip_roundtrip_and_guards(monkeypatch):
         return bytes(b)
 
     plain = encode_record_batch(0, [(1, b"k", b"v")])
-    for codec in (2, 3, 4):
+    for codec in (3, 4):
         with pytest.raises(ValueError, match="codec"):
             decode_record_batches(with_codec(plain, codec))
+    # codec 2 is no longer rejected at the gate — but uncompressed record
+    # bytes are not valid snappy, so the block decoder rejects them
+    with pytest.raises(ValueError, match="snappy"):
+        decode_record_batches(with_codec(plain, 2))
     # bomb guard: shrink the cap so an over-expanding records section
     # trips the bound instead of ballooning memory
     import rocksplicator_tpu.kafka.wire as wire_mod
@@ -179,6 +184,90 @@ def test_record_batch_gzip_roundtrip_and_guards(monkeypatch):
     # batch-count amplification guard)
     one = encode_record_batch(0, [(1, b"k", b"\x00" * 600)], codec="gzip")
     assert decode_record_batches(one)  # under the 1KiB cap by itself
+    two = one + encode_record_batch(
+        1, [(2, b"k", b"\x00" * 600)], codec="gzip")
+    with pytest.raises(ValueError, match="size cap"):
+        decode_record_batches(two)
+
+
+def test_record_batch_snappy_golden_frame():
+    """Golden snappy frame: a v2 batch with attributes codec 2 whose
+    records section is a hand-built snappy block — preamble varint,
+    literals, and one *overlapping* copy (offset 4, length 12 over
+    ``abcd``: the RLE idiom real encoders emit) — built independently of
+    encode_record_batch, so encoder and decoder cannot share a bug."""
+    from rocksplicator_tpu.kafka.wire import decode_record_set
+
+    batch = bytes.fromhex(
+        "000000000000002a"  # base_offset = 42
+        "00000062"          # batch_length
+        "00000000"          # partition_leader_epoch
+        "02"                # magic = 2
+        "53a70268"          # crc32c over the remainder (compressed bytes)
+        "0002"              # attributes: codec 2 = snappy
+        "00000002"          # last_offset_delta
+        "000001897bd98400"  # first_timestamp
+        "000001897bd98409"  # max_timestamp
+        "ffffffffffffffff"  # producer_id = -1
+        "ffff"              # producer_epoch = -1
+        "ffffffff"          # base_sequence = -1
+        "00000003"          # record count
+        # snappy block: varint(55) preamble, 25-byte literal, copy2
+        # (len 12, offset 4 — overlapping), 18-byte literal
+        "37"                                                  # preamble
+        "601c0000000a616c706861066f6e65002c000602012061626364"  # literal
+        "2e0400"                                              # copy2
+        "4400200012040a67616d6d610a746872656500"              # literal
+    )
+    expect = [
+        (42, 1690000000000, b"alpha", b"one"),
+        (43, 1690000000003, None, b"abcdabcdabcdabcd"),
+        (44, 1690000000009, b"gamma", b"three"),
+    ]
+    records, next_off = decode_record_set(batch)
+    assert records == expect
+    assert next_off == 45
+    # CRC covers the ON-WIRE (compressed) bytes: corrupt inside the
+    # snappy block must die at the CRC gate, not inside the decoder
+    corrupt = bytearray(batch)
+    corrupt[-10] ^= 0x01
+    with pytest.raises(ValueError, match="CRC"):
+        decode_record_batches(bytes(corrupt))
+    # same block behind snappy-java's xerial stream framing (magic +
+    # version/compat header + [len_be4, block]*) must decode identically
+    import struct as _s
+
+    head_len = 2 + 4 + 8 + 8 + 8 + 2 + 4 + 4  # attributes..recordCount
+    body = batch[8 + 4 + 4 + 1 + 4:]
+    block = body[head_len:]
+    xer = (b"\x82SNAPPY\x00" + _s.pack(">ii", 1, 1) +
+           _s.pack(">I", len(block)) + block)
+    xbody = body[:head_len] + xer
+    xbatch = (_s.pack(">qiib", 42, 4 + 1 + 4 + len(xbody), 0, 2) +
+              _s.pack(">I", crc32c(xbody)) + xbody)
+    assert decode_record_set(xbatch)[0] == expect
+
+
+def test_record_batch_snappy_roundtrip_and_guards(monkeypatch):
+    """Encoder snappy opt-in (literal-only blocks) round-trips through
+    the decoder; the size cap bounds a snappy bomb the same way it
+    bounds gzip (a copy-heavy block claiming a huge preamble dies at the
+    declared-length check, before any expansion)."""
+    records = [(1000, b"k1", b"v" * 300), (1010, None, b"v2"),
+               (1020, b"k3", b"abcd" * 40)]
+    sn = encode_record_batch(9, records, codec="snappy")
+    assert decode_record_batches(sn) == decode_record_batches(
+        encode_record_batch(9, records))
+    import rocksplicator_tpu.kafka.wire as wire_mod
+
+    monkeypatch.setattr(wire_mod, "_MAX_DECOMPRESSED", 1 << 10)
+    bomb = encode_record_batch(
+        0, [(1, b"k", b"\x00" * (1 << 12))], codec="snappy")
+    with pytest.raises(ValueError, match="size cap"):
+        decode_record_batches(bomb)
+    # the cumulative budget is shared with gzip batches in the same set
+    one = encode_record_batch(0, [(1, b"k", b"\x00" * 600)], codec="snappy")
+    assert decode_record_batches(one)
     two = one + encode_record_batch(
         1, [(2, b"k", b"\x00" * 600)], codec="gzip")
     with pytest.raises(ValueError, match="size cap"):

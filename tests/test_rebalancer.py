@@ -19,7 +19,8 @@ import pytest
 
 from rocksplicator_tpu.cluster.model import SplitRecord
 from rocksplicator_tpu.cluster.rebalancer import (RebalancerFlags,
-                                                  RebalancerPolicy)
+                                                  RebalancerPolicy,
+                                                  composite_loads)
 from rocksplicator_tpu.rpc import ClusterLayout, IoLoop, RpcRouter
 from rocksplicator_tpu.rpc.router import ReadPolicy
 from rocksplicator_tpu.testing import failpoints as fp
@@ -161,6 +162,70 @@ def test_policy_decide_failpoint_raises():
     for _ in range(3):
         out = rp.observe(SKEW)
     assert out  # recovery needs no special casing
+
+
+# ---------------------------------------------------------------------------
+# composite hot-spot score (RSTPU_REBALANCE_WEIGHTS)
+# ---------------------------------------------------------------------------
+
+
+def _stat(read=0.0, write=0.0, lag=0.0, debt=0.0):
+    return {"read_rate_1m": read, "write_rate_1m": write,
+            "max_applied_seq_lag": lag, "compaction_debt_bytes": debt}
+
+
+def test_composite_default_weights_is_rate_only():
+    """Default weights reproduce the pre-weights sensor exactly: the
+    score is the 1-minute read+write rate, lag and debt invisible."""
+    per = {"a": _stat(read=30.0, write=10.0, lag=5000.0, debt=1 << 30),
+           "b": _stat(read=40.0)}
+    loads = composite_loads(per, RebalancerFlags().weights)
+    assert loads == {"a": 40.0, "b": 40.0}
+
+
+def test_composite_lag_heavy_shard_outranks_rate_equal_peer():
+    """ISSUE pin: with a lag weight, a shard whose followers trail by
+    thousands of seqs outranks a rate-equal peer — and the composite
+    score drives the SAME policy to a move decision for it."""
+    weights = {"rate": 1.0, "lag": 0.01, "debt": 0.0}
+    per = {
+        "hot": _stat(read=20.0, write=20.0, lag=9000.0),
+        "peer": _stat(read=20.0, write=20.0, lag=0.0),
+        "idle1": _stat(read=20.0, write=20.0),
+        "idle2": _stat(read=20.0, write=20.0),
+    }
+    loads = composite_loads(per, weights)
+    assert loads["hot"] > loads["peer"] == 40.0
+    rp = RebalancerPolicy(_flags())
+    decisions = [rp.observe(loads) for _ in range(3)][-1]
+    assert [(d.kind, d.db_name) for d in decisions] == [("move", "hot")]
+    # rate-only weights see four identical shards: nothing is hot
+    rp2 = RebalancerPolicy(_flags())
+    flat = composite_loads(per, RebalancerFlags().weights)
+    for _ in range(4):
+        assert rp2.observe(flat) == []
+
+
+def test_composite_debt_weight_per_mib():
+    """Debt folds in per-MiB so the units stay comparable to ops/s; the
+    worst-replica max (not sum) is what the aggregator publishes."""
+    per = {"a": _stat(read=10.0, debt=64 << 20), "b": _stat(read=10.0)}
+    loads = composite_loads(per, {"rate": 1.0, "lag": 0.0, "debt": 0.5})
+    assert loads == {"a": 10.0 + 32.0, "b": 10.0}
+
+
+def test_composite_weights_from_env(monkeypatch):
+    monkeypatch.setenv("RSTPU_REBALANCE_WEIGHTS",
+                       "rate=2, lag=0.5,debt=0.25")
+    f = RebalancerFlags.from_env()
+    assert f.weights == {"rate": 2.0, "lag": 0.5, "debt": 0.25}
+    # unknown keys and garbage are ignored, omitted keys keep defaults
+    monkeypatch.setenv("RSTPU_REBALANCE_WEIGHTS", "lag=1.5,bogus=9,rate=x")
+    f = RebalancerFlags.from_env()
+    assert f.weights == {"rate": 1.0, "lag": 1.5, "debt": 0.0}
+    monkeypatch.delenv("RSTPU_REBALANCE_WEIGHTS")
+    assert RebalancerFlags.from_env().weights == {
+        "rate": 1.0, "lag": 0.0, "debt": 0.0}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,174 @@
+"""Split-child garbage trim (DBOptions.retain_lo/retain_hi).
+
+A range-split child is born by renaming a FULL parent copy — it serves
+half the key range but carries all of the parent's bytes. The retain
+range makes the child's compactions drop the other half: every merge
+funnels through ``_write_merged``, which filters user keys outside
+``[retain_lo, retain_hi)`` (hex, the SplitRecord split_key encoding).
+Pinned here:
+
+- byte counts SHRINK after the trim-triggering compaction, and every
+  in-range read stays byte-identical (the trim is garbage collection,
+  never data change);
+- the reserved internal namespace (leading NUL — CDC watermarks and
+  applies counters) is always retained: that state belongs to the db,
+  not the key range it serves;
+- the scheduled (auto) compaction path trims too, not just the manual
+  compact_range — the ISSUE contract is "the child's first scheduled
+  compaction drops out-of-range keys";
+- renameDB persists the bounds in DBMetaData and every reopen folds
+  them back into the engine options.
+"""
+
+import os
+import time
+
+from rocksplicator_tpu.replication import ReplicaRole
+from rocksplicator_tpu.rpc import IoLoop, RpcClientPool
+from rocksplicator_tpu.storage import DB, DBOptions
+from rocksplicator_tpu.storage.records import WriteBatch
+
+SPLIT = b"m500"
+
+
+def _sst_bytes(path):
+    return sum(
+        os.path.getsize(os.path.join(path, n))
+        for n in os.listdir(path) if n.endswith(".tsst"))
+
+
+def _fill(db, n=1000):
+    """Keys m000..m{n-1} padded to sort lexicographically, chunky
+    values so the on-disk shrink is unmistakable."""
+    expect = {}
+    for i in range(n):
+        k = b"m%03d" % i
+        v = (b"v%d." % i) * 40
+        db.put(k, v)
+        expect[k] = v
+    return expect
+
+
+def test_retain_trim_shrinks_bytes_in_range_identical(tmp_path):
+    path = str(tmp_path / "db")
+    with DB(path, DBOptions(disable_auto_compaction=True)) as db:
+        expect = _fill(db)
+        # CDC state in the reserved namespace rides along (a split
+        # child inherits its parent's consumer checkpoints)
+        wm = WriteBatch()
+        wm.put(b"\x00cdc\x00wm\x00t\x000", b"\x01" * 16)
+        db.write(wm)
+        db.compact_range()  # settled baseline: everything at bottom
+        before = _sst_bytes(path)
+
+        db.set_options({"retain_hi": SPLIT.hex()})  # the LOW child
+        db.compact_range()
+        after = _sst_bytes(path)
+
+        # half the user keys dropped — the bytes must actually shrink
+        assert after < before * 0.75, (before, after)
+        for k, v in expect.items():
+            if k < SPLIT:
+                assert db.get(k) == v  # byte-identical
+            else:
+                assert db.get(k) is None  # trimmed
+        # reserved namespace survives the trim (it sorts below any
+        # retain_lo a real split key could have)
+        assert db.get(b"\x00cdc\x00wm\x00t\x000") == b"\x01" * 16
+
+    # bounds live in options (not the manifest): a bare engine reopen
+    # without them does NOT resurrect trimmed keys — they are gone
+    with DB(path) as db:
+        assert db.get(b"m999") is None
+        assert db.get(b"m000") == expect[b"m000"]
+
+
+def test_retain_lo_trims_low_half_and_keeps_reserved(tmp_path):
+    with DB(str(tmp_path / "db"),
+            DBOptions(disable_auto_compaction=True,
+                      retain_lo=SPLIT.hex())) as db:  # the HIGH child
+        expect = _fill(db, 800)
+        wm = WriteBatch()
+        wm.put(b"\x00cdc\x00applies\x00t\x000", b"\x02" * 8)
+        db.write(wm)
+        db.compact_range()
+        for k, v in expect.items():
+            assert db.get(k) == (v if k >= SPLIT else None)
+        assert db.get(b"\x00cdc\x00applies\x00t\x000") == b"\x02" * 8
+
+
+def test_retain_trim_on_scheduled_compaction(tmp_path):
+    """The ISSUE contract: the split child's first SCHEDULED compaction
+    drops out-of-range keys — no operator compact_range required."""
+    opts = DBOptions(memtable_bytes=8 * 1024,
+                     level0_compaction_trigger=3,
+                     background_compaction=True,
+                     retain_hi=SPLIT.hex())
+    with DB(str(tmp_path / "db"), opts) as db:
+        expect = _fill(db, 600)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            with db._lock:
+                settled = (not db._levels[0] and not db._imms)
+            if settled:
+                break
+            time.sleep(0.05)
+        db.flush()
+        db.compact_range()  # drain any L0 stragglers deterministically
+        for k, v in expect.items():
+            assert db.get(k) == (v if k < SPLIT else None)
+
+
+def test_retain_bounds_malformed_hex_disables_trim(tmp_path):
+    """A bad knob must never drop data: malformed hex = no trim."""
+    with DB(str(tmp_path / "db"),
+            DBOptions(disable_auto_compaction=True,
+                      retain_hi="not-hex!")) as db:
+        assert db.options.retain_bounds() is None
+        expect = _fill(db, 100)
+        db.compact_range()
+        for k, v in expect.items():
+            assert db.get(k) == v
+
+
+def test_rename_db_persists_retain_range(tmp_path):
+    """renameDB carries the child's retained range into DBMetaData, the
+    reopen folds it into the engine options, and a host restart that
+    re-adds the db still trims — durable identity, not a one-shot."""
+    from test_admin import AdminNode
+
+    node = AdminNode(tmp_path, "n0")
+    ioloop = IoLoop.default()
+    pool = RpcClientPool()
+
+    def call(method, **args):
+        async def go():
+            return await pool.call("127.0.0.1", node.admin_port, method,
+                                   args, timeout=30)
+        return ioloop.run_sync(go())
+
+    try:
+        call("add_db", db_name="seg00001", role="LEADER")
+        parent = node.handler.db_manager.get_db("seg00001")
+        expect = _fill(parent.db, 400)
+        parent.db.flush()
+        call("rename_db", db_name="seg00001", new_db_name="seg00017",
+             new_role="LEADER", epoch=2, retain_hi=SPLIT.hex())
+
+        child = node.handler.db_manager.get_db("seg00017")
+        assert child.db.options.retain_hi == SPLIT.hex()
+        meta = node.handler.get_meta_data("seg00017")
+        assert meta.retain_hi == SPLIT.hex() and meta.retain_lo == ""
+        child.db.compact_range()
+        for k, v in expect.items():
+            assert child.db.get(k) == (v if k < SPLIT else None)
+
+        # host restart: remove + re-add under the child name — the
+        # metadata (not the caller) supplies the bounds again
+        node.handler.db_manager.remove_db("seg00017")
+        reopened = node.handler._open_app_db(
+            "seg00017", ReplicaRole.LEADER, None, epoch=2)
+        assert reopened.db.options.retain_hi == SPLIT.hex()
+    finally:
+        ioloop.run_sync(pool.close())
+        node.stop()

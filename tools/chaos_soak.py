@@ -118,6 +118,25 @@ invariant**:
    sharp probe runs WAITLESS once converged: an acked tail lost at a
    split cutover can never heal and must be caught, not outwaited.
 
+``--cdc`` (round 21) runs the CDC STREAMING INGEST deck (``cdc_burst``):
+an embedded broker feeds the exactly-once consumer
+(``kafka/ingestion.py``) applying into a 3-replica semi-sync group,
+while seeded schedules kill the consumer at every registered seam
+(``kafka.fetch`` / ``kafka.apply`` / ``kafka.checkpoint``) mid-batch,
+run multi-kill bursts, and depose the leader mid-consume (the consumer
+restarted against the promoted follower resumes from ITS replicated
+watermark). After EVERY schedule the harness holds the **eighth
+standing invariant**:
+
+8. **CDC exactly-once** — applied records == the produced prefix,
+   exactly once, per partition, on every replica of the serving
+   lineage: the durable watermark equals the produced count, the
+   applies-counter witness equals it too (record applies are idempotent
+   upserts, so a re-apply is INVISIBLE to state-compare — only the
+   counter riding the records batches can see a duplicate), and the
+   readable state equals the fold of the produced log (catching drops,
+   doubled deletes, and lost overwrites).
+
 - ``fencing`` (``--failover`` only) — the leader IGNORES epochs
   (``ReplicatedDB._reject_stale_epoch`` patched to a no-op): the
   stale-frame probes in the leader-crash schedule must catch it acking
@@ -135,6 +154,13 @@ invariant**:
   high child — keys at/above the split key acked after the snapshot
   are absent from the child that owns them FOREVER: the per-child
   acked-readability probe must catch the loss.
+- ``cdc_dedup`` (``--cdc`` only) — the at-least-once consumer a naive
+  port would ship: the offset checkpoint DECOUPLED from its apply batch
+  (records commit first, the watermark follows in a separate write). A
+  kill between the two leaves applied records above a stale watermark;
+  resume re-applies them. The re-apply is invisible to state-compare
+  (idempotent upserts) — the applies-counter witness must catch
+  ``applies_total > produced`` at quiesce.
 
 Usage::
 
@@ -149,6 +175,9 @@ Usage::
         --expect-violation                                      # tooth
     python -m tools.chaos_soak --rebalance --schedules 3 --seed 1
     python -m tools.chaos_soak --rebalance --break-guard split_cutover \
+        --expect-violation                                      # tooth
+    python -m tools.chaos_soak --cdc --schedules 5 --seed 1
+    python -m tools.chaos_soak --cdc --break-guard cdc_dedup \
         --expect-violation                                      # tooth
 """
 
@@ -1117,6 +1146,52 @@ def _break_guard(kind: str):
             lambda self, remote_epoch: False)
         return lambda: setattr(
             ReplicatedDB, "_reject_stale_epoch", orig_reject)
+    if kind == "cdc_dedup":
+        # the at-least-once bug class: the consumer-offset checkpoint
+        # DECOUPLED from the apply batch — records commit first, the
+        # watermark follows in a separate write (what a naive port of
+        # the reference's commit()-after-apply would do). The
+        # kafka.checkpoint seam moves with it: it now fires BETWEEN the
+        # records commit and the watermark write, so a seam kill leaves
+        # applied records above a stale watermark; resume re-applies
+        # them. State-compare can't see it (applies are idempotent
+        # upserts) — the applies-counter witness must catch
+        # ``applies_total > watermark.offset`` at quiesce.
+        from rocksplicator_tpu.kafka.checkpoint import (encode_watermark,
+                                                        watermark_key)
+        from rocksplicator_tpu.kafka.ingestion import IngestionWatcher
+        from rocksplicator_tpu.storage.records import (
+            WriteBatch as _WriteBatch)
+
+        orig_fold = IngestionWatcher._fold_checkpoint
+        orig_apply = IngestionWatcher._apply_group
+
+        def naive_fold(self, batch, partition, next_offset, applied,
+                       ts_ms):
+            pending = getattr(self, "_naive_pending", None)
+            if pending is None:
+                pending = self._naive_pending = []
+            pending.append((partition, next_offset, applied, ts_ms))
+
+        def naive_apply(self, batches):
+            orig_apply(self, batches)
+            pending, self._naive_pending = \
+                getattr(self, "_naive_pending", []) or [], []
+            for p, off, applied, ts in pending:
+                fp.hit("kafka.checkpoint")
+                wb = _WriteBatch()
+                wb.put(watermark_key(self._topic, p),
+                       encode_watermark(off, applied, ts))
+                self._write_many([wb])
+
+        IngestionWatcher._fold_checkpoint = naive_fold
+        IngestionWatcher._apply_group = naive_apply
+
+        def undo():
+            IngestionWatcher._fold_checkpoint = orig_fold
+            IngestionWatcher._apply_group = orig_apply
+
+        return undo
     raise ValueError(f"unknown break-guard: {kind}")
 
 
@@ -3115,6 +3190,304 @@ def run_rebalance_chaos(
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# CDC streaming ingest (round 21): the cdc_burst deck + the EIGHTH
+# standing invariant
+# ---------------------------------------------------------------------------
+
+CDC_TOPIC = "cdc_events"
+
+# the deck rotates one scenario per schedule; kills land at every
+# consumer seam mid-batch, plus a multi-kill burst and a leader
+# failover mid-consume. Order matters for the tooth: schedule 0 is the
+# checkpoint seam, where the cdc_dedup break-guard must be caught.
+_CDC_DECK = [
+    "seam:kafka.checkpoint",
+    "seam:kafka.apply",
+    "seam:kafka.fetch",
+    "burst",
+    "leader_failover",
+]
+
+
+class _CdcApplyTarget:
+    """ApplicationDB-shaped shim over a ReplicatedDB: ``.db`` exposes
+    the local engine (watermark reads, pacing gauges), ``write_many``
+    routes each batch through semi-sync replication — the watermark PUT
+    replicates with the records it covers, and fencing surfaces as a
+    write error exactly as on the serving stack."""
+
+    def __init__(self, engine: DB, rdb):
+        self.db = engine
+        self._rdb = rdb
+
+    def write_many(self, batches):
+        for b in batches:
+            self._rdb.write(b)
+
+
+def _cdc_deck_msgs(n: int) -> Tuple[List[Tuple[bytes, bytes]], Dict]:
+    """Deterministic produce history with overwrites and deletes: the
+    expected final state is the FOLD of the log, so a dropped or
+    doubled delete would surface even without the applies witness."""
+    msgs: List[Tuple[bytes, bytes]] = []
+    expect: Dict[bytes, bytes] = {}
+    for i in range(n):
+        key = b"c%03d" % (i % 120)
+        value = b"" if (i % 29 == 7) else b"v%d" % i
+        msgs.append((key, value))
+        if value:
+            expect[key] = value
+        else:
+            expect.pop(key, None)
+    return msgs, expect
+
+
+def _cdc_produce_bg(kafka, msgs, base_ts: int, pace_sec: float):
+    done = threading.Event()
+
+    def run():
+        for i, (k, v) in enumerate(msgs):
+            kafka.produce(CDC_TOPIC, 0, k, v, timestamp_ms=base_ts + i)
+            if pace_sec:
+                time.sleep(pace_sec)
+        done.set()
+
+    t = threading.Thread(target=run, name="cdc-producer", daemon=True)
+    t.start()
+    return t, done
+
+
+def _check_cdc_invariant(tag: str, kafka, engines: List[DB], expect,
+                         violations: List[str]) -> None:
+    """Invariant 8: applied records == produced prefix, EXACTLY once,
+    per partition — on every replica of the serving lineage. The
+    watermark names the prefix; the applies counter is the duplicate
+    witness (idempotent upserts make state-compare blind to re-applies,
+    the counter is not); the fold check catches drops."""
+    from rocksplicator_tpu.kafka.checkpoint import (read_applies,
+                                                    read_watermark)
+
+    produced = kafka.high_watermark(CDC_TOPIC, 0)
+    for i, engine in enumerate(engines):
+        wm = read_watermark(engine, CDC_TOPIC, 0)
+        off = None if wm is None else wm["offset"]
+        if off != produced:
+            violations.append(
+                f"{tag}: replica {i}: watermark {off} != produced "
+                f"{produced} — the applied prefix is not the produced "
+                f"prefix")
+            continue
+        applies = read_applies(engine, CDC_TOPIC, 0)
+        if applies != produced:
+            violations.append(
+                f"{tag}: replica {i}: applies_total {applies} != "
+                f"produced {produced} — records were NOT applied "
+                f"exactly once (duplicate applies survive state-compare; "
+                f"the counter witness does not)")
+        for k, v in expect.items():
+            got = engine.get(k)
+            if got != v:
+                violations.append(
+                    f"{tag}: replica {i}: fold mismatch at {k!r}: "
+                    f"read {got!r}, want {v!r}")
+                break
+
+
+def _run_cdc_schedule(root: str, si: int, rng: random.Random,
+                      scenario: str, violations: List[str],
+                      counters: Dict, heal_timeout: float) -> None:
+    from rocksplicator_tpu.kafka.broker import (MockConsumer,
+                                                MockKafkaCluster)
+    from rocksplicator_tpu.kafka.ingestion import IngestionWatcher
+
+    kafka = MockKafkaCluster()
+    kafka.create_topic(CDC_TOPIC, 1)
+    n = rng.randint(150, 300)
+    msgs, expect = _cdc_deck_msgs(n)
+    counters["produced"] += n
+    cluster = ChaosCluster(os.path.join(root, f"cdc{si}"))
+    tag = f"cdc schedule {si} [{scenario}]"
+    watchers = []
+
+    def start_watcher(node_idx: int, rdb) -> "IngestionWatcher":
+        w = IngestionWatcher(
+            None, DB_NAME,
+            _CdcApplyTarget(cluster.dbs[node_idx], rdb),
+            MockConsumer(kafka), CDC_TOPIC, [0], 0)
+        w.start()
+        watchers.append(w)
+        counters["consumer_starts"] += 1
+        return w
+
+    def wait(pred, timeout=20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return pred()
+
+    try:
+        if not cluster.wait_converged(20.0):
+            raise RuntimeError(f"{tag}: cluster never converged at start")
+        producer, prod_done = _cdc_produce_bg(
+            kafka, msgs, base_ts=1_000 + si, pace_sec=0.002)
+        engines: List[DB] = list(cluster.dbs)
+        if scenario.startswith("seam:"):
+            site = scenario[len("seam:"):]
+            fp.activate(site, f"fail_nth:{rng.randint(2, 6)}")
+            w = start_watcher(0, cluster.leader)
+            died = wait(lambda: w.error is not None
+                        or (prod_done.is_set() and w.watermark(0) == n))
+            fp.deactivate(site)
+            if w.error is not None:
+                counters["kills"] += 1
+            elif not died:
+                violations.append(
+                    f"{tag}: consumer neither died nor finished")
+            w.stop()
+            w2 = start_watcher(0, cluster.leader)
+            if not wait(lambda: w2.watermark(0) == n):
+                violations.append(
+                    f"{tag}: resumed consumer stalled at watermark "
+                    f"{w2.watermark(0)}/{n} (error {w2.error!r})")
+            w2.stop()
+        elif scenario == "burst":
+            # kill/restart at a random seam, repeatedly, racing the
+            # producer — then one clean pass to quiesce
+            for _cycle in range(3):
+                site = rng.choice(["kafka.fetch", "kafka.apply",
+                                   "kafka.checkpoint"])
+                fp.activate(site, f"fail_nth:{rng.randint(1, 4)}")
+                w = start_watcher(0, cluster.leader)
+                if wait(lambda: w.error is not None
+                        or (prod_done.is_set()
+                            and w.watermark(0) == n), timeout=10.0) \
+                        and w.error is not None:
+                    counters["kills"] += 1
+                fp.deactivate(site)
+                w.stop()
+            w = start_watcher(0, cluster.leader)
+            if not wait(lambda: w.watermark(0) == n):
+                violations.append(
+                    f"{tag}: post-burst consumer stalled at "
+                    f"{w.watermark(0)}/{n} (error {w.error!r})")
+            w.stop()
+        elif scenario == "leader_failover":
+            old_leader = cluster.leader
+            w = start_watcher(0, old_leader)
+            wait(lambda: w.watermark(0) >= n // 3)
+            # the controller's promotion at the data plane: follower 1
+            # takes epoch 2; follower 2's next pull (still aimed at the
+            # old leader) fences the deposed lineage — the consumer's
+            # next replicated write dies loudly
+            cluster.hosts[1].remove_db(DB_NAME)
+            new_leader = cluster.hosts[1].add_db(
+                DB_NAME, StorageDbWrapper(cluster.dbs[1]),
+                ReplicaRole.LEADER, replication_mode=1, epoch=2)
+            cluster.rdbs[1] = new_leader
+            cluster.rdbs[2].adopt_epoch(2)
+            if not wait(lambda: old_leader.fenced, timeout=10.0):
+                violations.append(f"{tag}: deposed leader never fenced")
+            if not wait(lambda: w.error is not None, timeout=15.0):
+                violations.append(
+                    f"{tag}: consumer survived its leader's deposition")
+            counters["kills"] += 1
+            w.stop()
+            cluster.rdbs[2].reset_upstream(
+                ("127.0.0.1", cluster.hosts[1].port))
+            prod_done.wait(20.0)
+            # resume against the promoted follower: its own replicated
+            # watermark names the resume point
+            w2 = start_watcher(1, new_leader)
+            if not wait(lambda: w2.watermark(0) == n):
+                violations.append(
+                    f"{tag}: post-failover consumer stalled at "
+                    f"{w2.watermark(0)}/{n} (error {w2.error!r})")
+            w2.stop()
+            engines = [cluster.dbs[1], cluster.dbs[2]]
+        else:
+            raise ValueError(f"unknown cdc scenario: {scenario}")
+        producer.join(20.0)
+        # quiesce: the serving lineage reconverges, then invariant 8
+        # holds on EVERY replica of it (watermark + counter rode the
+        # replicated batches)
+        lead = engines[0]
+        if not wait(lambda: all(
+                e.latest_sequence_number_relaxed()
+                == lead.latest_sequence_number_relaxed()
+                for e in engines), timeout=heal_timeout):
+            violations.append(
+                f"{tag}: lineage did not reconverge in {heal_timeout}s")
+        _check_cdc_invariant(tag, kafka, engines, expect, violations)
+    finally:
+        fp.clear()
+        for w in watchers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+        cluster.stop()
+
+
+def run_cdc_chaos(
+    root: str,
+    schedules: int = 5,
+    seed: int = 1,
+    break_guard: Optional[str] = None,
+    heal_timeout: float = 15.0,
+    log=print,
+) -> Dict:
+    """The ``cdc_burst`` chaos mode: kill/restart the CDC consumer at
+    every seam mid-batch (plus a multi-kill burst and a leader failover
+    mid-consume), asserting invariant 8 after every schedule."""
+    from rocksplicator_tpu.kafka import ingestion as ingestion_mod
+
+    saved_shape = (ingestion_mod.MAX_DRAIN, ingestion_mod.BATCH_RECORDS,
+                   ingestion_mod.POLL_SEC)
+    # chaos scale: small drains/batches so every schedule crosses many
+    # batch boundaries (a kill always has partial progress to tear)
+    ingestion_mod.MAX_DRAIN = 48
+    ingestion_mod.BATCH_RECORDS = 16
+    ingestion_mod.POLL_SEC = 0.05
+    undo = _break_guard(break_guard) if break_guard else None
+    violations: List[str] = []
+    counters: Dict = {"kills": 0, "consumer_starts": 0, "produced": 0}
+    scenarios: List[str] = []
+    fp.clear()
+    try:
+        for si in range(schedules):
+            rng = random.Random(seed * 1_000_003 + si)
+            scenario = _CDC_DECK[si % len(_CDC_DECK)]
+            scenarios.append(scenario)
+            _run_cdc_schedule(root, si, rng, scenario, violations,
+                              counters, heal_timeout)
+            log(f"  [{si + 1}/{schedules}] {scenario} "
+                f"kills={counters['kills']} "
+                f"starts={counters['consumer_starts']} "
+                f"violations={len(violations)}")
+            if violations and break_guard:
+                break
+    finally:
+        fp.clear()
+        if undo:
+            undo()
+        (ingestion_mod.MAX_DRAIN, ingestion_mod.BATCH_RECORDS,
+         ingestion_mod.POLL_SEC) = saved_shape
+    return {
+        "schedules": schedules,
+        "seed": seed,
+        "scenarios": scenarios,
+        "produced": counters["produced"],
+        "kills": counters["kills"],
+        "consumer_starts": counters["consumer_starts"],
+        "violations": violations,
+        "failpoint_trips": fp.trip_counts(),
+        "break_guard": break_guard,
+    }
+
+
 def run_chaos(
     root: str,
     schedules: int = 20,
@@ -3285,6 +3658,15 @@ def main(argv=None) -> int:
                          "decide/plan/dispatch seam faults and a "
                          "splitter killed AT the fenced cutover — "
                          "holding the SEVENTH standing invariant")
+    ap.add_argument("--cdc", action="store_true",
+                    help="CDC streaming-ingest schedules (the cdc_burst "
+                         "deck): kill/restart the exactly-once consumer "
+                         "at every seam mid-batch, a multi-kill burst, "
+                         "and a leader failover mid-consume — holding "
+                         "the EIGHTH standing invariant (applied "
+                         "records == produced prefix, exactly once, "
+                         "per partition, on every replica of the "
+                         "serving lineage)")
     ap.add_argument("--transport", choices=["tcp", "uds", "loopback"],
                     help="run the cluster's RPC plane on this byte layer "
                          "(RSTPU_TRANSPORT for the run; default: ambient "
@@ -3292,7 +3674,7 @@ def main(argv=None) -> int:
     ap.add_argument("--break-guard",
                     choices=["wal_hole", "meta_first", "fencing",
                              "move_flip", "remote_install",
-                             "split_cutover"])
+                             "split_cutover", "cdc_dedup"])
     ap.add_argument("--expect-violation", action="store_true",
                     help="exit 0 iff a violation WAS caught")
     ap.add_argument("--conv-timeout", type=float, default=30.0)
@@ -3304,6 +3686,8 @@ def main(argv=None) -> int:
         ap.error("--break-guard move_flip requires --reshard")
     if args.break_guard == "split_cutover" and not args.rebalance:
         ap.error("--break-guard split_cutover requires --rebalance")
+    if args.break_guard == "cdc_dedup" and not args.cdc:
+        ap.error("--break-guard cdc_dedup requires --cdc")
     if args.break_guard == "remote_install":
         if args.failover or args.reshard:
             ap.error("--break-guard remote_install is data-plane only "
@@ -3311,14 +3695,20 @@ def main(argv=None) -> int:
         if not args.remote_every:
             ap.error("--break-guard remote_install requires "
                      "--remote-every > 0")
-    if sum(map(bool, (args.failover, args.reshard, args.rebalance))) > 1:
-        ap.error("--failover / --reshard / --rebalance are mutually "
-                 "exclusive")
+    if sum(map(bool, (args.failover, args.reshard, args.rebalance,
+                      args.cdc))) > 1:
+        ap.error("--failover / --reshard / --rebalance / --cdc are "
+                 "mutually exclusive")
 
     root = tempfile.mkdtemp(prefix="rstpu-chaos-")
     t0 = time.monotonic()
     try:
-        if args.rebalance:
+        if args.cdc:
+            result = run_cdc_chaos(
+                root, schedules=args.schedules, seed=args.seed,
+                break_guard=args.break_guard,
+            )
+        elif args.rebalance:
             result = run_rebalance_chaos(
                 root, schedules=args.schedules, seed=args.seed,
                 break_guard=args.break_guard,
@@ -3345,7 +3735,14 @@ def main(argv=None) -> int:
     finally:
         shutil.rmtree(root, ignore_errors=True)
     result["elapsed_sec"] = round(time.monotonic() - t0, 1)
-    if args.rebalance:
+    if args.cdc:
+        print(f"chaos[cdc]: {result['schedules']} schedules "
+              f"({', '.join(sorted(set(result['scenarios'])))}), "
+              f"{result['produced']} records produced, "
+              f"{result['kills']} consumer kills / "
+              f"{result['consumer_starts']} starts, "
+              f"{result['elapsed_sec']}s")
+    elif args.rebalance:
         print(f"chaos[rebalance]: {result['schedules']} schedules, "
               f"{result['acked']} acked writes through policy-driven "
               f"placement ({result['write_errors']} refused), "
@@ -3399,13 +3796,18 @@ def main(argv=None) -> int:
               + (" --failover" if args.failover else "")
               + (" --reshard" if args.reshard else "")
               + (" --rebalance" if args.rebalance else "")
+              + (" --cdc" if args.cdc else "")
               + (f" --transport {args.transport}"
                  if args.transport else "")
               + (f" --break-guard {args.break_guard}"
                  if args.break_guard else ""))
         return 0 if args.expect_violation else 1
     print("chaos: all invariants held"
-          + ((" (policy-initiated placement: one unfenced leader per "
+          + ((" (CDC exactly-once: applied records == produced prefix "
+              "per partition on every serving replica — watermark, "
+              "applies-counter witness, and log-fold all agree)"
+              if args.cdc else
+              " (policy-initiated placement: one unfenced leader per "
               "CHILD, zero acked loss resolved per owning range, "
               "parent retired everywhere, bounded convergence)"
               if args.rebalance else
