@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab macro-bench-hot-shift macro-bench-cdc metrics-smoke compaction-bench compaction-bench-smoke compaction-remote-bench compaction-remote-smoke stream-merge-bench stream-merge-smoke overload-bench overload-smoke chaos-smoke chaos-failover-smoke reshard-smoke rebalance-smoke cdc-smoke clean
+.PHONY: check test test-fast native bench flush-bench flush-bench-smoke loadsst-bench load-sst-smoke soak-bench repl-bench-smoke transport-bench-smoke macro-bench macro-bench-smoke macro-bench-move-smoke macro-bench-sched-ab macro-bench-hot-shift macro-bench-cdc fleet-bench fleet-smoke metrics-smoke compaction-bench compaction-bench-smoke compaction-remote-bench compaction-remote-smoke stream-merge-bench stream-merge-smoke overload-bench overload-smoke chaos-smoke chaos-failover-smoke reshard-smoke rebalance-smoke cdc-smoke clean
 
 # rstpu-check: the three-pass static suite (lock-order/blocking-under-
 # lock, event-loop blocking, failpoint/span/stats registries) over
@@ -269,6 +269,52 @@ macro-bench-cdc:
 		--value_bytes 128 \
 		--out benchmarks/results/macro_bench_cdc_r21.json
 
+# round-22 fleet-density macro-bench (~5 min): 10 nodes x 100 shards
+# (RF=3 on the interleaved ring — each node leads 10 shards and
+# follows 20 from exactly TWO upstream peers) through the scripted
+# timeline: baseline, diurnal rate curve, hot-set shift, node SIGKILL
+# + restart, live drain (pause → level → promote(epoch+1) → repoint →
+# demote per shard, zero acked-write loss), CDC burst (exactly-once
+# drain), cooldown (full fleet convergence) — per-phase SLO gates +
+# /cluster_stats snapshots in the artifact. Then the mux acceptance
+# A/B at fleet shape (8 nodes x 64 shards, interleaved fresh fleets):
+# with RSTPU_PULL_MUX=1 the idle replication plane must carry >= 5x
+# fewer frames/sec and parked long-polls per node (the ring predicts
+# ~S/N = 8x) at equal applied put throughput, zero acked-write loss,
+# get p99 no worse. The A/B load window runs at a rate the host can
+# absorb without saturating (8 procs + driver share the CPU budget;
+# an oversubscribed window turns the p99 gate into a scheduler-noise
+# lottery — the idle-window frames/parked ratios don't depend on the
+# window rate at all), 3 reps so the median p99 gate isn't decided by
+# one noisy rep, a longer load window for more tail samples, and the
+# p99 factor at the 2x host-noise bound the other gates in this repo
+# use on a 1-CPU container (the smoke uses 3x, the tier-1 test 4x;
+# within-arm p99 spread here is routinely >3x between reps).
+fleet-bench:
+	$(PY) -m benchmarks.fleet_bench --nodes 10 --shards 100 \
+		--preload_keys 100 --rate 600 --duration 5 \
+		--out benchmarks/results/fleet_bench_r22.json
+	$(PY) -m benchmarks.fleet_bench --ab --ab_nodes 8 --ab_shards 64 \
+		--ab_reps 3 --ab_rate 150 --ab_load_sec 8 --ab_p99_factor 2 \
+		--preload_keys 60 \
+		--out benchmarks/results/fleet_mux_ab_r22.json
+
+# tier-1-sized fleet smoke (~3 min): the full timeline at 4 nodes x
+# 12 shards, then the mux A/B at the same shape with the factors
+# relaxed to 2x (the ring predicts ~3x here; the 5x gate applies to
+# the fleet-shaped run above) and the p99 gate widened for the short
+# noisy windows. tests/test_fleet_bench.py runs the same harness at a
+# smaller shape and asserts the artifact shapes.
+fleet-smoke:
+	$(PY) -m benchmarks.fleet_bench --nodes 4 --shards 12 \
+		--preload_keys 40 --rate 120 --duration 2 --cdc_records 30 \
+		--out benchmarks/results/fleet_smoke.json
+	$(PY) -m benchmarks.fleet_bench --ab --ab_nodes 4 --ab_shards 12 \
+		--preload_keys 40 --ab_reps 2 --ab_rate 150 --ab_load_sec 3 \
+		--ab_idle_sec 4 --ab_frames_factor 2 --ab_parked_factor 2 \
+		--ab_p99_factor 3 \
+		--out benchmarks/results/fleet_smoke_mux_ab.json
+
 # round-14 metrics-plane smoke (<10s): boots one replica in-process,
 # scrapes /metrics + /cluster_stats, validates Prometheus text-format
 # parseability, the presence of every registered gauge family (engine
@@ -283,9 +329,15 @@ metrics-smoke:
 # three standing invariants (hole-free WAL prefix, zero acked-write
 # loss, ingest atomicity/no-partial-meta); then the SAME seeded
 # schedules re-run on the uds and loopback byte layers (failpoints arm
-# identically on all three transports), and a deliberately-broken
-# durability guard run that must be CAUGHT (--expect-violation). A
-# violation prints the reproducing --seed.
+# identically on all three transports), the SAME deck re-run with the
+# multiplexed pull sessions forced on (RSTPU_PULL_MUX=1 — both chaos
+# shards ride ONE session per follower, crossing the repl.mux.serve/
+# apply seams), and deliberately-broken guard runs that must be CAUGHT
+# (--expect-violation): the wal_hole/meta_first durability teeth plus
+# the round-22 mux_misroute tooth (the serve loop files one shard's
+# updates under its sibling's section key, seqs restamped so the
+# continuity guard can't reject it — the cross-shard invariants must).
+# A violation prints the reproducing --seed.
 # RSTPU_LOCKWATCH=1 arms the runtime lock-order watchdog in every
 # process (parent + spawned replicas inherit the env): each schedule
 # also asserts the canonical acquisition order from testing/
@@ -300,11 +352,16 @@ chaos-smoke:
 	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --schedules 3 --seed 1 \
 		--transport loopback \
 		--out benchmarks/results/chaos_smoke_loopback.json
+	env RSTPU_LOCKWATCH=1 RSTPU_PULL_MUX=1 $(PY) -m tools.chaos_soak \
+		--schedules 6 --seed 3 \
+		--out benchmarks/results/chaos_smoke_mux.json
 	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --schedules 1 --seed 7 \
 		--break-guard wal_hole --expect-violation --conv-timeout 3
 	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --schedules 1 --seed 7 \
 		--ingest-every 1 \
 		--break-guard meta_first --expect-violation --conv-timeout 10
+	env RSTPU_LOCKWATCH=1 $(PY) -m tools.chaos_soak --schedules 1 --seed 7 \
+		--break-guard mux_misroute --expect-violation --conv-timeout 3
 
 # coordinator-backed failover chaos (~30s + ~20s tooth): >= 15 seeded
 # control-plane schedules against Controller + Spectator + 3

@@ -201,10 +201,21 @@ def serve(args) -> int:
         pull_error_delay_min_ms=50,
         pull_error_delay_max_ms=250,
     )
-    role = (ReplicaRole.LEADER if args.serve == "leader"
-            else ReplicaRole.FOLLOWER)
-    upstream = (("127.0.0.1", args.upstream_port)
-                if args.upstream_port else None)
+    # Per-shard assignment: the legacy 3-replica shape (one role, every
+    # shard, one upstream) or — round 22, the fleet topology — an
+    # explicit ``--topo`` JSON list of [shard, role, upstream_port]
+    # giving THIS node's hosted subset (leaders and followers mixed, a
+    # different upstream peer per shard).
+    if args.topo:
+        assign = [(int(s), ReplicaRole[r.upper()],
+                   ("127.0.0.1", int(up)) if up else None)
+                  for s, r, up in json.loads(args.topo)]
+    else:
+        role = (ReplicaRole.LEADER if args.serve == "leader"
+                else ReplicaRole.FOLLOWER)
+        upstream = (("127.0.0.1", args.upstream_port)
+                    if args.upstream_port else None)
+        assign = [(s, role, upstream) for s in range(args.shards)]
     replicator = Replicator(port=args.port, flags=flags,
                             executor_threads=args.executor_threads)
     handler = admin_server = None
@@ -244,12 +255,15 @@ def serve(args) -> int:
         admin_server.add_handler(handler)
         admin_server.start()
     dbs = []
-    for s in range(args.shards):
+    for s, role, upstream in assign:
         name = segment_to_db_name(SEGMENT, s)
         db = DB(os.path.join(args.db_dir, name), db_options(SEGMENT))
         if role is ReplicaRole.LEADER and args.preload_keys:
             # preload BEFORE replication registration: engine writes go
-            # straight to the WAL, followers replay them on first pull
+            # straight to the WAL, followers replay them on first pull.
+            # gids are dealt round-robin across the TOTAL shard count
+            # (shard = gid % --shards), so each leader preloads exactly
+            # its residue class
             batch = None
             for gid in range(s, args.shards * args.preload_keys,
                              args.shards):
@@ -276,7 +290,7 @@ def serve(args) -> int:
             replicator.add_db(name, StorageDbWrapper(db), role,
                               upstream_addr=upstream, replication_mode=1)
     print(f"READY role={args.serve} port={replicator.port} "
-          f"shards={args.shards}", flush=True)
+          f"shards={len(assign)}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     try:
@@ -2156,7 +2170,11 @@ def cdc_failures(result: Dict) -> List[str]:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     # child modes
-    p.add_argument("--serve", choices=["leader", "follower"])
+    p.add_argument("--serve", choices=["leader", "follower", "topo"])
+    p.add_argument("--topo",
+                   help="serve: JSON [[shard, role, upstream_port], ...] "
+                        "— this node's hosted subset of the fleet "
+                        "topology (fleet_bench spawns these)")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--upstream_port", type=int, default=0)
     p.add_argument("--admin_port", type=int, default=0,

@@ -18,8 +18,13 @@ from .wire import ReplicaRole, ReplicateErrorCode
 
 
 class ReplicatorHandler:
-    def __init__(self, db_map: FastReadMap):
+    def __init__(self, db_map: FastReadMap, mux_state=None):
         self._dbs = db_map
+        if mux_state is None:
+            from .pull_mux import MuxServerState
+
+            mux_state = MuxServerState()
+        self._mux_state = mux_state
 
     async def handle_replicate(
         self,
@@ -49,6 +54,24 @@ class ReplicatorHandler:
             max_updates=max_updates, role=role, applied_seq=applied_seq,
             epoch=epoch,
         )
+
+    async def handle_replicate_mux(
+        self,
+        sections: Optional[dict] = None,
+        max_wait_ms: Optional[int] = None,
+        budget: Optional[int] = None,
+    ) -> dict:
+        """Multiplexed pull (round 22): ONE long-poll carrying the cursor
+        set for every shard the peer pulls from this node; per-shard
+        sections come back in one response, each with the exact
+        semantics (fencing, acks, WAL typing, commit point) of a
+        per-shard ``replicate`` — see replication/pull_mux.py."""
+        span = current_span()
+        if span is not None and span.sampled:
+            span.annotate(mux_sections=len(sections or ()))
+        return await self._mux_state.serve(
+            self._dbs, sections or {}, max_wait_ms=max_wait_ms,
+            budget=budget)
 
     async def handle_replicate_ack(
         self,
@@ -106,7 +129,12 @@ class ReplicatorHandler:
         roles = {name: rdb.role.value for name, rdb in self._dbs.items()
                  if not rdb.removed}
         loop = asyncio.get_running_loop()
-        state = await loop.run_in_executor(None, Stats.get().export_state)
+        # cached dump (round 22): at fleet shape the export's gauge
+        # sweep is O(shards); the short-TTL cache makes concurrent
+        # scrapers (spectator + /metrics pollers) share one pass. The
+        # cached dict is shared — copy the top level before annotating.
+        state = dict(await loop.run_in_executor(
+            None, Stats.get().export_state_cached))
         state["shard_roles"] = roles
         return state
 

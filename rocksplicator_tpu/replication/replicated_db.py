@@ -100,6 +100,25 @@ class ReplicationFlags:
     # lower BOTH knobs together (the bench and chaos flags do).
     read_info_ttl_ms: int = 12_000
     read_probe_timeout_ms: int = 1000
+    # Fast-first-connect backoff tier (round 22): the 5-10s error floor
+    # is right for a STEADY follower whose upstream died, but a fleet
+    # cold start races pullers against their leaders' process spin-up —
+    # with only the steady floor, a 100-shard node staggers its first
+    # convergence across minutes. The first N attempts of a shard that
+    # has NEVER completed a pull retry on a jittered fast tier instead;
+    # once any pull succeeds (or N attempts burn), the steady floor
+    # rules. Jitter rides the same RSTPU_PULL_RETRY_SEED rng.
+    pull_fast_first_attempts: int = 5
+    pull_fast_min_ms: int = 100
+    pull_fast_max_ms: int = 500
+    # Multiplexed per-peer pull sessions (round 22): one long-poll
+    # carries every shard pulled from that peer. None = obey the
+    # RSTPU_PULL_MUX env killswitch (default off); True/False override.
+    pull_mux: Optional[bool] = None
+    # server-side cap on the TOTAL updates one mux response may carry
+    # across all sections (each section is additionally clamped by its
+    # own requested max_updates and adaptive_max_updates_cap)
+    mux_session_budget: int = 4096
 
 
 class ReplicatedDB:
@@ -117,6 +136,7 @@ class ReplicatedDB:
         leader_resolver: Optional[LeaderResolver] = None,
         epoch: int = 0,
         stat_tags: Optional[dict] = None,
+        mux=None,
     ):
         self.name = name
         self.wrapper = wrapper
@@ -215,6 +235,17 @@ class ReplicatedDB:
         self._pull_retry_attempt = 0
         _seed = os.environ.get("RSTPU_PULL_RETRY_SEED")
         self._pull_rng = random.Random(int(_seed) if _seed else None)
+        # first-connect detection for the fast backoff tier: flips true
+        # on the first successful pull (solo loop or mux section)
+        self._ever_pulled = False
+        # mux pull session manager (replication/pull_mux.py) — when set
+        # and the killswitch allows, start() registers with it instead
+        # of spawning the per-shard _pull_loop
+        self._mux = mux
+        # serves currently PARKED in this shard's long-poll (loop thread
+        # only) — the per-shard half of the parked-longpolls gauge the
+        # fleet A/B reads; the mux session park has its own counter
+        self._parked_serves = 0
         self._stats = Stats.get()
         # per-shard load counters (round 14): the spectator's hot-spot
         # ranking input. Names precomputed — tagged() is a string join
@@ -248,12 +279,28 @@ class ReplicatedDB:
         if self.role in (ReplicaRole.FOLLOWER, ReplicaRole.OBSERVER):
             if self.upstream_addr is None:
                 raise ValueError(f"{self.name}: {self.role} requires an upstream")
-            self._pull_task = asyncio.run_coroutine_threadsafe(
-                self._pull_loop(), self._loop
-            )
+            if self._mux is not None:
+                # multiplexed pulls: one session per upstream PEER, not
+                # per shard — the manager routes this shard into (or
+                # spawns) its peer's session; shards whose peer predates
+                # replicate_mux come back through start_solo_pull()
+                self._mux.register(self)
+            else:
+                self.start_solo_pull()
+
+    def start_solo_pull(self) -> None:
+        """Spawn the classic per-shard pull loop (the non-mux path, and
+        the mux manager's automatic fallback for legacy peers)."""
+        if self._removed or self._pull_task is not None:
+            return
+        self._pull_task = asyncio.run_coroutine_threadsafe(
+            self._pull_loop(), self._loop
+        )
 
     def stop(self) -> None:
         self._removed = True
+        if self._mux is not None:
+            self._mux.deregister(self)
         task = self._pull_task
         if task is not None:
             self._loop.call_soon_threadsafe(task.cancel)
@@ -724,10 +771,15 @@ class ReplicatedDB:
                     root = current_span()
                     if root is not None:
                         root.annotate(tail_exempt="longpoll_serve")
-                    with start_span("repl.longpoll_wait",
-                                    max_wait_ms=max_wait_ms):
-                        await self._notifier.wait_reserved(
-                            slot, max_wait_ms / 1000.0)
+                    self._stats.incr(M["longpoll_parks"])
+                    self._parked_serves += 1
+                    try:
+                        with start_span("repl.longpoll_wait",
+                                        max_wait_ms=max_wait_ms):
+                            await self._notifier.wait_reserved(
+                                slot, max_wait_ms / 1000.0)
+                    finally:
+                        self._parked_serves -= 1
                     if self._removed:
                         raise RpcApplicationError(
                             ReplicateErrorCode.SOURCE_REMOVED.value, self.name
@@ -1227,9 +1279,7 @@ class ReplicatedDB:
         while not self._removed:
             try:
                 applied, source_role = await self._pull_once()
-                self._conn_errors = 0
-                self._pull_retry_attempt = 0
-                self.pull_stalled_wal_gap = False
+                self._mark_pull_ok()
                 if (
                     applied == 0
                     and self.role is ReplicaRole.FOLLOWER
@@ -1314,6 +1364,16 @@ class ReplicatedDB:
                     self._conn_errors = 0
                 await self._maybe_reset_upstream(force_sample=forced)
                 await self._pull_error_delay()
+
+    def _mark_pull_ok(self) -> None:
+        """Reset the error machinery after a successful pull (solo loop
+        or mux section): error counters, backoff attempt, and the
+        WAL-gap stall flag (an upstream repoint may have landed on a
+        deeper-WAL donor)."""
+        self._ever_pulled = True
+        self._conn_errors = 0
+        self._pull_retry_attempt = 0
+        self.pull_stalled_wal_gap = False
 
     async def _pull_once(self) -> Tuple[int, Optional[str]]:
         """One pull iteration, DOUBLE-BUFFERED: the pull RPC for the next
@@ -1615,13 +1675,30 @@ class ReplicatedDB:
         # new updates immediately (reference replicated_db.cpp:391).
         self._notifier.notify_all_threadsafe()
 
-    async def _pull_error_delay(self) -> None:
-        delay = self._pull_retry.delay(
-            self._pull_retry_attempt, self._pull_rng)
+    def _next_pull_delay(self) -> float:
+        """Compute (and account) the next pull-error backoff in seconds.
+        A shard that has NEVER completed a pull rides the jittered
+        fast-first-connect tier for its first few attempts — fleet cold
+        start races pullers against leader spin-up, and the steady 5-10s
+        floor would stagger 100-shard convergence across minutes. After
+        that (or after any successful pull) the steady RetryPolicy floor
+        rules. Shared by the solo loop and the mux session's per-shard
+        error handling."""
+        f = self.flags
+        if (not self._ever_pulled
+                and self._pull_retry_attempt < f.pull_fast_first_attempts):
+            delay = self._pull_rng.uniform(
+                f.pull_fast_min_ms / 1000.0, f.pull_fast_max_ms / 1000.0)
+        else:
+            delay = self._pull_retry.delay(
+                self._pull_retry_attempt, self._pull_rng)
         self._pull_retry_attempt += 1
         self._stats.add_metric(
             "replicator.pull_backoff_ms", delay * 1000.0)
-        await asyncio.sleep(delay)
+        return delay
+
+    async def _pull_error_delay(self) -> None:
+        await asyncio.sleep(self._next_pull_delay())
 
     async def _maybe_reset_upstream(self, force_sample: bool) -> None:
         """Query the leader resolver (reference: Helix GetLeaderInstanceId,

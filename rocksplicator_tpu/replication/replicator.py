@@ -31,6 +31,7 @@ from ..utils.segment_utils import db_name_to_segment
 from ..utils.stats import Stats, tagged
 from .db_wrapper import DbWrapper
 from .handler import ReplicatorHandler
+from .pull_mux import MuxServerState, PullMuxManager, mux_enabled
 from .replicated_db import LeaderResolver, ReplicatedDB, ReplicationFlags
 from .wire import ReplicaRole
 
@@ -64,10 +65,25 @@ class Replicator:
         # ssl_context_manager.h) — both sides optional, mutual-TLS when
         # the managers carry a CA.
         self._pool = RpcClientPool(ssl_manager=client_ssl_manager)
+        # Mux pull sessions (round 22): the SERVER side always answers
+        # replicate_mux (so mux-enabled peers can pull from anyone); the
+        # CLIENT side multiplexes only when the killswitch allows.
+        self._mux_state = MuxServerState()
+        self._pull_mux: Optional[PullMuxManager] = (
+            PullMuxManager(self._ioloop.loop, self._executor, self._pool,
+                           self._flags)
+            if mux_enabled(self._flags) else None)
         self._server = RpcServer(port=port, ioloop=self._ioloop,
                                  ssl_manager=server_ssl_manager)
-        self._server.add_handler(ReplicatorHandler(self._dbs))
+        self._server.add_handler(
+            ReplicatorHandler(self._dbs, mux_state=self._mux_state))
         self._server.start()
+        # parked long-polls on THIS replica (per-shard parks + parked mux
+        # sessions): the fleet A/B's park gauge, per-port so in-process
+        # topologies keep one series per replica
+        self._parked_gauge = tagged("replicator.parked_longpolls",
+                                    port=str(self._server.port))
+        Stats.get().add_gauge(self._parked_gauge, self._parked_longpolls)
         self._maintenance_stop = threading.Event()
         self._maintenance = threading.Thread(
             target=self._maintenance_loop, name="replicator-maint", daemon=True
@@ -131,6 +147,7 @@ class Replicator:
             leader_resolver=leader_resolver,
             epoch=epoch,
             stat_tags={"port": str(self.port)},
+            mux=self._pull_mux,
         )
         if not self._dbs.add(name, rdb):
             raise ValueError(f"db already exists: {name}")
@@ -255,12 +272,23 @@ class Replicator:
             for _name, rdb in self._dbs.items():
                 rdb._iter_cache.evict_idle()
 
+    def _parked_longpolls(self) -> float:
+        """Gauge: serves currently parked on this replica — per-shard
+        long-poll parks plus parked mux sessions."""
+        total = self._mux_state.parked
+        for _name, rdb in self._dbs.items():
+            total += rdb._parked_serves
+        return float(total)
+
     def stop(self) -> None:
         self._maintenance_stop.set()
+        if self._pull_mux is not None:
+            self._pull_mux.stop()
         for _name, rdb in list(self._dbs.items()):
             rdb.stop()
             self._unregister_shard_gauges(rdb)
         self._dbs.clear()
+        Stats.get().remove_gauge(self._parked_gauge)
         self._server.stop()
         self._ioloop.run_sync(self._pool.close())
         self._executor.shutdown(wait=False)

@@ -101,4 +101,15 @@ REPLICATOR_METRICS = dict(
     replication_lag_ms="replicator.replication_lag_ms",
     iter_cache_hits="replicator.iter_cache_hits",
     iter_cache_misses="replicator.iter_cache_misses",
+    # Multiplexed per-peer pull sessions (round 22). A mux pull is ONE
+    # long-poll frame carrying every shard this node pulls from that
+    # peer; the park counters are the fleet-density A/B's primary
+    # signal — at 100 idle shards the per-shard path parks 100 serves
+    # per poll window, the mux path parks one per peer session.
+    mux_pulls="replicator.mux_pulls",                # client: mux rounds
+    mux_requests="replicator.mux_requests",          # server: mux serves
+    mux_sections="replicator.mux_sections_served",   # server: sections
+    mux_parks="replicator.mux_parks",                # server: session parks
+    longpoll_parks="replicator.longpoll_parks",      # server: per-shard parks
+    mux_fallbacks="replicator.mux_fallbacks",        # legacy-peer fallbacks
 )

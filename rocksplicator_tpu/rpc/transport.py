@@ -52,6 +52,7 @@ from dataclasses import dataclass
 from typing import Awaitable, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..testing import failpoints as fp
+from ..utils.stats import Stats
 from .errors import RpcTransportConfigError
 from .framing import (
     FrameBuffer,
@@ -61,6 +62,14 @@ from .framing import (
 )
 
 log = logging.getLogger(__name__)
+
+# Process-wide frame counters (round 22): the fleet A/B's frames/sec
+# signal — 100 per-shard pull streams vs one mux session per peer show
+# up HERE first. Counted once per frame at each transport's send/recv
+# choke point (thread-buffered Stats incr; negligible next to the frame
+# encode itself).
+M_FRAMES_SENT = "rpc.frames_sent"
+M_FRAMES_RECEIVED = "rpc.frames_received"
 
 SCHEMES = ("tcp", "uds", "loopback")
 
@@ -257,9 +266,12 @@ class TcpConnection(Connection):
         async with self._lock:
             for header, chunks in frames:
                 await write_frame(self._writer, header, chunks)
+        Stats.get().incr(M_FRAMES_SENT, len(frames))
 
     async def recv_frames(self) -> List[Tuple[memoryview, memoryview]]:
-        return [await self._reader.read_frame()]
+        frame = await self._reader.read_frame()
+        Stats.get().incr(M_FRAMES_RECEIVED)
+        return [frame]
 
     def close(self) -> None:
         self._writer.close()
@@ -376,6 +388,7 @@ class UdsConnection(Connection):
                 raise fp.FailpointError(f"torn frame at +{cut}B")
             parts.extend(frame_parts)
             self.frames_sent += 1
+        Stats.get().incr(M_FRAMES_SENT, len(frames))
         await self._enqueue(parts)
 
     def _enqueue(self, parts: List[bytes]) -> "asyncio.Future[None]":
@@ -501,6 +514,7 @@ class UdsConnection(Connection):
         for _ in frames:
             await fp.async_hit("rpc.frame.recv")
         self.frames_received += len(frames)
+        Stats.get().incr(M_FRAMES_RECEIVED, len(frames))
         return frames
 
     # -- lifecycle ------------------------------------------------------
@@ -669,6 +683,7 @@ class LoopbackConnection(Connection):
                 payload = memoryview(b"".join(chunks))
             peer._push(("frame", memoryview(header), payload))
             self.frames_sent += 1
+        Stats.get().incr(M_FRAMES_SENT, len(frames))
 
     def _push(self, item) -> None:
         self._q.append(item)
@@ -698,6 +713,7 @@ class LoopbackConnection(Connection):
         for _ in frames:
             await fp.async_hit("rpc.frame.recv")
         self.frames_received += len(frames)
+        Stats.get().incr(M_FRAMES_RECEIVED, len(frames))
         return frames
 
     def close(self) -> None:

@@ -46,6 +46,13 @@ REGISTRY = {
     "rpc.frame.recv": "RPC frame receive failure",
     "repl.pull": "replication pull RPC failure",
     "repl.apply": "follower apply failure",
+    # multiplexed per-peer pull sessions (round 22): serve is the
+    # server-side session seam (a fault fails the WHOLE mux response —
+    # the torn-session shape; per-SECTION faults ride the per-shard
+    # serve path's existing seams), apply is the client-side demux seam
+    # hit once per non-empty section before its apply is scheduled
+    "repl.mux.serve": "mux session serve failure (whole response)",
+    "repl.mux.apply": "mux per-section apply handoff failure",
     "repl.read": "bounded-staleness read-path failure at the replica",
     "router.read_pick": "router read host-pick failure",
     "ack.expire": "ack-window expiry timer blip",

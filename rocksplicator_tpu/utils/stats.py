@@ -231,6 +231,15 @@ class Stats:
         self._buffers_lock = threading.Lock()
         self._flush_interval = 1.0
         self._last_flush = 0.0
+        # whole-process scrape-dump cache (round 22): at fleet shape a
+        # scrape walks every registered series and evaluates every
+        # shard's gauges — O(shards) per scrape per SCRAPER. One cached
+        # pass with a short TTL makes concurrent/periodic scrapers
+        # (spectator, /metrics pollers, stats RPC) share it.
+        self._dump_ttl = 0.5
+        self._dump_lock = threading.Lock()
+        self._export_cache: Tuple[float, Optional[Dict]] = (0.0, None)
+        self._prom_cache: Tuple[float, Optional[str]] = (0.0, None)
 
     # -- singleton --------------------------------------------------------
 
@@ -426,6 +435,42 @@ class Stats:
             "metrics": metrics,
             "gauges": self.gauge_values(),
         }
+
+    def export_state_cached(self, max_age: Optional[float] = None) -> Dict:
+        """``export_state`` behind the whole-process dump cache: one
+        registry pass (and ONE gauge-callback sweep — the O(shards)
+        cost) serves every scraper inside the TTL. Single-flight: a
+        scraper finding the cache stale builds the dump under the dump
+        lock while concurrent scrapers wait and reuse it. Callers must
+        treat the dict as frozen (the stats-RPC handler copies the top
+        level before annotating)."""
+        ttl = self._dump_ttl if max_age is None else max_age
+        at, cached = self._export_cache
+        if cached is not None and time.monotonic() - at < ttl:
+            return cached
+        with self._dump_lock:
+            at, cached = self._export_cache
+            if cached is not None and time.monotonic() - at < ttl:
+                return cached
+            state = self.export_state()
+            self._export_cache = (time.monotonic(), state)
+            return state
+
+    def dump_prometheus_cached(self, max_age: Optional[float] = None) -> str:
+        """``dump_prometheus`` behind the same short-TTL cache (its own
+        slot — the two dumps have different shapes but share the
+        sub-linear-in-scrapers property)."""
+        ttl = self._dump_ttl if max_age is None else max_age
+        at, cached = self._prom_cache
+        if cached is not None and time.monotonic() - at < ttl:
+            return cached
+        with self._dump_lock:
+            at, cached = self._prom_cache
+            if cached is not None and time.monotonic() - at < ttl:
+                return cached
+            text = self.dump_prometheus()
+            self._prom_cache = (time.monotonic(), text)
+            return text
 
     def dump_prometheus(self) -> str:
         """Prometheus text exposition of counters, gauges, and the

@@ -47,7 +47,9 @@ class StatusServer:
             # text exposition (counters/gauges + log-bucket histograms
             # as native histogram lines) and the raw mergeable state the
             # spectator scrape consumes
-            "/metrics": lambda: Stats.get().dump_prometheus(),
+            # cached (0.5s TTL): a 100-shard node's gauge sweep runs
+            # once per TTL regardless of how many scrapers poll
+            "/metrics": lambda: Stats.get().dump_prometheus_cached(),
             "/stats.json": _dump_stats_json,
             "/flags.txt": FLAGS.dump_text,
             "/gflags.txt": FLAGS.dump_text,  # reference-compatible alias

@@ -1,0 +1,431 @@
+"""Multiplexed per-peer pull sessions (round 22) — edge semantics.
+
+One mux session per upstream peer must preserve every PER-SHARD
+guarantee: an epoch bump fences one section (not the session), a WAL_GAP
+stalls one shard's catch-up (not the session), a torn frame mid-response
+leaves no shard half-applied, and a peer that predates ``replicate_mux``
+gets automatic per-shard fallback. Plus the two round-22 satellites:
+the fast-first-connect backoff tier and the cached whole-process stats
+dump (sub-linear scrape cost in registered shards).
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import pytest
+
+from rocksplicator_tpu.replication import (
+    ReplicaRole,
+    ReplicationFlags,
+    Replicator,
+    StorageDbWrapper,
+)
+from rocksplicator_tpu.replication.wire import REPLICATOR_METRICS as M
+from rocksplicator_tpu.rpc.errors import RpcApplicationError
+from rocksplicator_tpu.storage import DB, DBOptions, WriteBatch
+from rocksplicator_tpu.testing import failpoints as fp
+from rocksplicator_tpu.utils.stats import Stats
+
+MUXFAST = ReplicationFlags(
+    server_long_poll_ms=400,
+    pull_error_delay_min_ms=50,
+    pull_error_delay_max_ms=120,
+    pull_fast_first_attempts=3,
+    pull_fast_min_ms=10,
+    pull_fast_max_ms=30,
+    empty_pulls_before_reset=1000,
+    pull_mux=True,
+)
+
+
+class Host:
+    def __init__(self, tmp_path, name, flags=MUXFAST):
+        self.name = name
+        self.dir = tmp_path / name
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.replicator = Replicator(port=0, flags=flags)
+        self.dbs = {}
+
+    @property
+    def addr(self):
+        return ("127.0.0.1", self.replicator.port)
+
+    def add_db(self, db_name, role, upstream=None, mode=0, **db_kw):
+        db = DB(str(self.dir / db_name), DBOptions(**db_kw))
+        self.dbs[db_name] = db
+        rdb = self.replicator.add_db(
+            db_name, StorageDbWrapper(db), role,
+            upstream_addr=upstream, replication_mode=mode,
+        )
+        return db, rdb
+
+    def stop(self):
+        self.replicator.stop()
+        for db in self.dbs.values():
+            db.close()
+
+
+@pytest.fixture()
+def hosts(tmp_path):
+    created = []
+
+    def make(name, flags=MUXFAST):
+        h = Host(tmp_path, name, flags)
+        created.append(h)
+        return h
+
+    yield make
+    for h in created:
+        h.stop()
+
+
+def wait_until(pred, timeout=12.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def counter_total(name):
+    s = Stats.get()
+    s.flush()
+    return s.export_state()["counters"].get(name, {}).get("total", 0.0)
+
+
+def in_sync(ldb, fdb):
+    return (ldb.latest_sequence_number() == fdb.latest_sequence_number()
+            and ldb.latest_sequence_number() > 0)
+
+
+# ---------------------------------------------------------------------------
+# mux basics
+# ---------------------------------------------------------------------------
+
+
+def test_mux_many_shards_one_session(hosts):
+    """8 shards from one peer converge through ONE mux session: the mux
+    round count is shared across shards (no per-shard pull streams)."""
+    leader, follower = hosts("l"), hosts("f")
+    pairs = []
+    for i in range(8):
+        name = f"seg{i:05d}"
+        ldb, _ = leader.add_db(name, ReplicaRole.LEADER, mode=2)
+        fdb, _ = follower.add_db(name, ReplicaRole.FOLLOWER,
+                                 upstream=leader.addr, mode=2)
+        pairs.append((name, ldb, fdb))
+    for name, *_ in pairs:
+        for k in range(20):
+            leader.replicator.write(
+                name, WriteBatch().put(f"k{k}".encode(), name.encode()))
+    assert wait_until(lambda: all(in_sync(l, f) for _n, l, f in pairs))
+    assert counter_total(M["mux_pulls"]) > 0
+    assert counter_total(M["mux_requests"]) > 0
+    # the whole point: sections-served outnumbers mux rounds (many
+    # shards per round), and the follower ran NO solo pull loops
+    assert (counter_total(M["mux_sections"])
+            > counter_total(M["mux_requests"]))
+    for name, _l, _f in pairs:
+        assert follower.replicator.get_db(name)._pull_task is None
+    # mode-2 acked write end to end through the mux ack path
+    w = leader.replicator.get_db(pairs[0][0]).write_async(
+        WriteBatch().put(b"fin", b"al"))
+    w.future.result(5)
+    assert w.acked
+
+
+def test_mux_epoch_bump_fences_one_section(hosts):
+    """An epoch bump carried on ONE shard's section fences THAT shard at
+    the serving leader — the session and every other section keep
+    replicating."""
+    leader, follower = hosts("l"), hosts("f")
+    names = [f"seg{i:05d}" for i in range(3)]
+    ldbs, frdbs, fdbs = {}, {}, {}
+    for n in names:
+        ldbs[n], _ = leader.add_db(n, ReplicaRole.LEADER)
+        fdbs[n], frdbs[n] = follower.add_db(n, ReplicaRole.FOLLOWER,
+                                            upstream=leader.addr)
+    for n in names:
+        leader.replicator.write(n, WriteBatch().put(b"a", b"1"))
+    assert wait_until(lambda: all(in_sync(ldbs[n], fdbs[n]) for n in names))
+    # the middle shard's puller learns a newer epoch (a raced promotion)
+    frdbs[names[1]].adopt_epoch(7)
+    lrdb1 = leader.replicator.get_db(names[1])
+    assert wait_until(lambda: lrdb1.fenced)
+    # the fenced LEADER refuses writes on that shard only
+    with pytest.raises(RpcApplicationError) as ei:
+        leader.replicator.write(names[1], WriteBatch().put(b"b", b"2"))
+    assert ei.value.code == "STALE_EPOCH"
+    # ...while its siblings replicate on, through the same session
+    for n in (names[0], names[2]):
+        leader.replicator.write(n, WriteBatch().put(b"b", b"2"))
+    assert wait_until(lambda: all(
+        in_sync(ldbs[n], fdbs[n]) for n in (names[0], names[2])))
+    assert not leader.replicator.get_db(names[0]).fenced
+    assert not leader.replicator.get_db(names[2]).fenced
+
+
+def test_mux_wal_gap_stalls_one_section(hosts):
+    """A WAL_GAP answer on one section flags THAT shard's snapshot
+    rebuild; sibling sections replicate on."""
+    from rocksplicator_tpu.storage import wal as wal_mod
+
+    leader = hosts("l")
+    gap, ok = "seg00000", "seg00001"
+    lgap, _ = leader.add_db(gap, ReplicaRole.LEADER, wal_segment_bytes=200)
+    lok, _ = leader.add_db(ok, ReplicaRole.LEADER)
+    for i in range(20):
+        leader.replicator.write(gap, WriteBatch().put(f"k{i}".encode(), b"v"))
+        leader.replicator.write(ok, WriteBatch().put(f"k{i}".encode(), b"v"))
+    lgap.flush()
+    removed = wal_mod.purge_obsolete(os.path.join(lgap.path, "wal"),
+                                     persisted_seq=20, ttl_seconds=0.0)
+    assert removed > 0
+    follower = hosts("f")
+    fgap, frgap = follower.add_db(gap, ReplicaRole.FOLLOWER,
+                                  upstream=leader.addr)
+    fok, _ = follower.add_db(ok, ReplicaRole.FOLLOWER, upstream=leader.addr)
+    # the healthy sibling converges through the session...
+    assert wait_until(lambda: in_sync(lok, fok))
+    # ...while the purged-history shard stalls with the typed rebuild flag
+    assert wait_until(lambda: frgap.pull_stalled_wal_gap)
+    assert fgap.latest_sequence_number() == 0
+
+
+def test_mux_torn_response_no_half_apply(hosts):
+    """A torn frame / failed serve mid-session must not half-apply any
+    shard: the response decodes all-or-nothing and each section's apply
+    revalidates seq continuity, so after the fault clears everything
+    converges exactly."""
+    leader, follower = hosts("l"), hosts("f")
+    names = [f"seg{i:05d}" for i in range(4)]
+    ldbs, fdbs = {}, {}
+    for n in names:
+        ldbs[n], _ = leader.add_db(n, ReplicaRole.LEADER)
+        fdbs[n], _ = follower.add_db(n, ReplicaRole.FOLLOWER,
+                                     upstream=leader.addr)
+    for n in names:
+        leader.replicator.write(n, WriteBatch().put(b"w0", b"x"))
+    assert wait_until(lambda: all(in_sync(ldbs[n], fdbs[n]) for n in names))
+    # tear the next wire frame (request or response — either way the
+    # session sees a connection-class error mid-exchange) and fail one
+    # whole mux serve for good measure
+    fp.activate("rpc.frame.send", "fail_nth:1")
+    fp.activate("repl.mux.serve", "fail_nth:2")
+    try:
+        for i in range(10):
+            for n in names:
+                leader.replicator.write(
+                    n, WriteBatch().put(f"k{i}".encode(), b"y"))
+        assert wait_until(
+            lambda: all(in_sync(ldbs[n], fdbs[n]) for n in names))
+    finally:
+        fp.deactivate("rpc.frame.send")
+        fp.deactivate("repl.mux.serve")
+    for n in names:
+        assert fdbs[n].get(b"k9") == b"y"
+
+
+def test_mux_legacy_peer_falls_back_per_shard(hosts):
+    """Shards whose upstream peer predates replicate_mux drop to solo
+    pull loops automatically; shards on a mux-capable peer stay muxed —
+    mixed fleets replicate both ways."""
+    mux_leader, old_leader, follower = hosts("lm"), hosts("lo"), hosts("f")
+    # simulate a pre-mux peer: its handler refuses the method
+    for h in old_leader.replicator._server._handlers:
+        h.handle_replicate_mux = None
+    lm, _ = mux_leader.add_db("seg00000", ReplicaRole.LEADER)
+    lo, _ = old_leader.add_db("seg00001", ReplicaRole.LEADER)
+    fm, _ = follower.add_db("seg00000", ReplicaRole.FOLLOWER,
+                            upstream=mux_leader.addr)
+    fo, _ = follower.add_db("seg00001", ReplicaRole.FOLLOWER,
+                            upstream=old_leader.addr)
+    mux_leader.replicator.write("seg00000", WriteBatch().put(b"k", b"m"))
+    old_leader.replicator.write("seg00001", WriteBatch().put(b"k", b"o"))
+    assert wait_until(lambda: in_sync(lm, fm) and in_sync(lo, fo))
+    assert fm.get(b"k") == b"m" and fo.get(b"k") == b"o"
+    assert counter_total(M["mux_fallbacks"]) >= 1
+    # fallback shard runs its own loop; mux shard does not
+    assert follower.replicator.get_db("seg00001")._pull_task is not None
+    assert follower.replicator.get_db("seg00000")._pull_task is None
+    # a LATER shard against the known-legacy peer skips mux entirely
+    lo2, _ = old_leader.add_db("seg00002", ReplicaRole.LEADER)
+    fo2, _ = follower.add_db("seg00002", ReplicaRole.FOLLOWER,
+                             upstream=old_leader.addr)
+    old_leader.replicator.write("seg00002", WriteBatch().put(b"k", b"2"))
+    assert wait_until(lambda: in_sync(lo2, fo2))
+    assert follower.replicator.get_db("seg00002")._pull_task is not None
+
+
+def test_mux_session_budget_rotation_no_starvation(hosts):
+    """A session budget smaller than one shard's backlog must not starve
+    any section: the rotation drains every shard to convergence."""
+    flags = ReplicationFlags(
+        server_long_poll_ms=400,
+        pull_error_delay_min_ms=50,
+        pull_error_delay_max_ms=120,
+        empty_pulls_before_reset=1000,
+        pull_mux=True,
+        mux_session_budget=8,
+    )
+    leader, follower = hosts("l", flags), hosts("f", flags)
+    names = [f"seg{i:05d}" for i in range(3)]
+    ldbs, fdbs = {}, {}
+    for n in names:
+        ldbs[n], _ = leader.add_db(n, ReplicaRole.LEADER)
+    for n in names:
+        for i in range(40):
+            leader.replicator.write(
+                n, WriteBatch().put(f"k{i}".encode(), b"v"))
+    for n in names:
+        fdbs[n], _ = follower.add_db(n, ReplicaRole.FOLLOWER,
+                                     upstream=leader.addr)
+    assert wait_until(lambda: all(in_sync(ldbs[n], fdbs[n]) for n in names))
+
+
+def test_mux_observer_and_commit_point(hosts):
+    """OBSERVER sections ride the same session (acks never counted), and
+    commit-point attestations arrive per section (bounded follower
+    reads keep working under mux)."""
+    leader, follower = hosts("l"), hosts("f")
+    ldb, _ = leader.add_db("seg00000", ReplicaRole.LEADER, mode=2)
+    fdb, frdb = follower.add_db("seg00000", ReplicaRole.OBSERVER,
+                                upstream=leader.addr, mode=2)
+    for i in range(5):
+        w = leader.replicator.get_db("seg00000").write_async(
+            WriteBatch().put(f"k{i}".encode(), b"v"))
+    assert wait_until(lambda: in_sync(ldb, fdb))
+    assert wait_until(lambda: frdb._upstream_latest is not None)
+    est, _heard = frdb._upstream_latest
+    assert est == ldb.latest_sequence_number()
+
+
+# ---------------------------------------------------------------------------
+# satellite: fast-first-connect backoff tier
+# ---------------------------------------------------------------------------
+
+
+def test_fast_first_connect_backoff_tier(hosts, monkeypatch):
+    """First-connect retries ride the jittered fast tier (100-500ms
+    default) instead of the 5-10s steady floor — the fleet cold-start
+    fix — then fall back to the floor; the jitter is reproducible under
+    RSTPU_PULL_RETRY_SEED."""
+    monkeypatch.setenv("RSTPU_PULL_RETRY_SEED", "1234")
+    flags = ReplicationFlags()  # stock 5-10s floor, 100-500ms fast tier
+    h = hosts("l", flags)
+    _db, rdb = h.add_db("seg00000", ReplicaRole.LEADER)  # no pull loop
+    delays = [rdb._next_pull_delay() for _ in range(7)]
+    fast, steady = delays[:flags.pull_fast_first_attempts], \
+        delays[flags.pull_fast_first_attempts:]
+    for d in fast:
+        assert flags.pull_fast_min_ms / 1000.0 <= d \
+            <= flags.pull_fast_max_ms / 1000.0
+    for d in steady:
+        assert d >= flags.pull_error_delay_min_ms / 1000.0
+    # seeded → reproducible
+    _db2, rdb2 = h.add_db("seg00001", ReplicaRole.LEADER)
+    assert [rdb2._next_pull_delay() for _ in range(7)] == delays
+    # after ANY successful pull the fast tier is over
+    _db3, rdb3 = h.add_db("seg00002", ReplicaRole.LEADER)
+    rdb3._mark_pull_ok()
+    assert rdb3._next_pull_delay() >= flags.pull_error_delay_min_ms / 1000.0
+
+
+def test_fast_first_connect_converges_quickly(hosts):
+    """Integration shape of the same fix: a follower whose first pulls
+    fail (upstream briefly dark) converges within a couple of fast-tier
+    retries, far inside the old 5s floor."""
+    flags = ReplicationFlags(
+        server_long_poll_ms=300,
+        pull_error_delay_min_ms=5_000,   # the OLD floor — must not bite
+        pull_error_delay_max_ms=10_000,
+        pull_fast_first_attempts=8,
+        pull_fast_min_ms=30,
+        pull_fast_max_ms=80,
+        empty_pulls_before_reset=1000,
+        pull_mux=True,
+    )
+    leader, follower = hosts("l", flags), hosts("f", flags)
+    ldb, _ = leader.add_db("seg00000", ReplicaRole.LEADER)
+    leader.replicator.write("seg00000", WriteBatch().put(b"k", b"v"))
+    # first mux rounds fail at the pull seam, then clear
+    fp.activate("repl.pull", "fail_nth:1")
+    try:
+        t0 = time.monotonic()
+        fdb, _ = follower.add_db("seg00000", ReplicaRole.FOLLOWER,
+                                 upstream=leader.addr)
+        assert wait_until(lambda: in_sync(ldb, fdb), timeout=4.0)
+        # converged through fast-tier retries — the 5s floor never bit
+        assert time.monotonic() - t0 < 4.0
+    finally:
+        fp.deactivate("repl.pull")
+
+
+# ---------------------------------------------------------------------------
+# satellite: cached whole-process stats dump
+# ---------------------------------------------------------------------------
+
+
+def test_stats_scrape_cost_sublinear_in_shards():
+    """K scrapes within the cache TTL cost ONE gauge sweep (O(shards)),
+    not K — the scrape-cost fix for 100-shard nodes. Outside the TTL a
+    fresh pass runs."""
+    Stats.reset_for_test()
+    try:
+        stats = Stats.get()
+        calls = {"n": 0}
+        NSHARDS = 40
+
+        def make_gauge(i):
+            def cb():
+                calls["n"] += 1
+                return float(i)
+            return cb
+
+        for i in range(NSHARDS):
+            stats.add_gauge(f"replicator.fake_lag db=seg{i:05d}",
+                            make_gauge(i))
+        for _ in range(10):
+            state = stats.export_state_cached()
+        assert len(state["gauges"]) == NSHARDS
+        assert calls["n"] == NSHARDS  # one pass for 10 scrapes
+        for _ in range(10):
+            stats.dump_prometheus_cached()
+        assert calls["n"] == 2 * NSHARDS  # its own single pass
+        # TTL expiry → exactly one more pass
+        stats._export_cache = (0.0, None)
+        stats.export_state_cached()
+        assert calls["n"] == 3 * NSHARDS
+        # the RAW dump still pays per call (the cached one is the fix)
+        stats.export_state()
+        stats.export_state()
+        assert calls["n"] == 5 * NSHARDS
+    finally:
+        Stats.reset_for_test()
+
+
+def test_stats_rpc_uses_cached_dump(hosts):
+    """The stats RPC annotates a COPY — the shared cached dict must not
+    grow a shard_roles key."""
+    h = hosts("l")
+    h.add_db("seg00000", ReplicaRole.LEADER)
+    from rocksplicator_tpu.rpc.client_pool import RpcClientPool
+
+    pool = RpcClientPool()
+    loop = h.replicator.ioloop.loop
+
+    async def scrape():
+        client = await pool.get_client(*h.addr)
+        a = await client.call("stats", {})
+        b = await client.call("stats", {})
+        await pool.close()
+        return a, b
+
+    a, b = asyncio.run_coroutine_threadsafe(scrape(), loop).result(10)
+    assert a["shard_roles"] == {"seg00000": "LEADER"}
+    assert b["shard_roles"] == {"seg00000": "LEADER"}
+    cached = Stats.get().export_state_cached()
+    assert "shard_roles" not in cached

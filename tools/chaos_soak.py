@@ -161,6 +161,13 @@ standing invariant**:
   resume re-applies them. The re-apply is invisible to state-compare
   (idempotent upserts) — the applies-counter witness must catch
   ``applies_total > produced`` at quiesce.
+- ``mux_misroute`` (data-plane only; forces ``RSTPU_PULL_MUX=1``) — the
+  session-demux bug class: the mux serve files one shard's updates
+  under its sibling's section key, seqs restamped off the victim's
+  cursor (the index-off-by-one a mux serve loop can ship). The batch is
+  perfectly continuous, so the apply-side guard cannot reject it — the
+  zero-acked-loss / reconvergence invariants over BOTH chaos shards
+  must catch the cross-shard bleed.
 
 Usage::
 
@@ -178,6 +185,9 @@ Usage::
         --expect-violation                                      # tooth
     python -m tools.chaos_soak --cdc --schedules 5 --seed 1
     python -m tools.chaos_soak --cdc --break-guard cdc_dedup \
+        --expect-violation                                      # tooth
+    RSTPU_PULL_MUX=1 python -m tools.chaos_soak --schedules 6   # mux deck
+    python -m tools.chaos_soak --break-guard mux_misroute \
         --expect-violation                                      # tooth
 """
 
@@ -209,6 +219,12 @@ from rocksplicator_tpu.testing import failpoints as fp
 from rocksplicator_tpu.utils.objectstore import LocalObjectStore
 
 DB_NAME = "seg00001"
+# sibling shard on the same 3 hosts (round 22): with RSTPU_PULL_MUX=1
+# every follower's pull session to the leader carries BOTH shards'
+# sections, so session-level faults and the mux_misroute tooth exercise
+# real multi-shard demux — and the standing invariants cover
+# cross-shard bleed
+DB2_NAME = "seg00002"
 
 # quick-recovery flags: chaos wants many fault→heal cycles per minute,
 # not the reference's production 5-10s backoffs
@@ -287,6 +303,15 @@ def _fault_menu(rng: random.Random) -> List[Tuple[str, str]]:
         ("compact.stream.chunk", f"fail_nth:{rng.randint(1, 4)}"),
         ("compact.stream.refill",
          f"fail_prob:{rng.uniform(0.02, 0.15):.3f}@seed{s}"),
+        # round 22: the mux session seams — a serve fault fails the
+        # WHOLE multiplexed response (every section of the session
+        # retries together, the torn-session shape), an apply fault
+        # kills ONE section's client-side demux handoff. With
+        # RSTPU_PULL_MUX=1 the decks cross them on every pull round;
+        # with mux off they arm but the per-shard path never trips them
+        ("repl.mux.serve",
+         f"fail_prob:{rng.uniform(0.02, 0.10):.3f}@seed{s}"),
+        ("repl.mux.apply", f"fail_nth:{rng.randint(1, 3)}"),
     ]
 
 
@@ -302,22 +327,34 @@ _INGEST_FAULTS = [
 
 
 class ChaosCluster:
-    """Leader + 2 followers over TCP loopback, semi-sync (mode 1)."""
+    """Leader + 2 followers over TCP loopback, semi-sync (mode 1), two
+    shards per host (DB_NAME + DB2_NAME) so muxed pull sessions carry
+    multiple sections."""
 
     def __init__(self, root: str):
         self.root = root
         self.hosts: List[Replicator] = [
             Replicator(port=0, flags=FLAGS) for _ in range(3)]
         self.dbs: List[DB] = []
+        self.dbs2: List[DB] = []
         self.rdbs = []
+        self.rdbs2 = []
         leader_addr = ("127.0.0.1", self.hosts[0].port)
         for i, rep in enumerate(self.hosts):
+            role = ReplicaRole.LEADER if i == 0 else ReplicaRole.FOLLOWER
             db = DB(os.path.join(root, f"n{i}", DB_NAME),
                     DBOptions(**DB_OPTS))
             self.dbs.append(db)
-            role = ReplicaRole.LEADER if i == 0 else ReplicaRole.FOLLOWER
             self.rdbs.append(rep.add_db(
                 DB_NAME, StorageDbWrapper(db), role,
+                upstream_addr=None if i == 0 else leader_addr,
+                replication_mode=1,
+            ))
+            db2 = DB(os.path.join(root, f"n{i}", DB2_NAME),
+                     DBOptions(**DB_OPTS))
+            self.dbs2.append(db2)
+            self.rdbs2.append(rep.add_db(
+                DB2_NAME, StorageDbWrapper(db2), role,
                 upstream_addr=None if i == 0 else leader_addr,
                 replication_mode=1,
             ))
@@ -326,10 +363,17 @@ class ChaosCluster:
     def leader(self):
         return self.rdbs[0]
 
+    @property
+    def leader2(self):
+        return self.rdbs2[0]
+
     def converged(self) -> bool:
-        lat = self.dbs[0].latest_sequence_number_relaxed()
-        return all(db.latest_sequence_number_relaxed() == lat
-                   for db in self.dbs[1:])
+        for group in (self.dbs, self.dbs2):
+            lat = group[0].latest_sequence_number_relaxed()
+            if any(db.latest_sequence_number_relaxed() != lat
+                   for db in group[1:]):
+                return False
+        return True
 
     def wait_converged(self, timeout: float) -> bool:
         deadline = time.monotonic() + timeout
@@ -342,7 +386,7 @@ class ChaosCluster:
     def stop(self) -> None:
         for rep in self.hosts:
             rep.stop()
-        for db in self.dbs:
+        for db in self.dbs + self.dbs2:
             db.close()
 
 
@@ -1135,6 +1179,64 @@ def _break_guard(kind: str):
             lambda job_epoch, current_epoch: True
         return lambda: setattr(
             rc_install, "_epoch_is_current", orig_gate)
+    if kind == "mux_misroute":
+        # the session-demux bug class (round 22): the server drains the
+        # right WALs but files one shard's updates under its SIBLING's
+        # section key — cursor bookkeeping intact, seqs restamped off
+        # the victim's cursor, which is exactly what an index-off-by-one
+        # in the serve loop produces. The apply side sees a perfectly
+        # CONTINUOUS batch of the wrong shard's bytes, so the
+        # seq-continuity guard cannot reject it — only the standing
+        # invariants can catch it: acked writes on the donor shard never
+        # reach the followers (zero-acked-loss), and the victim shard
+        # runs ahead of its leader (reconvergence never lands). Forces
+        # RSTPU_PULL_MUX=1 for the run — the tooth targets the mux path.
+        from rocksplicator_tpu.replication.pull_mux import MuxServerState
+
+        saved_mux = os.environ.get("RSTPU_PULL_MUX")
+        os.environ["RSTPU_PULL_MUX"] = "1"
+        orig_serve = MuxServerState.serve
+        state = {"n": 0}
+
+        def _restamp(updates, start):
+            out, seq = [], start
+            for u in updates:
+                u2 = dict(u)
+                u2["seq_no"] = seq
+                seq += int(u.get("count") or 1)
+                out.append(u2)
+            return out
+
+        async def misrouting_serve(self, db_map, sections,
+                                   max_wait_ms=None, budget=None):
+            resp = await orig_serve(self, db_map, sections,
+                                    max_wait_ms=max_wait_ms,
+                                    budget=budget)
+            out = resp.get("sections") or {}
+            live = sorted(n for n, sec in out.items()
+                          if isinstance(sec, dict) and "error" not in sec)
+            state["n"] += 1
+            if len(live) >= 2 and state["n"] % 2 == 0:
+                a, b = live[0], live[1]
+                ua = out[a].get("updates") or []
+                ub = out[b].get("updates") or []
+                if ua or ub:
+                    out[a]["updates"] = _restamp(
+                        ub, int(sections[a].get("seq_no", 0)) + 1)
+                    out[b]["updates"] = _restamp(
+                        ua, int(sections[b].get("seq_no", 0)) + 1)
+            return resp
+
+        MuxServerState.serve = misrouting_serve
+
+        def undo():
+            MuxServerState.serve = orig_serve
+            if saved_mux is None:
+                os.environ.pop("RSTPU_PULL_MUX", None)
+            else:
+                os.environ["RSTPU_PULL_MUX"] = saved_mux
+
+        return undo
     if kind == "fencing":
         # a leader that IGNORES epochs: stale-epoch frames are served and
         # acked, a deposed leader never fences — the no-split-brain
@@ -3545,7 +3647,22 @@ def run_chaos(
                              WriteBatch().put(key, val))))
                 except Exception:
                     write_errors += 1  # injected fault; write not acked
-            write_total += n_writes
+            # sibling-shard load: smaller but concurrent, so with mux on
+            # the session interleaves both shards' backlogs in one
+            # response stream under the same armed faults
+            waiters2 = []
+            n_writes2 = rng.randint(6, 14)
+            for i in range(n_writes2):
+                key = b"x%03dk%04d" % (si, i)
+                val = b"x%03dv%04d" % (si, i)
+                try:
+                    waiters2.append(
+                        (key, val,
+                         cluster.leader2.write_async(
+                             WriteBatch().put(key, val))))
+                except Exception:
+                    write_errors += 1
+            write_total += n_writes + n_writes2
             acked: List[Tuple[bytes, bytes]] = []
             for key, val, w in waiters:
                 try:
@@ -3554,7 +3671,15 @@ def run_chaos(
                     continue
                 if w.acked:
                     acked.append((key, val))
-            acked_total += len(acked)
+            acked2: List[Tuple[bytes, bytes]] = []
+            for key, val, w in waiters2:
+                try:
+                    w.future.result(5.0)
+                except Exception:
+                    continue
+                if w.acked:
+                    acked2.append((key, val))
+            acked_total += len(acked) + len(acked2)
             # -- heal + verify --------------------------------------------
             for site, _spec in faults:
                 fp.deactivate(site)
@@ -3564,14 +3689,20 @@ def run_chaos(
                 violations.append(
                     f"{tag}: no reconvergence {conv_timeout}s after "
                     f"faults cleared (seqs {lat}, faults {faults})")
-            for i, db in enumerate(cluster.dbs):
+            for i, db in enumerate(cluster.dbs + cluster.dbs2):
                 msg = check_wal_contiguous(db)
                 if msg:
                     violations.append(
-                        f"{tag}: node {i}: {msg} (faults {faults})")
+                        f"{tag}: node {i % 3} "
+                        f"({DB_NAME if i < 3 else DB2_NAME}): {msg} "
+                        f"(faults {faults})")
             lost = []
             for key, val in acked:
                 for i, db in enumerate(cluster.dbs):
+                    if db.get(key) != val:
+                        lost.append((i, key))
+            for key, val in acked2:
+                for i, db in enumerate(cluster.dbs2):
                     if db.get(key) != val:
                         lost.append((i, key))
             if lost:
@@ -3588,7 +3719,8 @@ def run_chaos(
                 remote.step(rng, violations, tag)
             gauge_snapshots.append(_gauge_snapshot(tag))
             log(f"  [{si + 1}/{schedules}] faults={faults} "
-                f"writes={n_writes} acked={len(acked)} "
+                f"writes={n_writes + n_writes2} "
+                f"acked={len(acked) + len(acked2)} "
                 f"errors={write_errors} "
                 f"violations={len(violations)}")
             if violations and break_guard:
@@ -3674,7 +3806,8 @@ def main(argv=None) -> int:
     ap.add_argument("--break-guard",
                     choices=["wal_hole", "meta_first", "fencing",
                              "move_flip", "remote_install",
-                             "split_cutover", "cdc_dedup"])
+                             "split_cutover", "cdc_dedup",
+                             "mux_misroute"])
     ap.add_argument("--expect-violation", action="store_true",
                     help="exit 0 iff a violation WAS caught")
     ap.add_argument("--conv-timeout", type=float, default=30.0)
@@ -3688,6 +3821,10 @@ def main(argv=None) -> int:
         ap.error("--break-guard split_cutover requires --rebalance")
     if args.break_guard == "cdc_dedup" and not args.cdc:
         ap.error("--break-guard cdc_dedup requires --cdc")
+    if args.break_guard == "mux_misroute" and (
+            args.failover or args.reshard or args.rebalance or args.cdc):
+        ap.error("--break-guard mux_misroute is data-plane only "
+                 "(drop --failover/--reshard/--rebalance/--cdc)")
     if args.break_guard == "remote_install":
         if args.failover or args.reshard:
             ap.error("--break-guard remote_install is data-plane only "
